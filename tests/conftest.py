@@ -1,0 +1,43 @@
+"""Test harness configuration.
+
+- Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
+  (Mesh/pjit/shard_map) is exercised without TPU hardware.  Must run before
+  the first ``import jax`` anywhere in the test session.
+- Provides a minimal async test runner (no pytest-asyncio in this image):
+  ``async def test_*`` functions run under ``asyncio.run``.
+"""
+
+import asyncio
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None
+
+
+@pytest.fixture
+def validation_root(tmp_path, monkeypatch):
+    """Relocate /run/tpu/validations into a tmpdir (UNIT_TEST seam)."""
+    root = tmp_path / "run" / "tpu"
+    root.mkdir(parents=True)
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(root))
+    return root

@@ -1,0 +1,286 @@
+"""k8s client ↔ fake apiserver integration: CRUD, selectors, watch, informer,
+apply hash-skip, leader election, DaemonSet simulator."""
+
+import asyncio
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.k8s import selectors
+from tpu_operator.k8s.apply import create_or_update
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.k8s.leader import LeaderElector
+from tpu_operator.testing import FakeCluster, SimConfig
+
+
+def cm(name, ns="default", labels=None, data=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "data": data or {},
+    }
+
+
+async def test_crud_roundtrip():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            created = await client.create(cm("a", data={"k": "v"}))
+            assert created["metadata"]["uid"]
+            got = await client.get("", "ConfigMap", "a", "default")
+            assert got["data"] == {"k": "v"}
+            got["data"]["k"] = "v2"
+            updated = await client.update(got)
+            assert int(updated["metadata"]["resourceVersion"]) > int(created["metadata"]["resourceVersion"])
+            await client.delete("", "ConfigMap", "a", "default")
+            with pytest.raises(ApiError) as exc:
+                await client.get("", "ConfigMap", "a", "default")
+            assert exc.value.not_found
+            # idempotent delete
+            assert await client.delete("", "ConfigMap", "a", "default") is None
+
+
+async def test_conflict_on_stale_update():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(cm("a"))
+            stale = await client.get("", "ConfigMap", "a", "default")
+            fresh = await client.get("", "ConfigMap", "a", "default")
+            fresh["data"] = {"x": "1"}
+            await client.update(fresh)
+            stale["data"] = {"y": "2"}
+            with pytest.raises(ApiError) as exc:
+                await client.update(stale)
+            assert exc.value.conflict
+
+
+async def test_label_selector_list():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(cm("one", labels={"app": "x", "tier": "a"}))
+            await client.create(cm("two", labels={"app": "x", "tier": "b"}))
+            await client.create(cm("three", labels={"app": "y"}))
+            items = await client.list_items("", "ConfigMap", "default", label_selector="app=x")
+            assert {i["metadata"]["name"] for i in items} == {"one", "two"}
+            items = await client.list_items("", "ConfigMap", "default", label_selector="app=x,tier in (b,c)")
+            assert {i["metadata"]["name"] for i in items} == {"two"}
+            items = await client.list_items("", "ConfigMap", "default", label_selector="!tier")
+            assert {i["metadata"]["name"] for i in items} == {"three"}
+
+
+def test_selector_parsing():
+    assert selectors.matches("a=1,b!=2,c,!d,e in (x,y)", {"a": "1", "c": "z", "e": "x"})
+    assert not selectors.matches("a=1", {"a": "2"})
+    assert selectors.matches("", {"anything": "goes"})
+    assert selectors.matches_structured(
+        {"matchLabels": {"a": "1"}, "matchExpressions": [{"key": "b", "operator": "Exists"}]},
+        {"a": "1", "b": ""},
+    )
+    assert not selectors.matches_structured({"matchLabels": {"a": "1"}}, {})
+
+
+async def test_watch_stream_and_informer():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            informer = Informer(client, "", "ConfigMap", namespace="default")
+            seen: list[tuple[str, str]] = []
+
+            async def handler(evt_type, obj):
+                seen.append((evt_type, obj["metadata"]["name"]))
+
+            informer.add_handler(handler)
+            await client.create(cm("pre"))
+            await informer.start()
+            assert informer.get("pre", "default") is not None
+            await client.create(cm("post"))
+            obj = await client.get("", "ConfigMap", "post", "default")
+            obj["data"] = {"z": "1"}
+            await client.update(obj)
+            await client.delete("", "ConfigMap", "post", "default")
+            for _ in range(100):
+                if ("DELETED", "post") in seen:
+                    break
+                await asyncio.sleep(0.02)
+            assert ("ADDED", "pre") in seen
+            assert ("ADDED", "post") in seen
+            assert ("MODIFIED", "post") in seen
+            assert ("DELETED", "post") in seen
+            assert informer.get("post", "default") is None
+            await informer.stop()
+
+
+async def test_create_or_update_hash_skip():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            obj = cm("cfg", data={"a": "1"})
+            _, changed = await create_or_update(client, obj, state_label="state-test")
+            assert changed
+            live, changed = await create_or_update(client, obj, state_label="state-test")
+            assert not changed  # identical desired state → skipped
+            assert live["metadata"]["labels"][consts.STATE_LABEL] == "state-test"
+            obj["data"]["a"] = "2"
+            _, changed = await create_or_update(client, obj, state_label="state-test")
+            assert changed
+
+
+async def test_owner_gc():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            owner = await client.create(cm("owner"))
+            child = cm("child")
+            from tpu_operator.k8s.objects import set_owner_reference
+
+            set_owner_reference(child, owner)
+            await client.create(child)
+            await client.delete("", "ConfigMap", "owner", "default")
+            with pytest.raises(ApiError):
+                await client.get("", "ConfigMap", "child", "default")
+
+
+async def test_leader_election_single_winner():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as c1, ApiClient(
+            Config(base_url=fc.base_url)
+        ) as c2:
+            e1 = LeaderElector(c1, "tpu-operator", identity="a", renew_interval=0.05, lease_duration=2)
+            e2 = LeaderElector(c2, "tpu-operator", identity="b", renew_interval=0.05, lease_duration=2)
+            await e1.start()
+            await asyncio.wait_for(e1.is_leader.wait(), 2)
+            await e2.start()
+            await asyncio.sleep(0.3)
+            assert e1.is_leader.is_set() and not e2.is_leader.is_set()
+            await e1.stop()  # releases the lease
+            await asyncio.wait_for(e2.is_leader.wait(), 3)
+            await e2.stop()
+
+
+async def test_daemonset_simulator_schedules_and_reports_ready():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        fc.add_node("tpu-node-1")
+        fc.add_node("cpu-node", tpu=False)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            ds = {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "metadata": {"name": "agent", "namespace": "tpu-operator"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "agent"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "agent"}},
+                        "spec": {
+                            "nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"},
+                            "containers": [{"name": "agent", "image": "img"}],
+                        },
+                    },
+                },
+            }
+            await client.create(ds)
+            for _ in range(200):
+                live = await client.get("apps", "DaemonSet", "agent", "tpu-operator")
+                st = live.get("status", {})
+                if st.get("desiredNumberScheduled") == 2 and st.get("numberReady") == 2:
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError(f"DS never ready: {live.get('status')}")
+            pods = await client.list_items("", "Pod", "tpu-operator")
+            assert {p["spec"]["nodeName"] for p in pods} == {"tpu-node-0", "tpu-node-1"}
+
+
+async def test_device_plugin_pod_advertises_tpu_capacity():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.01)) as fc:
+        fc.add_node("tpu-node-0", chips=8)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            ds = {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "metadata": {"name": "tpu-device-plugin", "namespace": "tpu-operator"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "tpu-device-plugin"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "tpu-device-plugin"}},
+                        "spec": {
+                            "nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"},
+                            "containers": [{"name": "plugin", "image": "img"}],
+                        },
+                    },
+                },
+            }
+            await client.create(ds)
+            for _ in range(200):
+                node = await client.get("", "Node", "tpu-node-0")
+                if node["status"].get("allocatable", {}).get(consts.TPU_RESOURCE) == "8":
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("node never advertised google.com/tpu")
+
+
+async def test_daemonset_template_update_rerolls_pods():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            ds = {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "metadata": {"name": "agent", "namespace": "tpu-operator"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "agent"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "agent"}},
+                        "spec": {"containers": [{"name": "agent", "image": "img:v1"}]},
+                    },
+                },
+            }
+            await client.create(ds)
+            for _ in range(200):
+                pods = await client.list_items("", "Pod", "tpu-operator")
+                if pods and pods[0]["status"].get("phase") == "Running":
+                    break
+                await asyncio.sleep(0.02)
+            assert pods[0]["spec"]["containers"][0]["image"] == "img:v1"
+            live = await client.get("apps", "DaemonSet", "agent", "tpu-operator")
+            live["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+            await client.update(live)
+            for _ in range(200):
+                pods = await client.list_items("", "Pod", "tpu-operator")
+                if pods and pods[0]["spec"]["containers"][0]["image"] == "img:v2" and pods[0]["status"].get("phase") == "Running":
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError("pods never re-rolled to new template")
+
+
+async def test_long_node_names_get_unique_pods():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.01)) as fc:
+        long_a = "gke-tpu-cluster-v5e-pool-0123456789abcdef-aaaaaaaaaaaaaaaa"
+        long_b = "gke-tpu-cluster-v5e-pool-0123456789abcdef-bbbbbbbbbbbbbbbb"
+        fc.add_node(long_a)
+        fc.add_node(long_b)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            ds = {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "metadata": {"name": "tpu-node-status-exporter", "namespace": "tpu-operator"},
+                "spec": {
+                    "selector": {"matchLabels": {"app": "nse"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "nse"}},
+                        "spec": {"containers": [{"name": "c", "image": "img"}]},
+                    },
+                },
+            }
+            await client.create(ds)
+            for _ in range(200):
+                live = await client.get("apps", "DaemonSet", "tpu-node-status-exporter", "tpu-operator")
+                if live.get("status", {}).get("numberReady") == 2:
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise AssertionError(f"collision: {live.get('status')}")
+            pods = await client.list_items("", "Pod", "tpu-operator")
+            names = {p["metadata"]["name"] for p in pods}
+            assert len(names) == 2
+            assert all(len(n) <= 63 for n in names)

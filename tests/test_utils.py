@@ -1,0 +1,49 @@
+from tpu_operator import utils
+
+
+def test_fnv1a_known_vector():
+    # FNV-1a 64-bit of empty input is the offset basis.
+    assert utils.fnv1a_64(b"") == 0xCBF29CE484222325
+    # Published vector: fnv1a64("a") = 0xaf63dc4c8601ec8c
+    assert utils.fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+
+
+def test_object_hash_deterministic_and_order_insensitive():
+    a = {"x": 1, "y": [1, 2, {"z": "s"}]}
+    b = {"y": [1, 2, {"z": "s"}], "x": 1}
+    assert utils.object_hash(a) == utils.object_hash(b)
+    assert utils.object_hash(a) != utils.object_hash({"x": 2})
+
+
+def test_deep_get_set():
+    d = {}
+    utils.deep_set(d, 5, "a", "b", "c")
+    assert utils.deep_get(d, "a", "b", "c") == 5
+    assert utils.deep_get(d, "a", "missing", default="dflt") == "dflt"
+    assert utils.deep_get({"l": [{"k": 1}]}, "l", 0, "k") == 1
+
+
+def test_merge_env():
+    env = [{"name": "A", "value": "1"}]
+    utils.merge_env(env, "A", "2")
+    utils.merge_env(env, "B", "3")
+    assert env == [{"name": "A", "value": "2"}, {"name": "B", "value": "3"}]
+
+
+def test_topology():
+    assert utils.parse_topology("2x4") == (2, 4)
+    assert utils.parse_topology("4x4x4") == (4, 4, 4)
+    assert utils.topology_chips("4x4x4") == 64
+    import pytest
+    with pytest.raises(ValueError):
+        utils.parse_topology("bogus")
+
+
+def test_files_with_suffix(tmp_path):
+    (tmp_path / "b.yaml").write_text("b")
+    (tmp_path / "a.yaml").write_text("a")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "c.yml").write_text("c")
+    (tmp_path / "skip.txt").write_text("x")
+    got = utils.files_with_suffix(str(tmp_path), ".yaml", ".yml")
+    assert [g.split("/")[-1] for g in got] == ["a.yaml", "b.yaml", "c.yml"]

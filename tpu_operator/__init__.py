@@ -1,0 +1,31 @@
+"""TPU Operator — a Kubernetes operator automating the TPU software stack.
+
+A TPU-native re-design of the capability surface of the NVIDIA GPU Operator
+(reference: /root/reference, nikp1172/gpu-operator): ClusterPolicy-style
+reconcile chain whose operand states deploy libtpu + the XLA PJRT runtime, a
+TPU device plugin advertising ``google.com/tpu``, tpu-feature-discovery node
+labels, a tpu-metrics exporter, a slice/topology manager, and a validation
+harness that gates readiness on a real JAX/XLA collective over ICI.
+
+Layer map (mirrors reference SURVEY layer map; reference file:line cited in
+each module's docstring):
+
+- ``tpu_operator.api``          CRD types + CRD generation       (api/v1, api/v1alpha1)
+- ``tpu_operator.cmd``          binaries / entry points          (cmd/gpu-operator, validator)
+- ``tpu_operator.controllers``  reconcilers + operator metrics   (controllers/)
+- ``tpu_operator.state``        declarative state engine         (internal/state)
+- ``tpu_operator.render``       manifest template renderer       (internal/render)
+- ``tpu_operator.k8s``          minimal Kubernetes client        (controller-runtime analogue)
+- ``tpu_operator.nodeinfo``     node attribute extraction        (internal/nodeinfo)
+- ``tpu_operator.deviceplugin`` kubelet device plugin            (payload image analogue)
+- ``tpu_operator.tfd``          tpu-feature-discovery            (gpu-feature-discovery analogue)
+- ``tpu_operator.validator``    node validation harness          (validator/)
+- ``tpu_operator.exporter``     metrics + node-status exporters  (dcgm-exporter, node-status-exporter)
+- ``tpu_operator.slicemanager`` slice/topology manager           (mig-manager analogue)
+- ``tpu_operator.workloads``    JAX/XLA validation workloads     (CUDA vectorAdd analogue → pmap psum)
+- ``tpu_operator.testing``      in-process fake apiserver        (fake client / envtest analogue)
+"""
+
+from tpu_operator.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,141 @@
+"""Shared constants: labels, annotations, state names, env vars.
+
+Reference analogue: internal/consts/consts.go:32-67 and the label constants in
+controllers/state_manager.go:54-121.  Naming scheme: the reference uses the
+``nvidia.com/`` domain for everything; we use ``google.com/tpu`` for the
+extended resource (what GKE schedulers match on) and the ``tpu.google.com/``
+domain for operator-owned labels/annotations.
+"""
+
+# ---------------------------------------------------------------------------
+# Extended resource advertised by the device plugin.
+TPU_RESOURCE = "google.com/tpu"
+
+# ---------------------------------------------------------------------------
+# Node labels set by GKE / NFD-style discovery that we key off (inputs).
+# On GKE TPU node pools these are present out of the box.
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"   # e.g. tpu-v5-lite-podslice
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"         # e.g. 2x4, 4x4x4
+
+# ---------------------------------------------------------------------------
+# Node labels owned by the operator (outputs).
+TPU_PRESENT_LABEL = "tpu.google.com/tpu.present"          # nvidia.com/gpu.present analogue
+TPU_COUNT_LABEL = "tpu.google.com/tpu.count"
+TPU_WORKLOAD_CONFIG_LABEL = "tpu.google.com/tpu.workload.config"  # container | vm-passthrough
+# Intentional exception to the tpu.google.com/ convention: BASELINE.json pins
+# the slice-config label (the nvidia.com/mig.config analogue) under the
+# google.com/tpu.* namespace, matching where GKE tooling looks for it.
+SLICE_CONFIG_LABEL = "google.com/tpu.slice.config"
+SLICE_CONFIG_STATE_LABEL = "google.com/tpu.slice.config.state"  # pending|success|failed|rebooting
+UPGRADE_STATE_LABEL = "tpu.google.com/tpu-runtime-upgrade-state"
+
+# Per-operand deployment gate labels (gpuStateLabels analogue,
+# controllers/state_manager.go:90-115).  Value "true" ⇒ operand DS schedules.
+DEPLOY_LABEL_PREFIX = "tpu.google.com/tpu.deploy."
+STATE_LABELS_CONTAINER = (
+    "libtpu",
+    "runtime-prep",
+    "device-plugin",
+    "metrics-agent",
+    "metrics-exporter",
+    "feature-discovery",
+    "slice-manager",
+    "node-status-exporter",
+    "operator-validator",
+)
+STATE_LABELS_VM = (
+    "vfio-manager",
+    "sandbox-device-plugin",
+    "sandbox-validator",
+)
+
+# Workload config values (nvidia.com/gpu.workload.config analogue).
+WORKLOAD_CONTAINER = "container"
+WORKLOAD_VM_PASSTHROUGH = "vm-passthrough"
+DEFAULT_WORKLOAD = WORKLOAD_CONTAINER
+
+# ---------------------------------------------------------------------------
+# Feature-discovery labels (gpu-feature-discovery analogue).
+TFD_LABEL_PREFIX = "tpu.google.com/"
+TFD_CHIP_LABEL = TFD_LABEL_PREFIX + "tpu.chip"            # e.g. v5e, v5p
+TFD_CHIPS_PER_HOST_LABEL = TFD_LABEL_PREFIX + "tpu.chips-per-host"
+TFD_HBM_GB_LABEL = TFD_LABEL_PREFIX + "tpu.memory.hbm-gb"
+TFD_ICI_TOPOLOGY_LABEL = TFD_LABEL_PREFIX + "tpu.ici.topology"      # e.g. 2x4
+TFD_SLICE_HOSTS_LABEL = TFD_LABEL_PREFIX + "tpu.slice.hosts"
+TFD_SLICE_WORKER_ID_LABEL = TFD_LABEL_PREFIX + "tpu.slice.worker-id"
+TFD_RUNTIME_VERSION_LABEL = TFD_LABEL_PREFIX + "tpu.runtime.version"  # libtpu version
+
+# ---------------------------------------------------------------------------
+# Annotations.
+LAST_APPLIED_HASH_ANNOTATION = "tpu.google.com/last-applied-hash"  # NvidiaAnnotationHashKey analogue
+STATE_LABEL = "tpu.google.com/tpu-operator.state"  # nvidia.com/gpu-operator.state analogue
+UPGRADE_REQUESTED_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-requested"
+
+# ---------------------------------------------------------------------------
+# Ordered operand state names (controllers/state_manager.go:795-813 analogue).
+# The sandbox/VM chain keeps its slots (survey §2.4 last row) but is disabled
+# by default; see TPUClusterPolicySpec.sandbox_workloads.
+STATE_NAMES = (
+    "pre-requisites",
+    "state-operator-metrics",
+    "state-libtpu",
+    "state-runtime-prep",
+    "state-operator-validation",
+    "state-device-plugin",
+    "state-metrics-agent",
+    "state-metrics-exporter",
+    "tpu-feature-discovery",
+    "state-slice-manager",
+    "state-node-status-exporter",
+    "state-sandbox-validation",
+    "state-vfio-manager",
+    "state-sandbox-device-plugin",
+)
+
+# ---------------------------------------------------------------------------
+# Env vars.
+OPERATOR_NAMESPACE_ENV = "OPERATOR_NAMESPACE"
+ASSETS_DIR_ENV = "OPERATOR_ASSETS"
+DEFAULT_ASSETS_DIR = "/opt/tpu-operator"
+UNIT_TEST_ENV = "UNIT_TEST"  # test seam, object_controls.go:820-822 analogue
+
+# Image resolution env fallbacks (imagePath analogue, clusterpolicy_types.go:1679-1708).
+IMAGE_ENVS = {
+    "libtpu": "LIBTPU_IMAGE",
+    "runtime-prep": "RUNTIME_PREP_IMAGE",
+    "device-plugin": "DEVICE_PLUGIN_IMAGE",
+    "metrics-agent": "METRICS_AGENT_IMAGE",
+    "metrics-exporter": "METRICS_EXPORTER_IMAGE",
+    "feature-discovery": "FEATURE_DISCOVERY_IMAGE",
+    "slice-manager": "SLICE_MANAGER_IMAGE",
+    "node-status-exporter": "NODE_STATUS_EXPORTER_IMAGE",
+    "validator": "VALIDATOR_IMAGE",
+    "vfio-manager": "VFIO_MANAGER_IMAGE",
+    "sandbox-device-plugin": "SANDBOX_DEVICE_PLUGIN_IMAGE",
+}
+
+# ---------------------------------------------------------------------------
+# Node-level validation status files (validator/main.go:131-166 analogue).
+VALIDATION_DIR = "/run/tpu/validations"
+VALIDATION_ROOT_ENV = "TPU_VALIDATION_ROOT"  # test seam: relocate /run/tpu
+STATUS_FILES = {
+    "libtpu": "libtpu-ready",
+    "pjrt": "pjrt-ready",
+    "plugin": "plugin-ready",
+    "jax": "jax-ready",
+    "runtime-prep": "runtime-prep-ready",
+}
+
+# ---------------------------------------------------------------------------
+# Control-loop constants (BASELINE.md reference envelope).
+REQUEUE_NOT_READY_SECONDS = 5.0      # clusterpolicy_controller.go:165,193
+REQUEUE_NO_TPU_NODES_SECONDS = 45.0  # :199 (NFD-missing poll analogue)
+UPGRADE_REQUEUE_SECONDS = 120.0      # upgrade_controller.go:58,196
+RATE_LIMIT_BASE_SECONDS = 0.1        # clusterpolicy_controller.go:354
+RATE_LIMIT_MAX_SECONDS = 3.0
+VALIDATOR_SLEEP_SECONDS = 5.0        # validator/main.go:133-134
+VALIDATOR_WORKLOAD_RETRIES = 60      # :167-170
+VALIDATOR_RESOURCE_RETRIES = 30      # :171-174
+
+# Leader election id (main.go:105-115 analogue: "53822513.nvidia.com").
+LEADER_ELECTION_ID = "53822513.tpu.google.com"

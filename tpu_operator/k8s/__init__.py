@@ -1,0 +1,18 @@
+"""Minimal in-tree Kubernetes client.
+
+The reference leans on sigs.k8s.io/controller-runtime for its client, caches,
+watches and leader election; no Python equivalent ships in this image, so this
+package provides the slice of that functionality the operator needs:
+
+- ``objects``    unstructured object helpers (GVK ↔ REST path mapping)
+- ``selectors``  label-selector parsing/matching (k8s.io/apimachinery labels)
+- ``client``     async REST client: CRUD, status subresource, list, watch
+- ``informer``   list+watch cache with handlers (controller-runtime cache)
+- ``apply``      create-or-update with last-applied-hash skip (stateSkel analogue)
+- ``leader``     Lease-based leader election (main.go:105-115 analogue)
+"""
+
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.k8s.objects import gvk_of, resource_path
+
+__all__ = ["ApiClient", "ApiError", "Config", "gvk_of", "resource_path"]

@@ -1,0 +1,90 @@
+"""Create-or-update with last-applied-hash skip.
+
+Reference analogue: internal/state/state_skel.go:223-285 (createOrUpdateObjs)
+and the DaemonSet hash-skip of controllers/object_controls.go:4173-4199.
+Rather than strategic-merge or SSA (which the fake apiserver doesn't model),
+desired state fully replaces spec; server-owned metadata is preserved by the
+server on PUT, and a content hash annotation avoids no-op updates (and thus
+pointless DaemonSet restarts).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.k8s import objects as obj_api
+from tpu_operator.utils import object_hash
+
+log = logging.getLogger("tpu_operator.k8s.apply")
+
+
+def desired_hash(obj: dict) -> str:
+    scrubbed = copy.deepcopy(obj)
+    meta = scrubbed.get("metadata", {})
+    meta.pop("resourceVersion", None)
+    meta.pop("uid", None)
+    meta.pop("creationTimestamp", None)
+    meta.pop("generation", None)
+    (meta.get("annotations") or {}).pop(consts.LAST_APPLIED_HASH_ANNOTATION, None)
+    scrubbed.pop("status", None)
+    return object_hash(scrubbed)
+
+
+async def create_or_update(
+    client: ApiClient,
+    obj: dict,
+    owner: Optional[dict] = None,
+    state_label: Optional[str] = None,
+) -> tuple[dict, bool]:
+    """Apply desired state. Returns (live_object, changed).
+
+    - stamps the state label (addStateSpecificLabels analogue, state_skel.go:287)
+    - sets the controller ownerReference when an owner is given
+    - skips the update entirely when the desired-hash annotation matches
+    """
+    obj = copy.deepcopy(obj)
+    meta = obj.setdefault("metadata", {})
+    if state_label:
+        meta.setdefault("labels", {})[consts.STATE_LABEL] = state_label
+    if owner is not None:
+        obj_api.set_owner_reference(obj, owner)
+    h = desired_hash(obj)
+    meta.setdefault("annotations", {})[consts.LAST_APPLIED_HASH_ANNOTATION] = h
+
+    gvk = obj_api.gvk_of(obj)
+    try:
+        live = await client.get(gvk.group, gvk.kind, meta["name"], meta.get("namespace"))
+    except ApiError as e:
+        if not e.not_found:
+            raise
+        created = await client.create(obj)
+        log.info("created %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
+        return created, True
+
+    live_hash = (live.get("metadata", {}).get("annotations") or {}).get(
+        consts.LAST_APPLIED_HASH_ANNOTATION
+    )
+    if live_hash == h:
+        return live, False
+
+    # Replace: keep server-side resourceVersion for optimistic concurrency,
+    # preserve ServiceAccount secrets-style server additions by carrying over
+    # fields we do not manage (state_skel.go:358-380 analogue).
+    obj["metadata"]["resourceVersion"] = live["metadata"].get("resourceVersion")
+    if gvk.kind == "ServiceAccount":
+        for f in ("secrets", "imagePullSecrets"):
+            if f in live and f not in obj:
+                obj[f] = live[f]
+    updated = await client.update(obj)
+    log.info("updated %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
+    return updated, True
+
+
+async def delete_if_exists(client: ApiClient, obj: dict) -> None:
+    gvk = obj_api.gvk_of(obj)
+    meta = obj.get("metadata", {})
+    await client.delete(gvk.group, gvk.kind, meta["name"], meta.get("namespace"))

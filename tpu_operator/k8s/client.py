@@ -1,0 +1,303 @@
+"""Async Kubernetes REST client (CRUD + status + list + watch).
+
+Fills the role controller-runtime's client plays for the reference
+(controllers use Get/List/Create/Update/Delete + watches).  Speaks plain
+HTTPS/JSON to the API server: in-cluster config from the service-account
+token, kubeconfig-less by design (the operator always runs in a pod; tests
+point it at the in-process fake apiserver via ``Config(base_url=...)``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import ssl
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+import aiohttp
+
+from tpu_operator.k8s import objects as obj_api
+
+log = logging.getLogger("tpu_operator.k8s")
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+@dataclass
+class Config:
+    base_url: str
+    token: Optional[str] = None
+    token_file: Optional[str] = None  # re-read periodically (bound SA tokens rotate ~1h)
+    ca_file: Optional[str] = None
+    verify_ssl: bool = True
+
+    @classmethod
+    def in_cluster(cls) -> "Config":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+        ca_path = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        token = None
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                token = f.read().strip()
+        return cls(
+            base_url=f"https://{host}:{port}",
+            token=token,
+            token_file=token_path if os.path.exists(token_path) else None,
+            ca_file=ca_path if os.path.exists(ca_path) else None,
+        )
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        """KUBERNETES_API_URL override (tests / out-of-cluster), else in-cluster."""
+        url = os.environ.get("KUBERNETES_API_URL")
+        if url:
+            return cls(base_url=url, token=os.environ.get("KUBERNETES_API_TOKEN"))
+        return cls.in_cluster()
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, reason: str, body: Any = None):
+        self.status = status
+        self.reason = reason
+        self.body = body
+        super().__init__(f"{status} {reason}")
+
+    @property
+    def not_found(self) -> bool:
+        return self.status == 404
+
+    @property
+    def conflict(self) -> bool:
+        return self.status == 409
+
+    @property
+    def already_exists(self) -> bool:
+        return self.status == 409
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED | BOOKMARK | ERROR
+    object: dict
+
+
+class ApiClient:
+    TOKEN_REFRESH_SECONDS = 60.0
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config.from_env()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._token_checked_at = 0.0
+        self._pending_closes: set[asyncio.Task] = set()
+
+    async def __aenter__(self) -> "ApiClient":
+        await self.session()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    def _maybe_refresh_token(self) -> None:
+        """Pick up rotated bound service-account tokens (client-go behaviour)."""
+        if not self.config.token_file:
+            return
+        now = time.monotonic()
+        if now - self._token_checked_at < self.TOKEN_REFRESH_SECONDS:
+            return
+        self._token_checked_at = now
+        try:
+            with open(self.config.token_file) as f:
+                token = f.read().strip()
+        except OSError:
+            return
+        if token and token != self.config.token:
+            self.config.token = token
+            if self._session and not self._session.closed:
+                # rebuild the session so the new Authorization header applies;
+                # hold a strong ref to the close task or it may be GC'd unrun
+                task = asyncio.get_event_loop().create_task(self._session.close())
+                self._pending_closes.add(task)
+                task.add_done_callback(self._pending_closes.discard)
+                self._session = None
+
+    async def session(self) -> aiohttp.ClientSession:
+        self._maybe_refresh_token()
+        if self._session is None or self._session.closed:
+            headers = {"Accept": "application/json"}
+            if self.config.token:
+                headers["Authorization"] = f"Bearer {self.config.token}"
+            ssl_ctx: Any = None
+            if self.config.base_url.startswith("https"):
+                if self.config.ca_file:
+                    ssl_ctx = ssl.create_default_context(cafile=self.config.ca_file)
+                elif not self.config.verify_ssl:
+                    ssl_ctx = False
+            connector = aiohttp.TCPConnector(ssl=ssl_ctx) if ssl_ctx is not None else None
+            self._session = aiohttp.ClientSession(
+                base_url=self.config.base_url,
+                headers=headers,
+                connector=connector,
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+    # ------------------------------------------------------------------
+    async def _request(
+        self,
+        method: str,
+        path: str,
+        *,
+        params: Optional[dict] = None,
+        body: Any = None,
+        content_type: str = "application/json",
+    ) -> Any:
+        sess = await self.session()
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = content_type
+        async with sess.request(method, path, params=params, data=data, headers=headers) as resp:
+            text = await resp.text()
+            payload: Any = None
+            if text:
+                try:
+                    payload = json.loads(text)
+                except json.JSONDecodeError:
+                    payload = text
+            if resp.status >= 400:
+                reason = payload.get("reason", resp.reason) if isinstance(payload, dict) else str(resp.reason)
+                raise ApiError(resp.status, str(reason), payload)
+            return payload
+
+    # ------------------------------------------------------------------
+    # Typed-by-kind convenience API. All objects are plain dicts
+    # ("unstructured") with apiVersion/kind/metadata.
+
+    async def get(self, group: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        info = obj_api.lookup(group, kind)
+        path = obj_api.resource_path(
+            info.gvk.group, info.gvk.version, info.plural, info.namespaced, namespace, name
+        )
+        return await self._request("GET", path)
+
+    @staticmethod
+    def _collection_path(info: obj_api.ResourceInfo, namespace: Optional[str]) -> str:
+        """Collection URL; namespaced kinds with no namespace → all-namespaces."""
+        if info.namespaced and namespace is None:
+            return obj_api.resource_path(info.gvk.group, info.gvk.version, info.plural, False)
+        ns = namespace if info.namespaced else None
+        return obj_api.resource_path(info.gvk.group, info.gvk.version, info.plural, info.namespaced, ns)
+
+    async def list(
+        self,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict:
+        info = obj_api.lookup(group, kind)
+        path = self._collection_path(info, namespace)
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        return await self._request("GET", path, params=params)
+
+    async def list_items(self, *args, **kwargs) -> list[dict]:
+        return (await self.list(*args, **kwargs)).get("items", [])
+
+    async def create(self, obj: dict) -> dict:
+        info = obj_api.info_of(obj)
+        meta = obj.get("metadata", {})
+        path = obj_api.resource_path(
+            info.gvk.group, info.gvk.version, info.plural, info.namespaced, meta.get("namespace")
+        )
+        return await self._request("POST", path, body=obj)
+
+    async def update(self, obj: dict) -> dict:
+        return await self._request("PUT", obj_api.object_path(obj), body=obj)
+
+    async def update_status(self, obj: dict) -> dict:
+        return await self._request("PUT", obj_api.object_path(obj, "status"), body=obj)
+
+    async def patch(
+        self, group: str, kind: str, name: str, patch: Any,
+        namespace: Optional[str] = None,
+        patch_type: str = "application/merge-patch+json",
+        subresource: Optional[str] = None,
+    ) -> dict:
+        info = obj_api.lookup(group, kind)
+        path = obj_api.resource_path(
+            info.gvk.group, info.gvk.version, info.plural, info.namespaced, namespace, name, subresource
+        )
+        return await self._request("PATCH", path, body=patch, content_type=patch_type)
+
+    async def delete(
+        self, group: str, kind: str, name: str, namespace: Optional[str] = None,
+        ignore_not_found: bool = True,
+    ) -> Optional[dict]:
+        info = obj_api.lookup(group, kind)
+        path = obj_api.resource_path(
+            info.gvk.group, info.gvk.version, info.plural, info.namespaced, namespace, name
+        )
+        try:
+            return await self._request("DELETE", path)
+        except ApiError as e:
+            if e.not_found and ignore_not_found:
+                return None
+            raise
+
+    async def delete_collection(
+        self, group: str, kind: str, namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+    ) -> None:
+        for item in await self.list_items(group, kind, namespace, label_selector):
+            meta = item.get("metadata", {})
+            await self.delete(group, kind, meta["name"], meta.get("namespace"))
+
+    # ------------------------------------------------------------------
+    async def watch(
+        self,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        timeout_seconds: Optional[float] = None,
+    ) -> AsyncIterator[WatchEvent]:
+        """Single watch stream; see Informer for resumable cached watches."""
+        info = obj_api.lookup(group, kind)
+        path = self._collection_path(info, namespace)
+        params: dict[str, str] = {"watch": "1", "allowWatchBookmarks": "true"}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        if label_selector:
+            params["labelSelector"] = label_selector
+        sess = await self.session()
+        timeout = aiohttp.ClientTimeout(total=timeout_seconds, sock_read=timeout_seconds)
+        async with sess.get(path, params=params, timeout=timeout) as resp:
+            if resp.status >= 400:
+                raise ApiError(resp.status, str(resp.reason))
+            buf = b""
+            async for chunk in resp.content.iter_any():
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    evt = json.loads(line)
+                    yield WatchEvent(evt["type"], evt.get("object", {}))

@@ -1,0 +1,128 @@
+"""List+watch informer with local cache and event handlers.
+
+Controller-runtime cache analogue: reconnects with resourceVersion resume and
+feeds controller workqueues (see tpu_operator.controllers.manager).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+from tpu_operator.k8s.client import ApiClient, ApiError
+
+log = logging.getLogger("tpu_operator.k8s.informer")
+
+Handler = Callable[[str, dict], Awaitable[None]]  # (event_type, object)
+
+
+class Informer:
+    def __init__(
+        self,
+        client: ApiClient,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        resync_seconds: float = 600.0,
+    ):
+        self.client = client
+        self.group = group
+        self.kind = kind
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.resync_seconds = resync_seconds
+        self.cache: dict[tuple[str, str], dict] = {}
+        self.handlers: list[Handler] = []
+        self._task: Optional[asyncio.Task] = None
+        self.synced = asyncio.Event()
+
+    def add_handler(self, handler: Handler) -> None:
+        self.handlers.append(handler)
+
+    def get(self, name: str, namespace: str = "") -> Optional[dict]:
+        return self.cache.get((namespace, name))
+
+    def items(self) -> list[dict]:
+        return list(self.cache.values())
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name=f"informer-{self.kind}")
+        await self.synced.wait()
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _dispatch(self, event_type: str, obj: dict) -> None:
+        for handler in self.handlers:
+            try:
+                await handler(event_type, obj)
+            except Exception:  # noqa: BLE001
+                log.exception("informer handler failed for %s %s", self.kind, event_type)
+
+    async def _run(self) -> None:
+        backoff = 0.05
+        while True:
+            watch_started = 0.0
+            try:
+                listing = await self.client.list(
+                    self.group, self.kind, self.namespace, self.label_selector
+                )
+                rv = listing.get("metadata", {}).get("resourceVersion")
+                fresh: dict[tuple[str, str], dict] = {}
+                for item in listing.get("items", []):
+                    meta = item.get("metadata", {})
+                    fresh[(meta.get("namespace", ""), meta["name"])] = item
+                # diff against cache → synthetic events; keep the cache
+                # consistent with each event *before* handlers observe it
+                for key, item in fresh.items():
+                    old = self.cache.get(key)
+                    if old is None:
+                        self.cache[key] = item
+                        await self._dispatch("ADDED", item)
+                    elif old.get("metadata", {}).get("resourceVersion") != item["metadata"].get("resourceVersion"):
+                        self.cache[key] = item
+                        await self._dispatch("MODIFIED", item)
+                for key, old in list(self.cache.items()):
+                    if key not in fresh:
+                        del self.cache[key]
+                        await self._dispatch("DELETED", old)
+                self.synced.set()
+                watch_started = time.monotonic()
+                async for evt in self.client.watch(
+                    self.group,
+                    self.kind,
+                    self.namespace,
+                    resource_version=rv,
+                    label_selector=self.label_selector,
+                    timeout_seconds=self.resync_seconds,
+                ):
+                    if evt.type == "BOOKMARK":
+                        continue
+                    if evt.type == "ERROR":
+                        break
+                    meta = evt.object.get("metadata", {})
+                    key = (meta.get("namespace", ""), meta.get("name", ""))
+                    if evt.type == "DELETED":
+                        self.cache.pop(key, None)
+                    else:
+                        self.cache[key] = evt.object
+                    await self._dispatch(evt.type, evt.object)
+            except asyncio.CancelledError:
+                raise
+            except (ApiError, OSError, asyncio.TimeoutError, Exception):  # noqa: BLE001
+                log.debug("informer %s stream reset; relisting", self.kind, exc_info=True)
+            # Only treat the cycle as healthy (reset backoff) if the watch ran
+            # for a while; a watch that dies instantly (e.g. RBAC 403) must
+            # keep backing off or we relist-hammer the apiserver.
+            if watch_started and time.monotonic() - watch_started >= 1.0:
+                backoff = 0.05
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)

@@ -1,0 +1,149 @@
+"""Lease-based leader election.
+
+Reference analogue: cmd/gpu-operator/main.go:105-115 (controller-runtime
+leader election with id 53822513.nvidia.com and a configurable
+lease-renew-deadline).  Standard coordination.k8s.io/v1 Lease protocol:
+acquire if unheld/expired, renew at renew_interval, yield on loss.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+import os
+import socket
+import time as _time
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, ApiError
+
+log = logging.getLogger("tpu_operator.k8s.leader")
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse(ts: str) -> datetime.datetime:
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(ts, fmt).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            continue
+    raise ValueError(f"bad timestamp {ts}")
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        name: str = consts.LEADER_ELECTION_ID,
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.is_leader = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._last_renew = 0.0
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="leader-elector")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        # best-effort release
+        try:
+            lease = await self.client.get("coordination.k8s.io", "Lease", self.name, self.namespace)
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = None
+                await self.client.update(lease)
+        except (ApiError, OSError):
+            pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                acquired = await self._try_acquire_or_renew()
+                if acquired:
+                    self._last_renew = _time.monotonic()
+                    if not self.is_leader.is_set():
+                        log.info("became leader (%s)", self.identity)
+                        self.is_leader.set()
+                elif self.is_leader.is_set():
+                    log.warning("lost leadership (%s)", self.identity)
+                    self.is_leader.clear()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("leader election error")
+                # Step down if we cannot prove we still hold the lease: once
+                # our last successful renew is older than the lease duration,
+                # another replica may legitimately acquire it (split-brain
+                # guard mirroring client-go's leaderelection renew deadline).
+                if (
+                    self.is_leader.is_set()
+                    and _time.monotonic() - self._last_renew > self.lease_duration
+                ):
+                    log.warning("renew deadline exceeded; stepping down (%s)", self.identity)
+                    self.is_leader.clear()
+            await asyncio.sleep(self.renew_interval if self.is_leader.is_set() else self.renew_interval / 2)
+
+    async def _try_acquire_or_renew(self) -> bool:
+        spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "renewTime": _now(),
+        }
+        try:
+            lease = await self.client.get("coordination.k8s.io", "Lease", self.name, self.namespace)
+        except ApiError as e:
+            if not e.not_found:
+                raise
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": {**spec, "acquireTime": spec["renewTime"]},
+            }
+            try:
+                await self.client.create(lease)
+                return True
+            except ApiError as e2:
+                if e2.conflict:
+                    return False
+                raise
+
+        holder = lease.get("spec", {}).get("holderIdentity")
+        renew = lease.get("spec", {}).get("renewTime")
+        expired = True
+        if holder and renew:
+            age = (
+                datetime.datetime.now(datetime.timezone.utc) - _parse(renew)
+            ).total_seconds()
+            expired = age > lease["spec"].get("leaseDurationSeconds", self.lease_duration)
+        if holder == self.identity or holder is None or expired:
+            if holder != self.identity:
+                spec["acquireTime"] = spec["renewTime"]
+            lease["spec"].update(spec)
+            try:
+                await self.client.update(lease)
+                return True
+            except ApiError as e:
+                if e.conflict:
+                    return False
+                raise
+        return False

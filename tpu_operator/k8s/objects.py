@@ -1,0 +1,152 @@
+"""Unstructured object helpers and GVK → REST path mapping.
+
+The reference gets this from apimachinery's RESTMapper; we keep a static table
+of every kind the operator touches (extensible at runtime for CRDs via
+``register_kind``), mirroring the GVK whitelist idea of
+internal/state/state_skel.go:62-165 (getSupportedGVKs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class GVK:
+    group: str  # "" for core
+    version: str
+    kind: str
+
+    @property
+    def api_version(self) -> str:
+        return self.version if not self.group else f"{self.group}/{self.version}"
+
+
+@dataclass(frozen=True)
+class ResourceInfo:
+    gvk: GVK
+    plural: str
+    namespaced: bool
+
+
+_REGISTRY: dict[tuple[str, str], ResourceInfo] = {}
+
+
+def register_kind(group: str, version: str, kind: str, plural: str, namespaced: bool) -> None:
+    _REGISTRY[(group, kind)] = ResourceInfo(GVK(group, version, kind), plural, namespaced)
+
+
+# Core kinds the operator manages (getSupportedGVKs analogue).
+for g, v, k, pl, ns in [
+    ("", "v1", "Namespace", "namespaces", False),
+    ("", "v1", "Node", "nodes", False),
+    ("", "v1", "Pod", "pods", True),
+    ("", "v1", "Service", "services", True),
+    ("", "v1", "ServiceAccount", "serviceaccounts", True),
+    ("", "v1", "ConfigMap", "configmaps", True),
+    ("", "v1", "Secret", "secrets", True),
+    ("", "v1", "Event", "events", True),
+    ("apps", "v1", "DaemonSet", "daemonsets", True),
+    ("apps", "v1", "Deployment", "deployments", True),
+    ("apps", "v1", "ControllerRevision", "controllerrevisions", True),
+    ("rbac.authorization.k8s.io", "v1", "Role", "roles", True),
+    ("rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebindings", True),
+    ("rbac.authorization.k8s.io", "v1", "ClusterRole", "clusterroles", False),
+    ("rbac.authorization.k8s.io", "v1", "ClusterRoleBinding", "clusterrolebindings", False),
+    ("coordination.k8s.io", "v1", "Lease", "leases", True),
+    ("monitoring.coreos.com", "v1", "ServiceMonitor", "servicemonitors", True),
+    ("monitoring.coreos.com", "v1", "PrometheusRule", "prometheusrules", True),
+    ("node.k8s.io", "v1", "RuntimeClass", "runtimeclasses", False),
+    ("apiextensions.k8s.io", "v1", "CustomResourceDefinition", "customresourcedefinitions", False),
+    ("policy", "v1", "PodDisruptionBudget", "poddisruptionbudgets", True),
+    ("scheduling.k8s.io", "v1", "PriorityClass", "priorityclasses", False),
+    # Operator CRDs (api/ package).
+    ("tpu.google.com", "v1", "TPUClusterPolicy", "tpuclusterpolicies", False),
+    ("tpu.google.com", "v1alpha1", "TPURuntime", "tpuruntimes", False),
+]:
+    register_kind(g, v, k, pl, ns)
+
+
+def gvk_of(obj: dict) -> GVK:
+    api_version = obj.get("apiVersion", "")
+    kind = obj.get("kind", "")
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+    else:
+        group, version = "", api_version
+    return GVK(group, version, kind)
+
+
+def lookup(group: str, kind: str) -> ResourceInfo:
+    try:
+        return _REGISTRY[(group, kind)]
+    except KeyError:
+        raise KeyError(f"unregistered kind {group or 'core'}/{kind}; call register_kind()") from None
+
+
+def info_of(obj: dict) -> ResourceInfo:
+    gvk = gvk_of(obj)
+    return lookup(gvk.group, gvk.kind)
+
+
+def resource_path(
+    group: str,
+    version: str,
+    plural: str,
+    namespaced: bool,
+    namespace: Optional[str] = None,
+    name: Optional[str] = None,
+    subresource: Optional[str] = None,
+) -> str:
+    base = f"/api/{version}" if not group else f"/apis/{group}/{version}"
+    parts = [base]
+    if namespaced:
+        if not namespace:
+            raise ValueError(f"namespace required for namespaced resource {plural}")
+        parts.append(f"namespaces/{namespace}")
+    parts.append(plural)
+    if name:
+        parts.append(name)
+        if subresource:
+            parts.append(subresource)
+    return "/".join(parts)
+
+
+def object_path(obj: dict, subresource: Optional[str] = None) -> str:
+    info = info_of(obj)
+    meta = obj.get("metadata", {})
+    return resource_path(
+        info.gvk.group,
+        info.gvk.version,
+        info.plural,
+        info.namespaced,
+        meta.get("namespace"),
+        meta.get("name"),
+        subresource,
+    )
+
+
+def set_owner_reference(obj: dict, owner: dict, controller: bool = True) -> None:
+    """ctrl.SetControllerReference analogue (object_controls.go:4112)."""
+    ref = {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"].get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+    refs = obj.setdefault("metadata", {}).setdefault("ownerReferences", [])
+    for existing in refs:
+        if existing.get("uid") == ref["uid"] and existing.get("name") == ref["name"]:
+            existing.update(ref)
+            return
+    refs.append(ref)
+
+
+def owned_by(obj: dict, owner_uid: str) -> bool:
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if ref.get("uid") == owner_uid:
+            return True
+    return False

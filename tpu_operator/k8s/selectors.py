@@ -1,0 +1,146 @@
+"""Label-selector parsing and matching (k8s.io/apimachinery/pkg/labels subset).
+
+Supports the string forms the operator and its manifests use:
+  ``k=v``, ``k==v``, ``k!=v``, ``k``, ``!k``, ``k in (a,b)``, ``k notin (a,b)``
+plus the structured ``matchLabels``/``matchExpressions`` selector form used by
+DaemonSets and node affinity.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+_SET_RE = re.compile(r"^\s*([A-Za-z0-9_./-]+)\s+(in|notin)\s+\(([^)]*)\)\s*$")
+
+
+@dataclass(frozen=True)
+class Requirement:
+    key: str
+    op: str  # =, !=, exists, !exists, in, notin, gt, lt
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        present = self.key in labels
+        val = labels.get(self.key)
+        if self.op == "exists":
+            return present
+        if self.op == "!exists":
+            return not present
+        if self.op == "=":
+            return present and val == self.values[0]
+        if self.op == "!=":
+            return not present or val != self.values[0]
+        if self.op == "in":
+            return present and val in self.values
+        if self.op == "notin":
+            return not present or val not in self.values
+        if self.op in ("gt", "lt"):
+            if not present:
+                return False
+            try:
+                n, bound = int(val), int(self.values[0])  # type: ignore[arg-type]
+            except ValueError:
+                return False
+            return n > bound if self.op == "gt" else n < bound
+        raise ValueError(f"unknown op {self.op}")
+
+
+def _split_top_level(s: str) -> list[str]:
+    """Split on commas not inside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
+def parse(selector: str) -> list[Requirement]:
+    reqs: list[Requirement] = []
+    if not selector or not selector.strip():
+        return reqs
+    for part in _split_top_level(selector):
+        part = part.strip()
+        if not part:
+            continue
+        m = _SET_RE.match(part)
+        if m:
+            vals = tuple(v.strip() for v in m.group(3).split(",") if v.strip())
+            reqs.append(Requirement(m.group(1), m.group(2), vals))
+        elif "!=" in part:
+            k, v = part.split("!=", 1)
+            reqs.append(Requirement(k.strip(), "!=", (v.strip(),)))
+        elif "==" in part:
+            k, v = part.split("==", 1)
+            reqs.append(Requirement(k.strip(), "=", (v.strip(),)))
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            reqs.append(Requirement(k.strip(), "=", (v.strip(),)))
+        elif part.startswith("!"):
+            reqs.append(Requirement(part[1:].strip(), "!exists"))
+        else:
+            reqs.append(Requirement(part, "exists"))
+    return reqs
+
+
+def matches(selector: str, labels: Optional[Mapping[str, str]]) -> bool:
+    labels = labels or {}
+    return all(r.matches(labels) for r in parse(selector))
+
+
+_EXPR_OPS = {
+    "In": "in",
+    "NotIn": "notin",
+    "Exists": "exists",
+    "DoesNotExist": "!exists",
+    "Gt": "gt",
+    "Lt": "lt",
+}
+
+
+def matches_structured(selector: Optional[dict], labels: Optional[Mapping[str, str]]) -> bool:
+    """Match a LabelSelector dict ({matchLabels, matchExpressions})."""
+    labels = labels or {}
+    if not selector:
+        return True
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        op = _EXPR_OPS.get(expr.get("operator", ""))
+        if op is None:
+            return False
+        req = Requirement(expr["key"], op, tuple(expr.get("values") or ()))
+        if not req.matches(labels):
+            return False
+    return True
+
+
+def matches_node_selector_terms(terms: list[dict], labels: Mapping[str, str]) -> bool:
+    """NodeSelectorTerms are ORed; matchExpressions within a term are ANDed."""
+    if not terms:
+        return True
+    for term in terms:
+        ok = True
+        for expr in term.get("matchExpressions") or []:
+            op = _EXPR_OPS.get(expr.get("operator", ""))
+            if op is None:
+                ok = False
+                break
+            req = Requirement(expr["key"], op, tuple(expr.get("values") or ()))
+            if not req.matches(labels):
+                ok = False
+                break
+        if ok:
+            return True
+    return False

@@ -1,0 +1,12 @@
+"""Test infrastructure: in-process fake Kubernetes API server + node simulators.
+
+Reference analogue: the fake client of controllers/object_controls_test.go:52-260
+plus the e2e harness of tests/e2e/.  Unlike the reference (SURVEY §4: "multi-node
+testing: not simulated"), this fake serves real HTTP + watch streams, so the
+operator under test runs its actual network/client/informer stack against an
+N-node simulated cluster, including kubelet-style DaemonSet scheduling.
+"""
+
+from tpu_operator.testing.fakecluster import FakeCluster, SimConfig
+
+__all__ = ["FakeCluster", "SimConfig"]

@@ -1,0 +1,709 @@
+"""In-process fake Kubernetes API server over real HTTP.
+
+Implements the API-machinery subset the operator exercises: generic CRUD for
+every kind registered in ``tpu_operator.k8s.objects``, resourceVersion
+bookkeeping, label/field selectors, watch streams (newline-delimited JSON)
+with a replay ring buffer, the ``status`` subresource, ownerReference garbage
+collection, and a kubelet simulator that schedules DaemonSet pods onto
+matching nodes and drives pod/DaemonSet readiness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import logging
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from aiohttp import web
+
+from tpu_operator import consts
+from tpu_operator.k8s import objects as obj_api
+from tpu_operator.k8s import selectors
+from tpu_operator.utils import deep_get, fnv1a_64
+
+log = logging.getLogger("tpu_operator.fakecluster")
+
+
+@dataclass
+class SimConfig:
+    enabled: bool = True
+    tick: float = 0.02
+    pod_ready_delay: float = 0.05     # DS pod creation → Ready
+    plugin_capacity_delay: float = 0.05  # plugin pod Ready → node advertises google.com/tpu
+    # Hook: given a workload pod dict, return final phase ("Succeeded"/"Failed").
+    # Called in a thread for pods with restartPolicy != Always (validator
+    # workload pods). None ⇒ auto-succeed after pod_ready_delay.
+    pod_executor: Optional[Callable[[dict], str]] = None
+
+
+class Store:
+    """Object store for one resource collection (group+plural)."""
+
+    def __init__(self, cluster: "FakeCluster", info: obj_api.ResourceInfo):
+        self.cluster = cluster
+        self.info = info
+        self.objects: dict[tuple[str, str], dict] = {}  # (ns, name) -> obj
+        # (queue, ns, parsed selector requirements)
+        self.watchers: list[tuple[asyncio.Queue, Optional[str], list[selectors.Requirement]]] = []
+        self.events: deque[tuple[int, dict]] = deque(maxlen=2048)  # (rv, event)
+
+    def key(self, namespace: Optional[str], name: str) -> tuple[str, str]:
+        return (namespace or "", name)
+
+    def _notify(self, event_type: str, obj: dict) -> None:
+        rv = int(obj["metadata"]["resourceVersion"])
+        evt = {"type": event_type, "object": copy.deepcopy(obj)}
+        self.events.append((rv, evt))
+        for queue, ns, parsed_sel in list(self.watchers):
+            if ns and obj["metadata"].get("namespace") != ns:
+                continue
+            labels = obj["metadata"].get("labels") or {}
+            if parsed_sel and not all(r.matches(labels) for r in parsed_sel):
+                continue
+            queue.put_nowait(evt)
+
+    # -- CRUD ----------------------------------------------------------
+    def create(self, obj: dict, namespace: Optional[str]) -> dict:
+        meta = obj.setdefault("metadata", {})
+        if self.info.namespaced:
+            meta["namespace"] = namespace or meta.get("namespace") or "default"
+        name = meta.get("name")
+        if not name and meta.get("generateName"):
+            name = meta["generateName"] + uuid.uuid4().hex[:5]
+            meta["name"] = name
+        if not name:
+            raise ApiException(422, "Invalid", "metadata.name required")
+        k = self.key(meta.get("namespace"), name)
+        if k in self.objects:
+            raise ApiException(409, "AlreadyExists", f"{self.info.plural} {name} already exists")
+        meta["uid"] = str(uuid.uuid4())
+        meta["creationTimestamp"] = _now()
+        meta["generation"] = 1
+        meta["resourceVersion"] = str(self.cluster.next_rv())
+        obj.setdefault("apiVersion", self.info.gvk.api_version)
+        obj.setdefault("kind", self.info.gvk.kind)
+        self.objects[k] = obj
+        self._notify("ADDED", obj)
+        return obj
+
+    def get(self, namespace: Optional[str], name: str) -> dict:
+        k = self.key(namespace, name)
+        if k not in self.objects:
+            raise ApiException(404, "NotFound", f"{self.info.plural} {name} not found")
+        return self.objects[k]
+
+    def update(self, obj: dict, namespace: Optional[str], name: str, status_only: bool = False) -> dict:
+        existing = self.get(namespace, name)
+        new_meta = obj.get("metadata", {})
+        if new_meta.get("resourceVersion") and new_meta["resourceVersion"] != existing["metadata"]["resourceVersion"]:
+            raise ApiException(409, "Conflict", f"resourceVersion conflict on {name}")
+        if status_only:
+            merged = copy.deepcopy(existing)
+            merged["status"] = obj.get("status", {})
+        else:
+            merged = copy.deepcopy(obj)
+            # preserve server-owned metadata + status on spec updates
+            merged["metadata"] = {**new_meta}
+            for f in ("uid", "creationTimestamp", "generation", "namespace"):
+                if f in existing["metadata"]:
+                    merged["metadata"][f] = existing["metadata"][f]
+            merged["metadata"]["name"] = name
+            if "status" not in merged and "status" in existing:
+                merged["status"] = existing["status"]
+            if merged.get("spec") != existing.get("spec"):
+                merged["metadata"]["generation"] = existing["metadata"].get("generation", 1) + 1
+        merged["apiVersion"] = self.info.gvk.api_version
+        merged["kind"] = self.info.gvk.kind
+        merged["metadata"]["resourceVersion"] = str(self.cluster.next_rv())
+        self.objects[self.key(namespace, name)] = merged
+        self._notify("MODIFIED", merged)
+        return merged
+
+    def patch(self, namespace: Optional[str], name: str, patch: Any, status_only: bool = False) -> dict:
+        existing = copy.deepcopy(self.get(namespace, name))
+        if isinstance(patch, list):  # JSON patch: support add/replace/remove on simple paths
+            for op in patch:
+                _apply_json_patch_op(existing, op)
+            merged = existing
+        else:
+            merged = _merge_patch(existing, patch)
+        return self.update(merged, namespace, name, status_only=status_only)
+
+    def delete(self, namespace: Optional[str], name: str) -> dict:
+        obj = self.get(namespace, name)
+        del self.objects[self.key(namespace, name)]
+        obj = copy.deepcopy(obj)
+        obj["metadata"]["resourceVersion"] = str(self.cluster.next_rv())
+        self._notify("DELETED", obj)
+        self.cluster.collect_garbage(obj["metadata"]["uid"])
+        return obj
+
+    def list(
+        self,
+        namespace: Optional[str],
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> list[dict]:
+        out = []
+        reqs = selectors.parse(label_selector) if label_selector else []
+        for (ns, _), obj in sorted(self.objects.items()):
+            if namespace and ns != namespace:
+                continue
+            labels = obj["metadata"].get("labels") or {}
+            if reqs and not all(r.matches(labels) for r in reqs):
+                continue
+            if field_selector and not _match_fields(field_selector, obj):
+                continue
+            out.append(obj)
+        return out
+
+
+class ApiException(Exception):
+    def __init__(self, status: int, reason: str, message: str):
+        self.status = status
+        self.reason = reason
+        self.message = message
+        super().__init__(message)
+
+    def response(self) -> web.Response:
+        return web.json_response(
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "message": self.message,
+                "reason": self.reason,
+                "code": self.status,
+            },
+            status=self.status,
+        )
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _merge_patch(base: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(base, dict):
+        base = {}
+    out = copy.deepcopy(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def _apply_json_patch_op(obj: dict, op: dict) -> None:
+    parts = [p.replace("~1", "/").replace("~0", "~") for p in op["path"].lstrip("/").split("/")]
+    cur: Any = obj
+    for p in parts[:-1]:
+        cur = cur[int(p)] if isinstance(cur, list) else cur.setdefault(p, {})
+    last = parts[-1]
+    kind = op["op"]
+    if kind in ("add", "replace"):
+        if isinstance(cur, list):
+            if last == "-":
+                cur.append(op["value"])
+            else:
+                cur.insert(int(last), op["value"]) if kind == "add" else cur.__setitem__(int(last), op["value"])
+        else:
+            cur[last] = op["value"]
+    elif kind == "remove":
+        if isinstance(cur, list):
+            del cur[int(last)]
+        else:
+            cur.pop(last, None)
+
+
+def _match_fields(field_selector: str, obj: dict) -> bool:
+    for part in field_selector.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            path, val = part.split("!=", 1)
+            if str(deep_get(obj, *path.split("."), default="")) == val:
+                return False
+        elif "==" in part:
+            path, val = part.split("==", 1)
+            if str(deep_get(obj, *path.split("."), default="")) != val:
+                return False
+        elif "=" in part:
+            path, val = part.split("=", 1)
+            if str(deep_get(obj, *path.split("."), default="")) != val:
+                return False
+    return True
+
+
+class FakeCluster:
+    """Runs the fake apiserver on 127.0.0.1:<port> plus simulators."""
+
+    def __init__(self, sim: Optional[SimConfig] = None):
+        self.sim = sim or SimConfig()
+        self._rv = 0
+        self.stores: dict[tuple[str, str], Store] = {}
+        for (group, _kind), info in obj_api._REGISTRY.items():
+            self.stores[(group, info.plural)] = self.stores.get((group, info.plural)) or Store(self, info)
+        self._runner: Optional[web.AppRunner] = None
+        self._sim_task: Optional[asyncio.Task] = None
+        self.port: Optional[int] = None
+        self._pod_timers: dict[tuple[str, str], float] = {}
+
+    # ------------------------------------------------------------------
+    def next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def store(self, group: str, plural: str) -> Store:
+        key = (group, plural)
+        if key not in self.stores:
+            raise ApiException(404, "NotFound", f"unknown resource {group}/{plural}")
+        return self.stores[key]
+
+    def store_for_kind(self, group: str, kind: str) -> Store:
+        info = obj_api.lookup(group, kind)
+        return self.store(group, info.plural)
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def collect_garbage(self, owner_uid: str) -> None:
+        """Delete objects owned (via ownerReferences) by a deleted uid."""
+        for store in self.stores.values():
+            for (ns, name), obj in list(store.objects.items()):
+                if obj_api.owned_by(obj, owner_uid):
+                    try:
+                        store.delete(ns or None, name)
+                    except ApiException:
+                        pass
+
+    # ------------------------------------------------------------------
+    # Direct (in-process) manipulation helpers for tests.
+
+    def put(self, obj: dict) -> dict:
+        """Create-or-replace directly in the store (test setup)."""
+        info = obj_api.info_of(obj)
+        store = self.store(info.gvk.group, info.plural)
+        meta = obj.setdefault("metadata", {})
+        ns = meta.get("namespace") if info.namespaced else None
+        try:
+            store.get(ns, meta["name"])
+            existing = store.get(ns, meta["name"])
+            obj.setdefault("metadata", {})["resourceVersion"] = existing["metadata"]["resourceVersion"]
+            return store.update(obj, ns, meta["name"])
+        except ApiException:
+            return store.create(obj, ns)
+
+    def get_obj(self, group: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        return self.store_for_kind(group, kind).get(namespace, name)
+
+    def add_node(
+        self,
+        name: str,
+        labels: Optional[dict] = None,
+        tpu: bool = True,
+        accelerator: str = "tpu-v5-lite-podslice",
+        topology: str = "2x4",
+        chips: int = 4,
+    ) -> dict:
+        """Add a simulated (GKE-style) node; TPU nodes carry GKE TPU labels."""
+        node_labels = {
+            "kubernetes.io/hostname": name,
+            "kubernetes.io/arch": "amd64",
+            "kubernetes.io/os": "linux",
+        }
+        if tpu:
+            node_labels[consts.GKE_TPU_ACCELERATOR_LABEL] = accelerator
+            node_labels[consts.GKE_TPU_TOPOLOGY_LABEL] = topology
+        node_labels.update(labels or {})
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": name, "labels": node_labels, "annotations": {}},
+            "spec": {},
+            "status": {
+                "capacity": {"cpu": "96", "memory": "200Gi"},
+                "allocatable": {"cpu": "95", "memory": "190Gi"},
+                "nodeInfo": {
+                    "containerRuntimeVersion": "containerd://1.7.0",
+                    "kubeletVersion": "v1.29.0",
+                    "osImage": "Container-Optimized OS from Google",
+                    "kernelVersion": "6.1.0-gke",
+                },
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+        if tpu:
+            node["metadata"]["annotations"]["tpu.google.com/sim.chips"] = str(chips)
+        return self.put(node)
+
+    # ------------------------------------------------------------------
+    # HTTP server.
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_route("*", "/api/v1/{rest:.*}", self._handle_core)
+        app.router.add_route("*", "/apis/{group}/{version}/{rest:.*}", self._handle_group)
+        self._runner = web.AppRunner(app, shutdown_timeout=1.0)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        if self.sim.enabled:
+            self._sim_task = asyncio.create_task(self._simulate())
+        # default namespaces
+        for ns in ("default", "kube-system", "tpu-operator"):
+            try:
+                self.store("", "namespaces").create(
+                    {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns}}, None
+                )
+            except ApiException:
+                pass
+
+    async def stop(self) -> None:
+        if self._sim_task:
+            self._sim_task.cancel()
+            try:
+                await self._sim_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def __aenter__(self) -> "FakeCluster":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # ------------------------------------------------------------------
+    async def _handle_core(self, request: web.Request) -> web.StreamResponse:
+        return await self._dispatch(request, "", "v1", request.match_info["rest"])
+
+    async def _handle_group(self, request: web.Request) -> web.StreamResponse:
+        return await self._dispatch(
+            request, request.match_info["group"], request.match_info["version"], request.match_info["rest"]
+        )
+
+    async def _dispatch(self, request: web.Request, group: str, version: str, rest: str) -> web.StreamResponse:
+        try:
+            parts = [p for p in rest.split("/") if p]
+            namespace: Optional[str] = None
+            subresource: Optional[str] = None
+            if parts and parts[0] == "namespaces" and len(parts) >= 3:
+                namespace = parts[1]
+                parts = parts[2:]
+            elif parts and parts[0] == "namespaces" and len(parts) == 2 and group == "":
+                # operations on the Namespace object itself
+                return await self._handle_object(request, self.store("", "namespaces"), None, parts[1], None)
+            if not parts:
+                raise ApiException(404, "NotFound", "no resource")
+            plural = parts[0]
+            name = parts[1] if len(parts) > 1 else None
+            if len(parts) > 2:
+                subresource = parts[2]
+            store = self.store(group, plural)
+            if name is None:
+                return await self._handle_collection(request, store, namespace)
+            return await self._handle_object(request, store, namespace, name, subresource)
+        except ApiException as e:
+            return e.response()
+        except json.JSONDecodeError as e:
+            return ApiException(400, "BadRequest", f"invalid JSON body: {e}").response()
+        except Exception as e:  # noqa: BLE001
+            log.exception("fake apiserver internal error")
+            return ApiException(500, "InternalError", str(e)).response()
+
+    async def _handle_collection(
+        self, request: web.Request, store: Store, namespace: Optional[str]
+    ) -> web.StreamResponse:
+        q = request.rel_url.query
+        if request.method == "GET" and q.get("watch") in ("1", "true"):
+            return await self._serve_watch(request, store, namespace)
+        if request.method == "GET":
+            items = store.list(namespace, q.get("labelSelector", ""), q.get("fieldSelector", ""))
+            return web.json_response(
+                {
+                    "kind": store.info.gvk.kind + "List",
+                    "apiVersion": store.info.gvk.api_version,
+                    "metadata": {"resourceVersion": str(self._rv)},
+                    "items": copy.deepcopy(items),
+                }
+            )
+        if request.method == "POST":
+            body = await request.json()
+            return web.json_response(store.create(body, namespace), status=201)
+        if request.method == "DELETE":
+            items = store.list(namespace, q.get("labelSelector", ""), q.get("fieldSelector", ""))
+            for item in list(items):
+                store.delete(item["metadata"].get("namespace"), item["metadata"]["name"])
+            return web.json_response({"status": "Success"})
+        raise ApiException(405, "MethodNotAllowed", request.method)
+
+    async def _handle_object(
+        self,
+        request: web.Request,
+        store: Store,
+        namespace: Optional[str],
+        name: str,
+        subresource: Optional[str],
+    ) -> web.StreamResponse:
+        status_only = subresource == "status"
+        if request.method == "GET":
+            return web.json_response(copy.deepcopy(store.get(namespace, name)))
+        if request.method == "PUT":
+            body = await request.json()
+            return web.json_response(store.update(body, namespace, name, status_only=status_only))
+        if request.method == "PATCH":
+            body = await request.json()
+            return web.json_response(store.patch(namespace, name, body, status_only=status_only))
+        if request.method == "DELETE":
+            return web.json_response(store.delete(namespace, name))
+        raise ApiException(405, "MethodNotAllowed", request.method)
+
+    async def _serve_watch(
+        self, request: web.Request, store: Store, namespace: Optional[str]
+    ) -> web.StreamResponse:
+        q = request.rel_url.query
+        selector = q.get("labelSelector", "")
+        rv0 = int(q.get("resourceVersion") or 0)
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/json", "Transfer-Encoding": "chunked"}
+        )
+        await resp.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+        parsed_sel = selectors.parse(selector) if selector else []
+        # replay buffered events newer than rv0
+        for rv, evt in list(store.events):
+            if rv > rv0:
+                obj = evt["object"]
+                if namespace and obj["metadata"].get("namespace") != namespace:
+                    continue
+                labels = obj["metadata"].get("labels") or {}
+                if parsed_sel and not all(r.matches(labels) for r in parsed_sel):
+                    continue
+                queue.put_nowait(evt)
+        store.watchers.append((queue, namespace, parsed_sel))
+        try:
+            while True:
+                try:
+                    evt = await asyncio.wait_for(queue.get(), timeout=0.2)
+                except asyncio.TimeoutError:
+                    if request.transport is None or request.transport.is_closing():
+                        break
+                    continue
+                await resp.write(json.dumps(evt).encode() + b"\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            store.watchers.remove((queue, namespace, parsed_sel))
+        return resp
+
+    # ------------------------------------------------------------------
+    # Kubelet / controller simulators.
+
+    async def _simulate(self) -> None:
+        while True:
+            try:
+                self._sim_daemonsets()
+                self._sim_deployments()
+                await self._sim_pods()
+            except Exception:  # noqa: BLE001
+                log.exception("simulator error")
+            await asyncio.sleep(self.sim.tick)
+
+    def _schedulable_nodes(self, pod_spec: dict) -> list[dict]:
+        nodes = self.store("", "nodes").list(None)
+        out = []
+        for node in nodes:
+            labels = node["metadata"].get("labels", {})
+            if node["spec"].get("unschedulable"):
+                continue
+            ns_sel = pod_spec.get("nodeSelector") or {}
+            if any(labels.get(k) != v for k, v in ns_sel.items()):
+                continue
+            affinity = deep_get(
+                pod_spec, "affinity", "nodeAffinity",
+                "requiredDuringSchedulingIgnoredDuringExecution", "nodeSelectorTerms",
+            )
+            if affinity and not selectors.matches_node_selector_terms(affinity, labels):
+                continue
+            out.append(node)
+        return out
+
+    def _sim_daemonsets(self) -> None:
+        ds_store = self.store("apps", "daemonsets")
+        pod_store = self.store("", "pods")
+        for ds in list(ds_store.objects.values()):
+            ns = ds["metadata"]["namespace"]
+            ds_name = ds["metadata"]["name"]
+            pod_spec = deep_get(ds, "spec", "template", "spec", default={})
+            pod_labels = deep_get(ds, "spec", "template", "metadata", "labels", default={})
+            nodes = self._schedulable_nodes(pod_spec)
+            want = {n["metadata"]["name"] for n in nodes}
+            have: dict[str, dict] = {}
+            for pod in list(pod_store.objects.values()):
+                if pod["metadata"].get("namespace") != ns:
+                    continue
+                if obj_api.owned_by(pod, ds["metadata"]["uid"]):
+                    have[deep_get(pod, "spec", "nodeName", default="")] = pod
+            generation = str(ds["metadata"].get("generation", 1))
+            for node_name in want - set(have):
+                base = f"{ds_name}-{node_name}"
+                if len(base) > 63:  # keep names unique under the k8s length cap
+                    base = base[:54] + "-" + format(fnv1a_64(base.encode()) & 0xFFFFFFFF, "08x")
+                pod = {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": base,
+                        "namespace": ns,
+                        "labels": dict(pod_labels),
+                        "annotations": {"tpu.google.com/sim.ds-generation": generation},
+                    },
+                    "spec": {**copy.deepcopy(pod_spec), "nodeName": node_name},
+                    "status": {"phase": "Pending"},
+                }
+                obj_api.set_owner_reference(pod, ds)
+                try:
+                    created = pod_store.create(pod, ns)
+                    self._pod_timers[(ns, created["metadata"]["name"])] = time.monotonic()
+                except ApiException:
+                    pass
+            for node_name, pod in list(have.items()):
+                stale = (
+                    pod["metadata"].get("annotations", {}).get("tpu.google.com/sim.ds-generation")
+                    != generation
+                )
+                if node_name not in want or stale:
+                    # template changed (OnDelete/rolling sim) or node no longer
+                    # matches → remove; re-created next tick from new template
+                    try:
+                        pod_store.delete(ns, pod["metadata"]["name"])
+                    except ApiException:
+                        pass
+            # recompute status
+            ready = sum(
+                1
+                for p in pod_store.objects.values()
+                if obj_api.owned_by(p, ds["metadata"]["uid"])
+                and deep_get(p, "status", "phase") == "Running"
+            )
+            scheduled = sum(
+                1 for p in pod_store.objects.values() if obj_api.owned_by(p, ds["metadata"]["uid"])
+            )
+            status = {
+                "desiredNumberScheduled": len(want),
+                "currentNumberScheduled": scheduled,
+                "numberReady": ready,
+                "numberAvailable": ready,
+                "updatedNumberScheduled": scheduled,
+                "numberMisscheduled": 0,
+                "observedGeneration": ds["metadata"].get("generation", 1),
+            }
+            if ds.get("status") != status:
+                patched = copy.deepcopy(ds)
+                patched["status"] = status
+                try:
+                    ds_store.update(patched, ns, ds_name, status_only=True)
+                except ApiException:
+                    pass
+
+    def _sim_deployments(self) -> None:
+        dep_store = self.store("apps", "deployments")
+        for dep in list(dep_store.objects.values()):
+            replicas = deep_get(dep, "spec", "replicas", default=1)
+            status = {
+                "replicas": replicas,
+                "readyReplicas": replicas,
+                "availableReplicas": replicas,
+                "updatedReplicas": replicas,
+                "observedGeneration": dep["metadata"].get("generation", 1),
+            }
+            if dep.get("status") != status:
+                patched = copy.deepcopy(dep)
+                patched["status"] = status
+                try:
+                    dep_store.update(patched, dep["metadata"]["namespace"], dep["metadata"]["name"], status_only=True)
+                except ApiException:
+                    pass
+
+    async def _sim_pods(self) -> None:
+        pod_store = self.store("", "pods")
+        now = time.monotonic()
+        for pod in list(pod_store.objects.values()):
+            ns = pod["metadata"]["namespace"]
+            name = pod["metadata"]["name"]
+            phase = deep_get(pod, "status", "phase")
+            key = (ns, name)
+            started = self._pod_timers.setdefault(key, now)
+            if phase == "Pending" and now - started >= self.sim.pod_ready_delay:
+                restart_policy = deep_get(pod, "spec", "restartPolicy", default="Always")
+                if restart_policy != "Always" and self.sim.pod_executor is not None:
+                    final = await asyncio.get_event_loop().run_in_executor(
+                        None, self.sim.pod_executor, copy.deepcopy(pod)
+                    )
+                    self._set_pod_phase(pod_store, ns, name, final)
+                elif restart_policy != "Always":
+                    self._set_pod_phase(pod_store, ns, name, "Succeeded")
+                else:
+                    self._set_pod_phase(pod_store, ns, name, "Running")
+                    self._maybe_advertise_tpu(pod)
+
+    def _set_pod_phase(self, pod_store: Store, ns: str, name: str, phase: str) -> None:
+        try:
+            pod = pod_store.get(ns, name)
+        except ApiException:
+            return
+        patched = copy.deepcopy(pod)
+        containers = deep_get(pod, "spec", "containers", default=[]) or [{"name": "main"}]
+        patched["status"] = {
+            "phase": phase,
+            "conditions": [{"type": "Ready", "status": "True" if phase == "Running" else "False"}],
+            "containerStatuses": [
+                {"name": c.get("name", "main"), "ready": phase == "Running", "restartCount": 0}
+                for c in containers
+            ],
+        }
+        try:
+            pod_store.update(patched, ns, name, status_only=True)
+        except ApiException:
+            pass
+
+    def _maybe_advertise_tpu(self, pod: dict) -> None:
+        """When a device-plugin DS pod goes Ready on a TPU node, simulate the
+        kubelet picking up the plugin registration: node advertises
+        google.com/tpu capacity/allocatable."""
+        labels = pod["metadata"].get("labels", {})
+        if labels.get("app") != "tpu-device-plugin":
+            return
+        node_name = deep_get(pod, "spec", "nodeName")
+        if not node_name:
+            return
+        node_store = self.store("", "nodes")
+        try:
+            node = node_store.get(None, node_name)
+        except ApiException:
+            return
+        chips = node["metadata"].get("annotations", {}).get("tpu.google.com/sim.chips", "4")
+        patched = copy.deepcopy(node)
+        patched["status"].setdefault("capacity", {})[consts.TPU_RESOURCE] = chips
+        patched["status"].setdefault("allocatable", {})[consts.TPU_RESOURCE] = chips
+        if patched["status"] != node["status"]:
+            try:
+                node_store.update(patched, None, node_name, status_only=True)
+            except ApiException:
+                pass

@@ -1,0 +1,107 @@
+"""Small shared helpers.
+
+Reference analogue: internal/utils/utils.go (GetObjectHash :66-78 — FNV-1a over
+a deterministic dump; GetFilesWithSuffix :33-58).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable, Iterator
+
+FNV1A_64_OFFSET = 0xCBF29CE484222325
+FNV1A_64_PRIME = 0x100000001B3
+
+
+def fnv1a_64(data: bytes) -> int:
+    h = FNV1A_64_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * FNV1A_64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def object_hash(obj: Any) -> str:
+    """Deterministic content hash of a JSON-serialisable object.
+
+    Used for the last-applied-hash annotation that lets states skip no-op
+    updates (getDaemonsetHash, controllers/object_controls.go:4173).
+    """
+    dumped = json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
+    return format(fnv1a_64(dumped.encode()), "x")
+
+
+def files_with_suffix(root: str, *suffixes: str) -> list[str]:
+    """Sorted file paths under ``root`` ending with any suffix (recursive)."""
+    out: list[str] = []
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            if name.endswith(tuple(suffixes)):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def deep_get(obj: Any, *path: str | int, default: Any = None) -> Any:
+    """Traverse nested dicts/lists; return ``default`` on any miss."""
+    cur = obj
+    for key in path:
+        try:
+            if isinstance(key, int):
+                cur = cur[key]
+            else:
+                cur = cur.get(key)  # type: ignore[union-attr]
+        except (TypeError, AttributeError, IndexError, KeyError):
+            return default
+        if cur is None:
+            return default
+    return cur
+
+
+def deep_set(obj: dict, value: Any, *path: str) -> None:
+    """Set a nested dict value, creating intermediate dicts."""
+    cur = obj
+    for key in path[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[path[-1]] = value
+
+
+def merge_env(env_list: list[dict], name: str, value: str) -> None:
+    """Set/replace an entry in a k8s container ``env`` list in place.
+
+    Reference analogue: setContainerEnv (controllers/object_controls.go:2170).
+    """
+    for item in env_list:
+        if item.get("name") == name:
+            item["value"] = value
+            return
+    env_list.append({"name": name, "value": value})
+
+
+def chunked(it: Iterable, n: int) -> Iterator[list]:
+    buf: list = []
+    for x in it:
+        buf.append(x)
+        if len(buf) == n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse an ICI topology string like ``2x4`` or ``4x4x4`` into dims."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"invalid topology {topology!r}") from e
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid topology {topology!r}")
+    return dims
+
+
+def topology_chips(topology: str) -> int:
+    n = 1
+    for d in parse_topology(topology):
+        n *= d
+    return n

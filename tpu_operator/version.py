@@ -1,0 +1,11 @@
+"""Version info, stamped at build time.
+
+Reference analogue: internal/info/version.go + ldflags stamping (Makefile:91-94).
+"""
+
+__version__ = "0.1.0"
+GIT_COMMIT = "unknown"
+
+
+def version_string() -> str:
+    return f"tpu-operator {__version__} (commit {GIT_COMMIT})"
