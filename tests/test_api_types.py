@@ -1,0 +1,192 @@
+"""API type round-trip, defaulting, enable-gate, and image-resolution tests.
+
+Reference test analogue: api/v1alpha1/nvidiadriver_types_test.go (image path
+resolution) and the IsEnabled helper behaviour of clusterpolicy_types.go.
+"""
+
+import pytest
+
+from tpu_operator.api import conditions, crds
+from tpu_operator.api.types import (
+    OperandSpec,
+    SliceStrategy,
+    TPUClusterPolicy,
+    TPUClusterPolicySpec,
+    TPURuntimeSpec,
+    resolve_image,
+)
+
+
+def test_spec_defaults():
+    spec = TPUClusterPolicySpec.from_dict({})
+    assert spec.device_plugin.is_enabled()
+    assert spec.sandbox_workloads.enabled is False
+    assert spec.slice_manager.strategy == SliceStrategy.SINGLE
+    assert spec.daemonsets.priority_class_name == "system-node-critical"
+    assert spec.libtpu.upgrade_policy.max_parallel_upgrades == 1
+
+
+def test_camel_case_round_trip():
+    data = {
+        "devicePlugin": {"enabled": False, "imagePullPolicy": "Always"},
+        "metricsExporter": {"serviceMonitor": {"enabled": True, "interval": "30s"}},
+        "daemonsets": {"priorityClassName": "high", "updateStrategy": "OnDelete"},
+        "futureField": {"anything": 1},
+    }
+    spec = TPUClusterPolicySpec.from_dict(data)
+    assert spec.device_plugin.enabled is False
+    assert spec.device_plugin.image_pull_policy == "Always"
+    assert spec.metrics_exporter.service_monitor.enabled is True
+    assert spec.daemonsets.update_strategy == "OnDelete"
+    out = spec.to_dict()
+    assert out["devicePlugin"]["enabled"] is False
+    assert out["metricsExporter"]["serviceMonitor"]["interval"] == "30s"
+    # unknown fields preserved (CRD forward-compat)
+    assert out["futureField"] == {"anything": 1}
+
+
+def test_state_enabled_gates():
+    spec = TPUClusterPolicySpec.from_dict({})
+    assert spec.state_enabled("state-libtpu")
+    assert spec.state_enabled("state-device-plugin")
+    assert not spec.state_enabled("state-sandbox-validation")
+    assert not spec.state_enabled("state-vfio-manager")
+    assert not spec.state_enabled("state-metrics-agent")  # defaults off like dcgm standalone
+
+    spec = TPUClusterPolicySpec.from_dict(
+        {"sandboxWorkloads": {"enabled": True}, "devicePlugin": {"enabled": False}}
+    )
+    assert spec.state_enabled("state-sandbox-validation")
+    assert spec.state_enabled("state-vfio-manager")
+    assert not spec.state_enabled("state-device-plugin")
+
+    # NVIDIADriver-CRD bypass analogue: libtpu state skipped when CRD-managed
+    spec = TPUClusterPolicySpec.from_dict({"libtpu": {"useTpuRuntimeCrd": True}})
+    assert not spec.state_enabled("state-libtpu")
+
+    with pytest.raises(ValueError):
+        spec.state_enabled("no-such-state")
+
+
+def test_image_resolution(monkeypatch):
+    # full triple
+    assert (
+        resolve_image("gcr.io/tpu-operator", "libtpu", "v1.2", "libtpu")
+        == "gcr.io/tpu-operator/libtpu:v1.2"
+    )
+    # digest
+    assert (
+        resolve_image("gcr.io/x", "libtpu", "sha256:abc", "libtpu")
+        == "gcr.io/x/libtpu@sha256:abc"
+    )
+    # fully-qualified image wins
+    assert resolve_image(None, "gcr.io/x/libtpu:tag", None, "libtpu") == "gcr.io/x/libtpu:tag"
+    # env fallback (imagePath analogue)
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/env/plugin:v9")
+    assert resolve_image(None, None, None, "device-plugin") == "gcr.io/env/plugin:v9"
+    monkeypatch.delenv("DEVICE_PLUGIN_IMAGE")
+    with pytest.raises(ValueError):
+        resolve_image(None, None, None, "device-plugin")
+
+
+def test_operand_spec_image_path(monkeypatch):
+    spec = OperandSpec.from_dict({"repository": "r", "image": "i", "version": "v"})
+    assert spec.image_path("validator") == "r/i:v"
+
+
+def test_cr_image_beats_env(monkeypatch):
+    # an explicit bare CR image must win over the deployment env fallback
+    monkeypatch.setenv("DEVICE_PLUGIN_IMAGE", "gcr.io/env/plugin:v9")
+    assert resolve_image(None, "my-custom-plugin", None, "device-plugin") == "my-custom-plugin"
+
+
+def test_empty_yaml_body_keeps_defaults():
+    # "libtpu:" with an empty body parses to None; defaults must survive
+    spec = TPUClusterPolicySpec.from_dict({"libtpu": None, "devicePlugin": None})
+    assert spec.libtpu.is_enabled()
+    assert spec.state_enabled("state-libtpu")
+
+
+def test_from_dict_does_not_alias_source():
+    src = {"devicePlugin": {"env": [{"name": "A", "value": "1"}]}}
+    spec = TPUClusterPolicySpec.from_dict(src)
+    spec.device_plugin.env.append({"name": "B", "value": "2"})
+    assert src["devicePlugin"]["env"] == [{"name": "A", "value": "1"}]
+
+
+def test_crd_enum_constraints():
+    props = crds.schema_of(TPUClusterPolicySpec)["properties"]
+    assert props["sliceManager"]["properties"]["strategy"]["enum"] == list(SliceStrategy.ALL)
+    assert set(props["daemonsets"]["properties"]["updateStrategy"]["enum"]) == {
+        "RollingUpdate", "OnDelete",
+    }
+    rt = crds.schema_of(TPURuntimeSpec)["properties"]
+    assert "enum" in rt["runtimeType"]
+
+
+def test_spec_cache():
+    cr = TPUClusterPolicy.new(spec={})
+    assert cr.spec is cr.spec  # parsed once
+
+
+def test_tpu_runtime_spec():
+    spec = TPURuntimeSpec.from_dict(
+        {
+            "runtimeType": "standard",
+            "repository": "gcr.io/t",
+            "image": "tpu-runtime",
+            "version": "2026.1",
+            "nodeSelector": {"pool": "a"},
+        }
+    )
+    assert spec.image_path() == "gcr.io/t/tpu-runtime:2026.1"
+    assert spec.node_selector == {"pool": "a"}
+
+
+def test_conditions_pairing():
+    status = {}
+    assert conditions.set_ready(status, generation=3)
+    assert conditions.is_ready(status)
+    ready = conditions.get_condition(status, conditions.READY)
+    assert ready["observedGeneration"] == 3
+    t0 = ready["lastTransitionTime"]
+    # no-op re-set → no change reported
+    assert not conditions.set_ready(status, generation=3)
+    assert conditions.get_condition(status, conditions.READY)["lastTransitionTime"] == t0
+    # flip to error
+    assert conditions.set_error(status, conditions.REASON_OPERAND_NOT_READY, "ds not ready")
+    assert not conditions.is_ready(status)
+    err = conditions.get_condition(status, conditions.ERROR)
+    assert err["status"] == "True"
+    assert err["reason"] == conditions.REASON_OPERAND_NOT_READY
+
+
+def test_crd_generation():
+    crd = crds.cluster_policy_crd()
+    assert crd["metadata"]["name"] == "tpuclusterpolicies.tpu.google.com"
+    version = crd["spec"]["versions"][0]
+    assert version["subresources"] == {"status": {}}
+    props = version["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+    # every component sub-spec appears, camelCased
+    for key in (
+        "operator", "daemonsets", "libtpu", "runtimePrep", "devicePlugin",
+        "metricsAgent", "metricsExporter", "featureDiscovery", "sliceManager",
+        "nodeStatusExporter", "validator", "sandboxWorkloads", "vfioManager",
+        "sandboxDevicePlugin", "psa", "cdi",
+    ):
+        assert key in props, key
+    # nested operand pattern renders
+    dp = props["devicePlugin"]["properties"]
+    assert dp["imagePullPolicy"]["type"] == "string"
+    assert dp["config"]["type"] == "object"
+    rt = crds.tpu_runtime_crd()
+    assert rt["spec"]["names"]["plural"] == "tpuruntimes"
+
+
+def test_cluster_policy_wrapper():
+    cr = TPUClusterPolicy.new(spec={"devicePlugin": {"enabled": False}})
+    assert cr.name == "cluster-policy"
+    assert not cr.spec.device_plugin.is_enabled()
+    cr.set_state("ready", "tpu-operator")
+    assert cr.obj["status"]["state"] == "ready"
+    assert cr.obj["status"]["namespace"] == "tpu-operator"

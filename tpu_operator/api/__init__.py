@@ -1,0 +1,16 @@
+"""API layer: the operator's CRD types.
+
+Reference analogue: ``api/v1/clusterpolicy_types.go`` (TPUClusterPolicy) and
+``api/v1alpha1/nvidiadriver_types.go`` (TPURuntime).  Objects on the wire are
+plain dicts; these dataclasses give the controllers a typed view plus
+defaulting, validation, and image resolution.
+"""
+
+from tpu_operator.api.types import (  # noqa: F401
+    TPUClusterPolicy,
+    TPUClusterPolicySpec,
+    TPURuntime,
+    TPURuntimeSpec,
+    OperandSpec,
+    State,
+)

@@ -1,0 +1,90 @@
+"""metav1.Condition helpers + Ready/Error updaters.
+
+Reference analogue: ``internal/conditions/`` — an Updater interface with
+ClusterPolicy and NVIDIADriver implementations that set paired Ready/Error
+conditions (conditions.go:33-36, clusterpolicy.go:37, nvidiadriver.go:43).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+READY = "Ready"
+ERROR = "Error"
+
+# Common reasons (internal/conditions/conditions.go reason constants).
+REASON_READY = "Ready"
+REASON_ERROR = "Error"
+REASON_OPERAND_NOT_READY = "OperandNotReady"
+REASON_NO_TPU_NODES = "NoTPUNodes"
+REASON_IGNORED = "Ignored"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def set_condition(
+    status: dict,
+    cond_type: str,
+    cond_status: str,
+    reason: str,
+    message: str = "",
+    generation: Optional[int] = None,
+) -> bool:
+    """Upsert a condition; returns True if anything changed.
+
+    lastTransitionTime only moves when ``status`` flips (metav1 semantics).
+    """
+    conds = status.setdefault("conditions", [])
+    new = {
+        "type": cond_type,
+        "status": cond_status,
+        "reason": reason,
+        "message": message,
+        "lastTransitionTime": _now(),
+    }
+    if generation is not None:
+        new["observedGeneration"] = generation
+    for i, c in enumerate(conds):
+        if c.get("type") == cond_type:
+            if (
+                c.get("status") == cond_status
+                and c.get("reason") == reason
+                and c.get("message") == message
+                and c.get("observedGeneration") == new.get("observedGeneration")
+            ):
+                return False
+            if c.get("status") == cond_status:
+                new["lastTransitionTime"] = c.get("lastTransitionTime", new["lastTransitionTime"])
+            conds[i] = new
+            return True
+    conds.append(new)
+    return True
+
+
+def get_condition(status: dict, cond_type: str) -> Optional[dict]:
+    for c in status.get("conditions", []) or []:
+        if c.get("type") == cond_type:
+            return c
+    return None
+
+
+def set_ready(status: dict, message: str = "All operands are ready", generation: Optional[int] = None) -> bool:
+    """Ready=True, Error=False pair (internal/conditions SetConditionsReady)."""
+    changed = set_condition(status, READY, "True", REASON_READY, message, generation)
+    changed |= set_condition(status, ERROR, "False", REASON_READY, "", generation)
+    return changed
+
+
+def set_error(status: dict, reason: str, message: str, generation: Optional[int] = None) -> bool:
+    """Ready=False, Error=True pair (internal/conditions SetConditionsError)."""
+    changed = set_condition(status, READY, "False", reason, message, generation)
+    changed |= set_condition(status, ERROR, "True", reason, message, generation)
+    return changed
+
+
+def is_ready(status: dict) -> bool:
+    c = get_condition(status, READY)
+    return bool(c and c.get("status") == "True")

@@ -1,0 +1,187 @@
+"""CRD manifest generation from the spec dataclasses.
+
+controller-gen analogue (reference builds CRDs from kubebuilder markers,
+Makefile:117-124): here the dataclasses *are* the schema source, so the CRD
+openAPIV3Schema is derived by introspection.  ``python -m tpu_operator.api.crds``
+writes the YAML into deploy/crds/ (done at build time, like `make manifests`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Optional, get_args, get_origin, get_type_hints
+
+from tpu_operator.api import types as t
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _schema_for_type(tp: Any) -> dict:
+    tp = t._unwrap_optional(tp)
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    origin = get_origin(tp)
+    if origin in (list, typing.List):
+        args = get_args(tp)
+        item = _schema_for_type(args[0]) if args else {"x-kubernetes-preserve-unknown-fields": True}
+        return {"type": "array", "items": item}
+    if origin in (dict, typing.Dict) or tp is dict:
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if tp is list:
+        return {"type": "array", "x-kubernetes-preserve-unknown-fields": True, "items": {"x-kubernetes-preserve-unknown-fields": True}}
+    if dataclasses.is_dataclass(tp):
+        return schema_of(tp)
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def schema_of(cls: type) -> dict:
+    hints = get_type_hints(cls)
+    props: dict[str, dict] = {}
+    for f in dataclasses.fields(cls):
+        if f.name == "extra_fields":
+            continue
+        schema = _schema_for_type(hints[f.name])
+        if f.default is not dataclasses.MISSING and f.default is not None and not isinstance(f.default, (dict, list)):
+            schema["default"] = f.default
+        # kubebuilder Enum marker analogue: enforced at admission
+        enum = (f.metadata or {}).get("enum")
+        if enum:
+            schema["enum"] = list(enum)
+        props[t._camel(f.name)] = schema
+    return {
+        "type": "object",
+        "properties": props,
+        # CRDs must tolerate forward-compat fields (extra_fields round-trip).
+        "x-kubernetes-preserve-unknown-fields": True,
+    }
+
+
+_STATUS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "state": {"type": "string", "enum": [t.State.IGNORED, t.State.READY, t.State.NOT_READY, t.State.DISABLED]},
+        "namespace": {"type": "string"},
+        "conditions": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["type", "status"],
+                "properties": {
+                    "type": {"type": "string"},
+                    "status": {"type": "string"},
+                    "reason": {"type": "string"},
+                    "message": {"type": "string"},
+                    "lastTransitionTime": {"type": "string"},
+                    "observedGeneration": {"type": "integer"},
+                },
+            },
+        },
+    },
+    "x-kubernetes-preserve-unknown-fields": True,
+}
+
+
+def _crd(
+    kind: str,
+    plural: str,
+    singular: str,
+    version: str,
+    spec_cls: type,
+    scope: str = "Cluster",
+    short_names: Optional[list[str]] = None,
+    extra_printer_columns: Optional[list[dict]] = None,
+) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{t.GROUP}"},
+        "spec": {
+            "group": t.GROUP,
+            "scope": scope,
+            "names": {
+                "kind": kind,
+                "listKind": kind + "List",
+                "plural": plural,
+                "singular": singular,
+                **({"shortNames": short_names} if short_names else {}),
+            },
+            "versions": [
+                {
+                    "name": version,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {"name": "Status", "type": "string", "jsonPath": ".status.state"},
+                        {"name": "Age", "type": "date", "jsonPath": ".metadata.creationTimestamp"},
+                        *(extra_printer_columns or []),
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": schema_of(spec_cls),
+                                "status": _STATUS_SCHEMA,
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def cluster_policy_crd() -> dict:
+    return _crd(
+        t.CLUSTER_POLICY_KIND,
+        "tpuclusterpolicies",
+        "tpuclusterpolicy",
+        t.CLUSTER_POLICY_VERSION,
+        t.TPUClusterPolicySpec,
+        short_names=["tcp", "tpupolicy"],
+    )
+
+
+def tpu_runtime_crd() -> dict:
+    return _crd(
+        t.TPU_RUNTIME_KIND,
+        "tpuruntimes",
+        "tpuruntime",
+        t.TPU_RUNTIME_VERSION,
+        t.TPURuntimeSpec,
+        short_names=["tr"],
+        extra_printer_columns=[
+            {"name": "Type", "type": "string", "jsonPath": ".spec.runtimeType"},
+        ],
+    )
+
+
+def all_crds() -> list[dict]:
+    return [cluster_policy_crd(), tpu_runtime_crd()]
+
+
+def main() -> None:
+    import os
+
+    import yaml
+
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "..", "deploy", "crds")
+    os.makedirs(out_dir, exist_ok=True)
+    for crd in all_crds():
+        path = os.path.join(out_dir, crd["metadata"]["name"] + ".yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(crd, f, sort_keys=False)
+        print(f"wrote {os.path.normpath(path)}")
+
+
+if __name__ == "__main__":
+    main()

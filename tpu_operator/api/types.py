@@ -1,0 +1,544 @@
+"""TPUClusterPolicy / TPURuntime spec types.
+
+Mirrors the *capability surface* of the reference CRDs:
+
+- ``api/v1/clusterpolicy_types.go:38-90`` — ClusterPolicySpec's ~25 component
+  sub-specs, each repeating {enabled, repository, image, version,
+  imagePullPolicy, imagePullSecrets, resources, args, env} plus extras.
+- ``api/v1/clusterpolicy_types.go:1679-1773`` — image resolution CR → env var.
+- ``api/v1/clusterpolicy_types.go:1608-1643`` — MIGStrategy enum and status.
+- ``api/v1alpha1/nvidiadriver_types.go:40-184`` — per-node-pool driver CR.
+
+Everything is a dataclass with ``from_dict``/``to_dict`` speaking the CRD's
+camelCase JSON.  Unknown keys are preserved on round-trip (CRDs evolve; the
+operator must not eat fields it does not understand).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, get_args, get_origin, get_type_hints
+
+from tpu_operator import consts
+
+GROUP = "tpu.google.com"
+CLUSTER_POLICY_KIND = "TPUClusterPolicy"
+CLUSTER_POLICY_VERSION = "v1"
+TPU_RUNTIME_KIND = "TPURuntime"
+TPU_RUNTIME_VERSION = "v1alpha1"
+
+
+class State:
+    """Operand/CR sync states (api/v1/clusterpolicy_types.go:1620-1632)."""
+
+    IGNORED = "ignored"
+    READY = "ready"
+    NOT_READY = "notReady"
+    DISABLED = "disabled"
+
+
+class SliceStrategy:
+    """MIGStrategy analogue (api/v1/clusterpolicy_types.go:1608-1618).
+
+    - none: slice partitioning ignored; whole-slice resources only.
+    - single: homogeneous sub-slices; still advertised as google.com/tpu.
+    - mixed: heterogeneous sub-slices advertised as google.com/tpu-<shape>.
+    """
+
+    NONE = "none"
+    SINGLE = "single"
+    MIXED = "mixed"
+
+    ALL = (NONE, SINGLE, MIXED)
+
+
+_CAMEL_RE = re.compile(r"_([a-z0-9])")
+
+
+def _camel(name: str) -> str:
+    return _CAMEL_RE.sub(lambda m: m.group(1).upper(), name)
+
+
+def _is_spec_type(t: Any) -> bool:
+    return dataclasses.is_dataclass(t) and isinstance(t, type)
+
+
+def _unwrap_optional(t: Any) -> Any:
+    if get_origin(t) is not None and type(None) in get_args(t):
+        args = [a for a in get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return t
+
+
+class SpecBase:
+    """from_dict/to_dict with camelCase mapping and unknown-key preservation.
+
+    The parsed spec is a *snapshot*: input values are deep-copied so mutating
+    the typed view never corrupts the source CR dict (informer caches hand out
+    shared objects), and writes to the typed view are not written back — CR
+    updates go through the unstructured dict.
+    """
+
+    @classmethod
+    def from_dict(cls, data: Optional[dict]) -> "SpecBase":
+        data = dict(data or {})
+        hints = get_type_hints(cls)
+        kwargs: dict[str, Any] = {}
+        extra: dict[str, Any] = {}
+        by_camel = {_camel(f.name): f for f in dataclasses.fields(cls) if f.name != "extra_fields"}
+        for key, value in data.items():
+            f = by_camel.get(key)
+            if f is None:
+                extra[key] = copy.deepcopy(value)
+                continue
+            if value is None:
+                # empty YAML body ("libtpu:") parses to None → keep the
+                # field's default instead of storing None into a
+                # non-Optional nested spec
+                continue
+            t = _unwrap_optional(hints[f.name])
+            if _is_spec_type(t) and isinstance(value, dict):
+                kwargs[f.name] = t.from_dict(value)
+            else:
+                kwargs[f.name] = copy.deepcopy(value)
+        obj = cls(**kwargs)  # type: ignore[call-arg]
+        if extra and hasattr(obj, "extra_fields"):
+            obj.extra_fields = extra  # type: ignore[attr-defined]
+        return obj
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(self):  # type: ignore[arg-type]
+            if f.name == "extra_fields":
+                continue
+            value = getattr(self, f.name)
+            if value is None:
+                continue
+            if isinstance(value, SpecBase):
+                nested = value.to_dict()
+                if nested:
+                    out[_camel(f.name)] = nested
+            else:
+                out[_camel(f.name)] = copy.deepcopy(value)
+        out.update(getattr(self, "extra_fields", {}) or {})
+        return out
+
+
+@dataclass
+class OperandSpec(SpecBase):
+    """The repeated per-component pattern (clusterpolicy_types.go:120-190).
+
+    ``enabled=None`` means "component default" — most operands default on,
+    the sandbox/VM chain defaults off (see is_enabled callers).
+    """
+
+    enabled: Optional[bool] = None
+    repository: Optional[str] = None
+    image: Optional[str] = None
+    version: Optional[str] = None
+    image_pull_policy: str = "IfNotPresent"
+    image_pull_secrets: list = field(default_factory=list)
+    resources: Optional[dict] = None
+    args: list = field(default_factory=list)
+    env: list = field(default_factory=list)
+    extra_fields: dict = field(default_factory=dict)
+
+    def is_enabled(self, default: bool = True) -> bool:
+        return default if self.enabled is None else bool(self.enabled)
+
+    def image_path(self, component: str) -> str:
+        """CR triple → else env fallback (imagePath, clusterpolicy_types.go:1679)."""
+        return resolve_image(self.repository, self.image, self.version, component)
+
+
+def resolve_image(
+    repository: Optional[str], image: Optional[str], version: Optional[str], component: str
+) -> str:
+    """CR fields win over the env fallback (imagePath, clusterpolicy_types.go:1679).
+
+    Any CR-provided image — even a bare name with no tag — takes precedence;
+    the component env var only fills in when the CR is silent.
+    """
+    if image:
+        path = f"{repository}/{image}" if repository else image
+        if version:
+            sep = "@" if version.startswith("sha256:") else ":"
+            return f"{path}{sep}{version}"
+        return path
+    env_name = consts.IMAGE_ENVS.get(component)
+    env_val = os.environ.get(env_name, "") if env_name else ""
+    if env_val:
+        return env_val
+    raise ValueError(
+        f"could not resolve image for component {component!r}: "
+        f"no repository/image/version in CR and ${env_name} unset"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Component sub-specs with extras beyond the OperandSpec pattern.
+
+
+@dataclass
+class OperatorSpec(SpecBase):
+    """clusterpolicy_types.go OperatorSpec analogue: manager-level knobs."""
+
+    default_runtime: str = field(default="containerd", metadata={"enum": ["docker", "crio", "containerd"]})
+    runtime_class: str = "tpu"
+    init_container: Optional[OperandSpec] = None
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    use_precompiled: Optional[bool] = None  # reserved; TPU hosts need no kmod builds
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class DaemonsetsSpec(SpecBase):
+    """Cluster-wide defaults stamped onto every operand DaemonSet."""
+
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    tolerations: list = field(default_factory=list)
+    priority_class_name: str = "system-node-critical"
+    update_strategy: str = field(default="RollingUpdate", metadata={"enum": ["RollingUpdate", "OnDelete"]})
+    rolling_update: Optional[dict] = None  # {"maxUnavailable": "1"}
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class DrainSpec(SpecBase):
+    enable: bool = True
+    force: bool = False
+    timeout_seconds: int = 300
+    delete_empty_dir: bool = False
+    pod_selector: str = ""
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class PodDeletionSpec(SpecBase):
+    force: bool = False
+    timeout_seconds: int = 300
+    delete_empty_dir: bool = False
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class WaitForCompletionSpec(SpecBase):
+    pod_selector: str = ""
+    timeout_seconds: int = 0
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class UpgradePolicySpec(SpecBase):
+    """Driver auto-upgrade policy (clusterpolicy_types.go DriverUpgradePolicySpec)."""
+
+    auto_upgrade: bool = False
+    max_parallel_upgrades: int = 1
+    max_unavailable: Optional[str] = "25%"
+    wait_for_completion: WaitForCompletionSpec = field(default_factory=WaitForCompletionSpec)
+    drain: DrainSpec = field(default_factory=DrainSpec)
+    pod_deletion: PodDeletionSpec = field(default_factory=PodDeletionSpec)
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class LibtpuSpec(OperandSpec):
+    """state-libtpu operand: installs/pins libtpu + PJRT on TPU hosts.
+
+    DriverSpec analogue (clusterpolicy_types.go:451-561) minus kernel-module
+    machinery (COS TPU hosts ship the accel driver; we pin the *runtime*).
+    """
+
+    use_tpu_runtime_crd: bool = False  # UseNvidiaDriverCRD analogue
+    libtpu_version: Optional[str] = None  # pinned libtpu build id
+    runtime_channel: str = field(default="stable", metadata={"enum": ["stable", "nightly", "pinned"]})
+    upgrade_policy: UpgradePolicySpec = field(default_factory=UpgradePolicySpec)
+
+
+@dataclass
+class RuntimePrepSpec(OperandSpec):
+    """container-toolkit analogue: host/device prep instead of runtime rewrite.
+
+    TPU VMs need no containerd shim; this state fixes /dev/accel* and
+    /dev/vfio permissions, hugepages, and rlimits for the runtime user.
+    """
+
+    device_permissions: str = "0666"
+    hugepages_gb: Optional[int] = None
+
+
+@dataclass
+class DevicePluginConfigSpec(SpecBase):
+    """Per-node plugin config via ConfigMap + node label (object_controls.go:2261)."""
+
+    name: Optional[str] = None
+    default: Optional[str] = None
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class DevicePluginSpec(OperandSpec):
+    config: DevicePluginConfigSpec = field(default_factory=DevicePluginConfigSpec)
+
+
+@dataclass
+class MetricsAgentSpec(OperandSpec):
+    """Standalone telemetry agent (DCGM hostengine analogue); hostPort serve."""
+
+    host_port: int = 5555
+
+
+@dataclass
+class ServiceMonitorSpec(SpecBase):
+    enabled: bool = False
+    interval: str = "15s"
+    honor_labels: bool = False
+    additional_labels: dict = field(default_factory=dict)
+    relabelings: list = field(default_factory=list)
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class MetricsExporterSpec(OperandSpec):
+    """DCGM-exporter analogue: scrapes the agent, serves Prometheus."""
+
+    service_monitor: ServiceMonitorSpec = field(default_factory=ServiceMonitorSpec)
+    metrics_config: Optional[str] = None  # ConfigMap with counter allowlist CSV
+    port: int = 9400
+
+
+@dataclass
+class FeatureDiscoverySpec(OperandSpec):
+    """tpu-feature-discovery (GFD analogue)."""
+
+    sleep_interval: str = "60s"
+
+
+@dataclass
+class SliceManagerSpec(OperandSpec):
+    """MIG-manager analogue over ICI slice shapes."""
+
+    strategy: str = field(default=SliceStrategy.SINGLE, metadata={"enum": list(SliceStrategy.ALL)})
+    config: DevicePluginConfigSpec = field(default_factory=DevicePluginConfigSpec)
+
+
+@dataclass
+class NodeStatusExporterSpec(OperandSpec):
+    pass
+
+
+@dataclass
+class ValidatorPluginSpec(SpecBase):
+    env: list = field(default_factory=list)
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class ValidatorSpec(OperandSpec):
+    """state-operator-validation (validator image + per-component env)."""
+
+    plugin: ValidatorPluginSpec = field(default_factory=ValidatorPluginSpec)
+    jax: ValidatorPluginSpec = field(default_factory=ValidatorPluginSpec)
+
+
+@dataclass
+class SandboxWorkloadsSpec(SpecBase):
+    """sandboxWorkloads analogue (clusterpolicy_types.go SandboxWorkloadsSpec)."""
+
+    enabled: bool = False
+    default_workload: str = consts.DEFAULT_WORKLOAD
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class PSASpec(SpecBase):
+    enabled: bool = False
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class CDISpec(SpecBase):
+    enabled: bool = False
+    default: bool = False
+    extra_fields: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TPUClusterPolicySpec(SpecBase):
+    """The singleton cluster policy (ClusterPolicySpec analogue)."""
+
+    operator: OperatorSpec = field(default_factory=OperatorSpec)
+    daemonsets: DaemonsetsSpec = field(default_factory=DaemonsetsSpec)
+    libtpu: LibtpuSpec = field(default_factory=LibtpuSpec)
+    runtime_prep: RuntimePrepSpec = field(default_factory=RuntimePrepSpec)
+    device_plugin: DevicePluginSpec = field(default_factory=DevicePluginSpec)
+    metrics_agent: MetricsAgentSpec = field(default_factory=MetricsAgentSpec)
+    metrics_exporter: MetricsExporterSpec = field(default_factory=MetricsExporterSpec)
+    feature_discovery: FeatureDiscoverySpec = field(default_factory=FeatureDiscoverySpec)
+    slice_manager: SliceManagerSpec = field(default_factory=SliceManagerSpec)
+    node_status_exporter: NodeStatusExporterSpec = field(default_factory=NodeStatusExporterSpec)
+    validator: ValidatorSpec = field(default_factory=ValidatorSpec)
+    sandbox_workloads: SandboxWorkloadsSpec = field(default_factory=SandboxWorkloadsSpec)
+    vfio_manager: OperandSpec = field(default_factory=OperandSpec)
+    sandbox_device_plugin: OperandSpec = field(default_factory=OperandSpec)
+    psa: PSASpec = field(default_factory=PSASpec)
+    cdi: CDISpec = field(default_factory=CDISpec)
+    extra_fields: dict = field(default_factory=dict)
+
+    # -- enable gates (isStateEnabled analogue, state_manager.go:994-1036) --
+    def state_enabled(self, state: str) -> bool:
+        sandbox = self.sandbox_workloads.enabled
+        gates = {
+            "pre-requisites": True,
+            "state-operator-metrics": True,
+            "state-libtpu": self.libtpu.is_enabled() and not self.libtpu.use_tpu_runtime_crd,
+            "state-runtime-prep": self.runtime_prep.is_enabled(),
+            "state-operator-validation": self.validator.is_enabled(),
+            "state-device-plugin": self.device_plugin.is_enabled(),
+            "state-metrics-agent": self.metrics_agent.is_enabled(default=False),
+            "state-metrics-exporter": self.metrics_exporter.is_enabled(),
+            "tpu-feature-discovery": self.feature_discovery.is_enabled(),
+            "state-slice-manager": self.slice_manager.is_enabled(),
+            "state-node-status-exporter": self.node_status_exporter.is_enabled(default=False),
+            "state-sandbox-validation": sandbox,
+            "state-vfio-manager": sandbox and self.vfio_manager.is_enabled(),
+            "state-sandbox-device-plugin": sandbox and self.sandbox_device_plugin.is_enabled(),
+        }
+        try:
+            return gates[state]
+        except KeyError:
+            raise ValueError(f"unknown state {state!r}") from None
+
+
+@dataclass
+class TPUClusterPolicy:
+    """Typed wrapper over the unstructured CR dict.
+
+    ``spec`` is parsed once per wrapper and cached (the reconcile loop reads
+    many fields per pass); it is a read-only snapshot — mutate ``obj`` for
+    writes.
+    """
+
+    obj: dict
+    _spec_cache: Optional["TPUClusterPolicySpec"] = field(default=None, repr=False, compare=False)
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "TPUClusterPolicy":
+        return cls(obj=obj)
+
+    @classmethod
+    def new(cls, name: str = "cluster-policy", spec: Optional[dict] = None) -> "TPUClusterPolicy":
+        return cls(
+            obj={
+                "apiVersion": f"{GROUP}/{CLUSTER_POLICY_VERSION}",
+                "kind": CLUSTER_POLICY_KIND,
+                "metadata": {"name": name},
+                "spec": spec or {},
+            }
+        )
+
+    @property
+    def name(self) -> str:
+        return self.obj["metadata"]["name"]
+
+    @property
+    def spec(self) -> TPUClusterPolicySpec:
+        if self._spec_cache is None:
+            self._spec_cache = TPUClusterPolicySpec.from_dict(self.obj.get("spec") or {})
+        return self._spec_cache
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
+
+    def set_state(self, state: str, namespace: str = "") -> None:
+        self.status["state"] = state
+        if namespace:
+            self.status["namespace"] = namespace
+
+
+# ---------------------------------------------------------------------------
+# TPURuntime — per-node-pool runtime CR (NVIDIADriver analogue).
+
+
+class RuntimeType:
+    """driverType analogue (nvidiadriver_types.go:44-47); immutable per CR."""
+
+    STANDARD = "standard"  # container workloads (gpu)
+    SANDBOX = "sandbox"  # VM passthrough workloads (vgpu-host-manager/vfio)
+
+    ALL = (STANDARD, SANDBOX)
+
+
+@dataclass
+class TPURuntimeSpec(SpecBase):
+    """Per-node-pool libtpu/PJRT runtime management.
+
+    NVIDIADriverSpec analogue (nvidiadriver_types.go:40-184): its own
+    nodeSelector/tolerations/priorityClass, per-pool image resolution, and an
+    upgrade policy, letting different TPU node pools pin different runtimes.
+    """
+
+    runtime_type: str = field(default=RuntimeType.STANDARD, metadata={"enum": list(RuntimeType.ALL)})
+    repository: Optional[str] = None
+    image: Optional[str] = None
+    version: Optional[str] = None
+    image_pull_policy: str = "IfNotPresent"
+    image_pull_secrets: list = field(default_factory=list)
+    libtpu_version: Optional[str] = None
+    runtime_channel: str = field(default="stable", metadata={"enum": ["stable", "nightly", "pinned"]})
+    node_selector: dict = field(default_factory=dict)
+    node_affinity: Optional[dict] = None
+    tolerations: list = field(default_factory=list)
+    priority_class_name: str = "system-node-critical"
+    resources: Optional[dict] = None
+    args: list = field(default_factory=list)
+    env: list = field(default_factory=list)
+    annotations: dict = field(default_factory=dict)
+    labels: dict = field(default_factory=dict)
+    upgrade_policy: UpgradePolicySpec = field(default_factory=UpgradePolicySpec)
+    extra_fields: dict = field(default_factory=dict)
+
+    def image_path(self) -> str:
+        return resolve_image(self.repository, self.image, self.version, "libtpu")
+
+
+@dataclass
+class TPURuntime:
+    obj: dict
+    _spec_cache: Optional["TPURuntimeSpec"] = field(default=None, repr=False, compare=False)
+
+    @classmethod
+    def new(cls, name: str, spec: Optional[dict] = None) -> "TPURuntime":
+        return cls(
+            obj={
+                "apiVersion": f"{GROUP}/{TPU_RUNTIME_VERSION}",
+                "kind": TPU_RUNTIME_KIND,
+                "metadata": {"name": name},
+                "spec": spec or {},
+            }
+        )
+
+    @property
+    def name(self) -> str:
+        return self.obj["metadata"]["name"]
+
+    @property
+    def spec(self) -> TPURuntimeSpec:
+        if self._spec_cache is None:
+            self._spec_cache = TPURuntimeSpec.from_dict(self.obj.get("spec") or {})
+        return self._spec_cache
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
