@@ -1,0 +1,110 @@
+"""Golden-file fixtures for manifest rendering.
+
+Reference analogue: internal/state/driver_test.go:66-100 with goldens in
+internal/state/testdata/golden/ (driver-minimal, -full-spec, ...).
+
+Run ``python -m tests.goldens`` from the repo root to regenerate after an
+intentional template change; test_render.py byte-compares against these.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from tpu_operator.api.types import TPUClusterPolicySpec
+from tpu_operator.render import new_renderer
+from tpu_operator.state.render_data import STATE_DEFS, ClusterContext
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "testdata", "golden")
+
+# (config name, cluster context, CR spec dict)
+CONFIGS: list[tuple[str, ClusterContext, dict]] = [
+    (
+        "minimal",
+        ClusterContext(namespace="tpu-operator", tpu_node_count=1),
+        {},
+    ),
+    (
+        "full-spec",
+        ClusterContext(namespace="tpu-system", service_monitors_available=True, tpu_node_count=4),
+        {
+            "operator": {"runtimeClass": "tpu-rc", "defaultRuntime": "containerd"},
+            "daemonsets": {
+                "labels": {"team": "ml-infra"},
+                "tolerations": [{"key": "dedicated", "operator": "Exists", "effect": "NoSchedule"}],
+                "priorityClassName": "tpu-critical",
+                "updateStrategy": "RollingUpdate",
+                "rollingUpdate": {"maxUnavailable": "1"},
+            },
+            "libtpu": {
+                "repository": "gcr.io/acme",
+                "image": "tpu-runtime",
+                "version": "2026.2.1",
+                "libtpuVersion": "libtpu-2026-02-01",
+                "runtimeChannel": "pinned",
+                "env": [{"name": "TPU_LOG_LEVEL", "value": "info"}],
+                "upgradePolicy": {"autoUpgrade": True, "maxParallelUpgrades": 2,
+                                  "drain": {"force": True, "timeoutSeconds": 120}},
+            },
+            "runtimePrep": {"devicePermissions": "0660", "hugepagesGb": 16},
+            "devicePlugin": {
+                "repository": "gcr.io/acme",
+                "image": "tpu-device-plugin",
+                "version": "v1.3",
+                "config": {"name": "plugin-config", "default": "default"},
+                "resources": {"limits": {"memory": "128Mi"}},
+            },
+            "metricsAgent": {"enabled": True, "hostPort": 5700},
+            "metricsExporter": {
+                "repository": "gcr.io/acme",
+                "image": "tpu-metrics-exporter",
+                "version": "v2.0",
+                "port": 9500,
+                "metricsConfig": "custom-counters",
+                "serviceMonitor": {"enabled": True, "interval": "30s", "honorLabels": True,
+                                   "additionalLabels": {"release": "prom"}},
+            },
+            "featureDiscovery": {"sleepInterval": "30s"},
+            "sliceManager": {"strategy": "mixed", "config": {"name": "my-slice-config", "default": "all-balanced"}},
+            "nodeStatusExporter": {"enabled": True},
+            "validator": {
+                "repository": "gcr.io/acme",
+                "image": "tpu-validator",
+                "version": "v1.0",
+                "plugin": {"env": [{"name": "WITH_WORKLOAD", "value": "true"}]},
+                "jax": {"env": [{"name": "WITH_WORKLOAD", "value": "true"}]},
+            },
+            "sandboxWorkloads": {"enabled": True, "defaultWorkload": "container"},
+            "vfioManager": {"repository": "gcr.io/acme", "image": "tpu-vfio-manager", "version": "v0.1"},
+            "sandboxDevicePlugin": {"repository": "gcr.io/acme", "image": "tpu-sandbox-plugin", "version": "v0.1"},
+        },
+    ),
+]
+
+
+def render_config(name: str, ctx: ClusterContext, spec_dict: dict) -> dict[str, str]:
+    """Render every state for one config → {state_name: yaml_text}."""
+    renderer = new_renderer()
+    spec = TPUClusterPolicySpec.from_dict(spec_dict)
+    out: dict[str, str] = {}
+    for sdef in STATE_DEFS:
+        objs = renderer.render_dir(sdef.name, sdef.render_data(ctx, spec))
+        out[sdef.name] = yaml.safe_dump_all(objs, sort_keys=True, default_flow_style=False)
+    return out
+
+
+def main() -> None:
+    for name, ctx, spec_dict in CONFIGS:
+        cfg_dir = os.path.join(GOLDEN_DIR, name)
+        os.makedirs(cfg_dir, exist_ok=True)
+        for state, text in render_config(name, ctx, spec_dict).items():
+            path = os.path.join(cfg_dir, state + ".yaml")
+            with open(path, "w") as f:
+                f.write(text)
+    print(f"regenerated goldens under {GOLDEN_DIR}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Renderer + golden-file manifest tests (internal/state/driver_test.go pattern)."""
+
+import os
+
+import pytest
+import yaml
+
+from tests.goldens import CONFIGS, GOLDEN_DIR, render_config
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicySpec
+from tpu_operator.render import RenderError, Renderer, new_renderer
+from tpu_operator.state.render_data import STATE_DEFS, ClusterContext
+from tpu_operator.utils import deep_get
+
+
+@pytest.mark.parametrize("config", [c[0] for c in CONFIGS])
+def test_goldens(config):
+    name, ctx, spec_dict = next(c for c in CONFIGS if c[0] == config)
+    rendered = render_config(name, ctx, spec_dict)
+    for state, text in rendered.items():
+        path = os.path.join(GOLDEN_DIR, name, state + ".yaml")
+        assert os.path.exists(path), f"missing golden {path}; run python -m tests.goldens"
+        with open(path) as f:
+            expected = f.read()
+        assert text == expected, (
+            f"golden mismatch for {name}/{state}; run python -m tests.goldens if intentional"
+        )
+
+
+def _render_all(spec_dict=None, **ctx_kw):
+    renderer = new_renderer()
+    ctx = ClusterContext(namespace="tpu-operator", tpu_node_count=1, **ctx_kw)
+    spec = TPUClusterPolicySpec.from_dict(spec_dict or {})
+    return {
+        sdef.name: renderer.render_dir(sdef.name, sdef.render_data(ctx, spec))
+        for sdef in STATE_DEFS
+    }
+
+
+def test_every_daemonset_gated_on_deploy_label():
+    """Every operand DS must schedule only on deploy-labelled nodes
+    (gpuStateLabels engine contract, state_manager.go:90-115)."""
+    for state, objs in _render_all().items():
+        for obj in objs:
+            if obj["kind"] != "DaemonSet":
+                continue
+            sel = deep_get(obj, "spec", "template", "spec", "nodeSelector", default={})
+            gate_keys = [k for k in sel if k.startswith(consts.DEPLOY_LABEL_PREFIX)]
+            assert gate_keys, f"{state} DaemonSet lacks a deploy-label nodeSelector"
+
+
+def test_every_daemonset_tolerates_tpu_taint():
+    for state, objs in _render_all().items():
+        for obj in objs:
+            if obj["kind"] != "DaemonSet":
+                continue
+            tols = deep_get(obj, "spec", "template", "spec", "tolerations", default=[])
+            assert any(t.get("key") == consts.TPU_RESOURCE for t in tols), state
+
+
+def test_service_monitors_require_crd():
+    with_sm = _render_all(service_monitors_available=True)
+    without_sm = _render_all(service_monitors_available=False)
+    sm_count = sum(1 for objs in with_sm.values() for o in objs if o["kind"] == "ServiceMonitor")
+    assert sm_count >= 2
+    assert not any(o["kind"] == "ServiceMonitor" for objs in without_sm.values() for o in objs)
+
+
+def test_device_plugin_config_sidecar_wiring():
+    plain = _render_all()["state-device-plugin"]
+    with_cfg = _render_all({"devicePlugin": {"config": {"name": "cm", "default": "d"}}})[
+        "state-device-plugin"
+    ]
+    ds_plain = next(o for o in plain if o["kind"] == "DaemonSet")
+    ds_cfg = next(o for o in with_cfg if o["kind"] == "DaemonSet")
+    names = [c["name"] for c in deep_get(ds_plain, "spec", "template", "spec", "containers")]
+    assert names == ["tpu-device-plugin"]
+    names_cfg = [c["name"] for c in deep_get(ds_cfg, "spec", "template", "spec", "containers")]
+    assert "config-manager" in names_cfg
+    inits = [c["name"] for c in deep_get(ds_cfg, "spec", "template", "spec", "initContainers")]
+    assert "config-manager-init" in inits
+    # RBAC for configmap reads only rendered alongside the sidecar
+    assert not any(o["kind"] == "Role" for o in plain)
+    assert any(o["kind"] == "Role" for o in with_cfg)
+
+
+def test_validation_chain_order():
+    """operator-validation inits must run pjrt → plugin → jax in order."""
+    objs = _render_all()["state-operator-validation"]
+    ds = next(o for o in objs if o["kind"] == "DaemonSet")
+    inits = [c["name"] for c in deep_get(ds, "spec", "template", "spec", "initContainers")]
+    assert inits == ["pjrt-validation", "plugin-validation", "jax-validation"]
+
+
+def test_update_strategy_stamped():
+    objs = _render_all({"daemonsets": {"updateStrategy": "OnDelete"}})
+    for state, state_objs in objs.items():
+        for obj in state_objs:
+            if obj["kind"] != "DaemonSet":
+                continue
+            # libtpu DS is pinned OnDelete regardless (driver DS pattern,
+            # assets/state-driver/0500_daemonset.yaml:16-17)
+            assert deep_get(obj, "spec", "updateStrategy", "type") == "OnDelete", state
+
+
+def test_env_value_from_renders():
+    """k8s-legal valueFrom env entries (no value key) must render."""
+    objs = _render_all(
+        {"devicePlugin": {"env": [
+            {"name": "NODE_IP", "valueFrom": {"fieldRef": {"fieldPath": "status.hostIP"}}},
+        ]}}
+    )["state-device-plugin"]
+    ds = next(o for o in objs if o["kind"] == "DaemonSet")
+    env = deep_get(ds, "spec", "template", "spec", "containers", 0, "env")
+    node_ip = next(e for e in env if e["name"] == "NODE_IP")
+    assert node_ip["valueFrom"]["fieldRef"]["fieldPath"] == "status.hostIP"
+
+
+def test_newline_in_env_value_quoted():
+    objs = _render_all(
+        {"libtpu": {"env": [{"name": "MULTI", "value": "a\nb"}]}}
+    )["state-libtpu"]
+    ds = next(o for o in objs if o["kind"] == "DaemonSet")
+    env = deep_get(ds, "spec", "template", "spec", "containers", 0, "env")
+    assert next(e for e in env if e["name"] == "MULTI")["value"] == "a\nb"
+
+
+def test_missing_variable_is_error(tmp_path):
+    (tmp_path / "x").mkdir()
+    (tmp_path / "x" / "0100_cm.yaml").write_text(
+        "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: {{ nope }}\n"
+    )
+    with pytest.raises(RenderError, match="missing template variable"):
+        Renderer(str(tmp_path)).render_dir("x", {})
+
+
+def test_rendered_non_object_is_error(tmp_path):
+    (tmp_path / "x").mkdir()
+    (tmp_path / "x" / "0100_junk.yaml").write_text("just a string\n")
+    with pytest.raises(RenderError, match="not a k8s object"):
+        Renderer(str(tmp_path)).render_dir("x", {})

@@ -1,0 +1,114 @@
+"""Manifest template renderer.
+
+Reference analogue: ``internal/render/render.go`` — text/template + sprig with
+``missingkey=error``, multi-doc YAML split, decode to unstructured.  Here the
+template language is Jinja2 with StrictUndefined (the missingkey=error
+equivalent) plus the helpers the reference gets from sprig/custom funcs:
+``toYaml`` (render.go's "yaml" func), ``indent``/``nindent``, ``default``,
+``quote``, ``b64enc``.
+
+Templates live one directory per operand state (assets/<state>/NNNN_kind.yaml),
+rendered in sorted filename order so apply order is deterministic
+(resource_manager.go:92 sorts the same way).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import Any, Optional
+
+import jinja2
+import yaml
+
+from tpu_operator.utils import files_with_suffix
+
+
+def _to_yaml(value: Any, indent: int = 0) -> str:
+    dumped = yaml.safe_dump(value, default_flow_style=False, sort_keys=False).rstrip("\n")
+    if indent:
+        pad = " " * indent
+        dumped = "\n".join(pad + line if line else line for line in dumped.splitlines())
+    return dumped
+
+
+def _quote(value: Any) -> str:
+    # JSON string quoting is valid YAML and escapes newlines/control chars,
+    # matching sprig's quote semantics.
+    import json
+
+    return json.dumps(str(value))
+
+
+def _b64enc(value: str) -> str:
+    return base64.b64encode(value.encode()).decode()
+
+
+class RenderError(Exception):
+    pass
+
+
+class Renderer:
+    """Renders one template directory into unstructured objects."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.env = jinja2.Environment(
+            loader=jinja2.FileSystemLoader(root),
+            undefined=jinja2.StrictUndefined,
+            trim_blocks=True,
+            lstrip_blocks=True,
+            keep_trailing_newline=True,
+        )
+        self.env.filters["toYaml"] = _to_yaml
+        self.env.filters["quote"] = _quote
+        self.env.filters["b64enc"] = _b64enc
+
+    def render_file(self, relpath: str, data: dict) -> list[dict]:
+        try:
+            text = self.env.get_template(relpath.replace(os.sep, "/")).render(**data)
+        except jinja2.UndefinedError as e:
+            raise RenderError(f"{relpath}: missing template variable: {e}") from e
+        except jinja2.TemplateError as e:
+            raise RenderError(f"{relpath}: {e}") from e
+        objs: list[dict] = []
+        try:
+            for doc in yaml.safe_load_all(text):
+                if not doc:
+                    continue
+                if not isinstance(doc, dict) or "kind" not in doc:
+                    raise RenderError(f"{relpath}: rendered doc is not a k8s object")
+                objs.append(doc)
+        except yaml.YAMLError as e:
+            raise RenderError(f"{relpath}: rendered invalid YAML: {e}") from e
+        return objs
+
+    def render_dir(self, subdir: str, data: dict) -> list[dict]:
+        """Render every template in assets/<subdir>/ in sorted order."""
+        dir_path = os.path.join(self.root, subdir)
+        if not os.path.isdir(dir_path):
+            raise RenderError(f"no such asset dir: {dir_path}")
+        out: list[dict] = []
+        for path in files_with_suffix(dir_path, ".yaml", ".yml"):
+            rel = os.path.relpath(path, self.root)
+            out.extend(self.render_file(rel, data))
+        return out
+
+
+_DEFAULT_ASSETS = os.path.join(os.path.dirname(__file__), "..", "assets")
+
+
+def default_assets_dir() -> str:
+    """Asset root: $OPERATOR_ASSETS override, else the in-repo assets/ tree
+    (baked into the operator image at /opt/tpu-operator, Dockerfile pattern
+    of docker/Dockerfile:84-86)."""
+    from tpu_operator import consts
+
+    env = os.environ.get(consts.ASSETS_DIR_ENV)
+    if env:
+        return env
+    return os.path.normpath(_DEFAULT_ASSETS)
+
+
+def new_renderer(root: Optional[str] = None) -> Renderer:
+    return Renderer(root or default_assets_dir())

@@ -1,0 +1,10 @@
+"""Declarative state engine.
+
+Reference analogue: ``internal/state/`` — a Manager running an ordered list of
+State implementations, each rendering templated manifests and applying them
+with ownerRef + state label + hash-skip, then gating on readiness
+(state.go:34-39, state_skel.go:223-444, manager.go:31-108).
+"""
+
+from tpu_operator.state.skel import OperandState, SyncState  # noqa: F401
+from tpu_operator.state.manager import StateManager, ClusterContext  # noqa: F401

@@ -1,0 +1,75 @@
+"""Ordered state walk.
+
+Reference analogue: ClusterPolicyController.init()/step()/last()
+(controllers/state_manager.go:754-990) merged with internal/state/manager.go's
+SyncState/Results aggregation — one engine, no legacy/declarative split.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.render import Renderer, new_renderer
+from tpu_operator.state.render_data import STATE_DEFS, ClusterContext
+from tpu_operator.state.skel import OperandState, StateResult, SyncState
+
+log = logging.getLogger("tpu_operator.state")
+
+
+@dataclass
+class SyncResults:
+    results: list[StateResult] = field(default_factory=list)
+
+    @property
+    def ready(self) -> bool:
+        return all(r.state in (SyncState.READY, SyncState.DISABLED, SyncState.IGNORE) for r in self.results)
+
+    @property
+    def not_ready_states(self) -> list[StateResult]:
+        return [r for r in self.results if r.state == SyncState.NOT_READY]
+
+    @property
+    def error_states(self) -> list[StateResult]:
+        return [r for r in self.results if r.state == SyncState.ERROR]
+
+    def message(self) -> str:
+        parts = [f"{r.name}: {r.message or r.state}" for r in self.results if r.state in (SyncState.NOT_READY, SyncState.ERROR)]
+        return "; ".join(parts)
+
+
+class StateManager:
+    """Walks every state in order each reconcile pass, aggregating results.
+
+    Unlike the reference's idx-cursor step() (state_manager.go:945-983) the
+    whole chain runs per pass — states are independent DaemonSets whose
+    init-container gating enforces the node-level ordering, so applying all
+    manifests up front converges faster than one-state-per-requeue while the
+    per-node file gates (validations dir) preserve correctness.
+    """
+
+    def __init__(self, renderer: Optional[Renderer] = None, skip_states: Optional[set[str]] = None):
+        self.renderer = renderer or new_renderer()
+        self.states = [OperandState(sdef, self.renderer) for sdef in STATE_DEFS]
+        # NVIDIADriver-CRD bypass analogue (state_manager.go:955-965): when
+        # TPURuntime CRs manage the runtime, the controller skips state-libtpu.
+        self.skip_states = skip_states or set()
+
+    async def sync(
+        self, client: ApiClient, ctx: ClusterContext, policy: TPUClusterPolicy
+    ) -> SyncResults:
+        out = SyncResults()
+        for state in self.states:
+            if state.name in self.skip_states:
+                out.results.append(StateResult(state.name, SyncState.IGNORE, "managed elsewhere"))
+                continue
+            try:
+                result = await state.sync(client, ctx, policy)
+            except Exception as e:  # noqa: BLE001
+                log.exception("state %s sync failed", state.name)
+                result = StateResult(state.name, SyncState.ERROR, str(e))
+            out.results.append(result)
+        return out

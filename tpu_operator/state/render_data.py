@@ -1,0 +1,203 @@
+"""Per-state template render data.
+
+Reference analogue: the Transform* functions of controllers/object_controls.go
+(:757-2110) plus stateDriver's driverRenderData (internal/state/driver.go:84-93).
+Unlike the reference's dual static-YAML+transform path, all per-spec variation
+flows through ONE rendering pass: this module maps (cluster context, CR spec)
+to the context consumed by assets/<state>/*.yaml templates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import OperandSpec, TPUClusterPolicySpec
+
+
+@dataclass
+class ClusterContext:
+    """Environment facts gathered once per reconcile (init() analogue,
+    controllers/state_manager.go:754-889)."""
+
+    namespace: str
+    k8s_version: str = ""
+    runtime: str = "containerd"
+    service_monitors_available: bool = False
+    tpu_node_count: int = 0
+    openshift: bool = False
+
+
+# Default toleration: GKE TPU node pools carry the google.com/tpu taint.
+_DEFAULT_TOLERATIONS = [
+    {"key": consts.TPU_RESOURCE, "operator": "Exists", "effect": "NoSchedule"},
+    {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"},
+]
+
+
+def _operand_block(spec: OperandSpec, component: str) -> dict:
+    try:
+        image = spec.image_path(component)
+    except ValueError:
+        # dev fallback so a bare CR works without the env ConfigMap the
+        # production Deployment injects (config/manager/manager.yaml:67-69
+        # pattern); production pins exact images via CR or env.
+        from tpu_operator.version import __version__
+
+        image = f"ghcr.io/tpu-operator/tpu-{component}:{__version__}"
+    return {
+        "name": component,
+        "image": image,
+        "pull_policy": spec.image_pull_policy,
+        "args": list(spec.args),
+        "env": list(spec.env),
+        "resources": spec.resources,
+    }
+
+
+def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    """Context keys every template/macro may rely on."""
+    ds = spec.daemonsets
+    tolerations = list(_DEFAULT_TOLERATIONS) + list(ds.tolerations)
+    return {
+        "namespace": ctx.namespace,
+        "runtime_class": spec.operator.runtime_class,
+        "default_runtime_handler": spec.operator.default_runtime,
+        "priority_class": ds.priority_class_name,
+        "tolerations": tolerations,
+        "ds_labels": dict(ds.labels),
+        "ds_annotations": dict(ds.annotations),
+        "update_strategy": ds.update_strategy,
+        "rolling_update": ds.rolling_update,
+        "image_pull_secrets": list(spec.validator.image_pull_secrets),
+        "deploy_label_prefix": consts.DEPLOY_LABEL_PREFIX,
+        "validation_dir": consts.VALIDATION_DIR,
+        "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
+        "service_monitors_available": ctx.service_monitors_available,
+        "validator": {
+            "image": _operand_block(spec.validator, "validator")["image"],
+            "pull_policy": spec.validator.image_pull_policy,
+            "plugin_env": list(spec.validator.plugin.env),
+            "jax_env": list(spec.validator.jax.env),
+        },
+        "slice_strategy": spec.slice_manager.strategy,
+    }
+
+
+@dataclass
+class StateDef:
+    """One operand state: asset dir + how to build its render data."""
+
+    name: str
+    operand: Optional[Callable[[TPUClusterPolicySpec], OperandSpec]] = None
+    component: str = ""
+    extras: Callable[[ClusterContext, TPUClusterPolicySpec], dict] = field(
+        default=lambda ctx, spec: {}
+    )
+    # DS states are skipped (Ready) when the cluster has no TPU nodes
+    # (object_controls.go:4046-4053); cluster-scope states always apply.
+    requires_tpu_nodes: bool = True
+
+    def render_data(self, ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+        data = base_render_data(ctx, spec)
+        if self.operand is not None:
+            data["operand"] = _operand_block(self.operand(spec), self.component)
+        data.update(self.extras(ctx, spec))
+        return data
+
+
+def _libtpu_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    up = spec.libtpu.upgrade_policy
+    return {
+        "libtpu": {
+            "libtpu_version": spec.libtpu.libtpu_version,
+            "runtime_channel": spec.libtpu.runtime_channel,
+            "drain_force": str(up.drain.force).lower(),
+            "drain_timeout_seconds": up.drain.timeout_seconds,
+        }
+    }
+
+
+def _runtime_prep_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    return {
+        "runtime_prep": {
+            "device_permissions": spec.runtime_prep.device_permissions,
+            "hugepages_gb": spec.runtime_prep.hugepages_gb,
+        }
+    }
+
+
+def _device_plugin_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    cfg = spec.device_plugin.config
+    return {
+        "device_plugin": {
+            "config_map": cfg.name,
+            "default_config": cfg.default or "default",
+        }
+    }
+
+
+def _metrics_agent_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    return {"metrics_agent": {"host_port": spec.metrics_agent.host_port}}
+
+
+def _metrics_exporter_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    me = spec.metrics_exporter
+    return {
+        "metrics_agent": {"host_port": spec.metrics_agent.host_port},
+        "metrics_exporter": {
+            "port": me.port,
+            "config_map": me.metrics_config,
+            "config_file": "/etc/tpu-metrics-exporter/counters.csv" if me.metrics_config else None,
+            "service_monitor": me.service_monitor.enabled,
+            "service_monitor_interval": me.service_monitor.interval,
+            "service_monitor_honor_labels": me.service_monitor.honor_labels,
+            "service_monitor_labels": me.service_monitor.additional_labels,
+            "service_monitor_relabelings": me.service_monitor.relabelings,
+        },
+    }
+
+
+def _feature_discovery_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    return {"feature_discovery": {"sleep_interval": spec.feature_discovery.sleep_interval}}
+
+
+def _slice_manager_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    cfg = spec.slice_manager.config
+    return {
+        "slice_manager": {
+            "config_map": cfg.name or "default-tpu-slice-config",
+            "default_config": cfg.default or "all-disabled",
+            "render_default_config": not cfg.name,
+        }
+    }
+
+
+# Ordered registry (addState ×N analogue, controllers/state_manager.go:795-813).
+STATE_DEFS: list[StateDef] = [
+    StateDef("pre-requisites", requires_tpu_nodes=False),
+    StateDef("state-operator-metrics", requires_tpu_nodes=False),
+    StateDef("state-libtpu", lambda s: s.libtpu, "libtpu", _libtpu_extras),
+    StateDef("state-runtime-prep", lambda s: s.runtime_prep, "runtime-prep", _runtime_prep_extras),
+    StateDef("state-operator-validation", lambda s: s.validator, "validator"),
+    StateDef("state-device-plugin", lambda s: s.device_plugin, "device-plugin", _device_plugin_extras),
+    StateDef("state-metrics-agent", lambda s: s.metrics_agent, "metrics-agent", _metrics_agent_extras),
+    StateDef("state-metrics-exporter", lambda s: s.metrics_exporter, "metrics-exporter", _metrics_exporter_extras),
+    StateDef("tpu-feature-discovery", lambda s: s.feature_discovery, "feature-discovery", _feature_discovery_extras),
+    StateDef("state-slice-manager", lambda s: s.slice_manager, "slice-manager", _slice_manager_extras),
+    StateDef("state-node-status-exporter", lambda s: s.node_status_exporter, "node-status-exporter"),
+    StateDef("state-sandbox-validation", lambda s: s.validator, "validator"),
+    StateDef("state-vfio-manager", lambda s: s.vfio_manager, "vfio-manager"),
+    StateDef("state-sandbox-device-plugin", lambda s: s.sandbox_device_plugin, "sandbox-device-plugin"),
+]
+
+if tuple(d.name for d in STATE_DEFS) != consts.STATE_NAMES:
+    raise RuntimeError("STATE_DEFS registry out of sync with consts.STATE_NAMES")
+
+
+def state_def(name: str) -> StateDef:
+    for d in STATE_DEFS:
+        if d.name == name:
+            return d
+    raise KeyError(name)

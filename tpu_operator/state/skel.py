@@ -1,0 +1,169 @@
+"""State skeleton: render → apply → readiness, plus delete-on-disable.
+
+Reference analogue: ``internal/state/state_skel.go`` — createOrUpdateObjs
+(:223-285), addStateSpecificLabels (:287-294), getSupportedGVKs whitelist
+(:62-165), getSyncState/isDaemonSetReady (:383-444) — and the legacy engine's
+disabled-state deletion pattern (controllers/object_controls.go:267-274).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field as dc_field
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.k8s.apply import create_or_update, delete_if_exists
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.render import Renderer
+from tpu_operator.state.render_data import ClusterContext, StateDef
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.state")
+
+
+class SyncState:
+    """internal/state/state.go:34-39 SyncState values."""
+
+    READY = "ready"
+    NOT_READY = "notReady"
+    DISABLED = "disabled"
+    IGNORE = "ignore"
+    ERROR = "error"
+
+
+# Kinds a state may own and that delete-on-disable sweeps, in deletion order
+# (getSupportedGVKs analogue, state_skel.go:62-165).
+SUPPORTED_GVKS: tuple[tuple[str, str], ...] = (
+    ("apps", "DaemonSet"),
+    ("apps", "Deployment"),
+    ("monitoring.coreos.com", "ServiceMonitor"),
+    ("monitoring.coreos.com", "PrometheusRule"),
+    ("", "Service"),
+    ("", "ConfigMap"),
+    ("rbac.authorization.k8s.io", "RoleBinding"),
+    ("rbac.authorization.k8s.io", "Role"),
+    ("rbac.authorization.k8s.io", "ClusterRoleBinding"),
+    ("rbac.authorization.k8s.io", "ClusterRole"),
+    ("", "ServiceAccount"),
+    ("node.k8s.io", "RuntimeClass"),
+)
+
+
+def daemonset_ready(ds: dict) -> bool:
+    """Desired != 0 and Desired == Available == Updated
+    (state_skel.go:439-441; OnDelete revision matching is approximated by
+    updatedNumberScheduled, which our fake kubelet maintains)."""
+    status = ds.get("status") or {}
+    desired = status.get("desiredNumberScheduled", 0)
+    return (
+        desired != 0
+        and desired == status.get("numberAvailable", 0)
+        and desired == status.get("updatedNumberScheduled", 0)
+    )
+
+
+def deployment_ready(dep: dict) -> bool:
+    replicas = deep_get(dep, "spec", "replicas", default=1)
+    status = dep.get("status") or {}
+    return status.get("availableReplicas", 0) >= replicas
+
+
+@dataclass
+class StateResult:
+    name: str
+    state: str
+    message: str = ""
+    applied: int = 0
+
+
+@dataclass
+class OperandState:
+    """One reconcile-chain state driven by a StateDef."""
+
+    sdef: StateDef
+    renderer: Renderer
+    # deletion sweep runs once per enabled→disabled transition, not every
+    # pass (the reference deletes in the disabled branch of each controlFunc
+    # but its objects are tracked; we track via this flag)
+    _cleaned: bool = dc_field(default=False, compare=False)
+
+    @property
+    def name(self) -> str:
+        return self.sdef.name
+
+    async def sync(
+        self,
+        client: ApiClient,
+        ctx: ClusterContext,
+        policy: TPUClusterPolicy,
+    ) -> StateResult:
+        spec = policy.spec
+        if not spec.state_enabled(self.name):
+            if self._cleaned:
+                return StateResult(self.name, SyncState.DISABLED, "state disabled")
+            deleted = await self.delete_objects(client, ctx.namespace)
+            self._cleaned = True
+            return StateResult(
+                self.name, SyncState.DISABLED, f"state disabled; removed {deleted} objects"
+            )
+        self._cleaned = False
+        if self.sdef.requires_tpu_nodes and ctx.tpu_node_count == 0:
+            # no TPU nodes → nothing to schedule; state is vacuously ready
+            # (object_controls.go:4046-4053)
+            return StateResult(self.name, SyncState.READY, "no TPU nodes; state skipped")
+
+        data = self.sdef.render_data(ctx, spec)
+        objs = self.renderer.render_dir(self.name, data)
+        applied = 0
+        live_objs: list[dict] = []
+        for obj in objs:
+            live, changed = await create_or_update(
+                client, obj, owner=policy.obj, state_label=self.name
+            )
+            live_objs.append(live)
+            applied += int(changed)
+
+        ready, message = self._readiness(live_objs)
+        return StateResult(
+            self.name,
+            SyncState.READY if ready else SyncState.NOT_READY,
+            message,
+            applied,
+        )
+
+    def _readiness(self, live_objs: list[dict]) -> tuple[bool, str]:
+        for obj in live_objs:
+            kind = obj.get("kind")
+            name = deep_get(obj, "metadata", "name", default="?")
+            if kind == "DaemonSet" and not daemonset_ready(obj):
+                return False, f"DaemonSet {name} not ready"
+            if kind == "Deployment" and not deployment_ready(obj):
+                return False, f"Deployment {name} not ready"
+        return True, ""
+
+    async def delete_objects(self, client: ApiClient, namespace: str) -> int:
+        """Remove everything this state ever applied, matched by state label.
+
+        Namespaced kinds are swept in the operator namespace; cluster-scoped
+        kinds cluster-wide.  A kind whose API is absent (e.g. ServiceMonitor
+        without prometheus-operator) is skipped; real failures propagate so
+        the state reports ERROR instead of lying about cleanup.
+        """
+        from tpu_operator.k8s import objects as obj_api
+        from tpu_operator.k8s.client import ApiError
+
+        deleted = 0
+        selector = f"{consts.STATE_LABEL}={self.name}"
+        for group, kind in SUPPORTED_GVKS:
+            ns = namespace if obj_api.lookup(group, kind).namespaced else None
+            try:
+                items = await client.list_items(group, kind, ns, selector)
+            except ApiError as e:
+                if e.status in (404, 405):  # API/kind not served in this cluster
+                    continue
+                raise
+            for item in items:
+                await delete_if_exists(client, item)
+                deleted += 1
+        return deleted
