@@ -34,6 +34,16 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+@pytest.fixture(autouse=True)
+def _isolate_operator_env(monkeypatch):
+    """Ambient *_IMAGE / OPERATOR_ASSETS vars must not leak into tests
+    (image resolution consults them before the dev fallback)."""
+    from tpu_operator import consts
+
+    for var in [*consts.IMAGE_ENVS.values(), consts.ASSETS_DIR_ENV]:
+        monkeypatch.delenv(var, raising=False)
+
+
 @pytest.fixture
 def validation_root(tmp_path, monkeypatch):
     """Relocate /run/tpu/validations into a tmpdir (UNIT_TEST seam)."""

@@ -1,0 +1,206 @@
+"""ClusterPolicy reconciler end-to-end tests on the fake apiserver.
+
+Covers BASELINE.json config 1 (reconcile on a CPU-only cluster → Ready) and
+the north-star flow: TPU node join → labels → operand DaemonSets → device
+plugin advertises google.com/tpu → policy Ready.  Reference test analogue:
+controllers/object_controls_test.go's fake-cluster setup plus the e2e
+operand-ready assertions of tests/e2e/gpu_operator_test.go:88-121.
+"""
+
+import asyncio
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import GROUP, CLUSTER_POLICY_KIND, State, TPUClusterPolicy
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+from tpu_operator.controllers.runtime import Manager
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+async def _converge(reconciler, name="cluster-policy", passes=30, settle=0.08):
+    """Drive reconcile directly (no manager) until Ready or pass budget."""
+    requeue = None
+    for _ in range(passes):
+        requeue = await reconciler.reconcile(name)
+        obj = await reconciler.client.get(GROUP, CLUSTER_POLICY_KIND, name)
+        if deep_get(obj, "status", "state") == State.READY:
+            return obj, requeue
+        await asyncio.sleep(settle)
+    return obj, requeue
+
+
+async def test_cpu_only_cluster_goes_ready():
+    """Config 1: no TPU nodes → all DS states vacuously ready, status Ready,
+    45s node poll requeue."""
+    async with FakeCluster() as fc:
+        fc.add_node("cpu-node-0", tpu=False)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            obj, requeue = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+            assert requeue == consts.REQUEUE_NO_TPU_NODES_SECONDS
+            conds = {c["type"]: c["status"] for c in obj["status"]["conditions"]}
+            assert conds == {"Ready": "True", "Error": "False"}
+            # cluster-scoped states still applied (RuntimeClass, metrics Service)
+            assert await client.get("node.k8s.io", "RuntimeClass", "tpu")
+            assert await client.get("", "Service", "tpu-operator-metrics", NS)
+            # but no DaemonSets created
+            assert await client.list_items("apps", "DaemonSet", NS) == []
+
+
+async def test_tpu_node_join_to_ready():
+    """North star: node join → labels → DS chain → google.com/tpu capacity."""
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await reconciler.reconcile("cluster-policy")
+
+            fc.add_node("tpu-node-0", accelerator="tpu-v5-lite-podslice", topology="2x4", chips=4)
+            fc.add_node("cpu-node-0", tpu=False)
+
+            obj, _ = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+
+            node = await client.get("", "Node", "tpu-node-0")
+            labels = node["metadata"]["labels"]
+            assert labels[consts.TPU_PRESENT_LABEL] == "true"
+            assert labels[consts.TPU_COUNT_LABEL] == "4"
+            assert labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+            assert labels[consts.DEPLOY_LABEL_PREFIX + "operator-validator"] == "true"
+            # vm chain not labelled (sandbox disabled)
+            assert consts.DEPLOY_LABEL_PREFIX + "vfio-manager" not in labels
+            # kubelet sim registered the plugin → extended resource advertised
+            assert node["status"]["allocatable"][consts.TPU_RESOURCE] == "4"
+
+            cpu_node = await client.get("", "Node", "cpu-node-0")
+            assert consts.TPU_PRESENT_LABEL not in cpu_node["metadata"]["labels"]
+
+            ds_names = {
+                d["metadata"]["name"] for d in await client.list_items("apps", "DaemonSet", NS)
+            }
+            assert "tpu-runtime-daemonset" in ds_names
+            assert "tpu-device-plugin-daemonset" in ds_names
+            assert "tpu-operator-validator" in ds_names
+            # disabled-by-default operands absent
+            assert "tpu-metrics-agent" not in ds_names
+
+            # owner references set for GC
+            ds = await client.get("apps", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+            refs = ds["metadata"]["ownerReferences"]
+            assert refs and refs[0]["kind"] == CLUSTER_POLICY_KIND
+
+
+async def test_singleton_guard():
+    async with FakeCluster() as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new("first").obj)
+            await asyncio.sleep(0)  # distinct creationTimestamp not guaranteed; name breaks tie
+            await client.create(TPUClusterPolicy.new("second").obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await reconciler.reconcile("second")
+            second = await client.get(GROUP, CLUSTER_POLICY_KIND, "second")
+            assert deep_get(second, "status", "state") == State.IGNORED
+            await _converge(reconciler, "first")
+            first = await client.get(GROUP, CLUSTER_POLICY_KIND, "first")
+            assert deep_get(first, "status", "state") == State.READY
+
+
+async def test_disable_operand_deletes_objects():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            assert await client.get("apps", "DaemonSet", "tpu-feature-discovery", NS)
+
+            # disable feature discovery → objects swept
+            cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            cr["spec"]["featureDiscovery"] = {"enabled": False}
+            await client.update(cr)
+            obj, _ = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+            names = {d["metadata"]["name"] for d in await client.list_items("apps", "DaemonSet", NS)}
+            assert "tpu-feature-discovery" not in names
+            # its RBAC went too
+            crs = {
+                c["metadata"]["name"]
+                for c in await client.list_items("rbac.authorization.k8s.io", "ClusterRole")
+            }
+            assert "tpu-feature-discovery" not in crs
+
+
+async def test_conditional_objects_pruned_on_spec_change():
+    """Objects that drop out of the rendered set while the state stays
+    enabled must be pruned (e.g. device-plugin RBAC after config removal)."""
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(
+                TPUClusterPolicy.new(
+                    spec={"devicePlugin": {"config": {"name": "cm", "default": "d"}}}
+                ).obj
+            )
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            assert await client.get("rbac.authorization.k8s.io", "Role", "tpu-device-plugin", NS)
+
+            cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            cr["spec"]["devicePlugin"] = {}
+            await client.update(cr)
+            await _converge(reconciler)
+            roles = await client.list_items("rbac.authorization.k8s.io", "Role", NS)
+            assert all(r["metadata"]["name"] != "tpu-device-plugin" for r in roles)
+            # config-manager sidecar gone from the DS too
+            ds = await client.get("apps", "DaemonSet", "tpu-device-plugin-daemonset", NS)
+            names = [c["name"] for c in deep_get(ds, "spec", "template", "spec", "containers")]
+            assert names == ["tpu-device-plugin"]
+
+
+async def test_manager_watch_driven_convergence():
+    """Full manager: watches drive reconciles without manual stepping; health
+    and metrics endpoints serve."""
+    import aiohttp
+
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            from tpu_operator.metrics import OperatorMetrics
+
+            metrics = OperatorMetrics()
+            mgr = Manager(client, NS, metrics_port=0, health_port=0,
+                          metrics_registry=metrics.registry)
+            reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics)
+            reconciler.setup(mgr)
+            async with mgr:
+                await client.create(TPUClusterPolicy.new().obj)
+                fc.add_node("tpu-node-0")
+                for _ in range(200):
+                    try:
+                        obj = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                        node = await client.get("", "Node", "tpu-node-0")
+                        if (
+                            deep_get(obj, "status", "state") == State.READY
+                            and consts.TPU_RESOURCE in node["status"]["allocatable"]
+                        ):
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    await asyncio.sleep(0.05)
+                else:
+                    pytest.fail("manager did not converge")
+
+                # probes + metrics
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"http://127.0.0.1:{mgr.health_port}/readyz") as r:
+                        assert r.status == 200
+                    async with http.get(f"http://127.0.0.1:{mgr.metrics_port}/metrics") as r:
+                        body = await r.text()
+                        assert "tpu_operator_reconciliation_total" in body
+                        assert "tpu_operator_tpu_nodes_total 1.0" in body

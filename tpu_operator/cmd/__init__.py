@@ -1,0 +1,1 @@
+"""Entry points (cmd/gpu-operator + payload binaries analogue)."""

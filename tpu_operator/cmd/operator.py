@@ -1,0 +1,97 @@
+"""tpu-operator manager binary.
+
+Reference analogue: cmd/gpu-operator/main.go:66-190 — flag surface
+(--metrics-bind-address, --health-probe-bind-address, --leader-elect,
+--leader-lease-renew-deadline), manager construction, reconciler
+registration, signal handling.
+
+Run: ``python -m tpu_operator.cmd.operator`` (in-cluster), or with
+``KUBERNETES_API_URL`` pointing at any API server (tests/dev).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from tpu_operator import consts
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+from tpu_operator.controllers.runtime import Manager
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.version import __version__
+
+
+def _port(addr: str) -> int:
+    """':8080' or 'host:8080' → 8080; '0' disables (Manager: negative=off)."""
+    if addr in ("0", ""):
+        return -1
+    return int(addr.rsplit(":", 1)[-1])
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("tpu-operator")
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true", default=False)
+    p.add_argument("--leader-lease-renew-deadline", default="10s")
+    p.add_argument("--zap-log-level", default="info")
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    logging.basicConfig(
+        level=getattr(logging, args.zap_log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("tpu_operator")
+    log.info("tpu-operator %s starting", __version__)
+
+    namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "tpu-operator")
+    client = ApiClient(Config.from_env())
+    metrics = OperatorMetrics()
+    mgr = Manager(
+        client,
+        namespace,
+        metrics_port=_port(args.metrics_bind_address),
+        health_port=_port(args.health_probe_bind_address),
+        leader_elect=args.leader_elect,
+        metrics_registry=metrics.registry,
+    )
+    reconciler = ClusterPolicyReconciler(client, namespace, metrics=metrics)
+    reconciler.setup(mgr)
+    try:
+        from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
+
+        TPURuntimeReconciler(client, namespace, metrics=metrics).setup(mgr)
+    except ImportError:
+        pass
+    try:
+        from tpu_operator.controllers.upgrade import UpgradeReconciler
+
+        UpgradeReconciler(client, namespace, metrics=metrics).setup(mgr)
+    except ImportError:
+        pass
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+
+    async with mgr:
+        await stop.wait()
+    await client.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

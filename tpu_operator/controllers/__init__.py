@@ -1,0 +1,9 @@
+"""Controllers: reconcilers + the manager runtime that hosts them.
+
+Reference analogue: ``controllers/`` (ClusterPolicyReconciler, UpgradeReconciler,
+NVIDIADriverReconciler) on top of controller-runtime's manager/workqueue,
+which tpu_operator.controllers.runtime reimplements natively (async).
+"""
+
+from tpu_operator.controllers.runtime import Controller, Manager  # noqa: F401
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler  # noqa: F401

@@ -1,0 +1,72 @@
+"""Cluster introspection.
+
+Reference analogue: controllers/clusterinfo/clusterinfo.go:42-144 (cached or
+live k8s/OpenShift version, runtime) and the init()-time environment sniffing
+of controllers/state_manager.go:754-889.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.state.render_data import ClusterContext
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.clusterinfo")
+
+
+def is_tpu_node(node: dict) -> bool:
+    """GKE TPU node pools carry the accelerator label out of the box
+    (the reference's NFD-PCI-label detection, state_manager.go:117-121)."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    return (
+        consts.GKE_TPU_ACCELERATOR_LABEL in labels
+        or labels.get(consts.TPU_PRESENT_LABEL) == "true"
+    )
+
+
+def runtime_of(node: dict) -> str:
+    """containerd://1.7.0 → containerd (getRuntimeString analogue,
+    state_manager.go:584-599)."""
+    version = deep_get(node, "status", "nodeInfo", "containerRuntimeVersion", default="")
+    return version.split("://", 1)[0] if "://" in version else ""
+
+
+async def gather(client: ApiClient, namespace: str, nodes: Optional[list[dict]] = None) -> ClusterContext:
+    if nodes is None:
+        nodes = await client.list_items("", "Node")
+    tpu_nodes = [n for n in nodes if is_tpu_node(n)]
+    runtime = "containerd"
+    for node in tpu_nodes or nodes:
+        r = runtime_of(node)
+        if r:
+            runtime = r
+            break
+
+    k8s_version = ""
+    try:
+        info = await client._request("GET", "/version")
+        if isinstance(info, dict):
+            k8s_version = info.get("gitVersion", "")
+    except (ApiError, OSError):
+        pass
+
+    service_monitors = True
+    try:
+        await client.list("monitoring.coreos.com", "ServiceMonitor", namespace)
+    except ApiError as e:
+        if e.status in (404, 405):
+            service_monitors = False
+    except OSError:
+        service_monitors = False
+
+    return ClusterContext(
+        namespace=namespace,
+        k8s_version=k8s_version,
+        runtime=runtime,
+        service_monitors_available=service_monitors,
+        tpu_node_count=len(tpu_nodes),
+    )

@@ -1,0 +1,172 @@
+"""TPUClusterPolicy reconciler.
+
+Reference analogue: controllers/clusterpolicy_controller.go —
+Reconcile (:94-235) with singleton guard (:121-126), ordered state walk via
+the state engine, status/conditions (:237), requeues (5s NotReady :165,193;
+45s no-TPU-labels poll :199), and the node/DaemonSet watch wiring of
+SetupWithManager (:352-404) + addWatchNewGPUNode predicates (:256-349).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api import conditions
+from tpu_operator.api.types import (
+    CLUSTER_POLICY_KIND,
+    GROUP,
+    State,
+    TPUClusterPolicy,
+)
+from tpu_operator.controllers import clusterinfo, labels
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import (
+    OperatorMetrics,
+    RECONCILE_FAILED,
+    RECONCILE_NOT_READY,
+    RECONCILE_SUCCESS,
+)
+from tpu_operator.render import Renderer
+from tpu_operator.state.manager import StateManager, SyncResults
+from tpu_operator.state.skel import SyncState
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.clusterpolicy")
+
+
+class ClusterPolicyReconciler:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        renderer: Optional[Renderer] = None,
+        metrics: Optional[OperatorMetrics] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.state_manager = StateManager(renderer)
+        self.metrics = metrics or OperatorMetrics()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, name: str) -> Optional[float]:
+        self.metrics.reconciliation_total.inc()
+        try:
+            obj = await self.client.get(GROUP, CLUSTER_POLICY_KIND, name)
+        except ApiError as e:
+            if e.not_found:
+                return None  # deleted; owned objects go via GC
+            raise
+
+        policy = TPUClusterPolicy.from_obj(obj)
+
+        # Singleton guard: oldest CR wins; later ones are Ignored
+        # (clusterpolicy_controller.go:121-126).
+        all_crs = await self.client.list_items(GROUP, CLUSTER_POLICY_KIND)
+        oldest = min(
+            all_crs,
+            key=lambda o: (
+                deep_get(o, "metadata", "creationTimestamp", default=""),
+                deep_get(o, "metadata", "name", default=""),
+            ),
+        )
+        if oldest["metadata"]["name"] != name:
+            await self._update_status(policy, State.IGNORED, "another TPUClusterPolicy is active")
+            return None
+
+        ctx = await clusterinfo.gather(self.client, self.namespace)
+        ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec)
+        self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
+        self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
+
+        skip: set[str] = set()
+        if policy.spec.libtpu.use_tpu_runtime_crd:
+            skip.add("state-libtpu")
+        results = await self.state_manager.sync(self.client, ctx, policy, skip_states=skip)
+
+        for r in results.results:
+            self.metrics.operand_state.labels(state=r.name).set(
+                -1 if r.state == SyncState.ERROR else (0 if r.state == SyncState.NOT_READY else 1)
+            )
+
+        if results.error_states:
+            self.metrics.reconciliation_status.set(RECONCILE_FAILED)
+            self.metrics.reconciliation_failed_total.inc()
+            await self._update_status(policy, State.NOT_READY, results.message())
+            # raising lets the workqueue apply exponential backoff
+            raise RuntimeError(f"state errors: {results.message()}")
+
+        if not results.ready:
+            self.metrics.reconciliation_status.set(RECONCILE_NOT_READY)
+            await self._update_status(policy, State.NOT_READY, results.message())
+            return consts.REQUEUE_NOT_READY_SECONDS
+
+        self.metrics.reconciliation_status.set(RECONCILE_SUCCESS)
+        self.metrics.reconciliation_last_success_ts.set(time.time())
+        await self._update_status(policy, State.READY, "")
+        if ctx.tpu_node_count == 0:
+            # Ready but keep polling for TPU nodes appearing without a watch
+            # event (NFD-missing 45s poll analogue).
+            return consts.REQUEUE_NO_TPU_NODES_SECONDS
+        return None
+
+    async def _update_status(self, policy: TPUClusterPolicy, state: str, message: str) -> None:
+        generation = deep_get(policy.obj, "metadata", "generation")
+        old_status = dict(policy.obj.get("status") or {})
+        policy.set_state(state, self.namespace)
+        if state == State.READY:
+            conditions.set_ready(policy.status, generation=generation)
+        elif state == State.IGNORED:
+            conditions.set_error(
+                policy.status, conditions.REASON_IGNORED,
+                message or "only one TPUClusterPolicy may be active", generation,
+            )
+        else:
+            conditions.set_error(
+                policy.status, conditions.REASON_OPERAND_NOT_READY, message, generation
+            )
+        if policy.obj.get("status") == old_status:
+            return
+        try:
+            await self.client.update_status(policy.obj)
+        except ApiError as e:
+            if not e.conflict:
+                raise
+            # stale CR copy; next reconcile pass re-reads and re-asserts
+
+    # ------------------------------------------------------------------
+    # Watch wiring (SetupWithManager analogue).
+
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(Controller("clusterpolicy", self.reconcile))
+
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+        daemonsets = mgr.informer("apps", "DaemonSet", namespace=self.namespace)
+
+        async def on_policy(event_type: str, obj: dict) -> None:
+            controller.enqueue(obj["metadata"]["name"])
+
+        async def on_node(event_type: str, obj: dict) -> None:
+            # Predicate (addWatchNewGPUNode :256-349): TPU-relevant label
+            # changes, node add with TPU labels, node deletion.
+            relevant = clusterinfo.is_tpu_node(obj) or any(
+                k.startswith("tpu.google.com/") or k.startswith("cloud.google.com/gke-tpu")
+                for k in (deep_get(obj, "metadata", "labels", default={}) or {})
+            )
+            if event_type == "DELETED" or relevant:
+                for p in policies.items():
+                    controller.enqueue(p["metadata"]["name"])
+
+        async def on_daemonset(event_type: str, obj: dict) -> None:
+            for ref in deep_get(obj, "metadata", "ownerReferences", default=[]) or []:
+                if ref.get("kind") == CLUSTER_POLICY_KIND:
+                    controller.enqueue(ref["name"])
+
+        policies.add_handler(on_policy)
+        nodes.add_handler(on_node)
+        daemonsets.add_handler(on_daemonset)
+        return controller

@@ -1,0 +1,116 @@
+"""Node labelling engine.
+
+Reference analogue: labelGPUNodes (controllers/state_manager.go:482-582) plus
+the per-workload-config deploy-label machinery gpuStateLabels /
+updateGPUStateLabels (:90-115, :364-374).  TPU nodes get:
+
+- ``tpu.google.com/tpu.present=true`` and ``tpu.count`` (chips per host)
+- a workload-config label (container | vm-passthrough) defaulted when absent
+  and sandbox workloads are enabled
+- one ``tpu.google.com/tpu.deploy.<operand>=true`` gate per operand matching
+  the node's workload config — every operand DaemonSet nodeSelects on its gate
+
+Non-TPU nodes get all operator-owned labels removed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicySpec
+from tpu_operator.controllers.clusterinfo import is_tpu_node
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.utils import deep_get, parse_topology, topology_chips
+
+log = logging.getLogger("tpu_operator.labels")
+
+# chips per host by GKE accelerator type (TFD refines at runtime via PJRT)
+CHIPS_PER_HOST = {
+    "tpu-v4-podslice": 4,
+    "tpu-v5-lite-podslice": 4,
+    "tpu-v5-lite-device": 8,
+    "tpu-v5p-slice": 4,
+    "tpu-v6e-slice": 4,
+    "tpu-v6e-device": 8,
+}
+DEFAULT_CHIPS_PER_HOST = 4
+
+
+def chips_per_host(node: dict) -> int:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    base = CHIPS_PER_HOST.get(accel, DEFAULT_CHIPS_PER_HOST)
+    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL)
+    if topo:
+        try:
+            # single-host topologies (e.g. 2x2) can hold fewer chips than the
+            # host maximum; multi-host slices never go below the per-host base
+            return min(base, topology_chips(topo)) if len(parse_topology(topo)) <= 2 else base
+        except ValueError:
+            pass
+    return base
+
+
+def workload_config(node: dict, spec: TPUClusterPolicySpec) -> str:
+    """getWorkloadConfig analogue (validator/main.go:416-448 +
+    state_manager.go:90-115): per-node override only honoured when sandbox
+    workloads are enabled cluster-wide."""
+    if not spec.sandbox_workloads.enabled:
+        return consts.WORKLOAD_CONTAINER
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    value = labels.get(consts.TPU_WORKLOAD_CONFIG_LABEL)
+    if value in (consts.WORKLOAD_CONTAINER, consts.WORKLOAD_VM_PASSTHROUGH):
+        return value
+    return spec.sandbox_workloads.default_workload
+
+
+def desired_node_labels(node: dict, spec: TPUClusterPolicySpec) -> dict[str, Optional[str]]:
+    """Labels to upsert (value) or remove (None) on one node."""
+    out: dict[str, Optional[str]] = {}
+    all_deploy_keys = consts.STATE_LABELS_CONTAINER + consts.STATE_LABELS_VM
+    if not is_tpu_node(node):
+        out[consts.TPU_PRESENT_LABEL] = None
+        out[consts.TPU_COUNT_LABEL] = None
+        for key in all_deploy_keys:
+            out[consts.DEPLOY_LABEL_PREFIX + key] = None
+        return out
+
+    out[consts.TPU_PRESENT_LABEL] = "true"
+    out[consts.TPU_COUNT_LABEL] = str(chips_per_host(node))
+    config = workload_config(node, spec)
+    active = (
+        consts.STATE_LABELS_CONTAINER
+        if config == consts.WORKLOAD_CONTAINER
+        else consts.STATE_LABELS_VM
+    )
+    for key in all_deploy_keys:
+        out[consts.DEPLOY_LABEL_PREFIX + key] = "true" if key in active else None
+    return out
+
+
+async def label_tpu_nodes(
+    client: ApiClient, spec: TPUClusterPolicySpec, nodes: Optional[list[dict]] = None
+) -> int:
+    """Apply the label engine to every node; returns the TPU node count."""
+    if nodes is None:
+        nodes = await client.list_items("", "Node")
+    tpu_count = 0
+    for node in nodes:
+        if is_tpu_node(node):
+            tpu_count += 1
+        desired = desired_node_labels(node, spec)
+        current = deep_get(node, "metadata", "labels", default={}) or {}
+        patch_labels = {}
+        for key, value in desired.items():
+            if value is None and key in current:
+                patch_labels[key] = None
+            elif value is not None and current.get(key) != value:
+                patch_labels[key] = value
+        if patch_labels:
+            await client.patch(
+                "", "Node", node["metadata"]["name"], {"metadata": {"labels": patch_labels}}
+            )
+            log.info("labelled node %s: %s", node["metadata"]["name"], patch_labels)
+    return tpu_count

@@ -1,0 +1,232 @@
+"""Controller-runtime analogue: rate-limited workqueues + the manager process.
+
+Reference analogue: sigs.k8s.io/controller-runtime as used by
+cmd/gpu-operator/main.go:66-190 — manager with leader election, metrics
+endpoint (:8080), health probes (:8081), and per-controller workqueues with
+exponential item backoff (clusterpolicy_controller.go:51-52,354 configures
+100ms–3s).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.k8s.leader import LeaderElector
+
+log = logging.getLogger("tpu_operator.controllers")
+
+# reconcile(key) returns the requeue delay in seconds, or None for "done".
+ReconcileFn = Callable[[str], Awaitable[Optional[float]]]
+
+
+class RateLimiter:
+    """Per-key exponential backoff (workqueue.DefaultItemBasedRateLimiter)."""
+
+    def __init__(
+        self,
+        base: float = consts.RATE_LIMIT_BASE_SECONDS,
+        cap: float = consts.RATE_LIMIT_MAX_SECONDS,
+    ):
+        self.base = base
+        self.cap = cap
+        self.failures: dict[str, int] = {}
+
+    def when(self, key: str) -> float:
+        n = self.failures.get(key, 0)
+        self.failures[key] = n + 1
+        return min(self.base * (2**n), self.cap)
+
+    def forget(self, key: str) -> None:
+        self.failures.pop(key, None)
+
+
+class Controller:
+    """One reconcile loop fed by a deduplicating delayed workqueue."""
+
+    def __init__(self, name: str, reconcile: ReconcileFn):
+        self.name = name
+        self.reconcile = reconcile
+        self.limiter = RateLimiter()
+        self._queue: asyncio.Queue[str] = asyncio.Queue()
+        self._pending: set[str] = set()  # dedupe: keys queued but not yet popped
+        self._timers: dict[str, asyncio.TimerHandle] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def enqueue(self, key: str) -> None:
+        if key in self._pending:
+            return
+        self._pending.add(key)
+        self._queue.put_nowait(key)
+
+    def enqueue_after(self, key: str, delay: float) -> None:
+        """Delayed add; an earlier timer for the same key is replaced only if
+        the new one fires sooner (mirrors workqueue.AddAfter semantics
+        closely enough for requeue use)."""
+        if delay <= 0:
+            self.enqueue(key)
+            return
+        loop = asyncio.get_event_loop()
+        existing = self._timers.get(key)
+        if existing is not None:
+            if existing.when() - loop.time() <= delay:
+                return
+            existing.cancel()
+        self._timers[key] = loop.call_later(delay, self._fire, key)
+
+    def _fire(self, key: str) -> None:
+        self._timers.pop(key, None)
+        self.enqueue(key)
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._worker(), name=f"controller-{self.name}")
+
+    async def stop(self) -> None:
+        for t in self._timers.values():
+            t.cancel()
+        self._timers.clear()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _worker(self) -> None:
+        while True:
+            key = await self._queue.get()
+            self._pending.discard(key)
+            try:
+                requeue = await self.reconcile(key)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                delay = self.limiter.when(key)
+                log.exception("[%s] reconcile %s failed; retrying in %.2fs", self.name, key, delay)
+                self.enqueue_after(key, delay)
+                continue
+            self.limiter.forget(key)
+            if requeue is not None:
+                self.enqueue_after(key, requeue)
+
+
+class Manager:
+    """Hosts informers + controllers + the health/metrics HTTP endpoints."""
+
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics_port: int = 8080,
+        health_port: int = 8081,
+        leader_elect: bool = False,
+        metrics_registry=None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics_port = metrics_port
+        self.health_port = health_port
+        self.leader_elect = leader_elect
+        self.metrics_registry = metrics_registry
+        self.informers: dict[str, Informer] = {}
+        self.controllers: list[Controller] = []
+        self.elector: Optional[LeaderElector] = None
+        self._runners: list[web.AppRunner] = []
+        self.started = asyncio.Event()
+        self.start_time = time.time()
+
+    def informer(self, group: str, kind: str, **kw) -> Informer:
+        key = f"{group}/{kind}/{kw.get('namespace') or ''}/{kw.get('label_selector') or ''}"
+        if key not in self.informers:
+            self.informers[key] = Informer(self.client, group, kind, **kw)
+        return self.informers[key]
+
+    def add_controller(self, controller: Controller) -> Controller:
+        self.controllers.append(controller)
+        return controller
+
+    async def start(self) -> None:
+        if self.leader_elect:
+            self.elector = LeaderElector(self.client, self.namespace)
+            await self.elector.start()
+            await self.elector.is_leader.wait()
+        await self._start_http()
+        for informer in self.informers.values():
+            await informer.start()
+        for controller in self.controllers:
+            await controller.start()
+        self.started.set()
+        log.info(
+            "manager started: %d informers, %d controllers, ns=%s",
+            len(self.informers), len(self.controllers), self.namespace,
+        )
+
+    async def stop(self) -> None:
+        for controller in self.controllers:
+            await controller.stop()
+        for informer in self.informers.values():
+            await informer.stop()
+        if self.elector:
+            await self.elector.stop()
+        for runner in self._runners:
+            await runner.cleanup()
+        self._runners.clear()
+
+    async def __aenter__(self) -> "Manager":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
+
+    # ------------------------------------------------------------------
+    async def _start_http(self) -> None:
+        # port semantics: negative → disabled, 0 → ephemeral (tests), else fixed
+        if self.health_port < 0 and self.metrics_port < 0:
+            return
+        health = web.Application()
+        health.router.add_get("/healthz", self._healthz)
+        health.router.add_get("/readyz", self._readyz)
+        metrics = web.Application()
+        metrics.router.add_get("/metrics", self._metrics)
+        # one server per port unless they coincide
+        apps = {}
+        if self.health_port >= 0:
+            apps[id(health)] = (self.health_port, health)
+        if self.metrics_port >= 0:
+            if self.metrics_port == self.health_port and self.health_port > 0:
+                health.router.add_get("/metrics", self._metrics)
+            else:
+                apps[id(metrics)] = (self.metrics_port, metrics)
+        for port, app in apps.values():
+            runner = web.AppRunner(app, shutdown_timeout=1.0)
+            await runner.setup()
+            site = web.TCPSite(runner, "0.0.0.0", port)
+            await site.start()
+            # port 0 → ephemeral; record the bound port for tests
+            bound = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+            if app is health:
+                self.health_port = bound
+            else:
+                self.metrics_port = bound
+            self._runners.append(runner)
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _readyz(self, request: web.Request) -> web.Response:
+        synced = all(i.synced.is_set() for i in self.informers.values())
+        return web.Response(text="ok" if synced else "not ready", status=200 if synced else 503)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        from prometheus_client import REGISTRY, generate_latest
+
+        data = generate_latest(self.metrics_registry if self.metrics_registry is not None else REGISTRY)
+        return web.Response(body=data, content_type="text/plain")

@@ -1,0 +1,67 @@
+"""Operator Prometheus metrics.
+
+Reference analogue: controllers/operator_metrics.go:29-201 — reconciliation
+status/total/failed/last-success gauges+counters, node-count gauge, label
+presence gauge, and the upgrade-state gauge family fed by the upgrade
+controller (gpu_operator_nodes_upgrades_*).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
+# reconciliation_status encodings (operator_metrics.go:52-64)
+RECONCILE_SUCCESS = 1
+RECONCILE_NOT_READY = 0
+RECONCILE_FAILED = -1
+
+
+class OperatorMetrics:
+    """Instance-scoped registry so tests can run many operators per process."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        g = lambda name, doc: Gauge(name, doc, registry=self.registry)  # noqa: E731
+        c = lambda name, doc: Counter(name, doc, registry=self.registry)  # noqa: E731
+        self.tpu_nodes_total = g(
+            "tpu_operator_tpu_nodes_total", "Number of nodes with TPU accelerators"
+        )
+        self.reconciliation_status = g(
+            "tpu_operator_reconciliation_status",
+            "1=success, 0=notReady, -1=failed (last reconcile)",
+        )
+        self.reconciliation_total = c(
+            "tpu_operator_reconciliation_total", "Total reconciliations"
+        )
+        self.reconciliation_failed_total = c(
+            "tpu_operator_reconciliation_failed_total", "Failed reconciliations"
+        )
+        self.reconciliation_last_success_ts = g(
+            "tpu_operator_reconciliation_last_success_ts_seconds",
+            "Unix timestamp of the last successful reconcile",
+        )
+        self.has_gke_tpu_labels = g(
+            "tpu_operator_has_gke_tpu_labels",
+            "1 when at least one node carries GKE TPU labels (has_nfd_labels analogue)",
+        )
+        self.operand_state = Gauge(
+            "tpu_operator_operand_state",
+            "Per-state sync result: 1=ready/disabled, 0=notReady, -1=error",
+            ["state"],
+            registry=self.registry,
+        )
+        # upgrade-state gauge family (operator_metrics.go upgrade gauges)
+        self.upgrades_in_progress = g(
+            "tpu_operator_nodes_upgrades_in_progress", "Nodes currently upgrading"
+        )
+        self.upgrades_done = g("tpu_operator_nodes_upgrades_done", "Nodes upgraded")
+        self.upgrades_failed = g("tpu_operator_nodes_upgrades_failed", "Nodes failed upgrade")
+        self.upgrades_available = g(
+            "tpu_operator_nodes_upgrades_available", "Nodes available for upgrade"
+        )
+        self.upgrades_pending = g("tpu_operator_nodes_upgrades_pending", "Nodes pending upgrade")
+        self.auto_upgrade_enabled = g(
+            "tpu_operator_runtime_auto_upgrade_enabled", "1 when auto-upgrade is on"
+        )

@@ -51,19 +51,22 @@ class StateManager:
     per-node file gates (validations dir) preserve correctness.
     """
 
-    def __init__(self, renderer: Optional[Renderer] = None, skip_states: Optional[set[str]] = None):
+    def __init__(self, renderer: Optional[Renderer] = None):
         self.renderer = renderer or new_renderer()
         self.states = [OperandState(sdef, self.renderer) for sdef in STATE_DEFS]
-        # NVIDIADriver-CRD bypass analogue (state_manager.go:955-965): when
-        # TPURuntime CRs manage the runtime, the controller skips state-libtpu.
-        self.skip_states = skip_states or set()
 
     async def sync(
-        self, client: ApiClient, ctx: ClusterContext, policy: TPUClusterPolicy
+        self,
+        client: ApiClient,
+        ctx: ClusterContext,
+        policy: TPUClusterPolicy,
+        skip_states: Optional[set[str]] = None,
     ) -> SyncResults:
+        # skip_states: TPURuntime-CRD bypass analogue (state_manager.go:955-965)
+        # — when TPURuntime CRs manage the runtime, the caller skips state-libtpu.
         out = SyncResults()
         for state in self.states:
-            if state.name in self.skip_states:
+            if skip_states and state.name in skip_states:
                 out.results.append(StateResult(state.name, SyncState.IGNORE, "managed elsewhere"))
                 continue
             try:

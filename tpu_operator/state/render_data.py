@@ -36,19 +36,22 @@ _DEFAULT_TOLERATIONS = [
 ]
 
 
-def _operand_block(spec: OperandSpec, component: str) -> dict:
+def _operand_image(spec: OperandSpec, component: str) -> str:
     try:
-        image = spec.image_path(component)
+        return spec.image_path(component)
     except ValueError:
         # dev fallback so a bare CR works without the env ConfigMap the
         # production Deployment injects (config/manager/manager.yaml:67-69
         # pattern); production pins exact images via CR or env.
         from tpu_operator.version import __version__
 
-        image = f"ghcr.io/tpu-operator/tpu-{component}:{__version__}"
+        return f"ghcr.io/tpu-operator/tpu-{component}:{__version__}"
+
+
+def _operand_block(spec: OperandSpec, component: str) -> dict:
     return {
         "name": component,
-        "image": image,
+        "image": _operand_image(spec, component),
         "pull_policy": spec.image_pull_policy,
         "args": list(spec.args),
         "env": list(spec.env),
@@ -70,13 +73,15 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         "ds_annotations": dict(ds.annotations),
         "update_strategy": ds.update_strategy,
         "rolling_update": ds.rolling_update,
-        "image_pull_secrets": list(spec.validator.image_pull_secrets),
+        # per-operand imagePullSecrets are stamped by StateDef.render_data;
+        # states without an operand spec run no pods
+        "image_pull_secrets": [],
         "deploy_label_prefix": consts.DEPLOY_LABEL_PREFIX,
         "validation_dir": consts.VALIDATION_DIR,
         "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
         "service_monitors_available": ctx.service_monitors_available,
         "validator": {
-            "image": _operand_block(spec.validator, "validator")["image"],
+            "image": _operand_image(spec.validator, "validator"),
             "pull_policy": spec.validator.image_pull_policy,
             "plugin_env": list(spec.validator.plugin.env),
             "jax_env": list(spec.validator.jax.env),
@@ -102,7 +107,9 @@ class StateDef:
     def render_data(self, ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         data = base_render_data(ctx, spec)
         if self.operand is not None:
-            data["operand"] = _operand_block(self.operand(spec), self.component)
+            operand_spec = self.operand(spec)
+            data["operand"] = _operand_block(operand_spec, self.component)
+            data["image_pull_secrets"] = list(operand_spec.image_pull_secrets)
         data.update(self.extras(ctx, spec))
         return data
 

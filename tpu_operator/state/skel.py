@@ -50,6 +50,14 @@ SUPPORTED_GVKS: tuple[tuple[str, str], ...] = (
 )
 
 
+def _obj_key(obj: dict) -> tuple[str, str, str]:
+    return (
+        obj.get("kind", ""),
+        deep_get(obj, "metadata", "namespace", default="") or "",
+        deep_get(obj, "metadata", "name", default=""),
+    )
+
+
 def daemonset_ready(ds: dict) -> bool:
     """Desired != 0 and Desired == Available == Updated
     (state_skel.go:439-441; OnDelete revision matching is approximated by
@@ -87,6 +95,9 @@ class OperandState:
     # pass (the reference deletes in the disabled branch of each controlFunc
     # but its objects are tracked; we track via this flag)
     _cleaned: bool = dc_field(default=False, compare=False)
+    # rendered-object keys from the previous pass; when the set shrinks
+    # (conditional template blocks turned off), strays are pruned by label
+    _last_rendered: frozenset = dc_field(default=frozenset(), compare=False)
 
     @property
     def name(self) -> str:
@@ -104,6 +115,7 @@ class OperandState:
                 return StateResult(self.name, SyncState.DISABLED, "state disabled")
             deleted = await self.delete_objects(client, ctx.namespace)
             self._cleaned = True
+            self._last_rendered = frozenset()
             return StateResult(
                 self.name, SyncState.DISABLED, f"state disabled; removed {deleted} objects"
             )
@@ -124,6 +136,16 @@ class OperandState:
             live_objs.append(live)
             applied += int(changed)
 
+        # Prune objects that fell out of the rendered set (e.g. the
+        # device-plugin RBAC after devicePlugin.config is removed, or a
+        # ServiceMonitor after serviceMonitor.enabled flips off).  The sweep
+        # runs when the rendered set changes — including the first pass after
+        # an operator restart, when _last_rendered is empty.
+        rendered = frozenset(_obj_key(o) for o in objs)
+        if rendered != self._last_rendered:
+            await self._prune(client, ctx.namespace, rendered)
+            self._last_rendered = rendered
+
         ready, message = self._readiness(live_objs)
         return StateResult(
             self.name,
@@ -142,10 +164,19 @@ class OperandState:
                 return False, f"Deployment {name} not ready"
         return True, ""
 
-    async def delete_objects(self, client: ApiClient, namespace: str) -> int:
-        """Remove everything this state ever applied, matched by state label.
+    async def _prune(self, client: ApiClient, namespace: str, keep: frozenset) -> None:
+        for item in await self._list_labeled(client, namespace):
+            if _obj_key(item) not in keep:
+                await delete_if_exists(client, item)
+                log.info(
+                    "state %s pruned stray %s %s", self.name, item.get("kind"),
+                    deep_get(item, "metadata", "name"),
+                )
 
-        Namespaced kinds are swept in the operator namespace; cluster-scoped
+    async def _list_labeled(self, client: ApiClient, namespace: str) -> list[dict]:
+        """Everything this state ever applied, matched by state label.
+
+        Namespaced kinds are listed in the operator namespace; cluster-scoped
         kinds cluster-wide.  A kind whose API is absent (e.g. ServiceMonitor
         without prometheus-operator) is skipped; real failures propagate so
         the state reports ERROR instead of lying about cleanup.
@@ -153,7 +184,7 @@ class OperandState:
         from tpu_operator.k8s import objects as obj_api
         from tpu_operator.k8s.client import ApiError
 
-        deleted = 0
+        out: list[dict] = []
         selector = f"{consts.STATE_LABEL}={self.name}"
         for group, kind in SUPPORTED_GVKS:
             ns = namespace if obj_api.lookup(group, kind).namespaced else None
@@ -163,7 +194,16 @@ class OperandState:
                 if e.status in (404, 405):  # API/kind not served in this cluster
                     continue
                 raise
+            # list responses omit item kind; stamp it for _obj_key/delete
             for item in items:
-                await delete_if_exists(client, item)
-                deleted += 1
+                item.setdefault("kind", kind)
+                item.setdefault("apiVersion", obj_api.lookup(group, kind).gvk.api_version)
+            out.extend(items)
+        return out
+
+    async def delete_objects(self, client: ApiClient, namespace: str) -> int:
+        deleted = 0
+        for item in await self._list_labeled(client, namespace):
+            await delete_if_exists(client, item)
+            deleted += 1
         return deleted

@@ -354,6 +354,7 @@ class FakeCluster:
 
     async def start(self) -> None:
         app = web.Application()
+        app.router.add_get("/version", self._handle_version)
         app.router.add_route("*", "/api/v1/{rest:.*}", self._handle_core)
         app.router.add_route("*", "/apis/{group}/{version}/{rest:.*}", self._handle_group)
         self._runner = web.AppRunner(app, shutdown_timeout=1.0)
@@ -390,6 +391,9 @@ class FakeCluster:
         await self.stop()
 
     # ------------------------------------------------------------------
+    async def _handle_version(self, request: web.Request) -> web.Response:
+        return web.json_response({"gitVersion": "v1.29.0-fake", "major": "1", "minor": "29"})
+
     async def _handle_core(self, request: web.Request) -> web.StreamResponse:
         return await self._dispatch(request, "", "v1", request.match_info["rest"])
 
