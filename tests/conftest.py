@@ -12,10 +12,19 @@ import inspect
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the axon TPU sitecustomize rewrites JAX_PLATFORMS
+# at interpreter start; tests must run on the virtual 8-device CPU platform
+# unless explicitly opted onto hardware.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("TPU_OPERATOR_TEST_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # the env var alone is not enough once a TPU plugin's sitecustomize has
+    # imported jax machinery; the config update pre-backend-init is decisive
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

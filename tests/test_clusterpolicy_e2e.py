@@ -137,6 +137,29 @@ async def test_disable_operand_deletes_objects():
             assert "tpu-feature-discovery" not in crs
 
 
+async def test_labels_removed_when_accelerator_label_goes():
+    """Node repurposed from TPU to CPU pool: operator-owned labels must be
+    stripped even though the operator itself wrote tpu.present=true."""
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            node = await client.get("", "Node", "tpu-node-0")
+            assert node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] == "true"
+
+            del node["metadata"]["labels"][consts.GKE_TPU_ACCELERATOR_LABEL]
+            await client.update(node)
+            await _converge(reconciler)
+            node = await client.get("", "Node", "tpu-node-0")
+            leftover = [
+                k for k in node["metadata"]["labels"]
+                if k.startswith("tpu.google.com/tpu.")
+            ]
+            assert leftover == [], leftover
+
+
 async def test_conditional_objects_pruned_on_spec_change():
     """Objects that drop out of the rendered set while the state stays
     enabled must be pruned (e.g. device-plugin RBAC after config removal)."""

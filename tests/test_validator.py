@@ -1,0 +1,209 @@
+"""Validator component tests.
+
+Covers the re-derived TPU validation chain (libtpu → pjrt → plugin → jax),
+status-file semantics, workload-pod spawning (with the fake kubelet actually
+executing the JAX workload in-process), and the metrics mode.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+from tpu_operator.validator import status
+from tpu_operator.validator.components import (
+    LIBTPU_CTR_MARKER,
+    ValidationError,
+    Validator,
+    ValidatorConfig,
+)
+
+NS = "tpu-operator"
+
+
+@pytest.fixture
+def fake_hw(tmp_path, monkeypatch):
+    """Synthetic host: 4 accel devices + libtpu.so under TPU_HW_ROOT."""
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    lib = tmp_path / "hw" / "home" / "kubernetes" / "tpu"
+    lib.mkdir(parents=True)
+    (lib / "libtpu.so").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    return tmp_path / "hw"
+
+
+def fast_config(**kw) -> ValidatorConfig:
+    return ValidatorConfig(
+        node_name=kw.pop("node_name", "tpu-node-0"),
+        namespace=NS,
+        sleep_interval=kw.pop("sleep_interval", 0.01),
+        workload_retries=kw.pop("workload_retries", 200),
+        resource_retries=kw.pop("resource_retries", 20),
+        platform="cpu",
+        **kw,
+    )
+
+
+async def test_libtpu_validation(validation_root, fake_hw):
+    status.write_marker(LIBTPU_CTR_MARKER)
+    v = Validator(fast_config())
+    await v.run("libtpu")
+    assert status.is_ready("libtpu")
+    payload = status.read_status("libtpu")
+    assert payload["chips"] == 4
+    assert not payload["host_managed"]
+
+
+async def test_libtpu_host_managed(validation_root, fake_hw):
+    """No runtime container marker but libtpu on host → host-managed path."""
+    v = Validator(fast_config(resource_retries=2))
+    await v.run("libtpu")
+    assert status.read_status("libtpu")["host_managed"] is True
+
+
+async def test_libtpu_fails_without_devices(validation_root, tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "empty"))
+    v = Validator(fast_config(resource_retries=2))
+    with pytest.raises(ValidationError):
+        await v.run("libtpu")
+    assert not status.is_ready("libtpu")
+
+
+async def test_pjrt_validation(validation_root, fake_hw):
+    status.write_ready("libtpu")
+    v = Validator(fast_config())
+    await v.run("pjrt")
+    payload = status.read_status("pjrt")
+    assert payload["platform"] == "cpu"
+    assert payload["device_count"] == 8
+
+
+async def test_plugin_validation_polls_allocatable(validation_root):
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            v = Validator(fast_config(resource_retries=5), client=client)
+            # no allocatable yet → times out
+            with pytest.raises(ValidationError):
+                await v.run("plugin")
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+            await v.run("plugin")
+            assert status.read_status("plugin")["allocatable"] == 4
+
+
+def _exec_workload_pod(pod: dict) -> str:
+    """Fake-kubelet executor: run the pod's command for real (CPU platform)."""
+    spec = pod["spec"]["containers"][0]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+    }
+    env.pop("WORKLOAD_IMAGE", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "tpu_operator.workloads.run_validation"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    return "Succeeded" if result.returncode == 0 else "Failed"
+
+
+async def test_jax_validation_spawns_real_workload(validation_root):
+    """End-to-end: jax component spawns a pod, the fake kubelet executes the
+    actual allreduce/burn-in, pod Succeeds, jax-ready written."""
+    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_workload_pod)
+    async with FakeCluster(sim) as fc:
+        node = fc.add_node("tpu-node-0")
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            # the real workload subprocess pays a ~15s jax import; generous wait
+            v = Validator(
+                fast_config(with_workload=True, sleep_interval=0.1, workload_retries=900),
+                client=client,
+            )
+            await v.run("jax")
+            payload = status.read_status("jax")
+            assert payload["mode"] == "workload-pod"
+            assert payload["chips"] == 4
+            pod = await client.get("", "Pod", "tpu-jax-workload-validation", NS)
+            assert deep_get(pod, "status", "phase") == "Succeeded"
+            limits = deep_get(pod, "spec", "containers", 0, "resources", "limits")
+            assert limits[consts.TPU_RESOURCE] == "4"
+
+
+async def test_jax_validation_in_process(validation_root):
+    status.write_ready("plugin")
+    v = Validator(fast_config(with_workload=False))
+    await v.run("jax")
+    payload = status.read_status("jax")
+    assert payload["mode"] == "in-process"
+    assert payload["devices"] == 8
+    assert payload["algbw_gbps"] > 0
+
+
+async def test_vfio_validation(validation_root, tmp_path, monkeypatch):
+    vfio = tmp_path / "hw" / "dev" / "vfio"
+    vfio.mkdir(parents=True)
+    (vfio / "vfio").touch()  # container device — not a group
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    v = Validator(fast_config())
+    with pytest.raises(ValidationError):
+        await v.run("vfio-pci")
+    (vfio / "0").touch()
+    await v.run("vfio-pci")
+    assert status.is_ready("vfio-pci")
+
+
+async def test_wait_only_and_cleanup(validation_root):
+    v = Validator(fast_config(workload_retries=3))
+    with pytest.raises(ValidationError):
+        await v.wait_ready("pjrt")
+    status.write_ready("pjrt")
+    await v.wait_ready("pjrt")
+    assert status.cleanup_all() == 1
+    assert not status.is_ready("pjrt")
+
+
+def test_cli_cleanup_and_wait(validation_root):
+    from tpu_operator.validator import cli
+
+    status.write_ready("libtpu")
+    assert cli.main(["--cleanup-all"]) == 0
+    assert not status.is_ready("libtpu")
+    # wait-only times out fast
+    assert (
+        cli.main(["--component", "libtpu", "--wait-only",
+                  "--sleep-interval-seconds", "0.01", "--workload-retries", "3"])
+        == 1
+    )
+    status.write_ready("libtpu")
+    assert (
+        cli.main(["--component", "libtpu", "--wait-only",
+                  "--sleep-interval-seconds", "0.01", "--workload-retries", "3"])
+        == 0
+    )
+
+
+def test_metrics_mode(validation_root, fake_hw, capsys):
+    from tpu_operator.validator import cli
+
+    status.write_ready("libtpu")
+    status.write_ready("pjrt")
+    assert cli.main(["--component", "metrics", "--oneshot"]) == 0
+    out = capsys.readouterr().out
+    assert 'tpu_validator_validation_status{component="libtpu"} 1.0' in out
+    assert 'tpu_validator_validation_status{component="jax"} 0.0' in out
+    assert "tpu_validator_tpu_device_count 4.0" in out

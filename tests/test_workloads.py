@@ -1,0 +1,92 @@
+"""Workload tests on the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_operator.workloads import collectives
+
+
+def test_platform_is_virtual_cpu_mesh():
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8
+
+
+def test_vector_add():
+    result = collectives.vector_add(1 << 14)
+    assert result["ok"]
+    assert result["max_error"] == 0.0
+
+
+def test_allreduce_benchmark_8dev():
+    result = collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1)
+    assert result["ok"]
+    assert result["devices"] == 8
+    assert result["algbw_gbps"] > 0
+    # busbw = algbw * 2*(n-1)/n
+    assert result["busbw_gbps"] == pytest.approx(result["algbw_gbps"] * 14 / 8)
+
+
+def test_make_mesh_shapes():
+    mesh = collectives.make_mesh()
+    assert mesh.size == 8
+    assert mesh.axis_names == ("dp", "mp")
+    assert mesh.devices.shape == (2, 4)
+    mesh2 = collectives.make_mesh(n_devices=4)
+    assert mesh2.devices.shape == (2, 2)
+    mesh1 = collectives.make_mesh(n_devices=1)
+    assert mesh1.devices.shape == (1, 1)
+
+
+def test_burn_in_8dev():
+    result = collectives.burn_in(steps=2, batch=32, d_model=256)
+    assert result["ok"]
+    assert result["devices"] == 8
+    assert result["mesh"] == {"dp": 2, "mp": 4}
+    assert all(np.isfinite(l) for l in result["losses"])
+    # deterministic params+input → identical losses across steps
+    assert result["losses"][0] == pytest.approx(result["losses"][1])
+
+
+def test_burn_in_matches_unsharded_reference():
+    """The sharded MLP must compute the same loss as plain jnp on one device."""
+    mesh = collectives.make_mesh(n_devices=4)
+    params = collectives.burn_in_params(mesh, d_model=128, d_hidden=256)
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (16, 128), jax.numpy.bfloat16),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp", None)),
+    )
+    sharded_loss = float(collectives.burn_in_step(mesh, params, x))
+    w1 = np.asarray(params["w1"], np.float32)
+    w2 = np.asarray(params["w2"], np.float32)
+    xs = np.asarray(x, np.float32)
+    h = np.maximum(xs @ w1, 0)
+    y = h @ w2
+    ref = float(np.mean(np.square(y)))
+    assert sharded_loss == pytest.approx(ref, rel=0.05)  # bf16 tolerance
+
+
+def test_run_validation_module(capsys):
+    import os
+
+    from tpu_operator.workloads import run_validation
+
+    os.environ["WORKLOAD_CHECKS"] = "vector-add,allreduce"
+    os.environ["ALLREDUCE_SIZE_MB"] = "2"
+    try:
+        rc = run_validation.main()
+    finally:
+        os.environ.pop("WORKLOAD_CHECKS")
+        os.environ.pop("ALLREDUCE_SIZE_MB")
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(lines) == 2
+
+
+def test_allreduce_min_bandwidth_gate(monkeypatch):
+    from tpu_operator.workloads import run_validation
+
+    monkeypatch.setenv("WORKLOAD_CHECKS", "allreduce")
+    monkeypatch.setenv("ALLREDUCE_SIZE_MB", "2")
+    monkeypatch.setenv("ALLREDUCE_MIN_GBPS", "1000000")
+    assert run_validation.main() == 1

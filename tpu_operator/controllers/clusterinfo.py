@@ -20,12 +20,14 @@ log = logging.getLogger("tpu_operator.clusterinfo")
 
 def is_tpu_node(node: dict) -> bool:
     """GKE TPU node pools carry the accelerator label out of the box
-    (the reference's NFD-PCI-label detection, state_manager.go:117-121)."""
+    (the reference's NFD-PCI-label detection, state_manager.go:117-121).
+
+    Deliberately NOT keyed on the operator's own tpu.present output label:
+    that would make label removal unreachable once a node was ever labelled
+    (accelerator label gone → node must be de-labelled).
+    """
     labels = deep_get(node, "metadata", "labels", default={}) or {}
-    return (
-        consts.GKE_TPU_ACCELERATOR_LABEL in labels
-        or labels.get(consts.TPU_PRESENT_LABEL) == "true"
-    )
+    return consts.GKE_TPU_ACCELERATOR_LABEL in labels
 
 
 def runtime_of(node: dict) -> str:
@@ -54,14 +56,14 @@ async def gather(client: ApiClient, namespace: str, nodes: Optional[list[dict]] 
     except (ApiError, OSError):
         pass
 
-    service_monitors = True
+    # default False on ANY failure (403 RBAC, 500, ...): rendering a
+    # ServiceMonitor the operator cannot apply would loop the policy in ERROR
+    service_monitors = False
     try:
         await client.list("monitoring.coreos.com", "ServiceMonitor", namespace)
-    except ApiError as e:
-        if e.status in (404, 405):
-            service_monitors = False
-    except OSError:
-        service_monitors = False
+        service_monitors = True
+    except (ApiError, OSError) as e:
+        log.debug("ServiceMonitor probe failed (%s); disabling ServiceMonitors", e)
 
     return ClusterContext(
         namespace=namespace,

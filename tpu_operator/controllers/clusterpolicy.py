@@ -77,8 +77,9 @@ class ClusterPolicyReconciler:
             await self._update_status(policy, State.IGNORED, "another TPUClusterPolicy is active")
             return None
 
-        ctx = await clusterinfo.gather(self.client, self.namespace)
-        ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec)
+        nodes = await self.client.list_items("", "Node")
+        ctx = await clusterinfo.gather(self.client, self.namespace, nodes=nodes)
+        ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec, nodes=nodes)
         self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
         self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
 

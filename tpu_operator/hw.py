@@ -1,0 +1,71 @@
+"""Host hardware probing seam.
+
+The reference shells out to chroot'd nvidia-smi / lspci for host truth
+(validator/main.go:606-718, metrics.go:250-300).  TPU hosts have no smi tool;
+truth comes from /dev/accel* device nodes, the libtpu shared object, and PJRT
+client init.  Everything roots at ``TPU_HW_ROOT`` (default ``/``) so tests
+and the fake kubelet can present a synthetic host.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+
+def hw_root() -> str:
+    return os.environ.get("TPU_HW_ROOT", "/")
+
+
+def accel_device_paths() -> list[str]:
+    """TPU chip device nodes: /dev/accel* (COS) or /dev/vfio/* when bound
+    for passthrough."""
+    root = hw_root()
+    return sorted(glob.glob(os.path.join(root, "dev", "accel*")))
+
+
+def vfio_device_paths() -> list[str]:
+    root = hw_root()
+    return sorted(
+        p
+        for p in glob.glob(os.path.join(root, "dev", "vfio", "*"))
+        if os.path.basename(p) != "vfio"  # the container device, not a group
+    )
+
+
+def chip_count() -> int:
+    """TPU_CHIP_COUNT env override → /dev/accel* count → 0."""
+    env = os.environ.get("TPU_CHIP_COUNT")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return len(accel_device_paths())
+
+
+_LIBTPU_GLOBS = (
+    "home/kubernetes/tpu/libtpu.so",
+    "usr/lib/libtpu.so",
+    "usr/local/lib/libtpu.so",
+    "lib/libtpu.so",
+)
+
+
+def libtpu_path() -> str:
+    """LIBTPU_PATH env override → well-known install locations under hw root."""
+    env = os.environ.get("LIBTPU_PATH")
+    if env and os.path.exists(env):
+        return env
+    root = hw_root()
+    for rel in _LIBTPU_GLOBS:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            return path
+    # the pip-installed libtpu the jax stack bundles also counts as present
+    try:
+        import libtpu  # type: ignore[import-not-found]
+
+        return os.path.dirname(libtpu.__file__)
+    except ImportError:
+        return ""

@@ -109,7 +109,11 @@ class StateDef:
         if self.operand is not None:
             operand_spec = self.operand(spec)
             data["operand"] = _operand_block(operand_spec, self.component)
-            data["image_pull_secrets"] = list(operand_spec.image_pull_secrets)
+            # union with the validator's secrets: most operand DS pods embed
+            # validator-image init containers (wait/run_validation macros)
+            merged = list(operand_spec.image_pull_secrets)
+            merged += [s for s in spec.validator.image_pull_secrets if s not in merged]
+            data["image_pull_secrets"] = merged
         data.update(self.extras(ctx, spec))
         return data
 

@@ -651,7 +651,8 @@ class FakeCluster:
         for pod in list(pod_store.objects.values()):
             ns = pod["metadata"]["namespace"]
             name = pod["metadata"]["name"]
-            phase = deep_get(pod, "status", "phase")
+            # directly-created pods (validator workloads) have no status yet
+            phase = deep_get(pod, "status", "phase") or "Pending"
             key = (ns, name)
             started = self._pod_timers.setdefault(key, now)
             if phase == "Pending" and now - started >= self.sim.pod_ready_delay:

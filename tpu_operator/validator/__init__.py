@@ -1,0 +1,7 @@
+"""tpu-validator: node-level validation harness.
+
+Reference analogue: ``validator/`` (the nvidia-validator binary, 1,911 LoC)
+— per-component validations writing status files under /run/tpu/validations
+that operand init containers gate on, plus workload-pod spawning and a node
+metrics mode.
+"""

@@ -1,0 +1,97 @@
+"""tpu-validator CLI.
+
+Reference analogue: validator/main.go:212-336 (urfave/cli flag surface) and
+start() dispatch (:450-565).  Runs as operand init containers:
+
+  python -m tpu_operator.validator.cli --component pjrt
+  python -m tpu_operator.validator.cli --component runtime-prep --wait-only
+  python -m tpu_operator.validator.cli --cleanup-all
+  python -m tpu_operator.validator.cli --component metrics --metrics-port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.validator import status
+from tpu_operator.validator.components import ValidationError, Validator, ValidatorConfig
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("tpu-validator")
+    p.add_argument("--component", "-c", default="",
+                   help="libtpu|pjrt|plugin|jax|vfio-pci|metrics (or any name with --wait-only)")
+    p.add_argument("--node-name", "-n", default=None)
+    p.add_argument("--namespace", default=None)
+    p.add_argument("--wait-only", action="store_true",
+                   help="wait for <component>-ready instead of validating")
+    p.add_argument("--with-workload", action="store_true", default=None)
+    p.add_argument("--cleanup-all", action="store_true")
+    p.add_argument("--sleep-interval-seconds", type=float, default=consts.VALIDATOR_SLEEP_SECONDS)
+    p.add_argument("--workload-retries", type=int, default=consts.VALIDATOR_WORKLOAD_RETRIES)
+    p.add_argument("--resource-retries", type=int, default=consts.VALIDATOR_RESOURCE_RETRIES)
+    p.add_argument("--metrics-port", type=int, default=8000)
+    p.add_argument("--oneshot", action="store_true", help="metrics: one scrape pass then exit")
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+    log = logging.getLogger("tpu-validator")
+
+    if args.cleanup_all:
+        removed = status.cleanup_all()
+        log.info("removed %d status files", removed)
+        return 0
+
+    if not args.component:
+        log.error("--component required")
+        return 2
+
+    config = ValidatorConfig(
+        sleep_interval=args.sleep_interval_seconds,
+        workload_retries=args.workload_retries,
+        resource_retries=args.resource_retries,
+    )
+    if args.node_name is not None:
+        config.node_name = args.node_name
+    if args.namespace is not None:
+        config.namespace = args.namespace
+    if args.with_workload is not None:
+        config.with_workload = args.with_workload
+
+    if args.component == "metrics":
+        from tpu_operator.validator.metrics import serve_metrics
+
+        await serve_metrics(args.metrics_port, oneshot=args.oneshot,
+                            interval=args.sleep_interval_seconds)
+        return 0
+
+    validator = Validator(config)
+    try:
+        if args.wait_only:
+            await validator.wait_ready(args.component)
+            log.info("%s-ready present", args.component)
+        else:
+            await validator.run(args.component)
+            log.info("%s validation succeeded", args.component)
+        return 0
+    except ValidationError as e:
+        log.error("%s validation failed: %s", args.component, e)
+        return 1
+    finally:
+        if validator._client is not None:
+            await validator._client.close()
+
+
+def main(argv: Optional[list] = None) -> int:
+    return asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+"""Validation component implementations.
+
+Reference analogue: validator/main.go:450-1302 — component dispatch (:450-565),
+driver chroot probe → status file (:606-689), plugin resource polling
+(:1115-1135), workload-pod spawning with ownerRef/toleration copying
+(:941-1052), CUDA workload (:1189-1302).
+
+TPU chain (re-derived, SURVEY §7 hard part 3):
+  libtpu   — wait for the runtime container marker, probe libtpu.so + /dev/accel*
+  pjrt     — initialize a PJRT client (the nvidia-smi analogue: no smi tool on
+             TPU hosts; a live XLA client is the root health proof)
+  plugin   — poll node allocatable google.com/tpu; optionally run a 1-chip
+             vector-add workload pod through the scheduler
+  jax      — the collective gate: allreduce + sharded burn-in over all local
+             chips, in-process or as a spawned pod (WITH_WORKLOAD)
+  vfio-pci — passthrough chain: vfio group device nodes present
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator import consts, hw
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.utils import deep_get
+from tpu_operator.validator import status
+
+log = logging.getLogger("tpu_operator.validator")
+
+LIBTPU_CTR_MARKER = ".libtpu-ctr-ready"
+
+
+@dataclass
+class ValidatorConfig:
+    node_name: str = field(default_factory=lambda: os.environ.get("NODE_NAME", ""))
+    namespace: str = field(
+        default_factory=lambda: os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "tpu-operator")
+    )
+    sleep_interval: float = consts.VALIDATOR_SLEEP_SECONDS
+    workload_retries: int = consts.VALIDATOR_WORKLOAD_RETRIES
+    resource_retries: int = consts.VALIDATOR_RESOURCE_RETRIES
+    with_workload: bool = field(
+        default_factory=lambda: os.environ.get("WITH_WORKLOAD", "").lower() in ("1", "true")
+    )
+    workload_image: str = field(default_factory=lambda: os.environ.get("WORKLOAD_IMAGE", ""))
+    # jax platform the PJRT probe asks for; cpu in tests
+    platform: str = field(default_factory=lambda: os.environ.get("TPU_VALIDATOR_PLATFORM", "tpu"))
+
+
+class ValidationError(Exception):
+    pass
+
+
+class Validator:
+    COMPONENTS = ("libtpu", "pjrt", "plugin", "jax", "vfio-pci")
+
+    def __init__(self, config: Optional[ValidatorConfig] = None, client: Optional[ApiClient] = None):
+        self.config = config or ValidatorConfig()
+        self._client = client
+
+    def client(self) -> ApiClient:
+        if self._client is None:
+            from tpu_operator.k8s.client import Config
+
+            self._client = ApiClient(Config.from_env())
+        return self._client
+
+    # ------------------------------------------------------------------
+    async def run(self, component: str) -> None:
+        """Run one validation; raises ValidationError on failure."""
+        handler = {
+            "libtpu": self.validate_libtpu,
+            "pjrt": self.validate_pjrt,
+            "plugin": self.validate_plugin,
+            "jax": self.validate_jax,
+            "vfio-pci": self.validate_vfio,
+        }.get(component)
+        if handler is None:
+            raise ValidationError(f"invalid component {component!r}; one of {self.COMPONENTS}")
+        status.clear(component)
+        await handler()
+
+    async def wait_ready(self, component: str, retries: Optional[int] = None) -> None:
+        """--wait-only: block until another pod's validation wrote the file
+        (device-plugin init gate pattern)."""
+        retries = retries if retries is not None else self.config.workload_retries
+        for _ in range(retries):
+            if status.is_ready(component):
+                return
+            await asyncio.sleep(self.config.sleep_interval)
+        raise ValidationError(f"timed out waiting for {component}-ready")
+
+    # ------------------------------------------------------------------
+    async def validate_libtpu(self) -> None:
+        """Wait for the runtime container, then probe host truth."""
+        host_managed = False
+        for _ in range(self.config.resource_retries):
+            if status.marker_exists(LIBTPU_CTR_MARKER):
+                break
+            if hw.libtpu_path():
+                # no operator-managed runtime container but libtpu is on the
+                # host → host-managed runtime (host-driver-ready analogue)
+                host_managed = True
+                break
+            await asyncio.sleep(self.config.sleep_interval)
+        else:
+            raise ValidationError("tpu runtime container never became ready")
+        libtpu = hw.libtpu_path()
+        if not libtpu:
+            raise ValidationError("libtpu.so not found on host")
+        chips = hw.chip_count()
+        if chips <= 0:
+            raise ValidationError("no /dev/accel* TPU device nodes")
+        status.write_ready(
+            "libtpu", {"libtpu_path": libtpu, "chips": chips, "host_managed": host_managed}
+        )
+
+    async def validate_pjrt(self) -> None:
+        """PJRT client init — the nvidia-smi analogue."""
+        await self.wait_ready("libtpu", retries=self.config.resource_retries)
+
+        def probe() -> dict:
+            import jax
+
+            devices = jax.devices(self.config.platform)
+            if not devices:
+                raise ValidationError(f"PJRT reports no {self.config.platform} devices")
+            return {
+                "platform": self.config.platform,
+                "device_count": len(devices),
+                "device_kind": getattr(devices[0], "device_kind", ""),
+            }
+
+        payload = await asyncio.get_event_loop().run_in_executor(None, probe)
+        status.write_ready("pjrt", payload)
+
+    async def validate_plugin(self) -> None:
+        """Node advertises google.com/tpu (validateGPUResource analogue)."""
+        if not self.config.node_name:
+            raise ValidationError("NODE_NAME required for plugin validation")
+        client = self.client()
+        for _ in range(self.config.resource_retries):
+            node = await client.get("", "Node", self.config.node_name)
+            alloc = deep_get(node, "status", "allocatable", default={}) or {}
+            try:
+                count = int(alloc.get(consts.TPU_RESOURCE, "0"))
+            except ValueError:
+                count = 0
+            if count > 0:
+                if self.config.with_workload:
+                    await self.spawn_workload(
+                        "tpu-plugin-workload-validation", checks="vector-add", tpu_request=1
+                    )
+                status.write_ready("plugin", {"allocatable": count})
+                return
+            await asyncio.sleep(self.config.sleep_interval)
+        raise ValidationError(f"node {self.config.node_name} never advertised {consts.TPU_RESOURCE}")
+
+    async def validate_jax(self) -> None:
+        """The collective gate: allreduce + burn-in over all local chips."""
+        await self.wait_ready("plugin", retries=self.config.resource_retries)
+        if self.config.with_workload:
+            chips = await self._node_chip_count()
+            await self.spawn_workload(
+                "tpu-jax-workload-validation",
+                checks="vector-add,allreduce,burn-in",
+                tpu_request=chips,
+            )
+            status.write_ready("jax", {"mode": "workload-pod", "chips": chips})
+            return
+
+        def run_checks() -> dict:
+            from tpu_operator.workloads import collectives
+
+            results = {
+                "vector-add": collectives.vector_add(1 << 16),
+                "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
+            }
+            for name, r in results.items():
+                if not r.get("ok"):
+                    raise ValidationError(f"jax check {name} failed: {r}")
+            return {
+                "mode": "in-process",
+                "devices": results["allreduce"]["devices"],
+                "algbw_gbps": results["allreduce"]["algbw_gbps"],
+            }
+
+        payload = await asyncio.get_event_loop().run_in_executor(None, run_checks)
+        status.write_ready("jax", payload)
+
+    async def validate_vfio(self) -> None:
+        devices = hw.vfio_device_paths()
+        if not devices:
+            raise ValidationError("no /dev/vfio group devices bound")
+        status.write_ready("vfio-pci", {"devices": devices})
+
+    # ------------------------------------------------------------------
+    async def _node_chip_count(self) -> int:
+        node = await self.client().get("", "Node", self.config.node_name)
+        alloc = deep_get(node, "status", "allocatable", default={}) or {}
+        try:
+            return max(1, int(alloc.get(consts.TPU_RESOURCE, "1")))
+        except ValueError:
+            return 1
+
+    async def _owner_daemonset(self) -> Optional[dict]:
+        try:
+            return await self.client().get(
+                "apps", "DaemonSet", "tpu-operator-validator", self.config.namespace
+            )
+        except ApiError:
+            return None
+
+    def _workload_pod(self, name: str, checks: str, tpu_request: int, owner: Optional[dict]) -> dict:
+        """Build the workload pod (plugin-workload-validation.yaml analogue,
+        validator/main.go:984-1052: node pinning, resource request, ownerRef
+        + tolerations copied from the validator DaemonSet)."""
+        image = self.config.workload_image or "ghcr.io/tpu-operator/tpu-validator:latest"
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": self.config.namespace,
+                "labels": {"app": name},
+            },
+            "spec": {
+                "nodeName": self.config.node_name,
+                "restartPolicy": "Never",
+                "containers": [
+                    {
+                        "name": "workload",
+                        "image": image,
+                        "command": ["python", "-m", "tpu_operator.workloads.run_validation"],
+                        "env": [
+                            {"name": "WORKLOAD_CHECKS", "value": checks},
+                            {
+                                "name": "ALLREDUCE_MIN_GBPS",
+                                "value": os.environ.get("ALLREDUCE_MIN_GBPS", "0"),
+                            },
+                        ],
+                        "resources": {
+                            "limits": {consts.TPU_RESOURCE: str(tpu_request)},
+                            "requests": {consts.TPU_RESOURCE: str(tpu_request)},
+                        },
+                    }
+                ],
+            },
+        }
+        if owner is not None:
+            from tpu_operator.k8s import objects as obj_api
+
+            obj_api.set_owner_reference(pod, owner)
+            tolerations = deep_get(owner, "spec", "template", "spec", "tolerations")
+            if tolerations:
+                pod["spec"]["tolerations"] = copy.deepcopy(tolerations)
+        return pod
+
+    async def spawn_workload(self, name: str, checks: str, tpu_request: int) -> None:
+        client = self.client()
+        owner = await self._owner_daemonset()
+        pod = self._workload_pod(name, checks, tpu_request, owner)
+        await client.delete("", "Pod", name, self.config.namespace)
+        await client.create(pod)
+        for _ in range(self.config.workload_retries):
+            live = await client.get("", "Pod", name, self.config.namespace)
+            phase = deep_get(live, "status", "phase")
+            if phase == "Succeeded":
+                return
+            if phase == "Failed":
+                raise ValidationError(f"workload pod {name} failed")
+            await asyncio.sleep(self.config.sleep_interval)
+        raise ValidationError(f"workload pod {name} did not complete (phase={phase})")

@@ -1,0 +1,71 @@
+"""Validator node-metrics mode.
+
+Reference analogue: validator/metrics.go:39-300 — Prometheus gauges mirroring
+the status files plus a host device count (their lspci, our /dev/accel*).
+Also the implementation behind the node-status-exporter operand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from tpu_operator import consts, hw
+from tpu_operator.validator import status
+
+log = logging.getLogger("tpu_operator.validator.metrics")
+
+
+class NodeMetrics:
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.validation_status = Gauge(
+            "tpu_validator_validation_status",
+            "1 when the component's validation status file is present",
+            ["component"],
+            registry=self.registry,
+        )
+        self.device_count = Gauge(
+            "tpu_validator_tpu_device_count",
+            "TPU chip device nodes visible on the host",
+            registry=self.registry,
+        )
+
+    def scrape(self) -> None:
+        for component in consts.STATUS_FILES:
+            self.validation_status.labels(component=component).set(
+                1 if status.is_ready(component) else 0
+            )
+        self.device_count.set(hw.chip_count())
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+async def serve_metrics(port: int, oneshot: bool = False, interval: float = 5.0) -> None:
+    metrics = NodeMetrics()
+    metrics.scrape()
+    if oneshot:
+        print(metrics.render().decode())
+        return
+
+    async def handler(request: web.Request) -> web.Response:
+        metrics.scrape()
+        return web.Response(body=metrics.render(), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    log.info("validator metrics serving on :%d", port)
+    try:
+        while True:
+            await asyncio.sleep(interval)
+    finally:
+        await runner.cleanup()

@@ -1,0 +1,89 @@
+"""Validation status files.
+
+Reference analogue: validator/main.go:131-166 — files like ``driver-ready``
+under /run/nvidia/validations; here ``libtpu-ready``/``pjrt-ready``/
+``plugin-ready``/``jax-ready`` under /run/tpu/validations, relocatable via
+``TPU_VALIDATION_ROOT`` (UNIT_TEST seam analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from tpu_operator import consts
+
+
+def validation_dir() -> str:
+    root = os.environ.get(consts.VALIDATION_ROOT_ENV)
+    if root:
+        return os.path.join(root, "validations")
+    return consts.VALIDATION_DIR
+
+
+def status_path(component: str) -> str:
+    name = consts.STATUS_FILES.get(component, f"{component}-ready")
+    return os.path.join(validation_dir(), name)
+
+
+def write_ready(component: str, payload: Optional[dict] = None) -> str:
+    path = status_path(component)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    data = {"component": component, "ts": time.time(), **(payload or {})}
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path
+
+
+def is_ready(component: str) -> bool:
+    return os.path.exists(status_path(component))
+
+
+def read_status(component: str) -> Optional[dict]:
+    try:
+        with open(status_path(component)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def clear(component: str) -> None:
+    try:
+        os.remove(status_path(component))
+    except OSError:
+        pass
+
+
+def cleanup_all() -> int:
+    """--cleanup-all: remove every *-ready file (validator preStop pattern,
+    assets/state-operator-validation/0500_daemonset.yaml:150-153)."""
+    d = validation_dir()
+    removed = 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    for name in names:
+        if name.endswith("-ready"):
+            try:
+                os.remove(os.path.join(d, name))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def write_marker(name: str) -> str:
+    """Dot-file markers for intra-chain handoff (.libtpu-ctr-ready analogue
+    of .driver-ctr-ready, validator/main.go:606-635)."""
+    path = os.path.join(validation_dir(), name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(str(time.time()))
+    return path
+
+
+def marker_exists(name: str) -> bool:
+    return os.path.exists(os.path.join(validation_dir(), name))

@@ -1,0 +1,8 @@
+"""JAX validation/burn-in workloads — the operator's TPU compute payloads.
+
+Reference analogue: the CUDA vectorAdd image the validator spawns
+(validator/main.go:1189-1302) and the plugin workload pod (:941-1028).  The
+TPU replacements are real XLA programs: a pallas vector-add for single-chip
+sanity, a psum allreduce over ICI with achieved-bandwidth reporting, and a
+sharded burn-in step exercising the MXU + collectives across a device mesh.
+"""

@@ -1,0 +1,50 @@
+"""Workload-pod entry point: the container command of the validator's spawned
+pods (cuda/plugin-workload-validation.yaml image analogue).
+
+Exits 0 iff every requested check passes; prints one JSON line per check so
+the validator (and humans reading pod logs) see the numbers.
+
+Env:
+- ``WORKLOAD_CHECKS``: comma list of vector-add,allreduce,burn-in (default all)
+- ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
+  minimum enforces the BASELINE "expected ICI GB/s" gate when set
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    from tpu_operator.workloads import collectives
+
+    checks = [
+        c.strip()
+        for c in os.environ.get("WORKLOAD_CHECKS", "vector-add,allreduce,burn-in").split(",")
+        if c.strip()
+    ]
+    ok = True
+    for check in checks:
+        if check == "vector-add":
+            result = collectives.vector_add()
+        elif check == "allreduce":
+            result = collectives.allreduce_benchmark(
+                size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "64"))
+            )
+            min_gbps = float(os.environ.get("ALLREDUCE_MIN_GBPS", "0"))
+            if min_gbps and result["algbw_gbps"] < min_gbps:
+                result["ok"] = False
+                result["error"] = f"algbw {result['algbw_gbps']:.1f} < required {min_gbps}"
+        elif check == "burn-in":
+            result = collectives.burn_in()
+        else:
+            result = {"ok": False, "error": f"unknown check {check}"}
+        print(json.dumps({"check": check, **result}), flush=True)
+        ok = ok and bool(result.get("ok"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
