@@ -1,0 +1,29 @@
+# tpu-operator build/test targets (reference Makefile surface analogue).
+
+PYTHON ?= python
+
+.PHONY: test unit-test proto manifests goldens bench lint all
+
+all: proto manifests test
+
+test: unit-test
+
+unit-test:
+	$(PYTHON) -m pytest tests/ -q
+
+# kubelet device-plugin v1beta1 message codegen (protoc only; gRPC wiring is
+# hand-written in tpu_operator/deviceplugin/rpc.py)
+proto:
+	protoc --python_out=tpu_operator/deviceplugin -Itpu_operator/deviceplugin \
+	  tpu_operator/deviceplugin/api.proto
+
+# CRD YAML from the spec dataclasses (controller-gen `make manifests` analogue)
+manifests:
+	$(PYTHON) -m tpu_operator.api.crds
+
+# regenerate golden render fixtures after intentional template changes
+goldens:
+	$(PYTHON) -m tests.goldens
+
+bench:
+	$(PYTHON) bench.py

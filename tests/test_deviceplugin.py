@@ -1,0 +1,164 @@
+"""Device-plugin protocol tests over real gRPC unix sockets."""
+
+import asyncio
+import os
+
+import pytest
+
+from tpu_operator.deviceplugin import api_pb2, rpc
+from tpu_operator.deviceplugin.plugin import PluginConfig, TPUDevicePlugin
+from tpu_operator.testing.fakekubelet import FakeKubelet
+
+
+@pytest.fixture
+def hw4(tmp_path, monkeypatch):
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    return tmp_path
+
+
+def make_plugin(tmp_path, **kw) -> TPUDevicePlugin:
+    config = PluginConfig(
+        kubelet_dir=str(tmp_path / "kubelet"), health_interval=0.05, **kw
+    )
+    return TPUDevicePlugin(config)
+
+
+async def test_register_and_list_and_watch(tmp_path, hw4):
+    plugin = make_plugin(tmp_path)
+    async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+        await plugin.serve()
+        try:
+            await plugin.register()
+            assert kubelet.registrations[0].resource_name == "google.com/tpu"
+            assert kubelet.registrations[0].version == "v1beta1"
+            assert kubelet.registrations[0].endpoint == "tpu.sock"
+
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                opts = await stub.GetDevicePluginOptions(api_pb2.Empty())
+                assert opts.get_preferred_allocation_available
+
+                stream = stub.ListAndWatch(api_pb2.Empty())
+                first = await asyncio.wait_for(stream.read(), timeout=5)
+                ids = [d.ID for d in first.devices]
+                assert ids == ["tpu-accel0", "tpu-accel1", "tpu-accel2", "tpu-accel3"]
+                assert all(d.health == "Healthy" for d in first.devices)
+
+                # chip device node disappears → still advertised, Unhealthy
+                # (kubelet's signal to fail pods bound to it)
+                os.remove(os.path.join(os.environ["TPU_HW_ROOT"], "dev", "accel3"))
+                update = await asyncio.wait_for(stream.read(), timeout=5)
+                health = {d.ID: d.health for d in update.devices}
+                assert health["tpu-accel3"] == "Unhealthy"
+                assert health["tpu-accel0"] == "Healthy"
+        finally:
+            await plugin.stop()
+
+
+async def test_allocate_device_specs_and_env(tmp_path, hw4):
+    plugin = make_plugin(tmp_path)
+    await plugin.serve()
+    try:
+        async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(devicesIDs=["tpu-accel1", "tpu-accel2"])
+                )
+                resp = await stub.Allocate(req)
+                cresp = resp.container_responses[0]
+                paths = {d.host_path for d in cresp.devices}
+                assert paths == {
+                    os.path.join(os.environ["TPU_HW_ROOT"], "dev", "accel1"),
+                    os.path.join(os.environ["TPU_HW_ROOT"], "dev", "accel2"),
+                }
+                assert all(d.container_path.startswith("/dev/accel") for d in cresp.devices)
+                assert cresp.envs["TPU_VISIBLE_CHIPS"] == "1,2"
+                assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2"
+    finally:
+        await plugin.stop()
+
+
+async def test_allocate_unknown_device_rejected(tmp_path, hw4):
+    plugin = make_plugin(tmp_path)
+    await plugin.serve()
+    try:
+        async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(devicesIDs=["tpu-accel99"])
+                )
+                import grpc
+
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await stub.Allocate(req)
+                assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        await plugin.stop()
+
+
+async def test_reserve_after_kubelet_wipe(tmp_path, hw4):
+    """serve() must be restart-safe: kubelet wipes the plugin dir on boot."""
+    plugin = make_plugin(tmp_path)
+    await plugin.serve()
+    try:
+        os.remove(plugin.config.socket_path)
+        await plugin.serve()  # re-serve over the wiped dir
+        async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                opts = await stub.GetDevicePluginOptions(api_pb2.Empty())
+                assert opts.get_preferred_allocation_available
+    finally:
+        await plugin.stop()
+
+
+def test_chip_index():
+    from tpu_operator.deviceplugin.plugin import chip_index
+
+    assert chip_index("tpu-accel3") == 3
+    assert chip_index("accel12") == 12
+    # only the trailing number counts, not every digit in the name
+    assert chip_index("tpu-v5e-accel7") == 7
+    assert chip_index("accel") == 0
+
+
+def test_preferred_allocation_contiguity():
+    plugin = TPUDevicePlugin(PluginConfig())
+    available = [f"tpu-accel{i}" for i in (0, 1, 3, 4, 5, 7)]
+    # best contiguous run of 3 is 3,4,5
+    assert plugin.preferred_allocation(available, [], 3) == [
+        "tpu-accel3", "tpu-accel4", "tpu-accel5",
+    ]
+    # must_include honoured and counted
+    picked = plugin.preferred_allocation(available, ["tpu-accel7"], 2)
+    assert picked[0] == "tpu-accel7"
+    assert len(picked) == 2
+
+
+async def test_vfio_mode(tmp_path, monkeypatch):
+    vfio = tmp_path / "hw" / "dev" / "vfio"
+    vfio.mkdir(parents=True)
+    (vfio / "vfio").touch()
+    (vfio / "0").touch()
+    (vfio / "1").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    plugin = make_plugin(tmp_path, mode="vfio", socket_name="tpu-vfio.sock")
+    plugin.refresh_devices()
+    assert sorted(plugin.devices) == ["tpu-0", "tpu-1"]
+
+
+async def test_env_declared_chips_without_device_nodes(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "nohw"))
+    monkeypatch.setenv("TPU_CHIP_COUNT", "8")
+    plugin = make_plugin(tmp_path)
+    plugin.refresh_devices()
+    assert len(plugin.devices) == 8
+    assert all(h == "Healthy" for h in plugin.health.values())

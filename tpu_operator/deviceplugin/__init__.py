@@ -1,0 +1,3 @@
+"""TPU kubelet device plugin (google.com/tpu)."""
+
+from tpu_operator.deviceplugin.plugin import PluginConfig, TPUDevicePlugin  # noqa: F401

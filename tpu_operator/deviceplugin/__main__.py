@@ -1,0 +1,37 @@
+"""python -m tpu_operator.deviceplugin [--mode accel|vfio]"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from tpu_operator import consts
+from tpu_operator.deviceplugin.plugin import PluginConfig, TPUDevicePlugin
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+    p = argparse.ArgumentParser("tpu-device-plugin")
+    p.add_argument("--mode", choices=["accel", "vfio"], default="accel")
+    p.add_argument("--resource-name", default=consts.TPU_RESOURCE)
+    p.add_argument("--socket-name", default=None)
+    args = p.parse_args()
+    config = PluginConfig(
+        resource_name=args.resource_name,
+        mode=args.mode,
+        socket_name=args.socket_name or ("tpu-vfio.sock" if args.mode == "vfio" else "tpu.sock"),
+    )
+    plugin = TPUDevicePlugin(config)
+
+    async def run() -> None:
+        try:
+            await plugin.run_forever()
+        finally:
+            await plugin.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Fake kubelet: the Registration gRPC server + device-plugin client.
+
+Lets tests drive the full device-plugin protocol — registration over the
+kubelet socket, ListAndWatch streaming, Allocate — without a real kubelet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+import grpc.aio
+
+from tpu_operator.deviceplugin import api_pb2, rpc
+
+
+class FakeKubelet:
+    def __init__(self, plugin_dir: str):
+        self.plugin_dir = plugin_dir
+        self.registrations: list[api_pb2.RegisterRequest] = []
+        self.registered = asyncio.Event()
+        self._server: Optional[grpc.aio.Server] = None
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.plugin_dir, "kubelet.sock")
+
+    async def Register(self, request: api_pb2.RegisterRequest, context) -> api_pb2.Empty:
+        self.registrations.append(request)
+        self.registered.set()
+        return api_pb2.Empty()
+
+    async def start(self) -> None:
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((rpc.registration_handler(self),))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        await self._server.start()
+
+    async def stop(self) -> None:
+        if self._server:
+            await self._server.stop(grace=0.5)
+
+    def plugin_channel(self, endpoint: str) -> grpc.aio.Channel:
+        return grpc.aio.insecure_channel(f"unix://{os.path.join(self.plugin_dir, endpoint)}")
+
+    async def __aenter__(self) -> "FakeKubelet":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
