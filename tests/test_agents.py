@@ -1,0 +1,260 @@
+"""Node agent tests: feature discovery, metrics agent/exporter, runtime
+chain (installer, manager, prep), config-manager, vfio-manager."""
+
+import asyncio
+import json
+import os
+
+import aiohttp
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+from tpu_operator.validator import status
+
+NS = "tpu-operator"
+
+
+@pytest.fixture
+def hw4(tmp_path, monkeypatch):
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    return tmp_path / "hw"
+
+
+# ---------------------------------------------------------------------------
+# feature discovery
+
+
+async def test_feature_discovery_labels(hw4, monkeypatch):
+    from tpu_operator.agents import feature_discovery
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-node-0", accelerator="tpu-v5-lite-podslice", topology="4x4")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            monkeypatch.setenv("TPU_WORKER_ID", "2")
+            features = await feature_discovery.label_node(client, "tpu-node-0")
+            assert features[consts.TFD_CHIP_LABEL] == "v5e"
+            assert features[consts.TFD_CHIPS_PER_HOST_LABEL] == "4"
+            assert features[consts.TFD_HBM_GB_LABEL] == "16"
+            assert features[consts.TFD_ICI_TOPOLOGY_LABEL] == "4x4"
+            assert features[consts.TFD_SLICE_HOSTS_LABEL] == "4"  # 16 chips / 4 per host
+            assert features[consts.TFD_SLICE_WORKER_ID_LABEL] == "2"
+            node = await client.get("", "Node", "tpu-node-0")
+            assert node["metadata"]["labels"][consts.TFD_CHIP_LABEL] == "v5e"
+            # second run is a no-op patch (idempotent)
+            rv = node["metadata"]["resourceVersion"]
+            await feature_discovery.label_node(client, "tpu-node-0")
+            node2 = await client.get("", "Node", "tpu-node-0")
+            assert node2["metadata"]["resourceVersion"] == rv
+
+
+def test_runtime_version_from_install_dir(hw4):
+    from tpu_operator.agents import feature_discovery
+
+    libdir = hw4 / "home" / "kubernetes" / "tpu"
+    libdir.mkdir(parents=True)
+    (libdir / "version").write_text("libtpu-2026-02-01\n")
+    assert feature_discovery.runtime_version() == "libtpu-2026-02-01"
+
+
+# ---------------------------------------------------------------------------
+# metrics agent + exporter
+
+
+async def test_metrics_agent_and_exporter(hw4, monkeypatch):
+    from tpu_operator.agents import base as agent_base
+    from tpu_operator.agents import metrics_agent, metrics_exporter
+
+    monkeypatch.setenv("NODE_NAME", "tpu-node-0")
+    stop = asyncio.Event()
+    agent_task = asyncio.create_task(metrics_agent.serve(15555, stop))
+    exp_task = asyncio.create_task(metrics_exporter.serve(19400, 15555, stop))
+    try:
+        await asyncio.sleep(0.2)
+        async with aiohttp.ClientSession() as http:
+            async with http.get("http://127.0.0.1:15555/counters") as r:
+                data = await r.json()
+                assert set(data["chips"].keys()) == {"0", "1", "2", "3"} or set(
+                    data["chips"].keys()
+                ) == {0, 1, 2, 3}
+            async with http.get("http://127.0.0.1:15555/metrics") as r:
+                text = await r.text()
+                assert 'tpu_duty_cycle_percent{chip="0"} 0.0' in text
+            async with http.get("http://127.0.0.1:19400/metrics") as r:
+                text = await r.text()
+                assert 'tpu_hbm_memory_usage_bytes{node="tpu-node-0",chip="2"} 0.0' in text
+    finally:
+        stop.set()
+        await asyncio.gather(agent_task, exp_task, return_exceptions=True)
+
+
+def test_exporter_allowlist(tmp_path):
+    from tpu_operator.agents.metrics_exporter import load_allowlist, render
+
+    csv = tmp_path / "counters.csv"
+    csv.write_text("# comment\ntpu_duty_cycle_percent, chip duty cycle\n")
+    allow = load_allowlist(str(csv))
+    assert allow == {"tpu_duty_cycle_percent"}
+    snapshot = {"chips": {0: {"tpu_duty_cycle_percent": 42.0, "tpu_hbm_memory_usage_bytes": 9}}}
+    text = render(snapshot, "n1", allow)
+    assert "tpu_duty_cycle_percent" in text
+    assert "tpu_hbm_memory_usage_bytes" not in text
+
+
+# ---------------------------------------------------------------------------
+# runtime chain
+
+
+def test_libtpu_installer(hw4, validation_root, monkeypatch, tmp_path):
+    from tpu_operator.agents import libtpu_installer
+    from tpu_operator.validator.components import LIBTPU_CTR_MARKER
+
+    src = tmp_path / "payload" / "libtpu.so"
+    src.parent.mkdir()
+    src.write_bytes(b"\x7fELF-fake-libtpu")
+    monkeypatch.setenv("LIBTPU_SRC", str(src))
+    monkeypatch.setenv("LIBTPU_VERSION", "libtpu-2026-02-01")
+    result = libtpu_installer.install()
+    assert result["installed"]
+    assert result["chips"] == 4
+    target = hw4 / "home" / "kubernetes" / "tpu" / "libtpu.so"
+    assert target.read_bytes() == b"\x7fELF-fake-libtpu"
+    assert (hw4 / "home" / "kubernetes" / "tpu" / "version").read_text() == "libtpu-2026-02-01"
+    # idempotent second pass
+    assert not libtpu_installer.install()["installed"]
+
+
+async def test_runtime_manager_evicts_on_upgrade(validation_root, monkeypatch):
+    from tpu_operator.agents import runtime_manager
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-node-0")
+        node["metadata"]["annotations"][consts.UPGRADE_REQUESTED_ANNOTATION] = "true"
+        fc.put(node)
+        # a TPU workload pod + a non-TPU pod on the node
+        fc.put({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "train", "namespace": "default"},
+            "spec": {"nodeName": "tpu-node-0", "containers": [
+                {"name": "c", "resources": {"limits": {consts.TPU_RESOURCE: "4"}}}]},
+        })
+        fc.put({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"nodeName": "tpu-node-0", "containers": [{"name": "c"}]},
+        })
+        monkeypatch.setenv("NODE_NAME", "tpu-node-0")
+        monkeypatch.setenv("KUBERNETES_API_URL", fc.base_url)
+        monkeypatch.setenv("DRAIN_TIMEOUT_SECONDS", "2")
+        assert await runtime_manager.run() == 0
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            pods = {p["metadata"]["name"] for p in await client.list_items("", "Pod", "default")}
+            assert pods == {"web"}
+            node = await client.get("", "Node", "tpu-node-0")
+            assert consts.UPGRADE_REQUESTED_ANNOTATION not in node["metadata"].get("annotations", {})
+
+
+async def test_runtime_manager_noop_without_request(validation_root, monkeypatch):
+    from tpu_operator.agents import runtime_manager
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-node-0")
+        fc.put({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "train", "namespace": "default"},
+            "spec": {"nodeName": "tpu-node-0", "containers": [
+                {"name": "c", "resources": {"limits": {consts.TPU_RESOURCE: "4"}}}]},
+        })
+        monkeypatch.setenv("NODE_NAME", "tpu-node-0")
+        monkeypatch.setenv("KUBERNETES_API_URL", fc.base_url)
+        assert await runtime_manager.run() == 0
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            assert len(await client.list_items("", "Pod", "default")) == 1
+
+
+def test_runtime_prep(hw4, validation_root, monkeypatch):
+    from tpu_operator.agents import runtime_prep
+
+    monkeypatch.setenv("DEVICE_PERMISSIONS", "0660")
+    monkeypatch.setenv("HUGEPAGES_GB", "8")
+    result = runtime_prep.prep()
+    assert len(result["devices"]) == 4
+    assert result["permissions"] == "0o660"
+    mode = os.stat(result["devices"][0]).st_mode & 0o777
+    assert mode == 0o660
+    hp = hw4 / "sys" / "kernel" / "mm" / "hugepages" / "hugepages-1048576kB" / "nr_hugepages"
+    assert hp.read_text() == "8"
+
+
+# ---------------------------------------------------------------------------
+# config manager
+
+
+async def test_config_manager_selects_by_label(tmp_path, monkeypatch):
+    from tpu_operator.agents import config_manager
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-node-0", labels={config_manager.NODE_CONFIG_LABEL: "perf"})
+        fc.put({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "plugin-config", "namespace": NS},
+            "data": {"default": "mode: default\n", "perf": "mode: perf\n"},
+        })
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            target = tmp_path / "config" / "config.yaml"
+            selected = await config_manager.sync_once(
+                client, "tpu-node-0", "plugin-config", NS, "default", str(target)
+            )
+            assert selected == "perf"
+            assert target.read_text() == "mode: perf\n"
+            # label removed → default
+            del node["metadata"]["labels"][config_manager.NODE_CONFIG_LABEL]
+            fc.put(node)
+            selected = await config_manager.sync_once(
+                client, "tpu-node-0", "plugin-config", NS, "default", str(target)
+            )
+            assert selected == "default"
+            assert target.read_text() == "mode: default\n"
+
+
+# ---------------------------------------------------------------------------
+# vfio manager
+
+
+def test_vfio_manager_binds_pci(tmp_path, monkeypatch):
+    from tpu_operator.agents import vfio_manager
+
+    root = tmp_path / "hw"
+    for addr, vendor in [("0000:00:05.0", "0x1ae0"), ("0000:00:06.0", "0x1ae0"),
+                         ("0000:00:03.0", "0x8086")]:
+        d = root / "sys" / "bus" / "pci" / "devices" / addr
+        d.mkdir(parents=True)
+        (d / "vendor").write_text(vendor + "\n")
+    monkeypatch.setenv("TPU_HW_ROOT", str(root))
+    addrs = vfio_manager.tpu_pci_addresses()
+    assert addrs == ["0000:00:05.0", "0000:00:06.0"]
+    for a in addrs:
+        assert vfio_manager.bind_to_vfio(a)
+    overrides = root / "sys" / "bus" / "pci" / "devices" / "0000:00:05.0" / "driver_override"
+    assert overrides.read_text() == "vfio-pci"
+    from tpu_operator import hw
+
+    assert len(hw.vfio_device_paths()) == 2
+
+
+def test_parse_duration():
+    from tpu_operator.agents.base import parse_duration
+
+    assert parse_duration("60s") == 60.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("1.5h") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration("30") == 30.0
+    with pytest.raises(ValueError):
+        parse_duration("abc")

@@ -1,0 +1,181 @@
+"""Slice partitioner + slice-manager agent + pooled readiness tests."""
+
+import json
+
+import pytest
+import yaml
+
+from tpu_operator import consts, slices
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+# ---------------------------------------------------------------------------
+# partitioner
+
+
+def test_partition_v5p_halves():
+    parts = slices.partition_topology("4x4x4", ["2x4x4", "2x4x4"])
+    assert len(parts) == 2
+    all_coords = set()
+    for p in parts:
+        coords = set(p.coords())
+        assert not coords & all_coords  # disjoint
+        all_coords |= coords
+    assert len(all_coords) == 64  # exact tiling
+
+
+def test_partition_2d():
+    parts = slices.partition_topology("2x4", ["2x2", "2x2"])
+    assert [p.origin for p in parts] == [(0, 0), (0, 2)]
+
+
+def test_partition_rejects_bad_coverage():
+    with pytest.raises(slices.PartitionError, match="cover"):
+        slices.partition_topology("4x4x4", ["2x4x4"])
+    with pytest.raises(slices.PartitionError, match="tile"):
+        slices.partition_topology("4x4", ["3x4", "1x4"])
+
+
+def test_chip_assignments_hosts():
+    layout = slices.chip_assignments("2x4", ["2x2", "2x2"], chips_per_host=4)
+    assert layout[0]["chip_ids"] == [0, 1, 4, 5]
+    assert layout[1]["chip_ids"] == [2, 3, 6, 7]
+    # row-major: host0 owns chips 0-3, host1 owns 4-7 → both partitions span both hosts
+    assert layout[0]["hosts"] == [0, 1]
+    assert layout[1]["hosts"] == [0, 1]
+
+
+def test_load_profile_matching():
+    config = {
+        "slice-configs": {
+            "all-balanced": [
+                {"accelerators": ["tpu-v5p-slice"], "topology": "4x4x4",
+                 "partitions": ["2x4x4", "2x4x4"]},
+                {"accelerators": ["*"], "partitions": []},
+            ]
+        }
+    }
+    assert slices.load_profile(config, "all-balanced", "tpu-v5p-slice", "4x4x4") == [
+        "2x4x4", "2x4x4",
+    ]
+    # wildcard fallback rule
+    assert slices.load_profile(config, "all-balanced", "tpu-v5-lite-podslice", "2x4") == []
+    with pytest.raises(slices.PartitionError):
+        slices.load_profile(config, "nope", "x", "y")
+
+
+# ---------------------------------------------------------------------------
+# slice-manager agent
+
+
+async def test_slice_manager_applies_profile(tmp_path, validation_root, monkeypatch):
+    from tpu_operator.agents.slice_manager import SliceManager, read_applied
+
+    config_file = tmp_path / "config.yaml"
+    config_file.write_text(yaml.safe_dump({
+        "version": "v1",
+        "slice-configs": {
+            "all-disabled": [{"accelerators": ["*"], "partitions": []}],
+            "halves": [{"accelerators": ["*"], "topology": "4x4x4",
+                        "partitions": ["2x4x4", "2x4x4"]}],
+        },
+    }))
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-node-0", accelerator="tpu-v5p-slice", topology="4x4x4")
+        node["metadata"]["labels"][consts.SLICE_CONFIG_LABEL] = "halves"
+        node["metadata"]["labels"][consts.TPU_COUNT_LABEL] = "4"
+        fc.put(node)
+        # a TPU workload pod that must be evicted before reconfig
+        fc.put({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "train", "namespace": "default"},
+            "spec": {"nodeName": "tpu-node-0", "containers": [
+                {"name": "c", "resources": {"limits": {consts.TPU_RESOURCE: "4"}}}]},
+        })
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = SliceManager(client, "tpu-node-0", str(config_file))
+            state = await mgr.sync_once()
+            assert state == "success"
+            node = await client.get("", "Node", "tpu-node-0")
+            assert node["metadata"]["labels"][consts.SLICE_CONFIG_STATE_LABEL] == "success"
+            applied = read_applied()
+            assert applied["profile"] == "halves"
+            assert len(applied["partitions"]) == 2
+            assert applied["partitions"][0]["shape"] == "2x4x4"
+            # workload evicted
+            assert await client.list_items("", "Pod", "default") == []
+            # idempotent: second pass is a no-op
+            assert await mgr.sync_once() is None
+            # editing the ConfigMap under the SAME profile name re-applies
+            config_file.write_text(yaml.safe_dump({
+                "slice-configs": {
+                    "all-disabled": [{"accelerators": ["*"], "partitions": []}],
+                    "halves": [{"accelerators": ["*"], "topology": "4x4x4",
+                                "partitions": ["4x4x1"] * 4}],
+                },
+            }))
+            assert await mgr.sync_once() == "success"
+            assert read_applied()["partitions"][0]["shape"] == "4x4x1"
+
+
+async def test_slice_manager_bad_profile_fails(tmp_path, validation_root):
+    from tpu_operator.agents.slice_manager import SliceManager
+
+    config_file = tmp_path / "config.yaml"
+    config_file.write_text(yaml.safe_dump({
+        "slice-configs": {"bad": [{"accelerators": ["*"], "partitions": ["3x3"]}],
+                          "all-disabled": [{"accelerators": ["*"], "partitions": []}]},
+    }))
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-node-0", topology="2x4")
+        node["metadata"]["labels"][consts.SLICE_CONFIG_LABEL] = "bad"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = SliceManager(client, "tpu-node-0", str(config_file))
+            assert await mgr.sync_once() == "failed"
+            node = await client.get("", "Node", "tpu-node-0")
+            assert node["metadata"]["labels"][consts.SLICE_CONFIG_STATE_LABEL] == "failed"
+
+
+# ---------------------------------------------------------------------------
+# pooled multi-host readiness
+
+
+async def test_pooled_slice_readiness():
+    from tpu_operator.controllers.labels import label_slice_readiness
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        # v5p-64: 4x4x4 = 64 chips, 4 per host → 16 hosts; simulate 2-of-2
+        # visible hosts in pool "pool-a" but slice expects 16 → not ready
+        for i in range(2):
+            node = fc.add_node(f"v5p-{i}", accelerator="tpu-v5p-slice", topology="4x4x4",
+                               labels={consts.GKE_NODEPOOL_LABEL: "pool-a"})
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+        # single-host v5e node: no pooled gate
+        fc.add_node("v5e-0", accelerator="tpu-v5-lite-podslice", topology="2x2")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            nodes = await client.list_items("", "Node")
+            result = await label_slice_readiness(client, nodes)
+            assert result == {"pool-a": False}
+            node = await client.get("", "Node", "v5p-0")
+            assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "false"
+            v5e = await client.get("", "Node", "v5e-0")
+            assert consts.SLICE_READY_LABEL not in v5e["metadata"]["labels"]
+
+        # all 16 hosts up and advertising → ready flips true everywhere
+        for i in range(2, 16):
+            node = fc.add_node(f"v5p-{i}", accelerator="tpu-v5p-slice", topology="4x4x4",
+                               labels={consts.GKE_NODEPOOL_LABEL: "pool-a"})
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            nodes = await client.list_items("", "Node")
+            result = await label_slice_readiness(client, nodes)
+            assert result == {"pool-a": True}
+            node = await client.get("", "Node", "v5p-7")
+            assert node["metadata"]["labels"][consts.SLICE_READY_LABEL] == "true"

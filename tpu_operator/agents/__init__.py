@@ -1,0 +1,6 @@
+"""Node agents: the operand payload binaries.
+
+The reference deploys prebuilt NVIDIA images for these roles (driver manager,
+GFD, DCGM, config-manager, vfio-manager); here each is an in-tree module the
+operand DaemonSets run with ``python -m tpu_operator.agents.<name>``.
+"""

@@ -1,0 +1,54 @@
+"""Shared agent plumbing: signal-aware main loops, duration parsing."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import signal
+
+
+def setup_logging() -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)?$")
+_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0}
+
+
+def parse_duration(text: str) -> float:
+    """'60s' / '5m' / '1.5h' / '30' → seconds (GFD sleepInterval format)."""
+    m = _DURATION_RE.match(text.strip())
+    if not m:
+        raise ValueError(f"invalid duration {text!r}")
+    return float(m.group(1)) * _UNITS[m.group(2)]
+
+
+def stop_event() -> asyncio.Event:
+    """Event set on SIGTERM/SIGINT (kubelet pod shutdown)."""
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    return stop
+
+
+async def run_periodic(fn, interval: float, stop: asyncio.Event, run_immediately: bool = True) -> None:
+    """Call (a)sync ``fn`` every ``interval`` seconds until stop is set."""
+    if run_immediately:
+        result = fn()
+        if asyncio.iscoroutine(result):
+            await result
+    while not stop.is_set():
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=interval)
+        except asyncio.TimeoutError:
+            pass
+        if stop.is_set():
+            break
+        result = fn()
+        if asyncio.iscoroutine(result):
+            await result

@@ -1,0 +1,83 @@
+"""config-manager sidecar: per-node plugin configuration.
+
+Reference analogue: the device-plugin config-manager init+sidecar wiring
+(controllers/object_controls.go:2261-2366) — a ConfigMap holds named configs;
+each node selects one via its ``tpu.google.com/device-plugin.config`` label
+(falling back to DEFAULT_CONFIG); the sidecar materialises the selection at
+/config/config.yaml and keeps it current as the label or ConfigMap changes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from tpu_operator.agents import base
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.config_manager")
+
+NODE_CONFIG_LABEL = "tpu.google.com/device-plugin.config"
+TARGET = "/config/config.yaml"
+
+
+async def sync_once(client: ApiClient, node_name: str, cm_name: str, namespace: str,
+                    default: str, target: str) -> str:
+    node = await client.get("", "Node", node_name)
+    selected = (deep_get(node, "metadata", "labels", default={}) or {}).get(
+        NODE_CONFIG_LABEL, default
+    )
+    cm = await client.get("", "ConfigMap", cm_name, namespace)
+    data = cm.get("data") or {}
+    key = selected if selected in data else f"{selected}.yaml"
+    if key not in data:
+        raise ApiError(404, "NotFound", f"config {selected!r} not in ConfigMap {cm_name}")
+    content = data[key]
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    current = None
+    try:
+        with open(target) as f:
+            current = f.read()
+    except OSError:
+        pass
+    if current != content:
+        with open(target, "w") as f:
+            f.write(content)
+        log.info("wrote config %r (%d bytes) to %s", selected, len(content), target)
+    return selected
+
+
+async def run(oneshot: bool) -> int:
+    node_name = os.environ["NODE_NAME"]
+    cm_name = os.environ["CONFIG_MAP_NAME"]
+    namespace = os.environ.get("OPERATOR_NAMESPACE", "tpu-operator")
+    default = os.environ.get("DEFAULT_CONFIG", "default")
+    target = os.environ.get("CONFIG_TARGET", TARGET)
+    interval = float(os.environ.get("SYNC_INTERVAL_SECONDS", "15"))
+    async with ApiClient(Config.from_env()) as client:
+        if oneshot:
+            await sync_once(client, node_name, cm_name, namespace, default, target)
+            return 0
+        stop = base.stop_event()
+
+        async def tick():
+            try:
+                await sync_once(client, node_name, cm_name, namespace, default, target)
+            except (ApiError, OSError) as e:
+                log.warning("config sync failed: %s", e)
+
+        await base.run_periodic(tick, interval, stop)
+    return 0
+
+
+def main() -> None:
+    import sys
+
+    base.setup_logging()
+    raise SystemExit(asyncio.run(run(oneshot="--oneshot" in sys.argv)))
+
+
+if __name__ == "__main__":
+    main()

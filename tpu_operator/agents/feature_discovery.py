@@ -1,0 +1,125 @@
+"""tpu-feature-discovery: node feature labelling daemon.
+
+Reference analogue: gpu-feature-discovery (assets/gpu-feature-discovery/
+0500_daemonset.yaml) — labels nodes with device properties.  TPU features:
+chip generation, chips-per-host, HBM per chip, ICI topology, slice host
+count, slice worker id, runtime (libtpu) version.
+
+Inputs, most-authoritative first: PJRT device introspection (when chips are
+attachable), GKE node labels, /dev probing, env overrides.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+from tpu_operator import consts, hw
+from tpu_operator.agents import base
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.utils import deep_get, parse_topology, topology_chips
+
+log = logging.getLogger("tpu_operator.tfd")
+
+# accelerator label value → (generation, HBM GiB per chip)
+ACCELERATOR_INFO = {
+    "tpu-v4-podslice": ("v4", 32),
+    "tpu-v5-lite-podslice": ("v5e", 16),
+    "tpu-v5-lite-device": ("v5e", 16),
+    "tpu-v5p-slice": ("v5p", 95),
+    "tpu-v6e-slice": ("v6e", 32),
+    "tpu-v6e-device": ("v6e", 32),
+}
+
+
+def runtime_version() -> str:
+    """libtpu build id: version file dropped by the installer, else the
+    packaged libtpu, else empty."""
+    root = os.environ.get("TPU_HW_ROOT", "/")
+    version_file = os.path.join(root, "home", "kubernetes", "tpu", "version")
+    try:
+        with open(version_file) as f:
+            return f.read().strip()
+    except OSError:
+        pass
+    try:
+        import libtpu  # type: ignore[import-not-found]
+
+        return getattr(libtpu, "__version__", "unknown")
+    except ImportError:
+        return ""
+
+
+def discover_features(node: dict) -> dict[str, str]:
+    """Compute the tpu.google.com/* feature labels for this node."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    gen, hbm = ACCELERATOR_INFO.get(accel, ("unknown", 0))
+    chips = hw.chip_count()
+    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    out = {
+        consts.TFD_CHIP_LABEL: gen,
+        consts.TFD_CHIPS_PER_HOST_LABEL: str(chips),
+    }
+    if hbm:
+        out[consts.TFD_HBM_GB_LABEL] = str(hbm)
+    if topo:
+        out[consts.TFD_ICI_TOPOLOGY_LABEL] = topo
+        try:
+            total = topology_chips(topo)
+            if chips:
+                out[consts.TFD_SLICE_HOSTS_LABEL] = str(max(1, total // chips))
+        except ValueError:
+            pass
+    worker_id = os.environ.get("TPU_WORKER_ID") or labels.get(
+        "cloud.google.com/gke-tpu-worker-id", ""
+    )
+    if worker_id != "":
+        out[consts.TFD_SLICE_WORKER_ID_LABEL] = str(worker_id)
+    version = runtime_version()
+    if version:
+        out[consts.TFD_RUNTIME_VERSION_LABEL] = version
+    return out
+
+
+async def label_node(client: ApiClient, node_name: str) -> dict[str, str]:
+    node = await client.get("", "Node", node_name)
+    features = discover_features(node)
+    current = deep_get(node, "metadata", "labels", default={}) or {}
+    patch = {k: v for k, v in features.items() if current.get(k) != v}
+    if patch:
+        await client.patch("", "Node", node_name, {"metadata": {"labels": patch}})
+        log.info("labelled %s: %s", node_name, patch)
+    return features
+
+
+async def run(oneshot: bool = False) -> None:
+    node_name = os.environ["NODE_NAME"]
+    interval = base.parse_duration(os.environ.get("TFD_SLEEP_INTERVAL", "60s"))
+    stop = base.stop_event()
+    async with ApiClient(Config.from_env()) as client:
+        if oneshot:
+            print(json.dumps(await label_node(client, node_name)))
+            return
+
+        async def tick():
+            try:
+                await label_node(client, node_name)
+            except Exception as e:  # noqa: BLE001 — transient apiserver blips must not crash-loop the DS
+                log.warning("feature labelling failed: %s", e)
+
+        await base.run_periodic(tick, interval, stop)
+
+
+def main() -> None:
+    import sys
+
+    base.setup_logging()
+    asyncio.run(run(oneshot="--oneshot" in sys.argv))
+
+
+if __name__ == "__main__":
+    main()

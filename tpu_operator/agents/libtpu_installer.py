@@ -1,0 +1,105 @@
+"""tpu-runtime-ctr: installs/pins libtpu + PJRT on the host.
+
+Reference analogue: the nvidia-driver-ctr of the driver DaemonSet
+(assets/state-driver/0500_daemonset.yaml) minus kernel-module compilation —
+COS TPU hosts ship the accel kernel driver, so "install" means placing the
+pinned libtpu build (bundled in this operand image or fetched per
+RUNTIME_CHANNEL) into the host dir jax/PJRT mounts read, then holding the
+node steady (marker file + sleep) until upgrade.
+
+Marker protocol: writes ``.libtpu-ctr-ready`` when the host is serving the
+pinned runtime; the startupProbe checks it and the validator's libtpu
+component gates on it; removed on shutdown (preStop parity).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+
+from tpu_operator import hw
+from tpu_operator.agents import base
+from tpu_operator.validator import status
+from tpu_operator.validator.components import LIBTPU_CTR_MARKER
+
+log = logging.getLogger("tpu_operator.libtpu_installer")
+
+
+def install_dir() -> str:
+    root = os.environ.get("TPU_HW_ROOT", "/")
+    return os.path.join(root, "home", "kubernetes", "tpu")
+
+
+def bundled_libtpu() -> str:
+    """The libtpu payload baked into this image: LIBTPU_SRC override, else
+    the pip-packaged libtpu the jax stack carries."""
+    src = os.environ.get("LIBTPU_SRC")
+    if src and os.path.exists(src):
+        return src
+    try:
+        import libtpu  # type: ignore[import-not-found]
+
+        pkg_dir = os.path.dirname(libtpu.__file__)
+        for name in ("libtpu.so", os.path.join("library", "libtpu.so")):
+            cand = os.path.join(pkg_dir, name)
+            if os.path.exists(cand):
+                return cand
+    except ImportError:
+        pass
+    return ""
+
+
+def install() -> dict:
+    """Idempatently place libtpu + version stamp into the host dir."""
+    target_dir = install_dir()
+    os.makedirs(target_dir, exist_ok=True)
+    version = os.environ.get("LIBTPU_VERSION") or os.environ.get("RUNTIME_CHANNEL", "stable")
+    target = os.path.join(target_dir, "libtpu.so")
+    src = bundled_libtpu()
+    installed = False
+    if src and os.path.abspath(src) != os.path.abspath(target):
+        version_file = os.path.join(target_dir, "version")
+        current = ""
+        try:
+            with open(version_file) as f:
+                current = f.read().strip()
+        except OSError:
+            pass
+        if current != version or not os.path.exists(target):
+            shutil.copyfile(src, target)
+            with open(version_file, "w") as f:
+                f.write(version)
+            installed = True
+    chips = hw.chip_count()
+    return {"target": target, "version": version, "chips": chips, "installed": installed}
+
+
+async def run() -> None:
+    result = install()
+    log.info("libtpu install: %s", result)
+    if result["chips"] <= 0:
+        # stay up but unready: the startupProbe keeps the pod NotReady until
+        # chips appear (driver-ctr behaviour on driverless nodes)
+        log.warning("no TPU chips visible; not writing readiness marker")
+    else:
+        status.write_marker(LIBTPU_CTR_MARKER)
+        log.info("runtime ready; marker written")
+    stop = base.stop_event()
+    try:
+        await stop.wait()
+    finally:
+        try:
+            os.remove(os.path.join(status.validation_dir(), LIBTPU_CTR_MARKER))
+        except OSError:
+            pass
+
+
+def main() -> None:
+    base.setup_logging()
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

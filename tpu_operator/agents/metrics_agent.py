@@ -1,0 +1,145 @@
+"""tpu-metrics-agent: host telemetry sampler (DCGM hostengine analogue).
+
+Reference analogue: assets/state-dcgm/0400_dcgm.yml — a standalone agent on a
+hostPort that the exporter scrapes, so multiple consumers share one sampler.
+
+Counter sources, in order: the per-chip libtpu runtime metrics endpoints
+(localhost:8431+i, the ports the device plugin advertises via
+TPU_RUNTIME_METRICS_PORTS), else a zeroed counter set per discovered chip so
+the scrape pipeline stays shape-stable on idle/virtual hosts.
+
+Serves JSON at /counters and Prometheus text at /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from tpu_operator import hw
+from tpu_operator.agents import base
+
+log = logging.getLogger("tpu_operator.metrics_agent")
+
+# canonical counter names (tpu_ prefix mirrors DCGM_FI_* naming discipline)
+COUNTERS = (
+    "tpu_duty_cycle_percent",
+    "tpu_tensorcore_utilization_percent",
+    "tpu_hbm_memory_total_bytes",
+    "tpu_hbm_memory_usage_bytes",
+    "tpu_ici_transmitted_bytes_total",
+    "tpu_ici_received_bytes_total",
+)
+
+
+async def scrape_runtime_endpoint(session: aiohttp.ClientSession, port: int) -> dict:
+    """One chip's libtpu runtime metrics endpoint (Prometheus text)."""
+    out: dict[str, float] = {}
+    async with session.get(f"http://127.0.0.1:{port}/metrics", timeout=aiohttp.ClientTimeout(total=2)) as resp:
+        text = await resp.text()
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        name = name.split("{", 1)[0].strip()
+        if name in COUNTERS:
+            try:
+                out[name] = float(value)
+            except ValueError:
+                pass
+    return out
+
+
+BASE_METRICS_PORT = 8431  # device plugin advertises 8431 + chip_index
+
+
+async def collect() -> dict:
+    """Per-chip counter map {chip_index: {counter: value}}; chip identity is
+    decoded from the port (port - 8431), matching the device plugin's
+    TPU_RUNTIME_METRICS_PORTS contract."""
+    chips = hw.chip_count()
+    ports_env = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
+    ports = [int(p) for p in ports_env.split(",") if p.strip().isdigit()]
+    if not ports:
+        ports = [BASE_METRICS_PORT + i for i in range(chips)]
+    per_chip: dict[int, dict] = {}
+    async with aiohttp.ClientSession() as session:
+        for port in ports:
+            chip = max(0, port - BASE_METRICS_PORT)
+            try:
+                per_chip[chip] = await scrape_runtime_endpoint(session, port)
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                per_chip[chip] = {}
+    # shape-stable zero fill
+    for i in range(chips):
+        per_chip.setdefault(i, {})
+    for chip in per_chip.values():
+        for counter in COUNTERS:
+            chip.setdefault(counter, 0.0)
+    return {"ts": time.time(), "chips": per_chip}
+
+
+def to_prometheus(
+    snapshot: dict,
+    extra_labels: Optional[dict] = None,
+    allow: Optional[set] = None,
+) -> str:
+    """Prometheus text for a counter snapshot; shared with the exporter
+    (extra node labels + counter allowlist)."""
+    prefix = "".join(f'{k}="{v}",' for k, v in (extra_labels or {}).items())
+    lines = []
+    for counter in COUNTERS:
+        if allow is not None and counter not in allow:
+            continue
+        kind = "counter" if counter.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {counter} {kind}")
+        for chip, values in sorted(snapshot.get("chips", {}).items()):
+            lines.append(f'{counter}{{{prefix}chip="{chip}"}} {values.get(counter, 0.0)}')
+    return "\n".join(lines) + "\n"
+
+
+async def serve(port: int, stop: asyncio.Event) -> None:
+    cache: dict = {"snapshot": {"ts": 0, "chips": {}}}
+
+    async def refresh() -> dict:
+        cache["snapshot"] = await collect()
+        return cache["snapshot"]
+
+    async def counters_handler(request: web.Request) -> web.Response:
+        return web.json_response(await refresh())
+
+    async def metrics_handler(request: web.Request) -> web.Response:
+        return web.Response(text=to_prometheus(await refresh()), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/counters", counters_handler)
+    app.router.add_get("/metrics", metrics_handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    log.info("metrics agent on :%d (%d chips)", port, hw.chip_count())
+    try:
+        await stop.wait()
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    base.setup_logging()
+    port = int(os.environ.get("AGENT_PORT", "5555"))
+
+    async def run() -> None:
+        await serve(port, base.stop_event())
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""tpu-metrics-exporter: per-node Prometheus endpoint (DCGM-exporter analogue).
+
+Reference analogue: assets/state-dcgm-exporter/0900_daemonset.yaml + the
+custom-counters ConfigMap wiring (object_controls.go:1373-1395).  Scrapes the
+metrics agent's /counters JSON (AGENT_PORT), filters through the optional
+counter allowlist CSV (METRICS_CONFIG_FILE, dcgm-exporter CSV convention:
+``counter_name, comment``), and re-exports with node/chip labels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from tpu_operator.agents import base
+from tpu_operator.agents.metrics_agent import COUNTERS, collect
+
+log = logging.getLogger("tpu_operator.metrics_exporter")
+
+
+def load_allowlist(path: Optional[str]) -> Optional[set[str]]:
+    """None → all counters; CSV rows 'counter, comment' → that subset."""
+    if not path:
+        return None
+    allow: set[str] = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                allow.add(line.split(",", 1)[0].strip())
+    except OSError as e:
+        log.warning("cannot read metrics config %s: %s; exporting all", path, e)
+        return None
+    return allow or None
+
+
+def render(snapshot: dict, node: str, allow: Optional[set[str]]) -> str:
+    from tpu_operator.agents.metrics_agent import to_prometheus
+
+    return to_prometheus(snapshot, extra_labels={"node": node}, allow=allow)
+
+
+async def fetch_snapshot(agent_port: int) -> dict:
+    """Agent first (shared sampler); direct collection as fallback."""
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{agent_port}/counters",
+                timeout=aiohttp.ClientTimeout(total=2),
+            ) as resp:
+                return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        return await collect()
+
+
+async def serve(port: int, agent_port: int, stop: asyncio.Event) -> None:
+    node = os.environ.get("NODE_NAME", "")
+    allow = load_allowlist(os.environ.get("METRICS_CONFIG_FILE"))
+
+    async def handler(request: web.Request) -> web.Response:
+        snapshot = await fetch_snapshot(agent_port)
+        return web.Response(text=render(snapshot, node, allow), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    log.info("metrics exporter on :%d (agent :%d)", port, agent_port)
+    try:
+        await stop.wait()
+    finally:
+        await runner.cleanup()
+
+
+def main() -> None:
+    base.setup_logging()
+
+    async def run() -> None:
+        await serve(
+            int(os.environ.get("EXPORTER_PORT", "9400")),
+            int(os.environ.get("AGENT_PORT", "5555")),
+            base.stop_event(),
+        )
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""node-status-exporter: validation status files → Prometheus.
+
+Reference analogue: assets/state-node-status-exporter (the node-status-exporter
+image runs the validator binary in metrics mode); here it is a thin main over
+tpu_operator.validator.metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from tpu_operator.agents import base
+from tpu_operator.validator.metrics import serve_metrics
+
+
+def main() -> None:
+    base.setup_logging()
+    port = int(os.environ.get("EXPORTER_PORT", "8000"))
+    interval = float(os.environ.get("SCRAPE_INTERVAL_SECONDS", "5"))
+    asyncio.run(serve_metrics(port, interval=interval))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""runtime-manager init container: safe runtime handover on upgrades.
+
+Reference analogue: k8s-driver-manager (driver DS initContainer,
+manifests/state-driver/0500_daemonset.yaml:74-115) — before the runtime
+container flips to a new version, evict TPU-consuming pods from this node so
+no workload straddles the swap.  No-op unless the operator requested an
+upgrade via the node annotation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from tpu_operator import consts
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.runtime_manager")
+
+
+def pod_requests_tpu(pod: dict) -> bool:
+    """gpuPodSpecFilter analogue (cmd/gpu-operator/main.go:192-214)."""
+    for container in deep_get(pod, "spec", "containers", default=[]) or []:
+        for kind in ("requests", "limits"):
+            resources = deep_get(container, "resources", kind, default={}) or {}
+            if any(r.startswith(consts.TPU_RESOURCE) for r in resources):
+                return True
+    return False
+
+
+async def evict_tpu_pods(client: ApiClient, node_name: str, force: bool, timeout: float) -> int:
+    pods = await client.list_items("", "Pod", field_selector=f"spec.nodeName={node_name}")
+    evicted: dict[tuple, str] = {}  # (ns, name) -> uid of the pod we deleted
+    for pod in pods:
+        if not pod_requests_tpu(pod):
+            continue
+        meta = pod["metadata"]
+        # DaemonSet-owned pods (our own operands) are not evicted
+        refs = meta.get("ownerReferences") or []
+        if any(r.get("kind") == "DaemonSet" for r in refs) and not force:
+            continue
+        await client.delete("", "Pod", meta["name"], meta.get("namespace"))
+        evicted[(meta.get("namespace"), meta["name"])] = meta.get("uid", "")
+        log.info("evicted TPU pod %s/%s", meta.get("namespace"), meta["name"])
+    # wait for the SPECIFIC pods we deleted to be gone (by uid): a DS may
+    # legitimately recreate a same-named pod, and force-deleted DS pods must
+    # still be waited on — the runtime swap cannot straddle them
+    deadline = asyncio.get_event_loop().time() + timeout
+    while evicted and asyncio.get_event_loop().time() < deadline:
+        pods = await client.list_items("", "Pod", field_selector=f"spec.nodeName={node_name}")
+        live = {
+            (p["metadata"].get("namespace"), p["metadata"]["name"]): p["metadata"].get("uid", "")
+            for p in pods
+        }
+        if all(live.get(key) != uid for key, uid in evicted.items()):
+            break
+        await asyncio.sleep(0.5)
+    return len(evicted)
+
+
+async def run() -> int:
+    node_name = os.environ["NODE_NAME"]
+    force = os.environ.get("DRAIN_USE_FORCE", "false").lower() in ("1", "true")
+    timeout = float(os.environ.get("DRAIN_TIMEOUT_SECONDS", "300"))
+    async with ApiClient(Config.from_env()) as client:
+        try:
+            node = await client.get("", "Node", node_name)
+        except ApiError as e:
+            log.error("cannot read node %s: %s", node_name, e)
+            return 1
+        annotations = deep_get(node, "metadata", "annotations", default={}) or {}
+        if annotations.get(consts.UPGRADE_REQUESTED_ANNOTATION) not in ("true", "1"):
+            log.info("no upgrade requested; nothing to do")
+            return 0
+        log.info("upgrade requested on %s; evicting TPU workloads", node_name)
+        evicted = await evict_tpu_pods(client, node_name, force, timeout)
+        # clear the request so the next restart is a plain boot
+        await client.patch(
+            "", "Node", node_name,
+            {"metadata": {"annotations": {consts.UPGRADE_REQUESTED_ANNOTATION: None}}},
+        )
+        log.info("evicted %d pods; upgrade annotation cleared", evicted)
+    return 0
+
+
+def main() -> None:
+    from tpu_operator.agents import base
+
+    base.setup_logging()
+    raise SystemExit(asyncio.run(run()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""tpu-runtime-prep: host preparation (container-toolkit analogue).
+
+Reference analogue: assets/state-container-toolkit/0500_daemonset.yaml — but
+TPU workloads need no containerd runtime rewrite; prep means device-node
+permissions, optional hugepages, and writing runtime-prep-ready for the
+device plugin's init gate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from tpu_operator import hw
+from tpu_operator.agents import base
+from tpu_operator.validator import status
+
+log = logging.getLogger("tpu_operator.runtime_prep")
+
+
+def prep() -> dict:
+    perms = int(os.environ.get("DEVICE_PERMISSIONS", "0666"), 8)
+    fixed = []
+    for path in hw.accel_device_paths() + hw.vfio_device_paths():
+        try:
+            os.chmod(path, perms)
+            fixed.append(path)
+        except OSError as e:
+            log.warning("chmod %s failed: %s", path, e)
+    hugepages = os.environ.get("HUGEPAGES_GB")
+    if hugepages:
+        # 1GiB pages; sysfs path rooted for tests
+        sysfs = os.path.join(
+            hw.hw_root(), "sys", "kernel", "mm", "hugepages", "hugepages-1048576kB"
+        )
+        try:
+            os.makedirs(sysfs, exist_ok=True)
+            with open(os.path.join(sysfs, "nr_hugepages"), "w") as f:
+                f.write(str(int(hugepages)))
+        except OSError as e:
+            log.warning("hugepages setup failed: %s", e)
+    return {"devices": fixed, "permissions": oct(perms)}
+
+
+async def run() -> None:
+    result = prep()
+    log.info("runtime prep: %s", result)
+    status.write_ready("runtime-prep", result)
+    stop = base.stop_event()
+    try:
+        await stop.wait()
+    finally:
+        status.clear("runtime-prep")
+
+
+def main() -> None:
+    base.setup_logging()
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

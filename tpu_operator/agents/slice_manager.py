@@ -1,0 +1,151 @@
+"""tpu-slice-manager: per-node slice reconfiguration daemon.
+
+Reference analogue: MIG manager (assets/state-mig-manager/0600_daemonset.yaml)
+— watches the node's ``nvidia.com/mig.config`` label, drains GPU clients,
+applies the mig-parted profile, reports via ``mig.config.state``.  TPU
+version: watches ``google.com/tpu.slice.config``, resolves the profile
+against the slice-config ConfigMap file, validates it against this node's
+accelerator/topology, evicts TPU pods, materialises the partition layout at
+/run/tpu/slice_config.json (read by the device plugin for mixed-strategy
+resource naming), and reports pending → success/failed via
+``google.com/tpu.slice.config.state``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+import yaml
+
+from tpu_operator import consts, slices
+from tpu_operator.agents import base
+from tpu_operator.agents.runtime_manager import evict_tpu_pods
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.utils import deep_get
+from tpu_operator.validator import status as vstatus
+
+log = logging.getLogger("tpu_operator.slice_manager")
+
+STATE_PENDING = "pending"
+STATE_SUCCESS = "success"
+STATE_FAILED = "failed"
+
+
+def applied_config_path() -> str:
+    return os.path.join(os.path.dirname(vstatus.validation_dir()), "slice_config.json")
+
+
+def read_applied() -> Optional[dict]:
+    try:
+        with open(applied_config_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_applied(payload: dict) -> None:
+    path = applied_config_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+class SliceManager:
+    def __init__(self, client: ApiClient, node_name: str, config_file: str,
+                 default_profile: str = "all-disabled"):
+        self.client = client
+        self.node_name = node_name
+        self.config_file = config_file
+        self.default_profile = default_profile
+
+    async def set_state(self, value: str) -> None:
+        await self.client.patch(
+            "", "Node", self.node_name,
+            {"metadata": {"labels": {consts.SLICE_CONFIG_STATE_LABEL: value}}},
+        )
+
+    def load_config(self) -> dict:
+        with open(self.config_file) as f:
+            return yaml.safe_load(f) or {}
+
+    async def sync_once(self) -> Optional[str]:
+        """One reconcile pass; returns the new state label or None (no-op)."""
+        node = await self.client.get("", "Node", self.node_name)
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        profile = labels.get(consts.SLICE_CONFIG_LABEL, self.default_profile)
+
+        try:
+            accelerator = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+            topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+            try:
+                chips_per_host = int(labels.get(consts.TPU_COUNT_LABEL, "4") or "4")
+            except ValueError:
+                chips_per_host = 4
+
+            # resolve the desired layout FIRST so idempotency compares the
+            # actual partitions, not just the profile name (a ConfigMap edit
+            # under the same name must re-apply)
+            config = self.load_config()
+            shapes = slices.load_profile(config, profile, accelerator, topology)
+            if shapes:
+                if not topology:
+                    raise slices.PartitionError("node has no ICI topology label")
+                layout = slices.chip_assignments(topology, shapes, chips_per_host)
+            else:
+                layout = []  # whole-slice default
+            desired = {"profile": profile, "topology": topology, "partitions": layout}
+
+            if read_applied() == desired:
+                if labels.get(consts.SLICE_CONFIG_STATE_LABEL) != STATE_SUCCESS:
+                    await self.set_state(STATE_SUCCESS)
+                    return STATE_SUCCESS
+                return None
+
+            log.info("applying slice profile %r (topology %s)", profile, topology)
+            await self.set_state(STATE_PENDING)
+            # MIG semantics: clients must be off the chips during reconfig
+            await evict_tpu_pods(self.client, self.node_name, force=False, timeout=30)
+            write_applied(desired)
+            await self.set_state(STATE_SUCCESS)
+            log.info("profile %r applied: %d partitions", profile, len(layout))
+            return STATE_SUCCESS
+        except (slices.PartitionError, ApiError, OSError, ValueError) as e:
+            log.error("slice config failed: %s", e)
+            await self.set_state(STATE_FAILED)
+            return STATE_FAILED
+
+
+async def run(oneshot: bool = False) -> None:
+    node_name = os.environ["NODE_NAME"]
+    config_file = os.environ.get("SLICE_CONFIG_FILE", "/slice-config/config.yaml")
+    default_profile = os.environ.get("DEFAULT_SLICE_CONFIG", "all-disabled")
+    interval = float(os.environ.get("SYNC_INTERVAL_SECONDS", "15"))
+    async with ApiClient(Config.from_env()) as client:
+        mgr = SliceManager(client, node_name, config_file, default_profile)
+        if oneshot:
+            await mgr.sync_once()
+            return
+        stop = base.stop_event()
+
+        async def tick():
+            try:
+                await mgr.sync_once()
+            except (ApiError, OSError) as e:
+                log.warning("slice sync failed: %s", e)
+
+        await base.run_periodic(tick, interval, stop)
+
+
+def main() -> None:
+    import sys
+
+    base.setup_logging()
+    asyncio.run(run(oneshot="--oneshot" in sys.argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""tpu-vfio-manager: bind TPU accel devices to vfio for VM passthrough.
+
+Reference analogue: assets/state-vfio-manager/0500_daemonset.yaml (NVIDIA's
+vfio-manage script binding GPUs to vfio-pci).  On a real host this writes the
+PCI driver override + bind sysfs files; both paths are rooted at TPU_HW_ROOT
+so the flow is testable and safe off-hardware.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from tpu_operator import hw
+from tpu_operator.agents import base
+
+log = logging.getLogger("tpu_operator.vfio_manager")
+
+
+def tpu_pci_addresses() -> list[str]:
+    """TPU PCI functions: sysfs scan under the hw root (vendor 0x1ae0 Google)."""
+    root = hw.hw_root()
+    devices_dir = os.path.join(root, "sys", "bus", "pci", "devices")
+    out = []
+    try:
+        entries = sorted(os.listdir(devices_dir))
+    except OSError:
+        return []
+    for addr in entries:
+        vendor_path = os.path.join(devices_dir, addr, "vendor")
+        try:
+            with open(vendor_path) as f:
+                if f.read().strip().lower() == "0x1ae0":
+                    out.append(addr)
+        except OSError:
+            continue
+    return out
+
+
+def bind_to_vfio(addr: str) -> bool:
+    """driver_override + bind; emulates the kernel by materialising the vfio
+    group node when running rooted (tests/virtual hosts)."""
+    root = hw.hw_root()
+    dev_dir = os.path.join(root, "sys", "bus", "pci", "devices", addr)
+    try:
+        with open(os.path.join(dev_dir, "driver_override"), "w") as f:
+            f.write("vfio-pci")
+        probe = os.path.join(root, "sys", "bus", "pci", "drivers_probe")
+        with open(probe, "w") as f:
+            f.write(addr)
+    except OSError as e:
+        log.error("vfio bind %s failed: %s", addr, e)
+        return False
+    if root != "/":
+        # no kernel to create the group node in rooted mode; materialise it
+        group = os.path.join(root, "dev", "vfio", str(len(hw.vfio_device_paths())))
+        os.makedirs(os.path.dirname(group), exist_ok=True)
+        open(group, "w").close()
+    return True
+
+
+async def run() -> None:
+    addrs = tpu_pci_addresses()
+    bound = [a for a in addrs if bind_to_vfio(a)]
+    log.info("bound %d/%d TPU PCI devices to vfio", len(bound), len(addrs))
+    stop = base.stop_event()
+    await stop.wait()
+
+
+def main() -> None:
+    base.setup_logging()
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

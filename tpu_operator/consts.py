@@ -28,6 +28,11 @@ TPU_WORKLOAD_CONFIG_LABEL = "tpu.google.com/tpu.workload.config"  # container | 
 SLICE_CONFIG_LABEL = "google.com/tpu.slice.config"
 SLICE_CONFIG_STATE_LABEL = "google.com/tpu.slice.config.state"  # pending|success|failed|rebooting
 UPGRADE_STATE_LABEL = "tpu.google.com/tpu-runtime-upgrade-state"
+# Pooled multi-host readiness: slice readiness is a SET property — every host
+# of the slice must advertise capacity before any host is marked ready
+# (SURVEY §7 hard part 1; no reference analogue, GPUs are node-local).
+SLICE_READY_LABEL = "tpu.google.com/tpu.slice.ready"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
 # Per-operand deployment gate labels (gpuStateLabels analogue,
 # controllers/state_manager.go:90-115).  Value "true" ⇒ operand DS schedules.

@@ -80,6 +80,7 @@ class ClusterPolicyReconciler:
         nodes = await self.client.list_items("", "Node")
         ctx = await clusterinfo.gather(self.client, self.namespace, nodes=nodes)
         ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec, nodes=nodes)
+        await labels.label_slice_readiness(self.client, nodes)
         self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
         self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
 

@@ -73,6 +73,7 @@ def desired_node_labels(node: dict, spec: TPUClusterPolicySpec) -> dict[str, Opt
     if not is_tpu_node(node):
         out[consts.TPU_PRESENT_LABEL] = None
         out[consts.TPU_COUNT_LABEL] = None
+        out[consts.SLICE_READY_LABEL] = None
         for key in all_deploy_keys:
             out[consts.DEPLOY_LABEL_PREFIX + key] = None
         return out
@@ -88,6 +89,74 @@ def desired_node_labels(node: dict, spec: TPUClusterPolicySpec) -> dict[str, Opt
     for key in all_deploy_keys:
         out[consts.DEPLOY_LABEL_PREFIX + key] = "true" if key in active else None
     return out
+
+
+def slice_group_key(node: dict) -> Optional[str]:
+    """Multi-host slice membership key.
+
+    GKE schedules one multi-host slice per node pool, so the nodepool label
+    is the slice identity; single-host topologies return None (no pooled
+    gate needed)."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL)
+    if not topo:
+        return None
+    try:
+        total = topology_chips(topo)
+    except ValueError:
+        return None
+    if total <= chips_per_host(node):
+        return None  # single host holds the whole slice
+    # Without a nodepool label, slice identity is unknowable — two distinct
+    # same-topology slices would merge into one group and cross-contaminate
+    # readiness.  No gate is safer than a wrong gate.
+    return labels.get(consts.GKE_NODEPOOL_LABEL)
+
+
+def node_advertises_tpu(node: dict) -> bool:
+    alloc = deep_get(node, "status", "allocatable", default={}) or {}
+    try:
+        return int(alloc.get(consts.TPU_RESOURCE, "0")) > 0
+    except ValueError:
+        return False
+
+
+async def label_slice_readiness(
+    client: ApiClient, nodes: list[dict]
+) -> dict[str, bool]:
+    """Pooled readiness: every host of a multi-host slice must advertise
+    google.com/tpu before ANY host gets slice.ready=true.  Returns
+    {group: ready}."""
+    groups: dict[str, list[dict]] = {}
+    for node in nodes:
+        if not is_tpu_node(node):
+            continue
+        key = slice_group_key(node)
+        if key is not None:
+            groups.setdefault(key, []).append(node)
+
+    result: dict[str, bool] = {}
+    for key, members in groups.items():
+        labels_of = {m["metadata"]["name"]: (deep_get(m, "metadata", "labels", default={}) or {}) for m in members}
+        expected = 0
+        for m in members:
+            topo = labels_of[m["metadata"]["name"]].get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+            try:
+                expected = max(expected, topology_chips(topo) // max(1, chips_per_host(m)))
+            except ValueError:
+                pass
+        ready = len(members) >= max(1, expected) and all(
+            node_advertises_tpu(m) for m in members
+        )
+        result[key] = ready
+        value = "true" if ready else "false"
+        for m in members:
+            if labels_of[m["metadata"]["name"]].get(consts.SLICE_READY_LABEL) != value:
+                await client.patch(
+                    "", "Node", m["metadata"]["name"],
+                    {"metadata": {"labels": {consts.SLICE_READY_LABEL: value}}},
+                )
+    return result
 
 
 async def label_tpu_nodes(

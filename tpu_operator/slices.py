@@ -1,0 +1,137 @@
+"""ICI topology partitioning.
+
+Reference analogue: MIG device partitioning — mig-parted profiles
+(assets/state-mig-manager/0400_configmap.yaml) splitting one GPU into typed
+slices.  The TPU analogue splits an ICI mesh (e.g. v5p 4x4x4) into
+sub-slices: each partition is an axis-aligned box of chips, the whole set
+must tile the mesh exactly, and every box must be contiguous so intra-slice
+traffic stays on ICI.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+
+from tpu_operator.utils import parse_topology, topology_chips
+
+
+class PartitionError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Partition:
+    shape: tuple[int, ...]
+    origin: tuple[int, ...]
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    def coords(self) -> list[tuple[int, ...]]:
+        ranges = [range(o, o + s) for o, s in zip(self.origin, self.shape)]
+        return [tuple(c) for c in itertools.product(*ranges)]
+
+
+def _fits(shape: tuple[int, ...], mesh: tuple[int, ...]) -> bool:
+    return len(shape) == len(mesh) and all(s <= m and m % s == 0 for s, m in zip(shape, mesh))
+
+
+def partition_topology(topology: str, shapes: list[str]) -> list[Partition]:
+    """Place ``shapes`` (e.g. ["2x4x4", "2x4x4"]) into ``topology`` (4x4x4).
+
+    Greedy first-fit over the mesh in lexicographic order; raises
+    PartitionError unless the shapes exactly tile the mesh (MIG semantics:
+    a profile either fits the device exactly or is rejected — no partial
+    layouts).
+    """
+    mesh = parse_topology(topology)
+    want = [parse_topology(s) for s in shapes]
+    if not want:
+        return []
+    total = sum(topology_chips(s) for s in shapes)
+    if total != topology_chips(topology):
+        raise PartitionError(
+            f"shapes {shapes} cover {total} chips; topology {topology} has "
+            f"{topology_chips(topology)}"
+        )
+    for shape in want:
+        if not _fits(shape, mesh):
+            raise PartitionError(f"shape {'x'.join(map(str, shape))} does not tile {topology}")
+
+    occupied: set[tuple[int, ...]] = set()
+    placed: list[Partition] = []
+
+    def all_coords():
+        return itertools.product(*[range(m) for m in mesh])
+
+    # big boxes first → greedy packing succeeds for axis-divisible tilings
+    for shape in sorted(want, key=lambda s: -topology_chips("x".join(map(str, s)))):
+        placed_one = False
+        for origin in all_coords():
+            if any(o + s > m for o, s, m in zip(origin, shape, mesh)):
+                continue
+            part = Partition(shape=shape, origin=origin)
+            coords = part.coords()
+            if any(c in occupied for c in coords):
+                continue
+            occupied.update(coords)
+            placed.append(part)
+            placed_one = True
+            break
+        if not placed_one:
+            raise PartitionError(f"cannot place {'x'.join(map(str, shape))} in {topology}")
+    return placed
+
+
+def chip_assignments(topology: str, shapes: list[str], chips_per_host: int) -> list[dict]:
+    """Partition layout with flat chip ids + owning hosts.
+
+    Chips are numbered in row-major mesh order; host h owns chips
+    [h*chips_per_host, (h+1)*chips_per_host).  Returns one dict per
+    partition: {shape, origin, chip_ids, hosts}.
+    """
+    mesh = parse_topology(topology)
+    parts = partition_topology(topology, shapes)
+
+    strides = [1] * len(mesh)
+    for i in range(len(mesh) - 2, -1, -1):
+        strides[i] = strides[i + 1] * mesh[i + 1]
+
+    out = []
+    for part in parts:
+        ids = sorted(sum(c * s for c, s in zip(coord, strides)) for coord in part.coords())
+        hosts = sorted({i // chips_per_host for i in ids}) if chips_per_host else []
+        out.append(
+            {
+                "shape": "x".join(map(str, part.shape)),
+                "origin": list(part.origin),
+                "chip_ids": ids,
+                "hosts": hosts,
+            }
+        )
+    return out
+
+
+def load_profile(config: dict, profile: str, accelerator: str, topology: str) -> list[str]:
+    """Resolve a named profile from the slice-config ConfigMap schema
+    (assets/state-slice-manager/0400_configmap.yaml) to partition shapes for
+    this node's accelerator/topology.  Empty list → whole-slice default."""
+    profiles = config.get("slice-configs") or {}
+    if profile not in profiles:
+        raise PartitionError(f"unknown slice profile {profile!r}")
+    for rule in profiles[profile]:
+        accels = rule.get("accelerators") or ["*"]
+        if "*" not in accels and accelerator not in accels:
+            continue
+        rule_topo = rule.get("topology")
+        if rule_topo and rule_topo != topology:
+            continue
+        return list(rule.get("partitions") or [])
+    raise PartitionError(
+        f"profile {profile!r} has no rule for accelerator={accelerator} topology={topology}"
+    )
