@@ -1,0 +1,128 @@
+"""TPURuntime per-pool reconciler tests (nvidiadriver_controller analogue)."""
+
+import asyncio
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import GROUP, State, TPUClusterPolicy, TPURuntime
+from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.state.nodepool import get_node_pools, hashed_name
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+def test_node_pools_partitioning():
+    nodes = [
+        {"metadata": {"labels": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                                 consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}}},
+        {"metadata": {"labels": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                                 consts.GKE_TPU_TOPOLOGY_LABEL: "2x4"}}},
+        {"metadata": {"labels": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+                                 consts.GKE_TPU_TOPOLOGY_LABEL: "4x4x4"}}},
+        {"metadata": {"labels": {}}},  # non-TPU
+    ]
+    pools = get_node_pools(nodes)
+    assert [(p.name, p.node_count) for p in pools] == [
+        ("v5-lite-2x4", 2), ("v5p-4x4x4", 1),
+    ]
+    assert pools[0].selector[consts.GKE_TPU_TOPOLOGY_LABEL] == "2x4"
+    # selector filtering
+    pools = get_node_pools(nodes, {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"})
+    assert len(pools) == 1 and pools[0].accelerator == "tpu-v5p-slice"
+
+
+def test_hashed_name_cap():
+    short = hashed_name("tpu-runtime-a", "pool")
+    assert short == "tpu-runtime-a-pool"
+    long = hashed_name("tpu-runtime-" + "x" * 70, "pool")
+    assert len(long) == 63
+    assert long != hashed_name("tpu-runtime-" + "x" * 71, "pool")
+
+
+async def _setup(fc, use_crd=True):
+    client = ApiClient(Config(base_url=fc.base_url))
+    await client.create(
+        TPUClusterPolicy.new(spec={"libtpu": {"useTpuRuntimeCrd": use_crd}}).obj
+    )
+    return client
+
+
+async def test_per_pool_daemonsets_and_stale_cleanup():
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        # deploy gate labels must be present for DS scheduling
+        for i in range(2):
+            fc.add_node(f"v5e-{i}", accelerator="tpu-v5-lite-podslice", topology="2x4",
+                        labels={consts.DEPLOY_LABEL_PREFIX + "libtpu": "true"})
+        fc.add_node("v5p-0", accelerator="tpu-v5p-slice", topology="4x4x4",
+                    labels={consts.DEPLOY_LABEL_PREFIX + "libtpu": "true"})
+        client = await _setup(fc)
+        try:
+            await client.create(TPURuntime.new("main", spec={"libtpuVersion": "v1"}).obj)
+            reconciler = TPURuntimeReconciler(client, NS)
+            for _ in range(40):
+                await reconciler.reconcile("main")
+                obj = await client.get(GROUP, "TPURuntime", "main")
+                if deep_get(obj, "status", "state") == State.READY:
+                    break
+                await asyncio.sleep(0.05)
+            assert deep_get(obj, "status", "state") == State.READY
+            ds_names = {
+                d["metadata"]["name"] for d in await client.list_items("apps", "DaemonSet", NS)
+            }
+            assert "tpu-runtime-main-v5-lite-2x4" in ds_names
+            assert "tpu-runtime-main-v5p-4x4x4" in ds_names
+            # pool DS targets only its nodes
+            ds = await client.get("apps", "DaemonSet", "tpu-runtime-main-v5p-4x4x4", NS)
+            sel = deep_get(ds, "spec", "template", "spec", "nodeSelector")
+            assert sel[consts.GKE_TPU_ACCELERATOR_LABEL] == "tpu-v5p-slice"
+            assert sel[consts.DEPLOY_LABEL_PREFIX + "libtpu"] == "true"
+
+            # v5p node leaves → its pool DS cleaned up
+            await client.delete("", "Node", "v5p-0")
+            for _ in range(40):
+                await reconciler.reconcile("main")
+                ds_names = {
+                    d["metadata"]["name"]
+                    for d in await client.list_items("apps", "DaemonSet", NS)
+                }
+                if "tpu-runtime-main-v5p-4x4x4" not in ds_names:
+                    break
+                await asyncio.sleep(0.05)
+            assert "tpu-runtime-main-v5p-4x4x4" not in ds_names
+            assert "tpu-runtime-main-v5-lite-2x4" in ds_names
+        finally:
+            await client.close()
+
+
+async def test_selector_conflict_detection():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("v5e-0", accelerator="tpu-v5-lite-podslice", topology="2x4")
+        client = await _setup(fc)
+        try:
+            await client.create(TPURuntime.new("a", spec={}).obj)  # matches all
+            await client.create(TPURuntime.new("b", spec={}).obj)  # matches all → conflict
+            reconciler = TPURuntimeReconciler(client, NS)
+            await reconciler.reconcile("b")
+            obj = await client.get(GROUP, "TPURuntime", "b")
+            assert deep_get(obj, "status", "state") == State.NOT_READY
+            assert "overlaps" in obj["status"]["conditions"][0]["message"]
+        finally:
+            await client.close()
+
+
+async def test_ignored_when_crd_mode_off():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("v5e-0")
+        client = await _setup(fc, use_crd=False)
+        try:
+            await client.create(TPURuntime.new("main", spec={}).obj)
+            reconciler = TPURuntimeReconciler(client, NS)
+            assert await reconciler.reconcile("main") is None
+            obj = await client.get(GROUP, "TPURuntime", "main")
+            assert deep_get(obj, "status", "state") == State.IGNORED
+        finally:
+            await client.close()

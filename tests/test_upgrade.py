@@ -1,0 +1,178 @@
+"""Upgrade state-machine tests (upgrade_controller + k8s-operator-libs analogue)."""
+
+import asyncio
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.controllers import upgrade as up
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+def test_parse_max_unavailable():
+    assert up.parse_max_unavailable("25%", 16) == 4
+    assert up.parse_max_unavailable("2", 16) == 2
+    assert up.parse_max_unavailable("10%", 4) == 1  # floor but ≥1
+    assert up.parse_max_unavailable(None, 4) == 4
+    assert up.parse_max_unavailable("garbage", 4) == 1
+
+
+async def _mk_cluster(fc, n_nodes=3, desired="v2", current="v1", auto=True,
+                      max_parallel=1, max_unavailable="50%"):
+    client = ApiClient(Config(base_url=fc.base_url))
+    await client.create(TPUClusterPolicy.new(spec={
+        "libtpu": {"libtpuVersion": desired,
+                   "upgradePolicy": {"autoUpgrade": auto,
+                                     "maxParallelUpgrades": max_parallel,
+                                     "maxUnavailable": max_unavailable,
+                                     "drain": {"enable": True, "timeoutSeconds": 1}}},
+    }).obj)
+    for i in range(n_nodes):
+        node = fc.add_node(f"tpu-{i}")
+        node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = current
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        fc.put(node)
+    return client
+
+
+def _runtime_pod(fc, node_name, phase="Running"):
+    fc.put({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"tpu-runtime-{node_name}", "namespace": NS,
+                     "labels": {"app": "tpu-runtime"}},
+        "spec": {"nodeName": node_name, "containers": [{"name": "c"}]},
+        "status": {"phase": phase},
+    })
+
+
+async def test_full_upgrade_lifecycle_single_node():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        _runtime_pod(fc, "tpu-0")
+        try:
+            r = up.UpgradeReconciler(client, NS)
+
+            async def state():
+                node = await client.get("", "Node", "tpu-0")
+                return node["metadata"]["labels"].get(consts.UPGRADE_STATE_LABEL, "")
+
+            await r.reconcile("upgrade")  # required → cordon → drain step runs next pass
+            assert await state() in (up.DRAIN, up.POD_RESTART, up.CORDON)
+            for _ in range(3):
+                await r.reconcile("upgrade")
+            # pod was deleted for the swap; node annotated
+            node = await client.get("", "Node", "tpu-0")
+            assert deep_get(node, "spec", "unschedulable") is True
+            pods = await client.list_items("", "Pod", NS)
+            assert pods == []  # runtime pod deleted, sim off so not recreated
+            assert await state() == up.POD_RESTART
+
+            # runtime pod comes back Running with NEW version → validation
+            _runtime_pod(fc, "tpu-0")
+            await r.reconcile("upgrade")
+            assert await state() == up.VALIDATION
+            # version still old → stays in validation
+            await r.reconcile("upgrade")
+            assert await state() == up.VALIDATION
+            node = await client.get("", "Node", "tpu-0")
+            node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = "v2"
+            fc.put(node)
+            await r.reconcile("upgrade")
+            assert await state() == up.UNCORDON
+            await r.reconcile("upgrade")
+            assert await state() == up.DONE
+            node = await client.get("", "Node", "tpu-0")
+            assert not deep_get(node, "spec", "unschedulable")
+        finally:
+            await client.close()
+
+
+async def test_done_node_re_upgrades_on_new_version():
+    """upgrade-done nodes must re-enter the pipeline when a newer version is
+    pinned (v2 done → v3 pinned → required again)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1, desired="v2", current="v2")
+        try:
+            node = await client.get("", "Node", "tpu-0")
+            node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = up.DONE
+            fc.put(node)
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.DONE
+
+            cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+            cr["spec"]["libtpu"]["libtpuVersion"] = "v3"
+            await client.update(cr)
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] in (
+                up.REQUIRED, *up.IN_PROGRESS_STATES,
+            )
+        finally:
+            await client.close()
+
+
+async def test_max_parallel_bound():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=4, max_parallel=2, max_unavailable="100%")
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            nodes = await client.list_items("", "Node")
+            states = [n["metadata"]["labels"].get(consts.UPGRADE_STATE_LABEL) for n in nodes]
+            assert sum(1 for s in states if s in up.IN_PROGRESS_STATES) == 2
+            assert sum(1 for s in states if s == up.REQUIRED) == 2
+        finally:
+            await client.close()
+
+
+async def test_up_to_date_nodes_untouched():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=2, desired="v1", current="v1")
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            nodes = await client.list_items("", "Node")
+            assert all(
+                consts.UPGRADE_STATE_LABEL not in n["metadata"]["labels"] for n in nodes
+            )
+        finally:
+            await client.close()
+
+
+async def test_disable_clears_labels():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert consts.UPGRADE_STATE_LABEL in node["metadata"]["labels"]
+            # flip auto-upgrade off
+            cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+            cr["spec"]["libtpu"]["upgradePolicy"]["autoUpgrade"] = False
+            await client.update(cr)
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert consts.UPGRADE_STATE_LABEL not in node["metadata"]["labels"]
+        finally:
+            await client.close()
+
+
+async def test_metrics_reported():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=3, max_parallel=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            assert r.metrics.upgrades_in_progress._value.get() == 1
+            assert r.metrics.upgrades_pending._value.get() == 2
+            assert r.metrics.auto_upgrade_enabled._value.get() == 1
+        finally:
+            await client.close()

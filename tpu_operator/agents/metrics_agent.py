@@ -104,11 +104,14 @@ def to_prometheus(
     return "\n".join(lines) + "\n"
 
 
-async def serve(port: int, stop: asyncio.Event) -> None:
-    cache: dict = {"snapshot": {"ts": 0, "chips": {}}}
+async def serve(port: int, stop: asyncio.Event, cache_ttl: float = 1.0) -> None:
+    # shared-sampler contract: concurrent scrapers within the TTL reuse one
+    # collection instead of re-hitting every per-chip runtime endpoint
+    cache: dict = {"snapshot": {"ts": 0.0, "chips": {}}}
 
     async def refresh() -> dict:
-        cache["snapshot"] = await collect()
+        if time.time() - cache["snapshot"]["ts"] >= cache_ttl:
+            cache["snapshot"] = await collect()
         return cache["snapshot"]
 
     async def counters_handler(request: web.Request) -> web.Response:

@@ -37,6 +37,24 @@ def runtime_of(node: dict) -> str:
     return version.split("://", 1)[0] if "://" in version else ""
 
 
+async def active_cluster_policy(client: ApiClient) -> Optional[dict]:
+    """Singleton election: the oldest TPUClusterPolicy wins (creationTimestamp,
+    then name — clusterpolicy_controller.go:121-126).  Shared by all three
+    reconcilers."""
+    from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP
+
+    items = await client.list_items(GROUP, CLUSTER_POLICY_KIND)
+    if not items:
+        return None
+    return min(
+        items,
+        key=lambda o: (
+            deep_get(o, "metadata", "creationTimestamp", default=""),
+            deep_get(o, "metadata", "name", default=""),
+        ),
+    )
+
+
 async def gather(client: ApiClient, namespace: str, nodes: Optional[list[dict]] = None) -> ClusterContext:
     if nodes is None:
         nodes = await client.list_items("", "Node")

@@ -63,17 +63,9 @@ class ClusterPolicyReconciler:
 
         policy = TPUClusterPolicy.from_obj(obj)
 
-        # Singleton guard: oldest CR wins; later ones are Ignored
-        # (clusterpolicy_controller.go:121-126).
-        all_crs = await self.client.list_items(GROUP, CLUSTER_POLICY_KIND)
-        oldest = min(
-            all_crs,
-            key=lambda o: (
-                deep_get(o, "metadata", "creationTimestamp", default=""),
-                deep_get(o, "metadata", "name", default=""),
-            ),
-        )
-        if oldest["metadata"]["name"] != name:
+        # Singleton guard: oldest CR wins; later ones are Ignored.
+        oldest = await clusterinfo.active_cluster_policy(self.client)
+        if oldest is None or oldest["metadata"]["name"] != name:
             await self._update_status(policy, State.IGNORED, "another TPUClusterPolicy is active")
             return None
 
@@ -116,8 +108,11 @@ class ClusterPolicyReconciler:
         return None
 
     async def _update_status(self, policy: TPUClusterPolicy, state: str, message: str) -> None:
+        import copy
+
         generation = deep_get(policy.obj, "metadata", "generation")
-        old_status = dict(policy.obj.get("status") or {})
+        # deep copy: set_condition mutates the nested conditions list in place
+        old_status = copy.deepcopy(policy.obj.get("status") or {})
         policy.set_state(state, self.namespace)
         if state == State.READY:
             conditions.set_ready(policy.status, generation=generation)

@@ -1,0 +1,258 @@
+"""TPURuntime reconciler — per-node-pool runtime management.
+
+Reference analogue: controllers/nvidiadriver_controller.go (:75-205) +
+internal/state/driver.go — the newer declarative path where each TPURuntime
+CR manages the runtime DaemonSet(s) for the node pools its nodeSelector
+matches, letting different pools pin different libtpu builds.  Includes the
+cross-CR nodeSelector conflict validation (internal/validator/validator.go:
+47-69: at most one runtime CR may match a node) and stale-DS cleanup
+(driver.go:173-198).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api import conditions
+from tpu_operator.api.types import (
+    CLUSTER_POLICY_KIND,
+    GROUP,
+    State,
+    TPU_RUNTIME_KIND,
+    TPUClusterPolicy,
+    TPURuntime,
+)
+from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s.apply import create_or_update
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.render import Renderer, new_renderer
+from tpu_operator.state.nodepool import NodePool, get_node_pools, hashed_name
+from tpu_operator.state.render_data import ClusterContext, state_def
+from tpu_operator.state.skel import daemonset_ready
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.tpuruntime")
+
+STATE_LABEL_VALUE = "tpu-runtime-cr"  # distinct from state-libtpu's label
+
+
+class TPURuntimeReconciler:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        renderer: Optional[Renderer] = None,
+        metrics: Optional[OperatorMetrics] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.renderer = renderer or new_renderer()
+        self.metrics = metrics or OperatorMetrics()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, name: str) -> Optional[float]:
+        try:
+            obj = await self.client.get(GROUP, TPU_RUNTIME_KIND, name)
+        except ApiError as e:
+            if e.not_found:
+                return None
+            raise
+        runtime = TPURuntime(obj)
+
+        policy = await self._cluster_policy()
+        if policy is None or not policy.spec.libtpu.use_tpu_runtime_crd:
+            # CRD path disabled: ignore but keep status honest
+            await self._update_status(
+                runtime, State.IGNORED,
+                "libtpu.useTpuRuntimeCrd is disabled in the TPUClusterPolicy",
+            )
+            return None
+
+        conflicts = await self._selector_conflicts(runtime)
+        if conflicts:
+            await self._update_status(
+                runtime, State.NOT_READY,
+                f"nodeSelector overlaps other TPURuntime CRs on nodes: {conflicts[:3]}",
+            )
+            return consts.REQUEUE_NOT_READY_SECONDS
+
+        nodes = await self.client.list_items("", "Node")
+        pools = get_node_pools(nodes, runtime.spec.node_selector)
+        desired_ds: set[str] = set()
+        all_ready = True
+        for pool in pools:
+            ds_name = hashed_name(f"tpu-runtime-{runtime.name}", pool.name)
+            desired_ds.add(ds_name)
+            ready = await self._sync_pool(runtime, policy, pool, ds_name)
+            all_ready = all_ready and ready
+
+        await self._cleanup_stale(runtime, desired_ds)
+
+        if not pools:
+            await self._update_status(runtime, State.READY, "no nodes match; nothing to manage")
+            return consts.REQUEUE_NO_TPU_NODES_SECONDS
+        if not all_ready:
+            await self._update_status(runtime, State.NOT_READY, "runtime DaemonSets not ready")
+            return consts.REQUEUE_NOT_READY_SECONDS
+        await self._update_status(runtime, State.READY, "")
+        return None
+
+    # ------------------------------------------------------------------
+    async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
+        obj = await clusterinfo.active_cluster_policy(self.client)
+        return TPUClusterPolicy(obj) if obj else None
+
+    async def _selector_conflicts(self, runtime: TPURuntime) -> list[str]:
+        """Nodes matched by this CR AND another CR (validator.go:47-69)."""
+        others = [
+            TPURuntime(o)
+            for o in await self.client.list_items(GROUP, TPU_RUNTIME_KIND)
+            if o["metadata"]["name"] != runtime.name
+        ]
+        if not others:
+            return []
+        nodes = await self.client.list_items("", "Node")
+        mine = runtime.spec.node_selector
+        conflicts = []
+        for node in nodes:
+            labels = deep_get(node, "metadata", "labels", default={}) or {}
+            if consts.GKE_TPU_ACCELERATOR_LABEL not in labels:
+                continue
+            if mine and any(labels.get(k) != v for k, v in mine.items()):
+                continue
+            for other in others:
+                sel = other.spec.node_selector
+                if not sel or all(labels.get(k) == v for k, v in sel.items()):
+                    conflicts.append(node["metadata"]["name"])
+                    break
+        return conflicts
+
+    def _render_pool_objects(
+        self, runtime: TPURuntime, policy: TPUClusterPolicy, pool: NodePool, ds_name: str
+    ) -> list[dict]:
+        """Render the state-libtpu templates with this CR's spec overriding
+        the policy-level libtpu spec, then re-target the DaemonSet at the
+        pool (per-pool name + nodeSelector)."""
+        spec = runtime.spec
+        sdef = state_def("state-libtpu")
+        ctx = ClusterContext(namespace=self.namespace, tpu_node_count=pool.node_count)
+        data = sdef.render_data(ctx, policy.spec)
+        data["operand"] = {
+            "name": "libtpu",
+            "image": spec.image_path() if (spec.image or spec.repository) else data["operand"]["image"],
+            "pull_policy": spec.image_pull_policy,
+            "args": list(spec.args),
+            "env": list(spec.env),
+            "resources": spec.resources,
+        }
+        data["libtpu"] = {
+            "libtpu_version": spec.libtpu_version,
+            "runtime_channel": spec.runtime_channel,
+            "drain_force": str(spec.upgrade_policy.drain.force).lower(),
+            "drain_timeout_seconds": spec.upgrade_policy.drain.timeout_seconds,
+        }
+        if spec.tolerations:
+            data["tolerations"] = data["tolerations"] + list(spec.tolerations)
+        if spec.priority_class_name:
+            data["priority_class"] = spec.priority_class_name
+        objs = self.renderer.render_dir("state-libtpu", data)
+        out = []
+        for obj in objs:
+            if obj.get("kind") != "DaemonSet":
+                out.append(obj)
+                continue
+            ds = copy.deepcopy(obj)
+            ds["metadata"]["name"] = ds_name
+            pod_spec = ds["spec"]["template"]["spec"]
+            selector = dict(pod_spec.get("nodeSelector") or {})
+            selector.update(pool.selector)
+            pod_spec["nodeSelector"] = selector
+            # per-CR labels for ownership + pool identity
+            for meta in (ds["metadata"], ds["spec"]["template"]["metadata"]):
+                meta.setdefault("labels", {})["tpu.google.com/runtime-cr"] = runtime.name
+                meta["labels"]["tpu.google.com/runtime-pool"] = pool.name
+            out.append(ds)
+        return out
+
+    async def _sync_pool(
+        self, runtime: TPURuntime, policy: TPUClusterPolicy, pool: NodePool, ds_name: str
+    ) -> bool:
+        ready = True
+        for obj in self._render_pool_objects(runtime, policy, pool, ds_name):
+            # Only the per-CR DaemonSet gets this CR as owner.  SA/RBAC are
+            # SHARED across TPURuntime CRs: stamping an owner would make two
+            # CRs fight over the hash every pass and deleting one CR would
+            # garbage-collect the SA out from under the other's DaemonSets.
+            is_ds = obj.get("kind") == "DaemonSet"
+            live, _ = await create_or_update(
+                self.client,
+                obj,
+                owner=runtime.obj if is_ds else None,
+                state_label=STATE_LABEL_VALUE,
+            )
+            if is_ds and not daemonset_ready(live):
+                ready = False
+        return ready
+
+    async def _cleanup_stale(self, runtime: TPURuntime, desired: set[str]) -> None:
+        """Delete DaemonSets this CR owns that no pool wants any more
+        (driver.go:173-198 cleanupStaleDriverDaemonsets)."""
+        items = await self.client.list_items(
+            "apps", "DaemonSet", self.namespace,
+            label_selector=f"tpu.google.com/runtime-cr={runtime.name}",
+        )
+        for item in items:
+            if item["metadata"]["name"] not in desired:
+                await self.client.delete(
+                    "apps", "DaemonSet", item["metadata"]["name"], self.namespace
+                )
+                log.info("deleted stale runtime DS %s", item["metadata"]["name"])
+
+    async def _update_status(self, runtime: TPURuntime, state: str, message: str) -> None:
+        generation = deep_get(runtime.obj, "metadata", "generation")
+        # deep copy: set_condition mutates the nested conditions list in place
+        old = copy.deepcopy(runtime.obj.get("status") or {})
+        runtime.status["state"] = state
+        if state == State.READY:
+            conditions.set_ready(runtime.status, generation=generation)
+        else:
+            reason = (
+                conditions.REASON_IGNORED if state == State.IGNORED
+                else conditions.REASON_OPERAND_NOT_READY
+            )
+            conditions.set_error(runtime.status, reason, message, generation)
+        if runtime.obj.get("status") == old:
+            return
+        try:
+            await self.client.update_status(runtime.obj)
+        except ApiError as e:
+            if not e.conflict:
+                raise
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(Controller("tpuruntime", self.reconcile))
+        runtimes = mgr.informer(GROUP, TPU_RUNTIME_KIND)
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+
+        async def on_runtime(event_type: str, obj: dict) -> None:
+            controller.enqueue(obj["metadata"]["name"])
+
+        async def enqueue_all(event_type: str, obj: dict) -> None:
+            for r in runtimes.items():
+                controller.enqueue(r["metadata"]["name"])
+
+        async def on_node(event_type: str, obj: dict) -> None:
+            if clusterinfo.is_tpu_node(obj) or event_type == "DELETED":
+                await enqueue_all(event_type, obj)
+
+        runtimes.add_handler(on_runtime)
+        policies.add_handler(enqueue_all)
+        nodes.add_handler(on_node)
+        return controller

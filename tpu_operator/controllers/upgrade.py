@@ -1,0 +1,261 @@
+"""Runtime auto-upgrade controller: per-node cordon→drain→swap→validate→uncordon.
+
+Reference analogue: controllers/upgrade_controller.go (:80-227) driving the
+external k8s-operator-libs/pkg/upgrade state machine — reimplemented in-tree
+(SURVEY §7 step 7).  Per-node state rides the
+``tpu.google.com/tpu-runtime-upgrade-state`` label:
+
+  upgrade-required → cordon-required → drain-required →
+  pod-restart-required → validation-required → uncordon-required →
+  upgrade-done | upgrade-failed
+
+Bounded by ``libtpu.upgradePolicy.maxParallelUpgrades`` and ``maxUnavailable``
+(:156-164), gated on validation before uncordon (:145 WithValidationEnabled),
+metrics-fed (:177-184), labels cleaned when auto-upgrade is disabled
+(:199-227), requeued every 2 minutes (:58,196).
+
+"Needs upgrade" = the node's tpu.runtime.version feature label differs from
+the policy's pinned libtpu version.  The swap itself is delegated to the
+node: the controller stamps the upgrade-requested annotation and deletes the
+OnDelete runtime DS pod; the replacement pod's runtime-manager init drains
+locally and the installer writes the new version, which feature discovery
+reflects back into the label the controller validates against.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy  # noqa: F401 (GROUP/KIND used in setup watches)
+from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers.labels import node_advertises_tpu
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.upgrade")
+
+# state-label values (k8s-operator-libs upgrade states)
+REQUIRED = "upgrade-required"
+CORDON = "cordon-required"
+DRAIN = "drain-required"
+POD_RESTART = "pod-restart-required"
+VALIDATION = "validation-required"
+UNCORDON = "uncordon-required"
+DONE = "upgrade-done"
+FAILED = "upgrade-failed"
+
+IN_PROGRESS_STATES = (CORDON, DRAIN, POD_RESTART, VALIDATION, UNCORDON)
+
+RECONCILE_KEY = "upgrade"
+
+
+def parse_max_unavailable(value: Optional[str], total: int) -> int:
+    """'25%' or '2' → absolute bound ≥1 (upgrade_controller.go:156-164)."""
+    if not value:
+        return max(1, total)
+    value = str(value).strip()
+    try:
+        if value.endswith("%"):
+            return max(1, int(total * int(value[:-1]) / 100))
+        return max(1, int(value))
+    except ValueError:
+        return 1
+
+
+class UpgradeReconciler:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, key: str) -> Optional[float]:
+        policy = await self._cluster_policy()
+        if policy is None:
+            return None
+        up = policy.spec.libtpu.upgrade_policy
+        nodes = [
+            n for n in await self.client.list_items("", "Node") if clusterinfo.is_tpu_node(n)
+        ]
+        self.metrics.auto_upgrade_enabled.set(1 if up.auto_upgrade else 0)
+        if not up.auto_upgrade:
+            await self._clear_labels(nodes)
+            return consts.UPGRADE_REQUEUE_SECONDS
+
+        desired = policy.spec.libtpu.libtpu_version
+        states = {n["metadata"]["name"]: self._state_of(n) for n in nodes}
+
+        # Mark out-of-date nodes (BuildState analogue).  DONE nodes become
+        # eligible again when a NEW version is pinned (v2 done, v3 pinned →
+        # re-required); FAILED stays sticky until operator intervention,
+        # matching the reference machine's failed-state semantics.
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if states[name] and states[name] != DONE:
+                continue
+            current = deep_get(node, "metadata", "labels", default={}).get(
+                consts.TFD_RUNTIME_VERSION_LABEL
+            )
+            if desired and current and current != desired:
+                await self._set_state(name, REQUIRED)
+                states[name] = REQUIRED
+
+        in_progress = sum(1 for s in states.values() if s in IN_PROGRESS_STATES)
+        unavailable = sum(
+            1 for n in nodes
+            if deep_get(n, "spec", "unschedulable") or not node_advertises_tpu(n)
+        )
+        max_parallel = max(1, up.max_parallel_upgrades)
+        max_unavailable = parse_max_unavailable(up.max_unavailable, len(nodes))
+
+        # Admit required nodes into the pipeline within bounds (ApplyState).
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if states[name] != REQUIRED:
+                continue
+            if in_progress >= max_parallel or unavailable >= max_unavailable:
+                break
+            await self._set_state(name, CORDON)
+            states[name] = CORDON
+            in_progress += 1
+            unavailable += 1
+
+        # Advance each in-flight node one step.
+        for node in nodes:
+            name = node["metadata"]["name"]
+            state = states[name]
+            try:
+                if state == CORDON:
+                    await self._cordon(name, True)
+                    await self._set_state(name, DRAIN)
+                elif state == DRAIN:
+                    await self._drain(node, up)
+                    await self._request_runtime_swap(node)
+                    await self._set_state(name, POD_RESTART)
+                elif state == POD_RESTART:
+                    if await self._runtime_pod_running(name):
+                        await self._set_state(name, VALIDATION)
+                elif state == VALIDATION:
+                    if self._validated(await self.client.get("", "Node", name), desired):
+                        await self._set_state(name, UNCORDON)
+                elif state == UNCORDON:
+                    await self._cordon(name, False)
+                    await self._set_state(name, DONE)
+            except ApiError as e:
+                log.error("upgrade step %s on %s failed: %s", state, name, e)
+                await self._set_state(name, FAILED)
+
+        fresh = [
+            n for n in await self.client.list_items("", "Node") if clusterinfo.is_tpu_node(n)
+        ]
+        await self._report(fresh)
+        return consts.UPGRADE_REQUEUE_SECONDS
+
+    # ------------------------------------------------------------------
+    def _state_of(self, node: dict) -> str:
+        return deep_get(node, "metadata", "labels", default={}).get(
+            consts.UPGRADE_STATE_LABEL, ""
+        )
+
+    async def _set_state(self, node_name: str, state: Optional[str]) -> None:
+        await self.client.patch(
+            "", "Node", node_name,
+            {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: state}}},
+        )
+
+    async def _cordon(self, node_name: str, value: bool) -> None:
+        await self.client.patch("", "Node", node_name, {"spec": {"unschedulable": value or None}})
+
+    async def _drain(self, node: dict, up) -> None:
+        """Evict TPU workload pods (gpuPodSpecFilter + drain spec)."""
+        if not up.drain.enable:
+            return
+        from tpu_operator.agents.runtime_manager import evict_tpu_pods
+
+        await evict_tpu_pods(
+            self.client,
+            node["metadata"]["name"],
+            force=up.drain.force,
+            timeout=min(30.0, float(up.drain.timeout_seconds)),
+        )
+
+    async def _request_runtime_swap(self, node: dict) -> None:
+        """Annotate + delete the OnDelete runtime DS pod on this node."""
+        name = node["metadata"]["name"]
+        await self.client.patch(
+            "", "Node", name,
+            {"metadata": {"annotations": {consts.UPGRADE_REQUESTED_ANNOTATION: "true"}}},
+        )
+        pods = await self.client.list_items(
+            "", "Pod", self.namespace, label_selector="app=tpu-runtime"
+        )
+        for pod in pods:
+            if deep_get(pod, "spec", "nodeName") == name:
+                await self.client.delete("", "Pod", pod["metadata"]["name"], self.namespace)
+                log.info("deleted runtime pod %s for swap on %s", pod["metadata"]["name"], name)
+
+    async def _runtime_pod_running(self, node_name: str) -> bool:
+        pods = await self.client.list_items(
+            "", "Pod", self.namespace, label_selector="app=tpu-runtime"
+        )
+        for pod in pods:
+            if deep_get(pod, "spec", "nodeName") != node_name:
+                continue
+            # the old pod lingers Running with a deletionTimestamp during
+            # graceful termination — only a non-terminating pod counts
+            if deep_get(pod, "metadata", "deletionTimestamp"):
+                continue
+            return deep_get(pod, "status", "phase") == "Running"
+        return False
+
+    def _validated(self, node: dict, desired: Optional[str]) -> bool:
+        """Post-swap gate before uncordon (validator-app gate analogue,
+        upgrade_controller.go:145): capacity advertised + version caught up."""
+        if not node_advertises_tpu(node):
+            return False
+        if desired:
+            current = deep_get(node, "metadata", "labels", default={}).get(
+                consts.TFD_RUNTIME_VERSION_LABEL
+            )
+            return current == desired
+        return True
+
+    async def _clear_labels(self, nodes: list[dict]) -> None:
+        """Auto-upgrade disabled → remove state labels (:199-227)."""
+        for node in nodes:
+            if self._state_of(node):
+                await self._set_state(node["metadata"]["name"], None)
+
+    async def _report(self, nodes: list[dict]) -> None:
+        states = [self._state_of(n) for n in nodes]
+        self.metrics.upgrades_in_progress.set(sum(1 for s in states if s in IN_PROGRESS_STATES))
+        self.metrics.upgrades_done.set(sum(1 for s in states if s == DONE))
+        self.metrics.upgrades_failed.set(sum(1 for s in states if s == FAILED))
+        self.metrics.upgrades_pending.set(sum(1 for s in states if s == REQUIRED))
+        self.metrics.upgrades_available.set(sum(1 for s in states if not s))
+
+    async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
+        obj = await clusterinfo.active_cluster_policy(self.client)
+        return TPUClusterPolicy(obj) if obj else None
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(Controller("upgrade", self.reconcile))
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+
+        async def kick(event_type: str, obj: dict) -> None:
+            controller.enqueue(RECONCILE_KEY)
+
+        policies.add_handler(kick)
+        nodes.add_handler(kick)
+        return controller

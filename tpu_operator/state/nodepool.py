@@ -1,0 +1,67 @@
+"""TPU node-pool partitioning for per-pool runtime DaemonSets.
+
+Reference analogue: internal/state/nodepool.go:55-133 — the driver state
+splits GPU nodes into pools (per kernel for precompiled, per RHCOS on OCP,
+else per osVersion) and renders one DaemonSet per pool.  TPU pools split on
+what actually differentiates the runtime payload: (accelerator type, ICI
+topology) — a v5e 2x4 host and a v5p 4x4x4 host pin different libtpu builds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_operator import consts
+from tpu_operator.utils import deep_get, fnv1a_64
+
+
+@dataclass(frozen=True)
+class NodePool:
+    accelerator: str
+    topology: str
+    node_count: int
+    # nodeSelector that uniquely targets this pool's nodes
+    selector: dict = field(hash=False, default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        """Short pool id used in DaemonSet names (getDriverName analogue)."""
+        accel = self.accelerator.replace("tpu-", "").replace("-podslice", "").replace("-slice", "")
+        return f"{accel}-{self.topology}".replace(".", "-").lower()
+
+
+def hashed_name(base: str, suffix: str, cap: int = 63) -> str:
+    """DNS-1123-capped unique name (getDriverAppName analogue,
+    internal/state/driver.go:428-457)."""
+    name = f"{base}-{suffix}"
+    if len(name) <= cap:
+        return name
+    digest = format(fnv1a_64(name.encode()) & 0xFFFFFFFF, "08x")
+    return f"{name[: cap - 9]}-{digest}"
+
+
+def get_node_pools(nodes: list[dict], node_selector: dict | None = None) -> list[NodePool]:
+    """Partition TPU nodes into runtime pools.
+
+    ``node_selector``: the TPURuntime CR's own selector — only matching
+    nodes join pools (nvidiadriver nodeSelector semantics).
+    """
+    groups: dict[tuple[str, str], int] = {}
+    for node in nodes:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL)
+        if not accel:
+            continue
+        if node_selector and any(labels.get(k) != v for k, v in node_selector.items()):
+            continue
+        topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+        groups[(accel, topo)] = groups.get((accel, topo), 0) + 1
+
+    pools = []
+    for (accel, topo), count in sorted(groups.items()):
+        selector = dict(node_selector or {})
+        selector[consts.GKE_TPU_ACCELERATOR_LABEL] = accel
+        if topo:
+            selector[consts.GKE_TPU_TOPOLOGY_LABEL] = topo
+        pools.append(NodePool(accelerator=accel, topology=topo, node_count=count, selector=selector))
+    return pools

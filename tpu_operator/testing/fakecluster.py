@@ -529,12 +529,15 @@ class FakeCluster:
                 log.exception("simulator error")
             await asyncio.sleep(self.sim.tick)
 
-    def _schedulable_nodes(self, pod_spec: dict) -> list[dict]:
+    def _schedulable_nodes(self, pod_spec: dict, daemonset: bool = False) -> list[dict]:
         nodes = self.store("", "nodes").list(None)
         out = []
         for node in nodes:
             labels = node["metadata"].get("labels", {})
-            if node["spec"].get("unschedulable"):
+            # DaemonSet pods tolerate node.kubernetes.io/unschedulable by
+            # default (real DS controller behaviour) — cordoned nodes still
+            # run operands, which the upgrade flow depends on
+            if node["spec"].get("unschedulable") and not daemonset:
                 continue
             ns_sel = pod_spec.get("nodeSelector") or {}
             if any(labels.get(k) != v for k, v in ns_sel.items()):
@@ -556,7 +559,7 @@ class FakeCluster:
             ds_name = ds["metadata"]["name"]
             pod_spec = deep_get(ds, "spec", "template", "spec", default={})
             pod_labels = deep_get(ds, "spec", "template", "metadata", "labels", default={})
-            nodes = self._schedulable_nodes(pod_spec)
+            nodes = self._schedulable_nodes(pod_spec, daemonset=True)
             want = {n["metadata"]["name"] for n in nodes}
             have: dict[str, dict] = {}
             for pod in list(pod_store.objects.values()):
