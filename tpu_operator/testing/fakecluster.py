@@ -565,7 +565,13 @@ class FakeCluster:
             for pod in list(pod_store.objects.values()):
                 if pod["metadata"].get("namespace") != ns:
                     continue
-                if obj_api.owned_by(pod, ds["metadata"]["uid"]):
+                # only manage pods this sim created (validator workload pods
+                # carry the DS ownerRef too — reference pattern — but are NOT
+                # DaemonSet replicas and must not be adopted/reaped)
+                sim_created = "tpu.google.com/sim.ds-generation" in (
+                    pod["metadata"].get("annotations") or {}
+                )
+                if sim_created and obj_api.owned_by(pod, ds["metadata"]["uid"]):
                     have[deep_get(pod, "spec", "nodeName", default="")] = pod
             generation = str(ds["metadata"].get("generation", 1))
             for node_name in want - set(have):
@@ -602,16 +608,19 @@ class FakeCluster:
                         pod_store.delete(ns, pod["metadata"]["name"])
                     except ApiException:
                         pass
-            # recompute status
+            # recompute status over sim-created replicas only
+            def _is_replica(p: dict) -> bool:
+                return obj_api.owned_by(p, ds["metadata"]["uid"]) and (
+                    "tpu.google.com/sim.ds-generation"
+                    in (p["metadata"].get("annotations") or {})
+                )
+
             ready = sum(
                 1
                 for p in pod_store.objects.values()
-                if obj_api.owned_by(p, ds["metadata"]["uid"])
-                and deep_get(p, "status", "phase") == "Running"
+                if _is_replica(p) and deep_get(p, "status", "phase") == "Running"
             )
-            scheduled = sum(
-                1 for p in pod_store.objects.values() if obj_api.owned_by(p, ds["metadata"]["uid"])
-            )
+            scheduled = sum(1 for p in pod_store.objects.values() if _is_replica(p))
             status = {
                 "desiredNumberScheduled": len(want),
                 "currentNumberScheduled": scheduled,
