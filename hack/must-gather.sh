@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Support-bundle collector (hack/must-gather.sh analogue).
+# Usage: ARTIFACT_DIR=/tmp/tpu-operator-gather ./hack/must-gather.sh
+set -uo pipefail
+
+OUT="${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather}"
+NS="${OPERATOR_NAMESPACE:-tpu-operator}"
+K="${KUBECTL:-kubectl}"
+mkdir -p "$OUT"/{crs,operands,nodes,logs}
+
+echo "gathering into $OUT"
+
+$K version -o yaml > "$OUT/version.yaml" 2>&1
+$K get tpuclusterpolicies -o yaml > "$OUT/crs/tpuclusterpolicies.yaml" 2>&1
+$K get tpuruntimes -o yaml > "$OUT/crs/tpuruntimes.yaml" 2>&1
+
+$K -n "$NS" get all -o wide > "$OUT/operands/all.txt" 2>&1
+$K -n "$NS" get daemonsets,deployments,services,configmaps -o yaml \
+  > "$OUT/operands/objects.yaml" 2>&1
+$K -n "$NS" get events --sort-by=.lastTimestamp > "$OUT/operands/events.txt" 2>&1
+
+$K get nodes -o yaml > "$OUT/nodes/nodes.yaml" 2>&1
+$K get nodes -L cloud.google.com/gke-tpu-accelerator \
+  -L cloud.google.com/gke-tpu-topology \
+  -L tpu.google.com/tpu.present \
+  -L google.com/tpu.slice.config.state \
+  -L tpu.google.com/tpu-runtime-upgrade-state > "$OUT/nodes/labels.txt" 2>&1
+
+for pod in $($K -n "$NS" get pods -o name 2>/dev/null); do
+  name="${pod#pod/}"
+  $K -n "$NS" logs "$pod" --all-containers --tail=2000 \
+    > "$OUT/logs/${name}.log" 2>&1
+done
+
+# per-node validation status files via the validator DS pods
+for pod in $($K -n "$NS" get pods -l app=tpu-operator-validator -o name 2>/dev/null); do
+  name="${pod#pod/}"
+  $K -n "$NS" exec "$pod" -- sh -c 'ls -la /run/tpu/validations; cat /run/tpu/validations/*-ready 2>/dev/null' \
+    > "$OUT/nodes/validations-${name}.txt" 2>&1
+done
+
+echo "done: $(find "$OUT" -type f | wc -l) files"

@@ -1,0 +1,134 @@
+"""Deploy chart rendering/install + tpuop-cfg validation tests.
+
+Reference test analogue: the e2e helm-install flow of
+tests/e2e/gpu_operator_test.go — here: render values → apply → the operator
+(driven against the same fake cluster) converges the installed CR.
+"""
+
+import asyncio
+import os
+
+import pytest
+import yaml
+
+from tpu_operator.api.types import GROUP, State
+from tpu_operator.cmd import deploy, tpuop_cfg
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+
+def test_render_manifests_shape():
+    values = deploy.load_values(os.path.join(deploy.DEPLOY_DIR, "values.yaml"), [])
+    objs = deploy.render_manifests(values)
+    kinds = [o["kind"] for o in objs]
+    assert kinds.count("CustomResourceDefinition") == 2
+    for kind in ("Namespace", "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                 "Deployment", "TPUClusterPolicy"):
+        assert kind in kinds, kind
+    dep = next(o for o in objs if o["kind"] == "Deployment")
+    envs = {e["name"]: e.get("value") for e in
+            deep_get(dep, "spec", "template", "spec", "containers", 0, "env")}
+    assert envs["DEVICE_PLUGIN_IMAGE"].startswith("ghcr.io/")
+    assert "VALIDATOR_IMAGE" in envs
+
+
+def test_set_overrides():
+    values = deploy.load_values(
+        os.path.join(deploy.DEPLOY_DIR, "values.yaml"),
+        ["operator.version=v9", "clusterPolicy.spec.devicePlugin.enabled=false",
+         "operator.replicas=2"],
+    )
+    objs = deploy.render_manifests(values)
+    dep = next(o for o in objs if o["kind"] == "Deployment")
+    assert dep["spec"]["replicas"] == 2
+    image = deep_get(dep, "spec", "template", "spec", "containers", 0, "image")
+    assert image.endswith(":v9")
+    cr = next(o for o in objs if o["kind"] == "TPUClusterPolicy")
+    assert cr["spec"]["devicePlugin"]["enabled"] is False
+
+
+def test_clusterpolicy_disabled_not_rendered():
+    values = deploy.load_values(
+        os.path.join(deploy.DEPLOY_DIR, "values.yaml"), ["clusterPolicy.enabled=false"]
+    )
+    objs = deploy.render_manifests(values)
+    assert not any(o["kind"] == "TPUClusterPolicy" for o in objs)
+
+
+async def test_install_then_operator_converges():
+    """helm-install → operand-ready e2e (gpu_operator_test.go:88-121 pattern)."""
+    values = deploy.load_values(os.path.join(deploy.DEPLOY_DIR, "values.yaml"), [])
+    objs = deploy.render_manifests(values)
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            for obj in objs:
+                from tpu_operator.k8s.apply import create_or_update
+
+                await create_or_update(client, obj)
+            # the installed Deployment is simulated Ready by the fake cluster
+            dep = await client.get("apps", "Deployment", "tpu-operator", "tpu-operator")
+            assert dep["metadata"]["name"] == "tpu-operator"
+            reconciler = ClusterPolicyReconciler(client, "tpu-operator")
+            for _ in range(40):
+                await reconciler.reconcile("cluster-policy")
+                cr = await client.get(GROUP, "TPUClusterPolicy", "cluster-policy")
+                if deep_get(cr, "status", "state") == State.READY:
+                    break
+                await asyncio.sleep(0.05)
+            assert deep_get(cr, "status", "state") == State.READY
+
+
+# ---------------------------------------------------------------------------
+# tpuop-cfg
+
+
+def test_validate_values_ok(capsys):
+    rc = tpuop_cfg.main(["validate", "values", "-f",
+                         os.path.join(deploy.DEPLOY_DIR, "values.yaml")])
+    assert rc == 0
+
+
+def test_validate_values_catches_missing_image(tmp_path):
+    values = deploy.load_values(os.path.join(deploy.DEPLOY_DIR, "values.yaml"), [])
+    del values["images"]["validator"]
+    f = tmp_path / "values.yaml"
+    f.write_text(yaml.safe_dump(values))
+    assert tpuop_cfg.main(["validate", "values", "-f", str(f)]) == 1
+
+
+def test_validate_clusterpolicy(tmp_path):
+    good = tmp_path / "good.yaml"
+    good.write_text(yaml.safe_dump({
+        "apiVersion": "tpu.google.com/v1", "kind": "TPUClusterPolicy",
+        "metadata": {"name": "cluster-policy"},
+        "spec": {"sliceManager": {"strategy": "mixed"}},
+    }))
+    assert tpuop_cfg.main(["validate", "clusterpolicy", "-f", str(good)]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({
+        "kind": "TPUClusterPolicy",
+        "spec": {"sliceManager": {"strategy": "bogus"}, "typoField": {}},
+    }))
+    assert tpuop_cfg.main(["validate", "clusterpolicy", "-f", str(bad)]) == 1
+
+
+def test_validate_sliceconfig(tmp_path):
+    good = tmp_path / "good.yaml"
+    good.write_text(yaml.safe_dump({
+        "slice-configs": {
+            "halves": [{"accelerators": ["*"], "topology": "4x4x4",
+                        "partitions": ["2x4x4", "2x4x4"]}],
+        }
+    }))
+    assert tpuop_cfg.main(["validate", "sliceconfig", "-f", str(good)]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({
+        "slice-configs": {
+            "broken": [{"accelerators": ["*"], "topology": "4x4x4",
+                        "partitions": ["3x4x4"]}],
+        }
+    }))
+    assert tpuop_cfg.main(["validate", "sliceconfig", "-f", str(bad)]) == 1

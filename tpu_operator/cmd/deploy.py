@@ -1,0 +1,103 @@
+"""Deploy tool: render/install/uninstall the operator (helm analogue).
+
+Reference analogue: `helm install` of deployments/gpu-operator — values file
+templating the operator Deployment + ClusterPolicy CR.  Uses the same Jinja
+renderer as the operand states.
+
+  python -m tpu_operator.cmd.deploy render  [-f values.yaml] [--set a.b=c]
+  python -m tpu_operator.cmd.deploy install [-f values.yaml] [--set a.b=c]
+  python -m tpu_operator.cmd.deploy uninstall
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import copy
+import os
+import sys
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.render import Renderer
+
+DEPLOY_DIR = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..", "deploy"))
+
+
+def load_values(path: str, overrides: list[str]) -> dict:
+    with open(path) as f:
+        values = yaml.safe_load(f) or {}
+    for item in overrides:
+        if "=" not in item:
+            raise SystemExit(f"--set expects a.b.c=value, got {item!r}")
+        key, _, raw = item.partition("=")
+        value = yaml.safe_load(raw)
+        cur = values
+        parts = key.split(".")
+        for i, p in enumerate(parts[:-1]):
+            if not isinstance(cur, dict):
+                raise SystemExit(
+                    f"--set {key}: {'.'.join(parts[:i])!r} is not a mapping"
+                )
+            cur = cur.setdefault(p, {})
+        if not isinstance(cur, dict):
+            raise SystemExit(f"--set {key}: {'.'.join(parts[:-1])!r} is not a mapping")
+        cur[parts[-1]] = value
+    return values
+
+
+def render_manifests(values: dict, deploy_dir: str = DEPLOY_DIR) -> list[dict]:
+    data = copy.deepcopy(values)
+    data["image_envs"] = consts.IMAGE_ENVS
+    renderer = Renderer(deploy_dir)
+    objs = renderer.render_dir("templates", data)
+    # CRDs first (install ordering)
+    crds = []
+    for name in sorted(os.listdir(os.path.join(deploy_dir, "crds"))):
+        with open(os.path.join(deploy_dir, "crds", name)) as f:
+            crds.extend(d for d in yaml.safe_load_all(f) if d)
+    return crds + objs
+
+
+async def apply_manifests(objs: list[dict]) -> None:
+    from tpu_operator.k8s.apply import create_or_update
+    from tpu_operator.k8s.client import ApiClient, Config
+
+    async with ApiClient(Config.from_env()) as client:
+        for obj in objs:
+            _, changed = await create_or_update(client, obj)
+            state = "applied" if changed else "unchanged"
+            print(f"{state}: {obj['kind']} {obj['metadata']['name']}", file=sys.stderr)
+
+
+async def delete_manifests(objs: list[dict]) -> None:
+    from tpu_operator.k8s.apply import delete_if_exists
+    from tpu_operator.k8s.client import ApiClient, Config
+
+    async with ApiClient(Config.from_env()) as client:
+        for obj in reversed(objs):
+            await delete_if_exists(client, obj)
+            print(f"deleted: {obj['kind']} {obj['metadata']['name']}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-operator-deploy")
+    p.add_argument("action", choices=["render", "install", "uninstall"])
+    p.add_argument("-f", "--values", default=os.path.join(DEPLOY_DIR, "values.yaml"))
+    p.add_argument("--set", dest="overrides", action="append", default=[])
+    args = p.parse_args(argv)
+
+    values = load_values(args.values, args.overrides)
+    objs = render_manifests(values)
+    if args.action == "render":
+        print(yaml.safe_dump_all(objs, sort_keys=False))
+    elif args.action == "install":
+        asyncio.run(apply_manifests(objs))
+    else:
+        asyncio.run(delete_manifests(objs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

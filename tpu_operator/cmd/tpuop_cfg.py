@@ -1,0 +1,133 @@
+"""tpuop-cfg: configuration validation CLI.
+
+Reference analogue: cmd/gpuop-cfg (`gpuop-cfg validate csv|clusterpolicy`,
+Makefile:228-235) — offline validation of config artifacts before they hit a
+cluster.
+
+  python -m tpu_operator.cmd.tpuop_cfg validate clusterpolicy -f cr.yaml
+  python -m tpu_operator.cmd.tpuop_cfg validate values        -f deploy/values.yaml
+  python -m tpu_operator.cmd.tpuop_cfg validate sliceconfig   -f config.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+import yaml
+
+from tpu_operator import consts, slices
+from tpu_operator.api.types import (
+    SliceStrategy,
+    TPUClusterPolicySpec,
+    TPURuntimeSpec,
+)
+
+
+def _enum_violations(spec_obj, path="spec") -> list[str]:
+    """Walk the dataclass tree checking enum-constrained fields."""
+    errors = []
+    for f in dataclasses.fields(spec_obj):
+        value = getattr(spec_obj, f.name)
+        enum = (f.metadata or {}).get("enum")
+        if enum and value not in enum:
+            errors.append(f"{path}.{f.name}: {value!r} not in {enum}")
+        if dataclasses.is_dataclass(value):
+            errors.extend(_enum_violations(value, f"{path}.{f.name}"))
+    return errors
+
+
+def validate_clusterpolicy(doc: dict) -> list[str]:
+    errors = []
+    kind = doc.get("kind")
+    if kind == "TPUClusterPolicy":
+        spec = TPUClusterPolicySpec.from_dict(doc.get("spec") or {})
+        errors += _enum_violations(spec)
+        if spec.extra_fields:
+            errors += [f"spec: unknown field {k!r}" for k in spec.extra_fields]
+        for state in consts.STATE_NAMES:
+            spec.state_enabled(state)  # raises on registry drift
+    elif kind == "TPURuntime":
+        rspec = TPURuntimeSpec.from_dict(doc.get("spec") or {})
+        errors += _enum_violations(rspec)
+    else:
+        errors.append(f"unsupported kind {kind!r}")
+    return errors
+
+
+def validate_values(doc: dict) -> list[str]:
+    """Every component env image must be defined; CR spec must parse."""
+    errors = []
+    images = doc.get("images") or {}
+    for component in consts.IMAGE_ENVS:
+        if component not in images:
+            errors.append(f"images.{component}: missing (operator env {consts.IMAGE_ENVS[component]})")
+    for component, image in images.items():
+        if component not in consts.IMAGE_ENVS:
+            errors.append(f"images.{component}: unknown component")
+        elif not isinstance(image, str) or not image:
+            errors.append(f"images.{component}: empty")
+    cp = (doc.get("clusterPolicy") or {}).get("spec")
+    if cp is not None:
+        errors += validate_clusterpolicy(
+            {"kind": "TPUClusterPolicy", "spec": cp}
+        )
+    if not doc.get("namespace"):
+        errors.append("namespace: required")
+    return errors
+
+
+def validate_sliceconfig(doc: dict) -> list[str]:
+    """Each profile rule with an explicit topology must tile it exactly."""
+    errors = []
+    profiles = doc.get("slice-configs")
+    if not isinstance(profiles, dict) or not profiles:
+        return ["slice-configs: missing or empty"]
+    for name, rules in profiles.items():
+        if not isinstance(rules, list):
+            errors.append(f"{name}: rules must be a list")
+            continue
+        for i, rule in enumerate(rules):
+            if not isinstance(rule, dict):
+                errors.append(f"{name}[{i}]: rule must be a mapping")
+                continue
+            shapes = rule.get("partitions") or []
+            topo = rule.get("topology")
+            # rules without an explicit topology are generic: they can only
+            # be tiling-checked against a concrete node topology at apply
+            if shapes and topo:
+                try:
+                    slices.partition_topology(topo, shapes)
+                except slices.PartitionError as e:
+                    errors.append(f"{name}[{i}]: {e}")
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpuop-cfg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("validate")
+    v.add_argument("what", choices=["clusterpolicy", "values", "sliceconfig"])
+    v.add_argument("-f", "--file", required=True)
+    args = p.parse_args(argv)
+
+    with open(args.file) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    errors: list[str] = []
+    for doc in docs:
+        if args.what == "clusterpolicy":
+            errors += validate_clusterpolicy(doc)
+        elif args.what == "values":
+            errors += validate_values(doc)
+        else:
+            errors += validate_sliceconfig(doc)
+    for e in errors:
+        print(f"INVALID: {e}", file=sys.stderr)
+    if not errors:
+        print(f"{args.file}: OK ({len(docs)} document(s))")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
