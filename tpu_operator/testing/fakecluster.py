@@ -259,6 +259,10 @@ class FakeCluster:
         self._sim_task: Optional[asyncio.Task] = None
         self.port: Optional[int] = None
         self._pod_timers: dict[tuple[str, str], float] = {}
+        # workload pods whose executor is currently running (concurrent:
+        # multi-host validation pods rendezvous at a coordinator and must
+        # all execute at once)
+        self._executing: set[tuple[str, str]] = set()
 
     # ------------------------------------------------------------------
     def next_rv(self) -> int:
@@ -670,15 +674,30 @@ class FakeCluster:
             if phase == "Pending" and now - started >= self.sim.pod_ready_delay:
                 restart_policy = deep_get(pod, "spec", "restartPolicy", default="Always")
                 if restart_policy != "Always" and self.sim.pod_executor is not None:
-                    final = await asyncio.get_event_loop().run_in_executor(
-                        None, self.sim.pod_executor, copy.deepcopy(pod)
-                    )
-                    self._set_pod_phase(pod_store, ns, name, final)
+                    if key in self._executing:
+                        continue
+                    self._executing.add(key)
+                    self._set_pod_phase(pod_store, ns, name, "Running")
+                    asyncio.create_task(self._execute_pod(pod_store, ns, name, pod))
                 elif restart_policy != "Always":
                     self._set_pod_phase(pod_store, ns, name, "Succeeded")
                 else:
                     self._set_pod_phase(pod_store, ns, name, "Running")
                     self._maybe_advertise_tpu(pod)
+
+    async def _execute_pod(self, pod_store: Store, ns: str, name: str, pod: dict) -> None:
+        """Run the pod's executor off-loop; concurrent across pods so
+        multi-process workloads can rendezvous."""
+        try:
+            final = await asyncio.get_event_loop().run_in_executor(
+                None, self.sim.pod_executor, copy.deepcopy(pod)
+            )
+        except Exception:  # noqa: BLE001
+            log.exception("pod executor failed for %s/%s", ns, name)
+            final = "Failed"
+        finally:
+            self._executing.discard((ns, name))
+        self._set_pod_phase(pod_store, ns, name, final)
 
     def _set_pod_phase(self, pod_store: Store, ns: str, name: str, phase: str) -> None:
         try:
