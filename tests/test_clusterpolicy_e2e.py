@@ -137,6 +137,28 @@ async def test_disable_operand_deletes_objects():
             assert "tpu-feature-discovery" not in crs
 
 
+async def test_tpu_runtime_crd_toggle_deletes_policy_runtime_ds():
+    """Flipping libtpu.useTpuRuntimeCrd on hands the runtime to TPURuntime
+    CRs: the policy-managed tpu-runtime-daemonset must be DELETED, not left
+    fighting the per-pool DaemonSets over /home/kubernetes/tpu
+    (ADVICE r1 high: the old skip_states special-case bypassed cleanup)."""
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            assert await client.get("apps", "DaemonSet", "tpu-runtime-daemonset", NS)
+
+            cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            cr["spec"].setdefault("libtpu", {})["useTpuRuntimeCrd"] = True
+            await client.update(cr)
+            obj, _ = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+            names = {d["metadata"]["name"] for d in await client.list_items("apps", "DaemonSet", NS)}
+            assert "tpu-runtime-daemonset" not in names, names
+
+
 async def test_labels_removed_when_accelerator_label_goes():
     """Node repurposed from TPU to CPU pool: operator-owned labels must be
     stripped even though the operator itself wrote tpu.present=true."""

@@ -79,7 +79,28 @@ async def test_allocate_device_specs_and_env(tmp_path, hw4):
                 }
                 assert all(d.container_path.startswith("/dev/accel") for d in cresp.devices)
                 assert cresp.envs["TPU_VISIBLE_CHIPS"] == "1,2"
-                assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2"
+                # libtpu parses an x,y,z bounds string, never a bare count
+                assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+                assert "TPU_WORKER_ID" not in cresp.envs  # single-host: no id source
+
+                # full-host request on a multi-host slice: worker id comes
+                # from the file tpu-feature-discovery drops under /run/tpu
+                run_tpu = tmp_path / "run_tpu"
+                (run_tpu / "validations").mkdir(parents=True)
+                (run_tpu / "worker_id").write_text("3")
+                os.environ["TPU_VALIDATION_ROOT"] = str(run_tpu)
+                try:
+                    req2 = api_pb2.AllocateRequest()
+                    req2.container_requests.append(
+                        api_pb2.ContainerAllocateRequest(
+                            devicesIDs=[f"tpu-accel{i}" for i in range(4)]
+                        )
+                    )
+                    cresp2 = (await stub.Allocate(req2)).container_responses[0]
+                    assert cresp2.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+                    assert cresp2.envs["TPU_WORKER_ID"] == "3"
+                finally:
+                    del os.environ["TPU_VALIDATION_ROOT"]
     finally:
         await plugin.stop()
 

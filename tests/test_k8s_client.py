@@ -124,6 +124,29 @@ async def test_create_or_update_hash_skip():
             assert changed
 
 
+async def test_service_update_preserves_cluster_ip():
+    """Full-replace PUT of a drifted Service must carry over the immutable
+    server-allocated clusterIP (a real apiserver 422s without it)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            svc = {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": "svc", "namespace": "default"},
+                "spec": {"ports": [{"port": 8080}], "selector": {"app": "x"}},
+            }
+            live, _ = await create_or_update(client, svc, state_label="state-test")
+            # simulate the apiserver allocating a clusterIP on create
+            live["spec"]["clusterIP"] = "10.0.0.7"
+            await client.update(live)
+
+            svc["spec"]["ports"] = [{"port": 9090}]  # drift → replace PUT
+            updated, changed = await create_or_update(client, svc, state_label="state-test")
+            assert changed
+            assert updated["spec"]["clusterIP"] == "10.0.0.7"
+            assert updated["spec"]["ports"] == [{"port": 9090}]
+
+
 async def test_owner_gc():
     async with FakeCluster(SimConfig(enabled=False)) as fc:
         async with ApiClient(Config(base_url=fc.base_url)) as client:

@@ -80,6 +80,18 @@ async def test_per_pool_daemonsets_and_stale_cleanup():
             sel = deep_get(ds, "spec", "template", "spec", "nodeSelector")
             assert sel[consts.GKE_TPU_ACCELERATOR_LABEL] == "tpu-v5p-slice"
             assert sel[consts.DEPLOY_LABEL_PREFIX + "libtpu"] == "true"
+            # pod selectors are disjoint across pools (no orphan adoption /
+            # status cross-talk between sibling per-pool DaemonSets)
+            other = await client.get("apps", "DaemonSet", "tpu-runtime-main-v5-lite-2x4", NS)
+            for d in (ds, other):
+                match = deep_get(d, "spec", "selector", "matchLabels")
+                tmpl = deep_get(d, "spec", "template", "metadata", "labels")
+                assert match["tpu.google.com/runtime-cr"] == "main"
+                assert all(tmpl[k] == v for k, v in match.items())
+            assert (
+                deep_get(ds, "spec", "selector", "matchLabels")
+                != deep_get(other, "spec", "selector", "matchLabels")
+            )
 
             # v5p node leaves → its pool DS cleaned up
             await client.delete("", "Node", "v5p-0")

@@ -79,10 +79,37 @@ def discover_features(node: dict) -> dict[str, str]:
     )
     if worker_id != "":
         out[consts.TFD_SLICE_WORKER_ID_LABEL] = str(worker_id)
+    _write_worker_id_file(str(worker_id))
     version = runtime_version()
     if version:
         out[consts.TFD_RUNTIME_VERSION_LABEL] = version
     return out
+
+
+def _write_worker_id_file(worker_id: str) -> None:
+    """Drop the worker id beside /run/tpu/validations so node-local daemons
+    without apiserver access (the device plugin's Allocate env) can read it.
+    An empty id REMOVES the file: a node repurposed out of its multi-host
+    slice must stop advertising a stale worker id (/run persists to reboot,
+    not to relabel)."""
+    from tpu_operator.validator import status as vstatus
+
+    path = vstatus.worker_id_path()
+    if not os.path.isdir(os.path.dirname(path)):
+        # /run/tpu is provisioned by the runtime DS mount on real nodes (and
+        # by the TPU_VALIDATION_ROOT seam in tests); never create it here
+        return
+    try:
+        if worker_id == "":
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            return
+        with open(path, "w") as f:
+            f.write(worker_id)
+    except OSError as e:
+        log.warning("could not update %s: %s", path, e)
 
 
 async def label_node(client: ApiClient, node_name: str) -> dict[str, str]:

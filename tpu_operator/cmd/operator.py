@@ -61,20 +61,15 @@ async def run(args: argparse.Namespace) -> None:
         leader_elect=args.leader_elect,
         metrics_registry=metrics.registry,
     )
+    # in-tree controllers can never legitimately be absent: a broken module
+    # must crash the operator loudly, not silently drop its controllers
+    from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
+    from tpu_operator.controllers.upgrade import UpgradeReconciler
+
     reconciler = ClusterPolicyReconciler(client, namespace, metrics=metrics)
     reconciler.setup(mgr)
-    try:
-        from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
-
-        TPURuntimeReconciler(client, namespace, metrics=metrics).setup(mgr)
-    except ImportError:
-        pass
-    try:
-        from tpu_operator.controllers.upgrade import UpgradeReconciler
-
-        UpgradeReconciler(client, namespace, metrics=metrics).setup(mgr)
-    except ImportError:
-        pass
+    TPURuntimeReconciler(client, namespace, metrics=metrics).setup(mgr)
+    UpgradeReconciler(client, namespace, metrics=metrics).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

@@ -76,10 +76,13 @@ class ClusterPolicyReconciler:
         self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
         self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
 
-        skip: set[str] = set()
-        if policy.spec.libtpu.use_tpu_runtime_crd:
-            skip.add("state-libtpu")
-        results = await self.state_manager.sync(self.client, ctx, policy, skip_states=skip)
+        # useTpuRuntimeCrd needs no special-case here: state_enabled() gates
+        # state-libtpu off when the CRD path owns the runtime, which routes
+        # through the DISABLED branch and *deletes* the policy-managed
+        # tpu-runtime-daemonset — two installers must never race over
+        # /home/kubernetes/tpu (state_manager.go:955-965 bypass analogue,
+        # done via the ordinary disable machinery instead).
+        results = await self.state_manager.sync(self.client, ctx, policy)
 
         for r in results.results:
             self.metrics.operand_state.labels(state=r.name).set(

@@ -173,9 +173,20 @@ class TPURuntimeReconciler:
             selector.update(pool.selector)
             pod_spec["nodeSelector"] = selector
             # per-CR labels for ownership + pool identity
+            pool_labels = {
+                "tpu.google.com/runtime-cr": runtime.name,
+                "tpu.google.com/runtime-pool": pool.name,
+            }
             for meta in (ds["metadata"], ds["spec"]["template"]["metadata"]):
-                meta.setdefault("labels", {})["tpu.google.com/runtime-cr"] = runtime.name
-                meta["labels"]["tpu.google.com/runtime-pool"] = pool.name
+                meta.setdefault("labels", {}).update(pool_labels)
+            # Pod selectors must be DISJOINT across the per-CR/per-pool
+            # DaemonSets sharing this namespace: with the template's bare
+            # {app: tpu-runtime} every DS would select every other DS's pods
+            # (orphan adoption + status cross-talk on a real apiserver).
+            # Selectors are immutable, but each per-pool DS is created fresh
+            # under its hashed name, so merging here is safe.
+            match = ds["spec"].setdefault("selector", {}).setdefault("matchLabels", {})
+            match.update(pool_labels)
             out.append(ds)
         return out
 
@@ -189,6 +200,8 @@ class TPURuntimeReconciler:
             # CRs fight over the hash every pass and deleting one CR would
             # garbage-collect the SA out from under the other's DaemonSets.
             is_ds = obj.get("kind") == "DaemonSet"
+            if is_ds:
+                await self._recreate_on_selector_change(obj)
             live, _ = await create_or_update(
                 self.client,
                 obj,
@@ -198,6 +211,30 @@ class TPURuntimeReconciler:
             if is_ds and not daemonset_ready(live):
                 ready = False
         return ready
+
+    async def _recreate_on_selector_change(self, desired: dict) -> None:
+        """spec.selector is immutable: a live DS created by an older operator
+        build with a different pod selector would 422 on replace-PUT.  Delete
+        it first so create_or_update recreates under the new selector (pods
+        re-roll; the runtime DS is OnDelete-tolerant by design)."""
+        try:
+            live = await self.client.get(
+                "apps", "DaemonSet", desired["metadata"]["name"], self.namespace
+            )
+        except ApiError as e:
+            if e.not_found:
+                return
+            raise
+        want = deep_get(desired, "spec", "selector", "matchLabels", default={})
+        have = deep_get(live, "spec", "selector", "matchLabels", default={})
+        if want != have:
+            log.info(
+                "DS %s pod selector changed %s → %s; delete-and-recreate",
+                desired["metadata"]["name"], have, want,
+            )
+            await self.client.delete(
+                "apps", "DaemonSet", desired["metadata"]["name"], self.namespace
+            )
 
     async def _cleanup_stale(self, runtime: TPURuntime, desired: set[str]) -> None:
         """Delete DaemonSets this CR owns that no pool wants any more

@@ -206,10 +206,16 @@ class TPUDevicePlugin:
                 chip_indices.append(chip_index(os.path.basename(path)))
             chip_indices.sort()
             cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in chip_indices)
-            cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] = str(len(chip_indices))
+            # libtpu wants the bounds of the chip grid the container sees as
+            # a comma-separated x,y,z string, not a count ("2,2,1" for a
+            # 4-chip v5e host) — a bare count breaks PJRT init.
+            cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] = hw.chip_bounds(len(chip_indices))
             cresp.envs["TPU_RUNTIME_METRICS_PORTS"] = ",".join(
                 str(8431 + i) for i in chip_indices
             )
+            wid = self.worker_id()
+            if wid is not None:
+                cresp.envs["TPU_WORKER_ID"] = str(wid)
             if os.path.isdir(self.config.libtpu_dir):
                 cresp.mounts.append(
                     api_pb2.Mount(
@@ -220,6 +226,26 @@ class TPUDevicePlugin:
                 )
             resp.container_responses.append(cresp)
         return resp
+
+    def worker_id(self) -> Optional[int]:
+        """This host's worker index within its multi-host slice: the
+        TPU_WORKER_ID env (DS-injected) wins, else the ``worker_id`` file
+        tpu-feature-discovery drops beside the validations dir.  None on
+        single-host nodes with neither source — the env is then omitted and
+        jax.distributed derives the id from its coordinator instead."""
+        env = os.environ.get("TPU_WORKER_ID")
+        if env is not None and env != "":
+            try:
+                return int(env)
+            except ValueError:
+                pass
+        from tpu_operator.validator import status as vstatus
+
+        try:
+            with open(vstatus.worker_id_path()) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
 
     async def PreStartContainer(self, request, context) -> api_pb2.PreStartContainerResponse:
         return api_pb2.PreStartContainerResponse()

@@ -44,6 +44,24 @@ def chip_count() -> int:
     return len(accel_device_paths())
 
 
+# chip count → x,y,z bounds of the chip grid those chips form on one host.
+# libtpu parses TPU_CHIPS_PER_HOST_BOUNDS as a comma-separated 3-D bounds
+# string (a v5e 4-chip host is a 2x2x1 grid), NOT a bare count.
+_CHIP_GRID_BOUNDS = {
+    1: (1, 1, 1),
+    2: (1, 2, 1),
+    4: (2, 2, 1),
+    8: (2, 4, 1),
+    16: (4, 4, 1),
+}
+
+
+def chip_bounds(count: int) -> str:
+    """x,y,z bounds string for ``count`` chips (e.g. 4 → "2,2,1")."""
+    x, y, z = _CHIP_GRID_BOUNDS.get(count, (count, 1, 1))
+    return f"{x},{y},{z}"
+
+
 _LIBTPU_GLOBS = (
     "home/kubernetes/tpu/libtpu.so",
     "usr/lib/libtpu.so",

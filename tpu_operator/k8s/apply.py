@@ -79,6 +79,15 @@ async def create_or_update(
         for f in ("secrets", "imagePullSecrets"):
             if f in live and f not in obj:
                 obj[f] = live[f]
+    if gvk.kind == "Service":
+        # immutable/server-allocated Service fields: a full-replace PUT that
+        # omits spec.clusterIP is a 422 on a real apiserver, wedging the
+        # owning state in ERROR on any Service drift
+        live_spec = live.get("spec") or {}
+        spec = obj.setdefault("spec", {})
+        for f in ("clusterIP", "clusterIPs", "ipFamilies", "ipFamilyPolicy", "healthCheckNodePort"):
+            if f in live_spec and f not in spec:
+                spec[f] = live_spec[f]
     updated = await client.update(obj)
     log.info("updated %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
     return updated, True

@@ -60,15 +60,9 @@ class StateManager:
         client: ApiClient,
         ctx: ClusterContext,
         policy: TPUClusterPolicy,
-        skip_states: Optional[set[str]] = None,
     ) -> SyncResults:
-        # skip_states: TPURuntime-CRD bypass analogue (state_manager.go:955-965)
-        # — when TPURuntime CRs manage the runtime, the caller skips state-libtpu.
         out = SyncResults()
         for state in self.states:
-            if skip_states and state.name in skip_states:
-                out.results.append(StateResult(state.name, SyncState.IGNORE, "managed elsewhere"))
-                continue
             try:
                 result = await state.sync(client, ctx, policy)
             except Exception as e:  # noqa: BLE001

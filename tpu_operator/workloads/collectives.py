@@ -126,6 +126,9 @@ def allreduce_benchmark(
         "time_ms": dt * 1e3,
         "algbw_gbps": algbw,
         "busbw_gbps": busbw,
+        # n=1 moves no inter-chip traffic: the number is an HBM copy rate,
+        # not an ICI bandwidth, and must never be gated or reported as one
+        "transport": "ici" if n > 1 else "hbm-local",
         "backend": jax.default_backend(),
     }
 
@@ -170,17 +173,22 @@ def burn_in_params(mesh: Mesh, d_model: int = 512, d_hidden: int = 2048, seed: i
     return {"w1": w1, "w2": w2}
 
 
-def burn_in_step(mesh: Mesh, params: dict, x: jax.Array) -> jax.Array:
-    """One forward+backward-ish pass: dp-sharded batch through an mp-sharded
-    MLP, gradients psum'd over dp — exercises MXU matmuls plus ICI
-    collectives (all_gather of activations implicit via sharding, psum of
-    the scalar loss/grads)."""
+def burn_in_step(
+    mesh: Mesh, params: dict, x: jax.Array, lr: float = 0.05
+) -> tuple[jax.Array, dict]:
+    """One real SGD train step: dp-sharded batch through an mp-sharded MLP,
+    gradients pmean'd over dp, parameters updated in place — exercises MXU
+    matmuls plus ICI collectives (implicit all_gather via sharding, the mp
+    psum of row-parallel outputs, dp grad reduction).  Returns
+    ``(loss, new_params)`` so repeated steps move the loss, making the
+    acceptance test's trajectory a real signal instead of a re-run of one
+    cached forward."""
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(None, "mp"), P("mp", None), P("dp", None)),
-        out_specs=P(),
+        out_specs=(P(), P(None, "mp"), P("mp", None)),
     )
     def step(w1, w2, xs):
         def loss_fn(w1, w2):
@@ -190,19 +198,16 @@ def burn_in_step(mesh: Mesh, params: dict, x: jax.Array) -> jax.Array:
             return jnp.mean(jnp.square(y.astype(jnp.float32)))
 
         loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w1, w2)
-        # data-parallel gradient reduction, then an mp psum to fold the grad
-        # magnitude into the (replicated) scalar output — keeps the grad
-        # collectives live in the compiled program (no DCE) while out_specs
-        # stays fully replicated
+        # data-parallel gradient reduction; each mp shard updates its own
+        # parameter slice (grads are per-shard already — Megatron layout)
         g1 = jax.lax.pmean(grads[0], "dp")
         g2 = jax.lax.pmean(grads[1], "dp")
-        gsum = jax.lax.psum(
-            jnp.sum(g1).astype(jnp.float32) + jnp.sum(g2).astype(jnp.float32), "mp"
-        )
-        loss = jax.lax.pmean(loss, "dp")
-        return loss + 0.0 * gsum
+        new_w1 = (w1.astype(jnp.float32) - lr * g1.astype(jnp.float32)).astype(w1.dtype)
+        new_w2 = (w2.astype(jnp.float32) - lr * g2.astype(jnp.float32)).astype(w2.dtype)
+        return jax.lax.pmean(loss, "dp"), new_w1, new_w2
 
-    return step(params["w1"], params["w2"], x)
+    loss, w1, w2 = step(params["w1"], params["w2"], x)
+    return loss, {"w1": w1, "w2": w2}
 
 
 def burn_in(
@@ -222,11 +227,15 @@ def burn_in(
     losses = []
     t0 = time.perf_counter()
     for _ in range(steps):
-        losses.append(float(step(params, x)))
+        loss, params = step(params, x)
+        losses.append(float(loss))
     dt = time.perf_counter() - t0
     finite = all(np.isfinite(l) for l in losses)
+    # real updates ⇒ the trajectory must move; a flat line means the step
+    # silently stopped training (the r1 constant-loss failure mode)
+    decreasing = len(losses) < 2 or losses[-1] < losses[0]
     return {
-        "ok": finite,
+        "ok": finite and decreasing,
         "devices": mesh.size,
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
         "steps": steps,
