@@ -1,0 +1,143 @@
+"""Table tests for the nodeinfo attribute provider + filter builders
+(internal/nodeinfo/node_info.go + filter.go analogue)."""
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.k8s import nodeinfo
+
+
+def mk_node(name="n0", labels=None, allocatable=None, unschedulable=None,
+            runtime="containerd://1.7.0"):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"unschedulable": unschedulable},
+        "status": {
+            "allocatable": allocatable or {},
+            "nodeInfo": {
+                "containerRuntimeVersion": runtime,
+                "osImage": "Container-Optimized OS",
+                "kernelVersion": "6.1.0",
+            },
+        },
+    }
+
+
+def tpu_labels(accel="tpu-v5-lite-podslice", topo="2x4", **extra):
+    labels = {
+        consts.GKE_TPU_ACCELERATOR_LABEL: accel,
+        consts.GKE_TPU_TOPOLOGY_LABEL: topo,
+    }
+    labels.update(extra)
+    return labels
+
+
+ATTR_CASES = [
+    # (accelerator, topology, generation, hbm, chips_per_host, slice_hosts)
+    ("tpu-v5-lite-podslice", "2x4", "v5e", 16, 4, 2),
+    ("tpu-v5-lite-podslice", "4x4", "v5e", 16, 4, 4),
+    ("tpu-v5-lite-podslice", "2x2", "v5e", 16, 4, 1),  # single-host sub-shape
+    ("tpu-v5-lite-podslice", "1x1", "v5e", 16, 1, 1),  # 1-chip VM
+    ("tpu-v5-lite-device", "2x4", "v5e", 16, 8, 1),
+    ("tpu-v5p-slice", "4x4x4", "v5p", 95, 4, 16),
+    ("tpu-v4-podslice", "2x2x2", "v4", 32, 4, 2),
+    ("tpu-v6e-slice", "2x4", "v6e", 32, 4, 2),
+    ("tpu-something-new", "2x4", "unknown", 0, 4, 2),
+]
+
+
+@pytest.mark.parametrize("accel,topo,gen,hbm,chips,hosts", ATTR_CASES)
+def test_attribute_extraction_table(accel, topo, gen, hbm, chips, hosts):
+    node = mk_node(labels=tpu_labels(accel=accel, topo=topo))
+    attrs = nodeinfo.attributes(node)
+    assert attrs.is_tpu
+    assert attrs.generation == gen
+    assert attrs.hbm_gb == hbm
+    assert attrs.chips_per_host == chips
+    assert attrs.slice_hosts == hosts
+    assert attrs.container_runtime == "containerd"
+
+
+def test_cpu_node_attributes():
+    attrs = nodeinfo.attributes(mk_node(labels={"kubernetes.io/arch": "amd64"}))
+    assert not attrs.is_tpu
+    assert attrs.generation == ""
+    assert attrs.chips_per_host == 0
+    assert attrs.slice_hosts == 1
+    assert attrs.tpu_allocatable == 0
+
+
+def test_identity_and_status_attributes():
+    node = mk_node(
+        name="tpu-3",
+        labels=tpu_labels(
+            **{
+                consts.GKE_NODEPOOL_LABEL: "pool-a",
+                "cloud.google.com/gke-tpu-worker-id": "3",
+                consts.TFD_RUNTIME_VERSION_LABEL: "v9",
+                consts.UPGRADE_STATE_LABEL: "upgrade-required",
+            }
+        ),
+        allocatable={consts.TPU_RESOURCE: "4"},
+        unschedulable=True,
+    )
+    attrs = nodeinfo.attributes(node)
+    assert attrs.name == "tpu-3"
+    assert attrs.nodepool == "pool-a"
+    assert attrs.worker_id == "3"
+    assert attrs.runtime_version == "v9"
+    assert attrs.upgrade_state == "upgrade-required"
+    assert attrs.unschedulable
+    assert attrs.tpu_allocatable == 4
+    # the operator-owned TFD label wins over the GKE one when both exist
+    node["metadata"]["labels"][consts.TFD_SLICE_WORKER_ID_LABEL] = "7"
+    assert nodeinfo.attributes(node).worker_id == "7"
+
+
+def test_filter_builders():
+    nodes = [
+        mk_node("v5e-0", tpu_labels(), allocatable={consts.TPU_RESOURCE: "4"}),
+        mk_node("v5e-1", tpu_labels(), allocatable={}),
+        mk_node("v5p-0", tpu_labels(accel="tpu-v5p-slice", topo="4x4x4"),
+                allocatable={consts.TPU_RESOURCE: "4"}, unschedulable=True),
+        mk_node("cpu-0", {}),
+    ]
+    assert [n["metadata"]["name"] for n in nodeinfo.NodeFilter().tpu().apply(nodes)] == [
+        "v5e-0", "v5e-1", "v5p-0",
+    ]
+    f = nodeinfo.NodeFilter().accelerator("tpu-v5-lite-podslice").advertises_tpu()
+    assert [n["metadata"]["name"] for n in f.apply(nodes)] == ["v5e-0"]
+    f = nodeinfo.NodeFilter().tpu().schedulable()
+    assert [n["metadata"]["name"] for n in f.apply(nodes)] == ["v5e-0", "v5e-1"]
+    # selector map + absent
+    f = nodeinfo.NodeFilter().selector({consts.GKE_TPU_TOPOLOGY_LABEL: "4x4x4"})
+    assert [n["metadata"]["name"] for n in f.apply(nodes)] == ["v5p-0"]
+    f = nodeinfo.NodeFilter().absent(consts.GKE_TPU_ACCELERATOR_LABEL)
+    assert [n["metadata"]["name"] for n in f.apply(nodes)] == ["cpu-0"]
+
+
+def test_label_selector_serialization():
+    f = (
+        nodeinfo.NodeFilter()
+        .eq("a", "1")
+        .eq("b", "2")
+        .exists("c")
+        .absent("d")
+        .advertises_tpu()  # predicate: not serializable, silently client-side
+    )
+    assert f.label_selector() == "a=1,b=2,c,!d"
+
+
+def test_provider_pools():
+    nodes = [
+        mk_node("v5e-0", tpu_labels()),
+        mk_node("v5e-1", tpu_labels()),
+        mk_node("v5p-0", tpu_labels(accel="tpu-v5p-slice", topo="4x4x4")),
+        mk_node("cpu-0", {}),
+    ]
+    pools = nodeinfo.Provider(nodes).pools()
+    assert set(pools) == {
+        ("tpu-v5-lite-podslice", "2x4"), ("tpu-v5p-slice", "4x4x4"),
+    }
+    assert len(pools[("tpu-v5-lite-podslice", "2x4")]) == 2
+    assert pools[("tpu-v5p-slice", "4x4x4")][0].generation == "v5p"

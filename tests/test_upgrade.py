@@ -50,10 +50,21 @@ def _runtime_pod(fc, node_name, phase="Running"):
     })
 
 
+def _validator_pod(fc, node_name, phase="Running"):
+    fc.put({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"tpu-operator-validator-{node_name}", "namespace": NS,
+                     "labels": {"app": "tpu-operator-validator"}},
+        "spec": {"nodeName": node_name, "containers": [{"name": "c"}]},
+        "status": {"phase": phase},
+    })
+
+
 async def test_full_upgrade_lifecycle_single_node():
     async with FakeCluster(SimConfig(enabled=False)) as fc:
         client = await _mk_cluster(fc, n_nodes=1)
         _runtime_pod(fc, "tpu-0")
+        _validator_pod(fc, "tpu-0")  # pre-swap validator (stale evidence)
         try:
             r = up.UpgradeReconciler(client, NS)
 
@@ -65,29 +76,92 @@ async def test_full_upgrade_lifecycle_single_node():
             assert await state() in (up.DRAIN, up.POD_RESTART, up.CORDON)
             for _ in range(3):
                 await r.reconcile("upgrade")
-            # pod was deleted for the swap; node annotated
+            # runtime pod deleted for the swap; node cordoned + annotated.
+            # The pre-swap validator pod is still untouched at this point.
             node = await client.get("", "Node", "tpu-0")
             assert deep_get(node, "spec", "unschedulable") is True
-            pods = await client.list_items("", "Pod", NS)
-            assert pods == []  # runtime pod deleted, sim off so not recreated
+            names = {p["metadata"]["name"] for p in await client.list_items("", "Pod", NS)}
+            assert names == {"tpu-operator-validator-tpu-0"}
             assert await state() == up.POD_RESTART
 
-            # runtime pod comes back Running with NEW version → validation
+            # runtime pod comes back Running → the STALE validator pod is
+            # deleted at this transition so its replacement must re-prove
+            # against the new runtime
             _runtime_pod(fc, "tpu-0")
             await r.reconcile("upgrade")
             assert await state() == up.VALIDATION
+            names = {p["metadata"]["name"] for p in await client.list_items("", "Pod", NS)}
+            assert "tpu-operator-validator-tpu-0" not in names
             # version still old → stays in validation
             await r.reconcile("upgrade")
             assert await state() == up.VALIDATION
             node = await client.get("", "Node", "tpu-0")
             node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = "v2"
             fc.put(node)
+            # version caught up but no fresh validator pod yet → still gated
+            await r.reconcile("upgrade")
+            assert await state() == up.VALIDATION
+            _validator_pod(fc, "tpu-0")  # re-created pod passed its init chain
             await r.reconcile("upgrade")
             assert await state() == up.UNCORDON
             await r.reconcile("upgrade")
             assert await state() == up.DONE
             node = await client.get("", "Node", "tpu-0")
             assert not deep_get(node, "spec", "unschedulable")
+        finally:
+            await client.close()
+
+
+async def test_validator_failure_post_swap_marks_failed():
+    """A node whose validator crashes after the runtime swap must go
+    upgrade-failed and STAY CORDONED — never uncordon unproven."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        _runtime_pod(fc, "tpu-0")
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            for _ in range(4):
+                await r.reconcile("upgrade")
+            _runtime_pod(fc, "tpu-0")
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.VALIDATION
+
+            # new version is live but the validator pod crashed
+            node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = "v2"
+            fc.put(node)
+            _validator_pod(fc, "tpu-0", phase="Failed")
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.FAILED
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+async def test_validation_timeout_marks_failed():
+    """No validator evidence within validationTimeoutSeconds → upgrade-failed
+    (instead of waiting in validation-required forever)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+        cr["spec"]["libtpu"]["upgradePolicy"]["validationTimeoutSeconds"] = 1
+        await client.update(cr)
+        _runtime_pod(fc, "tpu-0")
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            for _ in range(4):
+                await r.reconcile("upgrade")
+            _runtime_pod(fc, "tpu-0")
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.VALIDATION
+
+            await asyncio.sleep(1.2)  # exceed the 1s validation budget
+            await r.reconcile("upgrade")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.FAILED
+            assert deep_get(node, "spec", "unschedulable") is True
         finally:
             await client.close()
 

@@ -19,20 +19,11 @@ from typing import Optional
 
 from tpu_operator import consts, hw
 from tpu_operator.agents import base
+from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient, Config
-from tpu_operator.utils import deep_get, parse_topology, topology_chips
+from tpu_operator.utils import deep_get, topology_chips
 
 log = logging.getLogger("tpu_operator.tfd")
-
-# accelerator label value → (generation, HBM GiB per chip)
-ACCELERATOR_INFO = {
-    "tpu-v4-podslice": ("v4", 32),
-    "tpu-v5-lite-podslice": ("v5e", 16),
-    "tpu-v5-lite-device": ("v5e", 16),
-    "tpu-v5p-slice": ("v5p", 95),
-    "tpu-v6e-slice": ("v6e", 32),
-    "tpu-v6e-device": ("v6e", 32),
-}
 
 
 def runtime_version() -> str:
@@ -57,7 +48,8 @@ def discover_features(node: dict) -> dict[str, str]:
     """Compute the tpu.google.com/* feature labels for this node."""
     labels = deep_get(node, "metadata", "labels", default={}) or {}
     accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
-    gen, hbm = ACCELERATOR_INFO.get(accel, ("unknown", 0))
+    info = nodeinfo.accelerator_info(accel)
+    gen, hbm = info.generation, info.hbm_gb
     chips = hw.chip_count()
     topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
     out = {
@@ -75,7 +67,7 @@ def discover_features(node: dict) -> dict[str, str]:
         except ValueError:
             pass
     worker_id = os.environ.get("TPU_WORKER_ID") or labels.get(
-        "cloud.google.com/gke-tpu-worker-id", ""
+        consts.GKE_TPU_WORKER_ID_LABEL, ""
     )
     if worker_id != "":
         out[consts.TFD_SLICE_WORKER_ID_LABEL] = str(worker_id)

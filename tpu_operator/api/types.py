@@ -242,6 +242,10 @@ class UpgradePolicySpec(SpecBase):
     auto_upgrade: bool = False
     max_parallel_upgrades: int = 1
     max_unavailable: Optional[str] = "25%"
+    # post-swap validation budget before the node is marked upgrade-failed
+    # instead of waiting forever in validation-required; 0 disables the
+    # timeout (wait indefinitely)
+    validation_timeout_seconds: int = 600
     wait_for_completion: WaitForCompletionSpec = field(default_factory=WaitForCompletionSpec)
     drain: DrainSpec = field(default_factory=DrainSpec)
     pod_deletion: PodDeletionSpec = field(default_factory=PodDeletionSpec)

@@ -16,6 +16,7 @@ TPU_RESOURCE = "google.com/tpu"
 # On GKE TPU node pools these are present out of the box.
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"   # e.g. tpu-v5-lite-podslice
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"         # e.g. 2x4, 4x4x4
+GKE_TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"       # host index in its slice
 
 # ---------------------------------------------------------------------------
 # Node labels owned by the operator (outputs).
@@ -75,6 +76,9 @@ TFD_RUNTIME_VERSION_LABEL = TFD_LABEL_PREFIX + "tpu.runtime.version"  # libtpu v
 LAST_APPLIED_HASH_ANNOTATION = "tpu.google.com/last-applied-hash"  # NvidiaAnnotationHashKey analogue
 STATE_LABEL = "tpu.google.com/tpu-operator.state"  # nvidia.com/gpu-operator.state analogue
 UPGRADE_REQUESTED_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-requested"
+# when the node entered its current upgrade state (drives the post-swap
+# validation timeout; survives operator restarts)
+UPGRADE_STATE_TS_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-state-ts"
 
 # ---------------------------------------------------------------------------
 # Ordered operand state names (controllers/state_manager.go:795-813 analogue).

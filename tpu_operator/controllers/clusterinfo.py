@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from tpu_operator import consts
+from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.state.render_data import ClusterContext
 from tpu_operator.utils import deep_get
@@ -18,23 +18,9 @@ from tpu_operator.utils import deep_get
 log = logging.getLogger("tpu_operator.clusterinfo")
 
 
-def is_tpu_node(node: dict) -> bool:
-    """GKE TPU node pools carry the accelerator label out of the box
-    (the reference's NFD-PCI-label detection, state_manager.go:117-121).
-
-    Deliberately NOT keyed on the operator's own tpu.present output label:
-    that would make label removal unreachable once a node was ever labelled
-    (accelerator label gone → node must be de-labelled).
-    """
-    labels = deep_get(node, "metadata", "labels", default={}) or {}
-    return consts.GKE_TPU_ACCELERATOR_LABEL in labels
-
-
-def runtime_of(node: dict) -> str:
-    """containerd://1.7.0 → containerd (getRuntimeString analogue,
-    state_manager.go:584-599)."""
-    version = deep_get(node, "status", "nodeInfo", "containerRuntimeVersion", default="")
-    return version.split("://", 1)[0] if "://" in version else ""
+# attribute parsing lives in the shared nodeinfo provider (k8s/nodeinfo.py)
+is_tpu_node = nodeinfo.is_tpu
+runtime_of = nodeinfo.container_runtime
 
 
 async def active_cluster_policy(client: ApiClient) -> Optional[dict]:

@@ -21,36 +21,14 @@ from typing import Optional
 from tpu_operator import consts
 from tpu_operator.api.types import TPUClusterPolicySpec
 from tpu_operator.controllers.clusterinfo import is_tpu_node
+from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient
-from tpu_operator.utils import deep_get, parse_topology, topology_chips
+from tpu_operator.utils import deep_get
 
 log = logging.getLogger("tpu_operator.labels")
 
-# chips per host by GKE accelerator type (TFD refines at runtime via PJRT)
-CHIPS_PER_HOST = {
-    "tpu-v4-podslice": 4,
-    "tpu-v5-lite-podslice": 4,
-    "tpu-v5-lite-device": 8,
-    "tpu-v5p-slice": 4,
-    "tpu-v6e-slice": 4,
-    "tpu-v6e-device": 8,
-}
-DEFAULT_CHIPS_PER_HOST = 4
-
-
-def chips_per_host(node: dict) -> int:
-    labels = deep_get(node, "metadata", "labels", default={}) or {}
-    accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
-    base = CHIPS_PER_HOST.get(accel, DEFAULT_CHIPS_PER_HOST)
-    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL)
-    if topo:
-        try:
-            # single-host topologies (e.g. 2x2) can hold fewer chips than the
-            # host maximum; multi-host slices never go below the per-host base
-            return min(base, topology_chips(topo)) if len(parse_topology(topo)) <= 2 else base
-        except ValueError:
-            pass
-    return base
+# attribute parsing lives in the shared nodeinfo provider (k8s/nodeinfo.py)
+chips_per_host = nodeinfo.chips_per_host
 
 
 def workload_config(node: dict, spec: TPUClusterPolicySpec) -> str:
@@ -97,28 +75,17 @@ def slice_group_key(node: dict) -> Optional[str]:
     GKE schedules one multi-host slice per node pool, so the nodepool label
     is the slice identity; single-host topologies return None (no pooled
     gate needed)."""
-    labels = deep_get(node, "metadata", "labels", default={}) or {}
-    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL)
-    if not topo:
-        return None
-    try:
-        total = topology_chips(topo)
-    except ValueError:
-        return None
-    if total <= chips_per_host(node):
+    attrs = nodeinfo.attributes(node)
+    if not attrs.topology or attrs.slice_hosts <= 1:
         return None  # single host holds the whole slice
     # Without a nodepool label, slice identity is unknowable — two distinct
     # same-topology slices would merge into one group and cross-contaminate
     # readiness.  No gate is safer than a wrong gate.
-    return labels.get(consts.GKE_NODEPOOL_LABEL)
+    return attrs.nodepool or None
 
 
 def node_advertises_tpu(node: dict) -> bool:
-    alloc = deep_get(node, "status", "allocatable", default={}) or {}
-    try:
-        return int(alloc.get(consts.TPU_RESOURCE, "0")) > 0
-    except ValueError:
-        return False
+    return nodeinfo.tpu_allocatable(node) > 0
 
 
 async def label_slice_readiness(
@@ -138,13 +105,7 @@ async def label_slice_readiness(
     result: dict[str, bool] = {}
     for key, members in groups.items():
         labels_of = {m["metadata"]["name"]: (deep_get(m, "metadata", "labels", default={}) or {}) for m in members}
-        expected = 0
-        for m in members:
-            topo = labels_of[m["metadata"]["name"]].get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
-            try:
-                expected = max(expected, topology_chips(topo) // max(1, chips_per_host(m)))
-            except ValueError:
-                pass
+        expected = max(nodeinfo.slice_hosts(m) for m in members)
         ready = len(members) >= max(1, expected) and all(
             node_advertises_tpu(m) for m in members
         )

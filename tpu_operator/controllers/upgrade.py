@@ -24,6 +24,7 @@ reflects back into the label the controller validates against.
 
 from __future__ import annotations
 
+import datetime
 import logging
 from typing import Optional
 
@@ -32,6 +33,7 @@ from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy 
 from tpu_operator.controllers import clusterinfo
 from tpu_operator.controllers.labels import node_advertises_tpu
 from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.utils import deep_get
@@ -51,6 +53,17 @@ FAILED = "upgrade-failed"
 IN_PROGRESS_STATES = (CORDON, DRAIN, POD_RESTART, VALIDATION, UNCORDON)
 
 RECONCILE_KEY = "upgrade"
+
+VALIDATOR_POD_SELECTOR = "app=tpu-operator-validator"
+
+
+def _parse_ts(ts: str) -> Optional[datetime.datetime]:
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(ts, fmt).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            continue
+    return None
 
 
 def parse_max_unavailable(value: Optional[str], total: int) -> int:
@@ -102,9 +115,7 @@ class UpgradeReconciler:
             name = node["metadata"]["name"]
             if states[name] and states[name] != DONE:
                 continue
-            current = deep_get(node, "metadata", "labels", default={}).get(
-                consts.TFD_RUNTIME_VERSION_LABEL
-            )
+            current = nodeinfo.attributes(node).runtime_version
             if desired and current and current != desired:
                 await self._set_state(name, REQUIRED)
                 states[name] = REQUIRED
@@ -143,10 +154,24 @@ class UpgradeReconciler:
                     await self._set_state(name, POD_RESTART)
                 elif state == POD_RESTART:
                     if await self._runtime_pod_running(name):
+                        # the NEW runtime is live — only NOW delete the
+                        # validator pod, so its replacement provably re-runs
+                        # the init chain against the new libtpu (deleting it
+                        # at swap time would let the DS recreate it while the
+                        # OLD .so was still installed, producing stale
+                        # Running evidence)
+                        await self._delete_validator_pods(name)
                         await self._set_state(name, VALIDATION)
                 elif state == VALIDATION:
-                    if self._validated(await self.client.get("", "Node", name), desired):
+                    live = await self.client.get("", "Node", name)
+                    vpod = await self._validator_pod(name)
+                    if self._validated(live, desired, policy, vpod):
                         await self._set_state(name, UNCORDON)
+                    elif self._validation_failed(live, vpod, up):
+                        log.error(
+                            "post-swap validation failed on %s; marking %s", name, FAILED
+                        )
+                        await self._set_state(name, FAILED)
                 elif state == UNCORDON:
                     await self._cordon(name, False)
                     await self._set_state(name, DONE)
@@ -162,14 +187,20 @@ class UpgradeReconciler:
 
     # ------------------------------------------------------------------
     def _state_of(self, node: dict) -> str:
-        return deep_get(node, "metadata", "labels", default={}).get(
-            consts.UPGRADE_STATE_LABEL, ""
-        )
+        return nodeinfo.attributes(node).upgrade_state
 
     async def _set_state(self, node_name: str, state: Optional[str]) -> None:
+        ts = (
+            datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+            if state is not None
+            else None
+        )
         await self.client.patch(
             "", "Node", node_name,
-            {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: state}}},
+            {"metadata": {
+                "labels": {consts.UPGRADE_STATE_LABEL: state},
+                "annotations": {consts.UPGRADE_STATE_TS_ANNOTATION: ts},
+            }},
         )
 
     async def _cordon(self, node_name: str, value: bool) -> None:
@@ -185,31 +216,56 @@ class UpgradeReconciler:
             self.client,
             node["metadata"]["name"],
             force=up.drain.force,
-            timeout=min(30.0, float(up.drain.timeout_seconds)),
+            timeout=float(up.drain.timeout_seconds),
+        )
+
+    def _node_pods(self, node_name: str, label_selector: str):
+        """Namespace pods on one node, filtered server-side."""
+        return self.client.list_items(
+            "", "Pod", self.namespace,
+            label_selector=label_selector,
+            field_selector=f"spec.nodeName={node_name}",
         )
 
     async def _request_runtime_swap(self, node: dict) -> None:
-        """Annotate + delete the OnDelete runtime DS pod on this node."""
+        """Annotate + delete the OnDelete runtime DS pod on this node.  The
+        validator pod is NOT touched here — it is deleted later, once the new
+        runtime pod is Running (see the POD_RESTART step), so that its
+        replacement's init chain re-proves pjrt→plugin→jax against the new
+        libtpu (cmd/gpu-operator/main.go:145 WithValidationEnabled analogue;
+        stale pre-swap validations must never pass a node)."""
         name = node["metadata"]["name"]
         await self.client.patch(
             "", "Node", name,
             {"metadata": {"annotations": {consts.UPGRADE_REQUESTED_ANNOTATION: "true"}}},
         )
-        pods = await self.client.list_items(
-            "", "Pod", self.namespace, label_selector="app=tpu-runtime"
-        )
-        for pod in pods:
-            if deep_get(pod, "spec", "nodeName") == name:
-                await self.client.delete("", "Pod", pod["metadata"]["name"], self.namespace)
-                log.info("deleted runtime pod %s for swap on %s", pod["metadata"]["name"], name)
+        for pod in await self._node_pods(name, "app=tpu-runtime"):
+            await self.client.delete("", "Pod", pod["metadata"]["name"], self.namespace)
+            log.info("deleted %s for swap on %s", pod["metadata"]["name"], name)
+
+    async def _delete_validator_pods(self, node_name: str) -> None:
+        """Clear every validator pod on the node (including lingering Failed
+        ones) so the DS-recreated pod is the only source of evidence."""
+        for pod in await self._node_pods(node_name, VALIDATOR_POD_SELECTOR):
+            await self.client.delete("", "Pod", pod["metadata"]["name"], self.namespace)
+            log.info("deleted %s for re-validation on %s", pod["metadata"]["name"], node_name)
+
+    async def _validator_pod(self, node_name: str) -> Optional[dict]:
+        """The validator pod whose state should gate this node: a Running
+        non-terminating pod wins over a lingering Failed sibling (an evicted
+        pod object persists until GC even after the DS recreated a healthy
+        replacement — it must not fail the upgrade)."""
+        best: Optional[dict] = None
+        for pod in await self._node_pods(node_name, VALIDATOR_POD_SELECTOR):
+            if deep_get(pod, "metadata", "deletionTimestamp"):
+                continue
+            if deep_get(pod, "status", "phase") == "Running":
+                return pod
+            best = best or pod
+        return best
 
     async def _runtime_pod_running(self, node_name: str) -> bool:
-        pods = await self.client.list_items(
-            "", "Pod", self.namespace, label_selector="app=tpu-runtime"
-        )
-        for pod in pods:
-            if deep_get(pod, "spec", "nodeName") != node_name:
-                continue
+        for pod in await self._node_pods(node_name, "app=tpu-runtime"):
             # the old pod lingers Running with a deletionTimestamp during
             # graceful termination — only a non-terminating pod counts
             if deep_get(pod, "metadata", "deletionTimestamp"):
@@ -217,17 +273,45 @@ class UpgradeReconciler:
             return deep_get(pod, "status", "phase") == "Running"
         return False
 
-    def _validated(self, node: dict, desired: Optional[str]) -> bool:
+    def _validated(
+        self,
+        node: dict,
+        desired: Optional[str],
+        policy: TPUClusterPolicy,
+        vpod: Optional[dict],
+    ) -> bool:
         """Post-swap gate before uncordon (validator-app gate analogue,
-        upgrade_controller.go:145): capacity advertised + version caught up."""
+        upgrade_controller.go:145): capacity advertised, version caught up,
+        and — when the validator operand is enabled — a FRESH validator pod
+        Running on the node.  The swap deleted the old validator pod, so any
+        Running one proves the full init chain re-ran against the new
+        runtime (phase only reaches Running after initContainers pass)."""
         if not node_advertises_tpu(node):
             return False
-        if desired:
-            current = deep_get(node, "metadata", "labels", default={}).get(
-                consts.TFD_RUNTIME_VERSION_LABEL
-            )
-            return current == desired
+        if desired and nodeinfo.attributes(node).runtime_version != desired:
+            return False
+        if policy.spec.validator.is_enabled():
+            return vpod is not None and deep_get(vpod, "status", "phase") == "Running"
         return True
+
+    def _validation_failed(self, node: dict, vpod: Optional[dict], up) -> bool:
+        """FAILED when the validator pod crashed outright, or the node sat in
+        validation-required past upgradePolicy.validationTimeoutSeconds
+        (0 = wait forever).  A failed node stays cordoned for operator
+        intervention instead of silently uncordoning unproven."""
+        if vpod is not None and deep_get(vpod, "status", "phase") == "Failed":
+            return True
+        timeout = float(getattr(up, "validation_timeout_seconds", 0) or 0)
+        if not timeout:
+            return False
+        ts = deep_get(node, "metadata", "annotations", default={}).get(
+            consts.UPGRADE_STATE_TS_ANNOTATION
+        )
+        entered = _parse_ts(ts) if ts else None
+        if entered is None:
+            return False
+        age = (datetime.datetime.now(datetime.timezone.utc) - entered).total_seconds()
+        return age > timeout
 
     async def _clear_labels(self, nodes: list[dict]) -> None:
         """Auto-upgrade disabled → remove state labels (:199-227)."""

@@ -1,0 +1,260 @@
+"""Typed node-attribute provider + label-filter builders.
+
+Reference analogue: ``internal/nodeinfo/`` — attribute extraction
+(node_info.go:34-37, attributes.go:108-121) and the filter builders of
+filter.go:22-143.  One source of truth for parsing TPU node attributes out
+of labels/status; the label engine, pool partitioner, upgrade controller,
+and feature discovery all consume this instead of re-deriving ad hoc.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from tpu_operator import consts
+from tpu_operator.utils import deep_get, parse_topology, topology_chips
+
+
+# ---------------------------------------------------------------------------
+# Accelerator catalogue — the one table mapping GKE accelerator label values
+# to chip generation, HBM per chip, and default chips per host.
+
+
+@dataclass(frozen=True)
+class AcceleratorInfo:
+    generation: str       # v4 | v5e | v5p | v6e
+    hbm_gb: int           # HBM per chip (GiB)
+    chips_per_host: int   # default host chip count for this machine shape
+
+
+ACCELERATORS: dict[str, AcceleratorInfo] = {
+    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4),
+    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4),
+    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8),
+    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4),
+    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4),
+    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8),
+}
+
+UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4)
+
+
+def accelerator_info(accelerator: str) -> AcceleratorInfo:
+    return ACCELERATORS.get(accelerator, UNKNOWN_ACCELERATOR)
+
+
+# ---------------------------------------------------------------------------
+# Attribute extraction.
+
+
+@dataclass(frozen=True)
+class NodeAttributes:
+    """Everything the operator derives from one Node object."""
+
+    name: str
+    is_tpu: bool
+    accelerator: str          # GKE accelerator label value ("" on CPU nodes)
+    topology: str             # ICI topology label ("2x4", "4x4x4", "")
+    generation: str           # chip generation ("v5e", ... or "unknown")
+    hbm_gb: int               # HBM per chip
+    chips_per_host: int       # chips this host actually exposes
+    slice_hosts: int          # hosts forming the slice (1 = single-host)
+    worker_id: str            # slice worker index label ("" when absent)
+    nodepool: str             # GKE nodepool label (slice identity)
+    runtime_version: str      # TFD-reported libtpu version label
+    upgrade_state: str        # upgrade state-machine label
+    os_image: str
+    kernel: str
+    container_runtime: str    # containerd | docker | crio ("" unknown)
+    unschedulable: bool
+    tpu_allocatable: int      # allocatable google.com/tpu count
+    labels: dict = field(hash=False, default_factory=dict, repr=False)
+
+
+def is_tpu(node: dict) -> bool:
+    """GKE TPU node pools carry the accelerator label out of the box
+    (NFD-PCI-label detection analogue, state_manager.go:117-121).  Keyed on
+    the GKE input label, never the operator's own tpu.present output — else
+    de-labelling would be unreachable."""
+    return consts.GKE_TPU_ACCELERATOR_LABEL in (
+        deep_get(node, "metadata", "labels", default={}) or {}
+    )
+
+
+def chips_per_host(node: dict) -> int:
+    """Host chip count: accelerator-shape default, reduced for single-host
+    sub-shapes (a 2x2 v5e VM holds 4 chips even on an 8-chip machine type);
+    multi-host slices never go below the per-host base."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    base = accelerator_info(labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")).chips_per_host
+    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL)
+    if topo:
+        try:
+            if len(parse_topology(topo)) <= 2:
+                return min(base, topology_chips(topo))
+        except ValueError:
+            pass
+    return base
+
+
+def slice_hosts(node: dict) -> int:
+    """Hosts forming this node's slice (topology chips / chips per host)."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    if not topo:
+        return 1
+    try:
+        return max(1, topology_chips(topo) // max(1, chips_per_host(node)))
+    except ValueError:
+        return 1
+
+
+def tpu_allocatable(node: dict) -> int:
+    alloc = deep_get(node, "status", "allocatable", default={}) or {}
+    try:
+        return int(alloc.get(consts.TPU_RESOURCE, "0"))
+    except ValueError:
+        return 0
+
+
+def container_runtime(node: dict) -> str:
+    """containerd://1.7.0 → containerd (getRuntimeString analogue,
+    state_manager.go:584-599)."""
+    version = deep_get(node, "status", "nodeInfo", "containerRuntimeVersion", default="")
+    return version.split("://", 1)[0] if "://" in version else ""
+
+
+def attributes(node: dict) -> NodeAttributes:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    info = accelerator_info(accel)
+    node_info = deep_get(node, "status", "nodeInfo", default={}) or {}
+    return NodeAttributes(
+        name=deep_get(node, "metadata", "name", default=""),
+        is_tpu=bool(accel),
+        accelerator=accel,
+        topology=labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
+        generation=info.generation if accel else "",
+        hbm_gb=info.hbm_gb if accel else 0,
+        chips_per_host=chips_per_host(node) if accel else 0,
+        slice_hosts=slice_hosts(node) if accel else 1,
+        worker_id=str(
+            labels.get(consts.TFD_SLICE_WORKER_ID_LABEL)
+            or labels.get(consts.GKE_TPU_WORKER_ID_LABEL, "")
+        ),
+        nodepool=labels.get(consts.GKE_NODEPOOL_LABEL, ""),
+        runtime_version=labels.get(consts.TFD_RUNTIME_VERSION_LABEL, ""),
+        upgrade_state=labels.get(consts.UPGRADE_STATE_LABEL, ""),
+        os_image=node_info.get("osImage", ""),
+        kernel=node_info.get("kernelVersion", ""),
+        container_runtime=container_runtime(node),
+        unschedulable=bool(deep_get(node, "spec", "unschedulable")),
+        tpu_allocatable=tpu_allocatable(node),
+        labels=dict(labels),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Label-filter builders (filter.go:22-143 analogue).
+
+
+class NodeFilter:
+    """Composable node predicate that can also serialize to an apiserver
+    label selector for the requirements expressible as one."""
+
+    def __init__(self) -> None:
+        self._eq: dict[str, str] = {}
+        self._exists: list[str] = []
+        self._absent: list[str] = []
+        self._preds: list[Callable[[dict], bool]] = []
+
+    # -- label requirements (selector-expressible) ---------------------
+    def eq(self, key: str, value: str) -> "NodeFilter":
+        self._eq[key] = value
+        return self
+
+    def exists(self, key: str) -> "NodeFilter":
+        self._exists.append(key)
+        return self
+
+    def absent(self, key: str) -> "NodeFilter":
+        self._absent.append(key)
+        return self
+
+    def selector(self, node_selector: Optional[dict]) -> "NodeFilter":
+        """Add every key=value of a k8s nodeSelector map."""
+        for k, v in (node_selector or {}).items():
+            self.eq(k, v)
+        return self
+
+    # -- common TPU shorthands -----------------------------------------
+    def tpu(self) -> "NodeFilter":
+        return self.exists(consts.GKE_TPU_ACCELERATOR_LABEL)
+
+    def accelerator(self, value: str) -> "NodeFilter":
+        return self.eq(consts.GKE_TPU_ACCELERATOR_LABEL, value)
+
+    def topology(self, value: str) -> "NodeFilter":
+        return self.eq(consts.GKE_TPU_TOPOLOGY_LABEL, value)
+
+    def upgrade_state(self, value: str) -> "NodeFilter":
+        return self.eq(consts.UPGRADE_STATE_LABEL, value)
+
+    # -- arbitrary predicates (client-side only) -----------------------
+    def where(self, pred: Callable[[dict], bool]) -> "NodeFilter":
+        self._preds.append(pred)
+        return self
+
+    def advertises_tpu(self) -> "NodeFilter":
+        return self.where(lambda n: tpu_allocatable(n) > 0)
+
+    def schedulable(self) -> "NodeFilter":
+        return self.where(lambda n: not deep_get(n, "spec", "unschedulable"))
+
+    # -- evaluation ----------------------------------------------------
+    def matches(self, node: dict) -> bool:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        if any(labels.get(k) != v for k, v in self._eq.items()):
+            return False
+        if any(k not in labels for k in self._exists):
+            return False
+        if any(k in labels for k in self._absent):
+            return False
+        return all(p(node) for p in self._preds)
+
+    def apply(self, nodes: Iterable[dict]) -> list[dict]:
+        return [n for n in nodes if self.matches(n)]
+
+    def label_selector(self) -> str:
+        """Server-side selector string for the label requirements (the
+        ``where`` predicates cannot be pushed down and are ignored here)."""
+        parts = [f"{k}={v}" for k, v in sorted(self._eq.items())]
+        parts += sorted(self._exists)
+        parts += [f"!{k}" for k in sorted(self._absent)]
+        return ",".join(parts)
+
+
+class Provider:
+    """Cached attribute provider over a node list (nodeinfo.Provider
+    analogue, node_info.go:34-37)."""
+
+    def __init__(self, nodes: list[dict]):
+        self.nodes = nodes
+
+    def tpu_nodes(self) -> list[dict]:
+        return [n for n in self.nodes if is_tpu(n)]
+
+    def attributes(self) -> list[NodeAttributes]:
+        return [attributes(n) for n in self.nodes]
+
+    def filtered(self, f: NodeFilter) -> list[NodeAttributes]:
+        return [attributes(n) for n in f.apply(self.nodes)]
+
+    def pools(self) -> dict[tuple[str, str], list[NodeAttributes]]:
+        """TPU nodes grouped by (accelerator, topology) — the axes that
+        differentiate the runtime payload (nodepool.go:55-133 analogue)."""
+        out: dict[tuple[str, str], list[NodeAttributes]] = {}
+        for attrs in (attributes(n) for n in self.tpu_nodes()):
+            out.setdefault((attrs.accelerator, attrs.topology), []).append(attrs)
+        return out

@@ -12,7 +12,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from tpu_operator import consts
-from tpu_operator.utils import deep_get, fnv1a_64
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.utils import fnv1a_64
 
 
 @dataclass(frozen=True)
@@ -46,19 +47,12 @@ def get_node_pools(nodes: list[dict], node_selector: dict | None = None) -> list
     ``node_selector``: the TPURuntime CR's own selector — only matching
     nodes join pools (nvidiadriver nodeSelector semantics).
     """
-    groups: dict[tuple[str, str], int] = {}
-    for node in nodes:
-        labels = deep_get(node, "metadata", "labels", default={}) or {}
-        accel = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL)
-        if not accel:
-            continue
-        if node_selector and any(labels.get(k) != v for k, v in node_selector.items()):
-            continue
-        topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
-        groups[(accel, topo)] = groups.get((accel, topo), 0) + 1
+    f = nodeinfo.NodeFilter().tpu().selector(node_selector)
+    groups = nodeinfo.Provider(f.apply(nodes)).pools()
 
     pools = []
-    for (accel, topo), count in sorted(groups.items()):
+    for (accel, topo), members in sorted(groups.items()):
+        count = len(members)
         selector = dict(node_selector or {})
         selector[consts.GKE_TPU_ACCELERATOR_LABEL] = accel
         if topo:
