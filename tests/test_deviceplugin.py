@@ -183,3 +183,225 @@ async def test_env_declared_chips_without_device_nodes(tmp_path, monkeypatch):
     plugin.refresh_devices()
     assert len(plugin.devices) == 8
     assert all(h == "Healthy" for h in plugin.health.values())
+
+
+# ---------------------------------------------------------------------------
+# Mixed slice strategy (MIG-mixed analogue)
+
+
+def test_host_units_mapping():
+    from tpu_operator.deviceplugin import sliceconfig
+
+    # v5p 4x4x4 split into two 2x4x4 halves; 4 chips/host, 16 hosts
+    layout = {
+        "profile": "all-balanced",
+        "topology": "4x4x4",
+        "partitions": [
+            {"shape": "2x4x4", "chip_ids": list(range(0, 32)), "hosts": list(range(8))},
+            {"shape": "2x4x4", "chip_ids": list(range(32, 64)), "hosts": list(range(8, 16))},
+        ],
+    }
+    # host 0 holds chips 0-3 of the first half
+    assert sliceconfig.host_units(layout, 0, 4) == {"2x4x4": [[0, 1, 2, 3]]}
+    # host 9 holds chips 36-39 → local 0-3 of the second half
+    assert sliceconfig.host_units(layout, 9, 4) == {"2x4x4": [[0, 1, 2, 3]]}
+    # empty layout → no units (flat resource fallback)
+    assert sliceconfig.host_units({"partitions": []}, 0, 4) == {}
+    assert sliceconfig.host_units(None, 0, 4) == {}
+
+
+async def test_mixed_strategy_serves_per_shape_resources(tmp_path, monkeypatch):
+    """After the slice manager applies all-balanced (two 2x2 partitions on an
+    8-chip host), the plugin set serves google.com/tpu-2x2 with TWO partition
+    units; allocating one unit maps its 4 chips with the 2x2 bounds env."""
+    import yaml
+
+    from tpu_operator import consts
+    from tpu_operator.agents.slice_manager import SliceManager
+    from tpu_operator.deviceplugin import sliceconfig
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.testing import FakeCluster, SimConfig
+
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(8):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    run_tpu = tmp_path / "run" / "tpu"
+    (run_tpu / "validations").mkdir(parents=True)
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(run_tpu))
+
+    cfg_file = tmp_path / "slice-config.yaml"
+    cfg_file.write_text(yaml.safe_dump({
+        "version": "v1",
+        "slice-configs": {
+            "all-balanced": [{
+                "accelerators": ["tpu-v5-lite-device"],
+                "topology": "2x4",
+                "partitions": ["2x2", "2x2"],
+            }],
+        },
+    }))
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node("tpu-0", accelerator="tpu-v5-lite-device", topology="2x4")
+        node["metadata"]["labels"][consts.SLICE_CONFIG_LABEL] = "all-balanced"
+        node["metadata"]["labels"][consts.TPU_COUNT_LABEL] = "8"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = SliceManager(client, "tpu-0", str(cfg_file))
+            assert await mgr.sync_once() == "success"
+
+    configs = sliceconfig.build_plugin_configs("mixed")
+    assert [c.resource_name for c in configs] == ["google.com/tpu-2x2"]
+    assert configs[0].device_shape == "2x2"
+    assert len(configs[0].device_sets) == 2
+
+    from tpu_operator.deviceplugin.plugin import TPUDevicePlugin
+    from tpu_operator.testing.fakekubelet import FakeKubelet
+
+    config = configs[0]
+    config.kubelet_dir = str(tmp_path / "kubelet")
+    plugin = TPUDevicePlugin(config)
+    await plugin.serve()
+    try:
+        async with FakeKubelet(config.kubelet_dir) as kubelet:
+            await plugin.register()
+            assert kubelet.registrations[0].resource_name == "google.com/tpu-2x2"
+            async with kubelet.plugin_channel(config.socket_name) as channel:
+                stub = rpc.DevicePluginStub(channel)
+                stream = stub.ListAndWatch(api_pb2.Empty())
+                first = await asyncio.wait_for(stream.read(), timeout=5)
+                assert [d.ID for d in first.devices] == ["tpu-2x2-0", "tpu-2x2-1"]
+                assert all(d.health == "Healthy" for d in first.devices)
+
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(devicesIDs=["tpu-2x2-1"])
+                )
+                cresp = (await stub.Allocate(req)).container_responses[0]
+                assert len(cresp.devices) == 4
+                # second 2x2 box of the 2x4 mesh: row-major ids interleave
+                # ((0,2),(0,3),(1,2),(1,3) → 2,3,6,7) — an ICI-contiguous
+                # box, not a flat id range
+                assert cresp.envs["TPU_VISIBLE_CHIPS"] == "2,3,6,7"
+                assert cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    finally:
+        await plugin.stop()
+
+
+def test_mixed_without_layout_falls_back_to_flat(tmp_path, monkeypatch):
+    from tpu_operator.deviceplugin import sliceconfig
+    from tpu_operator.deviceplugin.plugin import PluginConfig
+
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(tmp_path / "run" / "tpu"))
+    configs = sliceconfig.build_plugin_configs("mixed", PluginConfig())
+    assert len(configs) == 1
+    assert configs[0].resource_name == "google.com/tpu"
+    assert configs[0].device_sets is None
+
+
+async def test_run_plugins_rebuilds_on_layout_change(tmp_path, monkeypatch):
+    """The plugin daemon must notice a slice reconfig (file change) and
+    re-serve + re-register the new resource set."""
+    import json
+
+    from tpu_operator import consts
+    from tpu_operator.deviceplugin import sliceconfig
+    from tpu_operator.validator import status as vstatus
+
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    run_tpu = tmp_path / "run" / "tpu"
+    (run_tpu / "validations").mkdir(parents=True)
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(run_tpu))
+
+    kubelet_dir = str(tmp_path / "kubelet")
+    base = PluginConfig(kubelet_dir=kubelet_dir, health_interval=0.05)
+    async with FakeKubelet(kubelet_dir) as kubelet:
+        task = asyncio.create_task(
+            sliceconfig.run_plugins("mixed", base, poll_seconds=0.05)
+        )
+        try:
+            for _ in range(100):
+                if kubelet.registrations:
+                    break
+                await asyncio.sleep(0.05)
+            assert kubelet.registrations[-1].resource_name == consts.TPU_RESOURCE
+
+            # slice manager applies a 2x2+2x2 split → plugin set rebuilds
+            with open(vstatus.slice_config_path(), "w") as f:
+                json.dump({
+                    "profile": "p", "topology": "2x2",
+                    "partitions": [
+                        {"shape": "1x2", "chip_ids": [0, 1], "hosts": [0]},
+                        {"shape": "1x2", "chip_ids": [2, 3], "hosts": [0]},
+                    ],
+                }, f)
+            for _ in range(100):
+                if any(
+                    r.resource_name == "google.com/tpu-1x2"
+                    for r in kubelet.registrations
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert kubelet.registrations[-1].resource_name == "google.com/tpu-1x2"
+        finally:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+
+async def test_mixed_rejects_multi_unit_request(tmp_path, monkeypatch):
+    """Two partition units do not merge into one ICI box — the bounds env
+    could not describe the union, so the request must be rejected."""
+    import grpc
+
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    config = PluginConfig(
+        kubelet_dir=str(tmp_path / "kubelet"),
+        resource_name="google.com/tpu-1x2",
+        socket_name="tpu-1x2.sock",
+        device_sets={"tpu-1x2-0": [str(dev / "accel0"), str(dev / "accel1")],
+                     "tpu-1x2-1": [str(dev / "accel2"), str(dev / "accel3")]},
+        device_shape="1x2",
+    )
+    plugin = TPUDevicePlugin(config)
+    await plugin.serve()
+    try:
+        async with FakeKubelet(config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel(config.socket_name) as channel:
+                stub = rpc.DevicePluginStub(channel)
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(
+                        devicesIDs=["tpu-1x2-0", "tpu-1x2-1"]
+                    )
+                )
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await stub.Allocate(req)
+                assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        await plugin.stop()
+
+
+def test_accel_paths_numeric_order(tmp_path, monkeypatch):
+    """accel10 must sort after accel2 (chip index ↔ path alignment)."""
+    from tpu_operator import hw
+
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(12):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    names = [os.path.basename(p) for p in hw.accel_device_paths()]
+    assert names == [f"accel{i}" for i in range(12)]

@@ -36,7 +36,7 @@ STATE_FAILED = "failed"
 
 
 def applied_config_path() -> str:
-    return os.path.join(os.path.dirname(vstatus.validation_dir()), "slice_config.json")
+    return vstatus.slice_config_path()
 
 
 def read_applied() -> Optional[dict]:

@@ -11,6 +11,7 @@ cross-CR nodeSelector conflict validation (internal/validator/validator.go:
 
 from __future__ import annotations
 
+import asyncio
 import copy
 import logging
 from typing import Optional
@@ -200,8 +201,11 @@ class TPURuntimeReconciler:
             # CRs fight over the hash every pass and deleting one CR would
             # garbage-collect the SA out from under the other's DaemonSets.
             is_ds = obj.get("kind") == "DaemonSet"
-            if is_ds:
-                await self._recreate_on_selector_change(obj)
+            if is_ds and not await self._selector_safe(obj):
+                # old DS with a different (immutable) selector is still
+                # terminating; applying now would 422 — retry next requeue
+                ready = False
+                continue
             live, _ = await create_or_update(
                 self.client,
                 obj,
@@ -212,29 +216,42 @@ class TPURuntimeReconciler:
                 ready = False
         return ready
 
-    async def _recreate_on_selector_change(self, desired: dict) -> None:
+    async def _selector_safe(self, desired: dict) -> bool:
         """spec.selector is immutable: a live DS created by an older operator
         build with a different pod selector would 422 on replace-PUT.  Delete
-        it first so create_or_update recreates under the new selector (pods
-        re-roll; the runtime DS is OnDelete-tolerant by design)."""
+        it and report unsafe until the object is actually GONE — a replace
+        issued while the old object lingers with a deletionTimestamp hits the
+        same 422 this path exists to avoid (pods re-roll on recreate; the
+        runtime DS is OnDelete-tolerant by design)."""
+        name = desired["metadata"]["name"]
         try:
-            live = await self.client.get(
-                "apps", "DaemonSet", desired["metadata"]["name"], self.namespace
-            )
+            live = await self.client.get("apps", "DaemonSet", name, self.namespace)
         except ApiError as e:
             if e.not_found:
-                return
+                return True
             raise
         want = deep_get(desired, "spec", "selector", "matchLabels", default={})
         have = deep_get(live, "spec", "selector", "matchLabels", default={})
-        if want != have:
+        if want == have:
+            return True
+        if not deep_get(live, "metadata", "deletionTimestamp"):
             log.info(
                 "DS %s pod selector changed %s → %s; delete-and-recreate",
-                desired["metadata"]["name"], have, want,
+                name, have, want,
             )
-            await self.client.delete(
-                "apps", "DaemonSet", desired["metadata"]["name"], self.namespace
-            )
+            await self.client.delete("apps", "DaemonSet", name, self.namespace)
+        # brief poll: in the common case deletion completes immediately and
+        # this pass can recreate; a lingering finalizer defers to the next
+        # requeue instead of risking the 422
+        for _ in range(5):
+            try:
+                await self.client.get("apps", "DaemonSet", name, self.namespace)
+            except ApiError as e:
+                if e.not_found:
+                    return True
+                raise
+            await asyncio.sleep(0.1)
+        return False
 
     async def _cleanup_stale(self, runtime: TPURuntime, desired: set[str]) -> None:
         """Delete DaemonSets this CR owns that no pool wants any more

@@ -149,9 +149,20 @@ class UpgradeReconciler:
                     await self._cordon(name, True)
                     await self._set_state(name, DRAIN)
                 elif state == DRAIN:
-                    await self._drain(node, up)
-                    await self._request_runtime_swap(node)
-                    await self._set_state(name, POD_RESTART)
+                    drained = await self._drain_step(node, up)
+                    if drained:
+                        await self._request_runtime_swap(node)
+                        await self._set_state(name, POD_RESTART)
+                    elif self._state_age(node) > float(up.drain.timeout_seconds):
+                        if up.drain.force:
+                            log.warning(
+                                "drain timeout on %s; forcing swap per drain.force", name
+                            )
+                            await self._request_runtime_swap(node)
+                            await self._set_state(name, POD_RESTART)
+                        else:
+                            log.error("drain timed out on %s; marking %s", name, FAILED)
+                            await self._set_state(name, FAILED)
                 elif state == POD_RESTART:
                     if await self._runtime_pod_running(name):
                         # the NEW runtime is live — only NOW delete the
@@ -206,18 +217,44 @@ class UpgradeReconciler:
     async def _cordon(self, node_name: str, value: bool) -> None:
         await self.client.patch("", "Node", node_name, {"spec": {"unschedulable": value or None}})
 
-    async def _drain(self, node: dict, up) -> None:
-        """Evict TPU workload pods (gpuPodSpecFilter + drain spec)."""
-        if not up.drain.enable:
-            return
-        from tpu_operator.agents.runtime_manager import evict_tpu_pods
-
-        await evict_tpu_pods(
-            self.client,
-            node["metadata"]["name"],
-            force=up.drain.force,
-            timeout=float(up.drain.timeout_seconds),
+    def _state_age(self, node: dict) -> float:
+        """Seconds since the node entered its current upgrade state."""
+        ts = deep_get(node, "metadata", "annotations", default={}).get(
+            consts.UPGRADE_STATE_TS_ANNOTATION
         )
+        entered = _parse_ts(ts) if ts else None
+        if entered is None:
+            return 0.0
+        return (datetime.datetime.now(datetime.timezone.utc) - entered).total_seconds()
+
+    async def _drain_step(self, node: dict, up) -> bool:
+        """One non-blocking drain pass: delete TPU workload pods that are not
+        already terminating, report whether the node is drained.  The node
+        stays in DRAIN across requeues until empty — drain.timeoutSeconds is
+        enforced against the state-entry timestamp, never by sleeping inside
+        the reconcile worker (a stuck finalizer must not stall every other
+        node's upgrade)."""
+        if not up.drain.enable:
+            return True
+        from tpu_operator.agents.runtime_manager import pod_requests_tpu
+
+        name = node["metadata"]["name"]
+        pods = await self.client.list_items(
+            "", "Pod", field_selector=f"spec.nodeName={name}"
+        )
+        remaining = False
+        for pod in pods:
+            if not pod_requests_tpu(pod):
+                continue
+            meta = pod["metadata"]
+            refs = meta.get("ownerReferences") or []
+            if any(r.get("kind") == "DaemonSet" for r in refs) and not up.drain.force:
+                continue  # our own operands drain via the runtime swap
+            remaining = True
+            if not meta.get("deletionTimestamp"):
+                await self.client.delete("", "Pod", meta["name"], meta.get("namespace"))
+                log.info("evicted TPU pod %s/%s", meta.get("namespace"), meta["name"])
+        return not remaining
 
     def _node_pods(self, node_name: str, label_selector: str):
         """Namespace pods on one node, filtered server-side."""
@@ -302,16 +339,7 @@ class UpgradeReconciler:
         if vpod is not None and deep_get(vpod, "status", "phase") == "Failed":
             return True
         timeout = float(getattr(up, "validation_timeout_seconds", 0) or 0)
-        if not timeout:
-            return False
-        ts = deep_get(node, "metadata", "annotations", default={}).get(
-            consts.UPGRADE_STATE_TS_ANNOTATION
-        )
-        entered = _parse_ts(ts) if ts else None
-        if entered is None:
-            return False
-        age = (datetime.datetime.now(datetime.timezone.utc) - entered).total_seconds()
-        return age > timeout
+        return bool(timeout) and self._state_age(node) > timeout
 
     async def _clear_labels(self, nodes: list[dict]) -> None:
         """Auto-upgrade disabled → remove state labels (:199-227)."""

@@ -1,13 +1,20 @@
-"""python -m tpu_operator.deviceplugin [--mode accel|vfio]"""
+"""python -m tpu_operator.deviceplugin [--mode accel|vfio]
+
+SLICE_STRATEGY env (none|single|mixed, DS-injected from
+sliceManager.strategy) selects the plugin set: mixed serves one
+google.com/tpu-<shape> resource per applied partition shape.
+"""
 
 from __future__ import annotations
 
 import argparse
 import asyncio
 import logging
+import os
 
 from tpu_operator import consts
-from tpu_operator.deviceplugin.plugin import PluginConfig, TPUDevicePlugin
+from tpu_operator.deviceplugin import sliceconfig
+from tpu_operator.deviceplugin.plugin import PluginConfig
 
 
 def main() -> None:
@@ -16,21 +23,20 @@ def main() -> None:
     p.add_argument("--mode", choices=["accel", "vfio"], default="accel")
     p.add_argument("--resource-name", default=consts.TPU_RESOURCE)
     p.add_argument("--socket-name", default=None)
+    p.add_argument(
+        "--slice-strategy",
+        choices=["none", "single", "mixed"],
+        default=os.environ.get("SLICE_STRATEGY", "none") or "none",
+    )
     args = p.parse_args()
-    config = PluginConfig(
+    base = PluginConfig(
         resource_name=args.resource_name,
         mode=args.mode,
         socket_name=args.socket_name or ("tpu-vfio.sock" if args.mode == "vfio" else "tpu.sock"),
     )
-    plugin = TPUDevicePlugin(config)
-
-    async def run() -> None:
-        try:
-            await plugin.run_forever()
-        finally:
-            await plugin.stop()
-
-    asyncio.run(run())
+    # vfio plugins never partition (whole-host passthrough)
+    strategy = args.slice_strategy if args.mode == "accel" else "none"
+    asyncio.run(sliceconfig.run_plugins(strategy, base))
 
 
 if __name__ == "__main__":

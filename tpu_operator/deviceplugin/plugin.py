@@ -51,6 +51,11 @@ class PluginConfig:
         default_factory=lambda: float(os.environ.get("HEALTH_INTERVAL_SECONDS", "5"))
     )
     libtpu_dir: str = "/home/kubernetes/tpu"
+    # Static device sets (mixed slice strategy): device id → list of host
+    # chip paths forming one partition unit, plus the unit's ICI shape.
+    # None ⇒ dynamic per-chip discovery (one device per /dev/accel*).
+    device_sets: Optional[dict[str, list[str]]] = None
+    device_shape: str = ""  # partition shape these sets share, e.g. "2x2"
 
     @property
     def socket_path(self) -> str:
@@ -76,6 +81,34 @@ def device_id(path: str) -> str:
     return "tpu-" + os.path.basename(path)
 
 
+def read_worker_id() -> Optional[int]:
+    """This host's worker index within its multi-host slice: the
+    TPU_WORKER_ID env (DS-injected) wins, else the ``worker_id`` file
+    tpu-feature-discovery drops beside the validations dir.  None on
+    single-host nodes with neither source — the env is then omitted and
+    jax.distributed derives the id from its coordinator instead."""
+    env = os.environ.get("TPU_WORKER_ID")
+    if env is not None and env != "":
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    from tpu_operator.validator import status as vstatus
+
+    try:
+        with open(vstatus.worker_id_path()) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def shape_bounds(shape: str) -> str:
+    """ICI shape string → x,y,z bounds env value ("2x2" → "2,2,1")."""
+    dims = [d for d in shape.lower().split("x") if d]
+    dims += ["1"] * (3 - len(dims))
+    return ",".join(dims[:3])
+
+
 def chip_index(name: str) -> int:
     """Trailing chip number of a device id/path basename ('tpu-accel3' → 3)."""
     digits = ""
@@ -92,7 +125,7 @@ class TPUDevicePlugin:
 
     def __init__(self, config: Optional[PluginConfig] = None):
         self.config = config or PluginConfig()
-        self.devices: dict[str, str] = {}  # id -> host path
+        self.devices: dict[str, list[str]] = {}  # id -> host path(s)
         self.health: dict[str, str] = {}
         # one queue per live ListAndWatch stream (broadcast, not steal)
         self._watchers: set[asyncio.Queue] = set()
@@ -104,13 +137,30 @@ class TPUDevicePlugin:
         """Re-discover chips.  A previously-seen chip whose device node
         vanished stays advertised as Unhealthy (the kubelet's signal to fail
         pods bound to it) rather than silently dropping capacity."""
-        found = {device_id(p): p for p in discover_devices(self.config.mode)}
+        if self.config.device_sets is not None:
+            return self._refresh_static()
+        found = {device_id(p): [p] for p in discover_devices(self.config.mode)}
         devices = dict(found)
         health = {did: HEALTHY for did in found}
-        for did, path in self.devices.items():
+        for did, paths in self.devices.items():
             if did not in devices:
-                devices[did] = path
+                devices[did] = paths
                 health[did] = UNHEALTHY
+        changed = devices != self.devices or health != self.health
+        self.devices, self.health = devices, health
+        return changed
+
+    def _refresh_static(self) -> bool:
+        """Mixed-strategy partition units: membership is fixed by the slice
+        layout; only health moves.  A unit is Healthy when every chip node
+        exists — or when the host has no device nodes at all (env-declared
+        virtual chips, same rule the dynamic path applies)."""
+        devices = {did: list(paths) for did, paths in self.config.device_sets.items()}
+        virtual = not hw.accel_device_paths()
+        health = {
+            did: HEALTHY if virtual or all(os.path.exists(p) for p in paths) else UNHEALTHY
+            for did, paths in devices.items()
+        }
         changed = devices != self.devices or health != self.health
         self.devices, self.health = devices, health
         return changed
@@ -185,35 +235,60 @@ class TPUDevicePlugin:
     async def Allocate(self, request, context) -> api_pb2.AllocateResponse:
         resp = api_pb2.AllocateResponse()
         for creq in request.container_requests:
+            if self.config.device_shape and len(creq.devicesIDs) > 1:
+                # a partition unit is the isolation boundary (MIG-instance
+                # semantics); two units do not merge into a larger ICI box,
+                # so the bounds env could not describe the union truthfully
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"{self.config.resource_name}: at most one partition unit "
+                    "per container (request a larger slice shape instead)",
+                )
             cresp = api_pb2.ContainerAllocateResponse()
             chip_indices = []
             for did in creq.devicesIDs:
-                path = self.devices.get(did)
-                if path is None:
+                paths = self.devices.get(did)
+                if paths is None:
                     await context.abort(
                         grpc.StatusCode.INVALID_ARGUMENT, f"unknown device {did}"
                     )
-                # env-declared (virtual) chips have no device node to map;
-                # emitting a nonexistent host_path would fail containerd
-                if os.path.exists(path):
-                    cresp.devices.append(
-                        api_pb2.DeviceSpec(
-                            container_path=f"/dev/{os.path.basename(path)}",
-                            host_path=path,
-                            permissions="rw",
+                for path in paths:
+                    # env-declared (virtual) chips have no device node to
+                    # map; a nonexistent host_path would fail containerd
+                    if os.path.exists(path):
+                        cresp.devices.append(
+                            api_pb2.DeviceSpec(
+                                container_path=f"/dev/{os.path.basename(path)}",
+                                host_path=path,
+                                permissions="rw",
+                            )
                         )
-                    )
-                chip_indices.append(chip_index(os.path.basename(path)))
+                    chip_indices.append(chip_index(os.path.basename(path)))
             chip_indices.sort()
             cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in chip_indices)
             # libtpu wants the bounds of the chip grid the container sees as
             # a comma-separated x,y,z string, not a count ("2,2,1" for a
-            # 4-chip v5e host) — a bare count breaks PJRT init.
-            cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] = hw.chip_bounds(len(chip_indices))
+            # 4-chip v5e host) — a bare count breaks PJRT init.  Partition
+            # units carry their exact ICI shape; the dynamic path falls back
+            # to the canonical grid for the chip count.
+            if self.config.device_shape:
+                cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] = shape_bounds(
+                    self.config.device_shape
+                )
+            else:
+                cresp.envs["TPU_CHIPS_PER_HOST_BOUNDS"] = hw.chip_bounds(len(chip_indices))
             cresp.envs["TPU_RUNTIME_METRICS_PORTS"] = ",".join(
                 str(8431 + i) for i in chip_indices
             )
-            wid = self.worker_id()
+            # Worker id only describes multi-host slice membership, which
+            # holds only for FULL-HOST allocations of the flat resource:
+            # sub-host chips and mixed-strategy partition units are their own
+            # (single- or partition-scoped) topology, where a host-level id
+            # would misdeclare membership and break PJRT slice init.
+            full_host = not self.config.device_shape and chip_indices and len(
+                chip_indices
+            ) == len(self.devices)
+            wid = self.worker_id() if full_host else None
             if wid is not None:
                 cresp.envs["TPU_WORKER_ID"] = str(wid)
             if os.path.isdir(self.config.libtpu_dir):
@@ -228,24 +303,7 @@ class TPUDevicePlugin:
         return resp
 
     def worker_id(self) -> Optional[int]:
-        """This host's worker index within its multi-host slice: the
-        TPU_WORKER_ID env (DS-injected) wins, else the ``worker_id`` file
-        tpu-feature-discovery drops beside the validations dir.  None on
-        single-host nodes with neither source — the env is then omitted and
-        jax.distributed derives the id from its coordinator instead."""
-        env = os.environ.get("TPU_WORKER_ID")
-        if env is not None and env != "":
-            try:
-                return int(env)
-            except ValueError:
-                pass
-        from tpu_operator.validator import status as vstatus
-
-        try:
-            with open(vstatus.worker_id_path()) as f:
-                return int(f.read().strip())
-        except (OSError, ValueError):
-            return None
+        return read_worker_id()
 
     async def PreStartContainer(self, request, context) -> api_pb2.PreStartContainerResponse:
         return api_pb2.PreStartContainerResponse()

@@ -1,0 +1,161 @@
+"""Mixed slice strategy: partition layout → per-shape device-plugin set.
+
+Reference analogue: MIG ``mixed`` strategy, where the device plugin stops
+advertising bare ``nvidia.com/gpu`` and serves one resource per MIG profile
+(``nvidia.com/mig-1g.5gb`` …, controllers/object_controls.go:2230-2241).
+TPU version: the slice manager materialises the applied partition layout at
+``/run/tpu/slice_config.json`` (agents/slice_manager.py); under
+``sliceManager.strategy: mixed`` this module turns that layout into one
+plugin instance per partition SHAPE — resource ``google.com/tpu-<shape>``,
+each device being one partition unit (this host's chips of one partition),
+allocated atomically like a MIG instance.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from tpu_operator import consts, hw
+from tpu_operator.deviceplugin.plugin import PluginConfig, TPUDevicePlugin, read_worker_id
+from tpu_operator.validator import status as vstatus
+
+log = logging.getLogger("tpu_operator.deviceplugin")
+
+
+def read_layout() -> Optional[dict]:
+    """The applied slice layout, or None when absent/unreadable."""
+    try:
+        with open(vstatus.slice_config_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def config_signature() -> str:
+    """Change-detection key for the reconfig watch: the applied layout AND
+    this host's worker id — a late-arriving worker_id file (TFD starting
+    after the plugin DS on a fresh multi-host node) changes which partition
+    units this host owns and must rebuild the plugin set too."""
+    layout = read_layout()
+    sig = json.dumps(layout, sort_keys=True) if layout else ""
+    return f"{sig}|worker={_worker_id()}"
+
+
+def host_units(
+    layout: Optional[dict], worker_id: int, chips_per_host: int
+) -> dict[str, list[list[int]]]:
+    """{shape: [local chip indices of each partition unit on this host]}.
+
+    Global chip ids are row-major over the slice mesh; host h owns
+    [h*chips_per_host, (h+1)*chips_per_host) (slices.chip_assignments
+    convention).  A partition spanning several hosts contributes one unit
+    per host — each host advertises its share, and multi-host workloads
+    consume one unit per worker pod.
+    """
+    out: dict[str, list[list[int]]] = {}
+    if not layout:
+        return out
+    lo = worker_id * chips_per_host
+    hi = lo + chips_per_host
+    for part in layout.get("partitions") or []:
+        local = [cid - lo for cid in part.get("chip_ids", []) if lo <= cid < hi]
+        if local:
+            out.setdefault(part["shape"], []).append(sorted(local))
+    return out
+
+
+def resource_name(shape: str) -> str:
+    return f"{consts.TPU_RESOURCE}-{shape.lower()}"
+
+
+def build_plugin_configs(
+    strategy: str,
+    base: Optional[PluginConfig] = None,
+) -> list[PluginConfig]:
+    """The plugin set this node should run right now.
+
+    - strategy none/single, or mixed with an empty/whole-slice layout →
+      the single dynamic ``google.com/tpu`` plugin (MIG-single semantics:
+      homogeneous sub-slices still count under the flat resource).
+    - mixed with partitions → one static plugin per shape.
+    """
+    base = base or PluginConfig()
+    if strategy != "mixed":
+        return [base]
+    layout = read_layout()
+    chips = hw.chip_count()
+    worker = _worker_id()
+    units = host_units(layout, worker, max(1, chips))
+    if not units:
+        return [base]
+    configs = []
+    for shape, unit_list in sorted(units.items()):
+        sets = {
+            f"tpu-{shape}-{k}": [_chip_path(i) for i in unit]
+            for k, unit in enumerate(unit_list)
+        }
+        configs.append(
+            PluginConfig(
+                resource_name=resource_name(shape),
+                socket_name=f"tpu-{shape.lower()}.sock",
+                kubelet_dir=base.kubelet_dir,
+                mode=base.mode,
+                health_interval=base.health_interval,
+                libtpu_dir=base.libtpu_dir,
+                device_sets=sets,
+                device_shape=shape,
+            )
+        )
+    return configs
+
+
+def _worker_id() -> int:
+    wid = read_worker_id()
+    return wid if wid is not None else 0
+
+
+def _chip_path(local_index: int) -> str:
+    """Local chip index → host device path (existing node preferred; the
+    virtual fallback mirrors discover_devices' env-declared mode).
+    accel_device_paths is numerically ordered, so index N is chip N."""
+    paths = hw.accel_device_paths()
+    if local_index < len(paths):
+        return paths[local_index]
+    return f"/dev/accel{local_index}"
+
+
+async def run_plugins(strategy: str, base: PluginConfig, poll_seconds: float = 10.0) -> None:
+    """Serve the plugin set, rebuilding it whenever the applied slice layout
+    changes (the slice manager's post-reconfig 'notification' is the file
+    itself — plugins re-serve + re-register, kubelet picks up the new
+    resources)."""
+    import asyncio
+
+    while True:
+        configs = build_plugin_configs(strategy, base)
+        plugins = [TPUDevicePlugin(c) for c in configs]
+        log.info(
+            "serving %d plugin(s): %s",
+            len(plugins), [c.resource_name for c in configs],
+        )
+        tasks = [asyncio.create_task(p.run_forever()) for p in plugins]
+        signature = config_signature() if strategy == "mixed" else ""
+        try:
+            while True:
+                await asyncio.sleep(poll_seconds)
+                if strategy == "mixed" and config_signature() != signature:
+                    log.info("slice layout/worker-id changed; rebuilding plugin set")
+                    break
+        finally:
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            for p in plugins:
+                await p.stop()

@@ -17,11 +17,24 @@ def hw_root() -> str:
     return os.environ.get("TPU_HW_ROOT", "/")
 
 
+def _trailing_number(path: str) -> int:
+    digits = ""
+    for c in reversed(os.path.basename(path)):
+        if c.isdigit():
+            digits = c + digits
+        else:
+            break
+    return int(digits) if digits else -1
+
+
 def accel_device_paths() -> list[str]:
     """TPU chip device nodes: /dev/accel* (COS) or /dev/vfio/* when bound
-    for passthrough."""
+    for passthrough.  Numeric order — lexicographic sorting would put
+    accel10 before accel2, scrambling chip-index↔path alignment on 10+ chip
+    hosts."""
     root = hw_root()
-    return sorted(glob.glob(os.path.join(root, "dev", "accel*")))
+    paths = glob.glob(os.path.join(root, "dev", "accel*"))
+    return sorted(paths, key=lambda p: (_trailing_number(p), p))
 
 
 def vfio_device_paths() -> list[str]:

@@ -23,6 +23,13 @@ def validation_dir() -> str:
     return consts.VALIDATION_DIR
 
 
+def slice_config_path() -> str:
+    """/run/tpu/slice_config.json — the applied partition layout, written by
+    the slice manager and read by the device plugin for mixed-strategy
+    resource naming."""
+    return os.path.join(os.path.dirname(validation_dir()), "slice_config.json")
+
+
 def worker_id_path() -> str:
     """/run/tpu/worker_id — the handoff file between tpu-feature-discovery
     (writer) and node-local daemons without apiserver access, e.g. the device
