@@ -207,3 +207,119 @@ def test_metrics_mode(validation_root, fake_hw, capsys):
     assert 'tpu_validator_validation_status{component="libtpu"} 1.0' in out
     assert 'tpu_validator_validation_status{component="jax"} 0.0' in out
     assert "tpu_validator_tpu_device_count 4.0" in out
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _exec_distributed_pod(port: int):
+    """Executor for multi-host validation pods: run the REAL
+    workloads.distributed program as a subprocess, rewriting the in-cluster
+    coordinator DNS (no DNS in the fake) to the shared localhost port.
+    Pods execute concurrently, so the jax.distributed rendezvous is real."""
+
+    def execute(pod: dict) -> str:
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_operator.workloads.distributed"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        if result.returncode != 0:
+            print("distributed pod failed:", result.stdout[-2000:], result.stderr[-2000:])
+        return "Succeeded" if result.returncode == 0 else "Failed"
+
+    return execute
+
+
+async def test_multihost_slice_validation(validation_root):
+    """THE multi-host capability: two hosts of one slice each run a
+    validator; worker 0 creates the coordinated pod set (headless Service +
+    one pinned pod per host); the fake kubelet executes both pods
+    CONCURRENTLY as real processes that jax.distributed-rendezvous and run
+    a global psum + burn-in; each host's jax-ready gates on its own pod."""
+    port = _free_port()
+    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_distributed_pod(port))
+    async with FakeCluster(sim) as fc:
+        for i in range(2):
+            node = fc.add_node(
+                f"tpu-{i}",
+                topology="2x4",  # 8 chips / 4 per host = 2 hosts
+                labels={
+                    consts.GKE_NODEPOOL_LABEL: "pool-a",
+                    consts.GKE_TPU_WORKER_ID_LABEL: str(i),
+                },
+            )
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as c0, ApiClient(
+            Config(base_url=fc.base_url)
+        ) as c1:
+            status.write_ready("plugin")
+            v0 = Validator(
+                fast_config(node_name="tpu-0", with_workload=True,
+                            sleep_interval=0.1, workload_retries=900),
+                client=c0,
+            )
+            v1 = Validator(
+                fast_config(node_name="tpu-1", with_workload=True,
+                            sleep_interval=0.1, workload_retries=900),
+                client=c1,
+            )
+            await asyncio.gather(v0.run("jax"), v1.run("jax"))
+
+            payload = status.read_status("jax")
+            assert payload["mode"] == "multi-host"
+            assert payload["workers"] == 2
+            assert payload["group"] == "pool-a"
+            # both per-host pods really succeeded
+            for wid, node_name in ((0, "tpu-0"), (1, "tpu-1")):
+                pod = await c0.get("", "Pod", f"tpu-jax-validation-pool-a-w{wid}", NS)
+                assert deep_get(pod, "status", "phase") == "Succeeded"
+                assert deep_get(pod, "spec", "nodeName") == node_name
+                envs = {
+                    e["name"]: e["value"]
+                    for e in deep_get(pod, "spec", "containers", 0, "env")
+                }
+                assert envs["NUM_PROCESSES"] == "2"
+                assert envs["PROCESS_ID"] == str(wid)
+            # headless rendezvous Service exists
+            svc = await c0.get("", "Service", "tpu-jax-validation-pool-a", NS)
+            assert svc["spec"]["clusterIP"] == "None"
+
+
+async def test_multihost_requires_all_hosts_present(validation_root):
+    """A slice with a missing host must FAIL validation, not quietly
+    validate the subset (set-property semantics)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        node = fc.add_node(
+            "tpu-0",
+            topology="4x4",  # 16 chips / 4 = 4 hosts, but only 1 present
+            labels={
+                consts.GKE_NODEPOOL_LABEL: "pool-b",
+                consts.GKE_TPU_WORKER_ID_LABEL: "0",
+            },
+        )
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            v = Validator(
+                fast_config(node_name="tpu-0", with_workload=True), client=client
+            )
+            with pytest.raises(ValidationError, match="1/4 hosts"):
+                await v.run("jax")

@@ -34,6 +34,7 @@ from tpu_operator.validator import status
 log = logging.getLogger("tpu_operator.validator")
 
 LIBTPU_CTR_MARKER = ".libtpu-ctr-ready"
+COORDINATOR_PORT = 8476  # jax.distributed coordinator (worker 0's pod)
 
 
 @dataclass
@@ -163,9 +164,16 @@ class Validator:
         raise ValidationError(f"node {self.config.node_name} never advertised {consts.TPU_RESOURCE}")
 
     async def validate_jax(self) -> None:
-        """The collective gate: allreduce + burn-in over all local chips."""
+        """The collective gate: allreduce + burn-in over all local chips —
+        or, on a multi-host slice, ONE jax.distributed program across every
+        host of the slice (SURVEY §7 hard parts 1 & 3: slice health is a set
+        property; no reference analogue, GPU validation is node-local)."""
         await self.wait_ready("plugin", retries=self.config.resource_retries)
         if self.config.with_workload:
+            group = await self._slice_group()
+            if group is not None:
+                await self.validate_jax_multihost(*group)
+                return
             chips = await self._node_chip_count()
             await self.spawn_workload(
                 "tpu-jax-workload-validation",
@@ -193,6 +201,164 @@ class Validator:
 
         payload = await asyncio.get_event_loop().run_in_executor(None, run_checks)
         status.write_ready("jax", payload)
+
+    # ------------------------------------------------------------------
+    # Multi-host slice validation (jax.distributed-coordinated worker pods).
+
+    async def _slice_group(self) -> Optional[tuple[str, list[dict]]]:
+        """(group_key, ordered member nodes) when this node belongs to a
+        multi-host slice; None on single-host nodes.  Membership = same GKE
+        nodepool (one multi-host slice per node pool); ordering = worker id
+        (TFD / GKE label)."""
+        from tpu_operator.controllers.labels import slice_group_key
+        from tpu_operator.k8s import nodeinfo
+
+        if not self.config.node_name:
+            return None
+        client = self.client()
+        node = await client.get("", "Node", self.config.node_name)
+        key = slice_group_key(node)
+        if key is None:
+            return None
+        members = (
+            nodeinfo.NodeFilter()
+            .tpu()
+            .eq(consts.GKE_NODEPOOL_LABEL, key)
+            .apply(await client.list_items("", "Node"))
+        )
+        members.sort(key=lambda n: int(nodeinfo.attributes(n).worker_id or "0"))
+        expected = max(nodeinfo.slice_hosts(m) for m in members)
+        if len(members) < expected:
+            raise ValidationError(
+                f"slice {key}: only {len(members)}/{expected} hosts present"
+            )
+        return key, members
+
+    def _group_pod_name(self, key: str, worker_id: int) -> str:
+        from tpu_operator.state.nodepool import hashed_name
+
+        return hashed_name("tpu-jax-validation", f"{key}-w{worker_id}")
+
+    def _group_service_name(self, key: str) -> str:
+        from tpu_operator.state.nodepool import hashed_name
+
+        return hashed_name("tpu-jax-validation", key)
+
+    async def validate_jax_multihost(self, key: str, members: list[dict]) -> None:
+        """One global collective across every host of the slice.
+
+        Worker 0's validator creates the coordination resources — a headless
+        Service plus one workload pod per slice host, each pinned to its
+        node and running ``workloads.distributed`` with
+        jax.distributed.initialize(coordinator=worker-0-pod DNS) — then every
+        host's validator (including 0) gates its own ``jax-ready`` on ITS
+        pod succeeding, which can only happen if the GLOBAL psum + burn-in
+        passed on all hosts (any missing worker fails the whole rendezvous).
+        Reference pattern: workload-pod spawning of validator/main.go:941-1052,
+        lifted from one pod to a coordinated set."""
+        from tpu_operator.k8s import nodeinfo
+
+        my_attrs = next(
+            nodeinfo.attributes(m)
+            for m in members
+            if m["metadata"]["name"] == self.config.node_name
+        )
+        my_id = int(my_attrs.worker_id or "0")
+        svc = self._group_service_name(key)
+        coordinator = (
+            f"{self._group_pod_name(key, 0)}.{svc}."
+            f"{self.config.namespace}.svc:{COORDINATOR_PORT}"
+        )
+        if my_id == 0:
+            await self._create_group_workloads(key, members, svc, coordinator)
+
+        # gate on THIS host's pod (per-host evidence; global success implied)
+        name = self._group_pod_name(key, my_id)
+        client = self.client()
+        phase = None
+        for _ in range(self.config.workload_retries):
+            try:
+                live = await client.get("", "Pod", name, self.config.namespace)
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                # worker 0 may not have created the set yet
+                await asyncio.sleep(self.config.sleep_interval)
+                continue
+            phase = deep_get(live, "status", "phase")
+            if phase == "Succeeded":
+                status.write_ready(
+                    "jax",
+                    {
+                        "mode": "multi-host",
+                        "group": key,
+                        "workers": len(members),
+                        "worker_id": my_id,
+                    },
+                )
+                return
+            if phase == "Failed":
+                raise ValidationError(
+                    f"distributed validation pod {name} failed (slice {key})"
+                )
+            await asyncio.sleep(self.config.sleep_interval)
+        raise ValidationError(
+            f"distributed validation pod {name} did not complete (phase={phase})"
+        )
+
+    async def _create_group_workloads(
+        self, key: str, members: list[dict], svc: str, coordinator: str
+    ) -> None:
+        """Worker 0 only: headless Service + one pinned pod per slice host."""
+        from tpu_operator.k8s import nodeinfo
+
+        client = self.client()
+        owner = await self._owner_daemonset()
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": svc,
+                "namespace": self.config.namespace,
+                "labels": {"app": "tpu-jax-validation", "tpu.google.com/slice-group": svc},
+            },
+            "spec": {
+                "clusterIP": "None",  # headless: per-pod DNS for the rendezvous
+                "selector": {"tpu.google.com/slice-group": svc},
+                "ports": [{"port": COORDINATOR_PORT, "name": "coordinator"}],
+            },
+        }
+        if owner is not None:
+            from tpu_operator.k8s import objects as obj_api
+
+            obj_api.set_owner_reference(service, owner)
+        try:
+            await client.create(service)
+        except ApiError as e:
+            if not e.conflict:
+                raise
+        for member in members:
+            attrs = nodeinfo.attributes(member)
+            wid = int(attrs.worker_id or "0")
+            name = self._group_pod_name(key, wid)
+            pod = self._workload_pod(
+                name, checks="", tpu_request=max(1, attrs.chips_per_host), owner=owner
+            )
+            pod["metadata"]["labels"]["tpu.google.com/slice-group"] = svc
+            spec = pod["spec"]
+            spec["nodeName"] = attrs.name
+            # per-pod DNS record under the headless Service
+            spec["hostname"] = name
+            spec["subdomain"] = svc
+            container = spec["containers"][0]
+            container["command"] = ["python", "-m", "tpu_operator.workloads.distributed"]
+            container["env"] = [
+                {"name": "COORDINATOR_ADDRESS", "value": coordinator},
+                {"name": "NUM_PROCESSES", "value": str(len(members))},
+                {"name": "PROCESS_ID", "value": str(wid)},
+            ]
+            await client.delete("", "Pod", name, self.config.namespace)
+            await client.create(pod)
 
     async def validate_vfio(self) -> None:
         devices = hw.vfio_device_paths()
