@@ -405,3 +405,49 @@ def test_accel_paths_numeric_order(tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
     names = [os.path.basename(p) for p in hw.accel_device_paths()]
     assert names == [f"accel{i}" for i in range(12)]
+
+def test_mixed_multihost_layout_without_worker_id_serves_flat(tmp_path, monkeypatch):
+    """No worker-id source yet (TFD hasn't dropped the handoff file): a
+    multi-host layout must NOT be served as worker 0's units — that would
+    advertise another host's partitions backed by the wrong chips."""
+    import json as _json
+
+    from tpu_operator.deviceplugin import sliceconfig
+    from tpu_operator.deviceplugin.plugin import PluginConfig
+    from tpu_operator.validator import status as vstatus
+
+    dev = tmp_path / "hw" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(tmp_path / "run" / "tpu"))
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    (tmp_path / "run" / "tpu").mkdir(parents=True)
+    # 4x4 slice, 4 hosts — partitions span chips beyond host 0's range
+    with open(vstatus.slice_config_path(), "w") as f:
+        _json.dump({
+            "topology": "4x4",
+            "partitions": [
+                {"shape": "2x4", "chip_ids": list(range(0, 8))},
+                {"shape": "2x4", "chip_ids": list(range(8, 16))},
+            ],
+        }, f)
+    configs = sliceconfig.build_plugin_configs("mixed", PluginConfig())
+    assert len(configs) == 1
+    assert configs[0].resource_name == "google.com/tpu"
+
+    # single-host layout: worker identity is irrelevant → mixed units served
+    with open(vstatus.slice_config_path(), "w") as f:
+        _json.dump({
+            "topology": "2x2",
+            "partitions": [{"shape": "1x2", "chip_ids": [0, 1]},
+                           {"shape": "1x2", "chip_ids": [2, 3]}],
+        }, f)
+    configs = sliceconfig.build_plugin_configs("mixed", PluginConfig())
+    assert {c.resource_name for c in configs} == {"google.com/tpu-1x2"}
+
+    # the worker id arriving flips the signature → daemon rebuild triggers
+    sig_before = sliceconfig.config_signature()
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert sliceconfig.config_signature() != sig_before

@@ -250,3 +250,82 @@ async def test_metrics_reported():
             assert r.metrics.auto_upgrade_enabled._value.get() == 1
         finally:
             await client.close()
+
+def _tpu_pod(fc, name, node_name, owner_kind=None):
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodeName": node_name, "containers": [
+            {"name": "c", "resources": {"limits": {consts.TPU_RESOURCE: "4"}}},
+        ]},
+        "status": {"phase": "Running"},
+    }
+    if owner_kind:
+        pod["metadata"]["ownerReferences"] = [
+            {"kind": owner_kind, "name": "owner", "uid": "u1", "apiVersion": "apps/v1"}
+        ]
+    fc.put(pod)
+    return pod
+
+
+async def test_drain_ignores_daemonset_pods_even_with_force():
+    """kubectl drain --ignore-daemonsets semantics: a DS recreates deleted
+    pods instantly, so counting or deleting them makes a forced drain churn
+    forever.  force applies only to bare pods."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            policy = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "force": True, "timeoutSeconds": 30}}}})
+            pol = policy.spec.libtpu.upgrade_policy
+            node = await client.get("", "Node", "tpu-0")
+            _tpu_pod(fc, "plugin-pod", "tpu-0", owner_kind="DaemonSet")
+            assert await r._drain_step(node, pol) is True
+            # the DS pod must not have been evicted
+            assert await client.get("", "Pod", "plugin-pod", "default")
+        finally:
+            await client.close()
+
+
+async def test_drain_bare_pod_blocks_unless_forced():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            node = await client.get("", "Node", "tpu-0")
+            _tpu_pod(fc, "bare-pod", "tpu-0")
+            no_force = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "force": False, "timeoutSeconds": 30}}}}
+            ).spec.libtpu.upgrade_policy
+            assert await r._drain_step(node, no_force) is False
+            assert await client.get("", "Pod", "bare-pod", "default")  # not deleted
+
+            force = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "force": True, "timeoutSeconds": 30}}}}
+            ).spec.libtpu.upgrade_policy
+            assert await r._drain_step(node, force) is False  # deleted, still terminating
+            pods = {p["metadata"]["name"] for p in await client.list_items("", "Pod", "default")}
+            assert "bare-pod" not in pods
+        finally:
+            await client.close()
+
+
+async def test_drain_evicts_replicaset_pods_without_force():
+    """Controller-managed (non-DS) TPU pods are evicted like kubectl drain
+    does, force or not; the drain reports done once they are gone."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            node = await client.get("", "Node", "tpu-0")
+            _tpu_pod(fc, "rs-pod", "tpu-0", owner_kind="ReplicaSet")
+            pol = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "force": False, "timeoutSeconds": 30}}}}
+            ).spec.libtpu.upgrade_policy
+            assert await r._drain_step(node, pol) is False  # evicted this pass
+            pods = {p["metadata"]["name"] for p in await client.list_items("", "Pod", "default")}
+            assert "rs-pod" not in pods
+            assert await r._drain_step(node, pol) is True  # gone → drained
+        finally:
+            await client.close()

@@ -17,7 +17,7 @@ from tpu_operator import consts
 from tpu_operator.k8s.client import ApiClient, Config
 from tpu_operator.testing import FakeCluster, SimConfig
 from tpu_operator.utils import deep_get
-from tpu_operator.validator import status
+from tpu_operator.validator import components, status
 from tpu_operator.validator.components import (
     LIBTPU_CTR_MARKER,
     ValidationError,
@@ -219,13 +219,17 @@ def _free_port() -> int:
     return port
 
 
-def _exec_distributed_pod(port: int):
+def _exec_distributed_pod(port: int, executed: list | None = None):
     """Executor for multi-host validation pods: run the REAL
     workloads.distributed program as a subprocess, rewriting the in-cluster
     coordinator DNS (no DNS in the fake) to the shared localhost port.
-    Pods execute concurrently, so the jax.distributed rendezvous is real."""
+    Pods execute concurrently, so the jax.distributed rendezvous is real.
+    ``executed`` collects the pod objects (the validator garbage-collects
+    them post-success, so assertions need the captured copies)."""
 
     def execute(pod: dict) -> str:
+        if executed is not None:
+            executed.append(pod)
         spec = pod["spec"]["containers"][0]
         env = {
             **os.environ,
@@ -253,7 +257,10 @@ async def test_multihost_slice_validation(validation_root):
     CONCURRENTLY as real processes that jax.distributed-rendezvous and run
     a global psum + burn-in; each host's jax-ready gates on its own pod."""
     port = _free_port()
-    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_distributed_pod(port))
+    executed: list = []
+    sim = SimConfig(
+        pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_distributed_pod(port, executed)
+    )
     async with FakeCluster(sim) as fc:
         for i in range(2):
             node = fc.add_node(
@@ -286,10 +293,11 @@ async def test_multihost_slice_validation(validation_root):
             assert payload["mode"] == "multi-host"
             assert payload["workers"] == 2
             assert payload["group"] == "pool-a"
-            # both per-host pods really succeeded
+            # both per-host pods really executed, pinned and numbered right
+            by_name = {p["metadata"]["name"]: p for p in executed}
+            assert len(by_name) == 2
             for wid, node_name in ((0, "tpu-0"), (1, "tpu-1")):
-                pod = await c0.get("", "Pod", f"tpu-jax-validation-pool-a-w{wid}", NS)
-                assert deep_get(pod, "status", "phase") == "Succeeded"
+                pod = by_name[f"tpu-jax-validation-pool-a-w{wid}"]
                 assert deep_get(pod, "spec", "nodeName") == node_name
                 envs = {
                     e["name"]: e["value"]
@@ -297,9 +305,23 @@ async def test_multihost_slice_validation(validation_root):
                 }
                 assert envs["NUM_PROCESSES"] == "2"
                 assert envs["PROCESS_ID"] == str(wid)
-            # headless rendezvous Service exists
+                assert pod["metadata"]["labels"][components.EPOCH_LABEL]
+            # worker 0 garbage-collected the Succeeded pods post-proof —
+            # pod count returns to baseline, evidence lives on the Service
+            pods = await c0.list_items("", "Pod", NS)
+            assert not [
+                p for p in pods
+                if p["metadata"]["name"].startswith("tpu-jax-validation")
+            ]
+            # headless rendezvous Service remains, carrying the epoch tombstone
             svc = await c0.get("", "Service", "tpu-jax-validation-pool-a", NS)
             assert svc["spec"]["clusterIP"] == "None"
+            assert (
+                deep_get(svc, "metadata", "annotations", default={}).get(
+                    components.VALIDATED_EPOCH_ANNOTATION
+                )
+                == payload["epoch"]
+            )
 
 
 async def test_multihost_requires_all_hosts_present(validation_root):
@@ -323,3 +345,145 @@ async def test_multihost_requires_all_hosts_present(validation_root):
             )
             with pytest.raises(ValidationError, match="1/4 hosts"):
                 await v.run("jax")
+
+
+def _slice_node(fc, name, wid, pool="pool-a", topology="2x4"):
+    node = fc.add_node(
+        name,
+        topology=topology,
+        labels={
+            consts.GKE_NODEPOOL_LABEL: pool,
+            **({consts.GKE_TPU_WORKER_ID_LABEL: wid} if wid is not None else {}),
+        },
+    )
+    node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+    fc.put(node)
+    return node
+
+
+async def test_slice_group_rejects_malformed_worker_ids(validation_root):
+    """Worker-id labels must be numeric, unique, and cover 0..N-1 — hosts
+    silently collapsing to id 0 would collide with the real worker 0
+    (duplicate pod names, wrong PROCESS_ID in the rendezvous)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", "not-a-number")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            v = Validator(fast_config(node_name="tpu-0", with_workload=True), client=client)
+            with pytest.raises(ValidationError, match="non-numeric worker-id"):
+                await v.run("jax")
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "1")
+        _slice_node(fc, "tpu-1", "1")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            v = Validator(fast_config(node_name="tpu-0", with_workload=True), client=client)
+            with pytest.raises(ValidationError, match="duplicate worker ids"):
+                await v.run("jax")
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", None)  # missing label
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            v = Validator(fast_config(node_name="tpu-0", with_workload=True), client=client)
+            with pytest.raises(ValidationError, match="no worker-id label"):
+                await v.run("jax")
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", "5")  # unique but not covering 0..1
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("plugin")
+            v = Validator(fast_config(node_name="tpu-0", with_workload=True), client=client)
+            with pytest.raises(ValidationError, match="do not cover"):
+                await v.run("jax")
+
+
+async def test_validation_epoch_tracks_runtime_identity(validation_root):
+    """The epoch must change when a member's runtime pod is replaced (swap)
+    — even at the same version — and when the version label moves."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", "1")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            v = Validator(fast_config(node_name="tpu-0"), client=client)
+            members = await client.list_items("", "Node")
+
+            async def swap_runtime_pod():
+                """A swap is delete + DS-recreate: new pod object, new
+                server-assigned uid, same name/labels/version."""
+                await client.delete("", "Pod", "tpu-runtime-x", NS)
+                fc.put({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "tpu-runtime-x", "namespace": NS,
+                                 "labels": {"app": "tpu-runtime"}},
+                    "spec": {"nodeName": "tpu-1", "containers": [{"name": "c"}]},
+                    "status": {"phase": "Running"},
+                })
+
+            await swap_runtime_pod()
+            e1 = await v._validation_epoch(members)
+            assert e1 == await v._validation_epoch(members)  # deterministic
+            await swap_runtime_pod()  # same version, new pod identity
+            e2 = await v._validation_epoch(members)
+            assert e2 != e1
+            # version label change alone also moves the epoch (members are
+            # re-listed per validation run in _slice_group)
+            node = await client.get("", "Node", "tpu-0")
+            node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = "v9"
+            fc.put(node)
+            members = await client.list_items("", "Node")
+            assert await v._validation_epoch(members) not in (e1, e2)
+
+
+async def test_multihost_stale_epoch_evidence_rejected(validation_root):
+    """Post-swap re-validation: Succeeded pods from an older epoch must not
+    re-gate jax-ready — the validator recreates the set at the current epoch
+    and proves the slice again (advisor round-2 finding)."""
+    port = _free_port()
+    executed: list = []
+    sim = SimConfig(
+        pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_distributed_pod(port, executed)
+    )
+    async with FakeCluster(sim) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", "1")
+        # stale evidence: Succeeded pods labelled with a pre-swap epoch
+        for wid in (0, 1):
+            fc.put({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": f"tpu-jax-validation-pool-a-w{wid}", "namespace": NS,
+                    "labels": {"tpu.google.com/slice-group": "tpu-jax-validation-pool-a",
+                               components.EPOCH_LABEL: "stale-epoch"},
+                },
+                "spec": {"nodeName": f"tpu-{wid}", "containers": [{"name": "c"}]},
+                "status": {"phase": "Succeeded"},
+            })
+        async with ApiClient(Config(base_url=fc.base_url)) as c0, ApiClient(
+            Config(base_url=fc.base_url)
+        ) as c1:
+            status.write_ready("plugin")
+            v0 = Validator(
+                fast_config(node_name="tpu-0", with_workload=True,
+                            sleep_interval=0.1, workload_retries=900),
+                client=c0,
+            )
+            v1 = Validator(
+                fast_config(node_name="tpu-1", with_workload=True,
+                            sleep_interval=0.1, workload_retries=900),
+                client=c1,
+            )
+            await asyncio.gather(v0.run("jax"), v1.run("jax"))
+            payload = status.read_status("jax")
+            assert payload["mode"] == "multi-host"
+            assert payload["epoch"] != "stale-epoch"
+            # the proof came from freshly executed pods, not the stale ones
+            assert len(executed) == 2
+            svc = await c0.get("", "Service", "tpu-jax-validation-pool-a", NS)
+            assert deep_get(svc, "metadata", "annotations", default={}).get(
+                components.VALIDATED_EPOCH_ANNOTATION
+            ) == payload["epoch"]

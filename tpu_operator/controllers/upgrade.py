@@ -248,8 +248,15 @@ class UpgradeReconciler:
                 continue
             meta = pod["metadata"]
             refs = meta.get("ownerReferences") or []
-            if any(r.get("kind") == "DaemonSet" for r in refs) and not up.drain.force:
-                continue  # our own operands drain via the runtime swap
+            if any(r.get("kind") == "DaemonSet" for r in refs):
+                # kubectl drain --ignore-daemonsets semantics: the DS would
+                # instantly recreate the pod, so deleting or counting it can
+                # never converge; operands drain via the runtime swap instead
+                continue
+            if not refs and not up.drain.force:
+                # bare pod: blocks the drain until timeout unless force
+                remaining = True
+                continue
             remaining = True
             if not meta.get("deletionTimestamp"):
                 await self.client.delete("", "Pod", meta["name"], meta.get("namespace"))

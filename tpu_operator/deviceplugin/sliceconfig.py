@@ -35,13 +35,15 @@ def read_layout() -> Optional[dict]:
 
 
 def config_signature() -> str:
-    """Change-detection key for the reconfig watch: the applied layout AND
-    this host's worker id — a late-arriving worker_id file (TFD starting
-    after the plugin DS on a fresh multi-host node) changes which partition
-    units this host owns and must rebuild the plugin set too."""
+    """Change-detection key for the reconfig watch: the applied layout, this
+    host's worker id, and its chip count — a late-arriving worker_id file
+    (TFD starting after the plugin DS on a fresh multi-host node) changes
+    which partition units this host owns, and /dev/accel* appearing after
+    the plugin started flips the spans-hosts classification; both must
+    rebuild the plugin set."""
     layout = read_layout()
     sig = json.dumps(layout, sort_keys=True) if layout else ""
-    return f"{sig}|worker={_worker_id()}"
+    return f"{sig}|worker={_worker_id()}|chips={hw.chip_count()}"
 
 
 def host_units(
@@ -88,6 +90,18 @@ def build_plugin_configs(
     layout = read_layout()
     chips = hw.chip_count()
     worker = _worker_id()
+    if worker is None:
+        if _layout_spans_hosts(layout, max(1, chips)):
+            # no worker-id source yet (TFD hasn't written the handoff file):
+            # assuming worker 0 would advertise another host's partition
+            # units backed by the wrong chips — serve the flat plugin until
+            # the id arrives (config_signature flips when it does)
+            log.warning(
+                "mixed strategy on a multi-host layout with no worker id yet; "
+                "serving flat plugin until TFD provides one"
+            )
+            return [base]
+        worker = 0
     units = host_units(layout, worker, max(1, chips))
     if not units:
         return [base]
@@ -112,9 +126,30 @@ def build_plugin_configs(
     return configs
 
 
-def _worker_id() -> int:
-    wid = read_worker_id()
-    return wid if wid is not None else 0
+def _worker_id() -> Optional[int]:
+    """This host's slice worker id, or None when no source (env or TFD
+    handoff file) has produced one yet."""
+    return read_worker_id()
+
+
+def _layout_spans_hosts(layout: Optional[dict], chips_per_host: int) -> bool:
+    """True when the layout describes a multi-host slice, i.e. worker
+    identity decides which partition units this host owns.  Derived from the
+    layout's slice topology (a 4x4 slice at 4 chips/host is 4 hosts even if
+    every partition's chip ids happen to fall inside host 0's range); the
+    chip-id span check is the fallback when the topology is absent."""
+    from tpu_operator.utils import topology_chips
+
+    topo = (layout or {}).get("topology") or ""
+    if topo:
+        try:
+            return topology_chips(topo) > chips_per_host
+        except ValueError:
+            pass
+    for part in (layout or {}).get("partitions") or []:
+        if any(cid >= chips_per_host for cid in part.get("chip_ids", [])):
+            return True
+    return False
 
 
 def _chip_path(local_index: int) -> str:

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import copy
+import hashlib
 import json
 import logging
 import os
@@ -35,6 +36,31 @@ log = logging.getLogger("tpu_operator.validator")
 
 LIBTPU_CTR_MARKER = ".libtpu-ctr-ready"
 COORDINATOR_PORT = 8476  # jax.distributed coordinator (worker 0's pod)
+EPOCH_LABEL = "tpu.google.com/validation-epoch"
+VALIDATED_EPOCH_ANNOTATION = "tpu.google.com/validated-epoch"
+
+
+def _worker_id_of(node: dict) -> int:
+    """The node's slice worker id; raises ValidationError on a malformed or
+    missing label (silently collapsing to 0 would collide with the real
+    worker 0: duplicate pod names, wrong PROCESS_ID in the rendezvous)."""
+    from tpu_operator.k8s import nodeinfo
+
+    attrs = nodeinfo.attributes(node)
+    raw = attrs.worker_id
+    if raw == "":
+        raise ValidationError(
+            f"node {attrs.name} is in a multi-host slice but has no worker-id label"
+        )
+    try:
+        wid = int(raw)
+    except ValueError:
+        raise ValidationError(
+            f"node {attrs.name} has a non-numeric worker-id label {raw!r}"
+        ) from None
+    if wid < 0:
+        raise ValidationError(f"node {attrs.name} has negative worker id {wid}")
+    return wid
 
 
 @dataclass
@@ -226,11 +252,23 @@ class Validator:
             .eq(consts.GKE_NODEPOOL_LABEL, key)
             .apply(await client.list_items("", "Node"))
         )
-        members.sort(key=lambda n: int(nodeinfo.attributes(n).worker_id or "0"))
+        ids = {m["metadata"]["name"]: _worker_id_of(m) for m in members}
+        dupes = {i for i in ids.values() if list(ids.values()).count(i) > 1}
+        if dupes:
+            raise ValidationError(
+                f"slice {key}: duplicate worker ids {sorted(dupes)} across hosts "
+                f"{sorted(n for n, i in ids.items() if i in dupes)}"
+            )
+        members.sort(key=lambda m: ids[m["metadata"]["name"]])
         expected = max(nodeinfo.slice_hosts(m) for m in members)
         if len(members) < expected:
             raise ValidationError(
                 f"slice {key}: only {len(members)}/{expected} hosts present"
+            )
+        if sorted(ids.values()) != list(range(len(members))):
+            raise ValidationError(
+                f"slice {key}: worker ids {sorted(ids.values())} do not cover "
+                f"0..{len(members) - 1}; check the worker-id labels"
             )
         return key, members
 
@@ -244,58 +282,123 @@ class Validator:
 
         return hashed_name("tpu-jax-validation", key)
 
+    async def _validation_epoch(self, members: list[dict]) -> str:
+        """Identity of the runtime the slice is being proven against.
+
+        A workload pod's Succeeded phase is only evidence for the runtime it
+        ran on; after an upgrade swaps libtpu on any member host the old
+        evidence must not re-gate jax-ready.  The epoch hashes, per member,
+        the live runtime pod's UID (changes on every swap, even same-version
+        reinstalls) with the TFD-reported version label as the host-managed
+        fallback — so all hosts derive the same value from cluster state."""
+        from tpu_operator.k8s import nodeinfo
+
+        runtime_uid: dict[str, str] = {}
+        for pod in await self.client().list_items(
+            "", "Pod", self.config.namespace, label_selector="app=tpu-runtime"
+        ):
+            if deep_get(pod, "metadata", "deletionTimestamp"):
+                continue
+            node = deep_get(pod, "spec", "nodeName")
+            if node:
+                runtime_uid[node] = deep_get(pod, "metadata", "uid", default="")
+        ident = sorted(
+            (a.name, runtime_uid.get(a.name, ""), a.runtime_version)
+            for a in (nodeinfo.attributes(m) for m in members)
+        )
+        return hashlib.sha1(json.dumps(ident).encode()).hexdigest()[:12]
+
     async def validate_jax_multihost(self, key: str, members: list[dict]) -> None:
         """One global collective across every host of the slice.
 
-        Worker 0's validator creates the coordination resources — a headless
+        The validator converges the coordination resources — a headless
         Service plus one workload pod per slice host, each pinned to its
         node and running ``workloads.distributed`` with
         jax.distributed.initialize(coordinator=worker-0-pod DNS) — then every
-        host's validator (including 0) gates its own ``jax-ready`` on ITS
-        pod succeeding, which can only happen if the GLOBAL psum + burn-in
-        passed on all hosts (any missing worker fails the whole rendezvous).
-        Reference pattern: workload-pod spawning of validator/main.go:941-1052,
-        lifted from one pod to a coordinated set."""
-        from tpu_operator.k8s import nodeinfo
+        host's validator gates its own ``jax-ready`` on ITS pod succeeding,
+        which can only happen if the GLOBAL psum + burn-in passed on all
+        hosts (any missing worker fails the whole rendezvous).
 
-        my_attrs = next(
-            nodeinfo.attributes(m)
+        Evidence is keyed to a validation EPOCH (runtime identity across the
+        slice): a Succeeded pod from an older epoch is stale — whichever
+        host's validator notices (worker 0 up front; any other worker after
+        a grace period, covering post-swap re-validation where worker 0's
+        validator isn't re-running) deletes and recreates the out-of-date
+        pods.  After success, worker 0 records the proven epoch on the
+        Service and garbage-collects the Succeeded pods, so re-validating
+        validators accept the Service tombstone instead of re-proving.
+        Reference pattern: workload-pod spawning of validator/main.go:941-1052,
+        lifted from one pod to a coordinated, epoch-keyed set."""
+        my_id = next(
+            _worker_id_of(m)
             for m in members
             if m["metadata"]["name"] == self.config.node_name
         )
-        my_id = int(my_attrs.worker_id or "0")
         svc = self._group_service_name(key)
         coordinator = (
             f"{self._group_pod_name(key, 0)}.{svc}."
             f"{self.config.namespace}.svc:{COORDINATOR_PORT}"
         )
-        if my_id == 0:
-            await self._create_group_workloads(key, members, svc, coordinator)
-
-        # gate on THIS host's pod (per-host evidence; global success implied)
-        name = self._group_pod_name(key, my_id)
         client = self.client()
+        epoch = await self._validation_epoch(members)
+        if my_id == 0:
+            await self._ensure_group_workloads(key, members, svc, coordinator, epoch)
+
+        def ready_payload(proven_by: str) -> dict:
+            return {
+                "mode": "multi-host",
+                "group": key,
+                "workers": len(members),
+                "worker_id": my_id,
+                "epoch": epoch,
+                "proven_by": proven_by,
+            }
+
+        # non-zero workers give worker 0 this many polls before converging
+        # the pod set themselves (idempotent: the epoch check skips current
+        # pods, so concurrent converging workers agree)
+        patience = 10 if my_id != 0 else 0
+        name = self._group_pod_name(key, my_id)
         phase = None
-        for _ in range(self.config.workload_retries):
+        ensured = my_id == 0  # whoever converged the pod set also GCs it
+        for attempt in range(self.config.workload_retries):
+            # re-derive the epoch every poll: a runtime pod restarting on any
+            # member mid-validation would otherwise leave validators that
+            # snapshotted different epochs deleting each other's pod sets
+            # until retries exhaust — recomputing makes them all converge on
+            # the latest cluster state
+            epoch = await self._validation_epoch(members)
+            tombstone = await self._group_tombstone(svc)
+            if tombstone == epoch:
+                status.write_ready("jax", ready_payload("service-tombstone"))
+                return
             try:
                 live = await client.get("", "Pod", name, self.config.namespace)
             except ApiError as e:
                 if not e.not_found:
                     raise
-                # worker 0 may not have created the set yet
+                live = None
+            pod_epoch = (
+                deep_get(live, "metadata", "labels", default={}).get(EPOCH_LABEL)
+                if live is not None
+                else None
+            )
+            if live is None or pod_epoch != epoch:
+                if attempt >= patience:
+                    await self._ensure_group_workloads(
+                        key, members, svc, coordinator, epoch
+                    )
+                    ensured = True
                 await asyncio.sleep(self.config.sleep_interval)
                 continue
             phase = deep_get(live, "status", "phase")
             if phase == "Succeeded":
-                status.write_ready(
-                    "jax",
-                    {
-                        "mode": "multi-host",
-                        "group": key,
-                        "workers": len(members),
-                        "worker_id": my_id,
-                    },
-                )
+                status.write_ready("jax", ready_payload("workload-pod"))
+                if ensured:
+                    # the worker that converged the pod set also records the
+                    # tombstone + GCs — covering re-proofs driven by a
+                    # non-zero worker while worker 0's validator is asleep
+                    await self._cleanup_group_workloads(key, members, svc, epoch)
                 return
             if phase == "Failed":
                 raise ValidationError(
@@ -306,12 +409,34 @@ class Validator:
             f"distributed validation pod {name} did not complete (phase={phase})"
         )
 
-    async def _create_group_workloads(
-        self, key: str, members: list[dict], svc: str, coordinator: str
+    async def _group_tombstone(self, svc: str) -> Optional[str]:
+        """The epoch already proven for this slice group, recorded on the
+        headless Service after worker 0 garbage-collected the pods."""
+        try:
+            service = await self.client().get(
+                "", "Service", svc, self.config.namespace
+            )
+        except ApiError as e:
+            if e.not_found:
+                return None
+            raise
+        return deep_get(service, "metadata", "annotations", default={}).get(
+            VALIDATED_EPOCH_ANNOTATION
+        )
+
+    async def _ensure_group_workloads(
+        self, key: str, members: list[dict], svc: str, coordinator: str, epoch: str
     ) -> None:
-        """Worker 0 only: headless Service + one pinned pod per slice host."""
+        """Converge the headless Service + one pinned pod per slice host to
+        the current epoch.  Pods already at this epoch (and not Failed) are
+        left untouched — no slice-wide churn when evidence is current."""
         from tpu_operator.k8s import nodeinfo
 
+        if await self._group_tombstone(svc) == epoch:
+            # already proven and garbage-collected (worker 0's cleanup can
+            # land between a peer's tombstone check and its pod poll);
+            # recreating pods here would start an unjoinable rendezvous
+            return
         client = self.client()
         owner = await self._owner_daemonset()
         service = {
@@ -339,12 +464,26 @@ class Validator:
                 raise
         for member in members:
             attrs = nodeinfo.attributes(member)
-            wid = int(attrs.worker_id or "0")
+            wid = _worker_id_of(member)
             name = self._group_pod_name(key, wid)
+            try:
+                live = await client.get("", "Pod", name, self.config.namespace)
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                live = None
+            if live is not None:
+                current = deep_get(live, "metadata", "labels", default={}).get(
+                    EPOCH_LABEL
+                )
+                if current == epoch and deep_get(live, "status", "phase") != "Failed":
+                    continue
+                await client.delete("", "Pod", name, self.config.namespace)
             pod = self._workload_pod(
                 name, checks="", tpu_request=max(1, attrs.chips_per_host), owner=owner
             )
             pod["metadata"]["labels"]["tpu.google.com/slice-group"] = svc
+            pod["metadata"]["labels"][EPOCH_LABEL] = epoch
             spec = pod["spec"]
             spec["nodeName"] = attrs.name
             # per-pod DNS record under the headless Service
@@ -357,8 +496,60 @@ class Validator:
                 {"name": "NUM_PROCESSES", "value": str(len(members))},
                 {"name": "PROCESS_ID", "value": str(wid)},
             ]
+            try:
+                await client.create(pod)
+            except ApiError as e:
+                # another worker converged this name concurrently, or the old
+                # pod is still terminating; the next poll's epoch check decides
+                if not e.conflict:
+                    raise
+
+    async def _cleanup_group_workloads(
+        self, key: str, members: list[dict], svc: str, epoch: str
+    ) -> None:
+        """Worker 0, post-success: once every member pod of this epoch has
+        Succeeded, record the proven epoch on the Service and delete the
+        pods (a 64-host slice must not leave 64 completed pods per round).
+        Best-effort and bounded — evidence is only deleted after the
+        tombstone is durably written, so a crash mid-cleanup at worst causes
+        one re-proof, never a false pass."""
+        client = self.client()
+        names = [self._group_pod_name(key, _worker_id_of(m)) for m in members]
+        for _ in range(min(60, self.config.workload_retries)):
+            done = 0
+            for name in names:
+                try:
+                    pod = await client.get("", "Pod", name, self.config.namespace)
+                except ApiError as e:
+                    if not e.not_found:
+                        raise
+                    # already gone (completed-pod GC, eviction, or a
+                    # concurrent cleanup) — absence must not block the
+                    # tombstone the remaining Succeeded pods have earned
+                    done += 1
+                    continue
+                if (
+                    deep_get(pod, "metadata", "labels", default={}).get(EPOCH_LABEL)
+                    == epoch
+                    and deep_get(pod, "status", "phase") == "Succeeded"
+                ):
+                    done += 1
+            if done == len(names):
+                break
+            await asyncio.sleep(self.config.sleep_interval)
+        else:
+            log.info(
+                "slice %s: not all validation pods finished; leaving them in place",
+                key,
+            )
+            return
+        await client.patch(
+            "", "Service", svc,
+            {"metadata": {"annotations": {VALIDATED_EPOCH_ANNOTATION: epoch}}},
+            self.config.namespace,
+        )
+        for name in names:
             await client.delete("", "Pod", name, self.config.namespace)
-            await client.create(pod)
 
     async def validate_vfio(self) -> None:
         devices = hw.vfio_device_paths()
