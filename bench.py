@@ -38,6 +38,18 @@ sys.path.insert(0, REPO)
 BASELINE_SECONDS = 900.0  # reference all-operands-ready budget
 NS = "tpu-operator"
 
+# prior rounds' headline numbers, carried in the output so regressions are
+# visible round-over-round (the r01→r02 allreduce drop went unnoticed
+# because nothing juxtaposed them)
+PRIOR_ROUNDS = {
+    "r01": {"join_s": 21.236, "allreduce_gbps": 7.20},
+    "r02": {"join_s": 22.883, "allreduce_gbps": 5.81},
+}
+
+# populated by _exec_workload_pod as the fake kubelet executes the real
+# validation workload: one parsed JSON result per check
+WORKLOAD_RESULTS: list[dict] = []
+
 
 # the validator waits workload_retries * sleep_interval = 3000 * 0.1 = 300s;
 # the subprocess budget stays inside it so a slow compile surfaces as a
@@ -70,9 +82,36 @@ def _exec_workload_pod(pod: dict) -> str:
     for line in result.stdout.splitlines():
         if line.startswith("{"):
             print("  workload:", line, file=sys.stderr)
+            try:
+                WORKLOAD_RESULTS.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
     if result.returncode != 0:
         print(result.stderr[-2000:], file=sys.stderr)
     return "Succeeded" if result.returncode == 0 else "Failed"
+
+
+def run_matmul_bench() -> dict:
+    """The compute half of the perf story: bf16 matmul sweep → TFLOPs → MFU
+    on this machine's chip, in a subprocess so the TPU is free of the
+    validator workload's PJRT client (one process owns the chip at a time).
+    """
+    env = {**os.environ}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_operator.workloads.matmul_bench"],
+            env=env, capture_output=True, text=True, timeout=400,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "matmul bench timed out"}
+    for line in reversed(result.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"ok": False, "error": result.stderr[-500:]}
 
 
 async def bench() -> dict:
@@ -150,6 +189,29 @@ async def bench() -> dict:
 def main() -> None:
     result = asyncio.run(bench())
     value = result["join_to_validated_s"]
+
+    # phase 3: compute + bandwidth detail on the now-free chip
+    matmul = run_matmul_bench()
+    checks = {r.get("check", "?"): r for r in WORKLOAD_RESULTS}
+    allreduce = checks.get("allreduce", {})
+    detail = {
+        **result,
+        "matmul": {
+            k: matmul.get(k)
+            for k in ("ok", "backend", "generation", "peak_bf16_tflops",
+                      "best_size", "tflops", "mfu")
+        },
+        "allreduce": {
+            k: allreduce.get(k)
+            for k in ("ok", "devices", "algbw_gbps", "algbw_gbps_median",
+                      "busbw_gbps", "overhead_ms", "best_of", "transport")
+        },
+        "burn_in": {
+            k: checks.get("burn-in", {}).get(k)
+            for k in ("ok", "devices", "time_s")
+        },
+        "prior_rounds": PRIOR_ROUNDS,
+    }
     print(
         json.dumps(
             {
@@ -157,7 +219,9 @@ def main() -> None:
                 "value": value,
                 "unit": "s",
                 "vs_baseline": round(value / BASELINE_SECONDS, 5),
-                "detail": result,
+                "tflops": round(matmul.get("tflops") or 0.0, 2),
+                "mfu": matmul.get("mfu"),
+                "detail": detail,
             }
         )
     )

@@ -1,0 +1,105 @@
+"""Matmul TFLOPs/MFU benchmark tests (workloads/matmul_bench.py).
+
+The perf instrument the reference never shipped: its CUDA validation
+workload (validator/main.go:1189-1302) proves execution, never rate.  These
+tests run the real sweep on the virtual-CPU backend (tiny sizes) and pin
+down the generation→peak wiring against the accelerator catalogue.
+"""
+
+import json
+import subprocess
+import sys
+
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.workloads import matmul_bench
+
+
+class _FakeDevice:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_detect_generation_mapping():
+    cases = {
+        "TPU v5 lite": "v5e",
+        "TPU v5e": "v5e",
+        "TPU v5p": "v5p",
+        "TPU v4": "v4",
+        "TPU v6e": "v6e",
+        "TPU v6 lite": "v6e",
+        "cpu": "unknown",
+        "": "unknown",
+    }
+    for kind, expected in cases.items():
+        assert matmul_bench.detect_generation(_FakeDevice(kind)) == expected, kind
+
+
+def test_peak_lookup_from_catalogue():
+    # the MFU denominators are the published per-chip dense bf16 peaks
+    assert matmul_bench.peak_bf16_tflops("v4") == 275.0
+    assert matmul_bench.peak_bf16_tflops("v5e") == 197.0
+    assert matmul_bench.peak_bf16_tflops("v5p") == 459.0
+    assert matmul_bench.peak_bf16_tflops("v6e") == 918.0
+    assert matmul_bench.peak_bf16_tflops("unknown") == 0.0
+
+
+def test_generation_info_covers_ici():
+    # the allreduce gate's expected-ICI column exists for every generation
+    for accel, info in nodeinfo.ACCELERATORS.items():
+        assert info.peak_bf16_tflops > 0, accel
+        assert info.ici_gbps > 0, accel
+    assert nodeinfo.generation_info("v5e").ici_gbps == 200.0
+    assert nodeinfo.generation_info("nope").ici_gbps == 0.0
+
+
+def test_chain_iters_budget():
+    # small sizes get many iterations (amortizing dispatch), large get few,
+    # and every count is a whole number of normalization bursts
+    small = matmul_bench.chain_iters(256)
+    large = matmul_bench.chain_iters(8192)
+    assert small == matmul_bench._MAX_CHAIN_ITERS
+    assert large < small
+    assert small % matmul_bench.NORM_PERIOD == 0
+    assert large % matmul_bench.NORM_PERIOD == 0
+    assert matmul_bench.chain_iters(1 << 20) == matmul_bench.NORM_PERIOD
+
+
+def test_matmul_benchmark_cpu():
+    result = matmul_bench.matmul_benchmark(
+        sizes=(128, 256), iters=matmul_bench.NORM_PERIOD, best_of=2
+    )
+    assert result["ok"]
+    assert result["backend"] == "cpu"
+    assert result["generation"] == "unknown"
+    assert result["mfu"] is None  # no peak for the CPU backend
+    assert result["tflops"] > 0
+    assert {r["size"] for r in result["results"]} == {128, 256}
+    for r in result["results"]:
+        assert r["finite"]
+        assert r["iters"] == matmul_bench.NORM_PERIOD
+        assert r["time_ms"] > 0
+
+
+def test_quick_benchmark_cpu_is_small():
+    result = matmul_bench.quick_benchmark()
+    assert result["ok"]
+    assert [r["size"] for r in result["results"]] == [256]
+
+
+def test_main_json_line_and_mfu_gate(monkeypatch):
+    """The CLI prints one JSON line; MATMUL_MIN_MFU gates when peak known
+    (on CPU mfu is None so the gate must not crash or trip)."""
+    monkeypatch.setenv("MATMUL_SIZES", "128")
+    monkeypatch.setenv("MATMUL_ITERS", str(matmul_bench.NORM_PERIOD))
+    monkeypatch.setenv("MATMUL_MIN_MFU", "0.99")
+    import os
+
+    result = subprocess.run(
+        [sys.executable, "-m", "tpu_operator.workloads.matmul_bench"],
+        capture_output=True, text=True, timeout=120, env=dict(os.environ),
+    )
+    assert result.returncode == 0, result.stderr[-500:]
+    line = [l for l in result.stdout.splitlines() if l.startswith("{")][-1]
+    payload = json.loads(line)
+    assert payload["ok"]
+    assert payload["mfu"] is None

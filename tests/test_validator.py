@@ -152,6 +152,10 @@ async def test_jax_validation_in_process(validation_root):
     assert payload["mode"] == "in-process"
     assert payload["devices"] == 8
     assert payload["algbw_gbps"] > 0
+    # the compute benchmark rides along: measured TFLOPs always, MFU only
+    # when the generation (hence peak) is known — not on the CPU backend
+    assert payload["matmul_tflops"] > 0
+    assert payload["mfu"] is None
 
 
 async def test_vfio_validation(validation_root, tmp_path, monkeypatch):
@@ -305,6 +309,9 @@ async def test_multihost_slice_validation(validation_root):
                 }
                 assert envs["NUM_PROCESSES"] == "2"
                 assert envs["PROCESS_ID"] == str(wid)
+                # the armed ICI gate, derived from the catalogue: v5e
+                # 200 GB/s * 0.25 fraction (visible in the pod spec)
+                assert envs["ALLREDUCE_MIN_GBPS"] == "50.0"
                 assert pod["metadata"]["labels"][components.EPOCH_LABEL]
             # worker 0 garbage-collected the Succeeded pods post-proof —
             # pod count returns to baseline, evidence lives on the Service

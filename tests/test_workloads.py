@@ -92,4 +92,33 @@ def test_allreduce_min_bandwidth_gate(monkeypatch):
     monkeypatch.setenv("WORKLOAD_CHECKS", "allreduce")
     monkeypatch.setenv("ALLREDUCE_SIZE_MB", "2")
     monkeypatch.setenv("ALLREDUCE_MIN_GBPS", "1000000")
+    # the gate applies to the tpu backend only unless widened (CPU/gloo
+    # rates say nothing about ICI health); widen it to exercise the fail path
+    assert run_validation.main() == 0
+    monkeypatch.setenv("ALLREDUCE_GATE_BACKENDS", "cpu,tpu")
     assert run_validation.main() == 1
+
+
+def test_distributed_reports_and_gates_allreduce(monkeypatch):
+    """The distributed validation program measures the global-mesh allreduce
+    and fails the rendezvous when the armed gate isn't met (BASELINE
+    'expected ICI GB/s' — previously never enforced)."""
+    from tpu_operator.workloads import distributed
+
+    # single process over the 8 virtual CPU devices: transport is ici
+    monkeypatch.setenv("ALLREDUCE_SIZE_MB", "1")
+    result = distributed.run_worker("", 1, 0, steps=2)
+    assert result["ok"]
+    assert result["allreduce"]["transport"] == "ici"
+    assert result["allreduce"]["busbw_gbps"] > 0
+    assert result["allreduce"]["gated"] is False  # no min set
+
+    # an impossible requirement must fail it — but only for gated backends
+    monkeypatch.setenv("ALLREDUCE_MIN_GBPS", "1000000")
+    result = distributed.run_worker("", 1, 0, steps=2)
+    assert result["ok"]  # cpu backend: catalogue gates don't apply
+    monkeypatch.setenv("ALLREDUCE_GATE_BACKENDS", "cpu,tpu")
+    result = distributed.run_worker("", 1, 0, steps=2)
+    assert not result["ok"]
+    assert "busbw" in result["allreduce"]["error"]
+    assert result["allreduce"]["min_gbps"] == 1000000

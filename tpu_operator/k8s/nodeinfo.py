@@ -23,25 +23,42 @@ from tpu_operator.utils import deep_get, parse_topology, topology_chips
 
 @dataclass(frozen=True)
 class AcceleratorInfo:
-    generation: str       # v4 | v5e | v5p | v6e
-    hbm_gb: int           # HBM per chip (GiB)
-    chips_per_host: int   # default host chip count for this machine shape
+    generation: str          # v4 | v5e | v5p | v6e
+    hbm_gb: int              # HBM per chip (GiB)
+    chips_per_host: int      # default host chip count for this machine shape
+    peak_bf16_tflops: float  # per-chip dense MXU peak (bf16 in, f32 acc)
+    ici_gbps: float          # per-chip aggregate ICI bandwidth, GB/s
+                             # (GKE per-chip interconnect spec / 8)
 
 
+# Per-generation perf envelope: peak TFLOPs are the published per-chip dense
+# bf16 numbers (v4 275, v5e 197, v5p 459, v6e 918); ICI GB/s is the per-chip
+# interchip-interconnect spec (v4 2400 Gbps, v5e 1600, v5p 4800, v6e 3584).
+# These drive the MFU denominator (workloads/matmul_bench.py) and the
+# allreduce bandwidth gate (validator components.py).
 ACCELERATORS: dict[str, AcceleratorInfo] = {
-    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4),
-    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4),
-    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8),
-    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4),
-    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4),
-    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8),
+    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0),
+    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0),
+    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0),
+    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0),
+    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0),
+    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0),
 }
 
-UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4)
+UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4, 0.0, 0.0)
 
 
 def accelerator_info(accelerator: str) -> AcceleratorInfo:
     return ACCELERATORS.get(accelerator, UNKNOWN_ACCELERATOR)
+
+
+def generation_info(generation: str) -> AcceleratorInfo:
+    """Perf envelope by chip generation (the axis the matmul/allreduce
+    benchmarks detect at runtime via PJRT device_kind)."""
+    for info in ACCELERATORS.values():
+        if info.generation == generation:
+            return info
+    return UNKNOWN_ACCELERATOR
 
 
 # ---------------------------------------------------------------------------

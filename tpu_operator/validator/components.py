@@ -39,6 +39,32 @@ COORDINATOR_PORT = 8476  # jax.distributed coordinator (worker 0's pod)
 EPOCH_LABEL = "tpu.google.com/validation-epoch"
 VALIDATED_EPOCH_ANNOTATION = "tpu.google.com/validated-epoch"
 
+# Fraction of the generation's published per-chip ICI bandwidth
+# (k8s/nodeinfo.py ACCELERATORS.ici_gbps) a validation allreduce's busbw
+# must reach: conservative enough for small validation buffers and mixed
+# topologies, tight enough that a degraded link (which halves or worse the
+# ring's steady-state rate) fails the slice instead of passing at any speed.
+ALLREDUCE_GATE_FRACTION = 0.25
+
+
+def _allreduce_min_gbps(generation: str) -> float:
+    """The armed ICI gate for this chip generation.  An explicit
+    ALLREDUCE_MIN_GBPS env on the validator (operator-injected override)
+    wins — including an explicit 0, which keeps the gate report-only;
+    otherwise the accelerator catalogue sets the expectation — the BASELINE
+    'expected ICI GB/s for slice shape' metric, which previously defaulted
+    to 0 and gated nothing.  Malformed values log and fall back rather than
+    crash the validation loop."""
+    env = os.environ.get("ALLREDUCE_MIN_GBPS", "")
+    if env != "":
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            log.warning("ignoring malformed ALLREDUCE_MIN_GBPS=%r", env)
+    from tpu_operator.k8s.nodeinfo import generation_info
+
+    return round(generation_info(generation).ici_gbps * ALLREDUCE_GATE_FRACTION, 1)
+
 
 def _worker_id_of(node: dict) -> int:
     """The node's slice worker id; raises ValidationError on a malformed or
@@ -201,20 +227,33 @@ class Validator:
                 await self.validate_jax_multihost(*group)
                 return
             chips = await self._node_chip_count()
+            # multi-chip: the local allreduce rides ICI — arm the busbw gate
+            # from the accelerator catalogue (single chip stays report-only)
+            min_gbps = 0.0
+            if chips > 1:
+                from tpu_operator.k8s import nodeinfo
+
+                node = await self.client().get("", "Node", self.config.node_name)
+                min_gbps = _allreduce_min_gbps(nodeinfo.attributes(node).generation)
             await self.spawn_workload(
                 "tpu-jax-workload-validation",
                 checks="vector-add,allreduce,burn-in",
                 tpu_request=chips,
+                min_gbps=min_gbps,
             )
-            status.write_ready("jax", {"mode": "workload-pod", "chips": chips})
+            status.write_ready(
+                "jax",
+                {"mode": "workload-pod", "chips": chips, "allreduce_min_gbps": min_gbps},
+            )
             return
 
         def run_checks() -> dict:
-            from tpu_operator.workloads import collectives
+            from tpu_operator.workloads import collectives, matmul_bench
 
             results = {
                 "vector-add": collectives.vector_add(1 << 16),
                 "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
+                "matmul": matmul_bench.quick_benchmark(),
             }
             for name, r in results.items():
                 if not r.get("ok"):
@@ -223,6 +262,8 @@ class Validator:
                 "mode": "in-process",
                 "devices": results["allreduce"]["devices"],
                 "algbw_gbps": results["allreduce"]["algbw_gbps"],
+                "matmul_tflops": results["matmul"]["tflops"],
+                "mfu": results["matmul"]["mfu"],
             }
 
         payload = await asyncio.get_event_loop().run_in_executor(None, run_checks)
@@ -480,7 +521,13 @@ class Validator:
                     continue
                 await client.delete("", "Pod", name, self.config.namespace)
             pod = self._workload_pod(
-                name, checks="", tpu_request=max(1, attrs.chips_per_host), owner=owner
+                name,
+                checks="",
+                tpu_request=max(1, attrs.chips_per_host),
+                owner=owner,
+                # the armed ICI gate: the distributed program measures the
+                # global allreduce and fails the rendezvous below this busbw
+                min_gbps=_allreduce_min_gbps(attrs.generation),
             )
             pod["metadata"]["labels"]["tpu.google.com/slice-group"] = svc
             pod["metadata"]["labels"][EPOCH_LABEL] = epoch
@@ -491,7 +538,7 @@ class Validator:
             spec["subdomain"] = svc
             container = spec["containers"][0]
             container["command"] = ["python", "-m", "tpu_operator.workloads.distributed"]
-            container["env"] = [
+            container["env"] += [
                 {"name": "COORDINATOR_ADDRESS", "value": coordinator},
                 {"name": "NUM_PROCESSES", "value": str(len(members))},
                 {"name": "PROCESS_ID", "value": str(wid)},
@@ -574,10 +621,19 @@ class Validator:
         except ApiError:
             return None
 
-    def _workload_pod(self, name: str, checks: str, tpu_request: int, owner: Optional[dict]) -> dict:
+    def _workload_pod(
+        self,
+        name: str,
+        checks: str,
+        tpu_request: int,
+        owner: Optional[dict],
+        min_gbps: float = 0.0,
+    ) -> dict:
         """Build the workload pod (plugin-workload-validation.yaml analogue,
         validator/main.go:984-1052: node pinning, resource request, ownerRef
-        + tolerations copied from the validator DaemonSet)."""
+        + tolerations copied from the validator DaemonSet).  ``min_gbps``
+        arms the allreduce busbw gate (catalogue-derived for multi-chip
+        workloads; 0 keeps it report-only)."""
         image = self.config.workload_image or "ghcr.io/tpu-operator/tpu-validator:latest"
         pod = {
             "apiVersion": "v1",
@@ -597,10 +653,7 @@ class Validator:
                         "command": ["python", "-m", "tpu_operator.workloads.run_validation"],
                         "env": [
                             {"name": "WORKLOAD_CHECKS", "value": checks},
-                            {
-                                "name": "ALLREDUCE_MIN_GBPS",
-                                "value": os.environ.get("ALLREDUCE_MIN_GBPS", "0"),
-                            },
+                            {"name": "ALLREDUCE_MIN_GBPS", "value": str(min_gbps)},
                         ],
                         "resources": {
                             "limits": {consts.TPU_RESOURCE: str(tpu_request)},
@@ -619,10 +672,12 @@ class Validator:
                 pod["spec"]["tolerations"] = copy.deepcopy(tolerations)
         return pod
 
-    async def spawn_workload(self, name: str, checks: str, tpu_request: int) -> None:
+    async def spawn_workload(
+        self, name: str, checks: str, tpu_request: int, min_gbps: float = 0.0
+    ) -> None:
         client = self.client()
         owner = await self._owner_daemonset()
-        pod = self._workload_pod(name, checks, tpu_request, owner)
+        pod = self._workload_pod(name, checks, tpu_request, owner, min_gbps=min_gbps)
         await client.delete("", "Pod", name, self.config.namespace)
         await client.create(pod)
         for _ in range(self.config.workload_retries):

@@ -79,6 +79,7 @@ def allreduce_benchmark(
     iters: int = 10,
     warmup: int = 2,
     devices: Optional[list] = None,
+    best_of: int = 3,
 ) -> dict:
     """psum a bf16 buffer across all chips; report achieved algbw GB/s.
 
@@ -86,6 +87,13 @@ def allreduce_benchmark(
     bytes, so algbw = size / t and busbw = algbw * 2*(n-1)/n (NCCL-tests
     convention, reported the same way so numbers compare 1:1 with the
     reference's GPU fleet tooling).
+
+    Methodology (r03): ``iters`` collectives run inside ONE compiled
+    fori_loop with a single scalar readback — per-dispatch timing is
+    untrustworthy on tunneled PJRT backends and host sync would serialize
+    the ICI — and the dispatch+readback floor (a null program) is
+    subtracted.  ``best_of`` repetitions with min/median reported: the r02
+    round's 19% "regression" was single-shot noise nobody could see.
     """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
@@ -95,37 +103,89 @@ def allreduce_benchmark(
     elems_per_dev = (elems_per_dev + 127) // 128 * 128
     global_elems = elems_per_dev * n
 
-    x = jax.device_put(
-        jnp.ones((global_elems,), jnp.bfloat16),
-        NamedSharding(mesh, P("x")),
-    )
+    sharding = NamedSharding(mesh, P("x"))
+    if jax.process_count() > 1:
+        # multi-controller (the distributed validation program): every
+        # process contributes its local shards; device_put can't scatter a
+        # host array across processes
+        local = np.ones(
+            (elems_per_dev * jax.local_device_count(),), np.float32
+        ).astype(jnp.bfloat16)
+        x = jax.make_array_from_process_local_data(sharding, local)
+    else:
+        x = jax.device_put(jnp.ones((global_elems,), jnp.bfloat16), sharding)
 
     @jax.jit
     @functools.partial(
         jax.shard_map, mesh=mesh, in_specs=P("x"), out_specs=P("x")
     )
-    def allreduce(shard):
-        return jax.lax.psum(shard, "x") / n
+    def chain(shard):
+        if n > 1:
+            # value stays exactly 1.0 every round: psum -> n, /n -> 1
+            # (pvary: the replicated psum result re-enters the loop as the
+            # device-varying carry the fori_loop signature requires)
+            body = lambda _, s: jax.lax.pvary(jax.lax.psum(s, "x") / n, "x")  # noqa: E731
+            expected = 1.0
+        else:
+            # single chip moves no ICI traffic; accumulate so the loop body
+            # is a real HBM read+write per iteration instead of an identity
+            # XLA would fold away (reported as hbm-local, never gated)
+            body = lambda _, s: s + 1  # noqa: E731
+            expected = 1.0 + iters
+        out = jax.lax.fori_loop(0, iters, body, shard)
+        return out - (expected - 1.0)  # normalize back to ones
 
-    for _ in range(warmup):
-        allreduce(x).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = allreduce(x)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    @jax.jit
+    def err(y):
+        return jnp.max(jnp.abs(y.astype(jnp.float32) - 1.0))
+
+    # dispatch + scalar-readback floor (min of 3: one noisy sample must not
+    # over-subtract and inflate the reported bandwidth past the gate)
+    float(err(x))  # compile
+    overheads = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(err(x))
+        overheads.append(time.perf_counter() - t0)
+    overhead = min(overheads)
+
+    for _ in range(max(1, warmup)):
+        float(err(chain(x)))  # compile + settle
+    raw = []
+    max_err = 0.0
+    for _ in range(best_of):
+        t0 = time.perf_counter()
+        # worst error across ALL reps: a corrupt repetition must fail the
+        # check even when a later one is clean
+        max_err = max(max_err, float(err(chain(x))))
+        raw.append(time.perf_counter() - t0)
+    times = sorted((t - overhead) / iters for t in raw)
+    # when the floor rivals the compute (tiny buffers or a huge dispatch
+    # RTT) subtraction is meaningless — report the unsubtracted, deflated
+    # rate and flag it so gates skip rather than trust either direction
+    overhead_dominated = times[0] <= 0 or overhead > 0.5 * min(raw)
+    if overhead_dominated:
+        times = sorted(t / iters for t in raw)
+    dt = times[0]
+    dt_median = times[len(times) // 2]
 
     size_bytes = global_elems * 2
     algbw = size_bytes / dt / 1e9
     busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-    ok = bool(jnp.allclose(out[:8].astype(jnp.float32), 1.0))
+    ok = max_err < 0.1
     return {
         "ok": ok,
         "devices": n,
         "size_mb": size_bytes / 1e6,
         "time_ms": dt * 1e3,
+        "time_ms_median": dt_median * 1e3,
+        "overhead_ms": overhead * 1e3,
+        "overhead_dominated": overhead_dominated,
+        "best_of": best_of,
         "algbw_gbps": algbw,
+        "algbw_gbps_median": size_bytes / dt_median / 1e9,
         "busbw_gbps": busbw,
+        "max_error": max_err,
         # n=1 moves no inter-chip traffic: the number is an HBM copy rate,
         # not an ICI bandwidth, and must never be gated or reported as one
         "transport": "ici" if n > 1 else "hbm-local",

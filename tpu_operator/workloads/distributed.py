@@ -83,9 +83,43 @@ def run_worker(
     expected = float(local * sum(range(1, num_processes + 1)))
     psum_ok = total == expected
 
+    # -- allreduce bandwidth over the global mesh: the armed ICI gate
+    # (BASELINE "expected ICI GB/s").  ALLREDUCE_MIN_GBPS is injected by the
+    # validator from the accelerator catalogue; the gate applies only on
+    # backends named in ALLREDUCE_GATE_BACKENDS (default tpu — CPU/gloo
+    # rates say nothing about ICI health)
+    from tpu_operator.workloads import collectives
+
+    bench = collectives.allreduce_benchmark(
+        size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "16")),
+        iters=5,
+        warmup=1,
+        devices=devices,
+        best_of=2,
+    )
+    try:
+        min_gbps = float(os.environ.get("ALLREDUCE_MIN_GBPS", "0") or 0)
+    except ValueError:
+        min_gbps = 0.0
+    gated_backends = [
+        b.strip()
+        for b in os.environ.get("ALLREDUCE_GATE_BACKENDS", "tpu").split(",")
+    ]
+    bw_ok = bool(bench["ok"])
+    if (
+        min_gbps
+        and bench["transport"] == "ici"
+        and bench["backend"] in gated_backends
+        and not bench.get("overhead_dominated")
+        and bench["busbw_gbps"] < min_gbps
+    ):
+        bw_ok = False
+        bench["error"] = (
+            f"busbw {bench['busbw_gbps']:.1f} < required {min_gbps} GB/s"
+        )
+
     # -- burn-in over the global (dp, mp) mesh: real SGD steps with MXU
     # matmuls + cross-host collectives (mp psum, dp grad pmean)
-    from tpu_operator.workloads import collectives
 
     mesh = collectives.make_mesh(devices=devices)
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]
@@ -123,13 +157,19 @@ def run_worker(
     decreasing = len(losses) < 2 or losses[-1] < losses[0]
 
     return {
-        "ok": psum_ok and finite and decreasing,
+        "ok": psum_ok and finite and decreasing and bw_ok,
         "process_id": process_id,
         "num_processes": num_processes,
         "global_devices": len(devices),
         "local_devices": local,
         "mesh": {"dp": dp, "mp": mp},
         "psum": {"total": total, "expected": expected, "ok": psum_ok},
+        "allreduce": {
+            k: bench.get(k)
+            for k in ("ok", "busbw_gbps", "algbw_gbps", "size_mb", "transport",
+                      "overhead_dominated", "error")
+            if k in bench
+        } | {"min_gbps": min_gbps, "gated": bool(min_gbps)},
         "losses": losses,
         "time_s": time.perf_counter() - t0,
         "backend": jax.default_backend(),
