@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench lint all
+.PHONY: test unit-test proto manifests goldens bench lint all image e2e-kind
 
 all: proto manifests test
 
@@ -27,3 +27,11 @@ goldens:
 
 bench:
 	$(PYTHON) bench.py
+
+# single image for operator + operands (docker/Dockerfile)
+image:
+	docker build -t tpu-operator:dev -f docker/Dockerfile .
+
+# real-apiserver e2e: kind + helm install + policy Ready + zero restarts
+e2e-kind:
+	bash tests/scripts/e2e-kind.sh

@@ -1,0 +1,352 @@
+"""Minimal Go-template/helm evaluator for chart golden tests.
+
+No helm binary ships in this image, so tests render ``deploy/chart/`` with
+this evaluator — which implements exactly the template subset the chart is
+written in — and assert object-for-object equality with the python
+installer's output.  The subset (and only it) is allowed in chart templates:
+
+  {{ .Values.a.b }}  {{ .Release.Namespace }}  {{ .Chart.Name }}
+  {{- if <expr> }} / {{- else }} / {{- end }}   (truthiness, `not <expr>`)
+  {{- range $k, $v := <expr> }} / {{- end }}    (maps: sorted keys, like Go;
+                                                 lists: $v only or $k=index)
+  pipelines: quote, upper, toYaml, indent N, nindent N, default X,
+             replace "a" "b"
+
+Semantics mirror text/template + sprig closely enough that real `helm
+template` produces the same objects (map ranges iterate in sorted key
+order in Go templates; toYaml differences wash out because tests compare
+PARSED objects, not strings).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Iterator, Optional
+
+import yaml
+
+TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+class HelmLiteError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexing: split template into (text | action) parts with whitespace trimming.
+
+
+def _lex(src: str) -> list[tuple[str, str]]:
+    """Two-pass lexer: normalize trim markers first, then split."""
+    # {{- trims ALL preceding whitespace (incl. newlines); -}} trims ALL
+    # following whitespace — matching text/template's definition.
+    src = re.sub(r"\s*\{\{-", "{{", src)
+    src = re.sub(r"-\}\}\s*", "}}", src)
+    parts: list[tuple[str, str]] = []
+    pos = 0
+    for m in TOKEN.finditer(src):
+        parts.append(("text", src[pos : m.start()]))
+        parts.append(("action", m.group(1).strip()))
+        pos = m.end()
+    parts.append(("text", src[pos:]))
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# Parsing: nest if/range blocks.
+
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s: str):
+        self.s = s
+
+
+class Expr(Node):
+    def __init__(self, e: str):
+        self.e = e
+
+
+class If(Node):
+    def __init__(self, cond: str):
+        self.cond = cond
+        self.body: list[Node] = []
+        self.orelse: list[Node] = []
+
+
+class Range(Node):
+    def __init__(self, header: str):
+        self.header = header
+        self.body: list[Node] = []
+
+
+def _parse(parts: list[tuple[str, str]]) -> list[Node]:
+    root: list[Node] = []
+    stack: list[tuple[Any, list[Node]]] = [(None, root)]
+    for kind, payload in parts:
+        top = stack[-1][1]
+        if kind == "text":
+            if payload:
+                top.append(Text(payload))
+            continue
+        if payload.startswith("if "):
+            node = If(payload[3:].strip())
+            top.append(node)
+            stack.append((node, node.body))
+        elif payload == "else":
+            node = stack[-1][0]
+            if not isinstance(node, If):
+                raise HelmLiteError("else outside if")
+            stack[-1] = (node, node.orelse)
+        elif payload.startswith("range "):
+            node = Range(payload[6:].strip())
+            top.append(node)
+            stack.append((node, node.body))
+        elif payload == "end":
+            if len(stack) == 1:
+                raise HelmLiteError("unbalanced end")
+            stack.pop()
+        elif payload.startswith(("/*", "#")):
+            continue  # comment
+        else:
+            top.append(Expr(payload))
+    if len(stack) != 1:
+        raise HelmLiteError("unclosed block")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Evaluation.
+
+_STR = re.compile(r'^"((?:[^"\\]|\\.)*)"$')
+
+
+def _split_args(s: str) -> list[str]:
+    """Split on spaces outside quotes and parens."""
+    out, buf, depth, q = [], "", 0, False
+    for ch in s:
+        if ch == '"' and (not buf or buf[-1] != "\\"):
+            q = not q
+        if ch == "(" and not q:
+            depth += 1
+        if ch == ")" and not q:
+            depth -= 1
+        if ch == " " and not q and depth == 0:
+            if buf:
+                out.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    if buf:
+        out.append(buf)
+    return out
+
+
+class Scope:
+    def __init__(self, ctx: dict, variables: Optional[dict] = None):
+        self.ctx = ctx
+        self.vars = variables or {}
+
+    def child(self, **new) -> "Scope":
+        return Scope(self.ctx, {**self.vars, **new})
+
+
+def _resolve_path(obj: Any, path: str) -> Any:
+    for part in path.split("."):
+        if part == "":
+            continue
+        if isinstance(obj, dict):
+            obj = obj.get(part)
+        else:
+            obj = getattr(obj, part, None)
+    return obj
+
+
+def _eval_term(term: str, scope: Scope) -> Any:
+    term = term.strip()
+    if term.startswith("(") and term.endswith(")"):
+        return _eval_pipeline(term[1:-1], scope)
+    m = _STR.match(term)
+    if m:
+        return m.group(1).replace('\\"', '"')
+    if term in ("true", "false"):
+        return term == "true"
+    if re.fullmatch(r"-?\d+", term):
+        return int(term)
+    if term.startswith("$"):
+        name, _, rest = term.partition(".")
+        if name not in scope.vars:
+            raise HelmLiteError(f"undefined variable {name}")
+        base = scope.vars[name]
+        return _resolve_path(base, rest) if rest else base
+    if term.startswith("."):
+        return _resolve_path(scope.ctx, term[1:])
+    raise HelmLiteError(f"cannot evaluate term {term!r}")
+
+
+def _apply_fn(fn: str, args: list[Any], piped: Any) -> Any:
+    if fn == "quote":
+        return '"' + str(piped).replace('"', '\\"') + '"'
+    if fn == "upper":
+        return str(piped).upper()
+    if fn == "replace":
+        return str(piped).replace(str(args[0]), str(args[1]))
+    if fn == "default":
+        return piped if piped not in (None, "", 0, False, [], {}) else args[0]
+    if fn == "toYaml":
+        return yaml.safe_dump(piped, default_flow_style=False, sort_keys=True).rstrip("\n")
+    if fn == "indent":
+        pad = " " * int(args[0])
+        return "\n".join(pad + line for line in str(piped).splitlines())
+    if fn == "nindent":
+        pad = " " * int(args[0])
+        return "\n" + "\n".join(pad + line for line in str(piped).splitlines())
+    if fn == "not":
+        return not _truthy(piped)
+    raise HelmLiteError(f"unsupported function {fn!r}")
+
+
+def _eval_segment(seg: str, scope: Scope, piped: Any = ...) -> Any:
+    toks = _split_args(seg.strip())
+    if not toks:
+        raise HelmLiteError("empty segment")
+    head = toks[0]
+    if head in ("quote", "upper", "replace", "default", "toYaml", "indent",
+                "nindent", "not"):
+        args = [_eval_term(t, scope) for t in toks[1:]]
+        if piped is ...:
+            # prefix form: fn ARG (last arg is the subject)
+            if not args:
+                raise HelmLiteError(f"{head} needs an argument")
+            return _apply_fn(head, args[:-1], args[-1])
+        return _apply_fn(head, args, piped)
+    if len(toks) != 1:
+        raise HelmLiteError(f"cannot evaluate {seg!r}")
+    return _eval_term(head, scope)
+
+
+def _eval_pipeline(expr: str, scope: Scope) -> Any:
+    segments = [s.strip() for s in _smart_split_pipe(expr)]
+    value: Any = ...
+    for seg in segments:
+        value = _eval_segment(seg, scope, piped=value)
+    return value
+
+
+def _smart_split_pipe(s: str) -> list[str]:
+    out, buf, depth, q = [], "", 0, False
+    for ch in s:
+        if ch == '"' and (not buf or buf[-1] != "\\"):
+            q = not q
+        if ch == "(" and not q:
+            depth += 1
+        if ch == ")" and not q:
+            depth -= 1
+        if ch == "|" and not q and depth == 0:
+            out.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    out.append(buf)
+    return out
+
+
+def _truthy(v: Any) -> bool:
+    return bool(v)
+
+
+def _render_nodes(nodes: list[Node], scope: Scope) -> Iterator[str]:
+    for node in nodes:
+        if isinstance(node, Text):
+            yield node.s
+        elif isinstance(node, Expr):
+            value = _eval_pipeline(node.e, scope)
+            yield "" if value is None else str(value)
+        elif isinstance(node, If):
+            cond = _eval_pipeline(node.cond, scope)
+            yield from _render_nodes(node.body if _truthy(cond) else node.orelse, scope)
+        elif isinstance(node, Range):
+            header = node.header
+            if ":=" in header:
+                var_part, _, expr = header.partition(":=")
+                names = [v.strip() for v in var_part.split(",")]
+                coll = _eval_pipeline(expr.strip(), scope)
+            else:
+                names, coll = [], _eval_pipeline(header, scope)
+            if isinstance(coll, dict):
+                items = [(k, coll[k]) for k in sorted(coll)]  # Go: sorted keys
+            elif isinstance(coll, list):
+                items = list(enumerate(coll))
+            elif coll is None:
+                items = []
+            else:
+                raise HelmLiteError(f"cannot range over {type(coll)}")
+            for k, v in items:
+                if len(names) == 2:
+                    child = scope.child(**{names[0]: k, names[1]: v})
+                elif len(names) == 1:
+                    child = scope.child(**{names[0]: v})
+                else:
+                    child = scope
+                yield from _render_nodes(node.body, child)
+
+
+def render_template(src: str, ctx: dict) -> str:
+    return "".join(_render_nodes(_parse(_lex(src)), Scope(ctx)))
+
+
+# ---------------------------------------------------------------------------
+# Chart-level entry point.
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def render_chart(
+    chart_dir: str,
+    namespace: str = "tpu-operator",
+    release: str = "tpu-operator",
+    values: Optional[dict] = None,
+    include_crds: bool = True,
+) -> list[dict]:
+    """helm-template the chart: CRDs (helm's crds/ dir semantics) + every
+    templates/*.yaml, parsed into objects."""
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        base_values = yaml.safe_load(f) or {}
+    ctx = {
+        "Values": _deep_merge(base_values, values or {}),
+        "Release": {"Namespace": namespace, "Name": release},
+        "Chart": {
+            "Name": chart_meta.get("name"),
+            "Version": chart_meta.get("version"),
+            "AppVersion": chart_meta.get("appVersion"),
+        },
+    }
+    objs: list[dict] = []
+    if include_crds:
+        crd_dir = os.path.join(chart_dir, "crds")
+        if os.path.isdir(crd_dir):
+            for name in sorted(os.listdir(crd_dir)):
+                with open(os.path.join(crd_dir, name)) as f:
+                    objs.extend(d for d in yaml.safe_load_all(f) if d)
+    tpl_dir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(tpl_dir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tpl_dir, name)) as f:
+            rendered = render_template(f.read(), ctx)
+        objs.extend(d for d in yaml.safe_load_all(rendered) if d)
+    return objs

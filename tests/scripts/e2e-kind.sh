@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Real-cluster e2e: helm-install the operator into a kind cluster and assert
+# the ClusterPolicy reconciles to Ready with zero operand restarts.
+#
+# Reference analogue: tests/e2e/gpu_operator_test.go:43-154 (helm install,
+# Eventually all-operands-Ready within 15 min, zero restarts) and
+# tests/scripts/end-to-end.sh.  BASELINE config 1: "ClusterPolicy CR
+# reconcile on CPU-only kind cluster".
+#
+# Requires: kind, kubectl, helm, docker.
+#
+# Env:
+#   CLUSTER_NAME       kind cluster name        (default tpu-operator-e2e)
+#   KEEP_CLUSTER=1     skip deletion on exit
+#   OPERATOR_READY_BUDGET   seconds for the Deployment   (default 300)
+#   POLICY_READY_BUDGET     seconds for policy Ready     (default 900)
+#   E2E_FAKE_TPU=1     additionally label the kind node as a TPU host with
+#                      env-declared chips and assert the operand DaemonSets
+#                      schedule (device plugin runs in virtual-chip mode)
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-operator-e2e}"
+NAMESPACE="${NAMESPACE:-tpu-operator}"
+IMAGE="${IMAGE:-tpu-operator:e2e}"
+OPERATOR_READY_BUDGET="${OPERATOR_READY_BUDGET:-300}"
+POLICY_READY_BUDGET="${POLICY_READY_BUDGET:-900}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+
+log() { echo "[e2e-kind] $*" >&2; }
+
+cleanup() {
+  if [ "${KEEP_CLUSTER:-0}" != "1" ]; then
+    kind delete cluster --name "$CLUSTER_NAME" || true
+  fi
+}
+trap cleanup EXIT
+
+log "building operator image $IMAGE"
+docker build -t "$IMAGE" -f "$REPO_ROOT/docker/Dockerfile" "$REPO_ROOT"
+
+log "creating kind cluster $CLUSTER_NAME"
+kind create cluster --name "$CLUSTER_NAME" --wait 120s
+kind load docker-image "$IMAGE" --name "$CLUSTER_NAME"
+
+log "helm-installing the chart"
+helm install tpu-operator "$REPO_ROOT/deploy/chart/tpu-operator" \
+  --namespace "$NAMESPACE" \
+  --set createNamespace=false \
+  --set operator.image="${IMAGE%%:*}" \
+  --set operator.version="${IMAGE##*:}" \
+  --set operator.imagePullPolicy=Never \
+  --create-namespace
+
+log "waiting for the operator Deployment (budget ${OPERATOR_READY_BUDGET}s)"
+kubectl -n "$NAMESPACE" rollout status deployment/tpu-operator \
+  --timeout="${OPERATOR_READY_BUDGET}s"
+
+log "waiting for TPUClusterPolicy Ready (budget ${POLICY_READY_BUDGET}s)"
+deadline=$(( $(date +%s) + POLICY_READY_BUDGET ))
+state=""
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  state="$(kubectl get tpuclusterpolicy cluster-policy \
+    -o jsonpath='{.status.state}' 2>/dev/null || true)"
+  [ "$state" = "ready" ] && break
+  sleep 5
+done
+if [ "$state" != "ready" ]; then
+  log "policy never reached ready (state=$state)"
+  kubectl get tpuclusterpolicy cluster-policy -o yaml || true
+  kubectl -n "$NAMESPACE" get pods -o wide || true
+  kubectl -n "$NAMESPACE" logs deployment/tpu-operator --tail=100 || true
+  exit 1
+fi
+log "policy is ready"
+
+if [ "${E2E_FAKE_TPU:-0}" = "1" ]; then
+  log "labelling the kind node as a virtual TPU host"
+  node="$(kubectl get nodes -o jsonpath='{.items[0].metadata.name}')"
+  kubectl label node "$node" \
+    cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice \
+    cloud.google.com/gke-tpu-topology=2x2 --overwrite
+  log "waiting for the operand DaemonSets to schedule"
+  deadline=$(( $(date +%s) + 300 ))
+  while [ "$(date +%s)" -lt "$deadline" ]; do
+    scheduled="$(kubectl -n "$NAMESPACE" get ds \
+      -o jsonpath='{range .items[*]}{.status.desiredNumberScheduled}{"\n"}{end}' \
+      | grep -c '^1$' || true)"
+    [ "$scheduled" -ge 1 ] && break
+    sleep 5
+  done
+  kubectl -n "$NAMESPACE" get ds
+fi
+
+log "asserting zero restarts across operator + operand pods"
+restarts="$(kubectl -n "$NAMESPACE" get pods \
+  -o jsonpath='{range .items[*]}{range .status.containerStatuses[*]}{.restartCount}{"\n"}{end}{end}' \
+  | awk '{s+=$1} END {print s+0}')"
+if [ "$restarts" != "0" ]; then
+  log "unexpected restarts: $restarts"
+  kubectl -n "$NAMESPACE" get pods
+  exit 1
+fi
+
+log "PASS: operator installed via helm, policy ready, zero restarts"

@@ -1,0 +1,135 @@
+"""Helm chart golden tests (deploy/chart/tpu-operator).
+
+Reference analogue: deployments/gpu-operator/ chart surface (Chart.yaml:1,
+templates/clusterpolicy.yaml, templates/nvidiadriver.yaml).  No helm binary
+ships in this image, so the chart is rendered with tests/helmlite.py — an
+evaluator of exactly the template subset the chart uses — and compared
+object-for-object against the python installer (cmd/deploy.py), which is
+the behavior `helm template` must reproduce in a real cluster.
+"""
+
+import os
+
+import yaml
+
+from tests import helmlite
+from tpu_operator.cmd import deploy
+
+CHART_DIR = os.path.join(deploy.DEPLOY_DIR, "chart", "tpu-operator")
+
+
+def _by_key(objs):
+    out = {}
+    for o in objs:
+        key = (o["kind"], o["metadata"]["name"])
+        assert key not in out, f"duplicate object {key}"
+        out[key] = o
+    return out
+
+
+def _installer_objs(overrides=None):
+    values = deploy.load_values(
+        os.path.join(deploy.DEPLOY_DIR, "values.yaml"), overrides or []
+    )
+    return deploy.render_manifests(values)
+
+
+def test_chart_matches_installer_defaults():
+    chart = _by_key(helmlite.render_chart(CHART_DIR))
+    installer = _by_key(_installer_objs())
+    assert set(chart) == set(installer)
+    for key in installer:
+        assert chart[key] == installer[key], f"mismatch for {key}"
+
+
+def test_chart_matches_installer_with_overrides():
+    runtime_instance = {
+        "name": "v5e-stable",
+        "spec": {
+            "runtimeChannel": "stable",
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"
+            },
+        },
+    }
+    chart = _by_key(
+        helmlite.render_chart(
+            CHART_DIR,
+            namespace="tpu-system",
+            values={
+                "operator": {"leaderElect": False, "replicas": 2},
+                "images": {"validator": "example.com/validator:v9"},
+                "tpuRuntime": {"enabled": True, "instances": [runtime_instance]},
+            },
+        )
+    )
+    installer = _by_key(
+        _installer_objs(
+            [
+                "namespace=tpu-system",
+                "operator.leaderElect=false",
+                "operator.replicas=2",
+                "images.validator=example.com/validator:v9",
+                "tpuRuntime.enabled=true",
+                f"tpuRuntime.instances={yaml.safe_dump([runtime_instance], default_flow_style=True).strip()}",
+            ]
+        )
+    )
+    assert set(chart) == set(installer)
+    for key in installer:
+        assert chart[key] == installer[key], f"mismatch for {key}"
+    assert ("TPURuntime", "v5e-stable") in chart
+    deployment = chart[("Deployment", "tpu-operator")]
+    args = deployment["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--leader-elect" not in args
+
+
+def test_chart_crds_in_sync_with_installer():
+    """helm's crds/ dir must carry byte-identical copies of the generated
+    CRDs (deploy/crds, themselves golden-tested against api/crds.py)."""
+    src = os.path.join(deploy.DEPLOY_DIR, "crds")
+    dst = os.path.join(CHART_DIR, "crds")
+    assert sorted(os.listdir(src)) == sorted(os.listdir(dst))
+    for name in os.listdir(src):
+        with open(os.path.join(src, name)) as f1, open(os.path.join(dst, name)) as f2:
+            assert f1.read() == f2.read(), f"chart crds/{name} drifted"
+
+
+def test_chart_namespace_gate():
+    objs = helmlite.render_chart(CHART_DIR, values={"createNamespace": False})
+    assert not [o for o in objs if o["kind"] == "Namespace"]
+    objs = helmlite.render_chart(CHART_DIR)
+    ns = [o for o in objs if o["kind"] == "Namespace"][0]
+    assert (
+        ns["metadata"]["labels"]["pod-security.kubernetes.io/enforce"]
+        == "privileged"
+    )
+
+
+# ---------------------------------------------------------------------------
+# helmlite itself (the subset must behave like text/template + sprig)
+
+
+def test_helmlite_pipeline_functions():
+    ctx = {"Values": {"name": "device-plugin", "empty": "", "res": {"b": 1, "a": 2}}}
+    render = helmlite.render_template
+    assert render('{{ .Values.name | replace "-" "_" | upper }}_IMAGE', ctx) \
+        == "DEVICE_PLUGIN_IMAGE"
+    assert render("{{ .Values.name | quote }}", ctx) == '"device-plugin"'
+    assert render('{{ .Values.empty | default "x" }}', ctx) == "x"
+    assert yaml.safe_load(render("{{ toYaml .Values.res }}", ctx)) == {"a": 2, "b": 1}
+    assert render("a:{{ toYaml .Values.res | nindent 2 }}", ctx) == "a:\n  a: 2\n  b: 1"
+
+
+def test_helmlite_control_flow():
+    render = helmlite.render_template
+    ctx = {"Values": {"on": True, "imgs": {"b": "2", "a": "1"}, "list": ["x", "y"]}}
+    assert render("{{- if .Values.on }}yes{{- else }}no{{- end }}", ctx) == "yes"
+    assert render("{{- if not .Values.on }}yes{{- else }}no{{- end }}", ctx) == "no"
+    # maps iterate in sorted key order, like Go templates
+    out = render(
+        "{{- range $k, $v := .Values.imgs }}{{ $k }}={{ $v }};{{- end }}", ctx
+    )
+    assert out == "a=1;b=2;"
+    out = render("{{- range $v := .Values.list }}{{ $v }},{{- end }}", ctx)
+    assert out == "x,y,"

@@ -174,13 +174,19 @@ def main() -> None:
 
     import yaml
 
-    out_dir = os.path.join(os.path.dirname(__file__), "..", "..", "deploy", "crds")
-    os.makedirs(out_dir, exist_ok=True)
-    for crd in all_crds():
-        path = os.path.join(out_dir, crd["metadata"]["name"] + ".yaml")
-        with open(path, "w") as f:
-            yaml.safe_dump(crd, f, sort_keys=False)
-        print(f"wrote {os.path.normpath(path)}")
+    deploy_dir = os.path.join(os.path.dirname(__file__), "..", "..", "deploy")
+    # the installer's crds/ and the helm chart's crds/ carry identical copies
+    # (tests/test_chart.py guards against drift)
+    for out_dir in (
+        os.path.join(deploy_dir, "crds"),
+        os.path.join(deploy_dir, "chart", "tpu-operator", "crds"),
+    ):
+        os.makedirs(out_dir, exist_ok=True)
+        for crd in all_crds():
+            path = os.path.join(out_dir, crd["metadata"]["name"] + ".yaml")
+            with open(path, "w") as f:
+                yaml.safe_dump(crd, f, sort_keys=False)
+            print(f"wrote {os.path.normpath(path)}")
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ vectorAdd, validator/main.go:1189-1302) with TPU-native XLA programs:
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Optional
 
@@ -191,6 +192,39 @@ def allreduce_benchmark(
         "transport": "ici" if n > 1 else "hbm-local",
         "backend": jax.default_backend(),
     }
+
+
+def apply_allreduce_gate(result: dict, min_gbps: float) -> dict:
+    """The ICI bandwidth gate policy, in ONE place (the workload-pod and the
+    distributed multi-host paths must enforce identical rules):
+
+    - gates busbw (the link-rate-comparable NCCL-tests number)
+    - only over real ICI (single-chip HBM copy rates are never gated)
+    - only on backends named in ALLREDUCE_GATE_BACKENDS (default tpu —
+      CPU/gloo rates say nothing about ICI health)
+    - never when the measurement was overhead-dominated (can't be trusted
+      in either direction)
+
+    Mutates ``result``: records ``min_gbps`` and whether the gate was
+    actually ``gated`` (enforced), and flips ``ok`` on a miss."""
+    backends = [
+        b.strip()
+        for b in os.environ.get("ALLREDUCE_GATE_BACKENDS", "tpu").split(",")
+    ]
+    enforced = (
+        min_gbps > 0
+        and result.get("transport") == "ici"
+        and result.get("backend") in backends
+        and not result.get("overhead_dominated")
+    )
+    result["min_gbps"] = min_gbps
+    result["gated"] = enforced
+    if enforced and result["busbw_gbps"] < min_gbps:
+        result["ok"] = False
+        result["error"] = (
+            f"busbw {result['busbw_gbps']:.1f} < required {min_gbps} GB/s"
+        )
+    return result
 
 
 # ---------------------------------------------------------------------------

@@ -101,22 +101,8 @@ def run_worker(
         min_gbps = float(os.environ.get("ALLREDUCE_MIN_GBPS", "0") or 0)
     except ValueError:
         min_gbps = 0.0
-    gated_backends = [
-        b.strip()
-        for b in os.environ.get("ALLREDUCE_GATE_BACKENDS", "tpu").split(",")
-    ]
+    collectives.apply_allreduce_gate(bench, min_gbps)
     bw_ok = bool(bench["ok"])
-    if (
-        min_gbps
-        and bench["transport"] == "ici"
-        and bench["backend"] in gated_backends
-        and not bench.get("overhead_dominated")
-        and bench["busbw_gbps"] < min_gbps
-    ):
-        bw_ok = False
-        bench["error"] = (
-            f"busbw {bench['busbw_gbps']:.1f} < required {min_gbps} GB/s"
-        )
 
     # -- burn-in over the global (dp, mp) mesh: real SGD steps with MXU
     # matmuls + cross-host collectives (mp psum, dp grad pmean)

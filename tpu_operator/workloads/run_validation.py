@@ -34,37 +34,21 @@ def main() -> int:
         if check == "vector-add":
             result = collectives.vector_add()
         elif check == "allreduce":
-            result = collectives.allreduce_benchmark(
-                size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "64"))
+            result = collectives.apply_allreduce_gate(
+                collectives.allreduce_benchmark(
+                    size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "64"))
+                ),
+                float(os.environ.get("ALLREDUCE_MIN_GBPS", "0")),
             )
-            min_gbps = float(os.environ.get("ALLREDUCE_MIN_GBPS", "0"))
-            if result["transport"] != "ici":
-                min_gbps = 0  # single chip: an HBM copy rate, not ICI; never gate
-            gated = [
-                b.strip()
-                for b in os.environ.get("ALLREDUCE_GATE_BACKENDS", "tpu").split(",")
-            ]
-            if result["backend"] not in gated:
-                min_gbps = 0  # CPU/gloo rates say nothing about ICI health
-            if result.get("overhead_dominated"):
-                # the measurement floor swamped the collective — the number
-                # is reported (deflated) but cannot be gated either way
-                min_gbps = 0
-            # busbw is the link-rate-comparable number (NCCL-tests
-            # convention) and what the catalogue expectation describes
-            if min_gbps and result["busbw_gbps"] < min_gbps:
-                result["ok"] = False
-                result["error"] = f"busbw {result['busbw_gbps']:.1f} < required {min_gbps}"
         elif check == "burn-in":
             result = collectives.burn_in()
         elif check == "matmul":
             from tpu_operator.workloads import matmul_bench
 
-            result = matmul_bench.quick_benchmark()
-            min_mfu = float(os.environ.get("MATMUL_MIN_MFU", "0"))
-            if min_mfu and result["mfu"] is not None and result["mfu"] < min_mfu:
-                result["ok"] = False
-                result["error"] = f"mfu {result['mfu']:.3f} < required {min_mfu}"
+            result = matmul_bench.apply_mfu_gate(
+                matmul_bench.quick_benchmark(),
+                float(os.environ.get("MATMUL_MIN_MFU", "0")),
+            )
         else:
             result = {"ok": False, "error": f"unknown check {check}"}
         print(json.dumps({"check": check, **result}), flush=True)
