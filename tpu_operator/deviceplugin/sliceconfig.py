@@ -163,34 +163,75 @@ def _chip_path(local_index: int) -> str:
 
 
 async def run_plugins(strategy: str, base: PluginConfig, poll_seconds: float = 10.0) -> None:
-    """Serve the plugin set, rebuilding it whenever the applied slice layout
+    """Serve the plugin set, reconciling it whenever the applied slice layout
     changes (the slice manager's post-reconfig 'notification' is the file
-    itself — plugins re-serve + re-register, kubelet picks up the new
-    resources)."""
+    itself).  The reconcile is INCREMENTAL: only plugins whose config
+    actually changed are stopped/started — an unchanged shape keeps its
+    socket and kubelet registration across a repartition that only touches
+    other shapes (the r02 full-restart caused a kubelet-visible blip for
+    every resource on every reconfigure)."""
     import asyncio
+    import dataclasses
 
-    while True:
-        configs = build_plugin_configs(strategy, base)
-        plugins = [TPUDevicePlugin(c) for c in configs]
-        log.info(
-            "serving %d plugin(s): %s",
-            len(plugins), [c.resource_name for c in configs],
-        )
-        tasks = [asyncio.create_task(p.run_forever()) for p in plugins]
-        signature = config_signature() if strategy == "mixed" else ""
+    # resource name → (config identity, plugin, serving task)
+    running: dict[str, tuple[str, TPUDevicePlugin, "asyncio.Task"]] = {}
+
+    def _key(cfg: PluginConfig) -> str:
+        return json.dumps(dataclasses.asdict(cfg), sort_keys=True, default=str)
+
+    async def _stop(resource: str) -> None:
+        _, plugin, task = running.pop(resource)
+        task.cancel()
         try:
+            await task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        await plugin.stop()
+
+    try:
+        while True:
+            # signature FIRST: a layout write landing between the config
+            # build and a later capture would be absorbed unseen (the
+            # reconcile below spans real await points)
+            signature = config_signature() if strategy == "mixed" else ""
+            desired = {
+                c.resource_name: c for c in build_plugin_configs(strategy, base)
+            }
+            for resource in list(running):
+                if (
+                    resource not in desired
+                    or _key(desired[resource]) != running[resource][0]
+                    or running[resource][2].done()  # crashed task: revive
+                ):
+                    log.info("plugin %s removed/changed/dead; restarting it", resource)
+                    await _stop(resource)
+            for resource, cfg in desired.items():
+                if resource not in running:
+                    plugin = TPUDevicePlugin(cfg)
+                    running[resource] = (
+                        _key(cfg),
+                        plugin,
+                        asyncio.create_task(plugin.run_forever()),
+                    )
+            log.info("serving %d plugin(s): %s", len(running), sorted(running))
             while True:
                 await asyncio.sleep(poll_seconds)
                 if strategy == "mixed" and config_signature() != signature:
-                    log.info("slice layout/worker-id changed; rebuilding plugin set")
+                    log.info("slice layout/worker-id changed; reconciling plugin set")
                     break
-        finally:
-            for t in tasks:
-                t.cancel()
-            for t in tasks:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                    pass
-            for p in plugins:
-                await p.stop()
+                dead = {
+                    resource: entry[2]
+                    for resource, entry in running.items()
+                    if entry[2].done()
+                }
+                if dead:
+                    for resource, task in dead.items():
+                        exc = None if task.cancelled() else task.exception()
+                        log.warning(
+                            "plugin %s serving task died; reconciling plugin set",
+                            resource, exc_info=exc,
+                        )
+                    break
+    finally:
+        for resource in list(running):
+            await _stop(resource)

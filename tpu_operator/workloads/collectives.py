@@ -123,9 +123,14 @@ def allreduce_benchmark(
     def chain(shard):
         if n > 1:
             # value stays exactly 1.0 every round: psum -> n, /n -> 1
-            # (pvary: the replicated psum result re-enters the loop as the
-            # device-varying carry the fori_loop signature requires)
-            body = lambda _, s: jax.lax.pvary(jax.lax.psum(s, "x") / n, "x")  # noqa: E731
+            # (the replicated psum result must re-enter the loop as the
+            # device-varying carry the fori_loop signature requires; pcast
+            # replaced pvary in newer jax — keep the fallback for older)
+            if hasattr(jax.lax, "pcast"):
+                _vary = lambda v: jax.lax.pcast(v, "x", to="varying")  # noqa: E731
+            else:  # pragma: no cover — older jax
+                _vary = lambda v: jax.lax.pvary(v, "x")  # noqa: E731
+            body = lambda _, s: _vary(jax.lax.psum(s, "x") / n)  # noqa: E731
             expected = 1.0
         else:
             # single chip moves no ICI traffic; accumulate so the loop body

@@ -127,12 +127,19 @@ def run_worker(
             "w2": NamedSharding(mesh, P("mp", None)),
         },
     )(jax.random.PRNGKey(0))
-    batch_per_proc = 8 * max(1, dp // num_processes) if dp >= num_processes else 8
-    x = jax.make_array_from_process_local_data(
+    # Global batch sized to the dp axis alone — every process builds the SAME
+    # deterministic global array and each device picks out its own slice, so
+    # the construction is correct for ANY hosts-vs-dp topology (8 single-chip
+    # hosts on a dp=2 mesh included; the old per-process-local sizing only
+    # tiled when num_processes divided dp).
+    global_batch = 8 * dp
+    gx = np.random.default_rng(1).standard_normal(
+        (global_batch, d_model), dtype=np.float32
+    ).astype(jnp.bfloat16)
+    x = jax.make_array_from_callback(
+        (global_batch, d_model),
         NamedSharding(mesh, P("dp", None)),
-        np.random.default_rng(1).standard_normal(
-            (batch_per_proc, d_model), dtype=np.float32
-        ).astype(jnp.bfloat16),
+        lambda idx: gx[idx],
     )
     step = jax.jit(functools.partial(collectives.burn_in_step, mesh))
     losses = []
@@ -160,6 +167,65 @@ def run_worker(
         "time_s": time.perf_counter() - t0,
         "backend": jax.default_backend(),
     }
+
+
+def spawn_local_workers(
+    num_processes: int,
+    devices_per_proc: int,
+    steps: int = 2,
+    extra_env: Optional[dict] = None,
+    timeout: float = 300,
+) -> list[dict]:
+    """Spawn ``num_processes`` REAL worker processes on the CPU backend
+    against a local coordinator — the one harness behind the driver's
+    multi-chip dryrun and the multi-process tests (the env contract below
+    is what the validator's pod spec injects in-cluster; keeping it in one
+    place keeps the dryrun and the tests from diverging).
+
+    Returns each worker's parsed result JSON; raises AssertionError when a
+    worker exits non-zero."""
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for wid in range(num_processes):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_proc}",
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": str(num_processes),
+            "PROCESS_ID": str(wid),
+            "BURN_IN_STEPS": str(steps),
+            **(extra_env or {}),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.distributed"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    try:
+        for wid, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=timeout)
+            assert proc.returncode == 0, (
+                f"distributed worker {wid} failed:\n{out[-2000:]}\n{err[-2000:]}"
+            )
+            results.append(json.loads(out.splitlines()[-1]))
+    finally:
+        # one worker failing must not strand the rest blocked on the dead
+        # coordinator with unread pipes
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+    return results
 
 
 def main() -> int:
