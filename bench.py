@@ -71,6 +71,12 @@ def _exec_workload_pod(pod: dict) -> str:
     }
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("WORKLOAD_IMAGE", None)
+    # the persistent XLA cache is a node-local win (disk-bound writes) but a
+    # loss through THIS runner's tunneled PJRT backend, where serializing
+    # each executable costs a device round-trip (measured: +40s cold, A/B
+    # r03); disable it here so the headline number reflects the pipeline,
+    # not the testbed's transport
+    env["TPU_COMPILE_CACHE"] = "0"
     try:
         result = subprocess.run(
             [sys.executable, "-m", "tpu_operator.workloads.run_validation"],
@@ -98,6 +104,7 @@ def run_matmul_bench() -> dict:
     """
     env = {**os.environ}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["TPU_COMPILE_CACHE"] = "0"  # see _exec_workload_pod: tunnel artifact
     try:
         result = subprocess.run(
             [sys.executable, "-m", "tpu_operator.workloads.matmul_bench"],
@@ -178,10 +185,23 @@ async def bench() -> dict:
                 await validator.run("jax")
                 t_validated = time.perf_counter() - t0
 
+                # phase 2b: re-validation — the operationally recurring cost
+                # (preStop re-gating, upgrade re-proof).  NOTE the persistent
+                # XLA cache is NOT in play here (this file disables it; see
+                # _exec_workload_pod), so this measures the steady recurring
+                # validation round on this transport, nothing cache-related.
+                n_cold_results = len(WORKLOAD_RESULTS)
+                vstatus.clear("jax")
+                t1 = time.perf_counter()
+                await validator.run("jax")
+                t_revalidated = time.perf_counter() - t1
+
                 jax_status = vstatus.read_status("jax") or {}
                 return {
                     "join_to_schedulable_s": round(t_schedulable, 3),
                     "join_to_validated_s": round(t_validated, 3),
+                    "revalidation_s": round(t_revalidated, 3),
+                    "n_cold_results": n_cold_results,
                     "chips": jax_status.get("chips"),
                 }
 
@@ -190,9 +210,13 @@ def main() -> None:
     result = asyncio.run(bench())
     value = result["join_to_validated_s"]
 
-    # phase 3: compute + bandwidth detail on the now-free chip
+    # phase 3: compute + bandwidth detail on the now-free chip.
+    # Detail numbers come from the COLD run only — the re-validation appended
+    # a second result set, and prior rounds' juxtaposed numbers were single
+    # cold runs; mixing provenance would misattribute warm-run drift.
     matmul = run_matmul_bench()
-    checks = {r.get("check", "?"): r for r in WORKLOAD_RESULTS}
+    cold = WORKLOAD_RESULTS[: result.pop("n_cold_results", len(WORKLOAD_RESULTS))]
+    checks = {r.get("check", "?"): r for r in cold}
     allreduce = checks.get("allreduce", {})
     detail = {
         **result,

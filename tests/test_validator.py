@@ -112,6 +112,7 @@ def _exec_workload_pod(pod: dict) -> str:
         **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
     }
     env.pop("WORKLOAD_IMAGE", None)
+    env["TPU_COMPILE_CACHE"] = "0"  # pod env points at /run/tpu on the host
     result = subprocess.run(
         [sys.executable, "-m", "tpu_operator.workloads.run_validation"],
         env=env, capture_output=True, text=True, timeout=300,
@@ -142,6 +143,14 @@ async def test_jax_validation_spawns_real_workload(validation_root):
             assert deep_get(pod, "status", "phase") == "Succeeded"
             limits = deep_get(pod, "spec", "containers", 0, "resources", "limits")
             assert limits[consts.TPU_RESOURCE] == "4"
+            # persistent XLA cache rides the node's /run/tpu hostPath
+            env = {
+                e["name"]: e.get("value", "")
+                for e in deep_get(pod, "spec", "containers", 0, "env")
+            }
+            assert env["TPU_COMPILE_CACHE"] == "/run/tpu/compile_cache"
+            vol = deep_get(pod, "spec", "volumes", 0)
+            assert vol["hostPath"]["path"] == "/run/tpu/compile_cache"
 
 
 async def test_jax_validation_in_process(validation_root):
@@ -243,6 +252,7 @@ def _exec_distributed_pod(port: int, executed: list | None = None):
             **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
         }
         env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["TPU_COMPILE_CACHE"] = "0"  # pod env points at /run/tpu on the host
         result = subprocess.run(
             [sys.executable, "-m", "tpu_operator.workloads.distributed"],
             env=env, capture_output=True, text=True, timeout=300,

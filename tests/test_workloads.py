@@ -86,6 +86,35 @@ def test_run_validation_module(capsys):
     assert len(lines) == 2
 
 
+def test_compile_cache_enable(tmp_path, monkeypatch):
+    """The persistent XLA cache is STRICTLY opt-in: only an explicit
+    TPU_COMPILE_CACHE=<path> enables it — unset and '0' are both no-ops
+    (an implicit default would make every test/dryrun worker write to the
+    real host's /run/tpu)."""
+    import os
+
+    import jax as _jax
+
+    from tpu_operator.workloads import compile_cache
+
+    prior = _jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.delenv("TPU_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("TPU_VALIDATION_ROOT", str(tmp_path))
+        assert compile_cache.enable() is None  # no implicit derivation
+
+        monkeypatch.setenv("TPU_COMPILE_CACHE", "0")
+        assert compile_cache.enable() is None
+
+        cache_dir = str(tmp_path / "explicit-cache")
+        monkeypatch.setenv("TPU_COMPILE_CACHE", cache_dir)
+        assert compile_cache.enable() == cache_dir
+        assert os.path.isdir(cache_dir)
+        assert _jax.config.jax_compilation_cache_dir == cache_dir
+    finally:
+        _jax.config.update("jax_compilation_cache_dir", prior)
+
+
 def test_distributed_four_process_rendezvous():
     """4 hosts x 2 devices each: host count EXCEEDS the mesh's dp axis
     (dp=2, mp=4) — the topology whose global-batch construction the old

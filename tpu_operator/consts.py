@@ -127,6 +127,9 @@ IMAGE_ENVS = {
 # Node-level validation status files (validator/main.go:131-166 analogue).
 VALIDATION_DIR = "/run/tpu/validations"
 VALIDATION_ROOT_ENV = "TPU_VALIDATION_ROOT"  # test seam: relocate /run/tpu
+# persistent XLA compilation cache, sibling of the validations dir on the
+# same hostPath (one knob: both follow VALIDATION_DIR's root)
+COMPILE_CACHE_DIR = VALIDATION_DIR.rsplit("/", 1)[0] + "/compile_cache"
 STATUS_FILES = {
     "libtpu": "libtpu-ready",
     "pjrt": "pjrt-ready",

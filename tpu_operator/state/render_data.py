@@ -79,6 +79,7 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         "deploy_label_prefix": consts.DEPLOY_LABEL_PREFIX,
         "validation_dir": consts.VALIDATION_DIR,
         "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
+        "compile_cache_dir": consts.COMPILE_CACHE_DIR,
         "service_monitors_available": ctx.service_monitors_available,
         "validator": {
             "image": _operand_image(spec.validator, "validator"),

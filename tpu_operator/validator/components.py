@@ -37,6 +37,9 @@ log = logging.getLogger("tpu_operator.validator")
 LIBTPU_CTR_MARKER = ".libtpu-ctr-ready"
 COORDINATOR_PORT = 8476  # jax.distributed coordinator (worker 0's pod)
 EPOCH_LABEL = "tpu.google.com/validation-epoch"
+# node-local persistent XLA compilation cache shared by all validation
+# workload pods on a host (see workloads/compile_cache.py)
+COMPILE_CACHE_HOST_PATH = consts.COMPILE_CACHE_DIR
 VALIDATED_EPOCH_ANNOTATION = "tpu.google.com/validated-epoch"
 
 # Fraction of the generation's published per-chip ICI bandwidth
@@ -248,8 +251,9 @@ class Validator:
             return
 
         def run_checks() -> dict:
-            from tpu_operator.workloads import collectives, matmul_bench
+            from tpu_operator.workloads import collectives, compile_cache, matmul_bench
 
+            compile_cache.enable()
             results = {
                 "vector-add": collectives.vector_add(1 << 16),
                 "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
@@ -654,10 +658,29 @@ class Validator:
                         "env": [
                             {"name": "WORKLOAD_CHECKS", "value": checks},
                             {"name": "ALLREDUCE_MIN_GBPS", "value": str(min_gbps)},
+                            # node-local persistent XLA cache: re-validations
+                            # (preStop re-gating, upgrade re-proof) skip the
+                            # ~2s/program recompiles (workloads/compile_cache.py)
+                            {"name": "TPU_COMPILE_CACHE", "value": COMPILE_CACHE_HOST_PATH},
                         ],
                         "resources": {
                             "limits": {consts.TPU_RESOURCE: str(tpu_request)},
                             "requests": {consts.TPU_RESOURCE: str(tpu_request)},
+                        },
+                        "volumeMounts": [
+                            {
+                                "name": "compile-cache",
+                                "mountPath": COMPILE_CACHE_HOST_PATH,
+                            }
+                        ],
+                    }
+                ],
+                "volumes": [
+                    {
+                        "name": "compile-cache",
+                        "hostPath": {
+                            "path": COMPILE_CACHE_HOST_PATH,
+                            "type": "DirectoryOrCreate",
                         },
                     }
                 ],

@@ -1,0 +1,58 @@
+"""Persistent XLA compilation cache for the validation workloads.
+
+The validator deliberately re-proves nodes (preStop removes ``*-ready`` so
+dependents re-gate; the upgrade machine deletes validator pods to force
+fresh evidence), so the same XLA programs — vector-add, the chained
+allreduce, the burn-in step, the matmul sweep — recompile on every
+re-validation.  On a tunneled PJRT backend each compile costs ~2s, which is
+most of a validation round's wall clock.  The TPU-idiomatic fix is XLA's
+persistent compilation cache (``jax_compilation_cache_dir``): keyed on HLO +
+backend config, so re-validations and post-restart validator pods hit disk
+instead of the compiler.
+
+The cache lives under the node's ``/run/tpu`` hostPath (workload pods mount
+it), surviving pod churn but not node replacement — exactly the lifetime of
+the evidence it accelerates.  Enabled ONLY by an explicit
+``TPU_COMPILE_CACHE=<path>`` env (the operator injects it in-cluster);
+unset or ``0`` means no persistent cache.
+
+Reference contrast: the CUDA vectorAdd validation image
+(validator/main.go:1189-1302) ships precompiled SASS so NVIDIA never pays
+this cost; for XLA the persistent cache is the equivalent of shipping
+compiled programs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def enable() -> Optional[str]:
+    """Point jax at the node-local persistent compilation cache.
+
+    STRICTLY opt-in: only an explicit ``TPU_COMPILE_CACHE=<path>`` enables it
+    (the operator injects it into workload pods and the validator DS, which
+    mount the backing hostPath).  No implicit default — deriving one from the
+    validation root made every test run and dryrun worker silently write a
+    persistent cache to the real host's /run/tpu and leak the global
+    ``jax_compilation_cache_dir`` for the rest of the process.
+
+    Must run before the first jit compilation (config updates are decisive
+    at trace time).  Returns the cache dir, or None when disabled or the
+    location is unusable (never fails validation over a cache)."""
+    path = os.environ.get("TPU_COMPILE_CACHE", "")
+    if not path or path == "0":
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # keep jax's default min-compile-time threshold (1s): each cache
+        # WRITE serializes the executable, which on a tunneled backend costs
+        # a device round-trip — caching every trivial program made the cold
+        # validation 3x slower; only the multi-second compiles are worth it
+    except Exception:  # noqa: BLE001 — cache is an optimization, never a gate
+        return None
+    return path

@@ -229,6 +229,9 @@ def spawn_local_workers(
 
 
 def main() -> int:
+    from tpu_operator.workloads import compile_cache
+
+    compile_cache.enable()
     coordinator = os.environ.get("COORDINATOR_ADDRESS", "")
     num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
     process_id = int(

@@ -22,7 +22,9 @@ import sys
 
 
 def main() -> int:
-    from tpu_operator.workloads import collectives
+    from tpu_operator.workloads import collectives, compile_cache
+
+    compile_cache.enable()
 
     checks = [
         c.strip()
