@@ -25,6 +25,11 @@ manifests:
 goldens:
 	$(PYTHON) -m tests.goldens
 
+# regenerate the OLM bundle (CSV + CRDs + metadata) from deploy values
+bundle:
+	$(PYTHON) -m tpu_operator.cmd.bundle
+	$(PYTHON) -m tpu_operator.cmd.tpuop_cfg validate csv -f deploy/bundle/v$$($(PYTHON) -c "from tpu_operator.version import __version__; print(__version__)")/manifests/tpu-operator.clusterserviceversion.yaml
+
 bench:
 	$(PYTHON) bench.py
 

@@ -1,0 +1,175 @@
+"""OLM bundle generation + CSV validation (bundle/ + gpuop-cfg csv analogue)."""
+
+import copy
+import os
+
+import yaml
+
+from tpu_operator.cmd import bundle, deploy, tpuop_cfg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUNDLE_DIR = os.path.join(REPO, "deploy", "bundle")
+
+
+def _values():
+    return deploy.load_values(os.path.join(deploy.DEPLOY_DIR, "values.yaml"), [])
+
+
+def test_generated_csv_is_valid():
+    csv = bundle.build_csv(_values())
+    assert tpuop_cfg.validate_csv(csv) == []
+
+
+def test_committed_bundle_matches_generation():
+    """The committed deploy/bundle/ must be regenerable byte-for-byte from
+    the values + templates (no hand-drift; `make bundle` refreshes it)."""
+    from tpu_operator.version import __version__
+
+    root = os.path.join(BUNDLE_DIR, f"v{__version__}")
+    generated = bundle.build_bundle(_values())
+    for rel, content in generated.items():
+        path = os.path.join(root, rel)
+        assert os.path.exists(path), f"missing committed bundle file {rel}"
+        with open(path) as f:
+            assert f.read() == content, f"{rel} drifted; run `make bundle`"
+    # nothing extra lying around either
+    committed = []
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            committed.append(
+                os.path.relpath(os.path.join(dirpath, name), root)
+            )
+    assert sorted(committed) == sorted(generated)
+
+
+def test_csv_deployment_matches_installer():
+    """The CSV embeds the installer's own Deployment spec — same images,
+    same env fallbacks (the consistency gpuop-cfg checks by hand is
+    guaranteed by construction here, but prove it anyway)."""
+    values = _values()
+    csv = bundle.build_csv(values)
+    installer_dep = next(
+        o for o in deploy.render_manifests(values) if o["kind"] == "Deployment"
+    )
+    csv_dep = csv["spec"]["install"]["spec"]["deployments"][0]
+    assert csv_dep["name"] == installer_dep["metadata"]["name"]
+    assert csv_dep["spec"] == installer_dep["spec"]
+
+
+def test_csv_related_images_cover_all_operands():
+    from tpu_operator import consts
+
+    csv = bundle.build_csv(_values())
+    related = {e["image"] for e in csv["spec"]["relatedImages"]}
+    ctr = csv["spec"]["install"]["spec"]["deployments"][0]["spec"]["template"][
+        "spec"
+    ]["containers"][0]
+    envs = {e["name"]: e["value"] for e in ctr["env"] if e["name"].endswith("_IMAGE")}
+    assert set(envs) == set(consts.IMAGE_ENVS.values())
+    assert set(envs.values()) <= related
+
+
+def test_validate_csv_catches_breakage():
+    csv = bundle.build_csv(_values())
+
+    broken = copy.deepcopy(csv)
+    broken["spec"]["relatedImages"] = broken["spec"]["relatedImages"][:1]
+    errs = tpuop_cfg.validate_csv(broken)
+    assert any("not listed" in e for e in errs)
+
+    broken = copy.deepcopy(csv)
+    ctr = broken["spec"]["install"]["spec"]["deployments"][0]["spec"]["template"][
+        "spec"
+    ]["containers"][0]
+    ctr["env"][1]["value"] = "Not A Valid Ref!"
+    assert any("malformed image reference" in e for e in tpuop_cfg.validate_csv(broken))
+
+    broken = copy.deepcopy(csv)
+    ctr = broken["spec"]["install"]["spec"]["deployments"][0]["spec"]["template"][
+        "spec"
+    ]["containers"][0]
+    ctr["image"] = "ghcr.io/tpu-operator/tpu-operator"  # no tag/digest
+    assert any("neither tag nor digest" in e for e in tpuop_cfg.validate_csv(broken))
+
+    broken = copy.deepcopy(csv)
+    broken["metadata"]["annotations"]["alm-examples"] = '[{"kind": "Wrong"}]'
+    assert any("TPUClusterPolicy" in e for e in tpuop_cfg.validate_csv(broken))
+
+    broken = copy.deepcopy(csv)
+    broken["spec"]["customresourcedefinitions"]["owned"] = []
+    errs = tpuop_cfg.validate_csv(broken)
+    assert any("missing TPUClusterPolicy" in e for e in errs)
+    assert any("missing TPURuntime" in e for e in errs)
+
+    broken = copy.deepcopy(csv)
+    broken["metadata"]["name"] = "tpu-operator.v9.9.9"
+    assert any("does not end with" in e for e in tpuop_cfg.validate_csv(broken))
+
+
+def test_validate_csv_tolerates_malformed_structures():
+    """Hand-edited CSVs with wrong-typed entries must produce validation
+    errors, not tracebacks."""
+    csv = bundle.build_csv(_values())
+
+    broken = copy.deepcopy(csv)
+    broken["metadata"]["annotations"]["alm-examples"] = '["oops"]'
+    assert any("must be an object" in e for e in tpuop_cfg.validate_csv(broken))
+
+    broken = copy.deepcopy(csv)
+    broken["spec"]["relatedImages"].append("not-a-dict")
+    assert any("must be an object" in e for e in tpuop_cfg.validate_csv(broken))
+
+
+def test_image_ref_syntax():
+    ok = tpuop_cfg._image_ref_errors
+    assert ok("ghcr.io/tpu-operator/tpu-operator:latest", "x") == []
+    assert ok("myimage:123", "x") == []  # numeric tag on bare repo, not a port
+    assert ok("localhost:5000/img:v1", "x") == []
+    assert ok("nvcr.io/nvidia/gpu-operator@sha256:" + "a" * 64, "x") == []
+    assert any("neither tag nor digest" in e for e in ok("repo/img", "x"))
+    assert any("malformed" in e for e in ok("Not A Ref!", "x"))
+    assert any("malformed digest" in e for e in ok("repo/img@sha256:zz", "x"))
+    # valueFrom env (no literal value) is skipped, not flagged
+    csv = bundle.build_csv(_values())
+    ctr = csv["spec"]["install"]["spec"]["deployments"][0]["spec"]["template"][
+        "spec"
+    ]["containers"][0]
+    ctr["env"].append({"name": "EXTRA_IMAGE", "valueFrom": {"fieldRef": {"fieldPath": "x"}}})
+    assert tpuop_cfg.validate_csv(csv) == []
+
+
+def test_write_bundle_clears_stale_files(tmp_path):
+    from tpu_operator.version import __version__
+
+    values = _values()
+    root = bundle.write_bundle(values, str(tmp_path))
+    stale = os.path.join(root, "manifests", "stale.yaml")
+    with open(stale, "w") as f:
+        f.write("kind: Stale\n")
+    bundle.write_bundle(values, str(tmp_path))
+    assert not os.path.exists(stale)
+    assert root == os.path.join(str(tmp_path), f"v{__version__}")
+
+
+def test_alm_examples_parse_as_valid_crs():
+    import json
+
+    csv = bundle.build_csv(_values())
+    examples = json.loads(csv["metadata"]["annotations"]["alm-examples"])
+    kinds = [e["kind"] for e in examples]
+    assert kinds[0] == "TPUClusterPolicy"
+    assert "TPURuntime" in kinds
+    for ex in examples:
+        assert tpuop_cfg.validate_clusterpolicy(ex) == []
+
+
+def test_cli_validate_csv(tmp_path, capsys):
+    csv = bundle.build_csv(_values())
+    good = tmp_path / "csv.yaml"
+    good.write_text(yaml.safe_dump(csv, sort_keys=False))
+    assert tpuop_cfg.main(["validate", "csv", "-f", str(good)]) == 0
+
+    csv["spec"]["install"]["spec"]["deployments"] = []
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump(csv, sort_keys=False))
+    assert tpuop_cfg.main(["validate", "csv", "-f", str(bad)]) == 1

@@ -1,0 +1,232 @@
+"""OLM bundle generator: ClusterServiceVersion + CRDs + bundle metadata.
+
+Reference analogue: `bundle/v*/manifests/gpu-operator-certified.
+clusterserviceversion.yaml` (+ per-bundle `metadata/annotations.yaml`) — the
+OLM packaging surface next to the helm chart.  One deliberate divergence:
+the reference maintains its CSVs by hand per release and then checks them for
+consistency with `gpuop-cfg validate csv`; the TPU bundle is GENERATED from
+the exact objects `cmd.deploy` renders (same values file, same templates), so
+the CSV's deployment, RBAC, and image list cannot drift from the installer's.
+
+  python -m tpu_operator.cmd.bundle [-f deploy/values.yaml] [-o deploy/bundle]
+
+Writes  <out>/v<version>/manifests/tpu-operator.clusterserviceversion.yaml,
+        <out>/v<version>/manifests/<crd>.yaml (both CRDs),
+        <out>/v<version>/metadata/annotations.yaml.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+import yaml
+
+from tpu_operator.api.types import (
+    CLUSTER_POLICY_KIND,
+    TPU_RUNTIME_KIND,
+    TPUClusterPolicy,
+    TPURuntime,
+)
+from tpu_operator.cmd import deploy
+from tpu_operator.version import __version__
+
+CSV_NAME = "tpu-operator"
+PACKAGE = "tpu-operator"
+
+DESCRIPTION = (
+    "Automates the TPU software stack on Kubernetes nodes: libtpu/PJRT "
+    "runtime install, the device plugin advertising google.com/tpu, "
+    "feature discovery labels, metrics exporters, ICI slice partitioning, "
+    "rolling runtime upgrades with drain, and a JAX/XLA collective "
+    "validation harness gating node readiness."
+)
+
+# sample CRs surfaced in the OLM UI (alm-examples); the ClusterPolicy example
+# is the same default CR the installer applies
+_RUNTIME_EXAMPLE_SPEC = {
+    "runtimeType": "standard",
+    "runtimeChannel": "stable",
+    "nodeSelector": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"
+    },
+}
+
+
+def _rendered(values: dict) -> list[dict]:
+    return deploy.render_manifests(values)
+
+
+def _find(objs: list[dict], kind: str) -> dict:
+    for o in objs:
+        if o.get("kind") == kind:
+            return o
+    raise SystemExit(f"bundle: installer rendered no {kind}")
+
+
+def build_csv(values: dict) -> dict:
+    """The ClusterServiceVersion, built from the installer's own objects."""
+    objs = _rendered(values)
+    deployment = copy.deepcopy(_find(objs, "Deployment"))
+    cluster_role = _find(objs, "ClusterRole")
+    sa_name = deployment["spec"]["template"]["spec"]["serviceAccountName"]
+
+    # OLM owns namespace + ownerRefs; the CSV embeds only name + spec
+    dep_entry = {
+        "name": deployment["metadata"]["name"],
+        "spec": deployment["spec"],
+    }
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    operator_image = container["image"]
+
+    related = [{"name": "tpu-operator-image", "image": operator_image}]
+    for env in container.get("env", []):
+        if env.get("name", "").endswith("_IMAGE") and env.get("value"):
+            related.append(
+                {
+                    "name": env["name"].lower().replace("_", "-"),
+                    "image": env["value"],
+                }
+            )
+
+    try:
+        cp_example = copy.deepcopy(_find(objs, CLUSTER_POLICY_KIND))
+    except SystemExit:
+        cp_example = TPUClusterPolicy.new().obj
+    runtime_example = TPURuntime.new("v5e-stable", spec=_RUNTIME_EXAMPLE_SPEC).obj
+
+    crd_meta = []
+    for crd_file in sorted(os.listdir(os.path.join(deploy.DEPLOY_DIR, "crds"))):
+        with open(os.path.join(deploy.DEPLOY_DIR, "crds", crd_file)) as f:
+            for crd in yaml.safe_load_all(f):
+                if not crd:
+                    continue
+                kind = crd["spec"]["names"]["kind"]
+                crd_meta.append(
+                    {
+                        "name": crd["metadata"]["name"],
+                        "kind": kind,
+                        "version": crd["spec"]["versions"][0]["name"],
+                        "displayName": kind,
+                        "description": {
+                            CLUSTER_POLICY_KIND: "Cluster-wide TPU software stack configuration",
+                            TPU_RUNTIME_KIND: "Per-node-pool TPU runtime version pinning",
+                        }.get(kind, kind),
+                    }
+                )
+
+    return {
+        "apiVersion": "operators.coreos.com/v1alpha1",
+        "kind": "ClusterServiceVersion",
+        "metadata": {
+            "name": f"{CSV_NAME}.v{__version__}",
+            "annotations": {
+                "alm-examples": json.dumps(
+                    [cp_example, runtime_example], indent=2
+                ),
+                "capabilities": "Deep Insights",
+                "categories": "AI/Machine Learning, OpenShift Optional",
+                "containerImage": operator_image,
+                "description": DESCRIPTION,
+                "operatorframework.io/suggested-namespace": values.get(
+                    "namespace", "tpu-operator"
+                ),
+            },
+        },
+        "spec": {
+            "displayName": "TPU Operator",
+            "description": DESCRIPTION,
+            "version": __version__,
+            "maturity": "alpha",
+            "provider": {"name": "tpu-operator project"},
+            "keywords": ["tpu", "jax", "xla", "device plugin", "operator"],
+            "installModes": [
+                {"type": "OwnNamespace", "supported": True},
+                {"type": "SingleNamespace", "supported": True},
+                {"type": "MultiNamespace", "supported": False},
+                {"type": "AllNamespaces", "supported": False},
+            ],
+            "install": {
+                "strategy": "deployment",
+                "spec": {
+                    "clusterPermissions": [
+                        {
+                            "serviceAccountName": sa_name,
+                            "rules": cluster_role["rules"],
+                        }
+                    ],
+                    "deployments": [dep_entry],
+                },
+            },
+            "customresourcedefinitions": {"owned": crd_meta},
+            "relatedImages": related,
+        },
+    }
+
+
+def build_bundle(values: dict) -> dict[str, str]:
+    """{relative path: file content} for the whole bundle directory."""
+    csv = build_csv(values)
+    files = {
+        f"manifests/{CSV_NAME}.clusterserviceversion.yaml": yaml.safe_dump(
+            csv, sort_keys=False
+        ),
+        "metadata/annotations.yaml": yaml.safe_dump(
+            {
+                "annotations": {
+                    "operators.operatorframework.io.bundle.mediatype.v1": "registry+v1",
+                    "operators.operatorframework.io.bundle.manifests.v1": "manifests/",
+                    "operators.operatorframework.io.bundle.metadata.v1": "metadata/",
+                    "operators.operatorframework.io.bundle.package.v1": PACKAGE,
+                    "operators.operatorframework.io.bundle.channels.v1": "stable",
+                    "operators.operatorframework.io.bundle.channel.default.v1": "stable",
+                }
+            },
+            sort_keys=False,
+        ),
+    }
+    crds_dir = os.path.join(deploy.DEPLOY_DIR, "crds")
+    for crd_file in sorted(os.listdir(crds_dir)):
+        with open(os.path.join(crds_dir, crd_file)) as f:
+            files[f"manifests/{crd_file}"] = f.read()
+    return files
+
+
+def write_bundle(values: dict, out_dir: str) -> str:
+    import shutil
+
+    root = os.path.join(out_dir, f"v{__version__}")
+    # fresh directory: a renamed/removed manifest must not linger as a stale
+    # file in the committed bundle
+    if os.path.isdir(root):
+        shutil.rmtree(root)
+    for rel, content in build_bundle(values).items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+    return root
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-operator-bundle")
+    parser.add_argument(
+        "-f", "--values",
+        default=os.path.join(deploy.DEPLOY_DIR, "values.yaml"),
+    )
+    parser.add_argument(
+        "-o", "--out", default=os.path.join(deploy.DEPLOY_DIR, "bundle")
+    )
+    parser.add_argument("--set", action="append", default=[], dest="overrides")
+    args = parser.parse_args(argv)
+    values = deploy.load_values(args.values, args.overrides)
+    root = write_bundle(values, args.out)
+    print(f"wrote bundle under {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ cluster.
   python -m tpu_operator.cmd.tpuop_cfg validate clusterpolicy -f cr.yaml
   python -m tpu_operator.cmd.tpuop_cfg validate values        -f deploy/values.yaml
   python -m tpu_operator.cmd.tpuop_cfg validate sliceconfig   -f config.yaml
+  python -m tpu_operator.cmd.tpuop_cfg validate csv           -f deploy/bundle/v0.1.0/manifests/tpu-operator.clusterserviceversion.yaml
 """
 
 from __future__ import annotations
@@ -78,6 +79,151 @@ def validate_values(doc: dict) -> list[str]:
     return errors
 
 
+import re as _re
+
+_IMAGE_REPO_RE = _re.compile(
+    r"[a-z0-9]+(?:[._-][a-z0-9]+)*"  # first component (may be registry host)
+    r"(?::[0-9]+)?"                  # optional registry port
+    r"(?:/[a-z0-9]+(?:[._-][a-z0-9]+)*)*"
+)
+_IMAGE_TAG_RE = _re.compile(r"[A-Za-z0-9_][A-Za-z0-9._-]{0,127}")
+_IMAGE_DIGEST_RE = _re.compile(r"sha256:[a-f0-9]{64}")
+
+
+def _image_ref_errors(ref, where: str) -> list[str]:
+    """Syntactic image-reference check (registry[:port]/repo[:tag][@digest]).
+
+    Divergence from the reference, by design: gpuop-cfg resolves every image
+    manifest against the live registry (cmd/gpuop-cfg/validate/csv/images.go)
+    — this build validates offline (no egress), so the check is syntax +
+    digest-format only.  Parsed procedurally because a single regex cannot
+    disambiguate ``myimage:123`` (numeric tag) from a registry port."""
+    if not isinstance(ref, str) or not ref:
+        return [f"{where}: empty image reference"]
+    rest = ref
+    digest = None
+    if "@" in rest:
+        rest, _, digest = rest.partition("@")
+        if not _IMAGE_DIGEST_RE.fullmatch(digest):
+            return [f"{where}: malformed digest in {ref!r}"]
+    tag = None
+    if ":" in rest:
+        head, _, candidate = rest.rpartition(":")
+        # a colon-suffix containing '/' is a registry port, not a tag
+        if "/" not in candidate:
+            tag, rest = candidate, head
+    if not _IMAGE_REPO_RE.fullmatch(rest):
+        return [f"{where}: malformed image reference {ref!r}"]
+    if tag is not None and not _IMAGE_TAG_RE.fullmatch(tag):
+        return [f"{where}: malformed tag in {ref!r}"]
+    if tag is None and digest is None:
+        return [f"{where}: image reference {ref!r} has neither tag nor digest"]
+    return []
+
+
+def validate_csv(doc: dict) -> list[str]:
+    """OLM ClusterServiceVersion consistency (gpuop-cfg `validate csv`
+    analogue, cmd/gpuop-cfg/validate/csv/): the alm-examples must parse into
+    valid CRs, every operand image env must be a well-formed reference and
+    listed in relatedImages, and both CRDs must be owned."""
+    import json as _json
+
+    errors: list[str] = []
+    if doc.get("kind") != "ClusterServiceVersion":
+        return [f"unsupported kind {doc.get('kind')!r} (want ClusterServiceVersion)"]
+    spec = doc.get("spec") or {}
+
+    # alm-examples: first entry must be a valid TPUClusterPolicy
+    # (validate/csv/alm-examples.go analogue, extended to validate the spec)
+    alm = ((doc.get("metadata") or {}).get("annotations") or {}).get("alm-examples")
+    if not alm:
+        errors.append("metadata.annotations.alm-examples: missing")
+    else:
+        try:
+            examples = _json.loads(alm)
+        except ValueError as e:
+            examples = None
+            errors.append(f"alm-examples: not valid JSON ({e})")
+        if examples is not None:
+            if not isinstance(examples, list) or not examples:
+                errors.append("alm-examples: must be a non-empty list")
+            elif any(not isinstance(ex, dict) for ex in examples):
+                errors.append("alm-examples: every entry must be an object")
+            elif examples[0].get("kind") != "TPUClusterPolicy":
+                errors.append("alm-examples[0]: must be a TPUClusterPolicy")
+            else:
+                for i, ex in enumerate(examples):
+                    for e in validate_clusterpolicy(ex):
+                        errors.append(f"alm-examples[{i}]: {e}")
+
+    # install strategy: operator deployment + image envs
+    deployments = (
+        ((spec.get("install") or {}).get("spec") or {}).get("deployments") or []
+    )
+    related_entries = [
+        e for e in spec.get("relatedImages") or [] if isinstance(e, dict)
+    ]
+    if len(related_entries) != len(spec.get("relatedImages") or []):
+        errors.append("relatedImages: every entry must be an object")
+    related = {entry.get("image") for entry in related_entries}
+    if not deployments:
+        errors.append("spec.install.spec.deployments: empty")
+    else:
+        containers = (
+            ((deployments[0].get("spec") or {}).get("template") or {})
+            .get("spec", {})
+            .get("containers", [])
+        )
+        if not containers:
+            errors.append("spec.install.spec.deployments[0]: no containers")
+        for ctr in containers:
+            errors += _image_ref_errors(
+                ctr.get("image"), f"deployment container {ctr.get('name')}"
+            )
+            if ctr.get("image") not in related:
+                errors.append(
+                    f"relatedImages: operator image {ctr.get('image')!r} not listed"
+                )
+            for env in ctr.get("env", []):
+                if not env.get("name", "").endswith("_IMAGE"):
+                    continue
+                if "value" not in env:
+                    # valueFrom envs resolve at runtime; nothing to check
+                    # offline (the generator emits literal values only)
+                    continue
+                errors += _image_ref_errors(env.get("value"), f"env {env['name']}")
+                if env.get("value") not in related:
+                    errors.append(
+                        f"relatedImages: {env['name']}={env.get('value')!r} not listed"
+                    )
+
+    names = {e.get("name") for e in related_entries}
+    if len(names) != len(related_entries):
+        errors.append("relatedImages: duplicate names")
+    for entry in related_entries:
+        errors += _image_ref_errors(
+            entry.get("image"), f"relatedImages[{entry.get('name')}]"
+        )
+
+    owned = {
+        crd.get("kind")
+        for crd in (spec.get("customresourcedefinitions") or {}).get("owned") or []
+    }
+    for kind in ("TPUClusterPolicy", "TPURuntime"):
+        if kind not in owned:
+            errors.append(f"customresourcedefinitions.owned: missing {kind}")
+
+    version = spec.get("version") or ""
+    if not version:
+        errors.append("spec.version: missing")
+    elif not str(doc.get("metadata", {}).get("name", "")).endswith(f".v{version}"):
+        errors.append(
+            f"metadata.name {doc.get('metadata', {}).get('name')!r} "
+            f"does not end with .v{version}"
+        )
+    return errors
+
+
 def validate_sliceconfig(doc: dict) -> list[str]:
     """Each profile rule with an explicit topology must tile it exactly."""
     errors = []
@@ -108,7 +254,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
     v = sub.add_parser("validate")
-    v.add_argument("what", choices=["clusterpolicy", "values", "sliceconfig"])
+    v.add_argument("what", choices=["clusterpolicy", "values", "sliceconfig", "csv"])
     v.add_argument("-f", "--file", required=True)
     args = p.parse_args(argv)
 
@@ -120,6 +266,8 @@ def main(argv=None) -> int:
             errors += validate_clusterpolicy(doc)
         elif args.what == "values":
             errors += validate_values(doc)
+        elif args.what == "csv":
+            errors += validate_csv(doc)
         else:
             errors += validate_sliceconfig(doc)
     for e in errors:
