@@ -97,21 +97,19 @@ def _exec_workload_pod(pod: dict) -> str:
     return "Succeeded" if result.returncode == 0 else "Failed"
 
 
-def run_matmul_bench() -> dict:
-    """The compute half of the perf story: bf16 matmul sweep → TFLOPs → MFU
-    on this machine's chip, in a subprocess so the TPU is free of the
-    validator workload's PJRT client (one process owns the chip at a time).
-    """
+def _run_bench_module(module: str, timeout: float = 400) -> dict:
+    """Run a perf workload module in a subprocess (one process owns the chip
+    at a time) and parse its JSON result line."""
     env = {**os.environ}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["TPU_COMPILE_CACHE"] = "0"  # see _exec_workload_pod: tunnel artifact
     try:
         result = subprocess.run(
-            [sys.executable, "-m", "tpu_operator.workloads.matmul_bench"],
-            env=env, capture_output=True, text=True, timeout=400,
+            [sys.executable, "-m", module],
+            env=env, capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return {"ok": False, "error": "matmul bench timed out"}
+        return {"ok": False, "error": f"{module} timed out"}
     for line in reversed(result.stdout.splitlines()):
         if line.startswith("{"):
             try:
@@ -119,6 +117,16 @@ def run_matmul_bench() -> dict:
             except json.JSONDecodeError:
                 continue
     return {"ok": False, "error": result.stderr[-500:]}
+
+
+def run_matmul_bench() -> dict:
+    """The compute third of the perf triad: bf16 matmul sweep → TFLOPs → MFU."""
+    return _run_bench_module("tpu_operator.workloads.matmul_bench")
+
+
+def run_hbm_bench() -> dict:
+    """The memory third: streaming bandwidth vs the chip's published HBM spec."""
+    return _run_bench_module("tpu_operator.workloads.hbm_bench")
 
 
 async def bench() -> dict:
@@ -215,6 +223,7 @@ def main() -> None:
     # a second result set, and prior rounds' juxtaposed numbers were single
     # cold runs; mixing provenance would misattribute warm-run drift.
     matmul = run_matmul_bench()
+    hbm = run_hbm_bench()
     cold = WORKLOAD_RESULTS[: result.pop("n_cold_results", len(WORKLOAD_RESULTS))]
     checks = {r.get("check", "?"): r for r in cold}
     allreduce = checks.get("allreduce", {})
@@ -224,6 +233,12 @@ def main() -> None:
             k: matmul.get(k)
             for k in ("ok", "backend", "generation", "peak_bf16_tflops",
                       "best_size", "tflops", "mfu")
+        },
+        "hbm": {
+            k: hbm.get(k)
+            for k in ("ok", "backend", "generation", "size_mb", "gbps",
+                      "gbps_median", "peak_hbm_gbps", "fraction_of_peak",
+                      "overhead_dominated")
         },
         "allreduce": {
             k: allreduce.get(k)

@@ -97,6 +97,53 @@ async def test_tpu_node_join_to_ready():
             assert refs and refs[0]["kind"] == CLUSTER_POLICY_KIND
 
 
+async def test_psa_namespace_labels():
+    """psa.enabled labels the operator namespace for Pod Security Admission
+    (setPodSecurityLabelsForNamespace analogue, state_manager.go:601);
+    disabled leaves the namespace untouched; the patch is idempotent."""
+    async with FakeCluster() as fc:
+        fc.add_node("cpu-node-0", tpu=False)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(
+                TPUClusterPolicy.new(spec={"psa": {"enabled": True}}).obj
+            )
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            ns = await client.get("", "Namespace", NS)
+            nlabels = deep_get(ns, "metadata", "labels", default={})
+            for mode in ("enforce", "audit", "warn"):
+                assert nlabels[f"pod-security.kubernetes.io/{mode}"] == "privileged"
+
+            # idempotent: second reconcile patches nothing
+            from tpu_operator.controllers import labels as labels_mod
+
+            policy = TPUClusterPolicy.from_obj(
+                await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            )
+            assert not await labels_mod.apply_pod_security_labels(
+                client, NS, policy.spec
+            )
+
+            # toggling psa off removes the labels we applied
+            cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            cr["spec"]["psa"]["enabled"] = False
+            await client.update(cr)
+            await reconciler.reconcile("cluster-policy")
+            ns = await client.get("", "Namespace", NS)
+            nlabels = deep_get(ns, "metadata", "labels", default={}) or {}
+            assert not any(k.startswith("pod-security.") for k in nlabels)
+
+    async with FakeCluster() as fc:
+        fc.add_node("cpu-node-0", tpu=False)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)  # psa disabled
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+            ns = await client.get("", "Namespace", NS)
+            nlabels = deep_get(ns, "metadata", "labels", default={}) or {}
+            assert not any(k.startswith("pod-security.") for k in nlabels)
+
+
 async def test_singleton_guard():
     async with FakeCluster() as fc:
         async with ApiClient(Config(base_url=fc.base_url)) as client:

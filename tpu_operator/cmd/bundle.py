@@ -199,11 +199,13 @@ def write_bundle(values: dict, out_dir: str) -> str:
     import shutil
 
     root = os.path.join(out_dir, f"v{__version__}")
+    # build FIRST: a failed render must not leave the committed bundle wiped
+    files = build_bundle(values)
     # fresh directory: a renamed/removed manifest must not linger as a stale
     # file in the committed bundle
     if os.path.isdir(root):
         shutil.rmtree(root)
-    for rel, content in build_bundle(values).items():
+    for rel, content in files.items():
         path = os.path.join(root, rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:

@@ -168,12 +168,12 @@ def validate_csv(doc: dict) -> list[str]:
     related = {entry.get("image") for entry in related_entries}
     if not deployments:
         errors.append("spec.install.spec.deployments: empty")
+    elif not isinstance(deployments[0], dict):
+        errors.append("spec.install.spec.deployments[0]: must be an object")
     else:
-        containers = (
-            ((deployments[0].get("spec") or {}).get("template") or {})
-            .get("spec", {})
-            .get("containers", [])
-        )
+        template = (deployments[0].get("spec") or {}).get("template") or {}
+        containers = (template.get("spec") or {}).get("containers") or []
+        containers = [c for c in containers if isinstance(c, dict)]
         if not containers:
             errors.append("spec.install.spec.deployments[0]: no containers")
         for ctr in containers:
@@ -184,7 +184,10 @@ def validate_csv(doc: dict) -> list[str]:
                 errors.append(
                     f"relatedImages: operator image {ctr.get('image')!r} not listed"
                 )
-            for env in ctr.get("env", []):
+            for env in ctr.get("env") or []:
+                if not isinstance(env, dict):
+                    errors.append("deployment env: every entry must be an object")
+                    continue
                 if not env.get("name", "").endswith("_IMAGE"):
                     continue
                 if "value" not in env:
@@ -208,6 +211,7 @@ def validate_csv(doc: dict) -> list[str]:
     owned = {
         crd.get("kind")
         for crd in (spec.get("customresourcedefinitions") or {}).get("owned") or []
+        if isinstance(crd, dict)
     }
     for kind in ("TPUClusterPolicy", "TPURuntime"):
         if kind not in owned:

@@ -73,6 +73,11 @@ class ClusterPolicyReconciler:
         ctx = await clusterinfo.gather(self.client, self.namespace, nodes=nodes)
         ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec, nodes=nodes)
         await labels.label_slice_readiness(self.client, nodes)
+        # BEFORE sync: under a restricted PSA default the privileged operand
+        # pods the sync creates would be rejected at admission if the
+        # namespace weren't labelled yet (in production the operator's own
+        # namespace always exists; a fresh fake cluster labels on pass 2)
+        await labels.apply_pod_security_labels(self.client, self.namespace, policy.spec)
         self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
         self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
 

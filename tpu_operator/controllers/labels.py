@@ -120,6 +120,54 @@ async def label_slice_readiness(
     return result
 
 
+PSA_LABEL_PREFIX = "pod-security.kubernetes.io/"
+PSA_MODES = ("enforce", "audit", "warn")
+PSA_LEVEL_PRIVILEGED = "privileged"
+
+
+async def apply_pod_security_labels(
+    client: ApiClient, namespace: str, spec: TPUClusterPolicySpec
+) -> bool:
+    """Reconcile the operator namespace's Pod Security Admission labels
+    (setPodSecurityLabelsForNamespace analogue,
+    controllers/state_manager.go:601-645): the operands run privileged
+    (hostPath /run/tpu, /dev) so with ``psa.enabled`` enforce/audit/warn
+    must be ``privileged``; on disable, previously-applied ``privileged``
+    values are removed (values we don't own are left alone).  Idempotent;
+    returns whether a patch was applied."""
+    from tpu_operator.k8s.client import ApiError
+
+    try:
+        ns = await client.get("", "Namespace", namespace)
+    except ApiError as e:
+        if not e.not_found:
+            raise
+        # a fresh fake/minimal cluster may not have materialized the
+        # namespace yet; the next reconcile pass re-asserts
+        log.warning("psa: namespace %s not found; skipping PSA labels", namespace)
+        return False
+    current = deep_get(ns, "metadata", "labels", default={}) or {}
+    if spec.psa.enabled:
+        patch_labels = {
+            PSA_LABEL_PREFIX + mode: PSA_LEVEL_PRIVILEGED
+            for mode in PSA_MODES
+            if current.get(PSA_LABEL_PREFIX + mode) != PSA_LEVEL_PRIVILEGED
+        }
+    else:
+        patch_labels = {
+            PSA_LABEL_PREFIX + mode: None
+            for mode in PSA_MODES
+            if current.get(PSA_LABEL_PREFIX + mode) == PSA_LEVEL_PRIVILEGED
+        }
+    if not patch_labels:
+        return False
+    await client.patch(
+        "", "Namespace", namespace, {"metadata": {"labels": patch_labels}}
+    )
+    log.info("reconciled PSA labels on namespace %s: %s", namespace, patch_labels)
+    return True
+
+
 async def label_tpu_nodes(
     client: ApiClient, spec: TPUClusterPolicySpec, nodes: Optional[list[dict]] = None
 ) -> int:

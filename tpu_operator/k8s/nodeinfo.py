@@ -29,23 +29,27 @@ class AcceleratorInfo:
     peak_bf16_tflops: float  # per-chip dense MXU peak (bf16 in, f32 acc)
     ici_gbps: float          # per-chip aggregate ICI bandwidth, GB/s
                              # (GKE per-chip interconnect spec / 8)
+    hbm_gbps: float = 0.0    # per-chip HBM bandwidth, GB/s (published spec)
 
 
 # Per-generation perf envelope: peak TFLOPs are the published per-chip dense
 # bf16 numbers (v4 275, v5e 197, v5p 459, v6e 918); ICI GB/s is the per-chip
-# interchip-interconnect spec (v4 2400 Gbps, v5e 1600, v5p 4800, v6e 3584).
+# interchip-interconnect spec (v4 2400 Gbps, v5e 1600, v5p 4800, v6e 3584);
+# HBM GB/s is the published per-chip memory bandwidth (v4 1228, v5e 819,
+# v5p 2765, v6e 1640) — the denominator for the streaming benchmark
+# (workloads/hbm_bench.py).
 # These drive the MFU denominator (workloads/matmul_bench.py) and the
 # allreduce bandwidth gate (validator components.py).
 ACCELERATORS: dict[str, AcceleratorInfo] = {
-    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0),
-    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0),
-    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0),
-    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0),
-    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0),
-    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0),
+    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0, 1228.0),
+    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0, 819.0),
+    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0, 819.0),
+    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0, 2765.0),
+    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0, 1640.0),
+    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0, 1640.0),
 }
 
-UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4, 0.0, 0.0)
+UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4, 0.0, 0.0, 0.0)
 
 
 def accelerator_info(accelerator: str) -> AcceleratorInfo:

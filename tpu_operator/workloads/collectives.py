@@ -27,6 +27,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_operator.workloads import timing
+
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
@@ -165,13 +167,11 @@ def allreduce_benchmark(
         # check even when a later one is clean
         max_err = max(max_err, float(err(chain(x))))
         raw.append(time.perf_counter() - t0)
-    times = sorted((t - overhead) / iters for t in raw)
-    # when the floor rivals the compute (tiny buffers or a huge dispatch
-    # RTT) subtraction is meaningless — report the unsubtracted, deflated
-    # rate and flag it so gates skip rather than trust either direction
-    overhead_dominated = times[0] <= 0 or overhead > 0.5 * min(raw)
-    if overhead_dominated:
-        times = sorted(t / iters for t in raw)
+    # shared rule (workloads/timing.py): when the floor rivals the compute
+    # (tiny buffers or a huge dispatch RTT) subtraction is meaningless —
+    # report the unsubtracted, deflated rate and flag it so gates skip
+    # rather than trust either direction
+    times, overhead_dominated = timing.subtract_floor(raw, overhead, per=iters)
     dt = times[0]
     dt_median = times[len(times) // 2]
 

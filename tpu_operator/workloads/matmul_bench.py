@@ -33,6 +33,8 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from tpu_operator.workloads import timing
+
 
 DEFAULT_SIZES = (1024, 2048, 4096, 8192)
 
@@ -116,12 +118,6 @@ def _chain_fn(size: int, iters: int):
     return chain
 
 
-def _timed(fn) -> float:
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
-
-
 def chain_iters(size: int, flop_budget: float = _FLOP_BUDGET) -> int:
     raw = min(_MAX_CHAIN_ITERS, int(flop_budget / (2.0 * size**3)))
     # round up to a whole number of normalization bursts
@@ -147,7 +143,7 @@ def _time_matmul(
         return jnp.sum(c.astype(jnp.float32))
 
     float(null(a))  # compile
-    overhead = min(_timed(lambda: float(null(a))) for _ in range(3))
+    overhead = min(timing.timed(lambda: float(null(a))) for _ in range(3))
 
     for _ in range(max(1, warmup)):
         float(chain(a, b))  # compile + settle; scalar transfer forces sync
@@ -157,14 +153,11 @@ def _time_matmul(
         t0 = time.perf_counter()
         checksum = float(chain(a, b))
         raw.append(time.perf_counter() - t0)
-    times = sorted((t - overhead) / iters for t in raw)
-    # same rule as the allreduce benchmark: when the floor rivals the
-    # compute, subtraction can over-correct (one noisy sample inflating
-    # TFLOPs severalfold) — fall back to the unsubtracted, deflated rate
-    # and flag it so MFU gates skip rather than trust either direction
-    overhead_dominated = times[0] <= 0 or overhead > 0.5 * min(raw)
-    if overhead_dominated:
-        times = sorted(t / iters for t in raw)
+    # shared rule (workloads/timing.py): floor-subtract per-matmul time;
+    # when the floor rivals the compute, fall back to the unsubtracted,
+    # deflated rate and flag it so MFU gates skip rather than trust either
+    # direction
+    times, overhead_dominated = timing.subtract_floor(raw, overhead, per=iters)
     best = times[0]
     median = times[len(times) // 2]
     flops = 2.0 * size * size * size

@@ -1,0 +1,39 @@
+"""Shared chained-dispatch timing methodology for the perf benchmarks.
+
+All three benchmarks (collectives allreduce, matmul MFU, HBM streaming) use
+the same r03 recipe: run the op chain inside ONE compiled program with a
+scalar readback (per-dispatch timing is untrustworthy on tunneled PJRT
+backends), measure the dispatch+readback floor with a null program of the
+same shape, subtract it, best-of-N.  This module is the single home of the
+two pieces they must keep identical: the wall-clock probe and the
+floor-subtraction / overhead-domination rule.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def timed(fn) -> float:
+    """Wall-clock one call; ``fn`` must synchronize internally (e.g. a
+    float() readback)."""
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def subtract_floor(
+    raw: list[float], floor: float, per: int = 1
+) -> tuple[list[float], bool]:
+    """(sorted per-unit times with the floor subtracted, overhead_dominated).
+
+    One rule everywhere: when the floor rivals the raw measurement
+    (floor > raw/2, or subtraction goes non-positive) the measurement is
+    flagged overhead-dominated — the per-unit times then fall back to the
+    raw amortized values, and callers must never gate on a flagged number
+    in either direction."""
+    times = sorted((t - floor) / per for t in raw)
+    dominated = times[0] <= 0 or floor > 0.5 * min(raw)
+    if dominated:
+        times = sorted(t / per for t in raw)
+    return times, dominated
