@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
+import re
 import sys
 
 import yaml
@@ -79,15 +81,13 @@ def validate_values(doc: dict) -> list[str]:
     return errors
 
 
-import re as _re
-
-_IMAGE_REPO_RE = _re.compile(
+_IMAGE_REPO_RE = re.compile(
     r"[a-z0-9]+(?:[._-][a-z0-9]+)*"  # first component (may be registry host)
     r"(?::[0-9]+)?"                  # optional registry port
     r"(?:/[a-z0-9]+(?:[._-][a-z0-9]+)*)*"
 )
-_IMAGE_TAG_RE = _re.compile(r"[A-Za-z0-9_][A-Za-z0-9._-]{0,127}")
-_IMAGE_DIGEST_RE = _re.compile(r"sha256:[a-f0-9]{64}")
+_IMAGE_TAG_RE = re.compile(r"[A-Za-z0-9_][A-Za-z0-9._-]{0,127}")
+_IMAGE_DIGEST_RE = re.compile(r"sha256:[a-f0-9]{64}")
 
 
 def _image_ref_errors(ref, where: str) -> list[str]:
@@ -126,8 +126,6 @@ def validate_csv(doc: dict) -> list[str]:
     analogue, cmd/gpuop-cfg/validate/csv/): the alm-examples must parse into
     valid CRs, every operand image env must be a well-formed reference and
     listed in relatedImages, and both CRDs must be owned."""
-    import json as _json
-
     errors: list[str] = []
     if doc.get("kind") != "ClusterServiceVersion":
         return [f"unsupported kind {doc.get('kind')!r} (want ClusterServiceVersion)"]
@@ -140,7 +138,7 @@ def validate_csv(doc: dict) -> list[str]:
         errors.append("metadata.annotations.alm-examples: missing")
     else:
         try:
-            examples = _json.loads(alm)
+            examples = json.loads(alm)
         except ValueError as e:
             examples = None
             errors.append(f"alm-examples: not valid JSON ({e})")

@@ -134,7 +134,12 @@ async def apply_pod_security_labels(
     (hostPath /run/tpu, /dev) so with ``psa.enabled`` enforce/audit/warn
     must be ``privileged``; on disable, previously-applied ``privileged``
     values are removed (values we don't own are left alone).  Idempotent;
-    returns whether a patch was applied."""
+    returns whether a patch was applied.
+
+    Deliberate parity limit: deleting the TPUClusterPolicy CR outright does
+    NOT remove the labels (no finalizer) — the reference behaves the same
+    way, its namespace labelling being fire-and-forget from init.  Toggle
+    ``psa.enabled`` off before deleting the CR to unlabel."""
     from tpu_operator.k8s.client import ApiError
 
     try:

@@ -64,6 +64,7 @@ def main() -> int:
                 hbm_bench.hbm_benchmark(
                     size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
                     iters=int(os.environ.get("HBM_ITERS", "256")),
+                    best_of=int(os.environ.get("HBM_BEST_OF", "3")),
                 ),
                 float(os.environ.get("HBM_MIN_GBPS", "0") or 0),
             )
