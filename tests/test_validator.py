@@ -264,60 +264,64 @@ def _exec_distributed_pod(port: int, executed: list | None = None):
     return execute
 
 
-async def test_multihost_slice_validation(validation_root):
-    """THE multi-host capability: two hosts of one slice each run a
-    validator; worker 0 creates the coordinated pod set (headless Service +
-    one pinned pod per host); the fake kubelet executes both pods
-    CONCURRENTLY as real processes that jax.distributed-rendezvous and run
-    a global psum + burn-in; each host's jax-ready gates on its own pod."""
+async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
+    """One slice of ``num_hosts`` hosts (4 chips each): every host runs a
+    validator concurrently; worker 0 creates the coordinated pod set
+    (headless Service + one pinned pod per host); the fake kubelet executes
+    the pods CONCURRENTLY as real processes that jax.distributed-rendezvous
+    and run a global psum + burn-in.  Full assertion set shared by every
+    host count: pod pinning/numbering, the catalogue-armed ICI gate, epoch
+    labels, post-proof GC, and the Service epoch tombstone."""
     port = _free_port()
     executed: list = []
     sim = SimConfig(
-        pod_ready_delay=0.01, tick=0.01, pod_executor=_exec_distributed_pod(port, executed)
+        pod_ready_delay=0.01, tick=0.01,
+        pod_executor=_exec_distributed_pod(port, executed),
     )
     async with FakeCluster(sim) as fc:
-        for i in range(2):
+        for i in range(num_hosts):
             node = fc.add_node(
                 f"tpu-{i}",
-                topology="2x4",  # 8 chips / 4 per host = 2 hosts
+                topology=topology,
                 labels={
-                    consts.GKE_NODEPOOL_LABEL: "pool-a",
+                    consts.GKE_NODEPOOL_LABEL: pool,
                     consts.GKE_TPU_WORKER_ID_LABEL: str(i),
                 },
             )
             node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
             fc.put(node)
-        async with ApiClient(Config(base_url=fc.base_url)) as c0, ApiClient(
-            Config(base_url=fc.base_url)
-        ) as c1:
+        clients = []
+        try:
+            validators = []
+            for i in range(num_hosts):
+                c = ApiClient(Config(base_url=fc.base_url))
+                await c.__aenter__()
+                clients.append(c)
+                validators.append(
+                    Validator(
+                        fast_config(node_name=f"tpu-{i}", with_workload=True,
+                                    sleep_interval=0.1, workload_retries=1800),
+                        client=c,
+                    )
+                )
             status.write_ready("plugin")
-            v0 = Validator(
-                fast_config(node_name="tpu-0", with_workload=True,
-                            sleep_interval=0.1, workload_retries=900),
-                client=c0,
-            )
-            v1 = Validator(
-                fast_config(node_name="tpu-1", with_workload=True,
-                            sleep_interval=0.1, workload_retries=900),
-                client=c1,
-            )
-            await asyncio.gather(v0.run("jax"), v1.run("jax"))
+            await asyncio.gather(*(v.run("jax") for v in validators))
 
             payload = status.read_status("jax")
             assert payload["mode"] == "multi-host"
-            assert payload["workers"] == 2
-            assert payload["group"] == "pool-a"
-            # both per-host pods really executed, pinned and numbered right
+            assert payload["workers"] == num_hosts
+            assert payload["group"] == pool
+            # every per-host pod really executed, pinned and numbered right
             by_name = {p["metadata"]["name"]: p for p in executed}
-            assert len(by_name) == 2
-            for wid, node_name in ((0, "tpu-0"), (1, "tpu-1")):
-                pod = by_name[f"tpu-jax-validation-pool-a-w{wid}"]
-                assert deep_get(pod, "spec", "nodeName") == node_name
+            assert len(by_name) == num_hosts
+            for wid in range(num_hosts):
+                pod = by_name[f"tpu-jax-validation-{pool}-w{wid}"]
+                assert deep_get(pod, "spec", "nodeName") == f"tpu-{wid}"
                 envs = {
                     e["name"]: e["value"]
                     for e in deep_get(pod, "spec", "containers", 0, "env")
                 }
-                assert envs["NUM_PROCESSES"] == "2"
+                assert envs["NUM_PROCESSES"] == str(num_hosts)
                 assert envs["PROCESS_ID"] == str(wid)
                 # the armed ICI gate, derived from the catalogue: v5e
                 # 200 GB/s * 0.25 fraction (visible in the pod spec)
@@ -325,13 +329,13 @@ async def test_multihost_slice_validation(validation_root):
                 assert pod["metadata"]["labels"][components.EPOCH_LABEL]
             # worker 0 garbage-collected the Succeeded pods post-proof —
             # pod count returns to baseline, evidence lives on the Service
-            pods = await c0.list_items("", "Pod", NS)
+            pods = await clients[0].list_items("", "Pod", NS)
             assert not [
                 p for p in pods
                 if p["metadata"]["name"].startswith("tpu-jax-validation")
             ]
             # headless rendezvous Service remains, carrying the epoch tombstone
-            svc = await c0.get("", "Service", "tpu-jax-validation-pool-a", NS)
+            svc = await clients[0].get("", "Service", f"tpu-jax-validation-{pool}", NS)
             assert svc["spec"]["clusterIP"] == "None"
             assert (
                 deep_get(svc, "metadata", "annotations", default={}).get(
@@ -339,6 +343,21 @@ async def test_multihost_slice_validation(validation_root):
                 )
                 == payload["epoch"]
             )
+        finally:
+            for c in clients:
+                await c.__aexit__(None, None, None)
+
+
+async def test_multihost_slice_validation(validation_root):
+    """THE multi-host capability, at the minimum host count."""
+    await _run_multihost_validation(2, "2x4", "pool-a")
+
+
+async def test_multihost_four_host_slice_validation(validation_root):
+    """Four hosts of one 4x4 slice — host count exceeding the 2-host case's
+    coverage: 4 processes x 4 devices exercises cross-process shardings and
+    a wider rendezvous than the minimum pair."""
+    await _run_multihost_validation(4, "4x4", "pool-c")
 
 
 async def test_multihost_requires_all_hosts_present(validation_root):
