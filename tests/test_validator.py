@@ -290,20 +290,23 @@ async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
             )
             node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
             fc.put(node)
-        clients = []
-        try:
-            validators = []
-            for i in range(num_hosts):
-                c = ApiClient(Config(base_url=fc.base_url))
-                await c.__aenter__()
-                clients.append(c)
-                validators.append(
-                    Validator(
-                        fast_config(node_name=f"tpu-{i}", with_workload=True,
-                                    sleep_interval=0.1, workload_retries=1800),
-                        client=c,
-                    )
+        import contextlib
+
+        async with contextlib.AsyncExitStack() as stack:
+            clients = [
+                await stack.enter_async_context(
+                    ApiClient(Config(base_url=fc.base_url))
                 )
+                for _ in range(num_hosts)
+            ]
+            validators = [
+                Validator(
+                    fast_config(node_name=f"tpu-{i}", with_workload=True,
+                                sleep_interval=0.1, workload_retries=1800),
+                    client=clients[i],
+                )
+                for i in range(num_hosts)
+            ]
             status.write_ready("plugin")
             await asyncio.gather(*(v.run("jax") for v in validators))
 
@@ -343,9 +346,6 @@ async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
                 )
                 == payload["epoch"]
             )
-        finally:
-            for c in clients:
-                await c.__aexit__(None, None, None)
 
 
 async def test_multihost_slice_validation(validation_root):

@@ -86,6 +86,55 @@ def test_run_validation_module(capsys):
     assert len(lines) == 2
 
 
+def test_ring_benchmark_8dev():
+    """Per-link ring diagnostic: every hop's payload verified exactly (the
+    f32 accumulator at each device must equal total-minus-own), per-hop
+    bandwidth reported."""
+    result = collectives.ring_benchmark(size_mb=2, iters=2, best_of=2)
+    assert result["ok"]
+    assert result["devices"] == 8
+    assert result["max_error"] == 0.0
+    assert result["hops"] == 16  # 2 revolutions x 8 hops
+    assert result["link_gbps"] > 0
+    assert result["transport"] == "ici"
+
+
+def test_ring_single_chip_skips():
+    result = collectives.ring_benchmark(devices=jax.devices()[:1])
+    assert result["ok"]
+    assert result["transport"] == "hbm-local"
+    assert "skipped" in result
+
+
+def test_ring_gate(monkeypatch):
+    fake = {
+        "ok": True, "link_gbps": 1.0, "transport": "ici",
+        "backend": "cpu", "overhead_dominated": False,
+    }
+    r = collectives.apply_ring_gate(dict(fake), 100.0)
+    assert r["ok"] and not r["gated"]  # cpu not gated by default
+    monkeypatch.setenv("RING_GATE_BACKENDS", "cpu,tpu")
+    r = collectives.apply_ring_gate(dict(fake), 100.0)
+    assert not r["ok"] and "ring link" in r["error"]
+    r = collectives.apply_ring_gate(dict(fake), 0.5)
+    assert r["ok"] and r["gated"]
+
+
+def test_run_validation_ring_check(monkeypatch, capsys):
+    import json
+
+    from tpu_operator.workloads import run_validation
+
+    monkeypatch.setenv("WORKLOAD_CHECKS", "ring")
+    monkeypatch.setenv("RING_SIZE_MB", "1")
+    monkeypatch.setenv("RING_ITERS", "2")
+    assert run_validation.main() == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    result = json.loads(lines[0])
+    assert result["check"] == "ring"
+    assert result["max_error"] == 0.0
+
+
 def test_hbm_benchmark_cpu():
     """The streaming benchmark runs on any backend; peak/fraction appear
     only for a known generation (CPU → unknown → report-only)."""

@@ -17,7 +17,6 @@ vectorAdd, validator/main.go:1189-1302) with TPU-native XLA programs:
 from __future__ import annotations
 
 import functools
-import os
 import time
 from typing import Optional
 
@@ -32,6 +31,15 @@ from tpu_operator.workloads import timing
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
+
+
+def _vary(v, axis: str = "x"):
+    """Mark a replicated value as device-varying along ``axis`` inside
+    shard_map (loop carries must have matching varying-manual-axes; pcast
+    replaced pvary in newer jax — keep the fallback for older)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(v, axis, to="varying")
+    return jax.lax.pvary(v, axis)  # pragma: no cover — older jax
 
 
 # ---------------------------------------------------------------------------
@@ -126,12 +134,7 @@ def allreduce_benchmark(
         if n > 1:
             # value stays exactly 1.0 every round: psum -> n, /n -> 1
             # (the replicated psum result must re-enter the loop as the
-            # device-varying carry the fori_loop signature requires; pcast
-            # replaced pvary in newer jax — keep the fallback for older)
-            if hasattr(jax.lax, "pcast"):
-                _vary = lambda v: jax.lax.pcast(v, "x", to="varying")  # noqa: E731
-            else:  # pragma: no cover — older jax
-                _vary = lambda v: jax.lax.pvary(v, "x")  # noqa: E731
+            # device-varying carry the fori_loop signature requires)
             body = lambda _, s: _vary(jax.lax.psum(s, "x") / n)  # noqa: E731
             expected = 1.0
         else:
@@ -200,36 +203,164 @@ def allreduce_benchmark(
 
 
 def apply_allreduce_gate(result: dict, min_gbps: float) -> dict:
-    """The ICI bandwidth gate policy, in ONE place (the workload-pod and the
-    distributed multi-host paths must enforce identical rules):
-
-    - gates busbw (the link-rate-comparable NCCL-tests number)
-    - only over real ICI (single-chip HBM copy rates are never gated)
-    - only on backends named in ALLREDUCE_GATE_BACKENDS (default tpu —
-      CPU/gloo rates say nothing about ICI health)
-    - never when the measurement was overhead-dominated (can't be trusted
-      in either direction)
-
-    Mutates ``result``: records ``min_gbps`` and whether the gate was
-    actually ``gated`` (enforced), and flips ``ok`` on a miss."""
-    backends = [
-        b.strip()
-        for b in os.environ.get("ALLREDUCE_GATE_BACKENDS", "tpu").split(",")
-    ]
-    enforced = (
-        min_gbps > 0
-        and result.get("transport") == "ici"
-        and result.get("backend") in backends
-        and not result.get("overhead_dominated")
+    """The ICI allreduce gate (shared rule: timing.apply_min_gate): gates
+    busbw, the link-rate-comparable NCCL-tests number, over real ICI only.
+    The workload-pod and distributed multi-host paths both call this."""
+    return timing.apply_min_gate(
+        result, metric="busbw_gbps", minimum=min_gbps,
+        backends_env="ALLREDUCE_GATE_BACKENDS", label="busbw",
+        require_ici=True,
     )
-    result["min_gbps"] = min_gbps
-    result["gated"] = enforced
-    if enforced and result["busbw_gbps"] < min_gbps:
-        result["ok"] = False
-        result["error"] = (
-            f"busbw {result['busbw_gbps']:.1f} < required {min_gbps} GB/s"
+
+
+# ---------------------------------------------------------------------------
+# ring exchange (per-link ICI diagnostic)
+
+
+def ring_benchmark(
+    size_mb: float = 16.0,
+    iters: int = 4,
+    best_of: int = 3,
+    devices: Optional[list] = None,
+) -> dict:
+    """ppermute the chips' buffers around the full ring and verify every
+    hop's payload — the per-LINK diagnostic the global psum can't give.
+
+    An allreduce proves the slice as a whole (a wrong sum says "something
+    is broken", not where), and its tree/ring schedule is the compiler's
+    choice.  This check forces n-1 explicit neighbor hops: device i's
+    buffer visits every other device in order, and the accumulated sum at
+    each device is exact only if EVERY individual link carried its payload
+    uncorrupted.  The reported bandwidth is per-hop and bottlenecked by the
+    slowest link (ring pipelines all links each step) — the substrate
+    pattern of ring attention, where k/v blocks stream neighbor-to-neighbor
+    over ICI exactly like this.
+
+    Methodology: the r03 chained recipe (workloads/timing.py) — ``iters``
+    full ring revolutions inside one compiled program, scalar-readback
+    sync, dispatch floor subtracted, best-of-N."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n == 1:
+        return {
+            "ok": True,
+            "devices": 1,
+            "skipped": "single chip: no ring",
+            "transport": "hbm-local",
+            "backend": jax.default_backend(),
+        }
+    mesh = Mesh(np.array(devices), ("x",))
+    elems_per_dev = max(128, int(size_mb * 1024 * 1024 / 2 / n))
+    elems_per_dev = (elems_per_dev + 127) // 128 * 128
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    sharding = NamedSharding(mesh, P("x"))
+    ranks = np.repeat(np.arange(1, n + 1, dtype=np.float32), elems_per_dev)
+    # the payload AS IT RIDES THE RING: bf16-rounded ranks (integers above
+    # 256 are not bf16-exact, so the expected values must be computed from
+    # the rounded payload or big slices would fail spuriously)
+    payload = np.asarray(ranks.astype(jnp.bfloat16), dtype=np.float32)
+    if jax.process_count() > 1:
+        # rank = 1 + mesh POSITION, never device id — multi-process device
+        # ids are not contiguous (process 1's CPU devices start at 2048)
+        index_of = {d: i for i, d in enumerate(devices)}
+        local = np.repeat(
+            np.array(
+                sorted(1.0 + index_of[d] for d in mesh.local_devices),
+                dtype=np.float32,
+            ),
+            elems_per_dev,
+        ).astype(jnp.bfloat16)
+        x = jax.make_array_from_process_local_data(sharding, local)
+    else:
+        x = jax.device_put(ranks.astype(jnp.bfloat16), sharding)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=P("x"), out_specs=P("x")
+    )
+    def ring(shard):
+        # the ring payload stays bf16 (the bandwidth under test); the
+        # accumulator is f32 so rank sums stay exact on big slices (bf16
+        # integers are exact only to 256)
+        def hop(_, carry):
+            buf, acc = carry
+            buf = jax.lax.ppermute(buf, "x", perm)
+            return buf, acc + buf.astype(jnp.float32)
+
+        def revolution(_, carry):
+            # n-1 accumulating hops: my accumulator sums every other
+            # device's buffer, one hop at a time; the completing n-th hop
+            # brings my buffer home so the next revolution starts clean
+            buf, acc = jax.lax.fori_loop(
+                0, n - 1, hop,
+                (carry[0], _vary(jnp.zeros(carry[0].shape, jnp.float32))),
+            )
+            buf = jax.lax.ppermute(buf, "x", perm)
+            return buf, acc
+
+        buf, acc = jax.lax.fori_loop(
+            0, iters, revolution,
+            (shard, _vary(jnp.zeros(shard.shape, jnp.float32))),
         )
-    return result
+        return acc
+
+    @jax.jit
+    def err(acc):
+        # after a full revolution my buffer is back home (iters revolutions
+        # are idempotent on buf), and acc = sum of all OTHER devices'
+        # payloads: distinct-total minus own, computed from the bf16-rounded
+        # payload so the comparison is exact at any slice size (f32
+        # accumulation of bf16 integers is exact to 2^24).  One corrupted
+        # hop breaks the equality.
+        distinct_total = float(payload[::elems_per_dev].sum())
+        expected = jnp.asarray(distinct_total - payload, jnp.float32)
+        return jnp.max(jnp.abs(acc - expected))
+
+    acc0 = ring(x)  # compile + warm the timed program
+    float(err(acc0))  # compile err for its real (f32) input
+    # floor: dispatch + readback of the SAME compiled err on a materialized
+    # array — no recompile in the first sample, no ring execution
+    floor = min(
+        timing.timed(lambda: float(err(acc0))) for _ in range(max(2, best_of))
+    )
+    raw = []
+    max_err = 0.0
+    for _ in range(best_of):
+        t0 = time.perf_counter()
+        max_err = max(max_err, float(err(ring(x))))
+        raw.append(time.perf_counter() - t0)
+    # per-hop time: iters revolutions x n pipelined hops each (n-1
+    # accumulating + 1 completing)
+    times, overhead_dominated = timing.subtract_floor(
+        raw, floor, per=iters * n
+    )
+    hop_bytes = elems_per_dev * 2  # bf16 per device per hop
+    gbps = hop_bytes / times[0] / 1e9
+    return {
+        "ok": max_err < 0.1,
+        "devices": n,
+        "size_mb": hop_bytes * n / 1e6,
+        "hops": iters * n,
+        "hop_ms": times[0] * 1e3,
+        "overhead_ms": floor * 1e3,
+        "overhead_dominated": overhead_dominated,
+        "link_gbps": gbps,
+        "link_gbps_median": hop_bytes / times[len(times) // 2] / 1e9,
+        "max_error": max_err,
+        "transport": "ici",
+        "backend": jax.default_backend(),
+    }
+
+
+def apply_ring_gate(result: dict, min_gbps: float) -> dict:
+    """RING_MIN_GBPS gate on the per-link rate (shared rule:
+    timing.apply_min_gate; never on skipped/single-chip measurements)."""
+    return timing.apply_min_gate(
+        result, metric="link_gbps", minimum=min_gbps,
+        backends_env="RING_GATE_BACKENDS", label="ring link",
+        require_ici=True,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -104,6 +104,23 @@ def run_worker(
     collectives.apply_allreduce_gate(bench, min_gbps)
     bw_ok = bool(bench["ok"])
 
+    # -- ring exchange: the per-LINK diagnostic — every individual ICI hop
+    # must carry its payload exactly, and the reported rate is bottlenecked
+    # by the slowest link (the allreduce can't localize a bad link).
+    # Report-only unless RING_MIN_GBPS arms the gate.
+    ring = collectives.ring_benchmark(
+        size_mb=float(os.environ.get("RING_SIZE_MB", "8")),
+        iters=2,
+        best_of=2,
+        devices=devices,
+    )
+    try:
+        ring_min = float(os.environ.get("RING_MIN_GBPS", "0") or 0)
+    except ValueError:
+        ring_min = 0.0
+    collectives.apply_ring_gate(ring, ring_min)
+    ring_ok = bool(ring["ok"])
+
     # -- burn-in over the global (dp, mp) mesh: real SGD steps with MXU
     # matmuls + cross-host collectives (mp psum, dp grad pmean)
 
@@ -150,7 +167,7 @@ def run_worker(
     decreasing = len(losses) < 2 or losses[-1] < losses[0]
 
     return {
-        "ok": psum_ok and finite and decreasing and bw_ok,
+        "ok": psum_ok and finite and decreasing and bw_ok and ring_ok,
         "process_id": process_id,
         "num_processes": num_processes,
         "global_devices": len(devices),
@@ -160,9 +177,15 @@ def run_worker(
         "allreduce": {
             k: bench.get(k)
             for k in ("ok", "busbw_gbps", "algbw_gbps", "size_mb", "transport",
-                      "overhead_dominated", "error")
+                      "overhead_dominated", "min_gbps", "gated", "error")
             if k in bench
-        } | {"min_gbps": min_gbps, "gated": bool(min_gbps)},
+        },
+        "ring": {
+            k: ring.get(k)
+            for k in ("ok", "link_gbps", "max_error", "hops",
+                      "overhead_dominated", "gated", "error")
+            if k in ring
+        },
         "losses": losses,
         "time_s": time.perf_counter() - t0,
         "backend": jax.default_backend(),

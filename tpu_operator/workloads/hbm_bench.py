@@ -89,25 +89,12 @@ def _peak_hbm_gbps(generation: str) -> float:
 
 
 def apply_hbm_gate(result: dict, min_gbps: float) -> dict:
-    """HBM_MIN_GBPS gate, mirroring the allreduce gate's rules: tpu backend
-    only (widenable via HBM_GATE_BACKENDS for tests), never on
-    overhead-dominated measurements."""
-    backends = [
-        b.strip() for b in os.environ.get("HBM_GATE_BACKENDS", "tpu").split(",")
-    ]
-    enforced = (
-        min_gbps > 0
-        and result.get("backend") in backends
-        and not result.get("overhead_dominated")
+    """HBM_MIN_GBPS gate (shared rule: timing.apply_min_gate; no ICI
+    requirement — the stream is chip-local by construction)."""
+    return timing.apply_min_gate(
+        result, metric="gbps", minimum=min_gbps,
+        backends_env="HBM_GATE_BACKENDS", label="hbm",
     )
-    result["min_gbps"] = min_gbps
-    result["gated"] = enforced
-    if enforced and result["gbps"] < min_gbps:
-        result["ok"] = False
-        result["error"] = (
-            f"hbm {result['gbps']:.1f} GB/s below required {min_gbps:.1f}"
-        )
-    return result
 
 
 def main() -> int:

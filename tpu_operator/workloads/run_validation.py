@@ -5,9 +5,10 @@ Exits 0 iff every requested check passes; prints one JSON line per check so
 the validator (and humans reading pod logs) see the numbers.
 
 Env:
-- ``WORKLOAD_CHECKS``: comma list of vector-add,allreduce,burn-in,matmul,hbm
-  (default runs the first three; matmul and hbm are opt-in — they hold the
-  chip longer)
+- ``WORKLOAD_CHECKS``: comma list of
+  vector-add,allreduce,burn-in,matmul,hbm,ring (default runs the first
+  three; matmul/hbm/ring are opt-in — they hold the chip longer; ring is
+  the per-ICI-link diagnostic, gated by RING_MIN_GBPS)
 - ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
@@ -56,6 +57,14 @@ def main() -> int:
             result = matmul_bench.apply_mfu_gate(
                 matmul_bench.quick_benchmark(),
                 float(os.environ.get("MATMUL_MIN_MFU", "0")),
+            )
+        elif check == "ring":
+            result = collectives.apply_ring_gate(
+                collectives.ring_benchmark(
+                    size_mb=float(os.environ.get("RING_SIZE_MB", "16")),
+                    iters=int(os.environ.get("RING_ITERS", "4")),
+                ),
+                float(os.environ.get("RING_MIN_GBPS", "0") or 0),
             )
         elif check == "hbm":
             from tpu_operator.workloads import hbm_bench

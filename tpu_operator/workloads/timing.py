@@ -11,6 +11,7 @@ floor-subtraction / overhead-domination rule.
 
 from __future__ import annotations
 
+import os
 import time
 
 
@@ -37,3 +38,43 @@ def subtract_floor(
     if dominated:
         times = sorted(t / per for t in raw)
     return times, dominated
+
+
+def apply_min_gate(
+    result: dict,
+    *,
+    metric: str,
+    minimum: float,
+    backends_env: str,
+    label: str,
+    min_key: str = "min_gbps",
+    require_ici: bool = False,
+) -> dict:
+    """The bandwidth-gate enforcement rule, in ONE place (allreduce, ring
+    and HBM gates must stay identical):
+
+    - enforce only when a positive minimum is set
+    - only on backends named in the ``backends_env`` env var (default tpu —
+      CPU/gloo rates say nothing about chip health; tests widen it)
+    - with ``require_ici``, only over real inter-chip transport (single-chip
+      HBM copy rates are never gated as ICI)
+    - never when the measurement was overhead-dominated (can't be trusted
+      in either direction)
+
+    Mutates ``result``: records the minimum under ``min_key`` and whether
+    the gate was actually ``gated`` (enforced), and flips ``ok`` on a miss."""
+    backends = [b.strip() for b in os.environ.get(backends_env, "tpu").split(",")]
+    enforced = (
+        minimum > 0
+        and (not require_ici or result.get("transport") == "ici")
+        and result.get("backend") in backends
+        and not result.get("overhead_dominated")
+    )
+    result[min_key] = minimum
+    result["gated"] = enforced
+    if enforced and result[metric] < minimum:
+        result["ok"] = False
+        result["error"] = (
+            f"{label} {result[metric]:.1f} GB/s below required {minimum:g}"
+        )
+    return result
