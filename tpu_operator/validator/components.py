@@ -238,9 +238,12 @@ class Validator:
 
                 node = await self.client().get("", "Node", self.config.node_name)
                 min_gbps = _allreduce_min_gbps(nodeinfo.attributes(node).generation)
+            # multi-chip: add the ring per-link diagnostic (single chip has
+            # no ring; the check would just skip itself)
+            checks = "vector-add,allreduce,burn-in" + (",ring" if chips > 1 else "")
             await self.spawn_workload(
                 "tpu-jax-workload-validation",
-                checks="vector-add,allreduce,burn-in",
+                checks=checks,
                 tpu_request=chips,
                 min_gbps=min_gbps,
             )
@@ -257,6 +260,7 @@ class Validator:
             results = {
                 "vector-add": collectives.vector_add(1 << 16),
                 "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
+                "ring": collectives.ring_benchmark(size_mb=2, iters=2, best_of=2),
                 "matmul": matmul_bench.quick_benchmark(),
             }
             for name, r in results.items():
@@ -266,6 +270,7 @@ class Validator:
                 "mode": "in-process",
                 "devices": results["allreduce"]["devices"],
                 "algbw_gbps": results["allreduce"]["algbw_gbps"],
+                "ring_link_gbps": results["ring"].get("link_gbps"),
                 "matmul_tflops": results["matmul"]["tflops"],
                 "mfu": results["matmul"]["mfu"],
             }

@@ -305,30 +305,31 @@ def ring_benchmark(
         )
         return acc
 
+    distinct_total = float(payload[::elems_per_dev].sum())
+
     @jax.jit
-    def err(acc):
+    def err(acc, x_in):
         # after a full revolution my buffer is back home (iters revolutions
         # are idempotent on buf), and acc = sum of all OTHER devices'
-        # payloads: distinct-total minus own, computed from the bf16-rounded
-        # payload so the comparison is exact at any slice size (f32
-        # accumulation of bf16 integers is exact to 2^24).  One corrupted
-        # hop breaks the equality.
-        distinct_total = float(payload[::elems_per_dev].sum())
-        expected = jnp.asarray(distinct_total - payload, jnp.float32)
+        # payloads: distinct-total minus own, derived ON DEVICE from the
+        # unchanged input (not baked in as a global-size HLO constant) and
+        # exact at any slice size — bf16 integer payloads accumulate in f32
+        # exactly to 2^24.  One corrupted hop breaks the equality.
+        expected = distinct_total - x_in.astype(jnp.float32)
         return jnp.max(jnp.abs(acc - expected))
 
     acc0 = ring(x)  # compile + warm the timed program
-    float(err(acc0))  # compile err for its real (f32) input
+    float(err(acc0, x))  # compile err for its real input types
     # floor: dispatch + readback of the SAME compiled err on a materialized
     # array — no recompile in the first sample, no ring execution
     floor = min(
-        timing.timed(lambda: float(err(acc0))) for _ in range(max(2, best_of))
+        timing.timed(lambda: float(err(acc0, x))) for _ in range(max(2, best_of))
     )
     raw = []
     max_err = 0.0
     for _ in range(best_of):
         t0 = time.perf_counter()
-        max_err = max(max_err, float(err(ring(x))))
+        max_err = max(max_err, float(err(ring(x), x)))
         raw.append(time.perf_counter() - t0)
     # per-hop time: iters revolutions x n pipelined hops each (n-1
     # accumulating + 1 completing)
@@ -338,7 +339,9 @@ def ring_benchmark(
     hop_bytes = elems_per_dev * 2  # bf16 per device per hop
     gbps = hop_bytes / times[0] / 1e9
     return {
-        "ok": max_err < 0.1,
+        # the equality is exact by construction (integer payloads, f32
+        # accumulation): ANY deviation is a corrupted hop, no tolerance
+        "ok": max_err == 0.0,
         "devices": n,
         "size_mb": hop_bytes * n / 1e6,
         "hops": iters * n,

@@ -183,7 +183,7 @@ def run_worker(
         "ring": {
             k: ring.get(k)
             for k in ("ok", "link_gbps", "max_error", "hops",
-                      "overhead_dominated", "gated", "error")
+                      "overhead_dominated", "min_gbps", "gated", "error")
             if k in ring
         },
         "losses": losses,
