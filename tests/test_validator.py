@@ -235,10 +235,23 @@ def _free_port() -> int:
 def _exec_distributed_pod(port: int, executed: list | None = None):
     """Executor for multi-host validation pods: run the REAL
     workloads.distributed program as a subprocess, rewriting the in-cluster
-    coordinator DNS (no DNS in the fake) to the shared localhost port.
-    Pods execute concurrently, so the jax.distributed rendezvous is real.
-    ``executed`` collects the pod objects (the validator garbage-collects
-    them post-success, so assertions need the captured copies)."""
+    coordinator DNS (no DNS in the fake) to a localhost port PER rendezvous
+    group (pod subdomain = headless Service) — a multislice validation runs
+    several concurrent rendezvous (one per slice plus the cross-slice one),
+    each needing its own coordinator.  Pods execute concurrently, so the
+    jax.distributed rendezvous is real.  ``executed`` collects the pod
+    objects (the validator garbage-collects them post-success, so
+    assertions need the captured copies)."""
+    import threading
+
+    ports: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def group_port(subdomain: str) -> int:
+        with lock:
+            if subdomain not in ports:
+                ports[subdomain] = port if not ports else _free_port()
+            return ports[subdomain]
 
     def execute(pod: dict) -> str:
         if executed is not None:
@@ -251,7 +264,9 @@ def _exec_distributed_pod(port: int, executed: list | None = None):
             "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
         }
-        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["COORDINATOR_ADDRESS"] = (
+            f"127.0.0.1:{group_port(pod['spec'].get('subdomain', '') or '')}"
+        )
         env["TPU_COMPILE_CACHE"] = "0"  # pod env points at /run/tpu on the host
         result = subprocess.run(
             [sys.executable, "-m", "tpu_operator.workloads.distributed"],
@@ -358,6 +373,127 @@ async def test_multihost_four_host_slice_validation(validation_root):
     coverage: 4 processes x 4 devices exercises cross-process shardings and
     a wider rendezvous than the minimum pair."""
     await _run_multihost_validation(4, "4x4", "pool-c")
+
+
+async def test_multislice_cross_slice_validation(validation_root):
+    """Two 2-host slices (distinct node pools) declared one multislice
+    group: every host proves its own slice's ICI rendezvous AND the
+    cross-slice DCN rendezvous (4 global processes) before jax-ready.
+    Three real concurrent rendezvous run through the fake kubelet — one per
+    slice plus the cross-slice one with globally-ordered process ids and no
+    ICI-derived gate (DCN is a different fabric)."""
+    import contextlib
+
+    port = _free_port()
+    executed: list = []
+    sim = SimConfig(
+        pod_ready_delay=0.01, tick=0.01,
+        pod_executor=_exec_distributed_pod(port, executed),
+    )
+    async with FakeCluster(sim) as fc:
+        names = []
+        for s, pool in enumerate(("pool-a", "pool-b")):
+            for i in range(2):
+                name = f"tpu-{pool}-{i}"
+                names.append(name)
+                node = fc.add_node(
+                    name,
+                    topology="2x4",  # 8 chips / 4 per host = 2 hosts per slice
+                    labels={
+                        consts.GKE_NODEPOOL_LABEL: pool,
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(i),
+                        consts.MULTISLICE_GROUP_LABEL: "ms-test",
+                        consts.MULTISLICE_SLICES_LABEL: "2",
+                    },
+                )
+                node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+                fc.put(node)
+        async with contextlib.AsyncExitStack() as stack:
+            clients = [
+                await stack.enter_async_context(
+                    ApiClient(Config(base_url=fc.base_url))
+                )
+                for _ in names
+            ]
+            validators = [
+                Validator(
+                    fast_config(node_name=n, with_workload=True,
+                                sleep_interval=0.1, workload_retries=1800),
+                    client=clients[i],
+                )
+                for i, n in enumerate(names)
+            ]
+            status.write_ready("plugin")
+            await asyncio.gather(*(v.run("jax") for v in validators))
+
+            payload = status.read_status("jax")
+            assert payload["mode"] == "multi-host"
+            assert payload["workers"] == 2  # own slice
+            ms = payload["multislice"]
+            assert ms["group"] == "ms-test"
+            assert ms["workers"] == 4
+            assert ms["proven_by"] in ("workload-pod", "service-tombstone")
+
+            # the cross-slice pods (distinct tpu-ms-validation name base —
+            # never colliding with any nodepool's slice rendezvous) ran with
+            # GLOBAL process ids and no ICI-derived allreduce floor
+            ms_pods = [
+                p for p in executed
+                if p["metadata"]["name"].startswith("tpu-ms-validation")
+            ]
+            assert len({p["metadata"]["name"] for p in ms_pods}) == 4
+            global_ids = set()
+            for p in ms_pods:
+                envs = {
+                    e["name"]: e["value"]
+                    for e in p["spec"]["containers"][0]["env"]
+                }
+                assert envs["NUM_PROCESSES"] == "4"
+                assert envs["ALLREDUCE_MIN_GBPS"] == "0.0"  # DCN: no ICI floor
+                global_ids.add(envs["PROCESS_ID"])
+            assert global_ids == {"0", "1", "2", "3"}
+
+            # slice pods and multislice pods both garbage-collected
+            pods = await clients[0].list_items("", "Pod", NS)
+            assert not [
+                p for p in pods
+                if p["metadata"]["name"].startswith("tpu-jax-validation")
+                or p["metadata"]["name"].startswith("tpu-ms-validation")
+            ]
+
+
+async def test_multislice_missing_slice_fails(validation_root):
+    """A declared 2-slice multislice group with only one slice visible must
+    FAIL (set-property semantics) — without the declaration the label query
+    cannot distinguish 'group of one' from 'others not up yet'."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        for i in range(2):
+            node = fc.add_node(
+                f"tpu-a-{i}",
+                topology="2x4",
+                labels={
+                    consts.GKE_NODEPOOL_LABEL: "pool-a",
+                    consts.GKE_TPU_WORKER_ID_LABEL: str(i),
+                    consts.MULTISLICE_GROUP_LABEL: "ms-x",
+                    consts.MULTISLICE_SLICES_LABEL: "2",
+                },
+            )
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            v = Validator(
+                fast_config(node_name="tpu-a-0", with_workload=True),
+                client=client,
+            )
+            with pytest.raises(components.ValidationError, match="1/2"):
+                await v._multislice_group()
+
+            # without the declaration: skip (None), not a failure
+            for i in range(2):
+                n = await client.get("", "Node", f"tpu-a-{i}")
+                del n["metadata"]["labels"][consts.MULTISLICE_SLICES_LABEL]
+                await client.update(n)
+            assert await v._multislice_group() is None
 
 
 async def test_multihost_requires_all_hosts_present(validation_root):

@@ -34,6 +34,15 @@ UPGRADE_STATE_LABEL = "tpu.google.com/tpu-runtime-upgrade-state"
 # (SURVEY §7 hard part 1; no reference analogue, GPUs are node-local).
 SLICE_READY_LABEL = "tpu.google.com/tpu.slice.ready"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# Admin/TFD-applied multislice membership: slices (node pools) sharing a
+# value form one DCN-connected multislice group; the validator then also
+# proves a cross-slice rendezvous before gating jax-ready (no reference
+# analogue — NVLink/IB fabric validation does not exist in the reference).
+MULTISLICE_GROUP_LABEL = "tpu.google.com/multislice-group"
+# Expected member-slice count for the group: with it, validation FAILS (and
+# retries) until exactly that many slices are visible — the label query
+# alone cannot distinguish "group of one" from "other slices not up yet".
+MULTISLICE_SLICES_LABEL = "tpu.google.com/multislice-slices"
 
 # Per-operand deployment gate labels (gpuStateLabels analogue,
 # controllers/state_manager.go:90-115).  Value "true" ⇒ operand DS schedules.

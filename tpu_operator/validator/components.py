@@ -40,6 +40,10 @@ EPOCH_LABEL = "tpu.google.com/validation-epoch"
 # node-local persistent XLA compilation cache shared by all validation
 # workload pods on a host (see workloads/compile_cache.py)
 COMPILE_CACHE_HOST_PATH = consts.COMPILE_CACHE_DIR
+# distinct name base for cross-slice rendezvous resources: a nodepool whose
+# name happens to match a prefixed group key must never share Service/pod
+# names (and thus epoch tombstones) with the multislice rendezvous
+MULTISLICE_BASE = "tpu-ms-validation"
 VALIDATED_EPOCH_ANNOTATION = "tpu.google.com/validated-epoch"
 
 # Fraction of the generation's published per-chip ICI bandwidth
@@ -67,6 +71,20 @@ def _allreduce_min_gbps(generation: str) -> float:
     from tpu_operator.k8s.nodeinfo import generation_info
 
     return round(generation_info(generation).ici_gbps * ALLREDUCE_GATE_FRACTION, 1)
+
+
+def _multislice_min_gbps() -> float:
+    """The cross-slice (DCN) allreduce floor: report-only unless the
+    operator sets MULTISLICE_MIN_GBPS — the catalogue's ICI numbers say
+    nothing about the inter-slice fabric.  Malformed values log and fall
+    back rather than silently disarming the only cross-slice gate."""
+    env = os.environ.get("MULTISLICE_MIN_GBPS", "")
+    if env != "":
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            log.warning("ignoring malformed MULTISLICE_MIN_GBPS=%r", env)
+    return 0.0
 
 
 def _worker_id_of(node: dict) -> int:
@@ -302,6 +320,16 @@ class Validator:
             .eq(consts.GKE_NODEPOOL_LABEL, key)
             .apply(await client.list_items("", "Node"))
         )
+        self._checked_worker_ids(key, members)  # sorts members in place
+        return key, members
+
+    @staticmethod
+    def _checked_worker_ids(key: str, members: list[dict]) -> dict[str, int]:
+        """Validate one slice's worker-id labels (numeric, unique, covering
+        0..N-1, all hosts present), sort ``members`` by id in place, and
+        return {node name: worker id}."""
+        from tpu_operator.k8s import nodeinfo
+
         ids = {m["metadata"]["name"]: _worker_id_of(m) for m in members}
         dupes = {i for i in ids.values() if list(ids.values()).count(i) > 1}
         if dupes:
@@ -320,17 +348,116 @@ class Validator:
                 f"slice {key}: worker ids {sorted(ids.values())} do not cover "
                 f"0..{len(members) - 1}; check the worker-id labels"
             )
-        return key, members
+        return ids
 
-    def _group_pod_name(self, key: str, worker_id: int) -> str:
+    async def _multislice_group(
+        self,
+    ) -> Optional[tuple[str, list[dict], dict[str, int], dict[str, list[dict]]]]:
+        """(group key, globally-ordered members, {node: global process id},
+        {slice key: slice members}) when this node's slice belongs to a
+        DCN-connected multislice group spanning >1 slice; None otherwise.
+
+        Membership = the admin/TFD-applied ``tpu.google.com/multislice-group``
+        label (GKE creates one node pool per slice; which slices form a
+        multislice is a deployment decision the cluster must declare).
+        Global process ids order slices lexicographically by slice key, hosts
+        by worker id within each — every member derives the same order from
+        cluster state alone."""
+        from tpu_operator.controllers.labels import slice_group_key
+        from tpu_operator.k8s import nodeinfo
+
+        client = self.client()
+        node = await client.get("", "Node", self.config.node_name)
+        ms_key = (deep_get(node, "metadata", "labels", default={}) or {}).get(
+            consts.MULTISLICE_GROUP_LABEL
+        )
+        if not ms_key:
+            return None
+        members = (
+            nodeinfo.NodeFilter()
+            .tpu()
+            .eq(consts.MULTISLICE_GROUP_LABEL, ms_key)
+            .apply(await client.list_items("", "Node"))
+        )
+        slices: dict[str, list[dict]] = {}
+        for m in members:
+            sk = slice_group_key(m)
+            if sk is None:
+                raise ValidationError(
+                    f"multislice {ms_key}: member {m['metadata']['name']} has no "
+                    "slice identity (single-host or missing nodepool label)"
+                )
+            slices.setdefault(sk, []).append(m)
+        declared = (deep_get(node, "metadata", "labels", default={}) or {}).get(
+            consts.MULTISLICE_SLICES_LABEL
+        )
+        if declared:
+            try:
+                expected_slices = int(declared)
+            except ValueError:
+                raise ValidationError(
+                    f"multislice {ms_key}: malformed "
+                    f"{consts.MULTISLICE_SLICES_LABEL}={declared!r}"
+                )
+            if len(slices) != expected_slices:
+                # a wholly-absent member slice must FAIL, not silently
+                # degrade to single-slice validation (set-property
+                # semantics, same as a partially-present slice)
+                raise ValidationError(
+                    f"multislice {ms_key}: {len(slices)}/{expected_slices} "
+                    f"member slices visible ({sorted(slices)})"
+                )
+        elif len(slices) < 2:
+            log.warning(
+                "multislice %s: only one member slice visible and no %s "
+                "declaration; skipping cross-slice validation (set the label "
+                "to make absence a failure)",
+                ms_key, consts.MULTISLICE_SLICES_LABEL,
+            )
+            return None
+        ordered: list[dict] = []
+        for sk in sorted(slices):
+            self._checked_worker_ids(sk, slices[sk])  # sorts by worker id
+            ordered.extend(slices[sk])
+        ids = {m["metadata"]["name"]: i for i, m in enumerate(ordered)}
+        return ms_key, ordered, ids, slices
+
+    async def _await_member_slices_proven(
+        self, ms_key: str, slices: dict[str, list[dict]]
+    ) -> None:
+        """Block the cross-slice phase until every member slice's own
+        rendezvous is proven AND garbage-collected (Service tombstone at the
+        slice's current epoch).  Ordering matters on real kubelets: a
+        nodeName-pinned pod that doesn't fit the node's free chips is
+        REJECTED (OutOf<resource>), not queued — cross-slice pods must not
+        race member slices' validation pods for the same chips."""
+        for _ in range(self.config.workload_retries):
+            pending = None
+            for sk, mems in slices.items():
+                svc = self._group_service_name(sk)
+                epoch = await self._validation_epoch(mems)
+                if await self._group_tombstone(svc) != epoch:
+                    pending = sk
+                    break
+            if pending is None:
+                return
+            await asyncio.sleep(self.config.sleep_interval)
+        raise ValidationError(
+            f"multislice {ms_key}: member slice {pending} never proved its own "
+            "rendezvous; cannot start the cross-slice phase"
+        )
+
+    def _group_pod_name(
+        self, key: str, worker_id: int, base: str = "tpu-jax-validation"
+    ) -> str:
         from tpu_operator.state.nodepool import hashed_name
 
-        return hashed_name("tpu-jax-validation", f"{key}-w{worker_id}")
+        return hashed_name(base, f"{key}-w{worker_id}")
 
-    def _group_service_name(self, key: str) -> str:
+    def _group_service_name(self, key: str, base: str = "tpu-jax-validation") -> str:
         from tpu_operator.state.nodepool import hashed_name
 
-        return hashed_name("tpu-jax-validation", key)
+        return hashed_name(base, key)
 
     async def _validation_epoch(self, members: list[dict]) -> str:
         """Identity of the runtime the slice is being proven against.
@@ -378,25 +505,76 @@ class Validator:
         Service and garbage-collects the Succeeded pods, so re-validating
         validators accept the Service tombstone instead of re-proving.
         Reference pattern: workload-pod spawning of validator/main.go:941-1052,
-        lifted from one pod to a coordinated, epoch-keyed set."""
-        my_id = next(
-            _worker_id_of(m)
-            for m in members
-            if m["metadata"]["name"] == self.config.node_name
+        lifted from one pod to a coordinated, epoch-keyed set.
+
+        When the slice belongs to a declared MULTISLICE group, jax-ready
+        additionally requires the CROSS-SLICE rendezvous — the same
+        machinery over every host of every member slice, with global
+        process ids and the collective riding DCN between slices (SURVEY
+        §5.8's "DCN across slices later", now).  The ICI-derived allreduce
+        floor is NOT applied there (DCN is a different fabric); the
+        report-only numbers gate via MULTISLICE_MIN_GBPS when set."""
+        import functools
+
+        ids = {m["metadata"]["name"]: _worker_id_of(m) for m in members}
+        payload = await self._validate_group_rendezvous(
+            key, members, ids, mode="multi-host"
         )
-        svc = self._group_service_name(key)
+        ms = await self._multislice_group()
+        if ms is not None:
+            ms_key, ms_members, ms_ids, ms_slices = ms
+            ms_payload = await self._validate_group_rendezvous(
+                ms_key, ms_members, ms_ids, mode="multislice",
+                gate_ici=False,
+                base=MULTISLICE_BASE,
+                # re-awaited before EVERY pod-set convergence, not just the
+                # first: a mid-flight epoch change re-triggers member-slice
+                # validations, and cross-slice pods must never race them
+                # for the same chips
+                before_ensure=functools.partial(
+                    self._await_member_slices_proven, ms_key, ms_slices
+                ),
+            )
+            payload["multislice"] = {
+                k: ms_payload[k]
+                for k in ("group", "workers", "worker_id", "epoch", "proven_by")
+            }
+        status.write_ready("jax", payload)
+
+    async def _validate_group_rendezvous(
+        self,
+        key: str,
+        members: list[dict],
+        ids: dict[str, int],
+        mode: str,
+        gate_ici: bool = True,
+        base: str = "tpu-jax-validation",
+        before_ensure=None,
+    ) -> dict:
+        """Converge + gate on one coordinated rendezvous over ``members``
+        with the given process-id assignment; returns the proof payload
+        (the caller owns writing status).  ``base`` namespaces the
+        Service/pod names so distinct rendezvous kinds can never collide
+        (a nodepool literally named like a prefixed group key must not share
+        evidence with the cross-slice rendezvous)."""
+        my_id = ids[self.config.node_name]
+        svc = self._group_service_name(key, base)
         coordinator = (
-            f"{self._group_pod_name(key, 0)}.{svc}."
+            f"{self._group_pod_name(key, 0, base)}.{svc}."
             f"{self.config.namespace}.svc:{COORDINATOR_PORT}"
         )
         client = self.client()
         epoch = await self._validation_epoch(members)
         if my_id == 0:
-            await self._ensure_group_workloads(key, members, svc, coordinator, epoch)
+            if before_ensure is not None:
+                await before_ensure()
+            await self._ensure_group_workloads(
+                key, members, svc, coordinator, epoch, ids, gate_ici, base
+            )
 
         def ready_payload(proven_by: str) -> dict:
             return {
-                "mode": "multi-host",
+                "mode": mode,
                 "group": key,
                 "workers": len(members),
                 "worker_id": my_id,
@@ -408,7 +586,7 @@ class Validator:
         # the pod set themselves (idempotent: the epoch check skips current
         # pods, so concurrent converging workers agree)
         patience = 10 if my_id != 0 else 0
-        name = self._group_pod_name(key, my_id)
+        name = self._group_pod_name(key, my_id, base)
         phase = None
         ensured = my_id == 0  # whoever converged the pod set also GCs it
         for attempt in range(self.config.workload_retries):
@@ -420,8 +598,7 @@ class Validator:
             epoch = await self._validation_epoch(members)
             tombstone = await self._group_tombstone(svc)
             if tombstone == epoch:
-                status.write_ready("jax", ready_payload("service-tombstone"))
-                return
+                return ready_payload("service-tombstone")
             try:
                 live = await client.get("", "Pod", name, self.config.namespace)
             except ApiError as e:
@@ -435,21 +612,24 @@ class Validator:
             )
             if live is None or pod_epoch != epoch:
                 if attempt >= patience:
+                    if before_ensure is not None:
+                        await before_ensure()
                     await self._ensure_group_workloads(
-                        key, members, svc, coordinator, epoch
+                        key, members, svc, coordinator, epoch, ids, gate_ici, base
                     )
                     ensured = True
                 await asyncio.sleep(self.config.sleep_interval)
                 continue
             phase = deep_get(live, "status", "phase")
             if phase == "Succeeded":
-                status.write_ready("jax", ready_payload("workload-pod"))
                 if ensured:
                     # the worker that converged the pod set also records the
                     # tombstone + GCs — covering re-proofs driven by a
                     # non-zero worker while worker 0's validator is asleep
-                    await self._cleanup_group_workloads(key, members, svc, epoch)
-                return
+                    await self._cleanup_group_workloads(
+                        key, members, svc, epoch, ids, base
+                    )
+                return ready_payload("workload-pod")
             if phase == "Failed":
                 raise ValidationError(
                     f"distributed validation pod {name} failed (slice {key})"
@@ -475,12 +655,26 @@ class Validator:
         )
 
     async def _ensure_group_workloads(
-        self, key: str, members: list[dict], svc: str, coordinator: str, epoch: str
+        self,
+        key: str,
+        members: list[dict],
+        svc: str,
+        coordinator: str,
+        epoch: str,
+        ids: dict[str, int],
+        gate_ici: bool = True,
+        base: str = "tpu-jax-validation",
     ) -> None:
-        """Converge the headless Service + one pinned pod per slice host to
+        """Converge the headless Service + one pinned pod per group host to
         the current epoch.  Pods already at this epoch (and not Failed) are
-        left untouched — no slice-wide churn when evidence is current."""
+        left untouched — no group-wide churn when evidence is current.
+        ``ids`` assigns each host its process id (per-slice worker ids for a
+        slice group; global ids for a multislice group); ``gate_ici`` arms
+        the catalogue ICI floor (off for cross-slice DCN, where
+        MULTISLICE_MIN_GBPS is the only gate)."""
         from tpu_operator.k8s import nodeinfo
+
+        dcn_min_gbps = None if gate_ici else _multislice_min_gbps()
 
         if await self._group_tombstone(svc) == epoch:
             # already proven and garbage-collected (worker 0's cleanup can
@@ -514,8 +708,8 @@ class Validator:
                 raise
         for member in members:
             attrs = nodeinfo.attributes(member)
-            wid = _worker_id_of(member)
-            name = self._group_pod_name(key, wid)
+            wid = ids[member["metadata"]["name"]]
+            name = self._group_pod_name(key, wid, base)
             try:
                 live = await client.get("", "Pod", name, self.config.namespace)
             except ApiError as e:
@@ -529,14 +723,20 @@ class Validator:
                 if current == epoch and deep_get(live, "status", "phase") != "Failed":
                     continue
                 await client.delete("", "Pod", name, self.config.namespace)
+            if gate_ici:
+                # the armed ICI gate: the distributed program measures the
+                # global allreduce and fails the rendezvous below this busbw
+                min_gbps = _allreduce_min_gbps(attrs.generation)
+            else:
+                # cross-slice traffic rides DCN, not ICI — the catalogue
+                # floor does not apply; gate only on explicit request
+                min_gbps = dcn_min_gbps
             pod = self._workload_pod(
                 name,
                 checks="",
                 tpu_request=max(1, attrs.chips_per_host),
                 owner=owner,
-                # the armed ICI gate: the distributed program measures the
-                # global allreduce and fails the rendezvous below this busbw
-                min_gbps=_allreduce_min_gbps(attrs.generation),
+                min_gbps=min_gbps,
             )
             pod["metadata"]["labels"]["tpu.google.com/slice-group"] = svc
             pod["metadata"]["labels"][EPOCH_LABEL] = epoch
@@ -561,7 +761,13 @@ class Validator:
                     raise
 
     async def _cleanup_group_workloads(
-        self, key: str, members: list[dict], svc: str, epoch: str
+        self,
+        key: str,
+        members: list[dict],
+        svc: str,
+        epoch: str,
+        ids: dict[str, int],
+        base: str = "tpu-jax-validation",
     ) -> None:
         """Worker 0, post-success: once every member pod of this epoch has
         Succeeded, record the proven epoch on the Service and delete the
@@ -570,7 +776,10 @@ class Validator:
         tombstone is durably written, so a crash mid-cleanup at worst causes
         one re-proof, never a false pass."""
         client = self.client()
-        names = [self._group_pod_name(key, _worker_id_of(m)) for m in members]
+        names = [
+            self._group_pod_name(key, ids[m["metadata"]["name"]], base)
+            for m in members
+        ]
         for _ in range(min(60, self.config.workload_retries)):
             done = 0
             for name in names:
