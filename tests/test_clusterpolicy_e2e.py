@@ -256,6 +256,78 @@ async def test_conditional_objects_pruned_on_spec_change():
             assert names == ["tpu-device-plugin"]
 
 
+async def _wait_manager_converged(client, node_name="tpu-node-0", passes=300):
+    """Poll until the policy is Ready AND the node advertises google.com/tpu
+    (watch-driven managers converge without manual stepping)."""
+    for _ in range(passes):
+        try:
+            obj = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            node = await client.get("", "Node", node_name)
+            if (
+                deep_get(obj, "status", "state") == State.READY
+                and consts.TPU_RESOURCE in node["status"]["allocatable"]
+            ):
+                return
+        except Exception:  # noqa: BLE001
+            pass
+        await asyncio.sleep(0.05)
+    pytest.fail("manager did not converge")
+
+
+async def test_operator_crash_resume_mid_convergence():
+    """Checkpoint/resume property (SURVEY §5.4): the operator is stateless —
+    all state lives in the cluster (CR status, labels, hash annotations) —
+    so killing it MID-convergence and starting a fresh instance must adopt
+    the half-applied objects and converge with no duplicate/conflicting
+    operands and no object churn from the takeover."""
+    async with FakeCluster(SimConfig(pod_ready_delay=0.05, tick=0.02)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            # first operator: crashed (hard cancel, no draining) as soon as
+            # at least one operand DaemonSet is observed — genuinely
+            # mid-application, not after full convergence
+            mgr1 = Manager(client, NS, metrics_port=-1, health_port=-1)
+            r1 = ClusterPolicyReconciler(client, NS)
+            r1.setup(mgr1)
+            await mgr1.__aenter__()
+            try:
+                await client.create(TPUClusterPolicy.new().obj)
+                fc.add_node("tpu-node-0")
+                for _ in range(300):
+                    if await client.list_items("apps", "DaemonSet", NS):
+                        break
+                    await asyncio.sleep(0.01)
+            finally:
+                await mgr1.__aexit__(None, None, None)  # crash
+
+            mid_ds = {
+                d["metadata"]["name"]: deep_get(d, "metadata", "uid")
+                for d in await client.list_items("apps", "DaemonSet", NS)
+            }
+            assert mid_ds, "crash happened before any operand was applied"
+
+            # second operator: fresh process, same cluster
+            mgr2 = Manager(client, NS, metrics_port=-1, health_port=-1)
+            r2 = ClusterPolicyReconciler(client, NS)
+            r2.setup(mgr2)
+            async with mgr2:
+                await _wait_manager_converged(client)
+
+            # adoption, not replacement: operands that existed at crash time
+            # keep their identity (same UID) — the hash-skip machinery must
+            # not delete/recreate on takeover
+            all_ds = await client.list_items("apps", "DaemonSet", NS)
+            final_ds = {
+                d["metadata"]["name"]: deep_get(d, "metadata", "uid")
+                for d in all_ds
+            }
+            for name, uid in mid_ds.items():
+                assert final_ds.get(name) == uid, (
+                    f"DaemonSet {name} was recreated on operator restart"
+                )
+            # and exactly one DS per operand name (no duplicates)
+            assert len(all_ds) == len(final_ds)
+
+
 async def test_manager_watch_driven_convergence():
     """Full manager: watches drive reconciles without manual stepping; health
     and metrics endpoints serve."""
@@ -273,20 +345,7 @@ async def test_manager_watch_driven_convergence():
             async with mgr:
                 await client.create(TPUClusterPolicy.new().obj)
                 fc.add_node("tpu-node-0")
-                for _ in range(200):
-                    try:
-                        obj = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
-                        node = await client.get("", "Node", "tpu-node-0")
-                        if (
-                            deep_get(obj, "status", "state") == State.READY
-                            and consts.TPU_RESOURCE in node["status"]["allocatable"]
-                        ):
-                            break
-                    except Exception:  # noqa: BLE001
-                        pass
-                    await asyncio.sleep(0.05)
-                else:
-                    pytest.fail("manager did not converge")
+                await _wait_manager_converged(client)
 
                 # probes + metrics
                 async with aiohttp.ClientSession() as http:
