@@ -215,11 +215,37 @@ def test_metrics_mode(validation_root, fake_hw, capsys):
 
     status.write_ready("libtpu")
     status.write_ready("pjrt")
+    status.write_ready("jax", {
+        "mode": "multi-host", "workers": 4, "algbw_gbps": 12.5, "mfu": 0.94,
+        "ring_link_gbps": 45.0, "multislice": {"workers": 8},
+    })
     assert cli.main(["--component", "metrics", "--oneshot"]) == 0
     out = capsys.readouterr().out
     assert 'tpu_validator_validation_status{component="libtpu"} 1.0' in out
-    assert 'tpu_validator_validation_status{component="jax"} 0.0' in out
+    assert 'tpu_validator_validation_status{component="jax"} 1.0' in out
     assert "tpu_validator_tpu_device_count 4.0" in out
+    # measured perf surfaced from the jax payload
+    assert 'tpu_validator_measured{metric="allreduce_gbps"} 12.5' in out
+    assert 'tpu_validator_measured{metric="mfu"} 0.94' in out
+    assert 'tpu_validator_measured{metric="ring_link_gbps"} 45.0' in out
+    assert 'tpu_validator_measured{metric="slice_workers"} 4.0' in out
+    assert 'tpu_validator_measured{metric="multislice_workers"} 8.0' in out
+    # absent measurements materialize no series
+    assert 'metric="matmul_tflops"' not in out
+
+    # serve mode scrapes ONE NodeMetrics repeatedly: a new payload without
+    # the ring/multislice numbers must stop serving them (no stale series)
+    from tpu_operator.validator.metrics import NodeMetrics
+
+    m = NodeMetrics()
+    m.scrape()
+    assert 'metric="ring_link_gbps"' in m.render().decode()
+    status.write_ready("jax", {"mode": "in-process", "algbw_gbps": 3.0})
+    m.scrape()
+    out2 = m.render().decode()
+    assert 'tpu_validator_measured{metric="allreduce_gbps"} 3.0' in out2
+    assert 'metric="ring_link_gbps"' not in out2
+    assert 'metric="multislice_workers"' not in out2
 
 
 def _free_port() -> int:

@@ -34,6 +34,25 @@ class NodeMetrics:
             "TPU chip device nodes visible on the host",
             registry=self.registry,
         )
+        # measured perf from the jax validation payload (the numbers the
+        # reference never had: MFU, HBM-local allreduce, per-link ring) —
+        # a label family so series only materialize for measured metrics
+        self.perf = Gauge(
+            "tpu_validator_measured",
+            "Perf numbers measured by the last jax validation",
+            ["metric"],
+            registry=self.registry,
+        )
+
+    # jax-payload key → exported metric label (set only when present)
+    PERF_KEYS = {
+        "algbw_gbps": "allreduce_gbps",
+        "matmul_tflops": "matmul_tflops",
+        "mfu": "mfu",
+        "ring_link_gbps": "ring_link_gbps",
+        "workers": "slice_workers",
+        "allreduce_min_gbps": "allreduce_min_gbps",
+    }
 
     def scrape(self) -> None:
         for component in consts.STATUS_FILES:
@@ -41,6 +60,21 @@ class NodeMetrics:
                 1 if status.is_ready(component) else 0
             )
         self.device_count.set(hw.chip_count())
+        payload = status.read_status("jax") or {}
+        # re-derive the whole family each scrape: a metric absent from the
+        # CURRENT payload must stop being served, not linger from an older
+        # validation round (serve mode scrapes repeatedly)
+        self.perf.clear()
+
+        def _set(metric: str, value) -> None:
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                self.perf.labels(metric=metric).set(value)
+
+        for key, metric in self.PERF_KEYS.items():
+            _set(metric, payload.get(key))
+        ms = payload.get("multislice")
+        if isinstance(ms, dict):
+            _set("multislice_workers", ms.get("workers"))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
