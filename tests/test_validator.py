@@ -139,6 +139,12 @@ async def test_jax_validation_spawns_real_workload(validation_root):
             payload = status.read_status("jax")
             assert payload["mode"] == "workload-pod"
             assert payload["chips"] == 4
+            # the workload pod dropped its measured numbers into the shared
+            # /run/tpu; the payload must carry them (exporter → alerts)
+            assert payload["algbw_gbps"] > 0
+            assert payload["matmul_tflops"] > 0
+            # cpu backend: no published peak → no mfu key (never fabricated)
+            assert "mfu" not in payload
             pod = await client.get("", "Pod", "tpu-jax-workload-validation", NS)
             assert deep_get(pod, "status", "phase") == "Succeeded"
             limits = deep_get(pod, "spec", "containers", 0, "resources", "limits")
@@ -149,8 +155,16 @@ async def test_jax_validation_spawns_real_workload(validation_root):
                 for e in deep_get(pod, "spec", "containers", 0, "env")
             }
             assert env["TPU_COMPILE_CACHE"] == "/run/tpu/compile_cache"
-            vol = deep_get(pod, "spec", "volumes", 0)
-            assert vol["hostPath"]["path"] == "/run/tpu/compile_cache"
+            # exactly two NARROW identity mounts — cache + results drop-box,
+            # never the validations markers or handoff files
+            vols = {
+                v["name"]: v["hostPath"]["path"]
+                for v in deep_get(pod, "spec", "volumes")
+            }
+            assert vols == {
+                "compile-cache": "/run/tpu/compile_cache",
+                "workload-results": "/run/tpu/workload-results",
+            }
 
 
 async def test_jax_validation_in_process(validation_root):
@@ -355,6 +369,11 @@ async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
             assert payload["mode"] == "multi-host"
             assert payload["workers"] == num_hosts
             assert payload["group"] == pool
+            # measured numbers from the distributed pod's drop-box surface
+            # in the payload (exporter → the interconnect alert)
+            assert payload["algbw_gbps"] > 0
+            assert payload["ring_link_gbps"] > 0
+            assert payload["allreduce_min_gbps"] == 50.0
             # every per-host pod really executed, pinned and numbered right
             by_name = {p["metadata"]["name"]: p for p in executed}
             assert len(by_name) == num_hosts

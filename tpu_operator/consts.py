@@ -136,9 +136,14 @@ IMAGE_ENVS = {
 # Node-level validation status files (validator/main.go:131-166 analogue).
 VALIDATION_DIR = "/run/tpu/validations"
 VALIDATION_ROOT_ENV = "TPU_VALIDATION_ROOT"  # test seam: relocate /run/tpu
-# persistent XLA compilation cache, sibling of the validations dir on the
-# same hostPath (one knob: both follow VALIDATION_DIR's root)
-COMPILE_CACHE_DIR = VALIDATION_DIR.rsplit("/", 1)[0] + "/compile_cache"
+# ONE root knob: every node-local dir below derives from it
+RUN_TPU_DIR = VALIDATION_DIR.rsplit("/", 1)[0]
+# persistent XLA compilation cache (workload pods mount exactly this dir)
+COMPILE_CACHE_DIR = RUN_TPU_DIR + "/compile_cache"
+# workload measured-results drop-box — its own subdir so workload pods can
+# be mounted ONLY cache+results, never the validations ready markers or the
+# worker-id/slice-config handoff files they could forge/corrupt
+WORKLOAD_RESULTS_DIR = RUN_TPU_DIR + "/workload-results"
 STATUS_FILES = {
     "libtpu": "libtpu-ready",
     "pjrt": "pjrt-ready",

@@ -87,6 +87,45 @@ def _multislice_min_gbps() -> float:
     return 0.0
 
 
+def _measured_from_results(results: Optional[dict]) -> dict:
+    """Map the workload drop-box (status.read_workload_results — either a
+    run_validation {'checks': {...}} or a distributed {'distributed': {...}}
+    shape) to the jax-payload keys the node-status exporter serves
+    (metrics.NodeMetrics.PERF_KEYS).  Best-effort: absent file or keys
+    contribute nothing."""
+    out: dict = {}
+    if not isinstance(results, dict):
+        return out
+    checks = results.get("checks") or {}
+    dist = results.get("distributed") or {}
+    allreduce = checks.get("allreduce") or dist.get("allreduce") or {}
+    ring = checks.get("ring") or dist.get("ring") or {}
+    matmul = checks.get("matmul") or {}
+
+    def _num(value):
+        return (
+            value
+            if isinstance(value, (int, float)) and not isinstance(value, bool)
+            else None
+        )
+
+    algbw = _num(allreduce.get("algbw_gbps"))
+    if algbw is None:
+        # explicit None check, not `or`: a measured 0.0 is the most
+        # alert-worthy value and must survive into the payload
+        algbw = _num(allreduce.get("busbw_gbps"))
+    for key, value in (
+        ("algbw_gbps", algbw),
+        ("allreduce_min_gbps", _num(allreduce.get("min_gbps"))),
+        ("ring_link_gbps", _num(ring.get("link_gbps"))),
+        ("matmul_tflops", _num(matmul.get("tflops"))),
+        ("mfu", _num(matmul.get("mfu"))),
+    ):
+        if value is not None:
+            out[key] = value
+    return out
+
+
 def _worker_id_of(node: dict) -> int:
     """The node's slice worker id; raises ValidationError on a malformed or
     missing label (silently collapsing to 0 would collide with the real
@@ -256,19 +295,25 @@ class Validator:
 
                 node = await self.client().get("", "Node", self.config.node_name)
                 min_gbps = _allreduce_min_gbps(nodeinfo.attributes(node).generation)
-            # multi-chip: add the ring per-link diagnostic (single chip has
-            # no ring; the check would just skip itself)
-            checks = "vector-add,allreduce,burn-in" + (",ring" if chips > 1 else "")
+            # matmul (quick MFU probe, ~0.1s of chip time) keeps the
+            # compute-degradation alert live on workload-pod nodes; ring
+            # (per-link diagnostic) only on multi-chip — a single chip has
+            # no ring and the check would just skip itself
+            checks = "vector-add,allreduce,burn-in,matmul" + (
+                ",ring" if chips > 1 else ""
+            )
             await self.spawn_workload(
                 "tpu-jax-workload-validation",
                 checks=checks,
                 tpu_request=chips,
                 min_gbps=min_gbps,
             )
-            status.write_ready(
-                "jax",
-                {"mode": "workload-pod", "chips": chips, "allreduce_min_gbps": min_gbps},
-            )
+            payload = {
+                "mode": "workload-pod", "chips": chips,
+                "allreduce_min_gbps": min_gbps,
+            }
+            payload.update(_measured_from_results(status.read_workload_results()))
+            status.write_ready("jax", payload)
             return
 
         def run_checks() -> dict:
@@ -539,6 +584,17 @@ class Validator:
                 k: ms_payload[k]
                 for k in ("group", "workers", "worker_id", "epoch", "proven_by")
             }
+            # the cross-slice pod's DCN figures, from their own scope
+            payload["multislice"].update(
+                _measured_from_results(
+                    status.read_workload_results(scope="multislice")
+                )
+            )
+        # THIS host's slice pod dropped its ICI figures into the node-local
+        # drop-box it mounts — surface them (exporter → alerts); on the
+        # tombstone path the drop-box holds the last run's figures, which is
+        # exactly the gauge family's "last measured" semantics
+        payload.update(_measured_from_results(status.read_workload_results()))
         status.write_ready("jax", payload)
 
     async def _validate_group_rendezvous(
@@ -752,6 +808,12 @@ class Validator:
                 {"name": "NUM_PROCESSES", "value": str(len(members))},
                 {"name": "PROCESS_ID", "value": str(wid)},
             ]
+            if not gate_ici:
+                # cross-slice results land in their own drop-box scope so
+                # DCN figures never overwrite the slice's ICI figures
+                container["env"].append(
+                    {"name": "RESULTS_SCOPE", "value": "multislice"}
+                )
             try:
                 await client.create(pod)
             except ApiError as e:
@@ -882,10 +944,19 @@ class Validator:
                             "requests": {consts.TPU_RESOURCE: str(tpu_request)},
                         },
                         "volumeMounts": [
+                            # exactly two narrow identity mounts: the cache
+                            # and the measured-results drop-box — NOT the
+                            # validations ready markers or the worker-id/
+                            # slice-config handoff files a misbehaving
+                            # workload could forge or corrupt
                             {
                                 "name": "compile-cache",
                                 "mountPath": COMPILE_CACHE_HOST_PATH,
-                            }
+                            },
+                            {
+                                "name": "workload-results",
+                                "mountPath": consts.WORKLOAD_RESULTS_DIR,
+                            },
                         ],
                     }
                 ],
@@ -896,7 +967,14 @@ class Validator:
                             "path": COMPILE_CACHE_HOST_PATH,
                             "type": "DirectoryOrCreate",
                         },
-                    }
+                    },
+                    {
+                        "name": "workload-results",
+                        "hostPath": {
+                            "path": consts.WORKLOAD_RESULTS_DIR,
+                            "type": "DirectoryOrCreate",
+                        },
+                    },
                 ],
             },
         }

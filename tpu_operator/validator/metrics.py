@@ -75,6 +75,9 @@ class NodeMetrics:
         ms = payload.get("multislice")
         if isinstance(ms, dict):
             _set("multislice_workers", ms.get("workers"))
+            # DCN figures under their own names — never conflated with ICI
+            _set("multislice_allreduce_gbps", ms.get("algbw_gbps"))
+            _set("multislice_ring_link_gbps", ms.get("ring_link_gbps"))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

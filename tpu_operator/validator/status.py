@@ -101,3 +101,36 @@ def write_marker(name: str) -> str:
 
 def marker_exists(name: str) -> bool:
     return os.path.exists(os.path.join(validation_dir(), name))
+
+
+def workload_results_path(scope: str = "") -> str:
+    """Node-local drop-box for the measured numbers of the LAST validation
+    workload run on this host (workload pods mount exactly this subdir, so
+    the validator — and through it the node-status exporter — can surface
+    busbw/MFU/ring figures the pod measured; pod logs would need an extra
+    API round trip and log-parsing).  ``scope`` separates rendezvous kinds:
+    the cross-slice (DCN) run must not overwrite the slice's ICI figures."""
+    root = os.path.dirname(validation_dir())
+    suffix = f"-{scope}" if scope else ""
+    return os.path.join(root, "workload-results", f"results{suffix}.json")
+
+
+def write_workload_results(results: dict, scope: str = "") -> None:
+    """Best-effort: measurement evidence must never fail a validation."""
+    try:
+        path = workload_results_path(scope)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), **results}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def read_workload_results(scope: str = "") -> Optional[dict]:
+    try:
+        with open(workload_results_path(scope)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -270,6 +270,14 @@ def main() -> int:
         print(json.dumps({"ok": False, "process_id": process_id, "error": str(e)}), flush=True)
         return 1
     print(json.dumps(result), flush=True)
+    # node-local drop-box for the validator → node-status exporter → alerts;
+    # RESULTS_SCOPE (injected for the cross-slice pods) keeps DCN figures
+    # from overwriting the slice's ICI figures
+    from tpu_operator.validator import status as vstatus
+
+    vstatus.write_workload_results(
+        {"distributed": result}, scope=os.environ.get("RESULTS_SCOPE", "")
+    )
     return 0 if result["ok"] else 1
 
 

@@ -39,6 +39,7 @@ def main() -> int:
         if c.strip()
     ]
     ok = True
+    results: dict[str, dict] = {}
     for check in checks:
         if check == "vector-add":
             result = collectives.vector_add()
@@ -80,7 +81,15 @@ def main() -> int:
         else:
             result = {"ok": False, "error": f"unknown check {check}"}
         print(json.dumps({"check": check, **result}), flush=True)
+        results[check] = result
         ok = ok and bool(result.get("ok"))
+
+    # node-local drop-box: the validator (mounting the same /run/tpu) merges
+    # the measured numbers into the jax payload → node-status exporter →
+    # the perf-degradation alerts; best-effort, never a gate
+    from tpu_operator.validator import status as vstatus
+
+    vstatus.write_workload_results({"checks": results})
     return 0 if ok else 1
 
 
