@@ -6,3 +6,18 @@ TPU replacements are real XLA programs: a pallas vector-add for single-chip
 sanity, a psum allreduce over ICI with achieved-bandwidth reporting, and a
 sharded burn-in step exercising the MXU + collectives across a device mesh.
 """
+
+import os
+
+
+def honor_cpu_platform_request() -> None:
+    """Apply a caller's JAX_PLATFORMS=cpu request decisively.
+
+    A TPU-plugin sitecustomize may rewrite the env var at interpreter start
+    (before any entry point runs); the pre-backend-init config update wins
+    regardless.  Must be called before the first backend use.  One home for
+    the guard every workload entry point needs."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -47,10 +47,9 @@ def run_worker(
     run the burn-in.  Returns a result dict with ``ok``."""
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        # a TPU-plugin sitecustomize may have rewritten the env at
-        # interpreter start; the pre-backend-init config update is decisive
-        jax.config.update("jax_platforms", "cpu")
+    from tpu_operator import workloads
+
+    workloads.honor_cpu_platform_request()
 
     if num_processes > 1:
         jax.distributed.initialize(

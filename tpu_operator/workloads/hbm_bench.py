@@ -100,8 +100,9 @@ def apply_hbm_gate(result: dict, min_gbps: float) -> dict:
 def main() -> int:
     from tpu_operator.workloads import compile_cache
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        jax.config.update("jax_platforms", "cpu")
+    from tpu_operator import workloads
+
+    workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = hbm_benchmark(
         size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),

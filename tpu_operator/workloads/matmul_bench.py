@@ -234,10 +234,9 @@ def main() -> int:
 
     from tpu_operator.workloads import compile_cache
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        # a TPU-plugin sitecustomize may have rewritten the env at
-        # interpreter start; the pre-backend-init config update is decisive
-        jax.config.update("jax_platforms", "cpu")
+    from tpu_operator import workloads
+
+    workloads.honor_cpu_platform_request()
     compile_cache.enable()  # skips recompiles only; execution timing unaffected
 
     sizes = tuple(

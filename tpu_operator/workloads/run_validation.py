@@ -23,14 +23,10 @@ import sys
 
 
 def main() -> int:
+    from tpu_operator import workloads
     from tpu_operator.workloads import collectives, compile_cache
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        # a TPU-plugin sitecustomize may have rewritten the env at
-        # interpreter start; the pre-backend-init config update is decisive
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    workloads.honor_cpu_platform_request()
     compile_cache.enable()
 
     checks = [
