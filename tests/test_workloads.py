@@ -135,6 +135,66 @@ def test_run_validation_ring_check(monkeypatch, capsys):
     assert result["max_error"] == 0.0
 
 
+def test_timing_subtract_floor():
+    """The shared floor-subtraction rule all three benchmarks depend on."""
+    from tpu_operator.workloads import timing
+
+    # clean case: floor well under raw → subtracted, per-unit, sorted
+    times, dominated = timing.subtract_floor([1.1, 1.3, 1.2], 0.1, per=10)
+    assert not dominated
+    assert times == pytest.approx([0.1, 0.11, 0.12])
+
+    # floor > half the fastest raw → flagged, fall back to raw amortized
+    times, dominated = timing.subtract_floor([0.15, 0.2], 0.1, per=1)
+    assert dominated
+    assert times == pytest.approx([0.15, 0.2])
+
+    # over-subtraction (floor noise above a raw sample) → flagged too
+    times, dominated = timing.subtract_floor([0.05, 0.3], 0.06, per=1)
+    assert dominated
+
+
+def test_timing_apply_min_gate(monkeypatch):
+    """The one shared gate rule (allreduce/ring/hbm wrappers delegate)."""
+    from tpu_operator.workloads import timing
+
+    monkeypatch.delenv("X_GATE", raising=False)  # hermetic: default=tpu
+    base = {"ok": True, "gbps": 5.0, "backend": "tpu",
+            "overhead_dominated": False, "transport": "ici"}
+
+    r = timing.apply_min_gate(dict(base), metric="gbps", minimum=10.0,
+                              backends_env="X_GATE", label="x")
+    assert not r["ok"] and r["gated"] and "below required" in r["error"]
+
+    # minimum 0 → report-only
+    r = timing.apply_min_gate(dict(base), metric="gbps", minimum=0.0,
+                              backends_env="X_GATE", label="x")
+    assert r["ok"] and not r["gated"]
+
+    # wrong backend → skipped
+    r = timing.apply_min_gate(dict(base, backend="cpu"), metric="gbps",
+                              minimum=10.0, backends_env="X_GATE", label="x")
+    assert r["ok"] and not r["gated"]
+
+    # overhead-dominated → never gated in either direction
+    r = timing.apply_min_gate(dict(base, overhead_dominated=True),
+                              metric="gbps", minimum=10.0,
+                              backends_env="X_GATE", label="x")
+    assert r["ok"] and not r["gated"]
+
+    # require_ici blocks hbm-local transport
+    r = timing.apply_min_gate(dict(base, transport="hbm-local"),
+                              metric="gbps", minimum=10.0,
+                              backends_env="X_GATE", label="x",
+                              require_ici=True)
+    assert r["ok"] and not r["gated"]
+
+    # a measured 0.0 still gates (falsy values must not slip through)
+    r = timing.apply_min_gate(dict(base, gbps=0.0), metric="gbps",
+                              minimum=10.0, backends_env="X_GATE", label="x")
+    assert not r["ok"]
+
+
 def test_hbm_benchmark_cpu():
     """The streaming benchmark runs on any backend; peak/fraction appear
     only for a known generation (CPU → unknown → report-only)."""
