@@ -12,6 +12,47 @@ def test_platform_is_virtual_cpu_mesh():
     assert len(jax.devices()) == 8
 
 
+def test_device_count_check(monkeypatch):
+    """PJRT-visible devices vs the promised chip count — the r03 hole where
+    a node advertising 4 chips passed validation with 1 visible device."""
+    # default gate covers tpu only: the cpu mismatch reports but passes
+    r = collectives.device_count_check(4)
+    assert r["ok"] and not r["gated"] and r["visible"] == 8
+
+    monkeypatch.setenv("DEVICE_COUNT_GATE_BACKENDS", "cpu,tpu")
+    r = collectives.device_count_check(8)
+    assert r["ok"] and r["gated"]
+    r = collectives.device_count_check(4)
+    assert not r["ok"]
+    assert "8 local" in r["error"] and "4 local" in r["error"]
+    # multi-controller arithmetic: 2 hosts x 8 chips needs 16 global
+    r = collectives.device_count_check(8, num_processes=2)
+    assert not r["ok"] and r["expected_global"] == 16
+
+
+def test_run_validation_device_count_short_circuits(validation_root, monkeypatch, capsys):
+    import json
+
+    from tpu_operator.validator import status as vstatus
+    from tpu_operator.workloads import run_validation
+
+    monkeypatch.setenv("WORKLOAD_CHECKS", "vector-add")
+    monkeypatch.setenv("DEVICE_COUNT_GATE_BACKENDS", "cpu,tpu")
+    monkeypatch.setenv("EXPECTED_DEVICES", "8")
+    assert run_validation.main() == 0  # matching count: checks proceed
+
+    monkeypatch.setenv("EXPECTED_DEVICES", "4")
+    assert run_validation.main() == 1
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    # the failing run emitted ONLY the devices line — remaining checks are
+    # skipped so the count mismatch isn't buried under wrong-topology numbers
+    failing = json.loads(lines[-1])
+    assert failing["check"] == "devices" and not failing["ok"]
+    # the drop-box carries the evidence for the validator payload
+    results = vstatus.read_workload_results()
+    assert results["checks"]["devices"]["expected"] == 4
+
+
 def test_vector_add():
     result = collectives.vector_add(1 << 14)
     assert result["ok"]
@@ -278,13 +319,20 @@ def test_distributed_four_process_rendezvous():
     from tpu_operator.workloads.distributed import spawn_local_workers
 
     results = spawn_local_workers(
-        4, 2, steps=2, extra_env={"ALLREDUCE_SIZE_MB": "1"}
+        4, 2, steps=2, extra_env={
+            "ALLREDUCE_SIZE_MB": "1",
+            # device-count truth over the rendezvous: 2 local, 4x2 global
+            "EXPECTED_DEVICES": "2",
+            "DEVICE_COUNT_GATE_BACKENDS": "cpu,tpu",
+        }
     )
     for result in results:
         assert result["ok"]
         assert result["num_processes"] == 4
         assert result["mesh"] == {"dp": 2, "mp": 4}
         assert result["psum"]["ok"]
+        assert result["devices_check"]["gated"]
+        assert result["devices_check"]["visible_global"] == 8
 
 
 def test_allreduce_min_bandwidth_gate(monkeypatch):

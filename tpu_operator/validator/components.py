@@ -235,7 +235,11 @@ class Validator:
         )
 
     async def validate_pjrt(self) -> None:
-        """PJRT client init — the nvidia-smi analogue."""
+        """PJRT client init — the nvidia-smi analogue.  Beyond "a client
+        initializes", the device COUNT must match the host's chip truth
+        (libtpu-ready's /dev/accel* count): libtpu excludes dead chips at
+        init, so 4 device nodes with 1 PJRT device is a half-dead host that
+        must fail validation here, not pass on the survivors."""
         await self.wait_ready("libtpu", retries=self.config.resource_retries)
 
         def probe() -> dict:
@@ -251,6 +255,20 @@ class Validator:
             }
 
         payload = await asyncio.get_event_loop().run_in_executor(None, probe)
+        from tpu_operator.workloads.timing import gate_backends
+
+        chips = (status.read_status("libtpu") or {}).get("chips")
+        if (
+            self.config.platform in gate_backends("DEVICE_COUNT_GATE_BACKENDS")
+            and isinstance(chips, int)
+            and chips > 0
+            and payload["device_count"] != chips
+        ):
+            raise ValidationError(
+                f"PJRT initialized {payload['device_count']} devices but the "
+                f"host has {chips} chip device nodes — dead or missing chips"
+            )
+        payload["host_chips"] = chips
         status.write_ready("pjrt", payload)
 
     async def validate_plugin(self) -> None:
@@ -934,6 +952,10 @@ class Validator:
                         "env": [
                             {"name": "WORKLOAD_CHECKS", "value": checks},
                             {"name": "ALLREDUCE_MIN_GBPS", "value": str(min_gbps)},
+                            # device-count truth: the pod requested this many
+                            # chips; PJRT inside it must initialize exactly
+                            # that many (collectives.device_count_check)
+                            {"name": "EXPECTED_DEVICES", "value": str(tpu_request)},
                             # node-local persistent XLA cache: re-validations
                             # (preStop re-gating, upgrade re-proof) skip the
                             # ~2s/program recompiles (workloads/compile_cache.py)

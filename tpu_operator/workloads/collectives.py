@@ -43,6 +43,51 @@ def _vary(v, axis: str = "x"):
 
 
 # ---------------------------------------------------------------------------
+# device-count truth
+
+
+def device_count_check(expected_local: int, num_processes: int = 1) -> dict:
+    """Assert the devices PJRT actually initialized match what the node (or
+    the pod's resource request) promised.
+
+    The reference's plugin validation counts the ADVERTISED resource
+    (validator/main.go:1115-1135) and its CUDA workload then consumes one
+    GPU — but nothing in that chain notices a runtime that silently
+    initializes fewer devices than the node advertises.  On TPU that failure
+    is real: libtpu can come up with dead chips excluded, PJRT reports the
+    survivors, and every downstream collective quietly runs on the wrong
+    mesh.  This check is the missing equality: visible-local must equal the
+    promised chip count, and (multi-controller) the global count must equal
+    processes x per-host chips.
+
+    Enforced only on backends named in ``DEVICE_COUNT_GATE_BACKENDS``
+    (default tpu — the virtual CPU device count is a test-harness knob, not
+    hardware truth); unenforced runs still report the counts."""
+    visible_local = jax.local_device_count()
+    visible_global = jax.device_count()
+    expected_global = expected_local * max(1, num_processes)
+    backend = jax.default_backend()
+    gated = backend in timing.gate_backends("DEVICE_COUNT_GATE_BACKENDS")
+    matches = visible_local == expected_local and visible_global == expected_global
+    result = {
+        "ok": matches or not gated,
+        "visible": visible_local,
+        "expected": expected_local,
+        "visible_global": visible_global,
+        "expected_global": expected_global,
+        "gated": gated,
+        "backend": backend,
+    }
+    if not matches:
+        result["error"] = (
+            f"PJRT initialized {visible_local} local / {visible_global} global "
+            f"devices but the node advertises {expected_local} local / "
+            f"{expected_global} global — dead or missing chips"
+        )
+    return result
+
+
+# ---------------------------------------------------------------------------
 # vector add (pallas)
 
 

@@ -64,6 +64,31 @@ def run_worker(
     devices = jax.devices()  # GLOBAL across all processes
     local = jax.local_device_count()
 
+    # -- device-count truth: the validator promised chips-per-host via
+    # EXPECTED_DEVICES; the runtime must have initialized exactly that many
+    # locally AND processes x that many globally — a host with dead chips
+    # (or a rendezvous that silently lost a member's devices) fails here
+    # with the counts instead of psum-ing over the wrong mesh
+    from tpu_operator.workloads import collectives
+
+    expected_env = os.environ.get("EXPECTED_DEVICES", "")
+    devcheck = (
+        collectives.device_count_check(int(expected_env), num_processes)
+        if expected_env
+        else None
+    )
+    if devcheck is not None and not devcheck["ok"]:
+        return {
+            "ok": False,
+            "process_id": process_id,
+            "num_processes": num_processes,
+            "global_devices": len(devices),
+            "local_devices": local,
+            "devices_check": devcheck,
+            "error": devcheck.get("error", "device count mismatch"),
+            "backend": jax.default_backend(),
+        }
+
     # -- global psum proof: every process contributes (id+1) per chip; the
     # expected total is only reachable if every link carried its share
     mesh1d = Mesh(np.array(devices), ("x",))
@@ -87,8 +112,6 @@ def run_worker(
     # validator from the accelerator catalogue; the gate applies only on
     # backends named in ALLREDUCE_GATE_BACKENDS (default tpu — CPU/gloo
     # rates say nothing about ICI health)
-    from tpu_operator.workloads import collectives
-
     bench = collectives.allreduce_benchmark(
         size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "16")),
         iters=5,
@@ -172,6 +195,7 @@ def run_worker(
         "global_devices": len(devices),
         "local_devices": local,
         "mesh": {"dp": dp, "mp": mp},
+        "devices_check": devcheck,
         "psum": {"total": total, "expected": expected, "ok": psum_ok},
         "allreduce": {
             k: bench.get(k)

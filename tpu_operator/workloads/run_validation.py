@@ -36,6 +36,28 @@ def main() -> int:
     ]
     ok = True
     results: dict[str, dict] = {}
+
+    # device-count truth FIRST: when the validator promised a chip count
+    # (EXPECTED_DEVICES, from the node's advertised google.com/tpu), PJRT
+    # must have initialized exactly that many devices — a node with dead
+    # chips must fail here with the counts, not pass every check on the
+    # surviving subset (BENCH_r03: 4 advertised, 1 visible, validation green)
+    expected = os.environ.get("EXPECTED_DEVICES", "")
+    if expected:
+        try:
+            result = collectives.device_count_check(int(expected))
+        except ValueError:
+            # a malformed env must surface as a check result (and the
+            # drop-box write below), not a traceback with no evidence
+            result = {"ok": False, "error": f"malformed EXPECTED_DEVICES={expected!r}"}
+        print(json.dumps({"check": "devices", **result}), flush=True)
+        results["devices"] = result
+        if not result["ok"]:
+            # the remaining checks would measure the wrong topology and
+            # bury the real failure under misleading numbers
+            checks = []
+            ok = False
+
     for check in checks:
         if check == "vector-add":
             result = collectives.vector_add()

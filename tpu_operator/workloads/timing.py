@@ -15,6 +15,13 @@ import os
 import time
 
 
+def gate_backends(env_var: str, default: str = "tpu") -> list[str]:
+    """Backends a gate is enforced on (one parsing rule for every gate):
+    CPU/gloo numbers say nothing about chip health, so gates default to the
+    tpu backend only; tests widen via the env var."""
+    return [b.strip() for b in os.environ.get(env_var, default).split(",")]
+
+
 def timed(fn) -> float:
     """Wall-clock one call; ``fn`` must synchronize internally (e.g. a
     float() readback)."""
@@ -63,7 +70,7 @@ def apply_min_gate(
 
     Mutates ``result``: records the minimum under ``min_key`` and whether
     the gate was actually ``gated`` (enforced), and flips ``ok`` on a miss."""
-    backends = [b.strip() for b in os.environ.get(backends_env, "tpu").split(",")]
+    backends = gate_backends(backends_env)
     enforced = (
         minimum > 0
         and (not require_ici or result.get("transport") == "ici")
