@@ -149,6 +149,8 @@ STATUS_FILES = {
     "pjrt": "pjrt-ready",
     "plugin": "plugin-ready",
     "jax": "jax-ready",
+    # post-ready perf probes (report-only: readiness never gates on perf)
+    "perf": "perf-ready",
     "runtime-prep": "runtime-prep-ready",
 }
 

@@ -30,6 +30,16 @@ class AcceleratorInfo:
     ici_gbps: float          # per-chip aggregate ICI bandwidth, GB/s
                              # (GKE per-chip interconnect spec / 8)
     hbm_gbps: float = 0.0    # per-chip HBM bandwidth, GB/s (published spec)
+    ici_links: int = 4       # ICI links per chip (torus degree: 2D=4, 3D=6);
+                             # per-LINK bandwidth = ici_gbps / ici_links
+
+    @property
+    def ici_link_gbps(self) -> float:
+        """Per-link ICI bandwidth — the ring diagnostic's denominator.  The
+        aggregate number divided by the torus degree: a single healthy link
+        carries aggregate/links, so per-link floors must derive from THIS,
+        never from the multi-link aggregate."""
+        return self.ici_gbps / max(1, self.ici_links)
 
 
 # Per-generation perf envelope: peak TFLOPs are the published per-chip dense
@@ -41,12 +51,15 @@ class AcceleratorInfo:
 # These drive the MFU denominator (workloads/matmul_bench.py) and the
 # allreduce bandwidth gate (validator components.py).
 ACCELERATORS: dict[str, AcceleratorInfo] = {
-    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0, 1228.0),
-    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0, 819.0),
-    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0, 819.0),
-    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0, 2765.0),
-    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0, 1640.0),
-    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0, 1640.0),
+    # ici_links: torus degree per chip — v4/v5p are 3D tori (6 links),
+    # v5e/v6e are 2D (4 links); per-link bw = aggregate / links (v4
+    # 300/6=50, v5e 200/4=50, v5p 600/6=100, v6e 448/4=112 GB/s)
+    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0, 1228.0, 6),
+    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0, 819.0, 4),
+    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0, 819.0, 4),
+    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0, 2765.0, 6),
+    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0, 1640.0, 4),
+    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0, 1640.0, 4),
 }
 
 UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4, 0.0, 0.0, 0.0)

@@ -25,7 +25,7 @@ from tpu_operator.validator.components import ValidationError, Validator, Valida
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("tpu-validator")
     p.add_argument("--component", "-c", default="",
-                   help="libtpu|pjrt|plugin|jax|vfio-pci|metrics (or any name with --wait-only)")
+                   help="libtpu|pjrt|plugin|jax|perf|vfio-pci|metrics (or any name with --wait-only)")
     p.add_argument("--node-name", "-n", default=None)
     p.add_argument("--namespace", default=None)
     p.add_argument("--wait-only", action="store_true",

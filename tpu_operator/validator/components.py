@@ -53,6 +53,30 @@ VALIDATED_EPOCH_ANNOTATION = "tpu.google.com/validated-epoch"
 # ring's steady-state rate) fails the slice instead of passing at any speed.
 ALLREDUCE_GATE_FRACTION = 0.25
 
+# Fraction of the generation's per-LINK ICI bandwidth the ring diagnostic's
+# slowest hop must reach.  Deliberately derived from ici_link_gbps
+# (aggregate / torus degree), NEVER the aggregate: a single healthy link
+# runs at aggregate/links, which can sit at or below the multi-link
+# allreduce floor (ADVICE r03 — the old alert compared per-link rates to
+# the aggregate-derived floor and would fire chronically on v4).
+RING_GATE_FRACTION = 0.25
+
+
+def _ring_min_gbps(generation: str) -> float:
+    """The per-link ring floor for this chip generation.  An explicit
+    RING_MIN_GBPS env (operator-injected override) wins — including an
+    explicit 0, which keeps it report-only; otherwise the catalogue's
+    per-link bandwidth sets the expectation."""
+    env = os.environ.get("RING_MIN_GBPS", "")
+    if env != "":
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            log.warning("ignoring malformed RING_MIN_GBPS=%r", env)
+    from tpu_operator.k8s.nodeinfo import generation_info
+
+    return round(generation_info(generation).ici_link_gbps * RING_GATE_FRACTION, 1)
+
 
 def _allreduce_min_gbps(generation: str) -> float:
     """The armed ICI gate for this chip generation.  An explicit
@@ -92,7 +116,15 @@ def _measured_from_results(results: Optional[dict]) -> dict:
     run_validation {'checks': {...}} or a distributed {'distributed': {...}}
     shape) to the jax-payload keys the node-status exporter serves
     (metrics.NodeMetrics.PERF_KEYS).  Best-effort: absent file or keys
-    contribute nothing."""
+    contribute nothing.
+
+    MEASUREMENTS from overhead-dominated runs are dropped: the shared
+    timing rule (workloads/timing.py) says a flagged number can't be
+    trusted in either direction, and these values feed the
+    TPUNodeComputeDegraded / TPUNodeInterconnectDegraded alerts — r03's
+    own BENCH showed a healthy chip at a flagged 0.37 "MFU" that would
+    have paged the operator.  Gate FLOORS (min_gbps) are configuration,
+    not measurements, and always pass through."""
     out: dict = {}
     if not isinstance(results, dict):
         return out
@@ -101,6 +133,7 @@ def _measured_from_results(results: Optional[dict]) -> dict:
     allreduce = checks.get("allreduce") or dist.get("allreduce") or {}
     ring = checks.get("ring") or dist.get("ring") or {}
     matmul = checks.get("matmul") or {}
+    hbm = checks.get("hbm") or {}
 
     def _num(value):
         return (
@@ -109,17 +142,23 @@ def _measured_from_results(results: Optional[dict]) -> dict:
             else None
         )
 
-    algbw = _num(allreduce.get("algbw_gbps"))
-    if algbw is None:
+    def _measured(source: dict, key: str):
+        return None if source.get("overhead_dominated") else _num(source.get(key))
+
+    algbw = _measured(allreduce, "algbw_gbps")
+    if algbw is None and not allreduce.get("overhead_dominated"):
         # explicit None check, not `or`: a measured 0.0 is the most
         # alert-worthy value and must survive into the payload
         algbw = _num(allreduce.get("busbw_gbps"))
     for key, value in (
         ("algbw_gbps", algbw),
         ("allreduce_min_gbps", _num(allreduce.get("min_gbps"))),
-        ("ring_link_gbps", _num(ring.get("link_gbps"))),
-        ("matmul_tflops", _num(matmul.get("tflops"))),
-        ("mfu", _num(matmul.get("mfu"))),
+        ("ring_link_gbps", _measured(ring, "link_gbps")),
+        ("ring_min_gbps", _num(ring.get("min_gbps"))),
+        ("matmul_tflops", _measured(matmul, "tflops")),
+        ("mfu", _measured(matmul, "mfu")),
+        ("hbm_gbps", _measured(hbm, "gbps")),
+        ("hbm_fraction_of_peak", _measured(hbm, "fraction_of_peak")),
     ):
         if value is not None:
             out[key] = value
@@ -171,7 +210,7 @@ class ValidationError(Exception):
 
 
 class Validator:
-    COMPONENTS = ("libtpu", "pjrt", "plugin", "jax", "vfio-pci")
+    COMPONENTS = ("libtpu", "pjrt", "plugin", "jax", "perf", "vfio-pci")
 
     def __init__(self, config: Optional[ValidatorConfig] = None, client: Optional[ApiClient] = None):
         self.config = config or ValidatorConfig()
@@ -192,6 +231,7 @@ class Validator:
             "pjrt": self.validate_pjrt,
             "plugin": self.validate_plugin,
             "jax": self.validate_jax,
+            "perf": self.validate_perf,
             "vfio-pci": self.validate_vfio,
         }.get(component)
         if handler is None:
@@ -313,13 +353,15 @@ class Validator:
 
                 node = await self.client().get("", "Node", self.config.node_name)
                 min_gbps = _allreduce_min_gbps(nodeinfo.attributes(node).generation)
-            # matmul (quick MFU probe, ~0.1s of chip time) keeps the
-            # compute-degradation alert live on workload-pod nodes; ring
-            # (per-link diagnostic) only on multi-chip — a single chip has
-            # no ring and the check would just skip itself
-            checks = "vector-add,allreduce,burn-in,matmul" + (
-                ",ring" if chips > 1 else ""
-            )
+            # the readiness gate is the MINIMAL workload only (reference
+            # bar: validator/main.go:1189-1302 gates on vectorAdd, not a
+            # benchmark suite) — matmul/hbm/ring perf probes run POST-ready
+            # via the perf component; putting them here cost r03 a 37%
+            # join-to-validated regression.  burn-in gates only where it is
+            # a real slice-acceptance test (multi-chip collectives); on a
+            # single chip it is an MXU exercise that belongs with the
+            # post-ready probes, not on the critical path
+            checks = "vector-add,allreduce" + (",burn-in" if chips > 1 else "")
             await self.spawn_workload(
                 "tpu-jax-workload-validation",
                 checks=checks,
@@ -335,14 +377,15 @@ class Validator:
             return
 
         def run_checks() -> dict:
-            from tpu_operator.workloads import collectives, compile_cache, matmul_bench
+            from tpu_operator.workloads import collectives, compile_cache
 
             compile_cache.enable()
+            # minimal gate only — matmul/hbm/ring run post-ready via the
+            # perf component, same split as the workload-pod path
             results = {
                 "vector-add": collectives.vector_add(1 << 16),
                 "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
-                "ring": collectives.ring_benchmark(size_mb=2, iters=2, best_of=2),
-                "matmul": matmul_bench.quick_benchmark(),
+                "burn-in": collectives.burn_in(steps=2),
             }
             for name, r in results.items():
                 if not r.get("ok"):
@@ -351,13 +394,109 @@ class Validator:
                 "mode": "in-process",
                 "devices": results["allreduce"]["devices"],
                 "algbw_gbps": results["allreduce"]["algbw_gbps"],
-                "ring_link_gbps": results["ring"].get("link_gbps"),
-                "matmul_tflops": results["matmul"]["tflops"],
-                "mfu": results["matmul"]["mfu"],
             }
 
         payload = await asyncio.get_event_loop().run_in_executor(None, run_checks)
         status.write_ready("jax", payload)
+
+    async def validate_perf(self) -> None:
+        """Post-ready perf probes: matmul MFU, HBM streaming, and (on
+        multi-chip hosts) the per-link ring diagnostic — the measured
+        evidence behind the TPUNodeComputeDegraded /
+        TPUNodeInterconnectDegraded alerts.
+
+        Runs strictly AFTER jax-ready: readiness gates on the minimal
+        workload only (reference bar: the CUDA workload of
+        validator/main.go:1189-1302, not a benchmark suite), and the
+        probes' chip time must never sit on the join→validated critical
+        path — r03 put matmul there and regressed the headline 37%.
+        Probe failures are recorded in perf-ready (ok=false + error), not
+        raised: a slow chip is the alerts' business, not a reason to mark
+        the node unvalidated.  Workload-pod results land in their own
+        drop-box scope so they never clobber the gating run's figures."""
+        await self.wait_ready("jax", retries=self.config.resource_retries)
+        if self.config.with_workload:
+            from tpu_operator.k8s import nodeinfo
+
+            chips = await self._node_chip_count()
+            node = await self.client().get("", "Node", self.config.node_name)
+            generation = nodeinfo.attributes(node).generation
+            ring_min = _ring_min_gbps(generation) if chips > 1 else 0.0
+            # multi-chip: ring per-link diagnostic; single chip: the burn-in
+            # train-step moves here from the gate (still proven, just not on
+            # the readiness critical path)
+            checks = "matmul,hbm" + (",ring" if chips > 1 else ",burn-in")
+            # clear the previous run's drop-box FIRST: a failed probe run
+            # must surface as "no current measurements", never republish
+            # last round's healthy figures to the degradation alerts
+            status.clear_workload_results(scope="perf")
+            ok, error = True, None
+            try:
+                await self.spawn_workload(
+                    "tpu-perf-probes",
+                    checks=checks,
+                    tpu_request=chips,
+                    ring_min_gbps=ring_min,
+                    results_scope="perf",
+                )
+            except ValidationError as e:
+                ok, error = False, str(e)
+                # best-effort: a pod left Pending/Running would later grab
+                # the chips it never got and collide with user workloads
+                # (post-ready, the node is schedulable — probes are
+                # opportunistic and re-run on the next validation round)
+                await self.client().delete(
+                    "", "Pod", "tpu-perf-probes", self.config.namespace
+                )
+            dropbox = status.read_workload_results(scope="perf") or {}
+            results = dropbox.get("checks") or {}
+            measured = _measured_from_results(dropbox)
+        else:
+
+            def run_probes() -> dict:
+                import jax
+
+                from tpu_operator.workloads import (
+                    collectives,
+                    compile_cache,
+                    hbm_bench,
+                    matmul_bench,
+                )
+
+                compile_cache.enable()
+                # the per-link floor must be recorded here too (the alert
+                # needs its ring_min_gbps RHS on in-process nodes as much as
+                # on workload-pod ones); generation comes from the PJRT
+                # device kind — no apiserver needed in-process
+                ring_min = (
+                    _ring_min_gbps(matmul_bench.detect_generation())
+                    if len(jax.devices()) > 1
+                    else 0.0
+                )
+                return {
+                    "matmul": matmul_bench.quick_benchmark(),
+                    "hbm": hbm_bench.quick_benchmark(),
+                    "ring": collectives.apply_ring_gate(
+                        collectives.ring_benchmark(size_mb=2, iters=2, best_of=2),
+                        ring_min,
+                    ),
+                }
+
+            results = await asyncio.get_event_loop().run_in_executor(None, run_probes)
+            ok = all(bool(r.get("ok")) for r in results.values())
+            error = None if ok else "; ".join(
+                f"{name}: {r.get('error', 'failed')}"
+                for name, r in results.items()
+                if not r.get("ok")
+            )
+            measured = _measured_from_results({"checks": results})
+        # top level: the filtered measurements the exporter serves (flagged
+        # overhead-dominated figures already dropped); "checks": the raw
+        # probe results, flags and all, as the human-debuggable evidence
+        payload = {"ok": ok, **measured, "checks": results}
+        if error:
+            payload["error"] = error
+        status.write_ready("perf", payload)
 
     # ------------------------------------------------------------------
     # Multi-host slice validation (jax.distributed-coordinated worker pods).
@@ -798,19 +937,23 @@ class Validator:
                     continue
                 await client.delete("", "Pod", name, self.config.namespace)
             if gate_ici:
-                # the armed ICI gate: the distributed program measures the
-                # global allreduce and fails the rendezvous below this busbw
+                # the armed ICI gates: the distributed program measures the
+                # global allreduce (busbw floor) and the per-link ring
+                # (per-link floor) and fails the rendezvous below either
                 min_gbps = _allreduce_min_gbps(attrs.generation)
+                ring_min = _ring_min_gbps(attrs.generation)
             else:
                 # cross-slice traffic rides DCN, not ICI — the catalogue
-                # floor does not apply; gate only on explicit request
+                # floors do not apply; gate only on explicit request
                 min_gbps = dcn_min_gbps
+                ring_min = 0.0
             pod = self._workload_pod(
                 name,
                 checks="",
                 tpu_request=max(1, attrs.chips_per_host),
                 owner=owner,
                 min_gbps=min_gbps,
+                ring_min_gbps=ring_min,
             )
             pod["metadata"]["labels"]["tpu.google.com/slice-group"] = svc
             pod["metadata"]["labels"][EPOCH_LABEL] = epoch
@@ -926,12 +1069,17 @@ class Validator:
         tpu_request: int,
         owner: Optional[dict],
         min_gbps: float = 0.0,
+        ring_min_gbps: float = 0.0,
+        results_scope: str = "",
     ) -> dict:
         """Build the workload pod (plugin-workload-validation.yaml analogue,
         validator/main.go:984-1052: node pinning, resource request, ownerRef
         + tolerations copied from the validator DaemonSet).  ``min_gbps``
-        arms the allreduce busbw gate (catalogue-derived for multi-chip
-        workloads; 0 keeps it report-only)."""
+        arms the allreduce busbw gate and ``ring_min_gbps`` the per-link
+        ring gate (catalogue-derived for multi-chip workloads; 0 keeps them
+        report-only).  ``results_scope`` namespaces the measured-results
+        drop-box (the perf probes must not clobber the gating run's
+        figures)."""
         image = self.config.workload_image or "ghcr.io/tpu-operator/tpu-validator:latest"
         pod = {
             "apiVersion": "v1",
@@ -952,6 +1100,7 @@ class Validator:
                         "env": [
                             {"name": "WORKLOAD_CHECKS", "value": checks},
                             {"name": "ALLREDUCE_MIN_GBPS", "value": str(min_gbps)},
+                            {"name": "RING_MIN_GBPS", "value": str(ring_min_gbps)},
                             # device-count truth: the pod requested this many
                             # chips; PJRT inside it must initialize exactly
                             # that many (collectives.device_count_check)
@@ -960,6 +1109,11 @@ class Validator:
                             # (preStop re-gating, upgrade re-proof) skip the
                             # ~2s/program recompiles (workloads/compile_cache.py)
                             {"name": "TPU_COMPILE_CACHE", "value": COMPILE_CACHE_HOST_PATH},
+                            *(
+                                [{"name": "RESULTS_SCOPE", "value": results_scope}]
+                                if results_scope
+                                else []
+                            ),
                         ],
                         "resources": {
                             "limits": {consts.TPU_RESOURCE: str(tpu_request)},
@@ -1010,11 +1164,20 @@ class Validator:
         return pod
 
     async def spawn_workload(
-        self, name: str, checks: str, tpu_request: int, min_gbps: float = 0.0
+        self,
+        name: str,
+        checks: str,
+        tpu_request: int,
+        min_gbps: float = 0.0,
+        ring_min_gbps: float = 0.0,
+        results_scope: str = "",
     ) -> None:
         client = self.client()
         owner = await self._owner_daemonset()
-        pod = self._workload_pod(name, checks, tpu_request, owner, min_gbps=min_gbps)
+        pod = self._workload_pod(
+            name, checks, tpu_request, owner, min_gbps=min_gbps,
+            ring_min_gbps=ring_min_gbps, results_scope=results_scope,
+        )
         await client.delete("", "Pod", name, self.config.namespace)
         await client.create(pod)
         for _ in range(self.config.workload_retries):

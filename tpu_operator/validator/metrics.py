@@ -52,6 +52,13 @@ class NodeMetrics:
         "ring_link_gbps": "ring_link_gbps",
         "workers": "slice_workers",
         "allreduce_min_gbps": "allreduce_min_gbps",
+        # the ring alert's floor: per-LINK (catalogue aggregate / link
+        # count), NEVER the multi-link allreduce busbw floor — a single
+        # link legitimately runs at aggregate/links, which can sit at or
+        # below the allreduce floor on healthy hardware (ADVICE r03)
+        "ring_min_gbps": "ring_min_gbps",
+        "hbm_gbps": "hbm_gbps",
+        "hbm_fraction_of_peak": "hbm_fraction_of_peak",
     }
 
     def scrape(self) -> None:
@@ -61,6 +68,14 @@ class NodeMetrics:
             )
         self.device_count.set(hw.chip_count())
         payload = status.read_status("jax") or {}
+        # the post-ready perf probes carry the matmul/hbm/ring figures in
+        # their own status file; merge ONLY the measurement keys over the
+        # jax payload (never its ok/error bookkeeping)
+        perf = status.read_status("perf") or {}
+        payload = {
+            **payload,
+            **{k: v for k, v in perf.items() if k in self.PERF_KEYS},
+        }
         # re-derive the whole family each scrape: a metric absent from the
         # CURRENT payload must stop being served, not linger from an older
         # validation round (serve mode scrapes repeatedly)

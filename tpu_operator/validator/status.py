@@ -116,7 +116,10 @@ def workload_results_path(scope: str = "") -> str:
 
 
 def write_workload_results(results: dict, scope: str = "") -> None:
-    """Best-effort: measurement evidence must never fail a validation."""
+    """Best-effort: measurement evidence must never fail a validation —
+    including a non-serializable value (stray numpy scalar) raising
+    TypeError, which would flip a PASSED validation pod to Failed if it
+    escaped (callers invoke this outside their check try/except)."""
     try:
         path = workload_results_path(scope)
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -124,6 +127,15 @@ def write_workload_results(results: dict, scope: str = "") -> None:
         with open(tmp, "w") as f:
             json.dump({"ts": time.time(), **results}, f)
         os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — evidence is optional, the verdict is not
+        pass
+
+
+def clear_workload_results(scope: str = "") -> None:
+    """Drop a scope's measured evidence (the perf component clears before
+    each probe run so a failed run can never republish stale figures)."""
+    try:
+        os.remove(workload_results_path(scope))
     except OSError:
         pass
 

@@ -72,11 +72,17 @@ def run_worker(
     from tpu_operator.workloads import collectives
 
     expected_env = os.environ.get("EXPECTED_DEVICES", "")
-    devcheck = (
-        collectives.device_count_check(int(expected_env), num_processes)
-        if expected_env
-        else None
-    )
+    devcheck = None
+    if expected_env:
+        try:
+            devcheck = collectives.device_count_check(int(expected_env), num_processes)
+        except ValueError:
+            # same contract as run_validation: a malformed env surfaces as
+            # a structured failure, not a traceback with no evidence
+            devcheck = {
+                "ok": False,
+                "error": f"malformed EXPECTED_DEVICES={expected_env!r}",
+            }
     if devcheck is not None and not devcheck["ok"]:
         return {
             "ok": False,

@@ -88,6 +88,15 @@ def _peak_hbm_gbps(generation: str) -> float:
     return generation_info(generation).hbm_gbps
 
 
+def quick_benchmark() -> dict:
+    """The validator's in-process perf probe: the full-size stream on TPU
+    (the number must be comparable to bench.py's); a toy buffer on other
+    backends so tests stay fast."""
+    if jax.default_backend() == "tpu":
+        return hbm_benchmark()
+    return hbm_benchmark(size_mb=8.0, iters=4, best_of=2)
+
+
 def apply_hbm_gate(result: dict, min_gbps: float) -> dict:
     """HBM_MIN_GBPS gate (shared rule: timing.apply_min_gate; no ICI
     requirement — the stream is chip-local by construction)."""

@@ -103,11 +103,15 @@ def main() -> int:
         ok = ok and bool(result.get("ok"))
 
     # node-local drop-box: the validator (mounting the same /run/tpu) merges
-    # the measured numbers into the jax payload → node-status exporter →
-    # the perf-degradation alerts; best-effort, never a gate
+    # the measured numbers into its payloads → node-status exporter → the
+    # perf-degradation alerts; best-effort, never a gate.  RESULTS_SCOPE
+    # (injected for the perf-probes pod) keeps probe figures from
+    # clobbering the gating run's
     from tpu_operator.validator import status as vstatus
 
-    vstatus.write_workload_results({"checks": results})
+    vstatus.write_workload_results(
+        {"checks": results}, scope=os.environ.get("RESULTS_SCOPE", "")
+    )
     return 0 if ok else 1
 
 
