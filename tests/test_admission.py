@@ -1,0 +1,85 @@
+"""CEL-lite admission tests.
+
+Reference bar: the real apiserver enforces the CRDs' kubebuilder-style
+constraints — enums/defaults (clusterpolicy_types.go:122-124) and CEL
+XValidation immutability (nvidiadriver_types.go:44-47).  Our generated
+CRDs carry the same markers, and api/admission.py enforces the supported
+subset in the fake apiserver so mutation tests reject exactly where
+production would.
+"""
+
+import pytest
+
+from tpu_operator.api import admission, crds
+from tpu_operator.api.types import GROUP, TPURuntime
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+
+
+def test_generated_crds_carry_constraint_markers():
+    runtime = crds.tpu_runtime_crd()
+    spec = runtime["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    rt = spec["properties"]["runtimeType"]
+    assert rt["x-kubernetes-validations"] == [
+        {"rule": "self == oldSelf", "message": "runtimeType is immutable"}
+    ]
+    assert set(rt["enum"]) >= {"standard", "sandbox"}
+    assert spec["properties"]["imagePullPolicy"]["enum"] == [
+        "Always", "IfNotPresent", "Never",
+    ]
+    upgrade = spec["properties"]["upgradePolicy"]["properties"]
+    assert upgrade["maxParallelUpgrades"]["minimum"] == 0
+
+    policy = crds.cluster_policy_crd()
+    pspec = policy["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    assert pspec["properties"]["operator"]["properties"]["defaultRuntime"]["enum"] == [
+        "docker", "crio", "containerd",
+    ]
+
+
+def test_validate_spec_rules():
+    schema = admission.spec_schema(GROUP, "TPURuntime")
+    assert schema is not None
+    # enum violation at create
+    errs = admission.validate_spec(schema, {"runtimeType": "gpu"})
+    assert any("runtimeType" in e for e in errs)
+    # minimum bound
+    errs = admission.validate_spec(
+        schema, {"upgradePolicy": {"maxParallelUpgrades": -1}}
+    )
+    assert any("below minimum" in e for e in errs)
+    # immutability: explicit change rejected, same value fine
+    ok_spec = {"runtimeType": "sandbox"}
+    assert admission.validate_spec(schema, ok_spec, ok_spec) == []
+    errs = admission.validate_spec(schema, {"runtimeType": "standard"}, ok_spec)
+    assert any("immutable" in e for e in errs)
+    # defaulting mirrors the apiserver: omitting the field on update
+    # compares the DEFAULT against the old value
+    errs = admission.validate_spec(schema, {}, ok_spec)
+    assert any("immutable" in e for e in errs)
+    assert admission.validate_spec(schema, {}, {"runtimeType": "standard"}) == []
+    # create (no old) never fires transition rules
+    assert admission.validate_spec(schema, {"runtimeType": "sandbox"}) == []
+
+
+async def test_fake_apiserver_enforces_admission():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            # bad enum rejected at create
+            bad = TPURuntime.new("rt", {"runtimeType": "gpu"}).obj
+            with pytest.raises(ApiError):
+                await client.create(bad)
+            # good create admitted
+            cr = TPURuntime.new("rt", {"runtimeType": "sandbox", "version": "1"}).obj
+            created = await client.create(cr)
+            # mutating the immutable identity is rejected...
+            mutated = {**created, "spec": {**created["spec"], "runtimeType": "standard"}}
+            with pytest.raises(ApiError) as exc:
+                await client.update(mutated)
+            assert exc.value.status == 422
+            assert "immutable" in str(exc.value.body)
+            # ...while changing any mutable field is fine
+            live = await client.get(GROUP, "TPURuntime", "rt")
+            ok = {**live, "spec": {**live["spec"], "version": "2"}}
+            updated = await client.update(ok)
+            assert updated["spec"]["version"] == "2"

@@ -152,6 +152,7 @@ def test_chip_index():
 
 
 def test_preferred_allocation_contiguity():
+    # no discovered devices → no grid geometry → index-window fallback
     plugin = TPUDevicePlugin(PluginConfig())
     available = [f"tpu-accel{i}" for i in (0, 1, 3, 4, 5, 7)]
     # best contiguous run of 3 is 3,4,5
@@ -162,6 +163,68 @@ def test_preferred_allocation_contiguity():
     picked = plugin.preferred_allocation(available, ["tpu-accel7"], 2)
     assert picked[0] == "tpu-accel7"
     assert len(picked) == 2
+
+
+def _grid_plugin(n: int) -> TPUDevicePlugin:
+    plugin = TPUDevicePlugin(PluginConfig())
+    plugin.devices = {f"tpu-accel{i}": [f"/dev/accel{i}"] for i in range(n)}
+    return plugin
+
+
+def _mesh_dist(total: int, a: str, b: str) -> int:
+    from tpu_operator.deviceplugin.plugin import chip_index, host_grid_coords
+
+    coords = host_grid_coords(total)
+    pa, pb = coords[chip_index(a)], coords[chip_index(b)]
+    return abs(pa[0] - pb[0]) + abs(pa[1] - pb[1])
+
+
+def test_preferred_allocation_mesh_adjacency_2x2():
+    """A 4-chip v5e host is a 2x2 MESH: indices 1 and 2 are flat-contiguous
+    but DIAGONAL (two ICI hops) — the r03 index-span pick chose exactly
+    that pair.  The mesh metric must return a linked pair instead."""
+    plugin = _grid_plugin(4)
+    picked = plugin.preferred_allocation(["tpu-accel1", "tpu-accel2", "tpu-accel3"], [], 2)
+    assert len(picked) == 2
+    assert _mesh_dist(4, *picked) == 1  # shares a link; [1,2] would be 2
+    assert set(picked) != {"tpu-accel1", "tpu-accel2"}
+
+    # must_include is part of the geometry: accel3's mesh neighbours are
+    # 1 and 2, never 0 (diagonal)
+    picked = plugin.preferred_allocation(
+        [f"tpu-accel{i}" for i in range(4)], ["tpu-accel3"], 2
+    )
+    assert picked[0] == "tpu-accel3"
+    assert _mesh_dist(4, *picked) == 1
+
+
+def test_preferred_allocation_degrades_gracefully():
+    plugin = _grid_plugin(4)
+    # only the diagonal available → still honoured (best effort, no links)
+    picked = plugin.preferred_allocation(["tpu-accel0", "tpu-accel3"], [], 2)
+    assert sorted(picked) == ["tpu-accel0", "tpu-accel3"]
+    # 3-chip request on a 2x2: an L-shape with both links present
+    picked = plugin.preferred_allocation([f"tpu-accel{i}" for i in range(4)], [], 3)
+    assert len(picked) == 3
+    links = sum(
+        1 for a, b in __import__("itertools").combinations(picked, 2)
+        if _mesh_dist(4, a, b) == 1
+    )
+    assert links == 2
+
+
+def test_preferred_allocation_prefers_square_blocks():
+    """On a 2x4 (8-chip) host a 4-chip pick should be a 2x2 block (4 shared
+    links), not a 4-long snake (3)."""
+    import itertools
+
+    plugin = _grid_plugin(8)
+    available = [f"tpu-accel{i}" for i in (0, 2, 3, 4, 5, 6, 7)]  # chip 1 busy
+    picked = plugin.preferred_allocation(available, [], 4)
+    links = sum(
+        1 for a, b in itertools.combinations(picked, 2) if _mesh_dist(8, a, b) == 1
+    )
+    assert links == 4  # a 2x2 block; any row/snake has at most 3
 
 
 async def test_vfio_mode(tmp_path, monkeypatch):

@@ -1,0 +1,109 @@
+"""CEL-lite admission validation for the operator's CRDs.
+
+Reference analogue: kubebuilder markers compiled into the CRD schema and
+enforced by the real apiserver at admission — enums/defaults throughout
+(clusterpolicy_types.go:122-124) and XValidation CEL, e.g. the immutable
+driverType (nvidiadriver_types.go:44-47).  In production our generated
+``deploy/crds/*.yaml`` carry the same constraints and the apiserver is the
+authority.  This module is the shared in-process enforcement for the two
+places that have no real apiserver:
+
+- the fake apiserver (testing/fakecluster.py) — so a mutation test proves
+  rejection exactly where production would reject, and operator code never
+  relies on values admission would have refused;
+- ``tpuop_cfg validate`` — offline linting of CR manifests.
+
+Supported subset ("CEL-lite") — exactly what the generator emits:
+- ``self == oldSelf`` transition rules (field immutability)
+- ``enum`` membership
+- ``minimum`` / ``maximum`` numeric bounds
+
+Any other CEL expression is ignored (fail-open: full CEL belongs to the
+real apiserver; silently mis-evaluating it here would be worse than
+skipping it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# sentinel: "no previous object" (create) vs "previous value absent" (None)
+_NO_OLD = object()
+
+
+def validate_spec(schema: dict, new: Any, old: Any = _NO_OLD) -> list[str]:
+    """Validate a CR spec against its generated openAPIV3Schema subtree.
+
+    ``old`` is the previous spec on updates (enables transition rules);
+    omit it on create.  Returns human-readable error strings, empty when
+    admitted."""
+    errors: list[str] = []
+    _walk(schema, new, old, "spec", errors)
+    return errors
+
+
+def _effective(value: Any, schema: dict) -> Any:
+    """The value admission compares: explicit, else the schema default
+    (matching the real apiserver, which defaults before CEL evaluation)."""
+    return schema.get("default") if value is None else value
+
+
+def _walk(schema: dict, new: Any, old: Any, path: str, errors: list[str]) -> None:
+    effective = _effective(new, schema)
+
+    enum = schema.get("enum")
+    if enum is not None and effective is not None and effective not in enum:
+        errors.append(f"{path}: {effective!r} not one of {sorted(enum)}")
+
+    if isinstance(effective, (int, float)) and not isinstance(effective, bool):
+        minimum = schema.get("minimum")
+        if minimum is not None and effective < minimum:
+            errors.append(f"{path}: {effective} below minimum {minimum}")
+        maximum = schema.get("maximum")
+        if maximum is not None and effective > maximum:
+            errors.append(f"{path}: {effective} above maximum {maximum}")
+
+    if old is not _NO_OLD:
+        for rule in schema.get("x-kubernetes-validations") or []:
+            if rule.get("rule") != "self == oldSelf":
+                continue  # full CEL is the real apiserver's job
+            old_effective = _effective(old, schema)
+            if old_effective is not None and effective != old_effective:
+                errors.append(
+                    f"{path}: {rule.get('message', 'field is immutable')} "
+                    f"(was {old_effective!r}, got {effective!r})"
+                )
+
+    properties = schema.get("properties")
+    if properties and isinstance(new, dict):
+        old_map = old if isinstance(old, dict) else ({} if old is not _NO_OLD else None)
+        for key, sub in properties.items():
+            sub_old = _NO_OLD if old_map is None else old_map.get(key)
+            _walk(sub, new.get(key), sub_old, f"{path}.{key}", errors)
+
+    items = schema.get("items")
+    if items and isinstance(new, list):
+        # no per-item identity across updates — transition rules don't
+        # apply inside arrays; structural constraints still do
+        for i, element in enumerate(new):
+            _walk(items, element, _NO_OLD, f"{path}[{i}]", errors)
+
+
+_SPEC_SCHEMAS: Optional[dict[tuple[str, str], dict]] = None
+
+
+def spec_schema(group: str, kind: str) -> Optional[dict]:
+    """The generated spec schema for one of OUR CRDs (None for foreign
+    kinds — admission only guards what the operator owns)."""
+    global _SPEC_SCHEMAS
+    if _SPEC_SCHEMAS is None:
+        from tpu_operator.api import crds
+
+        _SPEC_SCHEMAS = {}
+        for crd in crds.all_crds():
+            spec = crd["spec"]
+            schema = spec["versions"][0]["schema"]["openAPIV3Schema"]
+            _SPEC_SCHEMAS[(spec["group"], spec["names"]["kind"])] = (
+                schema["properties"]["spec"]
+            )
+    return _SPEC_SCHEMAS.get((group, kind))

@@ -53,6 +53,18 @@ def schema_of(cls: type) -> dict:
         enum = (f.metadata or {}).get("enum")
         if enum:
             schema["enum"] = list(enum)
+        # kubebuilder Minimum/Maximum analogues
+        for marker in ("minimum", "maximum"):
+            value = (f.metadata or {}).get(marker)
+            if value is not None:
+                schema[marker] = value
+        # kubebuilder XValidation analogue (nvidiadriver_types.go:44-47
+        # pins driverType immutable this way): CEL rules enforced at
+        # admission by the real apiserver, and by api/admission.py's
+        # CEL-lite in the fake apiserver + tpuop_cfg
+        cel = (f.metadata or {}).get("cel")
+        if cel:
+            schema["x-kubernetes-validations"] = [dict(rule) for rule in cel]
         props[t._camel(f.name)] = schema
     return {
         "type": "object",

@@ -141,7 +141,10 @@ class OperandSpec(SpecBase):
     repository: Optional[str] = None
     image: Optional[str] = None
     version: Optional[str] = None
-    image_pull_policy: str = "IfNotPresent"
+    image_pull_policy: str = field(
+        default="IfNotPresent",
+        metadata={"enum": ["Always", "IfNotPresent", "Never"]},
+    )
     image_pull_secrets: list = field(default_factory=list)
     resources: Optional[dict] = None
     args: list = field(default_factory=list)
@@ -240,12 +243,12 @@ class UpgradePolicySpec(SpecBase):
     """Driver auto-upgrade policy (clusterpolicy_types.go DriverUpgradePolicySpec)."""
 
     auto_upgrade: bool = False
-    max_parallel_upgrades: int = 1
+    max_parallel_upgrades: int = field(default=1, metadata={"minimum": 0})
     max_unavailable: Optional[str] = "25%"
     # post-swap validation budget before the node is marked upgrade-failed
     # instead of waiting forever in validation-required; 0 disables the
     # timeout (wait indefinitely)
-    validation_timeout_seconds: int = 600
+    validation_timeout_seconds: int = field(default=600, metadata={"minimum": 0})
     wait_for_completion: WaitForCompletionSpec = field(default_factory=WaitForCompletionSpec)
     drain: DrainSpec = field(default_factory=DrainSpec)
     pod_deletion: PodDeletionSpec = field(default_factory=PodDeletionSpec)
@@ -493,11 +496,24 @@ class TPURuntimeSpec(SpecBase):
     upgrade policy, letting different TPU node pools pin different runtimes.
     """
 
-    runtime_type: str = field(default=RuntimeType.STANDARD, metadata={"enum": list(RuntimeType.ALL)})
+    # the runtime identity: immutable after creation, like the reference's
+    # driverType (nvidiadriver_types.go:44-47 XValidation) — flipping a
+    # live pool between standard and vfio would strand existing pods'
+    # device mounts; delete and recreate the CR instead
+    runtime_type: str = field(
+        default=RuntimeType.STANDARD,
+        metadata={
+            "enum": list(RuntimeType.ALL),
+            "cel": [{"rule": "self == oldSelf", "message": "runtimeType is immutable"}],
+        },
+    )
     repository: Optional[str] = None
     image: Optional[str] = None
     version: Optional[str] = None
-    image_pull_policy: str = "IfNotPresent"
+    image_pull_policy: str = field(
+        default="IfNotPresent",
+        metadata={"enum": ["Always", "IfNotPresent", "Never"]},
+    )
     image_pull_secrets: list = field(default_factory=list)
     libtpu_version: Optional[str] = None
     runtime_channel: str = field(default="stable", metadata={"enum": ["stable", "nightly", "pinned"]})

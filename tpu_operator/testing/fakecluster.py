@@ -69,7 +69,27 @@ class Store:
             queue.put_nowait(evt)
 
     # -- CRUD ----------------------------------------------------------
+    def _admit(self, obj: dict, old: Optional[dict] = None) -> None:
+        """CEL-lite admission for the operator's OWN CRDs (enums, bounds,
+        immutability) — the fake stands in for the real apiserver, which
+        enforces the same generated schema, so mutation tests reject here
+        exactly where production would (api/admission.py)."""
+        from tpu_operator.api import admission
+
+        schema = admission.spec_schema(self.info.gvk.group, self.info.gvk.kind)
+        if schema is None:
+            return
+        if old is None:
+            errors = admission.validate_spec(schema, obj.get("spec") or {})
+        else:
+            errors = admission.validate_spec(
+                schema, obj.get("spec") or {}, old.get("spec") or {}
+            )
+        if errors:
+            raise ApiException(422, "Invalid", "; ".join(errors))
+
     def create(self, obj: dict, namespace: Optional[str]) -> dict:
+        self._admit(obj)
         meta = obj.setdefault("metadata", {})
         if self.info.namespaced:
             meta["namespace"] = namespace or meta.get("namespace") or "default"
@@ -117,6 +137,7 @@ class Store:
             if "status" not in merged and "status" in existing:
                 merged["status"] = existing["status"]
             if merged.get("spec") != existing.get("spec"):
+                self._admit(merged, old=existing)
                 merged["metadata"]["generation"] = existing["metadata"].get("generation", 1) + 1
         merged["apiVersion"] = self.info.gvk.api_version
         merged["kind"] = self.info.gvk.kind
