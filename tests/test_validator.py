@@ -389,6 +389,21 @@ def test_ring_min_gbps_from_catalogue(monkeypatch):
     assert components._ring_min_gbps("v5e") == 12.5
 
 
+def test_multislice_min_gbps_from_catalogue(monkeypatch):
+    """The DCN gate arms from the generation's host NIC line rate (VERDICT
+    r03 #6: an unarmed cross-slice gate is decorative) — coarse but
+    non-zero, with the same explicit-override contract as the ICI gate."""
+    assert components._multislice_min_gbps("v5e") == 1.2   # 12.5 x 0.1
+    assert components._multislice_min_gbps("v5p") == 2.5   # 25.0 x 0.1
+    # unknown generations keep the gate report-only, never a made-up floor
+    assert components._multislice_min_gbps("unknown") == 0.0
+    assert components._multislice_min_gbps() == 0.0
+    monkeypatch.setenv("MULTISLICE_MIN_GBPS", "9")
+    assert components._multislice_min_gbps("v5e") == 9.0
+    monkeypatch.setenv("MULTISLICE_MIN_GBPS", "0")
+    assert components._multislice_min_gbps("v5e") == 0.0
+
+
 async def test_vfio_validation(validation_root, tmp_path, monkeypatch):
     vfio = tmp_path / "hw" / "dev" / "vfio"
     vfio.mkdir(parents=True)
@@ -712,7 +727,10 @@ async def test_multislice_cross_slice_validation(validation_root):
                     for e in p["spec"]["containers"][0]["env"]
                 }
                 assert envs["NUM_PROCESSES"] == "4"
-                assert envs["ALLREDUCE_MIN_GBPS"] == "0.0"  # DCN: no ICI floor
+                # DCN pods carry the NIC-rate-derived floor (v5e hosts:
+                # 12.5 GB/s x 0.1), never the ICI floor (50.0 for v5e) —
+                # the fabrics must not share an expectation
+                assert envs["ALLREDUCE_MIN_GBPS"] == "1.2"
                 global_ids.add(envs["PROCESS_ID"])
             assert global_ids == {"0", "1", "2", "3"}
 

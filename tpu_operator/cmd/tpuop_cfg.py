@@ -13,7 +13,6 @@ cluster.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import re
 import sys
@@ -28,17 +27,17 @@ from tpu_operator.api.types import (
 )
 
 
-def _enum_violations(spec_obj, path="spec") -> list[str]:
-    """Walk the dataclass tree checking enum-constrained fields."""
-    errors = []
-    for f in dataclasses.fields(spec_obj):
-        value = getattr(spec_obj, f.name)
-        enum = (f.metadata or {}).get("enum")
-        if enum and value not in enum:
-            errors.append(f"{path}.{f.name}: {value!r} not in {enum}")
-        if dataclasses.is_dataclass(value):
-            errors.extend(_enum_violations(value, f"{path}.{f.name}"))
-    return errors
+def _schema_violations(kind: str, spec_doc: dict) -> list[str]:
+    """The generated CRD schema's constraints (enums, bounds), via the same
+    CEL-lite walk the fake apiserver admission uses — ONE enforcement rule,
+    so offline linting can never pass what admission would reject."""
+    from tpu_operator.api import admission
+    from tpu_operator.api.types import GROUP
+
+    schema = admission.spec_schema(GROUP, kind)
+    if schema is None:
+        return [f"no generated schema for kind {kind!r}"]
+    return admission.validate_spec(schema, spec_doc or {})
 
 
 def validate_clusterpolicy(doc: dict) -> list[str]:
@@ -46,14 +45,14 @@ def validate_clusterpolicy(doc: dict) -> list[str]:
     kind = doc.get("kind")
     if kind == "TPUClusterPolicy":
         spec = TPUClusterPolicySpec.from_dict(doc.get("spec") or {})
-        errors += _enum_violations(spec)
+        errors += _schema_violations(kind, doc.get("spec") or {})
         if spec.extra_fields:
             errors += [f"spec: unknown field {k!r}" for k in spec.extra_fields]
         for state in consts.STATE_NAMES:
             spec.state_enabled(state)  # raises on registry drift
     elif kind == "TPURuntime":
-        rspec = TPURuntimeSpec.from_dict(doc.get("spec") or {})
-        errors += _enum_violations(rspec)
+        TPURuntimeSpec.from_dict(doc.get("spec") or {})  # parse errors raise
+        errors += _schema_violations(kind, doc.get("spec") or {})
     else:
         errors.append(f"unsupported kind {kind!r}")
     return errors

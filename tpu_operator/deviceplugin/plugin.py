@@ -123,8 +123,10 @@ def host_grid_coords(total: int) -> dict[int, tuple[int, int]]:
 
 
 # combinations cap for the exhaustive adjacency search: C(16,8)=12870 sets
-# on the largest (16-chip) host, microseconds of work in an allocation path
-# that runs once per pod placement
+# on the largest (16-chip) host, each scoring up to C(16,2) pairwise
+# distances in pure Python — ~100 ms worst case, which is why the gRPC
+# handler runs the pick in an executor instead of on the event loop that
+# also serves ListAndWatch
 _MAX_ADJACENCY_SEARCH = 20_000
 
 
@@ -217,7 +219,11 @@ class TPUDevicePlugin:
     async def GetPreferredAllocation(self, request, context) -> api_pb2.PreferredAllocationResponse:
         resp = api_pb2.PreferredAllocationResponse()
         for creq in request.container_requests:
-            picked = self.preferred_allocation(
+            # executor: the exhaustive pick is ~100 ms worst case (16-chip
+            # host) — the event loop must keep serving ListAndWatch
+            picked = await asyncio.get_event_loop().run_in_executor(
+                None,
+                self.preferred_allocation,
                 list(creq.available_deviceIDs),
                 list(creq.must_include_deviceIDs),
                 creq.allocation_size,

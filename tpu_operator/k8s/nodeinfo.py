@@ -32,6 +32,10 @@ class AcceleratorInfo:
     hbm_gbps: float = 0.0    # per-chip HBM bandwidth, GB/s (published spec)
     ici_links: int = 4       # ICI links per chip (torus degree: 2D=4, 3D=6);
                              # per-LINK bandwidth = ici_gbps / ici_links
+    dcn_gbps: float = 0.0    # per-HOST data-center-network bandwidth, GB/s
+                             # (the NIC line rate of the generation's VM
+                             # shape — the ceiling for cross-slice traffic;
+                             # 0 = unknown, keeps DCN gates report-only)
 
     @property
     def ici_link_gbps(self) -> float:
@@ -53,13 +57,17 @@ class AcceleratorInfo:
 ACCELERATORS: dict[str, AcceleratorInfo] = {
     # ici_links: torus degree per chip — v4/v5p are 3D tori (6 links),
     # v5e/v6e are 2D (4 links); per-link bw = aggregate / links (v4
-    # 300/6=50, v5e 200/4=50, v5p 600/6=100, v6e 448/4=112 GB/s)
-    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0, 1228.0, 6),
-    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0, 819.0, 4),
-    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0, 819.0, 4),
-    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0, 2765.0, 6),
-    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0, 1640.0, 4),
-    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0, 1640.0, 4),
+    # 300/6=50, v5e 200/4=50, v5p 600/6=100, v6e 448/4=112 GB/s).
+    # dcn_gbps: the host NIC line rate of the generation's standard VM
+    # shape (100 Gbps = 12.5 GB/s for v4/v5e hosts, 200 Gbps = 25 GB/s
+    # for v5p/v6e) — deliberately the BASE shape's rate: multi-NIC
+    # variants only raise the true ceiling above the floor derived here
+    "tpu-v4-podslice": AcceleratorInfo("v4", 32, 4, 275.0, 300.0, 1228.0, 6, 12.5),
+    "tpu-v5-lite-podslice": AcceleratorInfo("v5e", 16, 4, 197.0, 200.0, 819.0, 4, 12.5),
+    "tpu-v5-lite-device": AcceleratorInfo("v5e", 16, 8, 197.0, 200.0, 819.0, 4, 12.5),
+    "tpu-v5p-slice": AcceleratorInfo("v5p", 95, 4, 459.0, 600.0, 2765.0, 6, 25.0),
+    "tpu-v6e-slice": AcceleratorInfo("v6e", 32, 4, 918.0, 448.0, 1640.0, 4, 25.0),
+    "tpu-v6e-device": AcceleratorInfo("v6e", 32, 8, 918.0, 448.0, 1640.0, 4, 25.0),
 }
 
 UNKNOWN_ACCELERATOR = AcceleratorInfo("unknown", 0, 4, 0.0, 0.0, 0.0)

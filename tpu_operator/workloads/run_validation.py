@@ -91,7 +91,7 @@ def main() -> int:
             result = hbm_bench.apply_hbm_gate(
                 hbm_bench.hbm_benchmark(
                     size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
-                    iters=int(os.environ.get("HBM_ITERS", "256")),
+                    iters=int(os.environ.get("HBM_ITERS", "1024")),
                     best_of=int(os.environ.get("HBM_BEST_OF", "3")),
                 ),
                 float(os.environ.get("HBM_MIN_GBPS", "0") or 0),
