@@ -248,6 +248,52 @@ def test_vfio_manager_binds_pci(tmp_path, monkeypatch):
     assert len(hw.vfio_device_paths()) == 2
 
 
+def test_vm_runtime_manager_stages_containerd_config(tmp_path, monkeypatch):
+    """kata-manager analogue: one containerd runtime-handler drop-in per
+    configured class, converged idempotently, stale handlers pruned."""
+    from tpu_operator.agents import vm_runtime_manager as vrm
+
+    monkeypatch.setenv("TPU_HW_ROOT", str(tmp_path / "hw"))
+
+    assert vrm.parse_classes("kata-tpu=kata-tpu, fast=kata-clh,solo") == [
+        ("kata-tpu", "kata-tpu"), ("fast", "kata-clh"), ("solo", "solo"),
+    ]
+
+    classes = vrm.parse_classes("kata-tpu=kata-tpu,fast=kata-clh")
+    assert vrm.stage(classes, "/etc/containerd/conf.d") == 2
+    conf = tmp_path / "hw" / "etc" / "containerd" / "conf.d"
+    body = (conf / "tpu-vm-runtime-kata-tpu.toml").read_text()
+    assert 'runtimes.kata-tpu]' in body
+    assert 'runtime_type = "io.containerd.kata.v2"' in body
+    # idempotent: converged state writes nothing
+    assert vrm.stage(classes, "/etc/containerd/conf.d") == 0
+    # dropping a class prunes its drop-in, leaves the rest
+    assert vrm.stage(classes[:1], "/etc/containerd/conf.d") == 1
+    assert not (conf / "tpu-vm-runtime-kata-clh.toml").exists()
+    assert (conf / "tpu-vm-runtime-kata-tpu.toml").exists()
+
+
+def test_vm_runtime_extras_rejects_hostile_classes():
+    """Names/handlers outside the DNS-label/handler-token alphabet never
+    reach the env contract, drop-in filenames, or the privileged containerd
+    config (a ',' in a handler would re-split the agent's class list; a '/'
+    would path-escape the drop-in name; a newline would inject config)."""
+    from tpu_operator.api.types import TPUClusterPolicySpec
+    from tpu_operator.state.render_data import ClusterContext, _vm_runtime_extras
+
+    spec = TPUClusterPolicySpec.from_dict({"vmRuntime": {"runtimeClasses": [
+        {"name": "ok-class", "handler": "ok_handler"},
+        {"name": "bad", "handler": "kata,clh"},
+        {"name": "Bad_Name"},
+        {"name": "slash", "handler": "a/b"},
+        {"name": "inject", "handler": "x\ny"},
+        "not-a-dict",
+    ]}})
+    out = _vm_runtime_extras(ClusterContext(namespace="ns"), spec)["vm_runtime"]
+    assert [c["name"] for c in out["runtime_classes"]] == ["ok-class"]
+    assert out["classes_env"] == "ok-class=ok_handler"
+
+
 def test_parse_duration():
     from tpu_operator.agents.base import parse_duration
 

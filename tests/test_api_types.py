@@ -58,7 +58,18 @@ def test_state_enabled_gates():
     )
     assert spec.state_enabled("state-sandbox-validation")
     assert spec.state_enabled("state-vfio-manager")
+    assert spec.state_enabled("state-vm-runtime")
     assert not spec.state_enabled("state-device-plugin")
+
+    # the VM-isolation runtime manager (kata-manager analogue) follows the
+    # sandbox gate and its own enable switch
+    spec = TPUClusterPolicySpec.from_dict(
+        {"sandboxWorkloads": {"enabled": True}, "vmRuntime": {"enabled": False}}
+    )
+    assert not spec.state_enabled("state-vm-runtime")
+    assert TPUClusterPolicySpec.from_dict({}).vm_runtime.runtime_classes == [
+        {"name": "kata-tpu", "handler": "kata-tpu"}
+    ]
 
     # NVIDIADriver-CRD bypass analogue: libtpu state skipped when CRD-managed
     spec = TPUClusterPolicySpec.from_dict({"libtpu": {"useTpuRuntimeCrd": True}})
@@ -172,7 +183,7 @@ def test_crd_generation():
         "operator", "daemonsets", "libtpu", "runtimePrep", "devicePlugin",
         "metricsAgent", "metricsExporter", "featureDiscovery", "sliceManager",
         "nodeStatusExporter", "validator", "sandboxWorkloads", "vfioManager",
-        "sandboxDevicePlugin", "psa", "cdi",
+        "vmRuntime", "sandboxDevicePlugin", "psa", "cdi",
     ):
         assert key in props, key
     # nested operand pattern renders

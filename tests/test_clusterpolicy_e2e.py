@@ -144,6 +144,53 @@ async def test_psa_namespace_labels():
             assert not any(k.startswith("pod-security.") for k in nlabels)
 
 
+async def test_vm_passthrough_workload_routing():
+    """Sandbox workloads on: the label engine routes each node's workload
+    config to the right operand chain — a vm-passthrough node gets the
+    vfio/vm-runtime/sandbox gates and NOT the container chain, and the
+    VM-isolation runtime state (kata-manager analogue) materializes its
+    DaemonSet plus one RuntimeClass per configured class."""
+    async with FakeCluster() as fc:
+        fc.add_node("tpu-vm-0", labels={consts.TPU_WORKLOAD_CONFIG_LABEL: consts.WORKLOAD_VM_PASSTHROUGH})
+        fc.add_node("tpu-ctr-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            cr = TPUClusterPolicy.new()
+            cr.obj["spec"]["sandboxWorkloads"] = {"enabled": True}
+            cr.obj["spec"]["vmRuntime"] = {
+                "runtimeClasses": [
+                    {"name": "kata-tpu", "handler": "kata-tpu"},
+                    {"name": "kata-tpu-fast", "handler": "kata-clh"},
+                ]
+            }
+            await client.create(cr.obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            await _converge(reconciler)
+
+            vm = await client.get("", "Node", "tpu-vm-0")
+            ctr = await client.get("", "Node", "tpu-ctr-0")
+            vm_labels = vm["metadata"]["labels"]
+            ctr_labels = ctr["metadata"]["labels"]
+            # vm node: VM chain gated on, container chain off
+            assert vm_labels[consts.DEPLOY_LABEL_PREFIX + "vm-runtime"] == "true"
+            assert vm_labels[consts.DEPLOY_LABEL_PREFIX + "vfio-manager"] == "true"
+            assert consts.DEPLOY_LABEL_PREFIX + "device-plugin" not in vm_labels
+            # container node (sandbox default workload=container): inverse
+            assert ctr_labels[consts.DEPLOY_LABEL_PREFIX + "device-plugin"] == "true"
+            assert consts.DEPLOY_LABEL_PREFIX + "vm-runtime" not in ctr_labels
+
+            ds_names = {
+                d["metadata"]["name"] for d in await client.list_items("apps", "DaemonSet", NS)
+            }
+            assert "tpu-vm-runtime-manager" in ds_names
+            assert "tpu-vfio-manager" in ds_names
+            for rc_name, handler in (("kata-tpu", "kata-tpu"), ("kata-tpu-fast", "kata-clh")):
+                rc = await client.get("node.k8s.io", "RuntimeClass", rc_name)
+                assert rc["handler"] == handler
+                assert rc["scheduling"]["nodeSelector"] == {
+                    consts.DEPLOY_LABEL_PREFIX + "vm-runtime": "true"
+                }
+
+
 async def test_singleton_guard():
     async with FakeCluster() as fc:
         async with ApiClient(Config(base_url=fc.base_url)) as client:

@@ -26,6 +26,43 @@ NS = "tpu-operator"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_lease_tuning_flags():
+    """The reference exposes --leader-lease-renew-deadline
+    (cmd/gpu-operator/main.go:72-81); our flag surface parses the same
+    duration syntax and plumbs all three lease timings to the elector."""
+    from tpu_operator.cmd import operator
+    from tpu_operator.k8s.leader import LeaderElector
+
+    assert operator._duration("10s") == 10.0
+    assert operator._duration("2m") == 120.0
+    assert operator._duration("500ms") == 0.5
+    assert operator._duration("1.5h") == 5400.0
+    assert operator._duration("7") == 7.0
+
+    args = operator.parse_args([
+        "--leader-lease-duration", "30s",
+        "--leader-lease-retry-period", "3s",
+        "--leader-lease-renew-deadline", "20s",
+    ])
+    # argparse type conversion: values arrive as seconds (defaults included)
+    assert args.leader_lease_duration == 30.0
+    assert args.leader_lease_retry_period == 3.0
+    assert args.leader_lease_renew_deadline == 20.0
+    defaults = operator.parse_args([])
+    assert defaults.leader_lease_duration == 15.0
+
+    # client-go defaults ratio (10s deadline / 15s duration) and the
+    # split-brain ordering invariant retry < deadline <= duration
+    elector = LeaderElector(None, "ns", lease_duration=30.0, renew_interval=3.0)
+    assert elector.renew_deadline == 20.0
+    elector = LeaderElector(None, "ns", lease_duration=30.0, renew_deadline=25.0)
+    assert elector.renew_deadline == 25.0
+    with pytest.raises(ValueError):
+        LeaderElector(None, "ns", lease_duration=15.0, renew_deadline=30.0)
+    with pytest.raises(ValueError):
+        LeaderElector(None, "ns", renew_interval=12.0)  # >= default deadline
+
+
 async def test_operator_binary_end_to_end(tmp_path):
     async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
         env = {

@@ -1,0 +1,131 @@
+"""tpu-vm-runtime-manager: stage the VM-isolation container runtime.
+
+Reference analogue: the kata-manager operand
+(/root/reference/assets/state-kata-manager/0600_daemonset.yaml — NVIDIA's
+k8s-kata-manager installs kata artifacts and writes containerd runtime
+handlers; the operator renders one RuntimeClass per configured class,
+0700_runtime_class.yaml).  TPU translation: the RuntimeClass objects are
+rendered by the operator (assets/state-vm-runtime/0700_runtime_class.yaml);
+this node agent stages the containerd side — one runtime-handler drop-in
+per class under the host's containerd ``conf.d`` (COS/GKE containerd loads
+includes from there) — and keeps it converged.
+
+Everything roots at ``TPU_HW_ROOT`` (hw.py seam) so the flow is testable
+and safe off-hardware.  The agent never restarts containerd itself: COS
+reloads conf.d includes on config watch, and a node-level runtime restart
+is the admin's (or node-pool rollout's) call — same stance as the
+reference's CDI path.
+
+Env contract (DS-injected):
+  VM_RUNTIME_CLASSES  comma list of ``name=handler`` pairs
+  VM_RUNTIME_CONFIG_DIR  containerd drop-in dir (default /etc/containerd/conf.d)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from tpu_operator import hw
+from tpu_operator.agents import base
+
+log = logging.getLogger("tpu_operator.vm_runtime_manager")
+
+MARKER = "vm-runtime-staged"
+
+
+def parse_classes(env: str) -> list[tuple[str, str]]:
+    """'kata-tpu=kata-tpu,fast=kata-clh' → [(name, handler), ...]; entries
+    without '=' use the name as the handler."""
+    out = []
+    for item in env.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, handler = item.partition("=")
+        out.append((name, handler or name))
+    return out
+
+
+def handler_config(handler: str) -> str:
+    """The containerd runtime-handler drop-in for one class: a v2 runtime
+    entry named ``handler`` backed by the kata shim.  Annotations are
+    pod-passthrough so device hints reach the VM."""
+    return (
+        "version = 2\n"
+        f'[plugins."io.containerd.grpc.v1.cri".containerd.runtimes.{handler}]\n'
+        '  runtime_type = "io.containerd.kata.v2"\n'
+        '  privileged_without_host_devices = true\n'
+        "  pod_annotations = [\"tpu.google.com/*\"]\n"
+    )
+
+
+def config_path(config_dir: str, handler: str) -> str:
+    return os.path.join(
+        hw.hw_root(), config_dir.lstrip("/"), f"tpu-vm-runtime-{handler}.toml"
+    )
+
+
+def stage(classes: list[tuple[str, str]], config_dir: str) -> int:
+    """Converge one drop-in per handler; prune drop-ins for handlers no
+    longer configured (the operator owns the tpu-vm-runtime-* namespace).
+    Returns how many files changed."""
+    directory = os.path.join(hw.hw_root(), config_dir.lstrip("/"))
+    os.makedirs(directory, exist_ok=True)
+    # config_path is the ONE home of the naming rule — the prune below
+    # matches on the same basenames
+    desired = {
+        os.path.basename(config_path(config_dir, handler)): handler_config(handler)
+        for _, handler in classes
+    }
+    changed = 0
+    for name, content in desired.items():
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                if f.read() == content:
+                    continue
+        except OSError:
+            pass
+        with open(path, "w") as f:
+            f.write(content)
+        changed += 1
+        log.info("staged containerd runtime config %s", path)
+    for name in os.listdir(directory):
+        if name.startswith("tpu-vm-runtime-") and name not in desired:
+            os.remove(os.path.join(directory, name))
+            changed += 1
+            log.info("pruned stale runtime config %s", name)
+    return changed
+
+
+async def run() -> None:
+    from tpu_operator.validator import status
+
+    classes = parse_classes(os.environ.get("VM_RUNTIME_CLASSES", "kata-tpu=kata-tpu"))
+    config_dir = os.environ.get("VM_RUNTIME_CONFIG_DIR", "/etc/containerd/conf.d")
+    interval = base.parse_duration(os.environ.get("VM_RUNTIME_INTERVAL", "60s"))
+    stop = base.stop_event()
+
+    def converge() -> None:
+        # transient host-filesystem errors (ENOSPC, ro-remount, a file
+        # vanishing mid-prune) must retry next tick, not crash-loop the DS
+        try:
+            stage(classes, config_dir)
+            # readiness marker beside the validations (sandbox-validation
+            # and humans can see the runtime side is staged)
+            status.write_marker(MARKER)
+        except OSError as e:
+            log.warning("vm-runtime staging failed (will retry): %s", e)
+
+    await base.run_periodic(converge, interval, stop)
+
+
+def main() -> None:
+    base.setup_logging()
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -365,6 +365,25 @@ class SandboxWorkloadsSpec(SpecBase):
 
 
 @dataclass
+class VMRuntimeSpec(OperandSpec):
+    """state-vm-runtime: VM-isolation runtime manager (kata-manager
+    analogue, /root/reference/assets/state-kata-manager/0600_daemonset.yaml
+    + k8s-kata-manager config).  Each entry of ``runtime_classes`` becomes
+    a cluster RuntimeClass (name → containerd handler) scheduling-pinned to
+    vm-runtime-gated TPU nodes, and the node agent stages the containerd
+    runtime-handler config for it.  VM-isolated TPU pods then request the
+    RuntimeClass plus vfio-bound chips (the passthrough half lives in
+    state-vfio-manager / state-sandbox-device-plugin)."""
+
+    runtime_classes: list = field(
+        default_factory=lambda: [{"name": "kata-tpu", "handler": "kata-tpu"}]
+    )
+    # containerd drop-in directory the agent stages handler configs into
+    # (COS/GKE containerd loads conf.d includes)
+    config_dir: str = "/etc/containerd/conf.d"
+
+
+@dataclass
 class PSASpec(SpecBase):
     enabled: bool = False
     extra_fields: dict = field(default_factory=dict)
@@ -397,6 +416,7 @@ class TPUClusterPolicySpec(SpecBase):
     validator: ValidatorSpec = field(default_factory=ValidatorSpec)
     sandbox_workloads: SandboxWorkloadsSpec = field(default_factory=SandboxWorkloadsSpec)
     vfio_manager: OperandSpec = field(default_factory=OperandSpec)
+    vm_runtime: "VMRuntimeSpec" = field(default_factory=lambda: VMRuntimeSpec())
     sandbox_device_plugin: OperandSpec = field(default_factory=OperandSpec)
     psa: PSASpec = field(default_factory=PSASpec)
     cdi: CDISpec = field(default_factory=CDISpec)
@@ -419,6 +439,7 @@ class TPUClusterPolicySpec(SpecBase):
             "state-node-status-exporter": self.node_status_exporter.is_enabled(default=False),
             "state-sandbox-validation": sandbox,
             "state-vfio-manager": sandbox and self.vfio_manager.is_enabled(),
+            "state-vm-runtime": sandbox and self.vm_runtime.is_enabled(),
             "state-sandbox-device-plugin": sandbox and self.sandbox_device_plugin.is_enabled(),
         }
         try:

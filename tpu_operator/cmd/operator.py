@@ -32,12 +32,27 @@ def _port(addr: str) -> int:
     return int(addr.rsplit(":", 1)[-1])
 
 
+def _duration(value: str) -> float:
+    """'10s' / '2m' / '1.5h' / bare seconds → seconds (the urfave/cli
+    duration-flag subset the reference's flags accept; one parser for the
+    whole tree — agents/base.py)."""
+    from tpu_operator.agents.base import parse_duration
+
+    return parse_duration(value)
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("tpu-operator")
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true", default=False)
-    p.add_argument("--leader-lease-renew-deadline", default="10s")
+    # reference flag surface (cmd/gpu-operator/main.go:72-81): the renew
+    # deadline is the split-brain guard; the lease duration bounds how long
+    # a crashed leader blocks takeover.  type=_duration: malformed values
+    # exit with argparse usage, not a mid-start traceback
+    p.add_argument("--leader-lease-renew-deadline", type=_duration, default="10s")
+    p.add_argument("--leader-lease-duration", type=_duration, default="15s")
+    p.add_argument("--leader-lease-retry-period", type=_duration, default="5s")
     p.add_argument("--zap-log-level", default="info")
     return p.parse_args(argv)
 
@@ -60,6 +75,9 @@ async def run(args: argparse.Namespace) -> None:
         health_port=_port(args.health_probe_bind_address),
         leader_elect=args.leader_elect,
         metrics_registry=metrics.registry,
+        lease_duration=args.leader_lease_duration,
+        renew_interval=args.leader_lease_retry_period,
+        renew_deadline=args.leader_lease_renew_deadline,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers

@@ -60,6 +60,7 @@ STATE_LABELS_CONTAINER = (
 )
 STATE_LABELS_VM = (
     "vfio-manager",
+    "vm-runtime",
     "sandbox-device-plugin",
     "sandbox-validator",
 )
@@ -107,6 +108,7 @@ STATE_NAMES = (
     "state-node-status-exporter",
     "state-sandbox-validation",
     "state-vfio-manager",
+    "state-vm-runtime",
     "state-sandbox-device-plugin",
 )
 
@@ -129,6 +131,7 @@ IMAGE_ENVS = {
     "node-status-exporter": "NODE_STATUS_EXPORTER_IMAGE",
     "validator": "VALIDATOR_IMAGE",
     "vfio-manager": "VFIO_MANAGER_IMAGE",
+    "vm-runtime": "VM_RUNTIME_IMAGE",
     "sandbox-device-plugin": "SANDBOX_DEVICE_PLUGIN_IMAGE",
 }
 

@@ -128,6 +128,9 @@ class Manager:
         health_port: int = 8081,
         leader_elect: bool = False,
         metrics_registry=None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        renew_deadline: Optional[float] = None,
     ):
         self.client = client
         self.namespace = namespace
@@ -135,6 +138,11 @@ class Manager:
         self.health_port = health_port
         self.leader_elect = leader_elect
         self.metrics_registry = metrics_registry
+        # --leader-lease-renew-deadline analogue (cmd/gpu-operator
+        # main.go:72-81): operators tune these for flaky control planes
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.renew_deadline = renew_deadline
         self.informers: dict[str, Informer] = {}
         self.controllers: list[Controller] = []
         self.elector: Optional[LeaderElector] = None
@@ -154,7 +162,13 @@ class Manager:
 
     async def start(self) -> None:
         if self.leader_elect:
-            self.elector = LeaderElector(self.client, self.namespace)
+            self.elector = LeaderElector(
+                self.client,
+                self.namespace,
+                lease_duration=self.lease_duration,
+                renew_interval=self.renew_interval,
+                renew_deadline=self.renew_deadline,
+            )
             await self.elector.start()
             await self.elector.is_leader.wait()
         await self._start_http()

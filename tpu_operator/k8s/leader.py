@@ -44,6 +44,7 @@ class LeaderElector:
         identity: Optional[str] = None,
         lease_duration: float = 15.0,
         renew_interval: float = 5.0,
+        renew_deadline: Optional[float] = None,
     ):
         self.client = client
         self.namespace = namespace
@@ -51,6 +52,21 @@ class LeaderElector:
         self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
+        # client-go's RenewDeadline analogue: step down once we cannot
+        # prove a renew within this window.  Default mirrors client-go's
+        # 10s/15s ratio; the ordering invariant is enforced because a
+        # deadline past the lease duration opens a split-brain window (a
+        # peer legally acquires the expired lease while we still act as
+        # leader) — client-go rejects that configuration at construction
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None else lease_duration * 2.0 / 3.0
+        )
+        if not (self.renew_interval < self.renew_deadline <= self.lease_duration):
+            raise ValueError(
+                f"lease timings must satisfy retry ({self.renew_interval}s) < "
+                f"renew deadline ({self.renew_deadline}s) <= lease duration "
+                f"({self.lease_duration}s)"
+            )
         self.is_leader = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._last_renew = 0.0
@@ -96,7 +112,7 @@ class LeaderElector:
                 # guard mirroring client-go's leaderelection renew deadline).
                 if (
                     self.is_leader.is_set()
-                    and _time.monotonic() - self._last_renew > self.lease_duration
+                    and _time.monotonic() - self._last_renew > self.renew_deadline
                 ):
                     log.warning("renew deadline exceeded; stepping down (%s)", self.identity)
                     self.is_leader.clear()

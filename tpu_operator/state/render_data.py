@@ -9,6 +9,7 @@ to the context consumed by assets/<state>/*.yaml templates.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -175,6 +176,37 @@ def _feature_discovery_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -
     return {"feature_discovery": {"sleep_interval": spec.feature_discovery.sleep_interval}}
 
 
+# RuntimeClass names are DNS labels; containerd handler tokens are similarly
+# restricted.  Anything outside this alphabet could smuggle separators into
+# the agent's name=handler,... env contract, path components into the
+# drop-in filename, or raw lines into the privileged containerd config.
+_VM_CLASS_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+_VM_HANDLER_RE = re.compile(r"^[A-Za-z0-9_-]{1,63}$")
+
+
+def _vm_runtime_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
+    vr = spec.vm_runtime
+    # only well-formed entries reach the template: a malformed CR entry
+    # must not render a RuntimeClass with a null handler, and hostile
+    # name/handler strings must not reach the env/file/config contracts
+    classes = [
+        {"name": rc["name"], "handler": rc.get("handler") or rc["name"]}
+        for rc in vr.runtime_classes
+        if isinstance(rc, dict)
+        and isinstance(rc.get("name"), str)
+        and _VM_CLASS_NAME_RE.match(rc["name"])
+        and _VM_HANDLER_RE.match(str(rc.get("handler") or rc["name"]))
+    ]
+    return {
+        "vm_runtime": {
+            "runtime_classes": classes,
+            # the agent's VM_RUNTIME_CLASSES env contract: name=handler list
+            "classes_env": ",".join(f"{c['name']}={c['handler']}" for c in classes),
+            "config_dir": vr.config_dir,
+        }
+    }
+
+
 def _slice_manager_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
     cfg = spec.slice_manager.config
     return {
@@ -201,6 +233,7 @@ STATE_DEFS: list[StateDef] = [
     StateDef("state-node-status-exporter", lambda s: s.node_status_exporter, "node-status-exporter"),
     StateDef("state-sandbox-validation", lambda s: s.validator, "validator"),
     StateDef("state-vfio-manager", lambda s: s.vfio_manager, "vfio-manager"),
+    StateDef("state-vm-runtime", lambda s: s.vm_runtime, "vm-runtime", _vm_runtime_extras),
     StateDef("state-sandbox-device-plugin", lambda s: s.sandbox_device_plugin, "sandbox-device-plugin"),
 ]
 
