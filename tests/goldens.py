@@ -77,6 +77,7 @@ CONFIGS: list[tuple[str, ClusterContext, dict]] = [
                 "jax": {"env": [{"name": "WITH_WORKLOAD", "value": "true"}]},
             },
             "sandboxWorkloads": {"enabled": True, "defaultWorkload": "container"},
+            "cdi": {"enabled": True, "default": True},
             "vfioManager": {"repository": "gcr.io/acme", "image": "tpu-vfio-manager", "version": "v0.1"},
             "sandboxDevicePlugin": {"repository": "gcr.io/acme", "image": "tpu-sandbox-plugin", "version": "v0.1"},
         },

@@ -151,6 +151,81 @@ def test_chip_index():
     assert chip_index("accel") == 0
 
 
+async def test_cdi_spec_and_allocation(tmp_path, hw4):
+    """CDI mode (reference cdi sub-spec analogue): the plugin maintains the
+    host CDI spec file, and with cdi.default Allocate answers with
+    qualified CDI device names instead of raw DeviceSpecs (env vars still
+    carry the per-allocation TPU topology contract)."""
+    import json
+
+    plugin = make_plugin(
+        tmp_path, cdi_enabled=True, cdi_default=True, cdi_dir=str(tmp_path / "cdi")
+    )
+    await plugin.serve()
+    try:
+        spec_path = tmp_path / "cdi" / "google.com-tpu.json"
+        spec = json.loads(spec_path.read_text())
+        assert spec["kind"] == "google.com/tpu"
+        names = {d["name"] for d in spec["devices"]}
+        assert names == {f"accel{i}" for i in range(4)}
+        node = spec["devices"][0]["containerEdits"]["deviceNodes"][0]
+        assert node["path"] == "/dev/accel0"
+        assert node["permissions"] == "rw"
+
+        async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(devicesIDs=["tpu-accel1", "tpu-accel2"])
+                )
+                cresp = (await stub.Allocate(req)).container_responses[0]
+                assert [d.name for d in cresp.cdi_devices] == [
+                    "google.com/tpu=accel1", "google.com/tpu=accel2",
+                ]
+                # the runtime injects nodes/mounts from the spec file
+                assert len(cresp.devices) == 0
+                assert len(cresp.mounts) == 0
+                # the env contract is per-allocation and stays
+                assert cresp.envs["TPU_VISIBLE_CHIPS"] == "1,2"
+
+        # the spec CONVERGES on filesystem truth that moves after startup:
+        # libtpu lands asynchronously via the state-libtpu DS
+        libtpu = tmp_path / "libtpu"
+        libtpu.mkdir()
+        plugin.config.libtpu_dir = str(libtpu)
+        plugin.write_cdi_spec()
+        spec = json.loads(spec_path.read_text())
+        assert spec["containerEdits"]["mounts"][0]["hostPath"] == str(libtpu)
+    finally:
+        await plugin.stop()
+    # shutdown removes the spec — no orphan resolving a dead inventory
+    assert not (tmp_path / "cdi" / "google.com-tpu.json").exists()
+
+
+async def test_cdi_enabled_without_default_keeps_raw_devices(tmp_path, hw4):
+    """cdi.enabled alone writes the spec (annotation-based CDI requests
+    work) but Allocate still answers with raw DeviceSpecs."""
+    plugin = make_plugin(
+        tmp_path, cdi_enabled=True, cdi_default=False, cdi_dir=str(tmp_path / "cdi")
+    )
+    await plugin.serve()
+    try:
+        assert (tmp_path / "cdi" / "google.com-tpu.json").exists()
+        async with FakeKubelet(plugin.config.kubelet_dir) as kubelet:
+            async with kubelet.plugin_channel("tpu.sock") as channel:
+                stub = rpc.DevicePluginStub(channel)
+                req = api_pb2.AllocateRequest()
+                req.container_requests.append(
+                    api_pb2.ContainerAllocateRequest(devicesIDs=["tpu-accel0"])
+                )
+                cresp = (await stub.Allocate(req)).container_responses[0]
+                assert len(cresp.cdi_devices) == 0
+                assert len(cresp.devices) == 1
+    finally:
+        await plugin.stop()
+
+
 def test_preferred_allocation_contiguity():
     # no discovered devices → no grid geometry → index-window fallback
     plugin = TPUDevicePlugin(PluginConfig())

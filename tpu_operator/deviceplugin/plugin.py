@@ -53,6 +53,22 @@ class PluginConfig:
         default_factory=lambda: float(os.environ.get("HEALTH_INTERVAL_SECONDS", "5"))
     )
     libtpu_dir: str = "/home/kubernetes/tpu"
+    # CDI (container-device-interface) support, mirroring the reference's
+    # cdi sub-spec (clusterpolicy_types.go CDIConfig): ``cdi_enabled``
+    # maintains a CDI spec file under ``cdi_dir`` describing every chip;
+    # ``cdi_default`` switches Allocate to answer with CDI device names
+    # (the runtime injects nodes/mounts from the spec) instead of raw
+    # DeviceSpecs.  Annotation-based requests always work once the spec
+    # file exists.
+    cdi_enabled: bool = field(
+        default_factory=lambda: os.environ.get("CDI_ENABLED", "").lower() in ("1", "true")
+    )
+    cdi_default: bool = field(
+        default_factory=lambda: os.environ.get("CDI_DEFAULT", "").lower() in ("1", "true")
+    )
+    cdi_dir: str = field(
+        default_factory=lambda: os.environ.get("CDI_DIR", "/var/run/cdi")
+    )
     # Static device sets (mixed slice strategy): device id → list of host
     # chip paths forming one partition unit, plus the unit's ICI shape.
     # None ⇒ dynamic per-chip discovery (one device per /dev/accel*).
@@ -130,6 +146,13 @@ def host_grid_coords(total: int) -> dict[int, tuple[int, int]]:
 _MAX_ADJACENCY_SEARCH = 20_000
 
 
+def cdi_device_name(did: str) -> str:
+    """Device id → CDI device name ('tpu-accel3' → 'accel3'); the CDI name
+    is qualified by the spec's kind, so the 'tpu-' disambiguator the plugin
+    uses for kubelet ids would be redundant."""
+    return did[4:] if did.startswith("tpu-") else did
+
+
 def chip_index(name: str) -> int:
     """Trailing chip number of a device id/path basename ('tpu-accel3' → 3)."""
     digits = ""
@@ -186,6 +209,80 @@ class TPUDevicePlugin:
         self.devices, self.health = devices, health
         return changed
 
+    def _cdi_spec_path(self) -> str:
+        return os.path.join(
+            self.config.cdi_dir, self.config.resource_name.replace("/", "-") + ".json"
+        )
+
+    def write_cdi_spec(self) -> Optional[str]:
+        """Converge the host CDI spec file describing every advertised
+        device (reference cdi sub-spec analogue: the toolkit generates
+        nvidia.com/gpu CDI specs; here the plugin owns the device
+        inventory, so it owns the spec).  Returns the path, or None when
+        CDI is disabled (a leftover spec from a previous enablement is
+        removed — an orphaned file would keep resolving annotation-based
+        requests against stale state).
+
+        Called every health tick, NOT only on inventory changes: the spec
+        captures filesystem truths that move independently of the device
+        dict — libtpu lands asynchronously via the state-libtpu DS, and
+        env-declared chips can grow device nodes after startup — the same
+        truths the raw path re-checks per Allocate.  Unchanged content is
+        not rewritten."""
+        path = self._cdi_spec_path()
+        if not self.config.cdi_enabled:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        devices = []
+        for did in sorted(self.devices):
+            nodes = [
+                {
+                    "path": f"/dev/{os.path.basename(p)}",
+                    "hostPath": p,
+                    "permissions": "rw",
+                }
+                for p in self.devices[did]
+                if os.path.exists(p)
+            ]
+            devices.append(
+                {"name": cdi_device_name(did), "containerEdits": {"deviceNodes": nodes}}
+            )
+        spec: dict = {
+            "cdiVersion": "0.6.0",
+            "kind": self.config.resource_name,
+            "devices": devices,
+        }
+        if os.path.isdir(self.config.libtpu_dir):
+            # the libtpu install rides every CDI injection, replacing the
+            # per-allocation Mount of the raw path
+            spec["containerEdits"] = {
+                "mounts": [
+                    {
+                        "hostPath": self.config.libtpu_dir,
+                        "containerPath": self.config.libtpu_dir,
+                        "options": ["ro", "rbind"],
+                    }
+                ]
+            }
+        import json
+
+        os.makedirs(self.config.cdi_dir, exist_ok=True)
+        content = json.dumps(spec, indent=2)
+        try:
+            with open(path) as f:
+                if f.read() == content:
+                    return path
+        except OSError:
+            pass
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+        return path
+
     def _snapshot(self) -> api_pb2.ListAndWatchResponse:
         resp = api_pb2.ListAndWatchResponse()
         for did in sorted(self.devices):
@@ -195,7 +292,11 @@ class TPUDevicePlugin:
     async def _health_loop(self) -> None:
         while True:
             await asyncio.sleep(self.config.health_interval)
-            if self.refresh_devices():
+            changed = self.refresh_devices()
+            # every tick, not only on inventory changes: the spec also
+            # tracks libtpu/device-node filesystem state (see docstring)
+            self.write_cdi_spec()
+            if changed:
                 for queue in list(self._watchers):
                     queue.put_nowait(None)
 
@@ -312,6 +413,10 @@ class TPUDevicePlugin:
                     "per container (request a larger slice shape instead)",
                 )
             cresp = api_pb2.ContainerAllocateResponse()
+            # CDI-default: answer with qualified CDI device names and let
+            # the runtime inject nodes/mounts from the plugin-maintained
+            # spec file; env vars (below) still carry per-allocation values
+            use_cdi = self.config.cdi_enabled and self.config.cdi_default
             chip_indices = []
             for did in creq.devicesIDs:
                 paths = self.devices.get(did)
@@ -319,10 +424,16 @@ class TPUDevicePlugin:
                     await context.abort(
                         grpc.StatusCode.INVALID_ARGUMENT, f"unknown device {did}"
                     )
+                if use_cdi:
+                    cresp.cdi_devices.append(
+                        api_pb2.CDIDevice(
+                            name=f"{self.config.resource_name}={cdi_device_name(did)}"
+                        )
+                    )
                 for path in paths:
                     # env-declared (virtual) chips have no device node to
                     # map; a nonexistent host_path would fail containerd
-                    if os.path.exists(path):
+                    if os.path.exists(path) and not use_cdi:
                         cresp.devices.append(
                             api_pb2.DeviceSpec(
                                 container_path=f"/dev/{os.path.basename(path)}",
@@ -358,7 +469,8 @@ class TPUDevicePlugin:
             wid = self.worker_id() if full_host else None
             if wid is not None:
                 cresp.envs["TPU_WORKER_ID"] = str(wid)
-            if os.path.isdir(self.config.libtpu_dir):
+            if os.path.isdir(self.config.libtpu_dir) and not use_cdi:
+                # under CDI-default the spec's containerEdits carry this
                 cresp.mounts.append(
                     api_pb2.Mount(
                         container_path=self.config.libtpu_dir,
@@ -382,6 +494,7 @@ class TPUDevicePlugin:
         if self._server is not None:
             await self.stop()
         self.refresh_devices()
+        self.write_cdi_spec()
         os.makedirs(self.config.kubelet_dir, exist_ok=True)
         try:
             os.remove(self.config.socket_path)
@@ -416,6 +529,14 @@ class TPUDevicePlugin:
         log.info("registered %s with kubelet", self.config.resource_name)
 
     async def stop(self) -> None:
+        # reference-toolkit parity: specs are removed on shutdown so no
+        # orphaned file keeps resolving against a dead inventory (re-serve
+        # rewrites it)
+        if self.config.cdi_enabled:
+            try:
+                os.remove(self._cdi_spec_path())
+            except OSError:
+                pass
         if self._health_task:
             self._health_task.cancel()
             try:

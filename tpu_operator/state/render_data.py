@@ -89,6 +89,10 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
             "jax_env": list(spec.validator.jax.env),
         },
         "slice_strategy": spec.slice_manager.strategy,
+        # CDI (reference cdi sub-spec): the device plugin maintains the
+        # host CDI spec when enabled and answers with CDI device names
+        # when default
+        "cdi": {"enabled": spec.cdi.enabled, "default": spec.cdi.default},
     }
 
 
