@@ -83,3 +83,41 @@ async def test_fake_apiserver_enforces_admission():
             ok = {**live, "spec": {**live["spec"], "version": "2"}}
             updated = await client.update(ok)
             assert updated["spec"]["version"] == "2"
+
+
+def test_vm_runtime_constraints_rejected_at_admission():
+    """A malformed vmRuntime entry must be REJECTED with a path'd error at
+    admission — not silently dropped at render time, leaving the user's
+    pods an opaque "RuntimeClass not found" (r04 review finding)."""
+    schema = admission.spec_schema(GROUP, "TPUClusterPolicy")
+    assert schema is not None
+
+    def errs(vm: dict) -> list[str]:
+        return admission.validate_spec(schema, {"vmRuntime": vm})
+
+    # uppercase name fails the DNS-label pattern
+    out = errs({"runtimeClasses": [{"name": "Kata-TPU"}]})
+    assert any("runtimeClasses[0].name" in e and "does not match" in e for e in out)
+    # entry without a name fails required
+    out = errs({"runtimeClasses": [{"handler": "kata"}]})
+    assert any("missing required field 'name'" in e for e in out)
+    # non-object entry fails the structural type check
+    out = errs({"runtimeClasses": ["kata-tpu"]})
+    assert any("runtimeClasses[0]: expected object" in e for e in out)
+    # hostile handler alphabet
+    out = errs({"runtimeClasses": [{"name": "ok", "handler": "a/b"}]})
+    assert any("handler" in e for e in out)
+    # config_dir traversal / relative / unsafe chars all fail the pattern
+    for bad in ("../../opt/evil", "/etc/containerd/../../evil", "relative/dir", "/etc/conf d"):
+        assert any("configDir" in e for e in errs({"configDir": bad})), bad
+    # trailing newline must be rejected: Python's `$` matches before a
+    # final newline, RE2's (the real apiserver's) does not — CEL-lite uses
+    # fullmatch so the fake apiserver is never laxer than production
+    out = errs({"runtimeClasses": [{"name": "kata\n", "handler": "a\n"}], "configDir": "/etc\n"})
+    assert sum("does not match" in e for e in out) == 3
+    # the default spec and a well-formed custom one are admitted
+    assert errs({}) == []
+    assert errs({
+        "runtimeClasses": [{"name": "kata-tpu", "handler": "kata_v2"}],
+        "configDir": "/etc/containerd/conf.d",
+    }) == []

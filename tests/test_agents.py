@@ -271,6 +271,13 @@ def test_vm_runtime_manager_stages_containerd_config(tmp_path, monkeypatch):
     assert vrm.stage(classes[:1], "/etc/containerd/conf.d") == 1
     assert not (conf / "tpu-vm-runtime-kata-clh.toml").exists()
     assert (conf / "tpu-vm-runtime-kata-tpu.toml").exists()
+    # writes are atomic (tmp + rename): containerd reloading conf.d
+    # mid-converge must never parse a half-written privileged handler;
+    # a leftover tmp from a crash is pruned on the next converge
+    assert not list(conf.glob("*.tmp"))
+    (conf / "tpu-vm-runtime-crashed.toml.tmp").write_text("version = 2\n")
+    vrm.stage(classes[:1], "/etc/containerd/conf.d")
+    assert not list(conf.glob("*.tmp"))
 
 
 def test_vm_runtime_extras_rejects_hostile_classes():
@@ -287,11 +294,25 @@ def test_vm_runtime_extras_rejects_hostile_classes():
         {"name": "Bad_Name"},
         {"name": "slash", "handler": "a/b"},
         {"name": "inject", "handler": "x\ny"},
+        {"name": "trail\n", "handler": "a\n"},  # Python `$` newline trap
         "not-a-dict",
     ]}})
     out = _vm_runtime_extras(ClusterContext(namespace="ns"), spec)["vm_runtime"]
     assert [c["name"] for c in out["runtime_classes"]] == ["ok-class"]
     assert out["classes_env"] == "ok-class=ok_handler"
+
+
+def test_vm_runtime_extras_rejects_traversal_config_dir():
+    """A config_dir that escapes TPU_HW_ROOT (admission rejects it; this is
+    the render layer's defense in depth) falls back to the default instead
+    of reaching the hostPath template / the agent's root-relative join."""
+    from tpu_operator.api.types import TPUClusterPolicySpec
+    from tpu_operator.state.render_data import ClusterContext, _vm_runtime_extras
+
+    for bad in ("../../opt/evil", "/etc/containerd/../../evil", "/etc/conf d", "/etc\n", ""):
+        spec = TPUClusterPolicySpec.from_dict({"vmRuntime": {"configDir": bad}})
+        out = _vm_runtime_extras(ClusterContext(namespace="ns"), spec)["vm_runtime"]
+        assert out["config_dir"] == "/etc/containerd/conf.d"
 
 
 def test_parse_duration():

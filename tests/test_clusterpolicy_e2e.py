@@ -441,3 +441,24 @@ async def test_manager_watch_driven_convergence():
                         body = await r.text()
                         assert "tpu_operator_reconciliation_total" in body
                         assert "tpu_operator_tpu_nodes_total 1.0" in body
+
+
+async def test_sandbox_enabled_without_vm_nodes_goes_ready():
+    """sandboxWorkloads enabled while every TPU node runs container
+    workloads: the vm chain's DaemonSets match zero nodes and must be
+    vacuously ready (object_controls.go:3363-3366 — a desired==0 operand DS
+    is Ready), not wedge the whole policy notReady until a vm-passthrough
+    node joins."""
+    async with FakeCluster() as fc:
+        fc.add_node("tpu-ctr-0")  # container workload config (default)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            cr = TPUClusterPolicy.new()
+            cr.obj["spec"]["sandboxWorkloads"] = {"enabled": True}
+            cr.obj["spec"]["vmRuntime"] = {"enabled": True}
+            await client.create(cr.obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            obj, _ = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+            # the vm-chain operands exist (capability installed), just idle
+            ds = await client.get("apps", "DaemonSet", "tpu-vm-runtime-manager", NS)
+            assert deep_get(ds, "status", "desiredNumberScheduled", default=0) == 0

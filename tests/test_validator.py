@@ -946,3 +946,27 @@ async def test_multihost_stale_epoch_evidence_rejected(validation_root):
             assert deep_get(svc, "metadata", "annotations", default={}).get(
                 components.VALIDATED_EPOCH_ANNOTATION
             ) == payload["epoch"]
+
+
+async def test_perf_probes_skip_on_slice_member(validation_root):
+    """On a multi-host slice member a node-local probe pod would request
+    every host chip and hang in single-process slice init (the same reason
+    validate_jax branches to the coordinated multi-host program) — perf
+    must record an honest skip and spawn NO pod (r04 review finding)."""
+    from tpu_operator.k8s.client import ApiError
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        _slice_node(fc, "tpu-0", "0")
+        _slice_node(fc, "tpu-1", "1")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("jax")
+            v = Validator(
+                fast_config(node_name="tpu-0", with_workload=True, workload_retries=5),
+                client=client,
+            )
+            await v.run("perf")
+            payload = status.read_status("perf")
+            assert payload["ok"] is True
+            assert "slice" in payload and "skipped" in payload
+            with pytest.raises(ApiError):
+                await client.get("", "Pod", "tpu-perf-probes", NS)

@@ -88,8 +88,12 @@ def stage(classes: list[tuple[str, str]], config_dir: str) -> int:
                     continue
         except OSError:
             pass
-        with open(path, "w") as f:
+        # atomic: containerd may reload conf.d mid-write; a half-written
+        # TOML for a privileged runtime handler must never be observable
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             f.write(content)
+        os.replace(tmp, path)
         changed += 1
         log.info("staged containerd runtime config %s", path)
     for name in os.listdir(directory):

@@ -17,6 +17,12 @@ Supported subset ("CEL-lite") — exactly what the generator emits:
 - ``self == oldSelf`` transition rules (field immutability)
 - ``enum`` membership
 - ``minimum`` / ``maximum`` numeric bounds
+- ``pattern`` string regexes (the generator's patterns are fully
+  anchored; enforced with fullmatch so Python's newline-tolerant ``$``
+  cannot admit strings RE2 would reject)
+- structural ``type`` for object/array/string, and ``required`` keys of
+  object values (enough to reject a malformed list entry with a path'd
+  error instead of silently dropping it downstream)
 
 Any other CEL expression is ignored (fail-open: full CEL belongs to the
 real apiserver; silently mis-evaluating it here would be worse than
@@ -25,6 +31,7 @@ skipping it).
 
 from __future__ import annotations
 
+import re
 from typing import Any, Optional
 
 # sentinel: "no previous object" (create) vs "previous value absent" (None)
@@ -48,12 +55,34 @@ def _effective(value: Any, schema: dict) -> Any:
     return schema.get("default") if value is None else value
 
 
+_STRUCTURAL_TYPES = {"object": dict, "array": list, "string": str}
+
+
 def _walk(schema: dict, new: Any, old: Any, path: str, errors: list[str]) -> None:
     effective = _effective(new, schema)
+
+    expected = schema.get("type")
+    py_type = _STRUCTURAL_TYPES.get(expected)
+    if py_type is not None and effective is not None and not isinstance(effective, py_type):
+        errors.append(f"{path}: expected {expected}, got {type(effective).__name__}")
+        return  # nested checks assume the right shape
+
+    if isinstance(effective, dict):
+        for req in schema.get("required") or []:
+            if effective.get(req) is None:
+                errors.append(f"{path}: missing required field {req!r}")
 
     enum = schema.get("enum")
     if enum is not None and effective is not None and effective not in enum:
         errors.append(f"{path}: {effective!r} not one of {sorted(enum)}")
+
+    pattern = schema.get("pattern")
+    if pattern is not None and isinstance(effective, str) and not re.fullmatch(pattern, effective):
+        # fullmatch, not search: the generator's patterns are fully
+        # anchored, and Python's `$` would admit a trailing newline that
+        # the apiserver's RE2 (end-of-text `$`) rejects — search here
+        # would make the fake apiserver laxer than production
+        errors.append(f"{path}: {effective!r} does not match {pattern}")
 
     if isinstance(effective, (int, float)) and not isinstance(effective, bool):
         minimum = schema.get("minimum")

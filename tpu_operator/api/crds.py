@@ -8,6 +8,7 @@ writes the YAML into deploy/crds/ (done at build time, like `make manifests`).
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import typing
 from typing import Any, Optional, get_args, get_origin, get_type_hints
@@ -53,11 +54,16 @@ def schema_of(cls: type) -> dict:
         enum = (f.metadata or {}).get("enum")
         if enum:
             schema["enum"] = list(enum)
-        # kubebuilder Minimum/Maximum analogues
-        for marker in ("minimum", "maximum"):
+        # kubebuilder Minimum/Maximum/Pattern analogues
+        for marker in ("minimum", "maximum", "pattern"):
             value = (f.metadata or {}).get(marker)
             if value is not None:
                 schema[marker] = value
+        # explicit items schema for free-form list fields the type system
+        # can't constrain (e.g. vmRuntime.runtimeClasses name/handler rules)
+        items_schema = (f.metadata or {}).get("items_schema")
+        if items_schema:
+            schema["items"] = copy.deepcopy(items_schema)
         # kubebuilder XValidation analogue (nvidiadriver_types.go:44-47
         # pins driverType immutable this way): CEL rules enforced at
         # admission by the real apiserver, and by api/admission.py's

@@ -364,6 +364,17 @@ class SandboxWorkloadsSpec(SpecBase):
     extra_fields: dict = field(default_factory=dict)
 
 
+# Schema patterns for the vm-runtime contracts (admission-enforced; the
+# render layer keeps an equivalent filter as defense in depth).  RuntimeClass
+# names are DNS labels; containerd handler tokens are similarly restricted;
+# config_dir must be an absolute path whose every component starts with a
+# non-dot character (blocks `..` traversal out of TPU_HW_ROOT without
+# needing lookaheads — the apiserver's pattern engine is RE2).
+VM_CLASS_NAME_PATTERN = r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$"
+VM_HANDLER_PATTERN = r"^[A-Za-z0-9_-]{1,63}$"
+VM_CONFIG_DIR_PATTERN = r"^(/[A-Za-z0-9_-][A-Za-z0-9._-]*)+$"
+
+
 @dataclass
 class VMRuntimeSpec(OperandSpec):
     """state-vm-runtime: VM-isolation runtime manager (kata-manager
@@ -376,11 +387,27 @@ class VMRuntimeSpec(OperandSpec):
     state-vfio-manager / state-sandbox-device-plugin)."""
 
     runtime_classes: list = field(
-        default_factory=lambda: [{"name": "kata-tpu", "handler": "kata-tpu"}]
+        default_factory=lambda: [{"name": "kata-tpu", "handler": "kata-tpu"}],
+        # a malformed entry must be REJECTED at admission (with the path and
+        # rule in the error), not silently dropped at render time leaving an
+        # opaque "RuntimeClass not found" for the user's pods
+        metadata={"items_schema": {
+            "type": "object",
+            "required": ["name"],
+            "properties": {
+                "name": {"type": "string", "pattern": VM_CLASS_NAME_PATTERN},
+                "handler": {"type": "string", "pattern": VM_HANDLER_PATTERN},
+            },
+        }},
     )
     # containerd drop-in directory the agent stages handler configs into
-    # (COS/GKE containerd loads conf.d includes)
-    config_dir: str = "/etc/containerd/conf.d"
+    # (COS/GKE containerd loads conf.d includes); pattern keeps it inside
+    # TPU_HW_ROOT (the agent joins it with lstrip("/")) and safe for the
+    # unquoted hostPath template
+    config_dir: str = field(
+        default="/etc/containerd/conf.d",
+        metadata={"pattern": VM_CONFIG_DIR_PATTERN},
+    )
 
 
 @dataclass

@@ -294,8 +294,13 @@ class TPUDevicePlugin:
             await asyncio.sleep(self.config.health_interval)
             changed = self.refresh_devices()
             # every tick, not only on inventory changes: the spec also
-            # tracks libtpu/device-node filesystem state (see docstring)
-            self.write_cdi_spec()
+            # tracks libtpu/device-node filesystem state (see docstring).
+            # A transient host-fs error (ro cdi_dir, ENOSPC) must not kill
+            # the loop — health refresh is what keeps kubelet truthful.
+            try:
+                self.write_cdi_spec()
+            except OSError as e:
+                log.warning("CDI spec write failed (will retry): %s", e)
             if changed:
                 for queue in list(self._watchers):
                     queue.put_nowait(None)

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from tpu_operator import consts
+from tpu_operator.api import types as api_types
 from tpu_operator.api.types import OperandSpec, TPUClusterPolicySpec
 
 
@@ -180,12 +181,15 @@ def _feature_discovery_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -
     return {"feature_discovery": {"sleep_interval": spec.feature_discovery.sleep_interval}}
 
 
-# RuntimeClass names are DNS labels; containerd handler tokens are similarly
-# restricted.  Anything outside this alphabet could smuggle separators into
-# the agent's name=handler,... env contract, path components into the
-# drop-in filename, or raw lines into the privileged containerd config.
-_VM_CLASS_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
-_VM_HANDLER_RE = re.compile(r"^[A-Za-z0-9_-]{1,63}$")
+# Anything outside the schema alphabets could smuggle separators into the
+# agent's name=handler,... env contract, path components into the drop-in
+# filename, or raw lines into the privileged containerd config.  Admission
+# rejects malformed entries with a path'd error (api/types.py VM_* patterns,
+# enforced by the apiserver / CEL-lite); this filter is defense in depth for
+# objects that never passed admission.
+_VM_CLASS_NAME_RE = re.compile(api_types.VM_CLASS_NAME_PATTERN)
+_VM_HANDLER_RE = re.compile(api_types.VM_HANDLER_PATTERN)
+_VM_CONFIG_DIR_RE = re.compile(api_types.VM_CONFIG_DIR_PATTERN)
 
 
 def _vm_runtime_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
@@ -198,15 +202,20 @@ def _vm_runtime_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         for rc in vr.runtime_classes
         if isinstance(rc, dict)
         and isinstance(rc.get("name"), str)
-        and _VM_CLASS_NAME_RE.match(rc["name"])
-        and _VM_HANDLER_RE.match(str(rc.get("handler") or rc["name"]))
+        and _VM_CLASS_NAME_RE.fullmatch(rc["name"])
+        and _VM_HANDLER_RE.fullmatch(str(rc.get("handler") or rc["name"]))
     ]
+    config_dir = vr.config_dir
+    if not _VM_CONFIG_DIR_RE.fullmatch(config_dir or ""):
+        # never let a traversal/unsafe path reach the hostPath template or
+        # the agent's root-relative join (admission already rejects this)
+        config_dir = "/etc/containerd/conf.d"
     return {
         "vm_runtime": {
             "runtime_classes": classes,
             # the agent's VM_RUNTIME_CLASSES env contract: name=handler list
             "classes_env": ",".join(f"{c['name']}={c['handler']}" for c in classes),
-            "config_dir": vr.config_dir,
+            "config_dir": config_dir,
         }
     }
 

@@ -58,15 +58,25 @@ def _obj_key(obj: dict) -> tuple[str, str, str]:
     )
 
 
-def daemonset_ready(ds: dict) -> bool:
-    """Desired != 0 and Desired == Available == Updated
-    (state_skel.go:439-441; OnDelete revision matching is approximated by
-    updatedNumberScheduled, which our fake kubelet maintains)."""
+def daemonset_ready(ds: dict, empty_ok: bool = False) -> bool:
+    """Desired == Available == Updated (OnDelete revision matching is
+    approximated by updatedNumberScheduled, which our fake kubelet
+    maintains).  Two rules for desired == 0, both from the reference:
+
+    - ``empty_ok=False`` (per-pool runtime DS, state_skel.go:439-441):
+      pools are derived from live nodes, so a zero-desired DS is stale —
+      NOT ready.
+    - ``empty_ok=True`` (ClusterPolicy operand chain,
+      object_controls.go:3363-3366): operands are gated by per-node
+      workload-config deploy labels, and a gate matching no nodes is a
+      normal configuration (e.g. sandboxWorkloads enabled before any
+      vm-passthrough node joins) — vacuously ready."""
     status = ds.get("status") or {}
     desired = status.get("desiredNumberScheduled", 0)
+    if desired == 0:
+        return empty_ok
     return (
-        desired != 0
-        and desired == status.get("numberAvailable", 0)
+        desired == status.get("numberAvailable", 0)
         and desired == status.get("updatedNumberScheduled", 0)
     )
 
@@ -158,7 +168,7 @@ class OperandState:
         for obj in live_objs:
             kind = obj.get("kind")
             name = deep_get(obj, "metadata", "name", default="?")
-            if kind == "DaemonSet" and not daemonset_ready(obj):
+            if kind == "DaemonSet" and not daemonset_ready(obj, empty_ok=True):
                 return False, f"DaemonSet {name} not ready"
             if kind == "Deployment" and not deployment_ready(obj):
                 return False, f"Deployment {name} not ready"

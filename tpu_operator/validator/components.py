@@ -438,6 +438,25 @@ class Validator:
         if self.config.with_workload:
             from tpu_operator.k8s import nodeinfo
 
+            group = await self._slice_group()
+            if group is not None:
+                # a slice member's chips only initialize inside the
+                # coordinated jax.distributed program — a node-local
+                # single-process probe pod would request every host chip and
+                # hang in slice init (the same reason validate_jax branches
+                # to validate_jax_multihost).  Per-link ICI and allreduce
+                # busbw for the slice are measured by that coordinated run;
+                # chip-local matmul/HBM probes have no valid node-local
+                # execution here, so record the skip honestly instead of
+                # chronically failing perf-ready on healthy slices.
+                status.write_ready("perf", {
+                    "ok": True,
+                    "skipped": "multi-host slice member: node-local PJRT "
+                               "init is invalid; slice perf is measured by "
+                               "the coordinated multi-host validation",
+                    "slice": group[0],
+                })
+                return
             chips = await self._node_chip_count()
             node = await self.client().get("", "Node", self.config.node_name)
             generation = nodeinfo.attributes(node).generation
