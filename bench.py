@@ -295,6 +295,12 @@ def main() -> None:
             k: workload_hbm.get(k)
             for k in ("ok", "gbps", "fraction_of_peak", "overhead_dominated")
         },
+        # pallas DMA-pipeline cross-check: agreement with workload_hbm is
+        # the ceiling evidence (docs/PARITY.md), divergence isolates faults
+        "workload_hbm_dma": {
+            k: checks.get("hbm-dma", {}).get(k)
+            for k in ("ok", "gbps", "fraction_of_peak", "slots", "overhead_dominated")
+        },
         "hbm": {
             k: hbm.get(k)
             for k in ("ok", "backend", "generation", "size_mb", "gbps",

@@ -262,6 +262,7 @@ async def test_perf_probes_in_process(validation_root):
     assert payload["checks"]["matmul"]["tflops"] > 0
     assert payload["checks"]["ring"]["link_gbps"] > 0
     assert payload["checks"]["hbm"]["gbps"] > 0
+    assert payload["checks"]["hbm-dma"]["gbps"] > 0
     # cpu backend: no published peak → fraction/mfu never fabricated
     assert payload["checks"]["matmul"]["mfu"] is None
     assert payload["checks"]["hbm"]["fraction_of_peak"] is None
@@ -317,7 +318,7 @@ async def test_perf_probes_workload_pod(validation_root):
                 e["name"]: e.get("value", "")
                 for e in deep_get(pod, "spec", "containers", 0, "env")
             }
-            assert env["WORKLOAD_CHECKS"] == "matmul,hbm,ring"
+            assert env["WORKLOAD_CHECKS"] == "matmul,hbm,hbm-dma,ring"
             assert env["RESULTS_SCOPE"] == "perf"
             # 4 chips → per-link ring floor armed from the catalogue
             assert float(env["RING_MIN_GBPS"]) > 0

@@ -144,6 +144,7 @@ def _measured_from_results(results: Optional[dict]) -> dict:
     ring = checks.get("ring") or dist.get("ring") or {}
     matmul = checks.get("matmul") or {}
     hbm = checks.get("hbm") or {}
+    hbm_dma = checks.get("hbm-dma") or {}
 
     def _num(value):
         return (
@@ -169,6 +170,10 @@ def _measured_from_results(results: Optional[dict]) -> dict:
         ("mfu", _measured(matmul, "mfu")),
         ("hbm_gbps", _measured(hbm, "gbps")),
         ("hbm_fraction_of_peak", _measured(hbm, "fraction_of_peak")),
+        # the DMA-pipeline cross-check: same units as hbm_gbps, VPU-free
+        # path — divergence between the two isolates memory-system vs
+        # compute-pipeline degradation (workloads/hbm_pallas.py)
+        ("hbm_dma_gbps", _measured(hbm_dma, "gbps")),
     ):
         if value is not None:
             out[key] = value
@@ -463,8 +468,9 @@ class Validator:
             ring_min = _ring_min_gbps(generation) if chips > 1 else 0.0
             # multi-chip: ring per-link diagnostic; single chip: the burn-in
             # train-step moves here from the gate (still proven, just not on
-            # the readiness critical path)
-            checks = "matmul,hbm" + (",ring" if chips > 1 else ",burn-in")
+            # the readiness critical path).  hbm-dma is the pallas
+            # DMA-pipeline cross-check paired with hbm (fault isolation)
+            checks = "matmul,hbm,hbm-dma" + (",ring" if chips > 1 else ",burn-in")
             # clear the previous run's drop-box FIRST: a failed probe run
             # must surface as "no current measurements", never republish
             # last round's healthy figures to the degradation alerts
@@ -499,6 +505,7 @@ class Validator:
                     collectives,
                     compile_cache,
                     hbm_bench,
+                    hbm_pallas,
                     matmul_bench,
                 )
 
@@ -514,6 +521,7 @@ class Validator:
                 probes = {
                     "matmul": matmul_bench.quick_benchmark,
                     "hbm": hbm_bench.quick_benchmark,
+                    "hbm-dma": hbm_pallas.quick_benchmark,
                     "ring": lambda: collectives.apply_ring_gate(
                         collectives.ring_benchmark(size_mb=2, iters=2, best_of=2),
                         ring_min,

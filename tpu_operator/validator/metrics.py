@@ -58,6 +58,9 @@ class NodeMetrics:
         # below the allreduce floor on healthy hardware (ADVICE r03)
         "ring_min_gbps": "ring_min_gbps",
         "hbm_gbps": "hbm_gbps",
+        # pallas DMA-pipeline cross-check (VPU-free): compare against
+        # hbm_gbps to isolate memory-system vs compute-pipeline faults
+        "hbm_dma_gbps": "hbm_dma_gbps",
         "hbm_fraction_of_peak": "hbm_fraction_of_peak",
     }
 

@@ -6,9 +6,10 @@ the validator (and humans reading pod logs) see the numbers.
 
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
-  vector-add,allreduce,burn-in,matmul,hbm,ring (default runs the first
-  three; matmul/hbm/ring are opt-in — they hold the chip longer; ring is
-  the per-ICI-link diagnostic, gated by RING_MIN_GBPS)
+  vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring (default runs the
+  first three; matmul/hbm/hbm-dma/ring are opt-in — they hold the chip
+  longer; ring is the per-ICI-link diagnostic, gated by RING_MIN_GBPS;
+  hbm-dma is the pallas DMA-pipeline cross-check, report-only)
 - ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
@@ -28,6 +29,7 @@ def main() -> int:
 
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
+    import jax  # after the platform guard: first import may init a backend
 
     checks = [
         c.strip()
@@ -96,6 +98,24 @@ def main() -> int:
                 ),
                 float(os.environ.get("HBM_MIN_GBPS", "0") or 0),
             )
+        elif check == "hbm-dma":
+            # pallas DMA-pipeline cross-check (report-only by design): same
+            # units AND same env-driven working set as hbm — the pair's
+            # agreement/divergence is only meaningful over identical sizes
+            from tpu_operator.workloads import hbm_pallas
+
+            if jax.default_backend() == "tpu":
+                result = hbm_pallas.dma_stream_benchmark(
+                    size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
+                    iters=int(os.environ.get("HBM_ITERS", "1024")),
+                    chunk_mb=float(os.environ.get("HBM_DMA_CHUNK_MB", "4")),
+                    slots=int(os.environ.get("HBM_DMA_SLOTS", "4")),
+                    best_of=int(os.environ.get("HBM_BEST_OF", "3")),
+                )
+            else:
+                # interpret mode: full-size would take minutes in the
+                # python DMA emulator — toy shapes, figures labelled cpu
+                result = hbm_pallas.quick_benchmark()
         else:
             result = {"ok": False, "error": f"unknown check {check}"}
         print(json.dumps({"check": check, **result}), flush=True)
