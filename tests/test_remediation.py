@@ -1,0 +1,233 @@
+"""Remediation controller tests: the label-driven re-validation machine
+(requested -> revalidating -> healthy | remediation-failed)."""
+
+import asyncio
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.controllers import remediation as rem
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+async def _mk_cluster(fc, n_nodes=1, **remediation_spec):
+    client = ApiClient(Config(base_url=fc.base_url))
+    spec = {"remediation": remediation_spec} if remediation_spec else {}
+    await client.create(TPUClusterPolicy.new(spec=spec).obj)
+    for i in range(n_nodes):
+        node = fc.add_node(f"tpu-{i}")
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        fc.put(node)
+    return client
+
+
+def _validator_pod(fc, node_name, phase="Running", suffix=""):
+    fc.put({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"tpu-operator-validator-{node_name}{suffix}",
+                     "namespace": NS,
+                     "labels": {"app": "tpu-operator-validator"}},
+        "spec": {"nodeName": node_name, "containers": [{"name": "c"}]},
+        "status": {"phase": phase},
+    })
+
+
+async def _request(client, node_name):
+    await client.patch(
+        "", "Node", node_name,
+        {"metadata": {"labels": {consts.VALIDATE_REQUEST_LABEL: "requested"}}},
+    )
+
+
+async def _node(client, name):
+    return await client.get("", "Node", name)
+
+
+def _state(node):
+    return deep_get(node, "metadata", "labels", default={}).get(
+        consts.REMEDIATION_STATE_LABEL, ""
+    )
+
+
+async def test_requested_node_revalidates_to_healthy(validation_root):
+    """The happy loop: request label -> validator pods deleted (their
+    preStop clears the node's ready markers) -> fresh Running pod is the
+    proof -> healthy, request cleared."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        _validator_pod(fc, "tpu-0")  # stale evidence
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.REVALIDATING
+            # the stale pod was deleted — its Running phase must not count
+            pods = await client.list_items(
+                "", "Pod", NS, label_selector="app=tpu-operator-validator"
+            )
+            assert [p for p in pods if not deep_get(p, "metadata", "deletionTimestamp")] == []
+
+            _validator_pod(fc, "tpu-0", suffix="-fresh")  # DS recreated it
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.HEALTHY
+            labels = node["metadata"]["labels"]
+            assert consts.VALIDATE_REQUEST_LABEL not in labels
+            assert not deep_get(node, "spec", "unschedulable")
+        finally:
+            await client.close()
+
+
+async def test_failed_revalidation_cordons_and_recovers(validation_root):
+    """A Failed fresh pod marks the node remediation-failed and cordons it
+    (cordonOnFailure default); a re-request after the fix re-proves and
+    uncordons — but ONLY because the cordon was ours (annotation)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            _validator_pod(fc, "tpu-0", phase="Failed", suffix="-a")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.FAILED
+            assert deep_get(node, "spec", "unschedulable") is True
+            anns = node["metadata"]["annotations"]
+            assert anns[consts.REMEDIATION_CORDONED_ANNOTATION] == "true"
+            # sticky: no request -> no further transitions
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.FAILED
+
+            # admin fixes the node and re-requests
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.REVALIDATING
+            _validator_pod(fc, "tpu-0", suffix="-b")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.HEALTHY
+            assert not deep_get(node, "spec", "unschedulable")
+            assert not deep_get(node, "metadata", "annotations", default={}).get(
+                consts.REMEDIATION_CORDONED_ANNOTATION
+            )
+        finally:
+            await client.close()
+
+
+async def test_admin_cordon_never_released(validation_root):
+    """A node the ADMIN cordoned stays cordoned through a healthy
+    re-validation — the controller only undoes its own cordons."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        await client.patch("", "Node", "tpu-0", {"spec": {"unschedulable": True}})
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            _validator_pod(fc, "tpu-0", suffix="-fresh")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.HEALTHY
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+async def test_max_parallel_bounds_admission(validation_root):
+    """Each re-validation occupies the node's chips: with maxParallel=1,
+    the second request waits until the first completes."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=2, maxParallel=1)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await _request(client, "tpu-1")
+            await r.reconcile("remediation")
+            states = {}
+            for i in range(2):
+                states[f"tpu-{i}"] = _state(await _node(client, f"tpu-{i}"))
+            assert sorted(states.values()) == ["", rem.REVALIDATING]
+            busy = next(n for n, s in states.items() if s == rem.REVALIDATING)
+            _validator_pod(fc, busy, suffix="-fresh")
+            await r.reconcile("remediation")  # busy node completes
+            await r.reconcile("remediation")  # frees the slot for the other
+            states = {_state(await _node(client, f"tpu-{i}")) for i in range(2)}
+            assert states == {rem.HEALTHY, rem.REVALIDATING}
+        finally:
+            await client.close()
+
+
+async def test_validation_timeout_marks_failed(validation_root):
+    """No fresh pod within validationTimeoutSeconds -> remediation-failed
+    (a node whose validator never comes back is exactly the node to
+    cordon)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, validationTimeoutSeconds=1)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.REVALIDATING
+            await asyncio.sleep(1.1)
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == rem.FAILED
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+async def test_disabled_releases_state_and_our_cordon(validation_root):
+    """remediation.enabled=false clears the machine's labels and releases
+    only cordons the controller itself placed (upgrade _clear_labels
+    analogue)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, validationTimeoutSeconds=1)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            await asyncio.sleep(1.1)
+            await r.reconcile("remediation")  # -> failed + our cordon
+            assert deep_get(await _node(client, "tpu-0"), "spec", "unschedulable")
+
+            policy = await client.get(
+                "tpu.google.com", "TPUClusterPolicy", "cluster-policy"
+            )
+            policy["spec"]["remediation"]["enabled"] = False
+            await client.update(policy)
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""
+            assert not deep_get(node, "spec", "unschedulable")
+        finally:
+            await client.close()
+
+
+async def test_readmission_not_instantly_timed_out(validation_root):
+    """A node that failed remediation HOURS ago and is re-requested must get
+    a fresh validation window — the advance loop must not read the stale
+    terminal-state timestamp in the same pass as admission and instantly
+    re-fail it (r04 review finding)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, validationTimeoutSeconds=1)
+        node = await client.get("", "Node", "tpu-0")
+        node["metadata"]["labels"][consts.REMEDIATION_STATE_LABEL] = rem.FAILED
+        node["metadata"].setdefault("annotations", {})[
+            consts.REMEDIATION_STATE_TS_ANNOTATION
+        ] = "2020-01-01T00:00:00.000000Z"
+        fc.put(node)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            live = await _node(client, "tpu-0")
+            assert _state(live) == rem.REVALIDATING
+            assert not deep_get(live, "spec", "unschedulable")
+        finally:
+            await client.close()

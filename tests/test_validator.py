@@ -453,8 +453,13 @@ def test_cli_cleanup_and_wait(validation_root):
     )
 
 
-def test_metrics_mode(validation_root, fake_hw, capsys):
+def test_metrics_mode(validation_root, fake_hw, capsys, monkeypatch):
     from tpu_operator.validator import cli
+
+    # every series carries the NODE name (downward-API env): Prometheus's
+    # `instance` is the pod endpoint, and the alert runbooks/remediation
+    # channel label *nodes*
+    monkeypatch.setenv("NODE_NAME", "tpu-node-0")
 
     status.write_ready("libtpu")
     status.write_ready("pjrt")
@@ -470,18 +475,18 @@ def test_metrics_mode(validation_root, fake_hw, capsys):
     })
     assert cli.main(["--component", "metrics", "--oneshot"]) == 0
     out = capsys.readouterr().out
-    assert 'tpu_validator_validation_status{component="libtpu"} 1.0' in out
-    assert 'tpu_validator_validation_status{component="jax"} 1.0' in out
-    assert 'tpu_validator_validation_status{component="perf"} 1.0' in out
-    assert "tpu_validator_tpu_device_count 4.0" in out
+    assert 'tpu_validator_validation_status{component="libtpu",node="tpu-node-0"} 1.0' in out
+    assert 'tpu_validator_validation_status{component="jax",node="tpu-node-0"} 1.0' in out
+    assert 'tpu_validator_validation_status{component="perf",node="tpu-node-0"} 1.0' in out
+    assert 'tpu_validator_tpu_device_count{node="tpu-node-0"} 4.0' in out
     # measured perf surfaced from the jax payload + perf merge
-    assert 'tpu_validator_measured{metric="allreduce_gbps"} 12.5' in out
-    assert 'tpu_validator_measured{metric="mfu"} 0.94' in out
-    assert 'tpu_validator_measured{metric="ring_link_gbps"} 45.0' in out
-    assert 'tpu_validator_measured{metric="ring_min_gbps"} 12.5' in out
-    assert 'tpu_validator_measured{metric="hbm_gbps"} 660.0' in out
-    assert 'tpu_validator_measured{metric="slice_workers"} 4.0' in out
-    assert 'tpu_validator_measured{metric="multislice_workers"} 8.0' in out
+    assert 'tpu_validator_measured{metric="allreduce_gbps",node="tpu-node-0"} 12.5' in out
+    assert 'tpu_validator_measured{metric="mfu",node="tpu-node-0"} 0.94' in out
+    assert 'tpu_validator_measured{metric="ring_link_gbps",node="tpu-node-0"} 45.0' in out
+    assert 'tpu_validator_measured{metric="ring_min_gbps",node="tpu-node-0"} 12.5' in out
+    assert 'tpu_validator_measured{metric="hbm_gbps",node="tpu-node-0"} 660.0' in out
+    assert 'tpu_validator_measured{metric="slice_workers",node="tpu-node-0"} 4.0' in out
+    assert 'tpu_validator_measured{metric="multislice_workers",node="tpu-node-0"} 8.0' in out
     # absent measurements materialize no series
     assert 'metric="matmul_tflops"' not in out
 
@@ -496,7 +501,7 @@ def test_metrics_mode(validation_root, fake_hw, capsys):
     status.write_ready("perf", {"ok": True, "checks": {}})
     m.scrape()
     out2 = m.render().decode()
-    assert 'tpu_validator_measured{metric="allreduce_gbps"} 3.0' in out2
+    assert 'tpu_validator_measured{metric="allreduce_gbps",node="tpu-node-0"} 3.0' in out2
     assert 'metric="ring_link_gbps"' not in out2
     assert 'metric="multislice_workers"' not in out2
 

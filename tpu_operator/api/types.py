@@ -423,6 +423,26 @@ class CDISpec(SpecBase):
     extra_fields: dict = field(default_factory=dict)
 
 
+@dataclass
+class RemediationSpec(SpecBase):
+    """Label-driven node re-validation (controllers/remediation.py).
+
+    No reference analogue as a controller — the reference stops at
+    exporting validation state to Prometheus (validator/metrics.go); this
+    closes the loop.  ``tpu.google.com/tpu.validate=requested`` on a node
+    re-proves it through the validator chain; persistent failure cordons
+    it (when ``cordonOnFailure``)."""
+
+    enabled: bool = True
+    # a re-validation occupies the node's chips — bound the blast radius
+    max_parallel: int = field(default=1, metadata={"minimum": 1})
+    cordon_on_failure: bool = True
+    # seconds in revalidating before the node is marked failed (0 = wait
+    # forever); validation rounds are ~10s-minutes (BENCH figures)
+    validation_timeout_seconds: int = field(default=600, metadata={"minimum": 0})
+    extra_fields: dict = field(default_factory=dict)
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -447,6 +467,7 @@ class TPUClusterPolicySpec(SpecBase):
     sandbox_device_plugin: OperandSpec = field(default_factory=OperandSpec)
     psa: PSASpec = field(default_factory=PSASpec)
     cdi: CDISpec = field(default_factory=CDISpec)
+    remediation: RemediationSpec = field(default_factory=RemediationSpec)
     extra_fields: dict = field(default_factory=dict)
 
     # -- enable gates (isStateEnabled analogue, state_manager.go:994-1036) --

@@ -81,6 +81,7 @@ async def run(args: argparse.Namespace) -> None:
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
+    from tpu_operator.controllers.remediation import RemediationReconciler
     from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
@@ -88,6 +89,7 @@ async def run(args: argparse.Namespace) -> None:
     reconciler.setup(mgr)
     TPURuntimeReconciler(client, namespace, metrics=metrics).setup(mgr)
     UpgradeReconciler(client, namespace, metrics=metrics).setup(mgr)
+    RemediationReconciler(client, namespace, metrics=metrics).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

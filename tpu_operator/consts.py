@@ -29,6 +29,11 @@ TPU_WORKLOAD_CONFIG_LABEL = "tpu.google.com/tpu.workload.config"  # container | 
 SLICE_CONFIG_LABEL = "google.com/tpu.slice.config"
 SLICE_CONFIG_STATE_LABEL = "google.com/tpu.slice.config.state"  # pending|success|failed|rebooting
 UPGRADE_STATE_LABEL = "tpu.google.com/tpu-runtime-upgrade-state"
+# Remediation channel: admins/alert-automation set the request label; the
+# remediation controller answers on the state label (no reference analogue —
+# the reference stops at exporting validation state to Prometheus).
+VALIDATE_REQUEST_LABEL = "tpu.google.com/tpu.validate"          # value: requested
+REMEDIATION_STATE_LABEL = "tpu.google.com/tpu-remediation-state"
 # Pooled multi-host readiness: slice readiness is a SET property — every host
 # of the slice must advertise capacity before any host is marked ready
 # (SURVEY §7 hard part 1; no reference analogue, GPUs are node-local).
@@ -89,6 +94,11 @@ UPGRADE_REQUESTED_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-requested"
 # when the node entered its current upgrade state (drives the post-swap
 # validation timeout; survives operator restarts)
 UPGRADE_STATE_TS_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-state-ts"
+# when the node entered its current remediation state (validation timeout);
+# the cordoned annotation records the cordon is OURS — release never undoes
+# an admin's own cordon
+REMEDIATION_STATE_TS_ANNOTATION = "tpu.google.com/tpu-remediation-state-ts"
+REMEDIATION_CORDONED_ANNOTATION = "tpu.google.com/tpu-remediation-cordoned"
 
 # ---------------------------------------------------------------------------
 # Ordered operand state names (controllers/state_manager.go:795-813 analogue).
@@ -162,6 +172,7 @@ STATUS_FILES = {
 REQUEUE_NOT_READY_SECONDS = 5.0      # clusterpolicy_controller.go:165,193
 REQUEUE_NO_TPU_NODES_SECONDS = 45.0  # :199 (NFD-missing poll analogue)
 UPGRADE_REQUEUE_SECONDS = 120.0      # upgrade_controller.go:58,196
+REMEDIATION_REQUEUE_SECONDS = 30.0   # validation rounds are minutes, not hours
 RATE_LIMIT_BASE_SECONDS = 0.1        # clusterpolicy_controller.go:354
 RATE_LIMIT_MAX_SECONDS = 3.0
 VALIDATOR_SLEEP_SECONDS = 5.0        # validator/main.go:133-134

@@ -1,0 +1,291 @@
+"""Node remediation controller: label-driven re-validation, cordon on
+persistent failure.
+
+The reference leaves the loop open at observability: its
+node-status-exporter surfaces validation state to Prometheus
+(validator/metrics.go) and a human takes it from there.  This controller
+closes the loop with an actuation channel — capability on top of parity —
+while reusing the reference's own actuation mechanics: pod deletion to
+force the validator init chain to re-prove (the preStop of
+assets/state-operator-validation removes the node's *-ready markers, the
+reference pattern at 0500_daemonset.yaml:150-153), and the
+validator-app-Running gate before trusting a node
+(upgrade_controller.go:145 WithValidationEnabled analogue).
+
+Channel: an admin — or alert automation; the degradation PrometheusRules
+name the command — labels a node
+
+    tpu.google.com/tpu.validate=requested
+
+and the controller drives the per-node machine on
+``tpu.google.com/tpu-remediation-state``:
+
+    requested -> revalidating -> healthy | remediation-failed
+
+- admission into ``revalidating`` deletes the node's validator pods (the
+  DS-recreated pod's init chain re-proves libtpu->pjrt->plugin->jax; on a
+  multi-host slice, the epoch-keyed coordinated set).  Bounded by
+  ``remediation.maxParallel`` — each re-validation occupies chips.
+- a fresh non-terminating Running validator pod is the proof ->
+  ``healthy``; the request label is cleared and the node uncordoned IF
+  this controller cordoned it (recorded in an annotation — an admin's own
+  cordon is never undone).
+- a Failed validator pod, or ``validationTimeoutSeconds`` in state ->
+  ``remediation-failed``; with ``cordonOnFailure`` (default) the node is
+  cordoned: hardware that cannot re-prove its chips must not receive new
+  TPU workloads.  The state is sticky (upgrade-machine semantics) until
+  the admin re-requests validation after fixing the node.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
+from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.controllers.upgrade import VALIDATOR_POD_SELECTOR, _parse_ts
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.remediation")
+
+REQUESTED = "requested"
+REVALIDATING = "revalidating"
+HEALTHY = "healthy"
+FAILED = "remediation-failed"
+
+RECONCILE_KEY = "remediation"
+
+
+class RemediationReconciler:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, key: str) -> Optional[float]:
+        policy = await self._cluster_policy()
+        if policy is None:
+            return None
+        spec = policy.spec.remediation
+        nodes = [
+            n for n in await self.client.list_items("", "Node")
+            if clusterinfo.is_tpu_node(n)
+        ]
+        if not spec.enabled:
+            # disabled -> clear our state and release any cordon WE hold;
+            # in-flight requests are abandoned (upgrade _clear_labels
+            # analogue, upgrade_controller.go:199-227)
+            for node in nodes:
+                if self._state_of(node) or self._we_cordoned(node):
+                    await self._release(node)
+            await self._report([])
+            return consts.REMEDIATION_REQUEUE_SECONDS
+
+        states = {n["metadata"]["name"]: self._state_of(n) for n in nodes}
+        in_progress = sum(1 for s in states.values() if s == REVALIDATING)
+        max_parallel = max(1, spec.max_parallel)
+
+        # Admit requests within the parallelism bound.  A request on a
+        # FAILED/HEALTHY node re-enters the machine (that is how an admin
+        # re-tests after fixing hardware).
+        admitted: set[str] = set()
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if not self._requested(node) or states[name] == REVALIDATING:
+                continue
+            if in_progress >= max_parallel:
+                break
+            try:
+                await self._delete_validator_pods(name)
+                await self._set_state(name, REVALIDATING)
+            except ApiError as e:
+                log.error("remediation admit failed on %s: %s", name, e)
+                continue
+            states[name] = REVALIDATING
+            admitted.add(name)
+            in_progress += 1
+            log.info("re-validation started on %s", name)
+
+        # Advance in-flight nodes — but never one admitted THIS pass: its
+        # local dict predates the state patch, so _state_age would read the
+        # PREVIOUS terminal state's timestamp and a re-requested node that
+        # failed hours ago would instantly time out again with zero seconds
+        # allowed for the fresh proof.
+        for node in nodes:
+            name = node["metadata"]["name"]
+            if states[name] != REVALIDATING or name in admitted:
+                continue
+            try:
+                vpod = await self._validator_pod(name)
+                phase = deep_get(vpod, "status", "phase") if vpod else None
+                # Terminal transitions run cordon/uncordon FIRST: the
+                # except below swallows ApiErrors, so if the (un)cordon
+                # fails the node must still be REVALIDATING — retried next
+                # pass — never parked in a terminal state with the cordon
+                # silently not honored.
+                if phase == "Running":
+                    # fresh pod (admission deleted every predecessor): its
+                    # init chain re-proved the node against live hardware
+                    if self._we_cordoned(node):
+                        await self._cordon(name, False)
+                    await self._set_state(name, HEALTHY)
+                    await self._clear_request(name)
+                    log.info("re-validation passed on %s", name)
+                else:
+                    timeout = float(spec.validation_timeout_seconds or 0)
+                    timed_out = bool(timeout) and self._state_age(node) > timeout
+                    if phase != "Failed" and not timed_out:
+                        continue
+                    if spec.cordon_on_failure:
+                        await self._cordon(name, True)
+                    await self._set_state(name, FAILED)
+                    await self._clear_request(name)
+                    log.error(
+                        "re-validation FAILED on %s (pod phase %s); %s",
+                        name, phase,
+                        "cordoned" if spec.cordon_on_failure else "left schedulable",
+                    )
+            except ApiError as e:
+                log.error("remediation step on %s failed: %s", name, e)
+
+        fresh = [
+            n for n in await self.client.list_items("", "Node")
+            if clusterinfo.is_tpu_node(n)
+        ]
+        await self._report(fresh)
+        return consts.REMEDIATION_REQUEUE_SECONDS
+
+    # ------------------------------------------------------------------
+    def _requested(self, node: dict) -> bool:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return labels.get(consts.VALIDATE_REQUEST_LABEL) == REQUESTED
+
+    def _state_of(self, node: dict) -> str:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return labels.get(consts.REMEDIATION_STATE_LABEL, "")
+
+    def _we_cordoned(self, node: dict) -> bool:
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+        return anns.get(consts.REMEDIATION_CORDONED_ANNOTATION) == "true"
+
+    def _state_age(self, node: dict) -> float:
+        ts = deep_get(node, "metadata", "annotations", default={}).get(
+            consts.REMEDIATION_STATE_TS_ANNOTATION
+        )
+        entered = _parse_ts(ts) if ts else None
+        if entered is None:
+            return 0.0
+        return (
+            datetime.datetime.now(datetime.timezone.utc) - entered
+        ).total_seconds()
+
+    async def _set_state(self, node_name: str, state: Optional[str]) -> None:
+        ts = (
+            datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.%fZ"
+            )
+            if state is not None
+            else None
+        )
+        await self.client.patch(
+            "", "Node", node_name,
+            {"metadata": {
+                "labels": {consts.REMEDIATION_STATE_LABEL: state},
+                "annotations": {consts.REMEDIATION_STATE_TS_ANNOTATION: ts},
+            }},
+        )
+
+    async def _clear_request(self, node_name: str) -> None:
+        await self.client.patch(
+            "", "Node", node_name,
+            {"metadata": {"labels": {consts.VALIDATE_REQUEST_LABEL: None}}},
+        )
+
+    async def _cordon(self, node_name: str, value: bool) -> None:
+        # the annotation records that the cordon is OURS: release/uncordon
+        # must never undo an admin's own cordon
+        await self.client.patch(
+            "", "Node", node_name,
+            {
+                "spec": {"unschedulable": value or None},
+                "metadata": {"annotations": {
+                    consts.REMEDIATION_CORDONED_ANNOTATION: "true" if value else None
+                }},
+            },
+        )
+
+    async def _release(self, node: dict) -> None:
+        name = node["metadata"]["name"]
+        if self._we_cordoned(node):
+            await self._cordon(name, False)
+        await self._set_state(name, None)
+        await self._clear_request(name)
+
+    async def _delete_validator_pods(self, node_name: str) -> None:
+        """Clear every validator pod on the node so the DS-recreated pod is
+        the only source of evidence (upgrade controller pattern: a
+        lingering Failed sibling must not gate the fresh proof)."""
+        for pod in await self.client.list_items(
+            "", "Pod", self.namespace,
+            label_selector=VALIDATOR_POD_SELECTOR,
+            field_selector=f"spec.nodeName={node_name}",
+        ):
+            await self.client.delete(
+                "", "Pod", pod["metadata"]["name"], self.namespace
+            )
+            log.info(
+                "deleted %s for re-validation on %s",
+                pod["metadata"]["name"], node_name,
+            )
+
+    async def _validator_pod(self, node_name: str) -> Optional[dict]:
+        """Running non-terminating pod wins over lingering Failed siblings
+        (same rule as the upgrade controller's _validator_pod)."""
+        best: Optional[dict] = None
+        for pod in await self.client.list_items(
+            "", "Pod", self.namespace,
+            label_selector=VALIDATOR_POD_SELECTOR,
+            field_selector=f"spec.nodeName={node_name}",
+        ):
+            if deep_get(pod, "metadata", "deletionTimestamp"):
+                continue
+            if deep_get(pod, "status", "phase") == "Running":
+                return pod
+            best = best or pod
+        return best
+
+    async def _report(self, nodes: list[dict]) -> None:
+        states = [self._state_of(n) for n in nodes]
+        self.metrics.remediation_in_progress.set(
+            sum(1 for s in states if s == REVALIDATING)
+        )
+        self.metrics.remediation_failed.set(sum(1 for s in states if s == FAILED))
+
+    async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
+        obj = await clusterinfo.active_cluster_policy(self.client)
+        return TPUClusterPolicy(obj) if obj else None
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(Controller("remediation", self.reconcile))
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+
+        async def kick(event_type: str, obj: dict) -> None:
+            controller.enqueue(RECONCILE_KEY)
+
+        policies.add_handler(kick)
+        nodes.add_handler(kick)
+        return controller

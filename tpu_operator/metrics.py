@@ -62,6 +62,14 @@ class OperatorMetrics:
             "tpu_operator_nodes_upgrades_available", "Nodes available for upgrade"
         )
         self.upgrades_pending = g("tpu_operator_nodes_upgrades_pending", "Nodes pending upgrade")
+        self.remediation_in_progress = g(
+            "tpu_operator_nodes_remediation_in_progress",
+            "Nodes currently re-validating (remediation controller)",
+        )
+        self.remediation_failed = g(
+            "tpu_operator_nodes_remediation_failed",
+            "Nodes whose requested re-validation failed (sticky until re-requested)",
+        )
         self.auto_upgrade_enabled = g(
             "tpu_operator_runtime_auto_upgrade_enabled", "1 when auto-upgrade is on"
         )

@@ -21,17 +21,29 @@ log = logging.getLogger("tpu_operator.validator.metrics")
 
 
 class NodeMetrics:
-    def __init__(self, registry: Optional[CollectorRegistry] = None):
+    def __init__(
+        self,
+        registry: Optional[CollectorRegistry] = None,
+        node_name: Optional[str] = None,
+    ):
+        import os
+
         self.registry = registry or CollectorRegistry()
+        # every series carries the NODE name: Prometheus's `instance` is
+        # the scrape endpoint (podIP:port) — alert runbooks and the
+        # remediation channel (`kubectl label node ...`) need the real
+        # node, which the DS injects via the downward API (NODE_NAME)
+        self.node_name = node_name or os.environ.get("NODE_NAME", "unknown")
         self.validation_status = Gauge(
             "tpu_validator_validation_status",
             "1 when the component's validation status file is present",
-            ["component"],
+            ["node", "component"],
             registry=self.registry,
         )
         self.device_count = Gauge(
             "tpu_validator_tpu_device_count",
             "TPU chip device nodes visible on the host",
+            ["node"],
             registry=self.registry,
         )
         # measured perf from the jax validation payload (the numbers the
@@ -40,7 +52,7 @@ class NodeMetrics:
         self.perf = Gauge(
             "tpu_validator_measured",
             "Perf numbers measured by the last jax validation",
-            ["metric"],
+            ["node", "metric"],
             registry=self.registry,
         )
 
@@ -66,10 +78,10 @@ class NodeMetrics:
 
     def scrape(self) -> None:
         for component in consts.STATUS_FILES:
-            self.validation_status.labels(component=component).set(
-                1 if status.is_ready(component) else 0
-            )
-        self.device_count.set(hw.chip_count())
+            self.validation_status.labels(
+                node=self.node_name, component=component
+            ).set(1 if status.is_ready(component) else 0)
+        self.device_count.labels(node=self.node_name).set(hw.chip_count())
         payload = status.read_status("jax") or {}
         # the post-ready perf probes carry the matmul/hbm/ring figures in
         # their own status file; merge ONLY the measurement keys over the
@@ -86,7 +98,7 @@ class NodeMetrics:
 
         def _set(metric: str, value) -> None:
             if isinstance(value, (int, float)) and not isinstance(value, bool):
-                self.perf.labels(metric=metric).set(value)
+                self.perf.labels(node=self.node_name, metric=metric).set(value)
 
         for key, metric in self.PERF_KEYS.items():
             _set(metric, payload.get(key))
