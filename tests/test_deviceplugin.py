@@ -439,6 +439,58 @@ def test_mixed_without_layout_falls_back_to_flat(tmp_path, monkeypatch):
     assert configs[0].device_sets is None
 
 
+def test_mixed_vfio_serves_partitioned_passthrough(tmp_path, monkeypatch):
+    """Per-shape PARTITIONED VM passthrough (vgpu-device-manager /
+    mdev-type analogue): under `mixed`, a VM-passthrough node's sandbox
+    plugin advertises the SAME google.com/tpu-<shape> resources as
+    container nodes, each unit backed by the partition's vfio groups —
+    node workload-config routing, not resource names, selects the
+    isolation mode."""
+    import json
+
+    from tpu_operator.deviceplugin import sliceconfig
+    from tpu_operator.deviceplugin.plugin import PluginConfig
+    from tpu_operator.validator import status as vstatus
+
+    hwroot = tmp_path / "hw"
+    (hwroot / "dev" / "vfio").mkdir(parents=True)
+    # a REAL passthrough host has NO /dev/accel* left (the vfio-manager's
+    # driver_override rebind removed them) — the chip count must come from
+    # the iommu groups.  Group numbers deliberately cross a digit boundary
+    # (7..14): chip N must map to the Nth group NUMERICALLY, never
+    # lexicographically (10 < 7 as strings — cross-tenant group leakage).
+    groups = [str(7 + i) for i in range(8)]
+    for g in groups:
+        (hwroot / "dev" / "vfio" / g).touch()
+    (hwroot / "dev" / "vfio" / "vfio").touch()  # container device, not a group
+    monkeypatch.setenv("TPU_HW_ROOT", str(hwroot))
+    run_tpu = tmp_path / "run" / "tpu"
+    run_tpu.mkdir(parents=True)
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(run_tpu))
+    with open(vstatus.slice_config_path(), "w") as f:
+        json.dump({
+            "config": "all-balanced", "topology": "2x4",
+            "partitions": [
+                {"shape": "2x2", "chip_ids": [0, 1, 4, 5]},
+                {"shape": "2x2", "chip_ids": [2, 3, 6, 7]},
+            ],
+        }, f)
+
+    configs = sliceconfig.build_plugin_configs("mixed", PluginConfig(mode="vfio"))
+    assert [c.resource_name for c in configs] == ["google.com/tpu-2x2"]
+    assert configs[0].mode == "vfio"
+    sets = configs[0].device_sets
+    assert len(sets) == 2
+    # PER-UNIT membership: each unit holds exactly ITS partition chips'
+    # groups (chip i -> group 7+i) — a unit handing a VM another
+    # partition's group would leak devices across tenants
+    def unit_groups(chip_ids):
+        return sorted(str(hwroot / "dev" / "vfio" / str(7 + i)) for i in chip_ids)
+
+    assert sorted(sets["tpu-2x2-0"]) == unit_groups([0, 1, 4, 5])
+    assert sorted(sets["tpu-2x2-1"]) == unit_groups([2, 3, 6, 7])
+
+
 async def test_run_plugins_rebuilds_on_layout_change(tmp_path, monkeypatch):
     """The plugin daemon must notice a slice reconfig (file change) and
     re-serve + re-register the new resource set."""

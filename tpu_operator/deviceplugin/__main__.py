@@ -34,9 +34,13 @@ def main() -> None:
         mode=args.mode,
         socket_name=args.socket_name or ("tpu-vfio.sock" if args.mode == "vfio" else "tpu.sock"),
     )
-    # vfio plugins never partition (whole-host passthrough)
-    strategy = args.slice_strategy if args.mode == "accel" else "none"
-    asyncio.run(sliceconfig.run_plugins(strategy, base))
+    # vfio partitions too: under `mixed`, VM-passthrough nodes advertise
+    # the same per-shape google.com/tpu-<shape> resources as container
+    # nodes, each unit backed by the partition's vfio groups — the
+    # vgpu-device-manager (mdev-type partitioning) analogue.  Workloads
+    # request identical resource names either way; the workload-config
+    # node routing decides which plugin serves them.
+    asyncio.run(sliceconfig.run_plugins(args.slice_strategy, base))
 
 
 if __name__ == "__main__":

@@ -34,16 +34,27 @@ def read_layout() -> Optional[dict]:
         return None
 
 
-def config_signature() -> str:
+def host_chip_count(mode: str = "accel") -> int:
+    """Chips this host owns, by the mode's device source.  /dev/accel* (or
+    the TPU_CHIP_COUNT env) is the truth on container nodes; a vfio-bound
+    host has NO accel nodes left (the vfio-manager's driver_override rebind
+    removed them), so its iommu groups — one per chip — are the count."""
+    count = hw.chip_count()
+    if count == 0 and mode == "vfio":
+        count = len(hw.vfio_device_paths())
+    return count
+
+
+def config_signature(mode: str = "accel") -> str:
     """Change-detection key for the reconfig watch: the applied layout, this
     host's worker id, and its chip count — a late-arriving worker_id file
     (TFD starting after the plugin DS on a fresh multi-host node) changes
-    which partition units this host owns, and /dev/accel* appearing after
+    which partition units this host owns, and device nodes appearing after
     the plugin started flips the spans-hosts classification; both must
     rebuild the plugin set."""
     layout = read_layout()
     sig = json.dumps(layout, sort_keys=True) if layout else ""
-    return f"{sig}|worker={_worker_id()}|chips={hw.chip_count()}"
+    return f"{sig}|worker={_worker_id()}|chips={host_chip_count(mode)}"
 
 
 def host_units(
@@ -88,7 +99,7 @@ def build_plugin_configs(
     if strategy != "mixed":
         return [base]
     layout = read_layout()
-    chips = hw.chip_count()
+    chips = host_chip_count(base.mode)
     worker = _worker_id()
     if worker is None:
         if _layout_spans_hosts(layout, max(1, chips)):
@@ -108,7 +119,7 @@ def build_plugin_configs(
     configs = []
     for shape, unit_list in sorted(units.items()):
         sets = {
-            f"tpu-{shape}-{k}": [_chip_path(i) for i in unit]
+            f"tpu-{shape}-{k}": [_chip_path(i, base.mode) for i in unit]
             for k, unit in enumerate(unit_list)
         }
         configs.append(
@@ -152,14 +163,22 @@ def _layout_spans_hosts(layout: Optional[dict], chips_per_host: int) -> bool:
     return False
 
 
-def _chip_path(local_index: int) -> str:
+def _chip_path(local_index: int, mode: str = "accel") -> str:
     """Local chip index → host device path (existing node preferred; the
     virtual fallback mirrors discover_devices' env-declared mode).
-    accel_device_paths is numerically ordered, so index N is chip N."""
-    paths = hw.accel_device_paths()
+    Both path lists are numerically ordered, so index N is chip N — the
+    same ordering contract the flat plugin's discover_devices relies on
+    (for vfio, the vfio-manager binds chips in /dev/accel order, so group
+    numbering follows chip numbering)."""
+    if mode == "vfio":
+        paths = hw.vfio_device_paths()
+        fallback = f"/dev/vfio/{local_index}"
+    else:
+        paths = hw.accel_device_paths()
+        fallback = f"/dev/accel{local_index}"
     if local_index < len(paths):
         return paths[local_index]
-    return f"/dev/accel{local_index}"
+    return fallback
 
 
 async def run_plugins(strategy: str, base: PluginConfig, poll_seconds: float = 10.0) -> None:
@@ -193,7 +212,7 @@ async def run_plugins(strategy: str, base: PluginConfig, poll_seconds: float = 1
             # signature FIRST: a layout write landing between the config
             # build and a later capture would be absorbed unseen (the
             # reconcile below spans real await points)
-            signature = config_signature() if strategy == "mixed" else ""
+            signature = config_signature(base.mode) if strategy == "mixed" else ""
             desired = {
                 c.resource_name: c for c in build_plugin_configs(strategy, base)
             }
@@ -216,7 +235,7 @@ async def run_plugins(strategy: str, base: PluginConfig, poll_seconds: float = 1
             log.info("serving %d plugin(s): %s", len(running), sorted(running))
             while True:
                 await asyncio.sleep(poll_seconds)
-                if strategy == "mixed" and config_signature() != signature:
+                if strategy == "mixed" and config_signature(base.mode) != signature:
                     log.info("slice layout/worker-id changed; reconciling plugin set")
                     break
                 dead = {

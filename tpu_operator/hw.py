@@ -38,12 +38,17 @@ def accel_device_paths() -> list[str]:
 
 
 def vfio_device_paths() -> list[str]:
+    """IOMMU group device nodes, in NUMERIC group order (same rationale as
+    accel_device_paths: lexicographic sorting puts group 10 before group 7,
+    scrambling the chip-index↔group alignment the partitioned-passthrough
+    path relies on)."""
     root = hw_root()
-    return sorted(
+    paths = [
         p
         for p in glob.glob(os.path.join(root, "dev", "vfio", "*"))
         if os.path.basename(p) != "vfio"  # the container device, not a group
-    )
+    ]
+    return sorted(paths, key=lambda p: (_trailing_number(p), p))
 
 
 def chip_count() -> int:
