@@ -122,6 +122,26 @@ async def test_operator_binary_end_to_end(tmp_path):
                 labels = deep_get(node, "metadata", "labels", default={})
                 assert labels.get(consts.TPU_PRESENT_LABEL) == "true"
                 assert await client.list_items("apps", "DaemonSet", NS)
+                # remediation through the REAL binary: the request label
+                # drives requested -> revalidating (validator pod deleted,
+                # DS recreates) -> healthy with the request cleared
+                await client.patch(
+                    "", "Node", "tpu-node-0",
+                    {"metadata": {"labels": {
+                        consts.VALIDATE_REQUEST_LABEL: "requested"
+                    }}},
+                )
+                for _ in range(600):
+                    node = await client.get("", "Node", "tpu-node-0")
+                    labels = deep_get(node, "metadata", "labels", default={})
+                    if (
+                        labels.get(consts.REMEDIATION_STATE_LABEL) == "healthy"
+                        and consts.VALIDATE_REQUEST_LABEL not in labels
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                else:
+                    pytest.fail(f"remediation never converged:\n{logs()}")
         finally:
             try:
                 if proc.poll() is None:
