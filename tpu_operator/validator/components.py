@@ -466,11 +466,14 @@ class Validator:
             node = await self.client().get("", "Node", self.config.node_name)
             generation = nodeinfo.attributes(node).generation
             ring_min = _ring_min_gbps(generation) if chips > 1 else 0.0
-            # multi-chip: ring per-link diagnostic; single chip: the burn-in
-            # train-step moves here from the gate (still proven, just not on
-            # the readiness critical path).  hbm-dma is the pallas
-            # DMA-pipeline cross-check paired with hbm (fault isolation)
-            checks = "matmul,hbm,hbm-dma" + (",ring" if chips > 1 else ",burn-in")
+            # multi-chip: ring per-link diagnostic + sequence-parallel ring
+            # attention (the long-context acceptance); single chip: the
+            # burn-in train-step moves here from the gate (still proven,
+            # just not on the readiness critical path).  hbm-dma is the
+            # pallas DMA-pipeline cross-check paired with hbm
+            checks = "matmul,hbm,hbm-dma" + (
+                ",ring,ring-attention" if chips > 1 else ",burn-in"
+            )
             # clear the previous run's drop-box FIRST: a failed probe run
             # must surface as "no current measurements", never republish
             # last round's healthy figures to the degradation alerts
@@ -527,6 +530,11 @@ class Validator:
                         ring_min,
                     ),
                 }
+                if multi:
+                    from tpu_operator.workloads import ring_attention
+
+                    # sequence-parallel exact attention over the local ring
+                    probes["ring-attention"] = ring_attention.quick_check
                 if not multi:
                     # mirror the workload split: single-chip burn-in runs
                     # here, post-ready, instead of on the gate

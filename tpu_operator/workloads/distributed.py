@@ -194,8 +194,23 @@ def run_worker(
     finite = all(np.isfinite(l) for l in losses)
     decreasing = len(losses) < 2 or losses[-1] < losses[0]
 
+    # -- ring attention over the global 1-D ring: sequence parallelism
+    # ACROSS hosts — the long-context pattern (ring attention holds one KV
+    # block per chip, the layout that lets sequences outgrow a host; the
+    # blocks ride the same per-link ring the diagnostic above measured).
+    # Exact against the single-device reference, so a wrong hop or mask is
+    # a failure, not noise — which also means the PROBE's sequence must
+    # stay modest (the reference gathers the full sequence).
+    from tpu_operator.workloads import ring_attention
+
+    ra = ring_attention.acceptance(
+        seq_per_chip=int(os.environ.get("RING_ATTN_SEQ_PER_CHIP", "32")),
+        heads=2, head_dim=16, devices=devices,
+    )
+    ra_ok = bool(ra["ok"])
+
     return {
-        "ok": psum_ok and finite and decreasing and bw_ok and ring_ok,
+        "ok": psum_ok and finite and decreasing and bw_ok and ring_ok and ra_ok,
         "process_id": process_id,
         "num_processes": num_processes,
         "global_devices": len(devices),
@@ -214,6 +229,11 @@ def run_worker(
             for k in ("ok", "link_gbps", "max_error", "hops",
                       "overhead_dominated", "min_gbps", "gated", "error")
             if k in ring
+        },
+        "ring_attention": {
+            k: ra.get(k)
+            for k in ("ok", "seq", "seq_per_chip", "causal", "max_error", "time_s")
+            if k in ra
         },
         "losses": losses,
         "time_s": time.perf_counter() - t0,

@@ -6,10 +6,11 @@ the validator (and humans reading pod logs) see the numbers.
 
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
-  vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring (default runs the
-  first three; matmul/hbm/hbm-dma/ring are opt-in — they hold the chip
+  vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention
+  (default runs the first three; the rest are opt-in — they hold the chip
   longer; ring is the per-ICI-link diagnostic, gated by RING_MIN_GBPS;
-  hbm-dma is the pallas DMA-pipeline cross-check, report-only)
+  hbm-dma is the pallas DMA-pipeline cross-check, report-only;
+  ring-attention is the sequence-parallel long-context acceptance)
 - ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
@@ -79,6 +80,12 @@ def main() -> int:
                 matmul_bench.quick_benchmark(),
                 float(os.environ.get("MATMUL_MIN_MFU", "0")),
             )
+        elif check == "ring-attention":
+            # sequence-parallel exact attention over the local chip ring
+            # (long-context acceptance; report-only correctness-or-fail)
+            from tpu_operator.workloads import ring_attention
+
+            result = ring_attention.quick_check()
         elif check == "ring":
             result = collectives.apply_ring_gate(
                 collectives.ring_benchmark(
