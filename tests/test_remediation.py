@@ -231,3 +231,35 @@ async def test_readmission_not_instantly_timed_out(validation_root):
             assert not deep_get(live, "spec", "unschedulable")
         finally:
             await client.close()
+
+
+async def test_request_deferred_while_upgrade_in_progress(validation_root):
+    """A node mid-upgrade keeps its request label but is NOT admitted — the
+    upgrade machine owns the node's cordon and validator pods (its
+    VALIDATION step deletes and watches the same pods); remediation picks
+    the request up once the upgrade reaches a terminal state."""
+    from tpu_operator.controllers import upgrade as up
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await client.patch(
+                "", "Node", "tpu-0",
+                {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: up.VALIDATION}}},
+            )
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""  # deferred, not admitted
+            assert node["metadata"]["labels"][consts.VALIDATE_REQUEST_LABEL] == "requested"
+
+            # upgrade completes -> the standing request is admitted
+            await client.patch(
+                "", "Node", "tpu-0",
+                {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: up.DONE}}},
+            )
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.REVALIDATING
+        finally:
+            await client.close()

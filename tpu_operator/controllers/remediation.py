@@ -105,6 +105,12 @@ class RemediationReconciler:
             name = node["metadata"]["name"]
             if not self._requested(node) or states[name] == REVALIDATING:
                 continue
+            if self._upgrade_in_progress(node):
+                # the upgrade machine owns this node's cordon and validator
+                # pods right now (it deletes + watches the same pods in its
+                # VALIDATION step) — defer; the request label survives and
+                # is admitted once the upgrade reaches a terminal state
+                continue
             if in_progress >= max_parallel:
                 break
             try:
@@ -171,6 +177,13 @@ class RemediationReconciler:
     def _requested(self, node: dict) -> bool:
         labels = deep_get(node, "metadata", "labels", default={}) or {}
         return labels.get(consts.VALIDATE_REQUEST_LABEL) == REQUESTED
+
+    def _upgrade_in_progress(self, node: dict) -> bool:
+        from tpu_operator.controllers import upgrade
+
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        state = labels.get(consts.UPGRADE_STATE_LABEL, "")
+        return state in upgrade.IN_PROGRESS_STATES or state == upgrade.REQUIRED
 
     def _state_of(self, node: dict) -> str:
         labels = deep_get(node, "metadata", "labels", default={}) or {}
