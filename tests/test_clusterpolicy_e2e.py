@@ -462,3 +462,36 @@ async def test_sandbox_enabled_without_vm_nodes_goes_ready():
             # the vm-chain operands exist (capability installed), just idle
             ds = await client.get("apps", "DaemonSet", "tpu-vm-runtime-manager", NS)
             assert deep_get(ds, "status", "desiredNumberScheduled", default=0) == 0
+
+
+async def test_operands_opt_out_label_quarantines_node():
+    """tpu.google.com/tpu.deploy.operands=false on a node removes every
+    deploy gate (hasOperandsDisabled analogue, state_manager.go:313-320) so
+    no operand DS schedules there; identity labels stay, and clearing the
+    opt-out restores the gates."""
+    async with FakeCluster() as fc:
+        node = fc.add_node("tpu-quarantine")
+        node["metadata"]["labels"][consts.OPERANDS_LABEL] = "false"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new().obj)
+            reconciler = ClusterPolicyReconciler(client, NS)
+            obj, _ = await _converge(reconciler)
+            assert deep_get(obj, "status", "state") == State.READY
+            live = await client.get("", "Node", "tpu-quarantine")
+            labels = live["metadata"]["labels"]
+            assert labels[consts.TPU_PRESENT_LABEL] == "true"
+            assert not any(
+                k.startswith(consts.DEPLOY_LABEL_PREFIX)
+                for k in labels
+                if k != consts.OPERANDS_LABEL
+            ), labels
+
+            # opt-out lifted -> the gates come back
+            del live["metadata"]["labels"][consts.OPERANDS_LABEL]
+            await client.update(live)
+            await _converge(reconciler)
+            live = await client.get("", "Node", "tpu-quarantine")
+            assert live["metadata"]["labels"][
+                consts.DEPLOY_LABEL_PREFIX + "device-plugin"
+            ] == "true"

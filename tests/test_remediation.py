@@ -263,3 +263,43 @@ async def test_request_deferred_while_upgrade_in_progress(validation_root):
             assert _state(await _node(client, "tpu-0")) == rem.REVALIDATING
         finally:
             await client.close()
+
+
+async def test_inflight_remediation_freezes_during_upgrade(validation_root):
+    """An upgrade starting AFTER admission freezes the in-flight machine:
+    no healthy/failed verdict is reached off the upgrade's pod churn, and
+    the validation timer restarts from the upgrade's end (r04 review
+    finding)."""
+    from tpu_operator.controllers import upgrade as up
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, validationTimeoutSeconds=1)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.REVALIDATING
+
+            # upgrade begins; its machine deletes/recreates validator pods
+            await client.patch(
+                "", "Node", "tpu-0",
+                {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: up.VALIDATION}}},
+            )
+            _validator_pod(fc, "tpu-0", suffix="-upgrade")  # the UPGRADE's pod
+            await asyncio.sleep(1.1)  # past our validation timeout
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            # frozen: neither healthy off the upgrade's pod nor timed out
+            assert _state(node) == rem.REVALIDATING
+            assert not deep_get(node, "spec", "unschedulable")
+
+            # upgrade ends -> the machine resumes with a FRESH window and
+            # accepts the (post-upgrade) Running pod as proof
+            await client.patch(
+                "", "Node", "tpu-0",
+                {"metadata": {"labels": {consts.UPGRADE_STATE_LABEL: up.DONE}}},
+            )
+            await r.reconcile("remediation")
+            assert _state(await _node(client, "tpu-0")) == rem.HEALTHY
+        finally:
+            await client.close()

@@ -52,6 +52,9 @@ MULTISLICE_SLICES_LABEL = "tpu.google.com/multislice-slices"
 # Per-operand deployment gate labels (gpuStateLabels analogue,
 # controllers/state_manager.go:90-115).  Value "true" ⇒ operand DS schedules.
 DEPLOY_LABEL_PREFIX = "tpu.google.com/tpu.deploy."
+# Per-node opt-out: "false" removes every deploy gate from the node
+# (nvidia.com/gpu.deploy.operands analogue, state_manager.go:313-320)
+OPERANDS_LABEL = DEPLOY_LABEL_PREFIX + "operands"
 STATE_LABELS_CONTAINER = (
     "libtpu",
     "runtime-prep",

@@ -58,6 +58,14 @@ def desired_node_labels(node: dict, spec: TPUClusterPolicySpec) -> dict[str, Opt
 
     out[consts.TPU_PRESENT_LABEL] = "true"
     out[consts.TPU_COUNT_LABEL] = str(chips_per_host(node))
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    if labels.get(consts.OPERANDS_LABEL) == "false":
+        # per-node opt-out (hasOperandsDisabled, state_manager.go:313-320 +
+        # :365-370): the admin quarantines one node from every operand —
+        # all deploy gates removed, identity labels kept
+        for key in all_deploy_keys:
+            out[consts.DEPLOY_LABEL_PREFIX + key] = None
+        return out
     config = workload_config(node, spec)
     active = (
         consts.STATE_LABELS_CONTAINER

@@ -47,7 +47,11 @@ from tpu_operator import consts
 from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
 from tpu_operator.controllers import clusterinfo
 from tpu_operator.controllers.runtime import Controller, Manager
-from tpu_operator.controllers.upgrade import VALIDATOR_POD_SELECTOR, _parse_ts
+from tpu_operator.controllers.upgrade import (
+    NON_TERMINAL_STATES as UPGRADE_NON_TERMINAL,
+    VALIDATOR_POD_SELECTOR,
+    _parse_ts,
+)
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.utils import deep_get
@@ -133,6 +137,17 @@ class RemediationReconciler:
             name = node["metadata"]["name"]
             if states[name] != REVALIDATING or name in admitted:
                 continue
+            if self._upgrade_in_progress(node):
+                # an upgrade started AFTER admission: its machine now owns
+                # the validator pods (it deletes them in VALIDATION, and
+                # its fresh pod would be mistaken for our proof).  Freeze —
+                # and refresh the state timestamp so the validation window
+                # restarts from the upgrade's end, not its beginning.
+                try:
+                    await self._set_state(name, REVALIDATING)
+                except ApiError as e:
+                    log.error("remediation freeze on %s failed: %s", name, e)
+                continue
             try:
                 vpod = await self._validator_pod(name)
                 phase = deep_get(vpod, "status", "phase") if vpod else None
@@ -179,11 +194,8 @@ class RemediationReconciler:
         return labels.get(consts.VALIDATE_REQUEST_LABEL) == REQUESTED
 
     def _upgrade_in_progress(self, node: dict) -> bool:
-        from tpu_operator.controllers import upgrade
-
         labels = deep_get(node, "metadata", "labels", default={}) or {}
-        state = labels.get(consts.UPGRADE_STATE_LABEL, "")
-        return state in upgrade.IN_PROGRESS_STATES or state == upgrade.REQUIRED
+        return labels.get(consts.UPGRADE_STATE_LABEL, "") in UPGRADE_NON_TERMINAL
 
     def _state_of(self, node: dict) -> str:
         labels = deep_get(node, "metadata", "labels", default={}) or {}

@@ -51,6 +51,9 @@ DONE = "upgrade-done"
 FAILED = "upgrade-failed"
 
 IN_PROGRESS_STATES = (CORDON, DRAIN, POD_RESTART, VALIDATION, UNCORDON)
+# every state in which the machine still owns the node's cordon/pods
+# (remediation defers to these; DONE/FAILED/absent are terminal)
+NON_TERMINAL_STATES = (REQUIRED,) + IN_PROGRESS_STATES
 
 RECONCILE_KEY = "upgrade"
 
