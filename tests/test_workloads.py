@@ -441,3 +441,19 @@ def test_ring_attention_matches_reference():
         assert r["seq"] == 128  # the sequence genuinely spans the ring
         assert r["causal"] is causal
         assert r["max_error"] < 2e-2
+
+
+def test_ring_attention_pallas_flash_kernel():
+    """The fused pallas flash-block kernel (TPU hot-op path; interpret mode
+    here) folds each hop's K/V block into the online-softmax state and must
+    match the reference exactly — same bound as the jnp path it fuses."""
+    from tpu_operator.workloads import ring_attention as ra
+
+    for causal in (True, False):
+        r = ra.acceptance(
+            seq_per_chip=16, heads=2, head_dim=8, causal=causal, use_pallas=True
+        )
+        assert r["ok"], r
+        assert r["kernel"] == "pallas-flash"
+        assert r["devices"] == 8
+        assert r["max_error"] < 2e-2

@@ -26,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NEG_INF = -1e30  # large-negative instead of -inf: exp() of a fully-masked
@@ -48,29 +50,145 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
 
 
-def ring_attention_sharded(q, k, v, axis_name: str, causal: bool) -> jax.Array:
+# ---------------------------------------------------------------------------
+# The hop's hot op as a fused pallas kernel: one K/V block folded into the
+# online-softmax state entirely in VMEM — scores, mask, running max/denom
+# correction and the PV matmul in a single Mosaic program (the unfused jnp
+# path materializes the [B,H,Tq,Tk] score tensor in HBM twice per hop).
+# The jnp math in ring_attention_sharded is the kernel's reference; the
+# interpret-mode test pins them equal.
+
+
+def _flash_block_kernel(causal, scale,
+                        qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                        m_in, l_in, o_in, m_out, l_out, o_out):
+    q = q_ref[0].astype(jnp.float32)          # [Tq, D]
+    k = k_ref[0].astype(jnp.float32)          # [Tk, D]
+    v = v_ref[0].astype(jnp.float32)          # [Tk, D]
+    m = m_in[0]                               # [Tq, 1] (trailing unit dim:
+    l = l_in[0]                               #  Mosaic block-shape rules)
+    o = o_in[0]                               # [Tq, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                 # [Tq, Tk] on the MXU
+    if causal:
+        q_pos = qoff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    blk_max = jnp.max(s, axis=-1, keepdims=True)  # [Tq, 1]
+    m_new = jnp.maximum(m, blk_max)
+    corr = jnp.exp(m - m_new)
+    e = jnp.exp(s - m_new)
+    e = jnp.where(s <= NEG_INF * 0.5, 0.0, e)  # fully-masked guard
+    m_out[0] = m_new
+    l_out[0] = l * corr + jnp.sum(e, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        e, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_out[0] = o * corr + pv
+
+
+def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
+                       vma: Optional[frozenset] = None):
+    """Fold one K/V block into (m, l, o) with the fused kernel.
+
+    Shapes (per shard, already merged over batch×heads): q/k/v/o
+    ``[BH, T, D]``, m/l ``[BH, T]``; ``q_off``/``k_off`` are the blocks'
+    global sequence offsets (scalars, prefetched to SMEM for the causal
+    iota).  Grid: one program instance per (batch, head) pair.  ``vma``:
+    the mesh axes the outputs vary over when called under shard_map."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    # m/l travel as [BH, Tq, 1]: Mosaic requires the last two block dims
+    # divisible by (8, 128) or equal to the array dims — a trailing unit
+    # dim satisfies that where a flat [BH, Tq] block (1, Tq) cannot
+    m3, l3 = m[..., None], l[..., None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
+        ],
+    )
+    m3, l3, o = pl.pallas_call(
+        functools.partial(_flash_block_kernel, causal, scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(m3.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(l3.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(o.shape, jnp.float32, vma=vma),
+        ],
+        input_output_aliases={5: 0, 6: 1, 7: 2},
+        interpret=jax.default_backend() != "tpu",
+    )(
+        jnp.asarray([q_off], jnp.int32),
+        jnp.asarray([k_off], jnp.int32),
+        q, k, v, m3, l3, o,
+    )
+    return m3[..., 0], l3[..., 0], o
+
+
+def ring_attention_sharded(
+    q, k, v, axis_name: str, causal: bool, use_pallas: bool = False
+) -> jax.Array:
     """The per-shard program (call under shard_map with the sequence axis
-    sharded over ``axis_name``).  Shapes [B, T/p, H, D]."""
+    sharded over ``axis_name``).  Shapes [B, T/p, H, D].
+
+    ``use_pallas`` folds each block through the fused flash kernel
+    (state in the merged [B×H, T, ...] layout); the jnp path below is its
+    bit-level reference."""
     p = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
-    block = q.shape[1]
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    b, block, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
     q32 = q.astype(jnp.float32)
 
     from tpu_operator.workloads.collectives import _vary
 
+    def merge(x):  # [B, T, H, D] -> [B*H, T, D] (kernel layout)
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, block, d)
+
     # running online-softmax state per query position (marked
     # device-varying: the loop carry must match the varying outputs)
-    m = _vary(jnp.full(q.shape[:2] + q.shape[2:3], NEG_INF, jnp.float32), axis_name)
-    l = _vary(jnp.zeros(q.shape[:2] + q.shape[2:3], jnp.float32), axis_name)
-    o = _vary(jnp.zeros(q.shape, jnp.float32), axis_name)
+    if use_pallas:
+        state_shape = (b * h, block)
+        o_shape = (b * h, block, d)
+    else:
+        state_shape = (b, block, h)
+        o_shape = q.shape
+    m = _vary(jnp.full(state_shape, NEG_INF, jnp.float32), axis_name)
+    l = _vary(jnp.zeros(state_shape, jnp.float32), axis_name)
+    o = _vary(jnp.zeros(o_shape, jnp.float32), axis_name)
 
     q_pos = idx * block + jnp.arange(block)  # global positions of MY queries
+    if use_pallas:
+        # merge ONCE and rotate in the kernel layout — ppermute is
+        # layout-agnostic, and re-transposing K/V every hop would
+        # materialize two full relayout copies per hop in HBM, undoing
+        # the traffic the fused kernel saves
+        qm, k, v = merge(q), merge(k), merge(v)
 
     def consume(s, m, l, o, k, v):
         """Fold the K/V block currently held (produced by shard
         (idx - s) mod p) into the online-softmax state."""
         src = jax.lax.rem(idx - s + p, p)
+        if use_pallas:
+            return flash_block_update(
+                qm, k, v,
+                idx * block, src * block, m, l, o, causal,
+                vma=frozenset({axis_name}),
+            )
         scores = _block_scores(q32, k.astype(jnp.float32), scale)  # [B,H,Tq,Tk]
         if causal:
             k_pos = src * block + jnp.arange(block)
@@ -109,19 +227,30 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool) -> jax.Array:
     # guard fully-masked rows (can only happen without causal=False edge
     # cases; kept for robustness): denom 0 → output 0
     denom = jnp.where(l > 0, l, 1.0)
+    if use_pallas:
+        out = o / denom[:, :, None]  # [B*H, T, D]
+        out = jnp.transpose(out.reshape(b, h, block, d), (0, 2, 1, 3))
+        return out.astype(q.dtype)
     return (o / denom[:, :, :, None]).astype(q.dtype)
 
 
 def ring_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh, causal: bool = True
+    q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+    causal: bool = True, use_pallas: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention over a 1-D mesh axis "x"; inputs/outputs
     sequence-sharded [B, T, H, D]."""
-    fn = functools.partial(ring_attention_sharded, axis_name="x", causal=causal)
+    fn = functools.partial(
+        ring_attention_sharded, axis_name="x", causal=causal, use_pallas=use_pallas
+    )
     shard = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(None, "x"), P(None, "x"), P(None, "x")),
         out_specs=P(None, "x"),
+        # the pallas path trips the vma checker's dynamic_slice rule (its
+        # block machinery mixes varying operands with unvarying grid
+        # indices); the jnp path keeps the strict checking
+        check_vma=not use_pallas,
     )
     return shard(q, k, v)
 
@@ -134,6 +263,7 @@ def acceptance(
     causal: bool = True,
     devices: Optional[list] = None,
     tol: float = 2e-2,
+    use_pallas: bool = False,
 ) -> dict:
     """Run ring attention over every local chip and verify it matches the
     single-device reference bit-for-block (bf16 tolerance).  Returns the
@@ -159,7 +289,7 @@ def acceptance(
 
     @jax.jit
     def program(qs, ks, vs):
-        out = ring_attention(qs, ks, vs, mesh, causal=causal)
+        out = ring_attention(qs, ks, vs, mesh, causal=causal, use_pallas=use_pallas)
         ref = reference_attention(qs, ks, vs, causal)
         return jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
 
@@ -174,6 +304,7 @@ def acceptance(
         "heads": heads,
         "head_dim": head_dim,
         "causal": causal,
+        "kernel": "pallas-flash" if use_pallas else "jnp",
         "max_error": err,
         "time_s": dt,
         "backend": jax.default_backend(),
@@ -181,9 +312,11 @@ def acceptance(
 
 
 def quick_check() -> dict:
-    """The validator's probe: real shapes on TPU, tiny elsewhere."""
+    """The validator's probe: real shapes + the fused pallas flash kernel
+    on TPU; tiny jnp shapes elsewhere (the distributed CPU program must
+    not crawl through the pallas interpreter)."""
     if jax.default_backend() == "tpu":
-        return acceptance(seq_per_chip=512)
+        return acceptance(seq_per_chip=512, head_dim=128, use_pallas=True)
     return acceptance(seq_per_chip=16, heads=2, head_dim=8)
 
 
