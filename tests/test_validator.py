@@ -626,7 +626,7 @@ async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
                 pod = by_name[f"tpu-jax-validation-{pool}-w{wid}"]
                 assert deep_get(pod, "spec", "nodeName") == f"tpu-{wid}"
                 envs = {
-                    e["name"]: e["value"]
+                    e["name"]: e.get("value", "")
                     for e in deep_get(pod, "spec", "containers", 0, "env")
                 }
                 assert envs["NUM_PROCESSES"] == str(num_hosts)

@@ -520,6 +520,31 @@ def burn_in_step(
     return loss, {"w1": w1, "w2": w2}
 
 
+
+def _acceptance_run(mesh: Mesh, step, params, x, steps: int) -> dict:
+    """Shared acceptance-loop contract (burn_in and transformer_burn_in):
+    run ``steps`` jitted SGD steps, require finite and strictly-moving
+    losses (a flat line means the step silently stopped training — the r1
+    failure mode)."""
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params = step(params, x)
+        losses.append(float(loss))
+    dt = time.perf_counter() - t0
+    finite = all(np.isfinite(l) for l in losses)
+    decreasing = len(losses) < 2 or losses[-1] < losses[0]
+    return {
+        "ok": finite and decreasing,
+        "devices": mesh.size,
+        "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+        "steps": steps,
+        "losses": losses,
+        "time_s": dt,
+        "backend": jax.default_backend(),
+    }
+
+
 def burn_in(
     mesh: Optional[Mesh] = None,
     steps: int = 3,
@@ -533,23 +558,166 @@ def burn_in(
         jax.random.normal(jax.random.PRNGKey(1), (batch, d_model), jnp.bfloat16),
         NamedSharding(mesh, P("dp", None)),
     )
-    step = jax.jit(functools.partial(burn_in_step, mesh))
-    losses = []
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, params = step(params, x)
-        losses.append(float(loss))
-    dt = time.perf_counter() - t0
-    finite = all(np.isfinite(l) for l in losses)
-    # real updates ⇒ the trajectory must move; a flat line means the step
-    # silently stopped training (the r1 constant-loss failure mode)
-    decreasing = len(losses) < 2 or losses[-1] < losses[0]
+    return _acceptance_run(
+        mesh, jax.jit(functools.partial(burn_in_step, mesh)), params, x, steps
+    )
+
+
+# ---------------------------------------------------------------------------
+# Transformer-layer flagship step: SP attention + TP MLP + DP grads.
+#
+# The full sharding portfolio in ONE training step over the (dp, mp) mesh —
+# the shape the driver's dryrun_multichip compiles:
+#   - batch over dp (data parallel; gradients pmean'd across dp)
+#   - SEQUENCE over mp for attention: blockwise ring attention
+#     (workloads/ring_attention.py) — KV blocks ppermute the mp ring,
+#     peak attention memory one block per chip (sequence parallelism)
+#   - Megatron tensor parallel over mp for the MLP, in the Megatron-SP
+#     arrangement: all_gather the sequence shards into the TP region,
+#     column/row-split matmuls, reduce_scatter (psum_scatter) back to
+#     sequence shards — the collective sandwich of Korthikanti et al.
+# Attention projections are replicated (ring attention keeps heads whole);
+# their gradients therefore reduce over BOTH mesh axes, while the
+# mp-sharded MLP weights reduce over dp alone.
+
+
+def _rmsnorm(x, eps: float = 1e-6):
+    scale = jax.lax.rsqrt(jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True) + eps)
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def transformer_params(
+    mesh: Mesh,
+    d_model: int = 256,
+    d_hidden: int = 1024,
+    seed: int = 0,
+) -> dict:
+    """One pre-norm transformer layer's weights: replicated attention
+    projections, Megatron-split MLP."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    scale = 1.0 / np.sqrt(d_model)
+
+    def mk(k, shape, spec):
+        return jax.device_put(
+            jax.random.normal(k, shape, jnp.bfloat16) * scale,
+            NamedSharding(mesh, spec),
+        )
+
     return {
-        "ok": finite and decreasing,
-        "devices": mesh.size,
-        "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
-        "steps": steps,
-        "losses": losses,
-        "time_s": dt,
-        "backend": jax.default_backend(),
+        "wq": mk(ks[0], (d_model, d_model), P(None, None)),
+        "wk": mk(ks[1], (d_model, d_model), P(None, None)),
+        "wv": mk(ks[2], (d_model, d_model), P(None, None)),
+        "wo": mk(ks[3], (d_model, d_model), P(None, None)),
+        "w1": mk(ks[4], (d_model, d_hidden), P(None, "mp")),
+        "w2": mk(ks[5], (d_hidden, d_model), P("mp", None)),
     }
+
+
+def transformer_step(
+    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05
+) -> tuple[jax.Array, dict]:
+    """One SGD step of the transformer layer on x [B, S, D] sharded
+    P("dp", "mp", None) — batch over dp, sequence over mp.  ``heads`` is
+    static (it shapes the trace); partial it in before jit.  Returns
+    (loss, new_params)."""
+    from tpu_operator.workloads import ring_attention
+
+    dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, None), P(None, None), P(None, None), P(None, None),
+            P(None, "mp"), P("mp", None), P("dp", "mp", None),
+        ),
+        out_specs=(
+            P(),
+            P(None, None), P(None, None), P(None, None), P(None, None),
+            P(None, "mp"), P("mp", None),
+        ),
+    )
+    def step(wq, wk, wv, wo, w1, w2, xs):
+        b, s_loc, d = xs.shape
+        hd = d // heads
+
+        def loss_fn(wq, wk, wv, wo, w1, w2):
+            xf = xs.astype(jnp.bfloat16)
+            # -- attention, sequence-parallel over the mp ring
+            h = _rmsnorm(xf)
+            q = (h @ wq).reshape(b, s_loc, heads, hd)
+            k = (h @ wk).reshape(b, s_loc, heads, hd)
+            v = (h @ wv).reshape(b, s_loc, heads, hd)
+            attn = ring_attention.ring_attention_sharded(
+                q, k, v, "mp", causal=True, vary_axes=("dp", "mp")
+            )
+            xa = xf + attn.reshape(b, s_loc, d) @ wo
+            # -- MLP, Megatron-SP: sequence shards gather into the TP
+            # region, column/row-split matmuls, reduce-scatter back out
+            g = jax.lax.all_gather(_rmsnorm(xa), "mp", axis=1, tiled=True)
+            mid = jnp.maximum(g @ w1, 0)            # [b, S, hidden/mp]
+            y_part = mid @ w2                        # partial over mp
+            y = jax.lax.psum_scatter(y_part, "mp", scatter_dimension=1, tiled=True)
+            out = xa + y
+            # global mean-square loss: reduce over every shard's tokens
+            total = jax.lax.psum(
+                jax.lax.psum(jnp.sum(jnp.square(out.astype(jnp.float32))), "mp"),
+                "dp",
+            )
+            count = b * dp * s_loc * mp * d
+            return total / count
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3, 4, 5))(
+            wq, wk, wv, wo, w1, w2
+        )
+
+        def upd(w, grad, axes):
+            for ax in axes:
+                grad = jax.lax.pmean(grad, ax)
+            return (w.astype(jnp.float32) - lr * grad.astype(jnp.float32)).astype(w.dtype)
+
+        # replicated attention weights: every shard saw different tokens →
+        # reduce over BOTH axes; mp-sharded MLP slices reduce over dp only
+        new = (
+            upd(wq, grads[0], ("dp", "mp")),
+            upd(wk, grads[1], ("dp", "mp")),
+            upd(wv, grads[2], ("dp", "mp")),
+            upd(wo, grads[3], ("dp", "mp")),
+            upd(w1, grads[4], ("dp",)),
+            upd(w2, grads[5], ("dp",)),
+        )
+        return (loss, *new)
+
+    loss, wq, wk, wv, wo, w1, w2 = step(
+        params["wq"], params["wk"], params["wv"], params["wo"],
+        params["w1"], params["w2"], x,
+    )
+    return loss, {
+        "wq": wq, "wk": wk, "wv": wv, "wo": wo, "w1": w1, "w2": w2,
+    }
+
+
+def transformer_burn_in(
+    mesh: Optional[Mesh] = None,
+    steps: int = 3,
+    batch_per_dp: int = 4,
+    seq_per_mp: int = 16,
+    d_model: int = 128,
+    d_hidden: int = 256,
+    heads: int = 4,
+) -> dict:
+    """Acceptance run of the transformer step; same contract as burn_in."""
+    mesh = mesh or make_mesh()
+    dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+    params = transformer_params(mesh, d_model=d_model, d_hidden=d_hidden)
+    x = jax.device_put(
+        jax.random.normal(
+            jax.random.PRNGKey(1), (batch_per_dp * dp, seq_per_mp * mp, d_model),
+            jnp.bfloat16,
+        ),
+        NamedSharding(mesh, P("dp", "mp", None)),
+    )
+    return _acceptance_run(
+        mesh, jax.jit(functools.partial(transformer_step, mesh, heads)),
+        params, x, steps,
+    )

@@ -204,7 +204,10 @@ def run_worker(
     from tpu_operator.workloads import ring_attention
 
     ra = ring_attention.acceptance(
-        seq_per_chip=int(os.environ.get("RING_ATTN_SEQ_PER_CHIP", "32")),
+        # small by default: every slice host compiles this program inside
+        # its validation pod — the hop/mask/rendezvous proof needs blocks
+        # to span the ring, not big ones (quick_check covers real shapes)
+        seq_per_chip=int(os.environ.get("RING_ATTN_SEQ_PER_CHIP", "8")),
         heads=2, head_dim=16, devices=devices,
     )
     ra_ok = bool(ra["ok"])

@@ -140,14 +140,19 @@ def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
 
 
 def ring_attention_sharded(
-    q, k, v, axis_name: str, causal: bool, use_pallas: bool = False
+    q, k, v, axis_name: str, causal: bool, use_pallas: bool = False,
+    vary_axes: Optional[tuple] = None,
 ) -> jax.Array:
     """The per-shard program (call under shard_map with the sequence axis
     sharded over ``axis_name``).  Shapes [B, T/p, H, D].
 
     ``use_pallas`` folds each block through the fused flash kernel
     (state in the merged [B×H, T, ...] layout); the jnp path below is its
-    bit-level reference."""
+    bit-level reference.  ``vary_axes``: ALL manual axes the inputs vary
+    over (defaults to just ``axis_name``) — under a multi-axis shard_map
+    (e.g. the transformer step's (dp, mp) mesh, batch over dp) the loop
+    state must carry every axis's variance or the fori_loop carry types
+    mismatch."""
     p = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, block, h, d = q.shape
@@ -167,9 +172,10 @@ def ring_attention_sharded(
     else:
         state_shape = (b, block, h)
         o_shape = q.shape
-    m = _vary(jnp.full(state_shape, NEG_INF, jnp.float32), axis_name)
-    l = _vary(jnp.zeros(state_shape, jnp.float32), axis_name)
-    o = _vary(jnp.zeros(o_shape, jnp.float32), axis_name)
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    m = _vary(jnp.full(state_shape, NEG_INF, jnp.float32), axes)
+    l = _vary(jnp.zeros(state_shape, jnp.float32), axes)
+    o = _vary(jnp.zeros(o_shape, jnp.float32), axes)
 
     q_pos = idx * block + jnp.arange(block)  # global positions of MY queries
     if use_pallas:
@@ -187,7 +193,7 @@ def ring_attention_sharded(
             return flash_block_update(
                 qm, k, v,
                 idx * block, src * block, m, l, o, causal,
-                vma=frozenset({axis_name}),
+                vma=frozenset(axes),
             )
         scores = _block_scores(q32, k.astype(jnp.float32), scale)  # [B,H,Tq,Tk]
         if causal:

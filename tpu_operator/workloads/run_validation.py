@@ -6,11 +6,12 @@ the validator (and humans reading pod logs) see the numbers.
 
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
-  vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention
-  (default runs the first three; the rest are opt-in — they hold the chip
-  longer; ring is the per-ICI-link diagnostic, gated by RING_MIN_GBPS;
-  hbm-dma is the pallas DMA-pipeline cross-check, report-only;
-  ring-attention is the sequence-parallel long-context acceptance)
+  vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
+  transformer (default runs the first three; the rest are opt-in — they
+  hold the chip longer; ring is the per-ICI-link diagnostic, gated by
+  RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline cross-check,
+  report-only; ring-attention is the sequence-parallel long-context
+  acceptance; transformer is the flagship dp+sp+tp layer train step)
 - ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
@@ -73,6 +74,11 @@ def main() -> int:
             )
         elif check == "burn-in":
             result = collectives.burn_in()
+        elif check == "transformer":
+            # the flagship layer: dp batch + mp ring-attention sequence
+            # parallelism + Megatron-SP MLP in one train step (opt-in —
+            # the gate stays minimal, dryrun/tests prove this composition)
+            result = collectives.transformer_burn_in()
         elif check == "matmul":
             from tpu_operator.workloads import matmul_bench
 
