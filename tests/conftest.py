@@ -38,7 +38,7 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=240))
         return True
     return None
 

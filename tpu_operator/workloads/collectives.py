@@ -648,8 +648,12 @@ def transformer_step(
             q = (h @ wq).reshape(b, s_loc, heads, hd)
             k = (h @ wk).reshape(b, s_loc, heads, hd)
             v = (h @ wv).reshape(b, s_loc, heads, hd)
-            attn = ring_attention.ring_attention_sharded(
-                q, k, v, "mp", causal=True, vary_axes=("dp", "mp")
+            # the memory-efficient path: custom VJP recomputes each hop's
+            # scores in a second ring pass instead of letting AD save every
+            # hop's residuals — O(1) blocks per layer, the property that
+            # makes long sequences trainable at all
+            attn = ring_attention.ring_attention_remat(
+                q, k, v, "mp", True, ("dp", "mp")
             )
             xa = xf + attn.reshape(b, s_loc, d) @ wo
             # -- MLP, Megatron-SP: sequence shards gather into the TP

@@ -50,6 +50,18 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
 
 
+def _hop_scores(q32, k, scale, causal, q_pos, src, block):
+    """Scores of my Q block against the K block produced by shard ``src``,
+    causal-masked from global positions — the one definition both the
+    forward and the remat backward must agree on."""
+    scores = _block_scores(q32, k.astype(jnp.float32), scale)  # [B,H,Tq,Tk]
+    if causal:
+        k_pos = src * block + jnp.arange(block)
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    return scores
+
+
 # ---------------------------------------------------------------------------
 # The hop's hot op as a fused pallas kernel: one K/V block folded into the
 # online-softmax state entirely in VMEM — scores, mask, running max/denom
@@ -139,20 +151,9 @@ def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
     return m3[..., 0], l3[..., 0], o
 
 
-def ring_attention_sharded(
-    q, k, v, axis_name: str, causal: bool, use_pallas: bool = False,
-    vary_axes: Optional[tuple] = None,
-) -> jax.Array:
-    """The per-shard program (call under shard_map with the sequence axis
-    sharded over ``axis_name``).  Shapes [B, T/p, H, D].
-
-    ``use_pallas`` folds each block through the fused flash kernel
-    (state in the merged [B×H, T, ...] layout); the jnp path below is its
-    bit-level reference.  ``vary_axes``: ALL manual axes the inputs vary
-    over (defaults to just ``axis_name``) — under a multi-axis shard_map
-    (e.g. the transformer step's (dp, mp) mesh, batch over dp) the loop
-    state must carry every axis's variance or the fori_loop carry types
-    mismatch."""
+def _jnp_ring_forward(q, k, v, axis_name: str, causal: bool, axes: tuple):
+    """The jnp ring forward: returns (out, logsumexp) — the exact math the
+    pallas kernel fuses and the residuals the remat backward needs."""
     p = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, block, h, d = q.shape
@@ -161,45 +162,19 @@ def ring_attention_sharded(
 
     from tpu_operator.workloads.collectives import _vary
 
-    def merge(x):  # [B, T, H, D] -> [B*H, T, D] (kernel layout)
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, block, d)
-
     # running online-softmax state per query position (marked
     # device-varying: the loop carry must match the varying outputs)
-    if use_pallas:
-        state_shape = (b * h, block)
-        o_shape = (b * h, block, d)
-    else:
-        state_shape = (b, block, h)
-        o_shape = q.shape
-    axes = tuple(vary_axes) if vary_axes else (axis_name,)
-    m = _vary(jnp.full(state_shape, NEG_INF, jnp.float32), axes)
-    l = _vary(jnp.zeros(state_shape, jnp.float32), axes)
-    o = _vary(jnp.zeros(o_shape, jnp.float32), axes)
+    m = _vary(jnp.full((b, block, h), NEG_INF, jnp.float32), axes)
+    l = _vary(jnp.zeros((b, block, h), jnp.float32), axes)
+    o = _vary(jnp.zeros(q.shape, jnp.float32), axes)
 
     q_pos = idx * block + jnp.arange(block)  # global positions of MY queries
-    if use_pallas:
-        # merge ONCE and rotate in the kernel layout — ppermute is
-        # layout-agnostic, and re-transposing K/V every hop would
-        # materialize two full relayout copies per hop in HBM, undoing
-        # the traffic the fused kernel saves
-        qm, k, v = merge(q), merge(k), merge(v)
 
     def consume(s, m, l, o, k, v):
         """Fold the K/V block currently held (produced by shard
         (idx - s) mod p) into the online-softmax state."""
         src = jax.lax.rem(idx - s + p, p)
-        if use_pallas:
-            return flash_block_update(
-                qm, k, v,
-                idx * block, src * block, m, l, o, causal,
-                vma=frozenset(axes),
-            )
-        scores = _block_scores(q32, k.astype(jnp.float32), scale)  # [B,H,Tq,Tk]
-        if causal:
-            k_pos = src * block + jnp.arange(block)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        scores = _hop_scores(q32, k, scale, causal, q_pos, src, block)
         blk_max = jnp.max(scores, axis=-1)  # [B,H,Tq]
         blk_max = jnp.moveaxis(blk_max, 1, -1)  # [B,Tq,H]
         m_new = jnp.maximum(m, blk_max)
@@ -233,11 +208,70 @@ def ring_attention_sharded(
     # guard fully-masked rows (can only happen without causal=False edge
     # cases; kept for robustness): denom 0 → output 0
     denom = jnp.where(l > 0, l, 1.0)
-    if use_pallas:
-        out = o / denom[:, :, None]  # [B*H, T, D]
-        out = jnp.transpose(out.reshape(b, h, block, d), (0, 2, 1, 3))
-        return out.astype(q.dtype)
-    return (o / denom[:, :, :, None]).astype(q.dtype)
+    out = (o / denom[:, :, :, None]).astype(q.dtype)
+    return out, _lse_of(m, l)
+
+
+def ring_attention_sharded(
+    q, k, v, axis_name: str, causal: bool, use_pallas: bool = False,
+    vary_axes: Optional[tuple] = None,
+) -> jax.Array:
+    """The per-shard program (call under shard_map with the sequence axis
+    sharded over ``axis_name``).  Shapes [B, T/p, H, D].
+
+    ``use_pallas`` folds each block through the fused flash kernel
+    (state in the merged [B×H, T, ...] layout); the jnp path
+    (_jnp_ring_forward) is its bit-level reference.  ``vary_axes``: ALL
+    manual axes the inputs vary over (defaults to just ``axis_name``) —
+    under a multi-axis shard_map (e.g. the transformer step's (dp, mp)
+    mesh, batch over dp) the loop state must carry every axis's variance
+    or the fori_loop carry types mismatch."""
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    if not use_pallas:
+        out, _ = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
+        return out
+
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, block, h, d = q.shape
+
+    from tpu_operator.workloads.collectives import _vary
+
+    def merge(x):  # [B, T, H, D] -> [B*H, T, D] (kernel layout)
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, block, d)
+
+    m = _vary(jnp.full((b * h, block), NEG_INF, jnp.float32), axes)
+    l = _vary(jnp.zeros((b * h, block), jnp.float32), axes)
+    o = _vary(jnp.zeros((b * h, block, d), jnp.float32), axes)
+
+    # merge ONCE and rotate in the kernel layout — ppermute is
+    # layout-agnostic, and re-transposing K/V every hop would materialize
+    # two full relayout copies per hop in HBM, undoing the traffic the
+    # fused kernel saves
+    qm, k, v = merge(q), merge(k), merge(v)
+
+    def consume(s, m, l, o, k, v):
+        src = jax.lax.rem(idx - s + p, p)
+        return flash_block_update(
+            qm, k, v,
+            idx * block, src * block, m, l, o, causal,
+            vma=frozenset(axes),
+        )
+
+    def hop(s, carry):
+        m, l, o, k, v = carry
+        m, l, o = consume(s, m, l, o, k, v)
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        return m, l, o, k, v
+
+    m, l, o, k, v = jax.lax.fori_loop(0, p - 1, hop, (m, l, o, k, v))
+    m, l, o = consume(p - 1, m, l, o, k, v)
+    denom = jnp.where(l > 0, l, 1.0)
+    out = o / denom[:, :, None]  # [B*H, T, D]
+    out = jnp.transpose(out.reshape(b, h, block, d), (0, 2, 1, 3))
+    return out.astype(q.dtype)
 
 
 def ring_attention(
@@ -338,6 +372,99 @@ def main() -> int:
     result = quick_check()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
+
+
+# ---------------------------------------------------------------------------
+# Memory-efficient training path (jax.custom_vjp).
+#
+# Plain AD through the forward's fori_loop saves every hop's residuals —
+# O(p) block-pair intermediates per layer, which defeats ring attention's
+# whole memory argument for long sequences.  The Ring Attention recipe
+# (Liu et al.) instead RECOMPUTES each hop's scores in a second ring pass:
+# the forward saves only (q, k, v, out, logsumexp), and the backward
+# rotates K/V around the ring again with the FlashAttention-2 block
+# backward at each hop.  dK/dV accumulators travel WITH their blocks —
+# after the full revolution (p rotations this time; the accumulators must
+# get home) every block's gradient lands on the shard that owns it.
+
+
+def _lse_of(m, l):
+    """logsumexp per query from the online-softmax state (jnp layout)."""
+    return m + jnp.log(jnp.where(l > 0, l, 1.0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention_remat(q, k, v, axis_name: str, causal: bool, axes: tuple):
+    """ring_attention_sharded's jnp path with an O(1)-residual backward;
+    call under shard_map exactly like ring_attention_sharded."""
+    out, _ = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
+    return out
+
+
+def _remat_fwd(q, k, v, axis_name, causal, axes):
+    out, lse = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
+    return out, (q, k, v, out, lse)
+
+
+def _remat_bwd(axis_name, causal, axes, res, dout):
+    from tpu_operator.workloads.collectives import _vary
+
+    q, k, v, out, lse = res
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, block, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    q32 = q.astype(jnp.float32)
+    do32 = dout.astype(jnp.float32)
+    # D_i = rowsum(dO * O): the softmax-jacobian correction term
+    dsum = jnp.moveaxis(jnp.sum(do32 * out.astype(jnp.float32), -1), -1, 1)[..., None]
+    lse_b = jnp.moveaxis(lse, -1, 1)[..., None]  # [B,H,Tq,1]
+    q_pos = idx * block + jnp.arange(block)
+
+    dq = _vary(jnp.zeros(q.shape, jnp.float32), axes)
+    dk = _vary(jnp.zeros(k.shape, jnp.float32), axes)
+    dv = _vary(jnp.zeros(v.shape, jnp.float32), axes)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def consume(s, dq, dk, dv, k, v):
+        src = jax.lax.rem(idx - s + p, p)
+        k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+        scores = _hop_scores(q32, k, scale, causal, q_pos, src, block)
+        # exact probabilities from the SAVED logsumexp — no re-accumulation.
+        # Masked entries: exp(NEG_INF - lse) = 0, EXCEPT a fully-masked row
+        # whose lse collapsed to NEG_INF too — guard it like the forward
+        prob = jnp.where(scores <= NEG_INF * 0.5, 0.0, jnp.exp(scores - lse_b))
+        dv_new = dv + jnp.einsum("bhqk,bqhd->bkhd", prob, do32)
+        dprob = jnp.einsum("bqhd,bkhd->bhqk", do32, v32)
+        dscores = prob * (dprob - dsum)
+        dq_new = dq + jnp.einsum("bhqk,bkhd->bqhd", dscores, k32) * scale
+        dk_new = dk + jnp.einsum("bhqk,bqhd->bkhd", dscores, q32) * scale
+        return dq_new, dk_new, dv_new
+
+    def hop(s, carry):
+        dq, dk, dv, k, v = carry
+        dq, dk, dv = consume(s, dq, dk, dv, k, v)
+        # dK/dV travel with their block: ALL p hops rotate, so after the
+        # full revolution each accumulator is home on the shard that owns
+        # its block's gradient
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return dq, dk, dv, k, v
+
+    # last hop peeled: the accumulators still need their homing rotation,
+    # but rotating K/V once more would ship a redundant block pair over
+    # every ICI link (same reasoning as the forward's peeled last hop)
+    dq, dk, dv, k, v = jax.lax.fori_loop(0, p - 1, hop, (dq, dk, dv, k, v))
+    dq, dk, dv = consume(p - 1, dq, dk, dv, k, v)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention_remat.defvjp(_remat_fwd, _remat_bwd)
 
 
 if __name__ == "__main__":
