@@ -1,0 +1,182 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a pp axis.
+
+The last member of the parallelism census (SURVEY §2.6): dp (burn-in),
+tp/sp (transformer step), ep (moe), cp/sp-attention (ring + ulysses),
+and now pp.  Each chip owns ONE stage's weights; microbatches stream
+through the pipe with one ``ppermute`` per tick carrying activations to
+the next stage — M + p − 1 ticks fill and drain the pipe, and the bubble
+fraction (p−1)/(M+p−1) shrinks as microbatches grow, the classic GPipe
+trade.
+
+SPMD formulation (no per-stage programs, XLA-friendly): every chip runs
+the identical ``lax.scan``; stage identity comes from ``axis_index``.
+Stage 0 feeds microbatch ``t`` at tick ``t``; interior stages consume
+whatever the previous tick's ``ppermute`` delivered; the last stage
+lands finished microbatches in its output buffer.  Control flow is all
+static — ``jnp.where`` on the stage index, clamped ``dynamic_slice`` for
+the feed — so the whole pipe is one compiled program, differentiable
+end-to-end (the scan's AD replays ticks in reverse, ppermute transposes
+to the inverted permutation: backprop streams the pipe backwards, which
+is exactly pipeline-parallel training's backward pass).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stage_fn(x, w1, w2):
+    """One pipeline stage: residual relu MLP (bf16 matmuls, f32 carry)."""
+    h = jnp.maximum(x.astype(jnp.bfloat16) @ w1, 0)
+    return x + (h @ w2).astype(jnp.float32)
+
+
+def pipeline_sharded(x, w1, w2, axis_name: str):
+    """The per-shard pipe (call under shard_map: ``x`` [M, mb, D]
+    replicated microbatches, ``w1``/``w2`` [1, ...] this stage's weights,
+    stage = my index along ``axis_name``).
+
+    Returns the pipe's output [M, mb, D] (replicated via a final psum —
+    only the last stage's buffer is nonzero) — ticks M + p − 1 times."""
+    from tpu_operator.workloads.collectives import _vary
+
+    p = jax.lax.psum(1, axis_name)
+    s = jax.lax.axis_index(axis_name)
+    m, mb, d = x.shape
+    w1, w2 = w1[0], w2[0]
+    ticks = m + p - 1
+    fwd = [(i, i + 1) for i in range(p - 1)]  # chain, not ring: no wraparound
+
+    def feed(t):
+        # stage 0's input at tick t: microbatch t (clamped — the pipe
+        # drains on garbage that never reaches a valid output slot)
+        mbi = jnp.clip(t, 0, m - 1)
+        return jax.lax.dynamic_slice(x, (mbi, 0, 0), (1, mb, d))[0]
+
+    x0 = jnp.where(s == 0, feed(jnp.int32(0)), jnp.zeros((mb, d), x.dtype))
+    out0 = _vary(jnp.zeros_like(x), (axis_name,))
+
+    def tick(carry, t):
+        x_cur, out = carry
+        y = stage_fn(x_cur, w1, w2)
+        # the last stage lands microbatch j = t - (p-1) when it's real
+        j = t - (p - 1)
+        upd = jax.lax.dynamic_update_slice(out, y[None], (jnp.maximum(j, 0), 0, 0))
+        out = jnp.where((s == p - 1) & (j >= 0), upd, out)
+        # activations advance one stage; stage 0 pulls the next microbatch
+        recv = jax.lax.ppermute(y, axis_name, fwd)
+        x_next = jnp.where(s == 0, feed(t + 1), recv)
+        return (x_next, out), None
+
+    (_, out), _ = jax.lax.scan(tick, (x0, out0), jnp.arange(ticks, dtype=jnp.int32))
+    # replicate the result: every stage but the last contributed zeros
+    return jax.lax.psum(out, axis_name)
+
+
+def pipeline_apply(x: jax.Array, w1: jax.Array, w2: jax.Array, mesh: Mesh) -> jax.Array:
+    """Run x [M, mb, D] through the p-stage pipe; w1 [p, D, H] / w2
+    [p, H, D] stage-sharded over mesh axis "pp"."""
+    fn = functools.partial(pipeline_sharded, axis_name="pp")
+    shard = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, None, None), P("pp", None, None), P("pp", None, None)),
+        out_specs=P(None, None, None),
+    )
+    return shard(x, w1, w2)
+
+
+def pipeline_params(mesh: Mesh, d_model: int = 64, d_hidden: int = 128, seed: int = 0):
+    p = mesh.shape["pp"]
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    scale = 1.0 / np.sqrt(d_model)
+    w1 = jax.device_put(
+        jax.random.normal(k1, (p, d_model, d_hidden), jnp.bfloat16) * scale,
+        NamedSharding(mesh, P("pp", None, None)),
+    )
+    w2 = jax.device_put(
+        jax.random.normal(k2, (p, d_hidden, d_model), jnp.bfloat16) * scale,
+        NamedSharding(mesh, P("pp", None, None)),
+    )
+    return w1, w2
+
+
+def acceptance(
+    microbatches: int = 8,
+    microbatch: int = 4,
+    d_model: int = 32,
+    d_hidden: int = 64,
+    devices: Optional[list] = None,
+    tol: float = 1e-3,
+) -> dict:
+    """The pipe vs sequentially applying every stage on one device —
+    identical weights, identical math, M + p − 1 ticks of streaming in
+    between.  Returns the check-result dict (run_validation shape)."""
+    devices = devices if devices is not None else jax.devices()
+    p = len(devices)
+    mesh = Mesh(np.array(devices), ("pp",))
+    w1, w2 = pipeline_params(mesh, d_model, d_hidden)
+    x = jax.random.normal(
+        jax.random.PRNGKey(5), (microbatches, microbatch, d_model), jnp.float32
+    )
+
+    @jax.jit
+    def program(x, w1, w2):
+        out = pipeline_apply(x, w1, w2, mesh)
+
+        def ref_stage(h, ws):
+            return stage_fn(h, ws[0], ws[1]), None
+
+        ref, _ = jax.lax.scan(ref_stage, x, (w1, w2))
+        return jnp.max(jnp.abs(out - ref))
+
+    t0 = time.perf_counter()
+    err = float(program(x, w1, w2))
+    dt = time.perf_counter() - t0
+    return {
+        "ok": bool(np.isfinite(err) and err < tol),
+        "devices": p,
+        "stages": p,
+        "microbatches": microbatches,
+        "ticks": microbatches + p - 1,
+        "bubble_fraction": round((p - 1) / (microbatches + p - 1), 4),
+        "strategy": "pp-gpipe-microbatch",
+        "max_error": err,
+        "time_s": dt,
+        "backend": jax.default_backend(),
+    }
+
+
+def quick_check() -> dict:
+    """The validator's probe: the pipe exercises the neighbour-chain hops
+    (the ring diagnostic's pattern) under streamed compute."""
+    if jax.default_backend() == "tpu":
+        return acceptance(microbatches=16, microbatch=64, d_model=512,
+                          d_hidden=2048)
+    return acceptance()
+
+
+def main() -> int:
+    import json
+    import sys
+
+    from tpu_operator import workloads
+    from tpu_operator.workloads import compile_cache
+
+    workloads.honor_cpu_platform_request()
+    compile_cache.enable()
+    result = quick_check()
+    print(json.dumps(result), flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -7,11 +7,14 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  transformer (default runs the first three; the rest are opt-in — they
-  hold the chip longer; ring is the per-ICI-link diagnostic, gated by
-  RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline cross-check,
-  report-only; ring-attention is the sequence-parallel long-context
-  acceptance; transformer is the flagship dp+sp+tp layer train step)
+  ulysses,moe,pipeline,transformer (default runs the first three; the
+  rest are opt-in
+  — they hold the chip longer; ring is the per-ICI-link diagnostic,
+  gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
+  cross-check, report-only; ring-attention and ulysses are the two
+  sequence-parallel long-context acceptances — KV-rotation ring vs
+  all-to-all head re-sharding; transformer is the flagship dp+sp+tp
+  layer train step)
 - ``ALLREDUCE_SIZE_MB`` / ``ALLREDUCE_MIN_GBPS``: benchmark knobs; the
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
@@ -92,6 +95,24 @@ def main() -> int:
             from tpu_operator.workloads import ring_attention
 
             result = ring_attention.quick_check()
+        elif check == "ulysses":
+            # the all-to-all SP strategy (two AllToAlls re-shard
+            # seq<->heads); same acceptance contract as ring-attention
+            from tpu_operator.workloads import ulysses
+
+            result = ulysses.quick_check()
+        elif check == "moe":
+            # expert parallelism: routed all-to-all dispatch — the only
+            # collective here whose traffic crosses EVERY chip pair, so
+            # it doubles as a full-bisection interconnect diagnostic
+            from tpu_operator.workloads import moe
+
+            result = moe.quick_check()
+        elif check == "pipeline":
+            # GPipe microbatch streaming over chip-resident stages
+            from tpu_operator.workloads import pipeline
+
+            result = pipeline.quick_check()
         elif check == "ring":
             result = collectives.apply_ring_gate(
                 collectives.ring_benchmark(
