@@ -680,3 +680,44 @@ def test_run_validation_parallelism_census(monkeypatch, capsys):
     assert got["ulysses"]["strategy"] == "ulysses-all-to-all"
     assert got["moe"]["strategy"] == "ep-all-to-all-top1"
     assert got["pipeline"]["strategy"] == "pp-gpipe-microbatch"
+
+
+def test_transformer_pipeline_burn_in():
+    """The FULL composition — GPipe microbatch pipeline of transformer
+    stages, each internally dp + ring-attention SP + Megatron-SP TP —
+    trains on the 3-axis (pp, dp, mp) mesh."""
+    r = collectives.transformer_pipeline_burn_in()
+    assert r["ok"], r
+    assert r["mesh"] == {"pp": 2, "dp": 2, "mp": 2}
+    ls = r["losses"]
+    assert all(b < a for a, b in zip(ls, ls[1:])), ls
+
+
+def test_transformer_pipeline_matches_single_device():
+    """SPMD correctness pin for the full composition: the (2,2,2)-sharded
+    pipelined step must compute the same loss as the degenerate (1,1,1)
+    mesh on identical weights and microbatches."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # pp must match across the pin (the stage axis IS the model depth):
+    # compare the full (2,2,2) mesh against (2,1,1) — same 2-stage model,
+    # the dp/mp sharding (ring attention, Megatron sandwich, gradient
+    # reductions) must cancel to the same math
+    losses = {}
+    for shape in ((2, 2, 2), (2, 1, 1)):
+        n = int(np.prod(shape))
+        mesh = Mesh(
+            np.array(jax.devices()[:n]).reshape(shape), ("pp", "dp", "mp")
+        )
+        params = collectives.transformer_pipeline_params(
+            mesh, d_model=64, d_hidden=128
+        )
+        x = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(3), (4, 2, 32, 64), jnp.float32),
+            NamedSharding(mesh, P(None, "dp", "mp", None)),
+        )
+        loss, _ = collectives.transformer_pipeline_step(mesh, 4, params, x)
+        losses[shape] = float(loss)
+    a, b = losses.values()
+    assert a == pytest.approx(b, rel=0.02), losses

@@ -466,13 +466,17 @@ class Validator:
             node = await self.client().get("", "Node", self.config.node_name)
             generation = nodeinfo.attributes(node).generation
             ring_min = _ring_min_gbps(generation) if chips > 1 else 0.0
-            # multi-chip: ring per-link diagnostic + sequence-parallel ring
-            # attention (the long-context acceptance); single chip: the
-            # burn-in train-step moves here from the gate (still proven,
-            # just not on the readiness critical path).  hbm-dma is the
-            # pallas DMA-pipeline cross-check paired with hbm
+            # multi-chip: ring per-link diagnostic + the parallelism
+            # census (ring attention, Ulysses all-to-all, expert-parallel
+            # MoE — whose dispatch crosses EVERY chip pair, a full-
+            # bisection check the neighbour ring can't give — and the
+            # GPipe pipeline); single chip: the burn-in train-step moves
+            # here from the gate (still proven, just not on the readiness
+            # critical path).  hbm-dma is the pallas DMA-pipeline
+            # cross-check paired with hbm
             checks = "matmul,hbm,hbm-dma" + (
-                ",ring,ring-attention" if chips > 1 else ",burn-in"
+                ",ring,ring-attention,ulysses,moe,pipeline"
+                if chips > 1 else ",burn-in"
             )
             # clear the previous run's drop-box FIRST: a failed probe run
             # must surface as "no current measurements", never republish

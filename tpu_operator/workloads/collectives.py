@@ -447,14 +447,10 @@ def apply_ring_gate(result: dict, min_gbps: float) -> dict:
 # sharded burn-in (slice acceptance test)
 
 
-def make_mesh(n_devices: Optional[int] = None, devices: Optional[list] = None) -> Mesh:
-    """2-D (dp, mp) mesh over the available chips; mp rides the fastest ICI
-    dimension (innermost), dp the outer."""
-    devices = devices if devices is not None else jax.devices()
-    if n_devices:
-        devices = devices[:n_devices]
-    n = len(devices)
-    # both axes populated when possible so dp and mp collectives both flow
+def _split_dp_mp(n: int) -> tuple:
+    """(dp, mp) factorization of n chips — both axes populated when
+    possible so dp and mp collectives both flow; mp gets the larger
+    factor (it carries the sequence/TP collectives)."""
     if n == 1:
         mp = 1
     elif n % 4 == 0 and n > 4:
@@ -463,7 +459,17 @@ def make_mesh(n_devices: Optional[int] = None, devices: Optional[list] = None) -
         mp = 2
     else:
         mp = n
-    dp = n // mp
+    return n // mp, mp
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[list] = None) -> Mesh:
+    """2-D (dp, mp) mesh over the available chips; mp rides the fastest ICI
+    dimension (innermost), dp the outer."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    n = len(devices)
+    dp, mp = _split_dp_mp(n)
     return Mesh(np.array(devices).reshape(dp, mp), ("dp", "mp"))
 
 
@@ -613,6 +619,37 @@ def transformer_params(
     }
 
 
+def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple):
+    """The flagship per-shard transformer layer on [b, s_loc, d] — the ONE
+    definition both the flat (dp, mp) step and the pp-pipelined stages
+    run: sequence-parallel ring attention over mp, then the Megatron-SP
+    MLP sandwich.  ``axes``: every manual mesh axis the activations vary
+    over (the ring's loop carries must match)."""
+    from tpu_operator.workloads import ring_attention
+
+    b, s_loc, d = xs.shape
+    hd = d // heads
+    xf = xs.astype(jnp.bfloat16)
+    # -- attention, sequence-parallel over the mp ring
+    h = _rmsnorm(xf)
+    q = (h @ wq).reshape(b, s_loc, heads, hd)
+    k = (h @ wk).reshape(b, s_loc, heads, hd)
+    v = (h @ wv).reshape(b, s_loc, heads, hd)
+    # the memory-efficient path: custom VJP recomputes each hop's
+    # scores in a second ring pass instead of letting AD save every
+    # hop's residuals — O(1) blocks per layer, the property that
+    # makes long sequences trainable at all
+    attn = ring_attention.ring_attention_remat(q, k, v, "mp", True, axes)
+    xa = xf + attn.reshape(b, s_loc, d) @ wo
+    # -- MLP, Megatron-SP: sequence shards gather into the TP
+    # region, column/row-split matmuls, reduce-scatter back out
+    g = jax.lax.all_gather(_rmsnorm(xa), "mp", axis=1, tiled=True)
+    mid = jnp.maximum(g @ w1, 0)            # [b, S, hidden/mp]
+    y_part = mid @ w2                        # partial over mp
+    y = jax.lax.psum_scatter(y_part, "mp", scatter_dimension=1, tiled=True)
+    return xa + y
+
+
 def transformer_step(
     mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05
 ) -> tuple[jax.Array, dict]:
@@ -620,8 +657,6 @@ def transformer_step(
     P("dp", "mp", None) — batch over dp, sequence over mp.  ``heads`` is
     static (it shapes the trace); partial it in before jit.  Returns
     (loss, new_params)."""
-    from tpu_operator.workloads import ring_attention
-
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]
 
     @functools.partial(
@@ -639,30 +674,9 @@ def transformer_step(
     )
     def step(wq, wk, wv, wo, w1, w2, xs):
         b, s_loc, d = xs.shape
-        hd = d // heads
 
         def loss_fn(wq, wk, wv, wo, w1, w2):
-            xf = xs.astype(jnp.bfloat16)
-            # -- attention, sequence-parallel over the mp ring
-            h = _rmsnorm(xf)
-            q = (h @ wq).reshape(b, s_loc, heads, hd)
-            k = (h @ wk).reshape(b, s_loc, heads, hd)
-            v = (h @ wv).reshape(b, s_loc, heads, hd)
-            # the memory-efficient path: custom VJP recomputes each hop's
-            # scores in a second ring pass instead of letting AD save every
-            # hop's residuals — O(1) blocks per layer, the property that
-            # makes long sequences trainable at all
-            attn = ring_attention.ring_attention_remat(
-                q, k, v, "mp", True, ("dp", "mp")
-            )
-            xa = xf + attn.reshape(b, s_loc, d) @ wo
-            # -- MLP, Megatron-SP: sequence shards gather into the TP
-            # region, column/row-split matmuls, reduce-scatter back out
-            g = jax.lax.all_gather(_rmsnorm(xa), "mp", axis=1, tiled=True)
-            mid = jnp.maximum(g @ w1, 0)            # [b, S, hidden/mp]
-            y_part = mid @ w2                        # partial over mp
-            y = jax.lax.psum_scatter(y_part, "mp", scatter_dimension=1, tiled=True)
-            out = xa + y
+            out = _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads, ("dp", "mp"))
             # global mean-square loss: reduce over every shard's tokens
             total = jax.lax.psum(
                 jax.lax.psum(jnp.sum(jnp.square(out.astype(jnp.float32))), "mp"),
@@ -725,3 +739,196 @@ def transformer_burn_in(
         mesh, jax.jit(functools.partial(transformer_step, mesh, heads)),
         params, x, steps,
     )
+
+
+# ---------------------------------------------------------------------------
+# The FULL composition: pipeline-parallel stack of transformer stages.
+# Mesh (pp, dp, mp): each pp shard owns one transformer layer's weights
+# (GPipe microbatch streaming, pipeline.py's tick/feed/land machinery),
+# and INSIDE each stage the layer runs exactly like transformer_step —
+# batch over dp, ring-attention sequence parallelism over mp, Megatron-SP
+# MLP over mp.  One shard_map, one differentiable program: tp/pp/dp/sp in
+# a single train step (ep has its own mesh in workloads/moe.py — routing
+# wants the full axis for its all-to-all, not a leftover factor).
+
+
+def make_mesh3(n_devices: Optional[int] = None, devices: Optional[list] = None) -> Mesh:
+    """3-D (pp, dp, mp) mesh; mp innermost (fastest ICI), pp outermost —
+    stage hops are the rarest collective (one ppermute per tick) so they
+    take the slowest axis."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    n = len(devices)
+    pp = 2 if n % 2 == 0 and n >= 4 else 1
+    dp, mp = _split_dp_mp(n // pp)
+    return Mesh(np.array(devices).reshape(pp, dp, mp), ("pp", "dp", "mp"))
+
+
+def transformer_pipeline_params(
+    mesh: Mesh, d_model: int = 128, d_hidden: int = 256, seed: int = 0
+):
+    """Per-stage transformer weights, stage axis sharded over pp, MLP
+    halves additionally Megatron-split over mp."""
+    pp = mesh.shape["pp"]
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    scale = 1.0 / np.sqrt(d_model)
+
+    def mk(k, shape, spec):
+        return jax.device_put(
+            jax.random.normal(k, shape, jnp.bfloat16) * scale,
+            NamedSharding(mesh, spec),
+        )
+
+    return {
+        "wq": mk(ks[0], (pp, d_model, d_model), P("pp", None, None)),
+        "wk": mk(ks[1], (pp, d_model, d_model), P("pp", None, None)),
+        "wv": mk(ks[2], (pp, d_model, d_model), P("pp", None, None)),
+        "wo": mk(ks[3], (pp, d_model, d_model), P("pp", None, None)),
+        "w1": mk(ks[4], (pp, d_model, d_hidden), P("pp", None, "mp")),
+        "w2": mk(ks[5], (pp, d_hidden, d_model), P("pp", "mp", None)),
+    }
+
+
+def transformer_pipeline_step(
+    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05
+) -> tuple[jax.Array, dict]:
+    """One SGD step of the pp-stage pipelined transformer stack on x
+    [M, B, S, D] microbatches sharded P(None, "dp", "mp", None).  Returns
+    (loss, new_params)."""
+    pp, dp, mp = mesh.shape["pp"], mesh.shape["dp"], mesh.shape["mp"]
+    axes = ("pp", "dp", "mp")
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("pp", None, None), P("pp", None, None), P("pp", None, None),
+            P("pp", None, None), P("pp", None, "mp"), P("pp", "mp", None),
+            P(None, "dp", "mp", None),
+        ),
+        out_specs=(
+            P(),
+            P("pp", None, None), P("pp", None, None), P("pp", None, None),
+            P("pp", None, None), P("pp", None, "mp"), P("pp", "mp", None),
+        ),
+    )
+    def step(wq, wk, wv, wo, w1, w2, xs):
+        m, b, s_loc, d = xs.shape
+        s_pp = jax.lax.axis_index("pp")
+        fwd = [(i, i + 1) for i in range(pp - 1)]
+
+        def layer(h_in, wq, wk, wv, wo, w1, w2):
+            """transformer_step's stage body on [b, s_loc, d] (f32 carry
+            for the scan; the layer math itself is bf16)."""
+            return _layer_fwd(
+                h_in, wq, wk, wv, wo, w1, w2, heads, axes
+            ).astype(jnp.float32)
+
+        def loss_fn(wq, wk, wv, wo, w1, w2):
+            wq, wk, wv, wo, w1, w2 = (w[0] for w in (wq, wk, wv, wo, w1, w2))
+            ticks = m + pp - 1
+
+            def feed(t):
+                mbi = jnp.clip(t, 0, m - 1)
+                return jax.lax.dynamic_slice(
+                    xs, (mbi, 0, 0, 0), (1, b, s_loc, d)
+                )[0].astype(jnp.float32)
+
+            x0 = jnp.where(s_pp == 0, feed(jnp.int32(0)),
+                           jnp.zeros((b, s_loc, d), jnp.float32))
+            # the carry accumulates a masked SCALAR, not the [m, b, s, d]
+            # output buffer: under value_and_grad every tick's carry is an
+            # AD residual, and a full buffer carry would cost
+            # O(ticks · m · tokens) backward memory — defeating the O(1)
+            # residual budget the ring-attention remat buys this step
+            total0 = _vary(jnp.float32(0), axes)
+
+            def tick(carry, t):
+                x_cur, total = carry
+                y = layer(x_cur, wq, wk, wv, wo, w1, w2)
+                # the last stage lands microbatch j = t - (pp-1); drain
+                # garbage never lands (j caps at m-1 on the final tick)
+                j = t - (pp - 1)
+                total = total + jnp.where(
+                    (s_pp == pp - 1) & (j >= 0), jnp.sum(jnp.square(y)), 0.0
+                )
+                recv = jax.lax.ppermute(y, "pp", fwd)
+                x_next = jnp.where(s_pp == 0, feed(t + 1), recv)
+                return (x_next, total), None
+
+            (_, total), _ = jax.lax.scan(
+                tick, (x0, total0), jnp.arange(ticks, dtype=jnp.int32)
+            )
+            # loss lives on the last stage (zeros elsewhere): psum over pp
+            # picks it up, dp/mp sum their token shards
+            for ax in ("mp", "dp", "pp"):
+                total = jax.lax.psum(total, ax)
+            count = m * b * dp * s_loc * mp * d
+            return total / count
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3, 4, 5))(
+            wq, wk, wv, wo, w1, w2
+        )
+
+        def upd(w, grad, reduce_axes):
+            # w and grad both carry the [1, ...] per-shard stage axis
+            for ax in reduce_axes:
+                grad = jax.lax.pmean(grad, ax)
+            return (w.astype(jnp.float32) - lr * grad.astype(jnp.float32)).astype(w.dtype)
+
+        # stage weights are private to their pp shard (NO pp reduction);
+        # every stage's weights are shared across its dp x mp region,
+        # except the mp-split MLP halves which reduce over dp alone
+        new = (
+            upd(wq, grads[0], ("dp", "mp")),
+            upd(wk, grads[1], ("dp", "mp")),
+            upd(wv, grads[2], ("dp", "mp")),
+            upd(wo, grads[3], ("dp", "mp")),
+            upd(w1, grads[4], ("dp",)),
+            upd(w2, grads[5], ("dp",)),
+        )
+        return (loss, *new)
+
+    loss, wq, wk, wv, wo, w1, w2 = step(
+        params["wq"], params["wk"], params["wv"], params["wo"],
+        params["w1"], params["w2"], x,
+    )
+    return loss, {
+        "wq": wq, "wk": wk, "wv": wv, "wo": wo, "w1": w1, "w2": w2,
+    }
+
+
+def transformer_pipeline_burn_in(
+    mesh: Optional[Mesh] = None,
+    steps: int = 3,
+    microbatches: int = 4,
+    batch_per_dp: int = 2,
+    seq_per_mp: int = 16,
+    d_model: int = 64,
+    d_hidden: int = 128,
+    heads: int = 4,
+) -> dict:
+    """Acceptance run of the full tp/pp/dp/sp composition; same contract
+    as burn_in."""
+    mesh = mesh or make_mesh3()
+    dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+    params = transformer_pipeline_params(mesh, d_model=d_model, d_hidden=d_hidden)
+    x = jax.device_put(
+        jax.random.normal(
+            jax.random.PRNGKey(1),
+            (microbatches, batch_per_dp * dp, seq_per_mp * mp, d_model),
+            jnp.float32,
+        ),
+        NamedSharding(mesh, P(None, "dp", "mp", None)),
+    )
+    result = _acceptance_run(
+        mesh, jax.jit(functools.partial(transformer_pipeline_step, mesh, heads)),
+        params, x, steps,
+    )
+    if mesh.shape["pp"] == 1:
+        # make_mesh3 degrades to pp=1 below 4 chips: the math still runs
+        # but no stage boundary is crossed — say so rather than let a
+        # dead pp ICI path read as exercised
+        result["pp_degenerate"] = True
+    return result

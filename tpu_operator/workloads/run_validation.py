@@ -7,8 +7,8 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  ulysses,moe,pipeline,transformer (default runs the first three; the
-  rest are opt-in
+  ulysses,moe,pipeline,transformer,transformer-pp (default runs the
+  first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
   cross-check, report-only; ring-attention and ulysses are the two
@@ -82,6 +82,11 @@ def main() -> int:
             # parallelism + Megatron-SP MLP in one train step (opt-in —
             # the gate stays minimal, dryrun/tests prove this composition)
             result = collectives.transformer_burn_in()
+        elif check == "transformer-pp":
+            # the full composition: GPipe microbatch pipeline of
+            # chip-resident transformer stages, each internally the
+            # dp+sp+tp layer — tp/pp/dp/sp in one train step
+            result = collectives.transformer_pipeline_burn_in()
         elif check == "matmul":
             from tpu_operator.workloads import matmul_bench
 
