@@ -212,8 +212,22 @@ def run_worker(
     )
     ra_ok = bool(ra["ok"])
 
+    # -- expert parallelism across hosts: the MoE dispatch all-to-all is
+    # the only pattern whose traffic crosses EVERY chip pair — on a
+    # multi-host slice that means every DCN/ICI route at once, the
+    # full-bisection proof the neighbour-ring hops above can't give.
+    # Exact against the dense reference (tie-proof quantized routing).
+    from tpu_operator.workloads import moe
+
+    ep = moe.acceptance(
+        tokens_per_shard=int(os.environ.get("MOE_TOKENS_PER_SHARD", "16")),
+        d_model=16, d_hidden=32, devices=devices,
+    )
+    ep_ok = bool(ep["ok"])
+
     return {
-        "ok": psum_ok and finite and decreasing and bw_ok and ring_ok and ra_ok,
+        "ok": (psum_ok and finite and decreasing and bw_ok and ring_ok
+               and ra_ok and ep_ok),
         "process_id": process_id,
         "num_processes": num_processes,
         "global_devices": len(devices),
@@ -237,6 +251,12 @@ def run_worker(
             k: ra.get(k)
             for k in ("ok", "seq", "seq_per_chip", "causal", "max_error", "time_s")
             if k in ra
+        },
+        "moe": {
+            k: ep.get(k)
+            for k in ("ok", "experts", "tokens", "dropped_fraction",
+                      "max_error", "time_s")
+            if k in ep
         },
         "losses": losses,
         "time_s": time.perf_counter() - t0,

@@ -72,23 +72,29 @@ def moe_params(
     experts_per_shard: int = 1, seed: int = 0,
 ):
     """Router (replicated) + expert FFN weights sharded over ``ep``:
-    w1/w2 lead with the global expert axis, split one group per chip."""
+    w1/w2 lead with the global expert axis, split one group per chip.
+
+    Constructed BY jit with output shardings — correct in multi-controller
+    mode too (a host-side device_put of the full array can only target
+    addressable devices)."""
     ep = mesh.shape["ep"]
     e = ep * experts_per_shard
-    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
     scale = 1.0 / np.sqrt(d_model)
 
-    def mk(k, shape, spec):
-        return jax.device_put(
-            jax.random.normal(k, shape, jnp.float32) * scale,
-            NamedSharding(mesh, spec),
-        )
+    def init(key):
+        kr, k1, k2 = jax.random.split(key, 3)
+        return {
+            "wr": jax.random.normal(kr, (d_model, e), jnp.float32) * scale,
+            "w1": jax.random.normal(k1, (e, d_model, d_hidden), jnp.float32) * scale,
+            "w2": jax.random.normal(k2, (e, d_hidden, d_model), jnp.float32) * scale,
+        }
 
-    return {
-        "wr": mk(ks[0], (d_model, e), P(None, None)),
-        "w1": mk(ks[1], (e, d_model, d_hidden), P("ep", None, None)),
-        "w2": mk(ks[2], (e, d_hidden, d_model), P("ep", None, None)),
+    out_shardings = {
+        "wr": NamedSharding(mesh, P(None, None)),
+        "w1": NamedSharding(mesh, P("ep", None, None)),
+        "w2": NamedSharding(mesh, P("ep", None, None)),
     }
+    return jax.jit(init, out_shardings=out_shardings)(jax.random.PRNGKey(seed))
 
 
 def moe_layer_sharded(
@@ -153,15 +159,18 @@ def dense_reference(x, wr, w1, w2, n_shards: int, capacity_factor: float):
     e = w1.shape[0]
     n_loc = n // n_shards
     c = _capacity(n_loc, e, capacity_factor)
-    outs = []
-    for s in range(n_shards):
-        xs = x[s * n_loc:(s + 1) * n_loc]
+
+    def per_shard(xs):
         dispatch, combine, _ = route_top1(xs @ wr, c)
         buf = jnp.einsum("nec,nd->ecd", dispatch, xs)
         h = jnp.maximum(jnp.einsum("ecd,edh->ech", buf, w1), 0)
         out = jnp.einsum("ech,ehd->ecd", h, w2)
-        outs.append(jnp.einsum("nec,ecd->nd", combine, out))
-    return jnp.concatenate(outs, axis=0)
+        return jnp.einsum("nec,ecd->nd", combine, out)
+
+    # vmap over the shard axis, NOT a Python loop: the distributed
+    # validation calls this with n_shards = the global chip count, and an
+    # unrolled loop would grow the traced program linearly with slice size
+    return jax.vmap(per_shard)(x.reshape(n_shards, n_loc, d)).reshape(n, d)
 
 
 def acceptance(
@@ -179,21 +188,30 @@ def acceptance(
     devices = devices if devices is not None else jax.devices()
     p = len(devices)
     mesh = Mesh(np.array(devices), ("ep",))
-    params = moe_params(mesh, d_model, d_hidden, experts_per_shard)
     n = tokens_per_shard * p
-    # tokens and ROUTER weights quantized to a coarse grid: router logits
-    # become exact f32 sums of exact products (magnitudes far below 2^24),
-    # so the distributed path and the reference compute bit-identical
-    # logits despite differently-structured matmuls — an argmax near-tie
-    # can never route a token differently in the two programs (which
-    # would O(1)-differ the output and fail a healthy node)
-    x = jax.device_put(
-        jnp.round(
-            jax.random.normal(jax.random.PRNGKey(7), (n, d_model), jnp.float32) * 8
-        ) / 8,
-        NamedSharding(mesh, P("ep", None)),
-    )
-    params["wr"] = jnp.round(params["wr"] * 128) / 128
+
+    # arrays constructed BY jit with output shardings — correct in
+    # multi-controller mode too (a host-side device_put of the full array
+    # can only target addressable devices; this path also serves the
+    # multi-host distributed validation program).  Tokens and ROUTER
+    # weights are quantized to a coarse grid: router logits become exact
+    # f32 sums of exact products (magnitudes far below 2^24), so the
+    # distributed path and the reference compute bit-identical logits
+    # despite differently-structured matmuls — an argmax near-tie can
+    # never route a token differently in the two programs (which would
+    # O(1)-differ the output and fail a healthy node)
+    params = moe_params(mesh, d_model, d_hidden, experts_per_shard)
+    # router quantized to the grid (replicated eager op — multi-controller
+    # safe: every process computes its addressable shards identically)
+    wr = jnp.round(params["wr"] * 128) / 128
+    w1, w2 = params["w1"], params["w2"]
+
+    def init(key):
+        return jnp.round(jax.random.normal(key, (n, d_model), jnp.float32) * 8) / 8
+
+    x = jax.jit(
+        init, out_shardings=NamedSharding(mesh, P("ep", None))
+    )(jax.random.PRNGKey(7))
 
     @jax.jit
     def program(x, wr, w1, w2):
@@ -204,7 +222,7 @@ def acceptance(
         return err, aux
 
     t0 = time.perf_counter()
-    err, aux = program(x, params["wr"], params["w1"], params["w2"])
+    err, aux = program(x, wr, w1, w2)
     err = float(err)
     dt = time.perf_counter() - t0
     return {
