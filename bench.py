@@ -160,6 +160,13 @@ def run_hbm_bench() -> dict:
     return _run_bench_module("tpu_operator.workloads.hbm_bench")
 
 
+def run_train_bench() -> dict:
+    """End-to-end training throughput: full flagship train steps (fwd +
+    remat-attention bwd + SGD collectives) -> tokens/sec and training MFU —
+    what a user of the node actually gets, not a primitive."""
+    return _run_bench_module("tpu_operator.workloads.train_bench", timeout=560)
+
+
 async def bench() -> dict:
     from tpu_operator import consts
     from tpu_operator.api.types import GROUP, CLUSTER_POLICY_KIND, State, TPUClusterPolicy
@@ -272,6 +279,7 @@ def main() -> None:
     # cold runs; mixing provenance would misattribute warm-run drift.
     matmul = run_matmul_bench()
     hbm = run_hbm_bench()
+    train = run_train_bench()
     cold = WORKLOAD_RESULTS[: result.pop("n_cold_results", len(WORKLOAD_RESULTS))]
     checks = {r.get("check", "?"): r for r in cold}
     allreduce = checks.get("allreduce", {})
@@ -306,6 +314,12 @@ def main() -> None:
             for k in ("ok", "backend", "generation", "size_mb", "gbps",
                       "gbps_median", "peak_hbm_gbps", "fraction_of_peak",
                       "overhead_dominated")
+        },
+        "train": {
+            k: train.get(k)
+            for k in ("ok", "devices", "batch", "seq", "d_model",
+                      "step_time_ms", "tokens_per_sec", "model_tflops",
+                      "train_mfu", "overhead_dominated")
         },
         "allreduce": {
             k: allreduce.get(k)

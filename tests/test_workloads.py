@@ -721,3 +721,19 @@ def test_transformer_pipeline_matches_single_device():
         losses[shape] = float(loss)
     a, b = losses.values()
     assert a == pytest.approx(b, rel=0.02), losses
+
+
+def test_train_bench_cpu_shapes():
+    """The training-throughput benchmark's contract: finite loss, positive
+    rates, analytic FLOPs accounting consistent with the shapes."""
+    from tpu_operator.workloads import train_bench
+
+    r = train_bench.quick_check()
+    assert r["ok"], r
+    assert r["devices"] == 8 and r["mesh"] == {"dp": 2, "mp": 4}
+    assert r["tokens_per_sec"] > 0 and r["model_tflops"] > 0
+    # cpu generation is unknown -> no MFU claim
+    assert "train_mfu" not in r
+    flops = train_bench.step_model_flops(4, 128, 64, 128)
+    # 3 x (8bsd^2 + 4bsdh + 4bs^2d)
+    assert flops == 3 * (8*4*128*64*64 + 4*4*128*64*128 + 4*4*128*128*64)

@@ -7,8 +7,8 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  ulysses,moe,pipeline,transformer,transformer-pp (default runs the
-  first three; the rest are opt-in
+  ulysses,moe,pipeline,transformer,transformer-pp,train (default runs
+  the first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
   cross-check, report-only; ring-attention and ulysses are the two
@@ -87,6 +87,13 @@ def main() -> int:
             # chip-resident transformer stages, each internally the
             # dp+sp+tp layer — tp/pp/dp/sp in one train step
             result = collectives.transformer_pipeline_burn_in()
+        elif check == "train":
+            # end-to-end training throughput: tokens/sec + training MFU
+            # of the flagship step at real shapes (report-only evidence
+            # for capacity planning; holds the chip ~1min on TPU)
+            from tpu_operator.workloads import train_bench
+
+            result = train_bench.quick_check()
         elif check == "matmul":
             from tpu_operator.workloads import matmul_bench
 
