@@ -619,12 +619,15 @@ def transformer_params(
     }
 
 
-def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple):
+def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple,
+               use_pallas: bool = False):
     """The flagship per-shard transformer layer on [b, s_loc, d] — the ONE
     definition both the flat (dp, mp) step and the pp-pipelined stages
     run: sequence-parallel ring attention over mp, then the Megatron-SP
     MLP sandwich.  ``axes``: every manual mesh axis the activations vary
-    over (the ring's loop carries must match)."""
+    over (the ring's loop carries must match); ``use_pallas`` routes the
+    attention FORWARD through the fused flash kernel (training-safe: the
+    remat backward consumes only layout-identical residuals)."""
     from tpu_operator.workloads import ring_attention
 
     b, s_loc, d = xs.shape
@@ -639,7 +642,9 @@ def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple):
     # scores in a second ring pass instead of letting AD save every
     # hop's residuals — O(1) blocks per layer, the property that
     # makes long sequences trainable at all
-    attn = ring_attention.ring_attention_remat(q, k, v, "mp", True, axes)
+    attn = ring_attention.ring_attention_remat(
+        q, k, v, "mp", True, axes, use_pallas
+    )
     xa = xf + attn.reshape(b, s_loc, d) @ wo
     # -- MLP, Megatron-SP: sequence shards gather into the TP
     # region, column/row-split matmuls, reduce-scatter back out
@@ -651,7 +656,8 @@ def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple):
 
 
 def transformer_step(
-    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05
+    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05,
+    use_pallas: bool = False,
 ) -> tuple[jax.Array, dict]:
     """One SGD step of the transformer layer on x [B, S, D] sharded
     P("dp", "mp", None) — batch over dp, sequence over mp.  ``heads`` is
@@ -671,12 +677,16 @@ def transformer_step(
             P(None, None), P(None, None), P(None, None), P(None, None),
             P(None, "mp"), P("mp", None),
         ),
+        # the pallas path trips the vma checker's dynamic_slice rule (see
+        # ring_attention.ring_attention); jnp keeps the strict checking
+        check_vma=not use_pallas,
     )
     def step(wq, wk, wv, wo, w1, w2, xs):
         b, s_loc, d = xs.shape
 
         def loss_fn(wq, wk, wv, wo, w1, w2):
-            out = _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads, ("dp", "mp"))
+            out = _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads, ("dp", "mp"),
+                             use_pallas)
             # global mean-square loss: reduce over every shard's tokens
             total = jax.lax.psum(
                 jax.lax.psum(jnp.sum(jnp.square(out.astype(jnp.float32))), "mp"),

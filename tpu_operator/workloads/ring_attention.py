@@ -71,20 +71,27 @@ def _hop_scores(q32, k, scale, causal, q_pos, src, block):
 # interpret-mode test pins them equal.
 
 
-def _flash_block_kernel(causal, scale,
+def _flash_block_kernel(causal, scale, blk_q,
                         qoff_ref, koff_ref, q_ref, k_ref, v_ref,
                         m_in, l_in, o_in, m_out, l_out, o_out):
-    q = q_ref[0].astype(jnp.float32)          # [Tq, D]
-    k = k_ref[0].astype(jnp.float32)          # [Tk, D]
-    v = v_ref[0].astype(jnp.float32)          # [Tk, D]
-    m = m_in[0]                               # [Tq, 1] (trailing unit dim:
+    # inputs stay in their storage dtype (bf16 from the training step):
+    # the MXU runs bf16 x bf16 -> f32 at full rate, while upcasting to
+    # f32 first would halve-or-worse the matmul throughput — this cost
+    # 16% training MFU (0.56 -> 0.48) before the fix.  All softmax state
+    # math stays f32.
+    q = q_ref[0]                              # [blk_q, D]
+    k = k_ref[0]                              # [Tk, D]
+    v = v_ref[0]                              # [Tk, D]
+    m = m_in[0]                               # [blk_q, 1] (trailing unit dim:
     l = l_in[0]                               #  Mosaic block-shape rules)
-    o = o_in[0]                               # [Tq, D]
+    o = o_in[0]                               # [blk_q, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                 # [Tq, Tk] on the MXU
+    ) * scale                                 # [blk_q, Tk] on the MXU
     if causal:
-        q_pos = qoff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        # my q rows start at (shard offset) + (q-tile index) x blk_q
+        q_base = qoff_ref[0] + pl.program_id(1) * blk_q
+        q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     blk_max = jnp.max(s, axis=-1, keepdims=True)  # [Tq, 1]
@@ -95,9 +102,23 @@ def _flash_block_kernel(causal, scale,
     m_out[0] = m_new
     l_out[0] = l * corr + jnp.sum(e, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(
-        e, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     o_out[0] = o * corr + pv
+
+
+def _q_tile(tq: int, tk: int, budget_bytes: int = 4 << 20) -> int:
+    """Largest divisor of ``tq`` (multiple of 8) whose [blk_q, Tk] f32
+    score block fits the VMEM budget; ``tq`` itself when it already
+    fits (small validation shapes keep the original single-tile grid)."""
+    target = max(8, budget_bytes // (tk * 4))
+    if tq <= target:
+        return tq
+    for blk in range(min(tq, target - target % 8), 7, -8):
+        if tq % blk == 0:
+            return blk
+    return tq  # no aligned divisor — fall back to one tile
 
 
 def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
@@ -107,34 +128,38 @@ def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
     Shapes (per shard, already merged over batch×heads): q/k/v/o
     ``[BH, T, D]``, m/l ``[BH, T]``; ``q_off``/``k_off`` are the blocks'
     global sequence offsets (scalars, prefetched to SMEM for the causal
-    iota).  Grid: one program instance per (batch, head) pair.  ``vma``:
-    the mesh axes the outputs vary over when called under shard_map."""
+    iota).  Grid: (batch x head, q-tile) — Q (and its m/l/o state) is
+    tiled so the [blk_q, Tk] score block stays inside VMEM at training
+    shapes (a 2048x2048 f32 score block alone is 16 MB, the whole scoped
+    budget); K/V are revisited whole per tile.  ``vma``: the mesh axes
+    the outputs vary over when called under shard_map."""
     bh, tq, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
+    blk_q = _q_tile(tq, tk)
     # m/l travel as [BH, Tq, 1]: Mosaic requires the last two block dims
     # divisible by (8, 128) or equal to the array dims — a trailing unit
     # dim satisfies that where a flat [BH, Tq] block (1, Tq) cannot
     m3, l3 = m[..., None], l[..., None]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(bh,),
+        grid=(bh, tq // blk_q),
         in_specs=[
-            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tq, 1), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, tq, d), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
         ],
     )
     m3, l3, o = pl.pallas_call(
-        functools.partial(_flash_block_kernel, causal, scale),
+        functools.partial(_flash_block_kernel, causal, scale, blk_q),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(m3.shape, jnp.float32, vma=vma),
@@ -230,7 +255,14 @@ def ring_attention_sharded(
     if not use_pallas:
         out, _ = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
         return out
+    out, _ = _pallas_ring_forward(q, k, v, axis_name, causal, axes)
+    return out
 
+
+def _pallas_ring_forward(q, k, v, axis_name: str, causal: bool, axes: tuple):
+    """The fused-kernel ring forward: returns (out, logsumexp) in the same
+    layouts as _jnp_ring_forward (out [B, T, H, D], lse [B, T, H]) — so
+    the remat backward can consume either forward's residuals."""
     p = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, block, h, d = q.shape
@@ -271,7 +303,11 @@ def ring_attention_sharded(
     denom = jnp.where(l > 0, l, 1.0)
     out = o / denom[:, :, None]  # [B*H, T, D]
     out = jnp.transpose(out.reshape(b, h, block, d), (0, 2, 1, 3))
-    return out.astype(q.dtype)
+
+    def split(x):  # [B*H, T] -> [B, T, H] (jnp layout)
+        return jnp.transpose(x.reshape(b, h, block), (0, 2, 1))
+
+    return out.astype(q.dtype), _lse_of(split(m), split(l))
 
 
 def ring_attention(
@@ -393,20 +429,31 @@ def _lse_of(m, l):
     return m + jnp.log(jnp.where(l > 0, l, 1.0))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def ring_attention_remat(q, k, v, axis_name: str, causal: bool, axes: tuple):
-    """ring_attention_sharded's jnp path with an O(1)-residual backward;
-    call under shard_map exactly like ring_attention_sharded."""
-    out, _ = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_remat(q, k, v, axis_name: str, causal: bool, axes: tuple,
+                         use_pallas: bool = False):
+    """ring_attention_sharded with an O(1)-residual backward; call under
+    shard_map exactly like ring_attention_sharded.  ``use_pallas`` runs
+    the FORWARD through the fused flash kernel (the jnp forward
+    materializes the [B,H,Tq,Tk] score tensor in HBM twice per hop); the
+    backward consumes only (q, k, v, out, lse) so either forward feeds
+    the same second ring pass."""
+    out, _ = (
+        _pallas_ring_forward if use_pallas else _jnp_ring_forward
+    )(q, k, v, axis_name, causal, axes)
     return out
 
 
-def _remat_fwd(q, k, v, axis_name, causal, axes):
-    out, lse = _jnp_ring_forward(q, k, v, axis_name, causal, axes)
+def _remat_fwd(q, k, v, axis_name, causal, axes, use_pallas=False):
+    out, lse = (
+        _pallas_ring_forward if use_pallas else _jnp_ring_forward
+    )(q, k, v, axis_name, causal, axes)
     return out, (q, k, v, out, lse)
 
 
-def _remat_bwd(axis_name, causal, axes, res, dout):
+def _remat_bwd(axis_name, causal, axes, use_pallas, res, dout):
+    # use_pallas shaped the forward only; the backward's second ring pass
+    # needs nothing from it (residuals are layout-identical either way)
     from tpu_operator.workloads.collectives import _vary
 
     q, k, v, out, lse = res

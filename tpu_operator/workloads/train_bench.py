@@ -43,14 +43,15 @@ def step_model_flops(batch: int, seq: int, d_model: int, d_hidden: int) -> float
 
 
 def train_benchmark(
-    batch_per_dp: int = 8,
+    batch_per_dp: int = 4,
     seq_per_mp: int = 2048,
-    d_model: int = 2048,
-    d_hidden: int = 8192,
-    heads: int = 16,
+    d_model: int = 4096,
+    d_hidden: int = 16384,
+    heads: int = 32,
     steps: int = 4,
     best_of: int = 3,
     devices: Optional[list] = None,
+    use_pallas: bool = False,
 ) -> dict:
     """Measure sustained train-step throughput on all local chips.
 
@@ -76,7 +77,9 @@ def train_benchmark(
     @jax.jit
     def run(params, x):
         def body(params, _):
-            loss, params = collectives.transformer_step(mesh, heads, params, x)
+            loss, params = collectives.transformer_step(
+                mesh, heads, params, x, use_pallas=use_pallas
+            )
             return params, loss
         params, losses = jax.lax.scan(body, params, None, length=steps)
         return losses[-1], params
@@ -122,6 +125,7 @@ def train_benchmark(
         "model_tflops": tflops,
         "backend": jax.default_backend(),
         "generation": generation,
+        "attention_forward": "pallas-flash" if use_pallas else "jnp",
     }
     if peak > 0:
         result["train_mfu"] = round(tflops / peak, 4)
