@@ -319,7 +319,8 @@ async def test_perf_probes_workload_pod(validation_root):
                 for e in deep_get(pod, "spec", "containers", 0, "env")
             }
             assert env["WORKLOAD_CHECKS"] == (
-                "matmul,hbm,hbm-dma,ring,ring-attention,ulysses,moe,pipeline"
+                "matmul,hbm,hbm-dma,longctx,"
+                "ring,ring-attention,ulysses,moe,pipeline"
             )
             assert env["RESULTS_SCOPE"] == "perf"
             # 4 chips → per-link ring floor armed from the catalogue

@@ -1,0 +1,287 @@
+"""Long-context prefill: K/V-streamed flash attention on one chip.
+
+Ring attention (ring_attention.py) lets sequences outgrow a HOST by
+keeping one block per chip — but the per-chip block itself must not
+materialize its scores either, or the chip's HBM caps the block at
+~sqrt(HBM).  This module closes that half: full causal attention over a
+long local sequence with K/V streamed through the fused flash kernel
+one block at a time (the same ``flash_block_update`` + online-softmax
+state the ring uses per hop, here driven by an in-chip ``fori_loop``) —
+peak memory is O(T·D) activations plus one [blk_q, block_k] score tile
+in VMEM, never the [T, T] score matrix.  Composed with the ring this
+means sequence length is bounded by activation storage alone, at any
+slice size.
+
+Causal block skipping: a K/V block strictly above the diagonal for every
+query in the shard contributes nothing — ``lax.cond`` skips its matmuls
+entirely, the standard flash triangular saving (~2x at long T).
+
+Exactness evidence at scales where the full reference is impossible
+(32k² f32 scores per head = 4 GB): spot-check q-tiles — one tile's
+reference needs only a [tile, T] score slab, so the first and last tiles
+(the diagonal edge and the full-context row) are verified exactly.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_operator.workloads import timing
+from tpu_operator.workloads.ring_attention import (
+    NEG_INF,
+    online_softmax_block_update,
+)
+
+import functools
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _flash_full_kernel(causal, scale, blk_q, blk_k,
+                       qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                       o_out, lse_out, m_sc, l_sc, acc_sc):
+    kk = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    q_base = qoff_ref[0] + pl.program_id(1) * blk_q
+    k_base = koff_ref[0] + kk * blk_k
+    # causal: a block whose FIRST key is past the tile's last query is
+    # fully masked — predicate the whole update off (the flash
+    # triangular saving, ~2x at long T)
+    live = (k_base <= q_base + blk_q - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        m_new, l_new, acc_new = online_softmax_block_update(
+            causal, scale, q_ref[0], k_ref[0], v_ref[0],
+            m_sc[...], l_sc[...], acc_sc[...], q_base, k_base,
+        )
+        m_sc[...] = m_new
+        l_sc[...] = l_new
+        acc_sc[...] = acc_new
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        l = l_sc[...]
+        denom = jnp.where(l > 0, l, 1.0)
+        o_out[0] = (acc_sc[...] / denom).astype(o_out.dtype)
+        lse_out[0] = m_sc[...] + jnp.log(denom)
+
+
+def _block_div(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is <= ``want`` and a multiple of 8
+    (Mosaic tiling); ``t`` itself only when no aligned divisor exists
+    (tiny test shapes)."""
+    if t <= want:
+        return t
+    for blk in range(min(t, want - want % 8), 7, -8):
+        if t % blk == 0:
+            return blk
+    return t
+
+
+def flash_attention_local(q, k, v, causal: bool = True, block_k: int = 1024,
+                          block_q: int = 1024, q_off: int = 0, k_off: int = 0):
+    """Causal flash attention in the merged layout ``[BH, T, D]``: ONE
+    pallas program, grid (bh, q-tile, k-block) with k innermost — the
+    online-softmax state lives in VMEM scratch across a q-tile's k sweep
+    and each output tile is written once (the streamed-state fori_loop
+    this replaces re-read the full O(T·D) state per k block and measured
+    13 attn-TFLOPs at 32k; see prefill_benchmark).  Returns
+    (out [BH, Tq, D], lse [BH, Tq]).  ``q_off``/``k_off``: global
+    sequence offsets (a ring shard can stream its held block too).
+    Defaults from an r04 32k sweep on v5e: (block_q=1024, block_k=1024)
+    measured ~92 causal attn-TFLOPs (run-to-run tunnel variance up to
+    ~15%), ahead of 512-row q blocks (~62) and 256-col k blocks (~33);
+    2048-row q blocks exceed VMEM."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    # non-divisible sequences: largest aligned divisor at most the
+    # requested block (NOT one giant block — a [blk_q, tk] score tile at
+    # the long sequences this module exists for would blow VMEM)
+    block_k = _block_div(tk, block_k)
+    block_q = _block_div(tq, block_q)
+    scale = 1.0 / np.sqrt(d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk, *_: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk, *_: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk, *_: (i, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk, *_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kk, *_: (i, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    out, lse3 = pl.pallas_call(
+        functools.partial(_flash_full_kernel, causal, scale, block_q, block_k),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(
+        jnp.asarray([q_off], jnp.int32),
+        jnp.asarray([k_off], jnp.int32),
+        q, k, v,
+    )
+    return out, lse3[..., 0]
+
+
+def _merge(x):
+    """[B, T, H, D] -> [B*H, T, D] (kernel layout)."""
+    b, t, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+
+def _tile_reference(q_tile, k, v, tile_off, causal):
+    """Exact attention for one merged-layout q tile against the full
+    sequence — [tile, T] scores only, feasible at any T."""
+    s = jnp.einsum("btd,bkd->btk", q_tile.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(q_tile.shape[-1])
+    if causal:
+        t = k.shape[1]
+        q_pos = tile_off + jnp.arange(q_tile.shape[1])
+        s = jnp.where(q_pos[None, :, None] >= jnp.arange(t)[None, None, :],
+                      s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("btk,bkd->btd", w.astype(v.dtype), v)
+
+
+def prefill_benchmark(
+    seq: int = 32768,
+    heads: int = 8,
+    head_dim: int = 128,
+    batch: int = 1,
+    block_k: int = 1024,
+    tile: int = 128,
+    causal: bool = True,
+    best_of: int = 3,
+    iters: int = 8,
+) -> dict:
+    """Long-context prefill attention on one chip: throughput + spot-check
+    exactness.  Returns the check-result dict (run_validation shape).
+
+    Timing: ``iters`` prefills chained inside ONE compiled fori_loop
+    (each iteration's output becomes the next query — data-dependent, no
+    dead-code elimination), so the ~100ms tunneled dispatch floor
+    amortizes instead of dominating a single ~25ms prefill."""
+    bh = batch * heads
+
+    def init(key):
+        ks = jax.random.split(key, 3)
+        shape = (bh, seq, head_dim)
+        return tuple(jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+
+    q, k, v = jax.jit(init)(jax.random.PRNGKey(11))
+
+    @jax.jit
+    def single(q, k, v):
+        return flash_attention_local(q, k, v, causal, block_k)
+
+    @jax.jit
+    def chain(q, k, v):
+        def body(_, q):
+            out, _ = flash_attention_local(q, k, v, causal, block_k)
+            return out
+        return jnp.sum(jax.lax.fori_loop(0, iters, body, q)[0, 0].astype(jnp.float32))
+
+    out, _ = single(q, k, v)  # compile + settle (also the exactness subject)
+    out.block_until_ready()
+    float(chain(q, k, v))
+
+    @jax.jit
+    def null(q):
+        return jnp.sum(q[0, 0].astype(jnp.float32))
+
+    float(null(q))
+    overhead = min(timing.timed(lambda: float(null(q))) for _ in range(3))
+    raw = []
+    for _ in range(best_of):
+        t0 = time.perf_counter()
+        float(chain(q, k, v))
+        raw.append(time.perf_counter() - t0)
+    times, overhead_dominated = timing.subtract_floor(raw, overhead, per=iters)
+    dt = times[0]
+
+    # exactness: first tile (diagonal edge) and last tile (attends to the
+    # whole context) against the per-tile reference
+    @jax.jit
+    def spot_errors(q, k, v, out):
+        errs = []
+        for off in (0, seq - tile):
+            qt = jax.lax.dynamic_slice(q, (0, off, 0), (bh, tile, head_dim))
+            ot = jax.lax.dynamic_slice(out, (0, off, 0), (bh, tile, head_dim))
+            ref = _tile_reference(qt, k, v, off, causal)
+            errs.append(jnp.max(jnp.abs(
+                ot.astype(jnp.float32) - ref.astype(jnp.float32)
+            )))
+        return jnp.stack(errs)
+
+    errs = [float(e) for e in spot_errors(q, k, v, out)]
+    max_err = max(errs)
+    # attention FLOPs (causal: half the score/PV work is masked out)
+    flops = 4.0 * bh * seq * seq * head_dim * (0.5 if causal else 1.0)
+    return {
+        "ok": bool(np.isfinite(max_err) and max_err < 2e-2),
+        "seq": seq,
+        "heads": heads,
+        "head_dim": head_dim,
+        "block_k": block_k,
+        "causal": causal,
+        "time_s": dt,
+        "overhead_dominated": overhead_dominated,
+        "tokens_per_sec": batch * seq / dt,
+        "attn_tflops": flops / dt / 1e12,
+        "max_error": max_err,
+        "spot_tiles": [0, seq - tile],
+        "backend": jax.default_backend(),
+    }
+
+
+def quick_check() -> dict:
+    """The validator's probe: 32k tokens on TPU; tiny interpret shapes
+    elsewhere."""
+    if jax.default_backend() == "tpu":
+        return prefill_benchmark()
+    return prefill_benchmark(seq=256, heads=2, head_dim=8, block_k=64,
+                             tile=32, best_of=2)
+
+
+def main() -> int:
+    import json
+
+    from tpu_operator import workloads
+    from tpu_operator.workloads import compile_cache
+
+    workloads.honor_cpu_platform_request()
+    compile_cache.enable()
+    result = quick_check()
+    print(json.dumps(result), flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -71,41 +71,50 @@ def _hop_scores(q32, k, scale, causal, q_pos, src, block):
 # interpret-mode test pins them equal.
 
 
-def _flash_block_kernel(causal, scale, blk_q,
-                        qoff_ref, koff_ref, q_ref, k_ref, v_ref,
-                        m_in, l_in, o_in, m_out, l_out, o_out):
-    # inputs stay in their storage dtype (bf16 from the training step):
-    # the MXU runs bf16 x bf16 -> f32 at full rate, while upcasting to
-    # f32 first would halve-or-worse the matmul throughput — this cost
-    # 16% training MFU (0.56 -> 0.48) before the fix.  All softmax state
-    # math stays f32.
-    q = q_ref[0]                              # [blk_q, D]
-    k = k_ref[0]                              # [Tk, D]
-    v = v_ref[0]                              # [Tk, D]
-    m = m_in[0]                               # [blk_q, 1] (trailing unit dim:
-    l = l_in[0]                               #  Mosaic block-shape rules)
-    o = o_in[0]                               # [blk_q, D]
+def online_softmax_block_update(causal, scale, q, k, v, m, l, acc,
+                                q_base, k_base):
+    """The per-block flash update BOTH pallas kernels run (the ring hop
+    kernel below and longctx's full-attention kernel): fold one K/V
+    block's scores into the (m, l, acc) online-softmax state.  Pure
+    function of loaded VMEM values; numerically delicate — one home.
+
+    Inputs stay in their storage dtype (bf16 from the training step):
+    the MXU runs bf16 x bf16 -> f32 at full rate, while upcasting to
+    f32 first would halve-or-worse the matmul throughput — this cost
+    16% training MFU (0.56 -> 0.48) before the fix.  All softmax state
+    math stays f32.  Shapes: q [Bq, D], k/v [Bk, D], m/l [Bq, 1],
+    acc [Bq, D]."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                 # [blk_q, Tk] on the MXU
+    ) * scale                                 # [Bq, Bk] on the MXU
     if causal:
-        # my q rows start at (shard offset) + (q-tile index) x blk_q
-        q_base = qoff_ref[0] + pl.program_id(1) * blk_q
         q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    blk_max = jnp.max(s, axis=-1, keepdims=True)  # [Tq, 1]
+    blk_max = jnp.max(s, axis=-1, keepdims=True)  # [Bq, 1]
     m_new = jnp.maximum(m, blk_max)
     corr = jnp.exp(m - m_new)
     e = jnp.exp(s - m_new)
     e = jnp.where(s <= NEG_INF * 0.5, 0.0, e)  # fully-masked guard
-    m_out[0] = m_new
-    l_out[0] = l * corr + jnp.sum(e, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(
         e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_out[0] = o * corr + pv
+    return m_new, l * corr + jnp.sum(e, axis=-1, keepdims=True), acc * corr + pv
+
+
+def _flash_block_kernel(causal, scale, blk_q,
+                        qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                        m_in, l_in, o_in, m_out, l_out, o_out):
+    # my q rows start at (shard offset) + (q-tile index) x blk_q
+    q_base = qoff_ref[0] + pl.program_id(1) * blk_q
+    m_new, l_new, o_new = online_softmax_block_update(
+        causal, scale, q_ref[0], k_ref[0], v_ref[0],
+        m_in[0], l_in[0], o_in[0], q_base, koff_ref[0],
+    )
+    m_out[0] = m_new
+    l_out[0] = l_new
+    o_out[0] = o_new
 
 
 def _q_tile(tq: int, tk: int, budget_bytes: int = 4 << 20) -> int:

@@ -7,8 +7,8 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  ulysses,moe,pipeline,transformer,transformer-pp,train (default runs
-  the first three; the rest are opt-in
+  ulysses,moe,pipeline,longctx,transformer,transformer-pp,train (default
+  runs the first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
   cross-check, report-only; ring-attention and ulysses are the two
@@ -120,6 +120,12 @@ def main() -> int:
             from tpu_operator.workloads import moe
 
             result = moe.quick_check()
+        elif check == "longctx":
+            # long-context prefill: K/V-streamed flash attention (32k
+            # tokens on one chip), spot-tile exactness + throughput
+            from tpu_operator.workloads import longctx
+
+            result = longctx.quick_check()
         elif check == "pipeline":
             # GPipe microbatch streaming over chip-resident stages
             from tpu_operator.workloads import pipeline
