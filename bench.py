@@ -315,6 +315,11 @@ def main() -> None:
                       "gbps_median", "peak_hbm_gbps", "fraction_of_peak",
                       "overhead_dominated")
         },
+        "workload_longctx": {
+            k: checks.get("longctx", {}).get(k)
+            for k in ("ok", "seq", "attn_tflops", "tokens_per_sec",
+                      "max_error", "overhead_dominated")
+        },
         "train": {
             k: train.get(k)
             for k in ("ok", "devices", "batch", "seq", "d_model",
