@@ -320,6 +320,11 @@ def main() -> None:
             for k in ("ok", "seq", "attn_tflops", "tokens_per_sec",
                       "max_error", "overhead_dominated")
         },
+        "workload_decode": {
+            k: checks.get("decode", {}).get(k)
+            for k in ("ok", "seq", "decode_us", "cache_gbps",
+                      "cache_fraction_of_peak", "overhead_dominated")
+        },
         "train": {
             k: train.get(k)
             for k in ("ok", "devices", "batch", "seq", "d_model",

@@ -319,7 +319,7 @@ async def test_perf_probes_workload_pod(validation_root):
                 for e in deep_get(pod, "spec", "containers", 0, "env")
             }
             assert env["WORKLOAD_CHECKS"] == (
-                "matmul,hbm,hbm-dma,longctx,"
+                "matmul,hbm,hbm-dma,longctx,decode,"
                 "ring,ring-attention,ulysses,moe,pipeline"
             )
             assert env["RESULTS_SCOPE"] == "perf"

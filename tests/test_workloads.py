@@ -814,3 +814,31 @@ def test_longctx_prefill_check():
     r = longctx.quick_check()
     assert r["ok"], r
     assert r["seq"] == 256 and r["tokens_per_sec"] > 0
+
+
+def test_decode_attention_matches_reference():
+    """The decode path (8-row query tail at the cache end) must equal the
+    reference's last rows — the same kernel, extreme-aspect shapes."""
+    import jax.numpy as jnp
+
+    from tpu_operator.workloads import longctx
+    from tpu_operator.workloads.ring_attention import reference_attention
+
+    keys = jax.random.split(jax.random.PRNGKey(6), 3)
+    b, t, h, d = 1, 128, 2, 8
+    q, k, v = (jax.random.normal(kk, (b, t, h, d), jnp.bfloat16) for kk in keys)
+    qm, km, vm = (longctx._merge(x) for x in (q, k, v))
+    out, _ = longctx.flash_attention_local(
+        qm[:, -8:], km, vm, causal=True, block_k=32, q_off=t - 8
+    )
+    ref = longctx._merge(reference_attention(q, k, v, True))[:, -8:]
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    assert err < 2e-2, err
+
+
+def test_decode_check_cpu():
+    from tpu_operator.workloads import longctx
+
+    r = longctx.decode_quick_check()
+    assert r["ok"], r
+    assert r["decode_us"] > 0 and r["cache_gbps"] > 0

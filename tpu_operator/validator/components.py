@@ -474,7 +474,7 @@ class Validator:
             # here from the gate (still proven, just not on the readiness
             # critical path).  hbm-dma is the pallas DMA-pipeline
             # cross-check paired with hbm
-            checks = "matmul,hbm,hbm-dma,longctx" + (
+            checks = "matmul,hbm,hbm-dma,longctx,decode" + (
                 ",ring,ring-attention,ulysses,moe,pipeline"
                 if chips > 1 else ",burn-in"
             )

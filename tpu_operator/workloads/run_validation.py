@@ -7,7 +7,7 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  ulysses,moe,pipeline,longctx,transformer,transformer-pp,train (default
+  ulysses,moe,pipeline,longctx,decode,transformer,transformer-pp,train (default
   runs the first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
@@ -126,6 +126,12 @@ def main() -> int:
             from tpu_operator.workloads import longctx
 
             result = longctx.quick_check()
+        elif check == "decode":
+            # decode attention against a long KV cache: per-token latency
+            # + cache-read bandwidth (the HBM-bound half of serving)
+            from tpu_operator.workloads import longctx
+
+            result = longctx.decode_quick_check()
         elif check == "pipeline":
             # GPipe microbatch streaming over chip-resident stages
             from tpu_operator.workloads import pipeline
