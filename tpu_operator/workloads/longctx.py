@@ -33,6 +33,7 @@ import numpy as np
 from tpu_operator.workloads import timing
 from tpu_operator.workloads.ring_attention import (
     NEG_INF,
+    merge_heads as _merge,
     online_softmax_block_update,
 )
 
@@ -146,12 +147,6 @@ def flash_attention_local(q, k, v, causal: bool = True, block_k: int = 1024,
         q, k, v,
     )
     return out, lse3[..., 0]
-
-
-def _merge(x):
-    """[B, T, H, D] -> [B*H, T, D] (kernel layout)."""
-    b, t, h, d = x.shape
-    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
 
 def _tile_reference(q_tile, k, v, tile_off, causal):

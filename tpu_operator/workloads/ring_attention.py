@@ -50,6 +50,18 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
 
 
+def merge_heads(x):
+    """[B, T, H, D] -> [B*H, T, D] — the pallas kernels' layout."""
+    b, t, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+
+def split_heads(x, b: int, h: int):
+    """[B*H, T, D] -> [B, T, H, D] (merge_heads' inverse)."""
+    _, t, d = x.shape
+    return jnp.transpose(x.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
 def _hop_scores(q32, k, scale, causal, q_pos, src, block):
     """Scores of my Q block against the K block produced by shard ``src``,
     causal-masked from global positions — the one definition both the
@@ -278,9 +290,7 @@ def _pallas_ring_forward(q, k, v, axis_name: str, causal: bool, axes: tuple):
 
     from tpu_operator.workloads.collectives import _vary
 
-    def merge(x):  # [B, T, H, D] -> [B*H, T, D] (kernel layout)
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, block, d)
-
+    merge = merge_heads
     m = _vary(jnp.full((b * h, block), NEG_INF, jnp.float32), axes)
     l = _vary(jnp.zeros((b * h, block), jnp.float32), axes)
     o = _vary(jnp.zeros((b * h, block, d), jnp.float32), axes)
@@ -310,13 +320,12 @@ def _pallas_ring_forward(q, k, v, axis_name: str, causal: bool, axes: tuple):
     m, l, o, k, v = jax.lax.fori_loop(0, p - 1, hop, (m, l, o, k, v))
     m, l, o = consume(p - 1, m, l, o, k, v)
     denom = jnp.where(l > 0, l, 1.0)
-    out = o / denom[:, :, None]  # [B*H, T, D]
-    out = jnp.transpose(out.reshape(b, h, block, d), (0, 2, 1, 3))
+    out = split_heads(o / denom[:, :, None], b, h)
 
-    def split(x):  # [B*H, T] -> [B, T, H] (jnp layout)
+    def split2(x):  # [B*H, T] -> [B, T, H] (jnp layout)
         return jnp.transpose(x.reshape(b, h, block), (0, 2, 1))
 
-    return out.astype(q.dtype), _lse_of(split(m), split(l))
+    return out.astype(q.dtype), _lse_of(split2(m), split2(l))
 
 
 def ring_attention(
@@ -461,8 +470,10 @@ def _remat_fwd(q, k, v, axis_name, causal, axes, use_pallas=False):
 
 
 def _remat_bwd(axis_name, causal, axes, use_pallas, res, dout):
-    # use_pallas shaped the forward only; the backward's second ring pass
-    # needs nothing from it (residuals are layout-identical either way)
+    # residuals are layout-identical from either forward; use_pallas also
+    # selects the fused FA2 block-backward kernel (defined below)
+    if use_pallas:
+        return _remat_bwd_pallas(axis_name, causal, axes, res, dout)
     from tpu_operator.workloads.collectives import _vary
 
     q, k, v, out, lse = res
@@ -518,6 +529,181 @@ def _remat_bwd(axis_name, causal, axes, use_pallas, res, dout):
     dk = jax.lax.ppermute(dk, axis_name, perm)
     dv = jax.lax.ppermute(dv, axis_name, perm)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+
+
+# ---------------------------------------------------------------------------
+# The hop's backward as a fused kernel (FlashAttention-2 block backward):
+# scores are recomputed from the saved logsumexp and dq/dk/dv
+# contributions accumulate entirely in VMEM — the jnp backward
+# materializes four [B,H,Tq,Tk] tensors (scores, prob, dprob, dscores)
+# in HBM per hop, gigabytes each at training shapes.  Grid
+# (batch x head, q-tile): dq tiles are visited once; the dk/dv blocks are
+# revisited across a hop's q-tiles and accumulate in place on top of the
+# travelling ring accumulators (aliased in/out).
+
+
+def _flash_block_bwd_kernel(causal, scale, blk_q,
+                            qoff_ref, koff_ref,
+                            q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                            dq_in, dk_in, dv_in, dq_out, dk_out, dv_out):
+    j = pl.program_id(1)
+    q = q_ref[0]                                  # [blk_q, D] storage dtype
+    k = k_ref[0]                                  # [Tk, D]
+    v = v_ref[0]
+    do = do_ref[0]                                # [blk_q, D]
+    lse = lse_ref[0]                              # [blk_q, 1]
+    dsum = dsum_ref[0]                            # [blk_q, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                     # [blk_q, Tk]
+    q_base = qoff_ref[0] + j * blk_q
+    if causal:
+        q_pos = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    # exact probabilities from the SAVED logsumexp; fully-masked-row guard
+    # mirrors the jnp backward (lse collapses to NEG_INF there too)
+    prob = jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - lse))
+    # bf16 operands into the MXU with f32 accumulation (the FA2 recipe;
+    # the f32-input alternative halves matmul throughput — see the
+    # forward kernel's note)
+    pb = prob.astype(q.dtype)
+    dv_c = jax.lax.dot_general(                   # P^T @ dO  [Tk, D]
+        pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dprob = jax.lax.dot_general(                  # dO @ V^T  [blk_q, Tk]
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = (prob * (dprob - dsum)).astype(q.dtype)  # [blk_q, Tk]
+    dq_c = jax.lax.dot_general(                   # dS @ K    [blk_q, D]
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    dk_c = jax.lax.dot_general(                   # dS^T @ Q  [Tk, D]
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    dq_out[0] = dq_in[0] + dq_c
+
+    @pl.when(j == 0)
+    def _first():
+        # fold onto the travelling ring accumulators once per hop
+        dk_out[0] = dk_in[0] + dk_c
+        dv_out[0] = dv_in[0] + dv_c
+
+    @pl.when(j != 0)
+    def _rest():
+        # revisited blocks: accumulate in place across the hop's q-tiles
+        dk_out[0] = dk_out[0] + dk_c
+        dv_out[0] = dv_out[0] + dv_c
+
+
+def flash_block_backward(q, k, v, do, lse, dsum, dq, dk, dv,
+                         q_off, k_off, causal: bool,
+                         vma: Optional[frozenset] = None):
+    """One hop's dq/dk/dv contributions via the fused backward kernel.
+
+    Merged layout: q/do/dq ``[BH, Tq, D]``, k/v/dk/dv ``[BH, Tk, D]``,
+    lse/dsum ``[BH, Tq]`` (the forward's saved residuals).  dq/dk/dv are
+    accumulators: the returned arrays are input + this hop's
+    contribution (aliased buffers, no extra HBM copies)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    # tighter budget than the forward: the backward keeps ~3 score-sized
+    # f32 temporaries live at once (s, prob, dprob) plus their bf16
+    # casts — a forward-sized q tile blew scoped VMEM by 50% at tk=2048
+    blk_q = _q_tile(tq, tk, budget_bytes=1 << 20)
+    lse3, dsum3 = lse[..., None], dsum[..., None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, tq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, *_: (i, 0, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_flash_block_bwd_kernel, causal, scale, blk_q),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(dq.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(dk.shape, jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct(dv.shape, jnp.float32, vma=vma),
+        ],
+        input_output_aliases={8: 0, 9: 1, 10: 2},
+        interpret=jax.default_backend() != "tpu",
+    )(
+        jnp.asarray([q_off], jnp.int32),
+        jnp.asarray([k_off], jnp.int32),
+        q, k, v, do, lse3, dsum3, dq, dk, dv,
+    )
+
+
+def _remat_bwd_pallas(axis_name, causal, axes, res, dout):
+    """The remat backward with the fused FA2 block kernel per hop: merged
+    layout throughout, dq/dk/dv accumulating in aliased HBM buffers, the
+    same ring rotation/peeling as the jnp backward."""
+    from tpu_operator.workloads.collectives import _vary
+
+    q, k, v, out, lse = res
+    p = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, block, h, d = q.shape
+
+    def merge2(x):  # [B, T, H] -> [B*H, T]
+        return jnp.transpose(x, (0, 2, 1)).reshape(b * h, block)
+
+    qm, km, vm = merge_heads(q), merge_heads(k), merge_heads(v)
+    dom = merge_heads(dout)
+    # D_i = rowsum(dO * O): the softmax-jacobian correction term
+    dsum = jnp.sum(dom.astype(jnp.float32) * merge_heads(out).astype(jnp.float32), -1)
+    lsem = merge2(lse)
+
+    vma = frozenset(axes)
+    dq = _vary(jnp.zeros(qm.shape, jnp.float32), axes)
+    dk = _vary(jnp.zeros(km.shape, jnp.float32), axes)
+    dv = _vary(jnp.zeros(vm.shape, jnp.float32), axes)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def consume(s, dq, dk, dv, km, vm):
+        src = jax.lax.rem(idx - s + p, p)
+        return flash_block_backward(
+            qm, km, vm, dom, lsem, dsum, dq, dk, dv,
+            idx * block, src * block, causal, vma=vma,
+        )
+
+    def hop(s, carry):
+        dq, dk, dv, km, vm = carry
+        dq, dk, dv = consume(s, dq, dk, dv, km, vm)
+        km = jax.lax.ppermute(km, axis_name, perm)
+        vm = jax.lax.ppermute(vm, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return dq, dk, dv, km, vm
+
+    dq, dk, dv, km, vm = jax.lax.fori_loop(0, p - 1, hop, (dq, dk, dv, km, vm))
+    dq, dk, dv = consume(p - 1, dq, dk, dv, km, vm)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+
+    return (
+        split_heads(dq, b, h).astype(q.dtype),
+        split_heads(dk, b, h).astype(k.dtype),
+        split_heads(dv, b, h).astype(v.dtype),
+    )
 
 
 ring_attention_remat.defvjp(_remat_fwd, _remat_bwd)
