@@ -657,12 +657,19 @@ def _layer_fwd(xs, wq, wk, wv, wo, w1, w2, heads: int, axes: tuple,
 
 def transformer_step(
     mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05,
-    use_pallas: bool = False,
+    use_pallas: bool = False, check_vma: bool = True,
 ) -> tuple[jax.Array, dict]:
     """One SGD step of the transformer layer on x [B, S, D] sharded
     P("dp", "mp", None) — batch over dp, sequence over mp.  ``heads`` is
     static (it shapes the trace); partial it in before jit.  Returns
-    (loss, new_params)."""
+    (loss, new_params).
+
+    ``check_vma`` is TEST-ONLY (interpret-mode kernel pinning on CPU,
+    where the pallas interpreter's internal index ops can't satisfy the
+    checker).  NEVER disable it in real training: check_vma=False
+    changes the MLP collectives' gradient transposes — it inflated w1/w2
+    gradients by axis-size factors until r04 caught it by comparing
+    updated weights across the flag."""
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]
 
     @functools.partial(
@@ -677,9 +684,7 @@ def transformer_step(
             P(None, None), P(None, None), P(None, None), P(None, None),
             P(None, "mp"), P("mp", None),
         ),
-        # the pallas path trips the vma checker's dynamic_slice rule (see
-        # ring_attention.ring_attention); jnp keeps the strict checking
-        check_vma=not use_pallas,
+        check_vma=check_vma,
     )
     def step(wq, wk, wv, wo, w1, w2, xs):
         b, s_loc, d = xs.shape
@@ -801,11 +806,14 @@ def transformer_pipeline_params(
 
 
 def transformer_pipeline_step(
-    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05
+    mesh: Mesh, heads: int, params: dict, x: jax.Array, lr: float = 0.05,
+    use_pallas: bool = False, check_vma: bool = True,
 ) -> tuple[jax.Array, dict]:
     """One SGD step of the pp-stage pipelined transformer stack on x
     [M, B, S, D] microbatches sharded P(None, "dp", "mp", None).  Returns
-    (loss, new_params)."""
+    (loss, new_params).  ``use_pallas``: fused flash fwd + FA2 backward
+    kernels inside each stage; ``check_vma``: TEST-ONLY, see
+    transformer_step."""
     pp, dp, mp = mesh.shape["pp"], mesh.shape["dp"], mesh.shape["mp"]
     axes = ("pp", "dp", "mp")
 
@@ -822,6 +830,7 @@ def transformer_pipeline_step(
             P("pp", None, None), P("pp", None, None), P("pp", None, None),
             P("pp", None, None), P("pp", None, "mp"), P("pp", "mp", None),
         ),
+        check_vma=check_vma,
     )
     def step(wq, wk, wv, wo, w1, w2, xs):
         m, b, s_loc, d = xs.shape
@@ -832,7 +841,7 @@ def transformer_pipeline_step(
             """transformer_step's stage body on [b, s_loc, d] (f32 carry
             for the scan; the layer math itself is bf16)."""
             return _layer_fwd(
-                h_in, wq, wk, wv, wo, w1, w2, heads, axes
+                h_in, wq, wk, wv, wo, w1, w2, heads, axes, use_pallas
             ).astype(jnp.float32)
 
         def loss_fn(wq, wk, wv, wo, w1, w2):

@@ -142,6 +142,26 @@ def _q_tile(tq: int, tk: int, budget_bytes: int = 4 << 20) -> int:
     return tq  # no aligned divisor — fall back to one tile
 
 
+def _vary_all(vma, *arrays):
+    """Mark every kernel operand varying over ``vma``'s axes: under a
+    vma-checked shard_map the pallas machinery's internal index ops
+    require matching varying-manual-axes across operands — a mp-varying
+    scalar offset (axis_index) next to an unvarying array trips the
+    checker (the alternative, check_vma=False on the whole step, is NOT
+    an option: it changes collective transposes and inflated MLP grads
+    by axis-size factors before this existed)."""
+    if not vma:
+        return arrays
+    from tpu_operator.workloads.collectives import _vary
+
+    out = []
+    for a in arrays:
+        have = getattr(jax.typeof(a), "vma", frozenset())
+        need = tuple(ax for ax in vma if ax not in have)
+        out.append(_vary(a, need) if need else a)
+    return tuple(out)
+
+
 def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
                        vma: Optional[frozenset] = None):
     """Fold one K/V block into (m, l, o) with the fused kernel.
@@ -189,11 +209,12 @@ def flash_block_update(q, k, v, q_off, k_off, m, l, o, causal: bool,
         ],
         input_output_aliases={5: 0, 6: 1, 7: 2},
         interpret=jax.default_backend() != "tpu",
-    )(
+    )(*_vary_all(
+        vma,
         jnp.asarray([q_off], jnp.int32),
         jnp.asarray([k_off], jnp.int32),
         q, k, v, m3, l3, o,
-    )
+    ))
     return m3[..., 0], l3[..., 0], o
 
 
@@ -645,11 +666,12 @@ def flash_block_backward(q, k, v, do, lse, dsum, dq, dk, dv,
         ],
         input_output_aliases={8: 0, 9: 1, 10: 2},
         interpret=jax.default_backend() != "tpu",
-    )(
+    )(*_vary_all(
+        vma,
         jnp.asarray([q_off], jnp.int32),
         jnp.asarray([k_off], jnp.int32),
         q, k, v, do, lse3, dsum3, dq, dk, dv,
-    )
+    ))
 
 
 def _remat_bwd_pallas(axis_name, causal, axes, res, dout):
