@@ -209,6 +209,25 @@ async def test_disabled_releases_state_and_our_cordon(validation_root):
             await client.close()
 
 
+async def test_disabled_releases_pending_request(validation_root):
+    """A node carrying only a pending validate=requested label (no state,
+    no cordon) is also released on disable — otherwise the stale request
+    silently revives (deleting validator pods) when remediation is
+    re-enabled later."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, enabled=False)
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await _request(client, "tpu-0")
+            await r.reconcile("remediation")
+            node = await _node(client, "tpu-0")
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert consts.VALIDATE_REQUEST_LABEL not in labels
+            assert _state(node) == ""
+        finally:
+            await client.close()
+
+
 async def test_readmission_not_instantly_timed_out(validation_root):
     """A node that failed remediation HOURS ago and is re-requested must get
     a fresh validation window — the advance loop must not read the stale

@@ -47,3 +47,55 @@ def test_files_with_suffix(tmp_path):
     (tmp_path / "skip.txt").write_text("x")
     got = utils.files_with_suffix(str(tmp_path), ".yaml", ".yml")
     assert [g.split("/")[-1] for g in got] == ["a.yaml", "b.yaml", "c.yml"]
+
+
+def test_daemonset_ready_fresh_ds_not_vacuously_ready():
+    """A freshly created operand DS whose status the DS controller has not
+    processed yet (no status / observedGeneration behind) must NOT count as
+    vacuously ready under empty_ok — the ClusterPolicy would transiently
+    flash READY before any operand pod is scheduled."""
+    from tpu_operator.state.skel import daemonset_ready
+
+    fresh = {"metadata": {"generation": 1}}  # no status at all
+    assert not daemonset_ready(fresh, empty_ok=True)
+    assert not daemonset_ready(fresh, empty_ok=False)
+
+    processed_empty = {
+        "metadata": {"generation": 1},
+        "status": {"observedGeneration": 1, "desiredNumberScheduled": 0},
+    }
+    assert daemonset_ready(processed_empty, empty_ok=True)   # gate matches no nodes
+    assert not daemonset_ready(processed_empty, empty_ok=False)  # stale pool DS
+
+    rolling = {
+        "metadata": {"generation": 2},
+        "status": {
+            "observedGeneration": 2,
+            "desiredNumberScheduled": 2,
+            "numberAvailable": 2,
+            "updatedNumberScheduled": 1,
+        },
+    }
+    assert not daemonset_ready(rolling)
+    rolling["status"]["updatedNumberScheduled"] = 2
+    assert daemonset_ready(rolling)
+
+
+def test_daemonset_ready_stale_status_after_spec_update():
+    """A spec update bumps metadata.generation; until the DS controller
+    observes the new revision, the preserved pre-update counts must not
+    report the rollout complete."""
+    from tpu_operator.state.skel import daemonset_ready
+
+    stale = {
+        "metadata": {"generation": 2},
+        "status": {
+            "observedGeneration": 1,
+            "desiredNumberScheduled": 2,
+            "numberAvailable": 2,
+            "updatedNumberScheduled": 2,
+        },
+    }
+    assert not daemonset_ready(stale)
+    stale["status"]["observedGeneration"] = 2
+    assert daemonset_ready(stale)

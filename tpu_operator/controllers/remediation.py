@@ -89,10 +89,15 @@ class RemediationReconciler:
         ]
         if not spec.enabled:
             # disabled -> clear our state and release any cordon WE hold;
-            # in-flight requests are abandoned (upgrade _clear_labels
-            # analogue, upgrade_controller.go:199-227)
+            # in-flight AND pending requests are abandoned (a bare
+            # validate=requested label left behind would silently revive —
+            # deleting validator pods — whenever remediation is re-enabled)
             for node in nodes:
-                if self._state_of(node) or self._we_cordoned(node):
+                if (
+                    self._state_of(node)
+                    or self._we_cordoned(node)
+                    or self._requested(node)
+                ):
                     await self._release(node)
             await self._report([])
             return consts.REMEDIATION_REQUEUE_SECONDS

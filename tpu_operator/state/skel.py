@@ -70,8 +70,20 @@ def daemonset_ready(ds: dict, empty_ok: bool = False) -> bool:
       object_controls.go:3363-3366): operands are gated by per-node
       workload-config deploy labels, and a gate matching no nodes is a
       normal configuration (e.g. sandboxWorkloads enabled before any
-      vm-passthrough node joins) — vacuously ready."""
+      vm-passthrough node joins) — vacuously ready.  Unlike the
+      reference (whose state_skel.go comment warns about the quirk), a
+      zero-desired DS only counts as vacuously ready once the DS
+      controller has actually processed it (status.observedGeneration
+      caught up) — a freshly created DS with an unpopulated status must
+      not flash the ClusterPolicy READY before pods are scheduled.  The
+      same staleness gate covers desired > 0: a just-updated DS (spec PUT
+      bumped metadata.generation) keeps its pre-update status counts until
+      the DS controller observes the new revision — matching those stale
+      counts must not report the rollout complete."""
     status = ds.get("status") or {}
+    generation = deep_get(ds, "metadata", "generation", default=1) or 1
+    if status.get("observedGeneration", 0) < generation:
+        return False
     desired = status.get("desiredNumberScheduled", 0)
     if desired == 0:
         return empty_ok

@@ -453,7 +453,11 @@ class Validator:
                 # busbw for the slice are measured by that coordinated run;
                 # chip-local matmul/HBM probes have no valid node-local
                 # execution here, so record the skip honestly instead of
-                # chronically failing perf-ready on healthy slices.
+                # chronically failing perf-ready on healthy slices.  Clear
+                # the node-local drop-box too: a node that ran standalone
+                # perf probes and later joined a slice must not keep
+                # exporting stale matmul/hbm figures to the alerts.
+                status.clear_workload_results(scope="perf")
                 status.write_ready("perf", {
                     "ok": True,
                     "skipped": "multi-host slice member: node-local PJRT "

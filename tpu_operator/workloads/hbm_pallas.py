@@ -144,7 +144,11 @@ def dma_stream_benchmark(
 
     y = jfn(x)  # compile + warm
     y.block_until_ready()
-    if float(y[rows - 1, _COLS - 1]) != 1.0:
+    # full-buffer self-check: min==max==1.0 reads every element, so a
+    # kernel regression that skips an interior chunk (leaving it
+    # uninitialized) fails here — a trailing-element probe would not
+    lo, hi = jax.jit(lambda a: (jnp.min(a), jnp.max(a)))(y)
+    if float(lo) != 1.0 or float(hi) != 1.0:
         return {"ok": False, "error": "DMA pipeline copy produced wrong data",
                 "backend": jax.default_backend()}
     float(null(x))
