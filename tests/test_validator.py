@@ -673,6 +673,111 @@ async def test_multihost_four_host_slice_validation(validation_root):
     await _run_multihost_validation(4, "4x4", "pool-c")
 
 
+async def test_multihost_member_death_fails_bounded_then_revalidates(
+    validation_root,
+):
+    """Fault injection through the WHOLE validator path: worker 1's
+    distributed pod is SIGKILLed mid-rendezvous (psum phase) in the first
+    epoch.  Every host's validation must fail in bounded time — the
+    surviving pod exits via its watchdog instead of wedging for the 300 s
+    budget — with NO jax-ready written anywhere and the watchdog's
+    structured evidence (dead member, phase) in the drop-box.  Clearing
+    the fault, the validators re-run (the in-cluster restart semantics)
+    and the SAME epoch re-proves cleanly: Failed pods are swept,
+    jax-ready lands, the Service carries the epoch tombstone."""
+    import contextlib
+    import time as _time
+
+    port = _free_port()
+    executed: list = []
+    inner = _exec_distributed_pod(port, executed)
+    fault = {"armed": True}
+
+    def execute(pod: dict) -> str:
+        if fault["armed"]:
+            pod["spec"]["containers"][0]["env"] += [
+                {"name": "FAULT_INJECT", "value": "psum:1"},
+                {"name": "WATCHDOG_TIMEOUT_S", "value": "4"},
+            ]
+        return inner(pod)
+
+    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=execute)
+    async with FakeCluster(sim) as fc:
+        for i in range(2):
+            node = fc.add_node(
+                f"tpu-{i}",
+                topology="2x4",
+                labels={
+                    consts.GKE_NODEPOOL_LABEL: "pool-f",
+                    consts.GKE_TPU_WORKER_ID_LABEL: str(i),
+                },
+            )
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+        async with contextlib.AsyncExitStack() as stack:
+            clients = [
+                await stack.enter_async_context(
+                    ApiClient(Config(base_url=fc.base_url))
+                )
+                for _ in range(2)
+            ]
+            validators = [
+                Validator(
+                    fast_config(node_name=f"tpu-{i}", with_workload=True,
+                                sleep_interval=0.1, workload_retries=1800),
+                    client=clients[i],
+                )
+                for i in range(2)
+            ]
+            status.write_ready("plugin")
+
+            # epoch 1: member dies mid-rendezvous -> BOTH hosts fail, fast
+            t0 = _time.monotonic()
+            outcomes = await asyncio.gather(
+                *(v.run("jax") for v in validators), return_exceptions=True
+            )
+            elapsed = _time.monotonic() - t0
+            assert all(isinstance(o, ValidationError) for o in outcomes), outcomes
+            # bounded: watchdog (4 s) + pod poll, nowhere near the 300 s
+            # budget the pre-watchdog code would have burned
+            assert elapsed < 120, f"failure detection took {elapsed:.0f}s"
+            assert not status.is_ready("jax")
+            # the surviving worker's watchdog evidence reached the drop-box
+            evidence = status.read_workload_results()["distributed"]
+            assert evidence["ok"] is False
+            assert evidence["fault"]["type"] == "peer-heartbeat-lost"
+            assert [
+                d["process_id"] for d in evidence["fault"]["dead_members"]
+            ] == [1]
+
+            # epoch re-proof: fault cleared, validators restart (the DS
+            # restart semantics — a validator that raced a stale Failed pod
+            # re-runs until worker 0's converge loop has swept it)
+            fault["armed"] = False
+
+            async def run_with_restarts(v):
+                for _ in range(10):
+                    try:
+                        return await v.run("jax")
+                    except ValidationError:
+                        await asyncio.sleep(0.3)
+                raise AssertionError("validator never recovered")
+
+            await asyncio.gather(*(run_with_restarts(v) for v in validators))
+            payload = status.read_status("jax")
+            assert payload["mode"] == "multi-host"
+            assert payload["workers"] == 2
+            svc = await clients[0].get(
+                "", "Service", "tpu-jax-validation-pool-f", NS
+            )
+            assert (
+                deep_get(svc, "metadata", "annotations", default={}).get(
+                    components.VALIDATED_EPOCH_ANNOTATION
+                )
+                == payload["epoch"]
+            )
+
+
 async def test_multislice_cross_slice_validation(validation_root):
     """Two 2-host slices (distinct node pools) declared one multislice
     group: every host proves its own slice's ICI rendezvous AND the

@@ -911,3 +911,96 @@ def test_transformer_pipeline_pallas_matches():
             p_pal[key].astype(jnp.float32) - p_jnp[key].astype(jnp.float32)
         )))
         assert err < 2e-2, (key, err)
+
+
+def test_rendezvous_worker_death_detected_bounded(validation_root):
+    """Fault injection: SIGKILL worker 1 exactly at the psum phase boundary
+    (after jax.distributed.initialize, before the first collective
+    completes) — the failure shape a dying host produces during slice
+    validation.  The surviving members must fail BY THEMSELVES in bounded
+    time (the watchdog timeout, far under the 300 s pod budget) with
+    structured evidence naming the dead member and the phase, and the
+    drop-box must carry that evidence for the exporter — never a
+    jax-ready."""
+    from tpu_operator.validator import status
+    from tpu_operator.workloads import distributed, watchdog
+
+    outcomes = distributed.spawn_local_workers_outcomes(
+        3, 2, steps=2, timeout=120,
+        extra_env={
+            "FAULT_INJECT": "psum:1",
+            "WATCHDOG_TIMEOUT_S": "5",
+            "ALLREDUCE_SIZE_MB": "1",
+        },
+    )
+    pm = distributed.rendezvous_post_mortem(outcomes)
+    assert not pm["ok"]
+    # the killed member is named in the evidence (0 may ALSO appear: the
+    # coordinator-survivor's watchdog exit can cascade a coordinator-loss
+    # abort in the last survivor before its own peer timeout fires)
+    assert 1 in pm["dead_members"]
+    # bounded: every survivor exited on its own, well inside the budget
+    assert pm["survivors_failed_bounded"]
+    assert pm["max_survivor_elapsed_s"] < 90
+    by_id = {w["process_id"]: w for w in pm["workers"]}
+    assert by_id[1]["outcome"] == "killed"
+    # worker 0 IS the coordinator: nothing kills it early, so its own
+    # watchdog detection of dead member 1 at the psum phase is deterministic
+    assert by_id[0]["outcome"] == "watchdog-peer-death"
+    assert by_id[0]["returncode"] == watchdog.WATCHDOG_EXIT_CODE
+    assert by_id[0]["dead_members"] == [1]
+    assert by_id[0]["phase"] == "psum"
+    # worker 2 detects dead member 1 itself OR inherits the cascade when
+    # worker 0's watchdog exit takes the coordination service with it
+    assert by_id[2]["outcome"] in (
+        "watchdog-peer-death",
+        "watchdog-coordinator-loss",
+        "aborted-coordinator-loss",
+    )
+    assert by_id[2]["returncode"] != 0
+    if by_id[2]["outcome"] == "watchdog-peer-death":
+        assert by_id[2]["dead_members"] == [1]
+    # the node-local drop-box carries a structured failure record (the
+    # in-cluster evidence path: exporter -> alerts), not a healthy result
+    results = status.read_workload_results()
+    assert results is not None
+    evidence = results["distributed"]
+    assert evidence["ok"] is False
+    assert evidence["fault"]["type"] in (
+        "peer-heartbeat-lost", "coordinator-unreachable"
+    )
+    # and no worker ever wrote a ready/ok distributed record
+    assert not status.is_ready("jax")
+
+
+def test_rendezvous_coordinator_death_detected_bounded(validation_root):
+    """Fault injection: SIGKILL the COORDINATOR (worker 0).  Survivors are
+    aborted by the runtime's own error poll within seconds of the socket
+    closing (before Python can run — watchdog.py module doc); the
+    post-mortem classifies the stderr signature and pins dead member 0.
+    Detection is bounded either way: nobody waits out the pod budget."""
+    from tpu_operator.validator import status
+    from tpu_operator.workloads import distributed
+
+    outcomes = distributed.spawn_local_workers_outcomes(
+        3, 2, steps=2, timeout=120,
+        extra_env={
+            "FAULT_INJECT": "allreduce:0",
+            "WATCHDOG_TIMEOUT_S": "5",
+            "ALLREDUCE_SIZE_MB": "1",
+        },
+    )
+    pm = distributed.rendezvous_post_mortem(outcomes)
+    assert not pm["ok"]
+    assert 0 in pm["dead_members"]
+    assert pm["survivors_failed_bounded"]
+    assert pm["max_survivor_elapsed_s"] < 90
+    for w in pm["workers"]:
+        if w["process_id"] == 0:
+            assert w["outcome"] == "killed"
+            continue
+        assert w["outcome"] in (
+            "aborted-coordinator-loss", "watchdog-coordinator-loss"
+        )
+        assert w["returncode"] != 0
+    assert not status.is_ready("jax")

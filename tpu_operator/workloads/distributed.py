@@ -21,6 +21,10 @@ Env contract (injected by the validator's pod spec):
   NUM_PROCESSES        slice host count
   PROCESS_ID           this host's worker id (falls back to TPU_WORKER_ID)
   BURN_IN_STEPS        optional, default 3
+  WATCHDOG_TIMEOUT_S   peer-death detection bound (default 20; watchdog.py)
+  DIST_INIT_TIMEOUT_S  rendezvous-phase bound (default 120)
+  FAULT_INJECT         test-only: "<phase>:<process_id>" SIGKILLs that
+                       worker at that phase entry (fault-injection tests)
 """
 
 from __future__ import annotations
@@ -28,11 +32,36 @@ from __future__ import annotations
 import functools
 import json
 import os
+import signal
 import sys
 import time
 from typing import Optional
 
 import numpy as np
+
+# the failing worker's phase, readable from main()'s exception handler
+_LAST_PHASE: Optional[str] = None
+
+
+def _enter_phase(wd, name: str, process_id: int) -> None:
+    """Phase transition: record for post-mortem evidence (watchdog KV +
+    drop-box + a stdout line the orchestrator can stream), then the
+    fault-injection hook — a killed worker must die exactly AT the phase
+    boundary the test names, after the transition is already published."""
+    global _LAST_PHASE
+    _LAST_PHASE = name
+    if wd is not None:
+        wd.set_phase(name)
+    print(json.dumps({"phase": name, "process_id": process_id}), flush=True)
+    spec = os.environ.get("FAULT_INJECT", "")
+    if spec:
+        phase, _, wid = spec.partition(":")
+        if phase == name and wid.strip().isdigit() and int(wid) == process_id:
+            print(
+                json.dumps({"fault_injected": name, "process_id": process_id}),
+                flush=True,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 def run_worker(
@@ -56,13 +85,70 @@ def run_worker(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            # a member dying DURING the rendezvous strands the others inside
+            # initialize(); this bounds that phase (default 300 is the whole
+            # pod budget — a hung rendezvous must fail well inside it)
+            initialization_timeout=int(
+                float(os.environ.get("DIST_INIT_TIMEOUT_S", "120") or 120)
+            ),
+            # backstop only: the coordination service's own heartbeat abort.
+            # The PeerWatchdog below detects peer death far sooner (and with
+            # structured evidence); this bounds the corner where the
+            # watchdog itself is wedged
+            heartbeat_timeout_seconds=int(
+                float(os.environ.get("DIST_HEARTBEAT_TIMEOUT_S", "60") or 60)
+            ),
         )
+    devices = jax.devices()  # GLOBAL across all processes
+    local = jax.local_device_count()
+
+    # bounded peer-death detection from here on (watchdog.py: a dead peer
+    # or coordinator fails THIS worker in ~WATCHDOG_TIMEOUT_S with
+    # structured evidence, instead of wedging in a collective for the
+    # whole pod budget)
+    wd = None
+    if num_processes > 1:
+        from jax._src import distributed as jax_distributed
+
+        from tpu_operator.workloads.watchdog import DEFAULT_TIMEOUT_S, PeerWatchdog
+
+        wd = PeerWatchdog(
+            jax_distributed.global_state.client,
+            process_id,
+            num_processes,
+            timeout=float(
+                os.environ.get("WATCHDOG_TIMEOUT_S", str(DEFAULT_TIMEOUT_S))
+                or DEFAULT_TIMEOUT_S
+            ),
+            scope=os.environ.get("RESULTS_SCOPE", ""),
+        )
+        wd.start()
+    try:
+        return _run_checks(
+            wd, process_id, num_processes, devices, local, steps,
+            d_model, d_hidden,
+        )
+    finally:
+        if wd is not None:
+            wd.stop()
+
+
+def _run_checks(
+    wd,
+    process_id: int,
+    num_processes: int,
+    devices,
+    local: int,
+    steps: int,
+    d_model: int,
+    d_hidden: int,
+) -> dict:
+    import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     t0 = time.perf_counter()
-    devices = jax.devices()  # GLOBAL across all processes
-    local = jax.local_device_count()
+    _enter_phase(wd, "device-check", process_id)
 
     # -- device-count truth: the validator promised chips-per-host via
     # EXPECTED_DEVICES; the runtime must have initialized exactly that many
@@ -97,6 +183,7 @@ def run_worker(
 
     # -- global psum proof: every process contributes (id+1) per chip; the
     # expected total is only reachable if every link carried its share
+    _enter_phase(wd, "psum", process_id)
     mesh1d = Mesh(np.array(devices), ("x",))
     contrib = jax.make_array_from_process_local_data(
         NamedSharding(mesh1d, P("x")),
@@ -118,6 +205,7 @@ def run_worker(
     # validator from the accelerator catalogue; the gate applies only on
     # backends named in ALLREDUCE_GATE_BACKENDS (default tpu — CPU/gloo
     # rates say nothing about ICI health)
+    _enter_phase(wd, "allreduce", process_id)
     bench = collectives.allreduce_benchmark(
         size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "16")),
         iters=5,
@@ -136,6 +224,7 @@ def run_worker(
     # must carry its payload exactly, and the reported rate is bottlenecked
     # by the slowest link (the allreduce can't localize a bad link).
     # Report-only unless RING_MIN_GBPS arms the gate.
+    _enter_phase(wd, "ring", process_id)
     ring = collectives.ring_benchmark(
         size_mb=float(os.environ.get("RING_SIZE_MB", "8")),
         iters=2,
@@ -151,7 +240,7 @@ def run_worker(
 
     # -- burn-in over the global (dp, mp) mesh: real SGD steps with MXU
     # matmuls + cross-host collectives (mp psum, dp grad pmean)
-
+    _enter_phase(wd, "burn-in", process_id)
     mesh = collectives.make_mesh(devices=devices)
     dp, mp = mesh.shape["dp"], mesh.shape["mp"]
 
@@ -203,6 +292,7 @@ def run_worker(
     # stay modest (the reference gathers the full sequence).
     from tpu_operator.workloads import ring_attention
 
+    _enter_phase(wd, "ring-attention", process_id)
     ra = ring_attention.acceptance(
         # small by default: every slice host compiles this program inside
         # its validation pod — the hop/mask/rendezvous proof needs blocks
@@ -219,12 +309,14 @@ def run_worker(
     # Exact against the dense reference (tie-proof quantized routing).
     from tpu_operator.workloads import moe
 
+    _enter_phase(wd, "moe", process_id)
     ep = moe.acceptance(
         tokens_per_shard=int(os.environ.get("MOE_TOKENS_PER_SHARD", "16")),
         d_model=16, d_hidden=32, devices=devices,
     )
     ep_ok = bool(ep["ok"])
 
+    _enter_phase(wd, "done", process_id)
     return {
         "ok": (psum_ok and finite and decreasing and bw_ok and ring_ok
                and ra_ok and ep_ok),
@@ -264,7 +356,7 @@ def run_worker(
     }
 
 
-def spawn_local_workers(
+def spawn_local_workers_outcomes(
     num_processes: int,
     devices_per_proc: int,
     steps: int = 2,
@@ -277,8 +369,10 @@ def spawn_local_workers(
     is what the validator's pod spec injects in-cluster; keeping it in one
     place keeps the dryrun and the tests from diverging).
 
-    Returns each worker's parsed result JSON; raises AssertionError when a
-    worker exits non-zero."""
+    Returns one outcome dict per worker — returncode, elapsed wall time,
+    the last JSON line it printed (the result or the watchdog's evidence),
+    and output tails — WITHOUT asserting success: the fault-injection
+    tests need the failing shapes intact."""
     import socket
     import subprocess
 
@@ -305,14 +399,61 @@ def spawn_local_workers(
                 text=True,
             )
         )
-    results = []
+    import threading
+
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    # drain every worker CONCURRENTLY and stamp each one's own exit time:
+    # sequential drains would credit a fast detection with the slowest
+    # sibling's wall time (wrong detection-latency evidence), and polling
+    # without draining would deadlock a worker that filled its pipe buffer
+    drained: dict[int, tuple] = {}
+
+    def _drain(wid: int, proc) -> None:
+        out, err = proc.communicate()
+        drained[wid] = (out, err, round(time.monotonic() - t0, 3))
+
+    threads = [
+        threading.Thread(target=_drain, args=(wid, p), daemon=True)
+        for wid, p in enumerate(procs)
+    ]
+    for th in threads:
+        th.start()
+    outcomes = []
     try:
-        for wid, proc in enumerate(procs):
-            out, err = proc.communicate(timeout=timeout)
-            assert proc.returncode == 0, (
-                f"distributed worker {wid} failed:\n{out[-2000:]}\n{err[-2000:]}"
+        for th in threads:
+            th.join(timeout=max(0.1, deadline - time.monotonic()))
+        for wid, (th, proc) in enumerate(zip(threads, procs)):
+            timed_out = th.is_alive()
+            if timed_out:
+                proc.kill()
+                th.join(timeout=10)
+            out, err, elapsed = drained.get(
+                wid, ("", "", round(time.monotonic() - t0, 3))
             )
-            results.append(json.loads(out.splitlines()[-1]))
+            result = None
+            for line in reversed((out or "").splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        result = json.loads(line)
+                        break
+                    except json.JSONDecodeError:
+                        continue
+            outcomes.append({
+                "process_id": wid,
+                "returncode": proc.returncode,
+                "elapsed_s": elapsed,
+                "timed_out": timed_out,
+                "result": result,
+                # signature scan over the FULL stderr — a LOG(FATAL) stack
+                # dump can push it past any display tail
+                "coordinator_loss": any(
+                    sig in (err or "") for sig in _COORDINATOR_LOSS_SIGNATURES
+                ),
+                "stdout_tail": (out or "")[-2000:],
+                "stderr_tail": (err or "")[-2000:],
+            })
     finally:
         # one worker failing must not strand the rest blocked on the dead
         # coordinator with unread pipes
@@ -320,7 +461,104 @@ def spawn_local_workers(
             if proc.poll() is None:
                 proc.kill()
                 proc.communicate()
+    return outcomes
+
+
+def spawn_local_workers(
+    num_processes: int,
+    devices_per_proc: int,
+    steps: int = 2,
+    extra_env: Optional[dict] = None,
+    timeout: float = 300,
+) -> list[dict]:
+    """``spawn_local_workers_outcomes`` for the healthy path: returns each
+    worker's parsed result JSON; raises AssertionError when a worker exits
+    non-zero."""
+    outcomes = spawn_local_workers_outcomes(
+        num_processes, devices_per_proc, steps=steps,
+        extra_env=extra_env, timeout=timeout,
+    )
+    results = []
+    for o in outcomes:
+        assert o["returncode"] == 0, (
+            f"distributed worker {o['process_id']} failed:\n"
+            f"{o['stdout_tail']}\n{o['stderr_tail']}"
+        )
+        results.append(o["result"])
     return results
+
+
+# the runtime's own abort message when the coordination service leader
+# (worker 0) disappears: the agent's error-poll RPC fails on socket close
+# and LOG(FATAL)s the survivor before any Python handler can run, so this
+# stderr signature IS the evidence for that shape
+_COORDINATOR_LOSS_SIGNATURES = (
+    "Failed to send RPC to coordination service",
+    "leader task was preempted/died",
+)
+
+
+def rendezvous_post_mortem(outcomes: list[dict]) -> dict:
+    """Classify a fault-injected (or failed) rendezvous run into structured
+    evidence: which members died, how each survivor detected the failure
+    (own watchdog vs runtime abort on coordinator loss), at which phase,
+    and whether every survivor failed in bounded time (nobody burned the
+    full pod budget waiting on a dead peer)."""
+    workers = []
+    directly_dead: set[int] = set()
+    named_dead: set[int] = set()
+    for o in outcomes:
+        rc = o["returncode"]
+        result = o.get("result") or {}
+        fault = (result.get("fault") or {}) if isinstance(result, dict) else {}
+        dead_members = [d.get("process_id") for d in fault.get("dead_members", [])]
+        if rc == 0:
+            kind = "succeeded"
+        elif fault.get("type") == "peer-heartbeat-lost":
+            kind = "watchdog-peer-death"
+            named_dead.update(m for m in dead_members if m is not None)
+        elif fault.get("type") == "coordinator-unreachable":
+            kind = "watchdog-coordinator-loss"
+            named_dead.add(0)
+        elif o.get("coordinator_loss") or any(
+            sig in (o.get("stderr_tail") or "")
+            for sig in _COORDINATOR_LOSS_SIGNATURES
+        ):
+            # the runtime's LOG(FATAL) abort on coordinator loss — checked
+            # BEFORE the signal branch: the abort itself is a signal death
+            # (SIGABRT), but this worker was a victim, not the fault
+            kind = "aborted-coordinator-loss"
+            named_dead.add(0)
+        elif rc is not None and rc < 0 and not o.get("timed_out"):
+            kind = "killed"  # the injected fault itself (SIGKILL)
+            directly_dead.add(o["process_id"])
+        else:
+            kind = "failed"
+        workers.append({
+            "process_id": o["process_id"],
+            "outcome": kind,
+            "returncode": rc,
+            "elapsed_s": o.get("elapsed_s"),
+            "timed_out": bool(o.get("timed_out")),
+            "phase": result.get("phase") if isinstance(result, dict) else None,
+            "dead_members": dead_members or None,
+        })
+    survivors = [w for w in workers if w["outcome"] != "killed"]
+    dead = sorted(directly_dead | named_dead)
+    return {
+        "ok": all(w["outcome"] == "succeeded" for w in workers),
+        "workers": workers,
+        "dead_members": dead,
+        # bounded = every survivor exited by itself (nonzero, not our
+        # harness kill at the deadline) — the detection worked
+        "survivors_failed_bounded": (
+            all(not w["timed_out"] and w["returncode"] != 0 for w in survivors)
+            if dead else None
+        ),
+        "max_survivor_elapsed_s": max(
+            (w["elapsed_s"] for w in survivors), default=0.0
+        ),
+    }
 
 
 def main() -> int:
@@ -339,7 +577,21 @@ def main() -> int:
     try:
         result = run_worker(coordinator, num_processes, process_id, steps=steps)
     except Exception as e:  # noqa: BLE001 — the exit code IS the validation verdict
-        print(json.dumps({"ok": False, "process_id": process_id, "error": str(e)}), flush=True)
+        evidence = {
+            "ok": False,
+            "process_id": process_id,
+            # the phase names WHERE the failure hit (e.g. a collective
+            # erroring because its peer died) — the post-mortem evidence
+            "phase": _LAST_PHASE,
+            "error": str(e),
+        }
+        print(json.dumps(evidence), flush=True)
+        from tpu_operator.validator import status as vstatus
+
+        vstatus.write_workload_results(
+            {"distributed": evidence},
+            scope=os.environ.get("RESULTS_SCOPE", ""),
+        )
         return 1
     print(json.dumps(result), flush=True)
     # node-local drop-box for the validator → node-status exporter → alerts;

@@ -1,0 +1,256 @@
+"""Bounded-time failure detection for the multi-host rendezvous.
+
+The distributed runtime's own failure handling is either too slow or too
+blunt for slice validation (SURVEY §5.3 — the reference's recovery story
+is node-local cordon/drain, upgrade_controller.go:146-196; a coordinated
+SET of workers that must fail together is the TPU-specific problem):
+
+- a NON-coordinator worker dying is only noticed after the coordination
+  service's heartbeat timeout (100 s by default), and the notification is
+  a C++ LOG(FATAL) that kills the survivors with no structured evidence;
+- survivors wedged inside a collective whose peer died block at the XLA
+  level — the collective itself has no timeout.
+
+This watchdog bounds both from Python.  Every worker publishes a
+monotonically increasing heartbeat into the coordination service's
+key-value store (KV ops only need the COORDINATOR alive, not the peer)
+and a daemon thread checks the peers' beats.  A peer whose beat stalls
+past ``timeout`` is declared dead: the watchdog writes structured
+evidence — which member died, which phase it and we were in, detection
+latency — to the node-local drop-box, prints it as the final stdout line,
+and hard-exits (``os._exit`` fires even while the main thread is wedged
+inside a collective).  Detection latency is bounded by
+``timeout + interval``, independent of the validator's 300 s pod budget.
+
+The COORDINATOR dying is detected even faster, but not by us: every
+surviving agent's error-polling RPC fails on socket close and the runtime
+aborts the process within ~2 s (client.h LOG(FATAL)) — Python never runs
+again.  For that case the watchdog maintains an IN-FLIGHT phase record in
+the drop-box at every phase transition; the record survives the abort, so
+post-mortem evidence of where each worker was exists even when no Python
+handler could.  ``rendezvous_post_mortem`` (workloads/distributed.py)
+classifies both shapes from the worker outcomes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+_KV_PREFIX = "tpuop/watchdog"
+
+# distinct exit code so orchestrators can tell "this worker's watchdog
+# detected a dead PEER" from "this worker itself failed its checks" (1)
+WATCHDOG_EXIT_CODE = 3
+
+DEFAULT_TIMEOUT_S = 20.0
+
+
+class PeerWatchdog:
+    """Heartbeat-based peer liveness for one rendezvous.
+
+    ``client`` is the process's coordination-service client
+    (``jax._src.distributed.global_state.client``) — created by
+    ``jax.distributed.initialize``, so the watchdog can only start
+    post-rendezvous (pre-rendezvous hangs are bounded separately by
+    ``initialization_timeout``).
+    """
+
+    def __init__(
+        self,
+        client,
+        process_id: int,
+        num_processes: int,
+        *,
+        timeout: float = DEFAULT_TIMEOUT_S,
+        interval: Optional[float] = None,
+        scope: str = "",
+        exit_fn=os._exit,
+    ):
+        self.client = client
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.timeout = timeout
+        self.interval = interval if interval else max(0.25, min(2.0, timeout / 8))
+        self.scope = scope
+        self.exit_fn = exit_fn
+        self.phase = "post-init"
+        self._beat = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = 0.0
+        # peer -> (last value, monotonic time the value last advanced)
+        self._last_seen: dict[int, tuple[str, float]] = {}
+        # monotonic time KV ops started failing (None while healthy) — one
+        # transient RPC hiccup must not be declared a dead coordinator
+        self._kv_failing_since: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._started = time.monotonic()
+        self._publish_beat()
+        self._thread = threading.Thread(
+            target=self._run, name="peer-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+
+    def set_phase(self, name: str) -> None:
+        """Record the phase the main thread is entering.  The KV publish
+        lets PEERS name our phase in their evidence; the drop-box write is
+        the record that survives our own death (SIGKILL / runtime abort)."""
+        self.phase = name
+        self._write_inflight()
+        try:
+            self.client.key_value_set(
+                f"{_KV_PREFIX}/phase/{self.process_id}", name, True
+            )
+        except Exception:  # noqa: BLE001 — phase is evidence, not control flow
+            pass
+
+    # ------------------------------------------------------------------
+    def _publish_beat(self) -> None:
+        self._beat += 1
+        self.client.key_value_set(
+            f"{_KV_PREFIX}/hb/{self.process_id}", str(self._beat), True
+        )
+
+    def _peer_phase(self, peer: int) -> Optional[str]:
+        try:
+            return self.client.key_value_try_get(f"{_KV_PREFIX}/phase/{peer}")
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _write_inflight(self) -> None:
+        from tpu_operator.validator import status as vstatus
+
+        # read-modify-write: the drop-box write is a wholesale file replace
+        # (status.py), and the exporter may scrape mid-run — the previous
+        # run's 'distributed' figures must survive alongside the in-flight
+        # phase record, not vanish at the first phase transition
+        existing = vstatus.read_workload_results(scope=self.scope) or {}
+        existing.pop("ts", None)
+        existing["distributed_inflight"] = {
+            "process_id": self.process_id,
+            "num_processes": self.num_processes,
+            "phase": self.phase,
+            "elapsed_s": round(time.monotonic() - self._started, 3)
+            if self._started
+            else 0.0,
+        }
+        vstatus.write_workload_results(existing, scope=self.scope)
+
+    # ------------------------------------------------------------------
+    def _kv_failed(self, now: float, err: Exception) -> bool:
+        """Record a failed KV cycle; True once failures have persisted past
+        ``timeout`` (KV ops are served by the coordinator, so persistent
+        failure means the coordinator is gone — but ONE transient RPC
+        hiccup under load must not fail a healthy worker.  The runtime's
+        own error poll usually aborts us first on real coordinator death;
+        this path covers the race where our poll loses the socket before
+        it does)."""
+        if self._kv_failing_since is None:
+            self._kv_failing_since = now
+        return now - self._kv_failing_since > self.timeout
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            try:
+                self._publish_beat()
+            except Exception as e:  # noqa: BLE001
+                if self._kv_failed(now, e):
+                    self._fail_coordinator(e)
+                    return
+                continue
+            kv_healthy = True
+            dead: list[dict] = []
+            for peer in range(self.num_processes):
+                if peer == self.process_id:
+                    continue
+                value = None
+                try:
+                    value = self.client.key_value_try_get(
+                        f"{_KV_PREFIX}/hb/{peer}"
+                    )
+                except Exception as e:  # noqa: BLE001
+                    if "NOT_FOUND" not in str(e):
+                        kv_healthy = False
+                        if self._kv_failed(now, e):
+                            self._fail_coordinator(e)
+                            return
+                        continue
+                    # not published yet: stale-since = watchdog start
+                prev = self._last_seen.get(peer)
+                if value is not None and (prev is None or prev[0] != value):
+                    self._last_seen[peer] = (value, now)
+                    continue
+                stale_since = prev[1] if prev else self._started
+                stale_for = now - stale_since
+                if stale_for > self.timeout:
+                    dead.append(
+                        {
+                            "process_id": peer,
+                            "stale_for_s": round(stale_for, 3),
+                            "phase": self._peer_phase(peer),
+                        }
+                    )
+            if kv_healthy:
+                self._kv_failing_since = None
+            if dead:
+                self._fail_peers(dead)
+                return
+
+    # ------------------------------------------------------------------
+    def _fail_peers(self, dead: list[dict]) -> None:
+        self._die(
+            {
+                "type": "peer-heartbeat-lost",
+                "dead_members": dead,
+                "timeout_s": self.timeout,
+            }
+        )
+
+    def _fail_coordinator(self, err: Exception) -> None:
+        self._die(
+            {
+                "type": "coordinator-unreachable",
+                "dead_members": [{"process_id": 0, "phase": None}],
+                "error": str(err)[:500],
+            }
+        )
+
+    def _die(self, fault: dict) -> None:
+        # a thread that outlived stop()'s bounded join (wedged in an RPC
+        # that later failed) must never fail a worker whose validation
+        # already completed — the success result is written by then and
+        # os._exit(3) would flip a passed epoch to failed
+        if self._stop.is_set():
+            return
+        evidence = {
+            "ok": False,
+            "process_id": self.process_id,
+            "num_processes": self.num_processes,
+            "phase": self.phase,
+            "detected_after_s": round(time.monotonic() - self._started, 3),
+            "fault": fault,
+            "error": (
+                f"watchdog: {fault['type']} "
+                f"(members {[d['process_id'] for d in fault['dead_members']]}) "
+                f"during phase {self.phase!r}"
+            ),
+        }
+        from tpu_operator.validator import status as vstatus
+
+        vstatus.write_workload_results({"distributed": evidence}, scope=self.scope)
+        print(json.dumps(evidence), flush=True)
+        sys.stdout.flush()
+        self.exit_fn(WATCHDOG_EXIT_CODE)
