@@ -556,12 +556,19 @@ def burn_in(
     steps: int = 3,
     batch: int = 64,
     d_model: int = 512,
+    seed: int = 0,
 ) -> dict:
-    """Run the acceptance test; returns loss trajectory + timing."""
+    """Run the acceptance test; returns loss trajectory + timing.
+
+    ``seed`` varies params AND data (defaults reproduce the historical
+    trajectory) — the concurrent partition acceptance gives each partition
+    its own seed so the two trajectories are INDEPENDENT pinned signals:
+    identical losses from disjoint partitions would mean the isolation
+    boundary leaked one unit's computation into the other."""
     mesh = mesh or make_mesh()
-    params = burn_in_params(mesh, d_model=d_model)
+    params = burn_in_params(mesh, d_model=d_model, seed=seed)
     x = jax.device_put(
-        jax.random.normal(jax.random.PRNGKey(1), (batch, d_model), jnp.bfloat16),
+        jax.random.normal(jax.random.PRNGKey(seed + 1), (batch, d_model), jnp.bfloat16),
         NamedSharding(mesh, P("dp", None)),
     )
     return _acceptance_run(

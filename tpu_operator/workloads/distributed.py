@@ -356,12 +356,32 @@ def _run_checks(
     }
 
 
+def free_ports(n: int) -> list[int]:
+    """``n`` distinct ephemeral ports: all sockets bound SIMULTANEOUSLY
+    before any is closed, so concurrent rendezvous groups can never be
+    handed the same port (three independent bind/close cycles could be —
+    the kernel is free to reuse a just-closed port)."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def spawn_local_workers_outcomes(
     num_processes: int,
     devices_per_proc: int,
     steps: int = 2,
     extra_env: Optional[dict] = None,
     timeout: float = 300,
+    port: Optional[int] = None,
 ) -> list[dict]:
     """Spawn ``num_processes`` REAL worker processes on the CPU backend
     against a local coordinator — the one harness behind the driver's
@@ -372,14 +392,12 @@ def spawn_local_workers_outcomes(
     Returns one outcome dict per worker — returncode, elapsed wall time,
     the last JSON line it printed (the result or the watchdog's evidence),
     and output tails — WITHOUT asserting success: the fault-injection
-    tests need the failing shapes intact."""
-    import socket
+    tests need the failing shapes intact.  Callers running SEVERAL groups
+    concurrently must pre-allocate distinct ``port``s via ``free_ports``."""
     import subprocess
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    if port is None:
+        port = free_ports(1)[0]
     procs = []
     for wid in range(num_processes):
         env = {
@@ -470,13 +488,14 @@ def spawn_local_workers(
     steps: int = 2,
     extra_env: Optional[dict] = None,
     timeout: float = 300,
+    port: Optional[int] = None,
 ) -> list[dict]:
     """``spawn_local_workers_outcomes`` for the healthy path: returns each
     worker's parsed result JSON; raises AssertionError when a worker exits
     non-zero."""
     outcomes = spawn_local_workers_outcomes(
         num_processes, devices_per_proc, steps=steps,
-        extra_env=extra_env, timeout=timeout,
+        extra_env=extra_env, timeout=timeout, port=port,
     )
     results = []
     for o in outcomes:

@@ -19,6 +19,14 @@ Env:
   minimum enforces the BASELINE "expected ICI GB/s" gate when set
 - ``MATMUL_MIN_MFU``: fail the matmul check below this model-flops
   utilization (0 = report only)
+- ``BURN_IN_SEED``: burn-in params/data seed (default 0) — the concurrent
+  partition acceptance gives each partition its own seed
+- ``WORKLOAD_START_BARRIER`` / ``WORKLOAD_BARRIER_COUNT``: rendezvous dir
+  + member count for CONCURRENT runs (partition_acceptance.py): each
+  process announces itself in the dir and none runs a check until all
+  members are present, so simultaneous execution is proven by
+  construction, not by timing luck (``WORKLOAD_BARRIER_TIMEOUT_S``
+  bounds the wait, default 120)
 """
 
 from __future__ import annotations
@@ -43,6 +51,31 @@ def main() -> int:
     ]
     ok = True
     results: dict[str, dict] = {}
+
+    # concurrent-run start barrier (partition acceptance): announce, then
+    # hold until every member is present — only then is "these partitions
+    # ran SIMULTANEOUSLY" a fact rather than a race outcome
+    barrier_dir = os.environ.get("WORKLOAD_START_BARRIER", "")
+    if barrier_dir:
+        import time
+
+        count = int(os.environ.get("WORKLOAD_BARRIER_COUNT", "1") or 1)
+        budget = float(os.environ.get("WORKLOAD_BARRIER_TIMEOUT_S", "120") or 120)
+        os.makedirs(barrier_dir, exist_ok=True)
+        with open(os.path.join(barrier_dir, f"{os.getpid()}.ready"), "w") as f:
+            f.write(str(os.getpid()))
+        deadline = time.monotonic() + budget
+        while True:
+            present = [n for n in os.listdir(barrier_dir) if n.endswith(".ready")]
+            if len(present) >= count:
+                break
+            if time.monotonic() > deadline:
+                print(json.dumps({
+                    "check": "start-barrier", "ok": False,
+                    "error": f"only {len(present)}/{count} members after {budget}s",
+                }), flush=True)
+                return 1
+            time.sleep(0.05)
 
     # device-count truth FIRST: when the validator promised a chip count
     # (EXPECTED_DEVICES, from the node's advertised google.com/tpu), PJRT
@@ -76,7 +109,10 @@ def main() -> int:
                 float(os.environ.get("ALLREDUCE_MIN_GBPS", "0")),
             )
         elif check == "burn-in":
-            result = collectives.burn_in()
+            result = collectives.burn_in(
+                steps=int(os.environ.get("BURN_IN_STEPS", "3") or 3),
+                seed=int(os.environ.get("BURN_IN_SEED", "0") or 0),
+            )
         elif check == "transformer":
             # the flagship layer: dp batch + mp ring-attention sequence
             # parallelism + Megatron-SP MLP in one train step (opt-in —
