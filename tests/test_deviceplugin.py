@@ -782,9 +782,8 @@ async def test_concurrent_partition_isolation(tmp_path, monkeypatch):
     finally:
         await plugin.stop()
 
-    result = await asyncio.get_event_loop().run_in_executor(
-        None,
-        lambda: partition_acceptance.concurrent_acceptance(units, "2x2", steps=3),
+    result = await asyncio.to_thread(
+        partition_acceptance.concurrent_acceptance, units, "2x2", steps=3
     )
     assert result["ok"], result
     assert result["independent_trajectories"]

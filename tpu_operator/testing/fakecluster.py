@@ -284,6 +284,16 @@ class FakeCluster:
         # multi-host validation pods rendezvous at a coordinator and must
         # all execute at once)
         self._executing: set[tuple[str, str]] = set()
+        # apiserver request accounting: {(method, group/plural): count} —
+        # the control-plane scale tests prove reconcile passes stay
+        # O(states + nodes) in requests, not O(states x nodes^2)
+        self.request_counts: dict[tuple[str, str], int] = {}
+
+    def reset_request_counts(self) -> None:
+        self.request_counts = {}
+
+    def total_requests(self) -> int:
+        return sum(self.request_counts.values())
 
     # ------------------------------------------------------------------
     def next_rv(self) -> int:
@@ -427,6 +437,10 @@ class FakeCluster:
             request, request.match_info["group"], request.match_info["version"], request.match_info["rest"]
         )
 
+    def _count_request(self, method: str, group: str, plural: str) -> None:
+        key = (method, f"{group + '/' if group else ''}{plural}")
+        self.request_counts[key] = self.request_counts.get(key, 0) + 1
+
     async def _dispatch(self, request: web.Request, group: str, version: str, rest: str) -> web.StreamResponse:
         try:
             parts = [p for p in rest.split("/") if p]
@@ -437,10 +451,12 @@ class FakeCluster:
                 parts = parts[2:]
             elif parts and parts[0] == "namespaces" and len(parts) == 2 and group == "":
                 # operations on the Namespace object itself
+                self._count_request(request.method, group, "namespaces")
                 return await self._handle_object(request, self.store("", "namespaces"), None, parts[1], None)
             if not parts:
                 raise ApiException(404, "NotFound", "no resource")
             plural = parts[0]
+            self._count_request(request.method, group, plural)
             name = parts[1] if len(parts) > 1 else None
             if len(parts) > 2:
                 subresource = parts[2]

@@ -17,9 +17,13 @@ execution is a construction, not a race.  Each unit's loss trajectory is
 then compared EXACTLY against that unit's solo reference run: a partition
 whose numerics change when its neighbour is busy has a leaky isolation
 boundary (shared scheduler state, cross-partition collective, wrong chip
-masking).  On the CPU backend each process simulates its unit with
-``xla_force_host_platform_device_count=<unit size>``; on hardware the
-masked env IS the isolation mechanism, same as a kubelet-launched pod.
+masking).  With ``simulate_cpu`` (the default, and what this repo's
+tests/dryrun exercise) each process models its unit as
+``xla_force_host_platform_device_count=<unit size>`` virtual CPU devices;
+``simulate_cpu=False`` exists for a real partitioned host, where the
+masked env itself drives chip-level isolation through libtpu — untested
+here (single-chip bench environment; see PARITY "Verification
+environment limits").
 """
 
 from __future__ import annotations
@@ -40,25 +44,38 @@ def unit_env(
     seed: int,
     barrier_dir: Optional[str] = None,
     barrier_count: int = 0,
+    simulate_cpu: bool = True,
 ) -> dict:
     """The env a workload process needs to run masked to one partition
     unit — mirrors the device plugin's Allocate response
     (plugin.py::Allocate: TPU_VISIBLE_CHIPS + TPU_CHIPS_PER_HOST_BOUNDS)
-    plus the burn-in seed and optional start barrier."""
+    plus the burn-in seed and optional start barrier.
+
+    ``simulate_cpu`` (the default, and the only mode this environment can
+    exercise) models the unit as ``len(chip_indices)`` virtual CPU
+    devices; pass False on a real partitioned host to let the masked env
+    itself drive chip-level isolation through libtpu."""
     from tpu_operator.deviceplugin.plugin import shape_bounds
 
     env = {
         **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": (
-            f"--xla_force_host_platform_device_count={len(chip_indices)}"
-        ),
         "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in sorted(chip_indices)),
         "TPU_CHIPS_PER_HOST_BOUNDS": shape_bounds(shape),
         "WORKLOAD_CHECKS": "burn-in",
         "BURN_IN_SEED": str(seed),
         "TPU_COMPILE_CACHE": "0",
+        # the unit's true size — a leaked node-level EXPECTED_DEVICES (the
+        # validator sets it for the WHOLE host) would fail the masked
+        # subprocess's device-count gate before burn-in ever ran
+        "EXPECTED_DEVICES": str(len(chip_indices)),
     }
+    # likewise: a leaked RESULTS_SCOPE would redirect this unit's drop-box
+    env.pop("RESULTS_SCOPE", None)
+    if simulate_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={len(chip_indices)}"
+        )
     if barrier_dir:
         env["WORKLOAD_START_BARRIER"] = barrier_dir
         env["WORKLOAD_BARRIER_COUNT"] = str(barrier_count)
@@ -111,6 +128,7 @@ def concurrent_acceptance(
     shape: str,
     steps: int = 3,
     timeout: float = 240,
+    simulate_cpu: bool = True,
 ) -> dict:
     """Run every partition unit's burn-in SIMULTANEOUSLY (start-barrier
     synchronized) and compare each trajectory exactly against that unit's
@@ -127,7 +145,7 @@ def concurrent_acceptance(
     # solo references first: each unit alone, nothing else running
     solo: dict[str, list[float]] = {}
     for i, name in enumerate(names):
-        env = unit_env(units[name], shape, seed=i + 1)
+        env = unit_env(units[name], shape, seed=i + 1, simulate_cpu=simulate_cpu)
         env["BURN_IN_STEPS"] = str(steps)
         r = _run_unit(env, timeout)
         if r["returncode"] != 0 or not (r["burn_in"] or {}).get("ok"):
@@ -143,6 +161,7 @@ def concurrent_acceptance(
             env = unit_env(
                 units[name], shape, seed=i + 1,
                 barrier_dir=bd, barrier_count=len(names),
+                simulate_cpu=simulate_cpu,
             )
             env["BURN_IN_STEPS"] = str(steps)
             procs[name] = subprocess.Popen(
