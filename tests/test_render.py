@@ -139,3 +139,29 @@ def test_rendered_non_object_is_error(tmp_path):
     (tmp_path / "x" / "0100_junk.yaml").write_text("just a string\n")
     with pytest.raises(RenderError, match="not a k8s object"):
         Renderer(str(tmp_path)).render_dir("x", {})
+
+
+def test_perf_probe_budget_renders_into_validator_env():
+    """The CR -> render_data -> macros.j2 -> DS-env link for
+    validator.perfProbes: set, both env vars render on the validator
+    container; unset (default), neither appears (goldens stay minimal)."""
+    objs = _render_all(
+        {"validator": {"perfProbes": {"checks": "matmul,hbm",
+                                      "budgetSeconds": 30}}}
+    )["state-operator-validation"]
+    ds = next(o for o in objs if o["kind"] == "DaemonSet")
+    env = {
+        e["name"]: e.get("value")
+        for e in deep_get(ds, "spec", "template", "spec", "containers", 0, "env")
+    }
+    assert env["PERF_PROBE_CHECKS"] == "matmul,hbm"
+    assert env["PERF_PROBE_BUDGET_S"] == "30"
+
+    objs = _render_all()["state-operator-validation"]
+    ds = next(o for o in objs if o["kind"] == "DaemonSet")
+    env_names = {
+        e["name"]
+        for e in deep_get(ds, "spec", "template", "spec", "containers", 0, "env")
+    }
+    assert "PERF_PROBE_CHECKS" not in env_names
+    assert "PERF_PROBE_BUDGET_S" not in env_names

@@ -268,6 +268,24 @@ async def test_perf_probes_in_process(validation_root):
     assert payload["checks"]["hbm"]["fraction_of_peak"] is None
 
 
+async def test_perf_probes_in_process_honors_cr_budget(
+    validation_root, monkeypatch
+):
+    """The CR-level probe budget applies to the IN-PROCESS branch exactly
+    as to the probe pod: PERF_PROBE_CHECKS narrows the selection and
+    PERF_PROBE_BUDGET_S skips later probes (recorded, not failed)."""
+    status.write_ready("jax")
+    monkeypatch.setenv("PERF_PROBE_CHECKS", "matmul,hbm")
+    monkeypatch.setenv("PERF_PROBE_BUDGET_S", "0.000001")
+    v = Validator(fast_config(with_workload=False))
+    await v.run("perf")
+    payload = status.read_status("perf")
+    assert payload["ok"] is True
+    assert set(payload["checks"]) == {"matmul", "hbm"}
+    # the later probe is deterministically past the microscopic budget
+    assert "budget" in payload["checks"]["hbm"]["skipped"]
+
+
 async def test_perf_probes_workload_pod(validation_root):
     """Workload mode: the perf pod runs the probes with its own drop-box
     scope so the gating run's figures survive, and failures are recorded
@@ -1089,3 +1107,55 @@ async def test_perf_probes_skip_on_slice_member(validation_root):
             assert "slice" in payload and "skipped" in payload
             with pytest.raises(ApiError):
                 await client.get("", "Pod", "tpu-perf-probes", NS)
+
+
+async def test_perf_probe_cr_budget_reaches_pod(validation_root, monkeypatch):
+    """The CR-level probe budget (validator.perfProbes -> template env ->
+    validator): PERF_PROBE_CHECKS overrides the topology-derived check
+    selection and PERF_PROBE_BUDGET_S is forwarded to the probe pod as
+    WORKLOAD_BUDGET_S, where checks past the budget are skipped (recorded,
+    not failed) — the ~80s chip occupancy becomes an operator decision."""
+
+    def exec_perf_pod(pod: dict) -> str:
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env.pop("WORKLOAD_IMAGE", None)
+        env["TPU_COMPILE_CACHE"] = "0"
+        result = subprocess.run(
+            [sys.executable, "-m", "tpu_operator.workloads.run_validation"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        return "Succeeded" if result.returncode == 0 else "Failed"
+
+    monkeypatch.setenv("PERF_PROBE_CHECKS", "vector-add,burn-in")
+    monkeypatch.setenv("PERF_PROBE_BUDGET_S", "0.000001")
+    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=exec_perf_pod)
+    async with FakeCluster(sim) as fc:
+        node = fc.add_node("tpu-node-0")
+        node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        fc.put(node)
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            status.write_ready("jax")
+            v = Validator(
+                fast_config(with_workload=True, sleep_interval=0.1,
+                            workload_retries=900),
+                client=client,
+            )
+            await v.run("perf")
+            payload = status.read_status("perf")
+            assert payload["ok"] is True
+            # the tiny budget skips the later probes (the first may slip
+            # in before the budget registers) — recorded as evidence
+            assert "budget" in payload["checks"]["burn-in"]["skipped"]
+            pod = await client.get("", "Pod", "tpu-perf-probes", NS)
+            env = {
+                e["name"]: e.get("value", "")
+                for e in deep_get(pod, "spec", "containers", 0, "env")
+            }
+            assert env["WORKLOAD_CHECKS"] == "vector-add,burn-in"
+            assert float(env["WORKLOAD_BUDGET_S"]) > 0

@@ -348,11 +348,31 @@ class ValidatorPluginSpec(SpecBase):
 
 
 @dataclass
+class PerfProbesSpec(SpecBase):
+    """Post-ready perf-probe budget: which probes run and how long they may
+    hold the chips.  The probe suite occupies the node's chips for ~80 s
+    per validation round (BENCH_r04 perf_probes_s) — on a production slice
+    every validator restart re-runs it on hardware users are waiting for,
+    so the cost is an operator decision, not a constant.  Defaults
+    preserve the built-in behavior: topology-derived check selection,
+    unbounded runtime."""
+
+    # comma list overriding the validator's topology-derived selection
+    # (see validator/components.py::validate_perf); empty = default
+    checks: str = ""
+    # probe pod stops STARTING new checks past this budget (checks already
+    # running finish; skipped probes are recorded, not failed); 0 = off
+    budget_seconds: int = 0
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
 class ValidatorSpec(OperandSpec):
     """state-operator-validation (validator image + per-component env)."""
 
     plugin: ValidatorPluginSpec = field(default_factory=ValidatorPluginSpec)
     jax: ValidatorPluginSpec = field(default_factory=ValidatorPluginSpec)
+    perf_probes: PerfProbesSpec = field(default_factory=PerfProbesSpec)
 
 
 @dataclass

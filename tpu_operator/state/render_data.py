@@ -88,6 +88,10 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
             "pull_policy": spec.validator.image_pull_policy,
             "plugin_env": list(spec.validator.plugin.env),
             "jax_env": list(spec.validator.jax.env),
+            # post-ready probe budget (validator.perfProbes): rendered as
+            # env only when set so defaults keep the built-in behavior
+            "perf_checks": spec.validator.perf_probes.checks,
+            "perf_budget_seconds": spec.validator.perf_probes.budget_seconds,
         },
         "slice_strategy": spec.slice_manager.strategy,
         # CDI (reference cdi sub-spec): the device plugin maintains the

@@ -24,6 +24,7 @@ import hashlib
 import json
 import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -482,6 +483,12 @@ class Validator:
                 ",ring,ring-attention,ulysses,moe,pipeline"
                 if chips > 1 else ",burn-in"
             )
+            # the CR-level probe budget (validator.perfProbes → template
+            # env): check selection override + a time budget forwarded to
+            # the probe pod, which stops STARTING checks past it — the
+            # ~80 s of chip occupancy per round is an operator decision
+            checks = os.environ.get("PERF_PROBE_CHECKS", "") or checks
+            budget = _env_floor("PERF_PROBE_BUDGET_S", lambda: 0.0)
             # clear the previous run's drop-box FIRST: a failed probe run
             # must surface as "no current measurements", never republish
             # last round's healthy figures to the degradation alerts
@@ -494,6 +501,7 @@ class Validator:
                     tpu_request=chips,
                     ring_min_gbps=ring_min,
                     results_scope="perf",
+                    budget_seconds=budget,
                 )
             except ValidationError as e:
                 ok, error = False, str(e)
@@ -547,8 +555,28 @@ class Validator:
                     # mirror the workload split: single-chip burn-in runs
                     # here, post-ready, instead of on the gate
                     probes["burn-in"] = lambda: collectives.burn_in(steps=2)
+                # the CR-level budget applies in-process exactly as in the
+                # probe pod: selection override + stop STARTING probes past
+                # the budget (skipped = evidence, not failure)
+                selected = os.environ.get("PERF_PROBE_CHECKS", "")
+                if selected:
+                    names = [c.strip() for c in selected.split(",") if c.strip()]
+                    probes = {
+                        n: probes.get(
+                            n, lambda n=n: {"ok": False, "error": f"unknown probe {n}"}
+                        )
+                        for n in names
+                    }
+                budget = _env_floor("PERF_PROBE_BUDGET_S", lambda: 0.0)
+                t_start = time.monotonic()
                 out = {}
                 for probe_name, fn in probes.items():
+                    if budget and time.monotonic() - t_start > budget:
+                        out[probe_name] = {
+                            "ok": True,
+                            "skipped": f"budget ({budget}s) exhausted",
+                        }
+                        continue
                     try:
                         out[probe_name] = fn()
                     except Exception as e:  # noqa: BLE001
@@ -1152,6 +1180,7 @@ class Validator:
         min_gbps: float = 0.0,
         ring_min_gbps: float = 0.0,
         results_scope: str = "",
+        budget_seconds: float = 0.0,
     ) -> dict:
         """Build the workload pod (plugin-workload-validation.yaml analogue,
         validator/main.go:984-1052: node pinning, resource request, ownerRef
@@ -1193,6 +1222,16 @@ class Validator:
                             *(
                                 [{"name": "RESULTS_SCOPE", "value": results_scope}]
                                 if results_scope
+                                else []
+                            ),
+                            # the probe pod stops STARTING checks past this
+                            # budget (run_validation; skipped, not failed)
+                            *(
+                                [{
+                                    "name": "WORKLOAD_BUDGET_S",
+                                    "value": str(budget_seconds),
+                                }]
+                                if budget_seconds
                                 else []
                             ),
                         ],
@@ -1252,12 +1291,14 @@ class Validator:
         min_gbps: float = 0.0,
         ring_min_gbps: float = 0.0,
         results_scope: str = "",
+        budget_seconds: float = 0.0,
     ) -> None:
         client = self.client()
         owner = await self._owner_daemonset()
         pod = self._workload_pod(
             name, checks, tpu_request, owner, min_gbps=min_gbps,
             ring_min_gbps=ring_min_gbps, results_scope=results_scope,
+            budget_seconds=budget_seconds,
         )
         await client.delete("", "Pod", name, self.config.namespace)
         await client.create(pod)
