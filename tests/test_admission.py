@@ -121,3 +121,43 @@ def test_vm_runtime_constraints_rejected_at_admission():
         "runtimeClasses": [{"name": "kata-tpu", "handler": "kata_v2"}],
         "configDir": "/etc/containerd/conf.d",
     }) == []
+
+
+def test_cdi_default_requires_enabled():
+    """The cross-field implication rule (cdi.default requires cdi.enabled):
+    answering Allocate with CDI device names while nothing maintains the
+    host CDI spec would fail every TPU pod on the node — reject the combo
+    at admission, on create AND update."""
+    schema = admission.spec_schema(GROUP, "TPUClusterPolicy")
+    assert schema is not None
+    # create: default without enabled rejected
+    errs = admission.validate_spec(schema, {"cdi": {"default": True}})
+    assert any("cdi.default requires cdi.enabled" in e for e in errs)
+    # the valid combinations all admit
+    for cdi in ({}, {"enabled": True}, {"enabled": True, "default": True},
+                {"default": False}):
+        assert admission.validate_spec(schema, {"cdi": cdi}) == [], cdi
+    # update: flipping enabled off while default stays on rejected
+    old = {"cdi": {"enabled": True, "default": True}}
+    errs = admission.validate_spec(schema, {"cdi": {"default": True}}, old)
+    assert any("cdi.default requires cdi.enabled" in e for e in errs)
+
+
+async def test_fake_apiserver_enforces_cdi_rule():
+    from tpu_operator.api.types import TPUClusterPolicy
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            bad = TPUClusterPolicy.new(spec={"cdi": {"default": True}}).obj
+            with pytest.raises(ApiError) as exc:
+                await client.create(bad)
+            assert exc.value.status == 422
+            assert "cdi.default requires cdi.enabled" in str(exc.value.body)
+            ok = TPUClusterPolicy.new(
+                spec={"cdi": {"enabled": True, "default": True}}
+            ).obj
+            created = await client.create(ok)
+            # dropping enabled while default remains is rejected at update
+            mutated = {**created, "spec": {"cdi": {"default": True}}}
+            with pytest.raises(ApiError):
+                await client.update(mutated)

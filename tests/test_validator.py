@@ -286,6 +286,29 @@ async def test_perf_probes_in_process_honors_cr_budget(
     assert "budget" in payload["checks"]["hbm"]["skipped"]
 
 
+async def test_perf_probes_in_process_pod_only_check_skips(
+    validation_root, monkeypatch
+):
+    """A cluster-wide perfProbes.checks naming a probe only the workload
+    pod implements (e.g. longctx) must be SKIPPED evidence on in-process
+    nodes, never a hardware-looking failure; a genuinely unknown name
+    fails exactly as the probe pod would fail it."""
+    status.write_ready("jax")
+    monkeypatch.setenv("PERF_PROBE_CHECKS", "longctx")
+    v = Validator(fast_config(with_workload=False))
+    await v.run("perf")
+    payload = status.read_status("perf")
+    assert payload["ok"] is True
+    assert "not available in-process" in payload["checks"]["longctx"]["skipped"]
+
+    monkeypatch.setenv("PERF_PROBE_CHECKS", "hbmm")  # typo
+    status.clear("perf")
+    await v.run("perf")
+    payload = status.read_status("perf")
+    assert payload["ok"] is False
+    assert "unknown check hbmm" in payload["checks"]["hbmm"]["error"]
+
+
 async def test_perf_probes_workload_pod(validation_root):
     """Workload mode: the perf pod runs the probes with its own drop-box
     scope so the gating run's figures survive, and failures are recorded

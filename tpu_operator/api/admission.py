@@ -15,6 +15,9 @@ places that have no real apiserver:
 
 Supported subset ("CEL-lite") — exactly what the generator emits:
 - ``self == oldSelf`` transition rules (field immutability)
+- ``!self.<a> || self.<b>`` boolean implication over an object's own
+  properties (cross-field requires-rules, e.g. cdi.default requires
+  cdi.enabled) — evaluated on create AND update, like the apiserver
 - ``enum`` membership
 - ``minimum`` / ``maximum`` numeric bounds
 - ``pattern`` string regexes (the generator's patterns are fully
@@ -36,6 +39,10 @@ from typing import Any, Optional
 
 # sentinel: "no previous object" (create) vs "previous value absent" (None)
 _NO_OLD = object()
+
+# the one cross-field rule shape the generator emits: boolean implication
+# over sibling properties ("a requires b")
+_IMPLICATION_RULE = re.compile(r"!self\.(\w+) \|\| self\.(\w+)")
 
 
 def validate_spec(schema: dict, new: Any, old: Any = _NO_OLD) -> list[str]:
@@ -92,16 +99,32 @@ def _walk(schema: dict, new: Any, old: Any, path: str, errors: list[str]) -> Non
         if maximum is not None and effective > maximum:
             errors.append(f"{path}: {effective} above maximum {maximum}")
 
-    if old is not _NO_OLD:
-        for rule in schema.get("x-kubernetes-validations") or []:
-            if rule.get("rule") != "self == oldSelf":
-                continue  # full CEL is the real apiserver's job
+    for rule in schema.get("x-kubernetes-validations") or []:
+        expr = rule.get("rule") or ""
+        if expr == "self == oldSelf":
+            if old is _NO_OLD:
+                continue  # transition rules need a previous object
             old_effective = _effective(old, schema)
             if old_effective is not None and effective != old_effective:
                 errors.append(
                     f"{path}: {rule.get('message', 'field is immutable')} "
                     f"(was {old_effective!r}, got {effective!r})"
                 )
+            continue
+        implication = _IMPLICATION_RULE.fullmatch(expr)
+        if implication is not None:
+            antecedent, consequent = implication.group(1, 2)
+            props = schema.get("properties") or {}
+            obj = effective if isinstance(effective, dict) else {}
+            a = _effective(obj.get(antecedent), props.get(antecedent, {}))
+            b = _effective(obj.get(consequent), props.get(consequent, {}))
+            if bool(a) and not bool(b):
+                errors.append(
+                    f"{path}: "
+                    f"{rule.get('message', f'{antecedent} requires {consequent}')}"
+                )
+            continue
+        # any other expression: full CEL is the real apiserver's job
 
     properties = schema.get("properties")
     if properties and isinstance(new, dict):

@@ -486,7 +486,21 @@ class TPUClusterPolicySpec(SpecBase):
     vm_runtime: "VMRuntimeSpec" = field(default_factory=lambda: VMRuntimeSpec())
     sandbox_device_plugin: OperandSpec = field(default_factory=OperandSpec)
     psa: PSASpec = field(default_factory=PSASpec)
-    cdi: CDISpec = field(default_factory=CDISpec)
+    # cdi.default without cdi.enabled is always a misconfiguration: the
+    # plugin would answer Allocate with CDI device names while nothing
+    # maintains the host CDI spec file they refer to — every TPU pod on
+    # the node would fail container creation.  Guarded at admission (CEL
+    # in the CRD; the same rule enforced by the fake apiserver and
+    # tpuop_cfg via api/admission.py).
+    cdi: CDISpec = field(
+        default_factory=CDISpec,
+        metadata={
+            "cel": [{
+                "rule": "!self.default || self.enabled",
+                "message": "cdi.default requires cdi.enabled",
+            }],
+        },
+    )
     remediation: RemediationSpec = field(default_factory=RemediationSpec)
     extra_fields: dict = field(default_factory=dict)
 

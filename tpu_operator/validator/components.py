@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import copy
+import functools
 import hashlib
 import json
 import logging
@@ -560,11 +561,27 @@ class Validator:
                 # the budget (skipped = evidence, not failure)
                 selected = os.environ.get("PERF_PROBE_CHECKS", "")
                 if selected:
+                    from tpu_operator.workloads import run_validation
+
+                    valid = run_validation.known_checks()
                     names = [c.strip() for c in selected.split(",") if c.strip()]
+
+                    def _unavailable(n):
+                        # the CR selection is cluster-wide but in-process
+                        # nodes implement a probe subset — a VALID name
+                        # this node can't run is SKIPPED evidence (the
+                        # workload-pod nodes still run it), never a
+                        # hardware-looking failure; a typo'd name fails
+                        # here exactly as the probe pod would fail it
+                        if n in valid:
+                            return {
+                                "ok": True,
+                                "skipped": f"probe {n} not available in-process",
+                            }
+                        return {"ok": False, "error": f"unknown check {n}"}
+
                     probes = {
-                        n: probes.get(
-                            n, lambda n=n: {"ok": False, "error": f"unknown probe {n}"}
-                        )
+                        n: probes.get(n, functools.partial(_unavailable, n))
                         for n in names
                     }
                 budget = _env_floor("PERF_PROBE_BUDGET_S", lambda: 0.0)

@@ -40,6 +40,156 @@ import sys
 import time
 
 
+def check_runners() -> dict:
+    """Check name → zero-arg runner, the ONE source of truth for the
+    dispatch AND the valid-name set (name validation happens before the
+    budget skip, so the two may never drift).  Heavy modules import inside
+    each runner — only checks that actually run pay their import."""
+    from tpu_operator.workloads import collectives
+
+    def allreduce():
+        return collectives.apply_allreduce_gate(
+            collectives.allreduce_benchmark(
+                size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "64"))
+            ),
+            float(os.environ.get("ALLREDUCE_MIN_GBPS", "0")),
+        )
+
+    def burn_in():
+        return collectives.burn_in(
+            steps=int(os.environ.get("BURN_IN_STEPS", "3") or 3),
+            seed=int(os.environ.get("BURN_IN_SEED", "0") or 0),
+        )
+
+    def train():
+        # end-to-end training throughput: tokens/sec + training MFU of the
+        # flagship step at real shapes (report-only evidence for capacity
+        # planning; holds the chip ~1min on TPU)
+        from tpu_operator.workloads import train_bench
+
+        return train_bench.quick_check()
+
+    def matmul():
+        from tpu_operator.workloads import matmul_bench
+
+        return matmul_bench.apply_mfu_gate(
+            matmul_bench.quick_benchmark(),
+            float(os.environ.get("MATMUL_MIN_MFU", "0")),
+        )
+
+    def ring_attention():
+        # sequence-parallel exact attention over the local chip ring
+        # (long-context acceptance; report-only correctness-or-fail)
+        from tpu_operator.workloads import ring_attention as ra
+
+        return ra.quick_check()
+
+    def ulysses():
+        # the all-to-all SP strategy (two AllToAlls re-shard seq<->heads);
+        # same acceptance contract as ring-attention
+        from tpu_operator.workloads import ulysses as ul
+
+        return ul.quick_check()
+
+    def moe():
+        # expert parallelism: routed all-to-all dispatch — the only
+        # collective here whose traffic crosses EVERY chip pair, so it
+        # doubles as a full-bisection interconnect diagnostic
+        from tpu_operator.workloads import moe as m
+
+        return m.quick_check()
+
+    def longctx():
+        # long-context prefill: K/V-streamed flash attention (32k tokens
+        # on one chip), spot-tile exactness + throughput
+        from tpu_operator.workloads import longctx as lc
+
+        return lc.quick_check()
+
+    def decode():
+        # decode attention against a long KV cache: per-token latency +
+        # cache-read bandwidth (the HBM-bound half of serving)
+        from tpu_operator.workloads import longctx as lc
+
+        return lc.decode_quick_check()
+
+    def pipeline():
+        # GPipe microbatch streaming over chip-resident stages
+        from tpu_operator.workloads import pipeline as pl
+
+        return pl.quick_check()
+
+    def ring():
+        return collectives.apply_ring_gate(
+            collectives.ring_benchmark(
+                size_mb=float(os.environ.get("RING_SIZE_MB", "16")),
+                iters=int(os.environ.get("RING_ITERS", "4")),
+            ),
+            float(os.environ.get("RING_MIN_GBPS", "0") or 0),
+        )
+
+    def hbm():
+        from tpu_operator.workloads import hbm_bench
+
+        return hbm_bench.apply_hbm_gate(
+            hbm_bench.hbm_benchmark(
+                size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
+                iters=int(os.environ.get("HBM_ITERS", "1024")),
+                best_of=int(os.environ.get("HBM_BEST_OF", "3")),
+            ),
+            float(os.environ.get("HBM_MIN_GBPS", "0") or 0),
+        )
+
+    def hbm_dma():
+        # pallas DMA-pipeline cross-check (report-only by design): same
+        # units AND same env-driven working set as hbm — the pair's
+        # agreement/divergence is only meaningful over identical sizes
+        import jax
+
+        from tpu_operator.workloads import hbm_pallas
+
+        if jax.default_backend() == "tpu":
+            return hbm_pallas.dma_stream_benchmark(
+                size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
+                iters=int(os.environ.get("HBM_ITERS", "1024")),
+                chunk_mb=float(os.environ.get("HBM_DMA_CHUNK_MB", "4")),
+                slots=int(os.environ.get("HBM_DMA_SLOTS", "4")),
+                best_of=int(os.environ.get("HBM_BEST_OF", "3")),
+            )
+        # interpret mode: full-size would take minutes in the python DMA
+        # emulator — toy shapes, figures labelled cpu
+        return hbm_pallas.quick_benchmark()
+
+    return {
+        "vector-add": collectives.vector_add,
+        "allreduce": allreduce,
+        "burn-in": burn_in,
+        # the flagship layer: dp batch + mp ring-attention sequence
+        # parallelism + Megatron-SP MLP in one train step (opt-in — the
+        # gate stays minimal, dryrun/tests prove this composition)
+        "transformer": collectives.transformer_burn_in,
+        # the full composition: GPipe microbatch pipeline of chip-resident
+        # transformer stages, each internally the dp+sp+tp layer
+        "transformer-pp": collectives.transformer_pipeline_burn_in,
+        "train": train,
+        "matmul": matmul,
+        "ring-attention": ring_attention,
+        "ulysses": ulysses,
+        "moe": moe,
+        "longctx": longctx,
+        "decode": decode,
+        "pipeline": pipeline,
+        "ring": ring,
+        "hbm": hbm,
+        "hbm-dma": hbm_dma,
+    }
+
+
+def known_checks() -> set:
+    """Valid check names (derived from the dispatch — cannot drift)."""
+    return set(check_runners())
+
+
 def main() -> int:
     from tpu_operator import workloads
     from tpu_operator.workloads import collectives, compile_cache
@@ -106,143 +256,21 @@ def main() -> int:
         budget = 0.0
     t_start = time.monotonic()
 
-    KNOWN_CHECKS = {
-        "vector-add", "allreduce", "burn-in", "transformer", "transformer-pp",
-        "train", "matmul", "ring-attention", "ulysses", "moe", "longctx",
-        "decode", "pipeline", "ring", "hbm", "hbm-dma",
-    }
-
+    runners = check_runners()
     for check in checks:
-        if check not in KNOWN_CHECKS:
+        runner = runners.get(check)
+        if runner is None:
             # validate the NAME even past the budget: a typo'd check must
             # fail the pod, never be masked as a benign budget skip
             result = {"ok": False, "error": f"unknown check {check}"}
-            print(json.dumps({"check": check, **result}), flush=True)
-            results[check] = result
-            ok = False
-            continue
-        if budget and time.monotonic() - t_start > budget:
+        elif budget and time.monotonic() - t_start > budget:
             # chip-occupancy budget exhausted: remaining checks are
             # SKIPPED evidence, not failures — the operator chose the
             # budget; a probe that didn't run says nothing bad about
             # the hardware
             result = {"ok": True, "skipped": f"budget ({budget}s) exhausted"}
-            print(json.dumps({"check": check, **result}), flush=True)
-            results[check] = result
-            continue
-        if check == "vector-add":
-            result = collectives.vector_add()
-        elif check == "allreduce":
-            result = collectives.apply_allreduce_gate(
-                collectives.allreduce_benchmark(
-                    size_mb=float(os.environ.get("ALLREDUCE_SIZE_MB", "64"))
-                ),
-                float(os.environ.get("ALLREDUCE_MIN_GBPS", "0")),
-            )
-        elif check == "burn-in":
-            result = collectives.burn_in(
-                steps=int(os.environ.get("BURN_IN_STEPS", "3") or 3),
-                seed=int(os.environ.get("BURN_IN_SEED", "0") or 0),
-            )
-        elif check == "transformer":
-            # the flagship layer: dp batch + mp ring-attention sequence
-            # parallelism + Megatron-SP MLP in one train step (opt-in —
-            # the gate stays minimal, dryrun/tests prove this composition)
-            result = collectives.transformer_burn_in()
-        elif check == "transformer-pp":
-            # the full composition: GPipe microbatch pipeline of
-            # chip-resident transformer stages, each internally the
-            # dp+sp+tp layer — tp/pp/dp/sp in one train step
-            result = collectives.transformer_pipeline_burn_in()
-        elif check == "train":
-            # end-to-end training throughput: tokens/sec + training MFU
-            # of the flagship step at real shapes (report-only evidence
-            # for capacity planning; holds the chip ~1min on TPU)
-            from tpu_operator.workloads import train_bench
-
-            result = train_bench.quick_check()
-        elif check == "matmul":
-            from tpu_operator.workloads import matmul_bench
-
-            result = matmul_bench.apply_mfu_gate(
-                matmul_bench.quick_benchmark(),
-                float(os.environ.get("MATMUL_MIN_MFU", "0")),
-            )
-        elif check == "ring-attention":
-            # sequence-parallel exact attention over the local chip ring
-            # (long-context acceptance; report-only correctness-or-fail)
-            from tpu_operator.workloads import ring_attention
-
-            result = ring_attention.quick_check()
-        elif check == "ulysses":
-            # the all-to-all SP strategy (two AllToAlls re-shard
-            # seq<->heads); same acceptance contract as ring-attention
-            from tpu_operator.workloads import ulysses
-
-            result = ulysses.quick_check()
-        elif check == "moe":
-            # expert parallelism: routed all-to-all dispatch — the only
-            # collective here whose traffic crosses EVERY chip pair, so
-            # it doubles as a full-bisection interconnect diagnostic
-            from tpu_operator.workloads import moe
-
-            result = moe.quick_check()
-        elif check == "longctx":
-            # long-context prefill: K/V-streamed flash attention (32k
-            # tokens on one chip), spot-tile exactness + throughput
-            from tpu_operator.workloads import longctx
-
-            result = longctx.quick_check()
-        elif check == "decode":
-            # decode attention against a long KV cache: per-token latency
-            # + cache-read bandwidth (the HBM-bound half of serving)
-            from tpu_operator.workloads import longctx
-
-            result = longctx.decode_quick_check()
-        elif check == "pipeline":
-            # GPipe microbatch streaming over chip-resident stages
-            from tpu_operator.workloads import pipeline
-
-            result = pipeline.quick_check()
-        elif check == "ring":
-            result = collectives.apply_ring_gate(
-                collectives.ring_benchmark(
-                    size_mb=float(os.environ.get("RING_SIZE_MB", "16")),
-                    iters=int(os.environ.get("RING_ITERS", "4")),
-                ),
-                float(os.environ.get("RING_MIN_GBPS", "0") or 0),
-            )
-        elif check == "hbm":
-            from tpu_operator.workloads import hbm_bench
-
-            result = hbm_bench.apply_hbm_gate(
-                hbm_bench.hbm_benchmark(
-                    size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
-                    iters=int(os.environ.get("HBM_ITERS", "1024")),
-                    best_of=int(os.environ.get("HBM_BEST_OF", "3")),
-                ),
-                float(os.environ.get("HBM_MIN_GBPS", "0") or 0),
-            )
-        elif check == "hbm-dma":
-            # pallas DMA-pipeline cross-check (report-only by design): same
-            # units AND same env-driven working set as hbm — the pair's
-            # agreement/divergence is only meaningful over identical sizes
-            from tpu_operator.workloads import hbm_pallas
-
-            if jax.default_backend() == "tpu":
-                result = hbm_pallas.dma_stream_benchmark(
-                    size_mb=float(os.environ.get("HBM_SIZE_MB", "256")),
-                    iters=int(os.environ.get("HBM_ITERS", "1024")),
-                    chunk_mb=float(os.environ.get("HBM_DMA_CHUNK_MB", "4")),
-                    slots=int(os.environ.get("HBM_DMA_SLOTS", "4")),
-                    best_of=int(os.environ.get("HBM_BEST_OF", "3")),
-                )
-            else:
-                # interpret mode: full-size would take minutes in the
-                # python DMA emulator — toy shapes, figures labelled cpu
-                result = hbm_pallas.quick_benchmark()
         else:
-            result = {"ok": False, "error": f"unknown check {check}"}
+            result = runner()
         print(json.dumps({"check": check, **result}), flush=True)
         results[check] = result
         ok = ok and bool(result.get("ok"))
