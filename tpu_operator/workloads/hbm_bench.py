@@ -86,6 +86,7 @@ def hbm_benchmark(
         "overhead_dominated": overhead_dominated,
         "gbps": gbps,
         "gbps_median": moved / dt_median / 1e9,
+        "gbps_min": moved / times[-1] / 1e9,
         "generation": generation,
         "peak_hbm_gbps": peak,
         "fraction_of_peak": round(gbps / peak, 4) if peak else None,

@@ -170,6 +170,10 @@ def _time_matmul(
         "time_ms_median": median * 1e3,
         "tflops": flops / best / 1e12,
         "tflops_median": flops / median / 1e12,
+        # full best-of-N spread: a published figure without its error bar
+        # reads run variance as regression (the r04 0.952->0.905 scare was
+        # transport noise — the measured run-to-run envelope is ~0.89-0.95)
+        "tflops_min": flops / times[-1] / 1e12,
         "finite": math.isfinite(checksum),
     }
 
@@ -199,7 +203,16 @@ def matmul_benchmark(
         "best_size": best["size"],
         "overhead_dominated": best["overhead_dominated"],
         "tflops": best["tflops"],
+        # the best size's best-of-N spread, published alongside the
+        # headline so a reader can tell noise from regression
+        "tflops_spread": {
+            "min": best["tflops_min"],
+            "median": best["tflops_median"],
+            "max": best["tflops"],
+        },
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_median": round(best["tflops_median"] / peak, 4) if peak else None,
+        "mfu_min": round(best["tflops_min"] / peak, 4) if peak else None,
     }
 
 

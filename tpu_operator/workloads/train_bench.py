@@ -127,7 +127,13 @@ def train_benchmark(
         "overhead_dominated": overhead_dominated,
         "step_time_ms": step_s * 1e3,
         "step_time_ms_median": step_s_median * 1e3,
+        "step_time_ms_max": times[-1] * 1e3,
         "tokens_per_sec": b * s / step_s,
+        "tokens_per_sec_spread": {
+            "min": b * s / times[-1],
+            "median": b * s / step_s_median,
+            "max": b * s / step_s,
+        },
         "model_tflops": tflops,
         "backend": jax.default_backend(),
         "generation": generation,
@@ -137,6 +143,8 @@ def train_benchmark(
     }
     if peak > 0:
         result["train_mfu"] = round(tflops / peak, 4)
+        result["train_mfu_median"] = round(flops / step_s_median / 1e12 / peak, 4)
+        result["train_mfu_min"] = round(flops / times[-1] / 1e12 / peak, 4)
     return result
 
 
