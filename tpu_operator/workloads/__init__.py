@@ -10,6 +10,22 @@ sharded burn-in step exercising the MXU + collectives across a device mesh.
 import os
 
 
+def subprocess_pythonpath() -> str:
+    """PYTHONPATH value for a spawned worker that re-imports this package
+    via ``python -m``: the parent's package root prepended to the existing
+    PYTHONPATH.  Covers the ImportError case (worker launched from a cwd
+    without the package — e.g. the dryrun invoked outside the repo).  It
+    does NOT pin the worker to the parent's copy: ``-m`` still puts the
+    child's cwd at sys.path[0], ahead of PYTHONPATH — don't launch from a
+    directory containing a different checkout.  One home for the contract,
+    used by every subprocess-spawning workload harness."""
+    import tpu_operator
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(tpu_operator.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    return root + os.pathsep + existing if existing else root
+
+
 def honor_cpu_platform_request() -> None:
     """Apply a caller's JAX_PLATFORMS=cpu request decisively.
 

@@ -396,12 +396,16 @@ def spawn_local_workers_outcomes(
     concurrently must pre-allocate distinct ``port``s via ``free_ports``."""
     import subprocess
 
+    from tpu_operator import workloads
+
     if port is None:
         port = free_ports(1)[0]
     procs = []
     for wid in range(num_processes):
         env = {
             **os.environ,
+            # workers re-import the package via -m; see subprocess_pythonpath
+            "PYTHONPATH": workloads.subprocess_pythonpath(),
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_proc}",
             "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",

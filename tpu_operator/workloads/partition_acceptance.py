@@ -55,10 +55,13 @@ def unit_env(
     exercise) models the unit as ``len(chip_indices)`` virtual CPU
     devices; pass False on a real partitioned host to let the masked env
     itself drive chip-level isolation through libtpu."""
+    from tpu_operator import workloads
     from tpu_operator.deviceplugin.plugin import shape_bounds
 
     env = {
         **os.environ,
+        # unit processes re-import the package via -m; see subprocess_pythonpath
+        "PYTHONPATH": workloads.subprocess_pythonpath(),
         "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in sorted(chip_indices)),
         "TPU_CHIPS_PER_HOST_BOUNDS": shape_bounds(shape),
         "WORKLOAD_CHECKS": "burn-in",
