@@ -607,6 +607,40 @@ def _exec_distributed_pod(port: int, executed: list | None = None):
     return execute
 
 
+async def _run_validator_with_restarts(v, attempts: int = 10):
+    """The DS restart semantics for fault-recovery tests: a validator that
+    raced a stale Failed pod re-runs until the converge loop has swept it."""
+    for _ in range(attempts):
+        try:
+            return await v.run("jax")
+        except ValidationError:
+            await asyncio.sleep(0.3)
+    raise AssertionError("validator never recovered")
+
+
+def _add_multislice_nodes(fc, group: str, pools=("pool-a", "pool-b")) -> list:
+    """Two 2-host slices (distinct node pools) declared one multislice
+    group; returns the node names."""
+    names = []
+    for pool in pools:
+        for i in range(2):
+            name = f"tpu-{pool}-{i}"
+            names.append(name)
+            node = fc.add_node(
+                name,
+                topology="2x4",  # 8 chips / 4 per host = 2 hosts per slice
+                labels={
+                    consts.GKE_NODEPOOL_LABEL: pool,
+                    consts.GKE_TPU_WORKER_ID_LABEL: str(i),
+                    consts.MULTISLICE_GROUP_LABEL: group,
+                    consts.MULTISLICE_SLICES_LABEL: "2",
+                },
+            )
+            node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(node)
+    return names
+
+
 async def _run_multihost_validation(num_hosts: int, topology: str, pool: str):
     """One slice of ``num_hosts`` hosts (4 chips each): every host runs a
     validator concurrently; worker 0 creates the coordinated pod set
@@ -791,20 +825,11 @@ async def test_multihost_member_death_fails_bounded_then_revalidates(
                 d["process_id"] for d in evidence["fault"]["dead_members"]
             ] == [1]
 
-            # epoch re-proof: fault cleared, validators restart (the DS
-            # restart semantics — a validator that raced a stale Failed pod
-            # re-runs until worker 0's converge loop has swept it)
+            # epoch re-proof: fault cleared, validators restart
             fault["armed"] = False
-
-            async def run_with_restarts(v):
-                for _ in range(10):
-                    try:
-                        return await v.run("jax")
-                    except ValidationError:
-                        await asyncio.sleep(0.3)
-                raise AssertionError("validator never recovered")
-
-            await asyncio.gather(*(run_with_restarts(v) for v in validators))
+            await asyncio.gather(
+                *(_run_validator_with_restarts(v) for v in validators)
+            )
             payload = status.read_status("jax")
             assert payload["mode"] == "multi-host"
             assert payload["workers"] == 2
@@ -835,23 +860,7 @@ async def test_multislice_cross_slice_validation(validation_root):
         pod_executor=_exec_distributed_pod(port, executed),
     )
     async with FakeCluster(sim) as fc:
-        names = []
-        for s, pool in enumerate(("pool-a", "pool-b")):
-            for i in range(2):
-                name = f"tpu-{pool}-{i}"
-                names.append(name)
-                node = fc.add_node(
-                    name,
-                    topology="2x4",  # 8 chips / 4 per host = 2 hosts per slice
-                    labels={
-                        consts.GKE_NODEPOOL_LABEL: pool,
-                        consts.GKE_TPU_WORKER_ID_LABEL: str(i),
-                        consts.MULTISLICE_GROUP_LABEL: "ms-test",
-                        consts.MULTISLICE_SLICES_LABEL: "2",
-                    },
-                )
-                node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
-                fc.put(node)
+        names = _add_multislice_nodes(fc, "ms-test")
         async with contextlib.AsyncExitStack() as stack:
             clients = [
                 await stack.enter_async_context(
@@ -907,6 +916,94 @@ async def test_multislice_cross_slice_validation(validation_root):
                 if p["metadata"]["name"].startswith("tpu-jax-validation")
                 or p["metadata"]["name"].startswith("tpu-ms-validation")
             ]
+
+
+async def test_multislice_member_death_fails_bounded_then_revalidates(
+    validation_root,
+):
+    """Fault injection on the NEWEST distributed path: a member of the
+    CROSS-SLICE (DCN) rendezvous is SIGKILLed mid-run after both member
+    slices proved their own ICI rendezvous.  Every host must fail
+    validation in bounded time (watchdog semantics apply to the
+    cross-slice program identically), no jax-ready anywhere; after the
+    fault clears the member-slice proofs are reused via their epoch
+    tombstones and only the cross-slice phase re-proves."""
+    import contextlib
+    import time as _time
+
+    port = _free_port()
+    executed: list = []
+    inner = _exec_distributed_pod(port, executed)
+    fault = {"armed": True}
+
+    def execute(pod: dict) -> str:
+        # inject ONLY into the cross-slice pods: member-slice rendezvous
+        # must succeed first (their PROCESS_ID=1 pods are different
+        # processes than cross-slice global id 1)
+        if fault["armed"] and pod["metadata"]["name"].startswith(
+            "tpu-ms-validation"
+        ):
+            pod["spec"]["containers"][0]["env"] += [
+                {"name": "FAULT_INJECT", "value": "psum:1"},
+                {"name": "WATCHDOG_TIMEOUT_S", "value": "4"},
+            ]
+        return inner(pod)
+
+    sim = SimConfig(pod_ready_delay=0.01, tick=0.01, pod_executor=execute)
+    async with FakeCluster(sim) as fc:
+        names = _add_multislice_nodes(fc, "ms-fault")
+        async with contextlib.AsyncExitStack() as stack:
+            clients = [
+                await stack.enter_async_context(
+                    ApiClient(Config(base_url=fc.base_url))
+                )
+                for _ in names
+            ]
+            validators = [
+                Validator(
+                    fast_config(node_name=n, with_workload=True,
+                                sleep_interval=0.1, workload_retries=1800),
+                    client=clients[i],
+                )
+                for i, n in enumerate(names)
+            ]
+            status.write_ready("plugin")
+
+            t0 = _time.monotonic()
+            outcomes = await asyncio.gather(
+                *(v.run("jax") for v in validators), return_exceptions=True
+            )
+            elapsed = _time.monotonic() - t0
+            assert all(isinstance(o, ValidationError) for o in outcomes), outcomes
+            assert elapsed < 180, f"cross-slice failure took {elapsed:.0f}s"
+            assert not status.is_ready("jax")
+            # the member slices DID prove themselves (tombstoned) — the
+            # failure is isolated to the cross-slice phase
+            for pool in ("pool-a", "pool-b"):
+                svc = await clients[0].get(
+                    "", "Service", f"tpu-jax-validation-{pool}", NS
+                )
+                assert deep_get(
+                    svc, "metadata", "annotations", default={}
+                ).get(components.VALIDATED_EPOCH_ANNOTATION)
+
+            fault["armed"] = False
+            n_member_pods_before = len([
+                p for p in executed
+                if p["metadata"]["name"].startswith("tpu-jax-validation")
+            ])
+            await asyncio.gather(
+                *(_run_validator_with_restarts(v) for v in validators)
+            )
+            payload = status.read_status("jax")
+            assert payload["multislice"]["workers"] == 4
+            # re-proof reused the member-slice tombstones: no NEW
+            # member-slice pods executed in the second epoch
+            n_member_pods_after = len([
+                p for p in executed
+                if p["metadata"]["name"].startswith("tpu-jax-validation")
+            ])
+            assert n_member_pods_after == n_member_pods_before
 
 
 async def test_multislice_missing_slice_fails(validation_root):
