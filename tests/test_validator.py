@@ -975,7 +975,10 @@ async def test_multislice_member_death_fails_bounded_then_revalidates(
             )
             elapsed = _time.monotonic() - t0
             assert all(isinstance(o, ValidationError) for o in outcomes), outcomes
-            assert elapsed < 180, f"cross-slice failure took {elapsed:.0f}s"
+            # generous for the 1-core CI box under suite load (measured
+            # ~120s in isolation); still far inside the 3x300s worst case
+            # the pre-watchdog code could burn
+            assert elapsed < 270, f"cross-slice failure took {elapsed:.0f}s"
             assert not status.is_ready("jax")
             # the member slices DID prove themselves (tombstoned) — the
             # failure is isolated to the cross-slice phase
