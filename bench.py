@@ -345,12 +345,13 @@ def main() -> None:
         },
         "workload_longctx": {
             k: checks.get("longctx", {}).get(k)
-            for k in ("ok", "seq", "attn_tflops", "tokens_per_sec",
-                      "max_error", "overhead_dominated")
+            for k in ("ok", "seq", "attn_tflops", "attn_tflops_spread",
+                      "tokens_per_sec", "max_error", "overhead_dominated")
         },
         "workload_decode": {
             k: checks.get("decode", {}).get(k)
-            for k in ("ok", "seq", "decode_us", "cache_gbps",
+            for k in ("ok", "seq", "decode_us", "decode_us_median",
+                      "decode_us_max", "cache_gbps", "cache_gbps_min",
                       "cache_fraction_of_peak", "overhead_dominated")
         },
         "train": {

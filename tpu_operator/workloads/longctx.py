@@ -167,9 +167,10 @@ def _amortized_time(chain_call, null_call, iters: int, best_of: int):
     """The one timing harness both probes run: compile/settle both
     programs, measure the dispatch+readback floor with the null program,
     wall-clock ``best_of`` chained runs, floor-subtract per iteration
-    (workloads/timing.py rules).  Returns (per_iter_s, overhead_dominated,
-    last_chain_value) — the value so callers can fold finiteness into
-    ok."""
+    (workloads/timing.py rules).  Returns (per_iter_times_sorted,
+    overhead_dominated, last_chain_value) — the full sorted sample list so
+    callers publish best AND spread (error-bar rule), the value so callers
+    can fold finiteness into ok."""
     last = chain_call()  # compile + settle
     null_call()
     overhead = min(timing.timed(null_call) for _ in range(3))
@@ -179,7 +180,7 @@ def _amortized_time(chain_call, null_call, iters: int, best_of: int):
         last = chain_call()
         raw.append(time.perf_counter() - t0)
     times, dominated = timing.subtract_floor(raw, overhead, per=iters)
-    return times[0], dominated, last
+    return times, dominated, last
 
 
 def prefill_benchmark(
@@ -227,9 +228,10 @@ def prefill_benchmark(
     def null(q):
         return jnp.sum(q[0, 0].astype(jnp.float32))
 
-    dt, overhead_dominated, _ = _amortized_time(
+    times, overhead_dominated, _ = _amortized_time(
         lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of
     )
+    dt = times[0]
 
     # exactness: first tile (diagonal edge) and last tile (attends to the
     # whole context) against the per-tile reference
@@ -260,6 +262,11 @@ def prefill_benchmark(
         "overhead_dominated": overhead_dominated,
         "tokens_per_sec": batch * seq / dt,
         "attn_tflops": flops / dt / 1e12,
+        "attn_tflops_spread": {
+            "min": flops / times[-1] / 1e12,
+            "median": flops / times[len(times) // 2] / 1e12,
+            "max": flops / dt / 1e12,
+        },
         "max_error": max_err,
         "spot_tiles": [0, seq - tile],
         "backend": jax.default_backend(),
@@ -346,9 +353,10 @@ def decode_benchmark(
     def null(q):
         return jnp.sum(q[:, -1].astype(jnp.float32))
 
-    dt, overhead_dominated, last = _amortized_time(
+    times, overhead_dominated, last = _amortized_time(
         lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of
     )
+    dt = times[0]
 
     cache_bytes = 2.0 * bh * seq * head_dim * 2  # K and V, bf16
     generation = matmul_bench.detect_generation()
@@ -364,8 +372,11 @@ def decode_benchmark(
         "head_dim": head_dim,
         "batch": batch,
         "decode_us": dt * 1e6,
+        "decode_us_median": times[len(times) // 2] * 1e6,
+        "decode_us_max": times[-1] * 1e6,
         "decodes_per_sec": batch / dt,
         "cache_gbps": cache_bytes / dt / 1e9,
+        "cache_gbps_min": cache_bytes / times[-1] / 1e9,
         "overhead_dominated": overhead_dominated,
         "backend": jax.default_backend(),
         "generation": generation,
