@@ -99,3 +99,36 @@ def test_daemonset_ready_stale_status_after_spec_update():
     assert not daemonset_ready(stale)
     stale["status"]["observedGeneration"] = 2
     assert daemonset_ready(stale)
+
+
+def test_subprocess_pythonpath_contract():
+    """The child-import contract for subprocess workload harnesses: the
+    parent's package root leads, existing PYTHONPATH is preserved, and no
+    empty trailing entry ('' = cwd) is appended when PYTHONPATH is unset."""
+    import os
+
+    import tpu_operator
+    from tpu_operator import workloads
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(tpu_operator.__file__)))
+    prior = os.environ.pop("PYTHONPATH", None)
+    try:
+        assert workloads.subprocess_pythonpath() == root
+        os.environ["PYTHONPATH"] = "/elsewhere"
+        got = workloads.subprocess_pythonpath()
+        assert got.split(os.pathsep) == [root, "/elsewhere"]
+    finally:
+        if prior is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = prior
+
+
+def test_free_ports_distinct():
+    """Concurrent rendezvous coordinators need distinct ports: all sockets
+    are bound simultaneously before any is released."""
+    from tpu_operator.workloads.distributed import free_ports
+
+    ports = free_ports(4)
+    assert len(set(ports)) == 4
+    assert all(1024 < p < 65536 for p in ports)
