@@ -6,10 +6,26 @@ PYTHON ?= python
 
 all: proto manifests test
 
-test: unit-test
+# default test target = lint gate + the tier-1 pytest line CI runs
+test: lint unit-test
 
+# the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
+# plumbing): slow-marked tests excluded, collection errors non-fatal
 unit-test:
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+
+# ruff gates the obs/ package (and the rest of the tree it configures in
+# pyproject [tool.ruff]); images without ruff baked in fall back to a
+# bytecode compile check so `make test` still runs end to end
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check tpu_operator/obs tpu_operator/cmd tpu_operator/controllers; \
+	elif $(PYTHON) -c "import ruff" >/dev/null 2>&1; then \
+		$(PYTHON) -m ruff check tpu_operator/obs tpu_operator/cmd tpu_operator/controllers; \
+	else \
+		echo "ruff not installed; compile-checking instead"; \
+		$(PYTHON) -m compileall -q tpu_operator/obs tpu_operator/cmd tpu_operator/controllers; \
+	fi
 
 # kubelet device-plugin v1beta1 message codegen (protoc only; gRPC wiring is
 # hand-written in tpu_operator/deviceplugin/rpc.py)

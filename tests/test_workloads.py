@@ -1031,3 +1031,169 @@ def test_run_validation_budget_skips_checks(monkeypatch, capsys):
     got = {json.loads(l)["check"]: json.loads(l) for l in lines}
     assert "skipped" not in got["vector-add"]
     assert got["burn-in"]["losses"]
+
+
+# ----------------------------------------------------------------------
+# watchdog peer-liveness unit tests (fake KV client; the spawn-based
+# rendezvous tests above cover the integrated shapes)
+
+
+class _FakeKV:
+    """Minimal coordination-service KV double (key_value_set/try_get)."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, overwrite=True):
+        self.store[key] = value
+
+    def key_value_try_get(self, key):
+        if key not in self.store:
+            raise RuntimeError(f"NOT_FOUND: {key}")
+        return self.store[key]
+
+
+def test_watchdog_skips_cleanly_done_peer(validation_root):
+    """A peer that published the terminal phase and exited (heartbeat
+    stalls forever after) must NOT be declared dead — a survivor still
+    mid-run would otherwise hard-kill its own healthy validation
+    (ADVICE r05, watchdog.py)."""
+    import time as _time
+
+    from tpu_operator.workloads import watchdog
+
+    kv = _FakeKV()
+    exits = []
+    wd = watchdog.PeerWatchdog(
+        kv, 0, 2, timeout=0.05, interval=0.01, exit_fn=exits.append
+    )
+    # peer 1 beat once, published 'done', then exited: beat never advances
+    kv.key_value_set(f"{watchdog._KV_PREFIX}/hb/1", "1", True)
+    kv.key_value_set(f"{watchdog._KV_PREFIX}/phase/1", watchdog.TERMINAL_PHASE, True)
+    wd.start()
+    _time.sleep(0.3)  # many intervals past the 0.05s timeout
+    wd.stop()
+    assert exits == []
+
+
+def test_watchdog_declares_stalled_midrun_peer_dead(validation_root):
+    """Contrast case: the same stall in a NON-terminal phase is a death."""
+    import time as _time
+
+    from tpu_operator.workloads import watchdog
+
+    kv = _FakeKV()
+    exits = []
+    wd = watchdog.PeerWatchdog(
+        kv, 0, 2, timeout=0.05, interval=0.01, exit_fn=exits.append
+    )
+    kv.key_value_set(f"{watchdog._KV_PREFIX}/hb/1", "1", True)
+    kv.key_value_set(f"{watchdog._KV_PREFIX}/phase/1", "psum", True)
+    wd.start()
+    deadline = _time.monotonic() + 2.0
+    while not exits and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert exits == [watchdog.WATCHDOG_EXIT_CODE]
+    from tpu_operator.validator import status as vstatus
+
+    evidence = vstatus.read_workload_results()["distributed"]
+    assert evidence["fault"]["type"] == "peer-heartbeat-lost"
+    assert evidence["fault"]["dead_members"][0]["process_id"] == 1
+
+
+def test_post_mortem_classifies_killed_despite_harness_timeout():
+    """A fault-SIGKILLed worker that also crossed the harness deadline is
+    'killed', not 'failed' (ADVICE r05, distributed.py): the recorded
+    fault_injected stdout marker proves the direct death, so dead_members
+    cannot under-report on a slow box."""
+    import json as _json
+
+    from tpu_operator.workloads import distributed
+
+    outcomes = [
+        {
+            "process_id": 0, "returncode": 3, "elapsed_s": 1.0, "timed_out": False,
+            "result": {
+                "fault": {
+                    "type": "peer-heartbeat-lost",
+                    "dead_members": [{"process_id": 1}],
+                },
+                "phase": "psum",
+            },
+        },
+        {
+            "process_id": 1, "returncode": -9, "elapsed_s": 5.0, "timed_out": True,
+            "result": None,
+            "stdout_tail": _json.dumps({"fault_injected": "psum", "process_id": 1}),
+        },
+    ]
+    pm = distributed.rendezvous_post_mortem(outcomes)
+    by_id = {w["process_id"]: w for w in pm["workers"]}
+    assert by_id[1]["outcome"] == "killed"
+    assert by_id[1]["timed_out"] is True  # the deadline crossing stays visible
+    assert pm["dead_members"] == [1]
+    assert pm["survivors_failed_bounded"] is True
+
+
+def test_post_mortem_all_hang_is_not_killed():
+    """Contrast case: harness kills at the deadline with NO injected fault
+    (every worker hung) must not masquerade as detected deaths with a
+    vacuously-true bounded verdict."""
+    from tpu_operator.workloads import distributed
+
+    outcomes = [
+        {"process_id": i, "returncode": -9, "elapsed_s": 300.0, "timed_out": True,
+         "result": None, "stdout_tail": ""}
+        for i in range(2)
+    ]
+    pm = distributed.rendezvous_post_mortem(outcomes)
+    assert all(w["outcome"] == "failed" for w in pm["workers"])
+    assert pm["dead_members"] == []
+    assert pm["survivors_failed_bounded"] is None
+
+
+def test_workload_results_tmp_is_per_process(validation_root, monkeypatch):
+    """Concurrent local workers sharing one validation root must not share
+    a tmp file name (ADVICE r05, status.py): the staging name carries the
+    writer's pid; the publish stays an atomic os.replace."""
+    import os as _os
+
+    from tpu_operator.validator import status as vstatus
+
+    seen = []
+    real_replace = _os.replace
+
+    def spy(src, dst):
+        seen.append(src)
+        real_replace(src, dst)
+
+    monkeypatch.setattr(_os, "replace", spy)
+    vstatus.write_workload_results({"probe": {"ok": True}})
+    assert seen and f".{_os.getpid()}.tmp" in seen[0]
+    assert vstatus.read_workload_results()["probe"] == {"ok": True}
+
+
+def test_watchdog_transient_phase_read_failure_defers_verdict(validation_root):
+    """A transient KV error reading a stalled peer's PHASE must defer the
+    death verdict to the next cycle (the read cannot rule out clean
+    completion), not count as 'phase is non-terminal'."""
+    import time as _time
+
+    from tpu_operator.workloads import watchdog
+
+    class _FlakyPhaseKV(_FakeKV):
+        def key_value_try_get(self, key):
+            if "/phase/" in key:
+                raise RuntimeError("UNAVAILABLE: transient RPC error")
+            return super().key_value_try_get(key)
+
+    kv = _FlakyPhaseKV()
+    exits = []
+    wd = watchdog.PeerWatchdog(
+        kv, 0, 2, timeout=0.05, interval=0.01, exit_fn=exits.append
+    )
+    kv.key_value_set(f"{watchdog._KV_PREFIX}/hb/1", "1", True)
+    wd.start()
+    _time.sleep(0.3)
+    wd.stop()
+    assert exits == []

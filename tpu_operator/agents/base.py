@@ -4,12 +4,26 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import re
 import signal
+import sys
 
 
-def setup_logging() -> None:
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+def setup_logging(fmt: str | None = None) -> None:
+    """The one logging entry point for every agent binary.  Structured JSON
+    is opt-in: ``--log-format=json`` on the command line (agents keep their
+    minimal argv surfaces, so this is scanned rather than argparsed) or
+    ``TPU_OPERATOR_LOG_FORMAT=json`` injected by the DaemonSet template."""
+    from tpu_operator import consts
+    from tpu_operator.obs import logging as obs_logging
+
+    if fmt is None:
+        for arg in sys.argv[1:]:
+            if arg.startswith("--log-format="):
+                fmt = arg.split("=", 1)[1]
+        fmt = fmt or os.environ.get(consts.LOG_FORMAT_ENV, obs_logging.FORMAT_TEXT)
+    obs_logging.setup(fmt)
 
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)?$")

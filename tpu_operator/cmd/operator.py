@@ -22,6 +22,9 @@ from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
 from tpu_operator.controllers.runtime import Manager
 from tpu_operator.k8s.client import ApiClient, Config
 from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import logging as obs_logging
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.version import __version__
 
 
@@ -54,13 +57,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--leader-lease-duration", type=_duration, default="15s")
     p.add_argument("--leader-lease-retry-period", type=_duration, default="5s")
     p.add_argument("--zap-log-level", default="info")
+    # structured logging (zap JSON encoder analogue); json records carry the
+    # active reconcile id / controller / operand state from the span context
+    p.add_argument(
+        "--log-format",
+        choices=(obs_logging.FORMAT_TEXT, obs_logging.FORMAT_JSON),
+        default=os.environ.get(consts.LOG_FORMAT_ENV, obs_logging.FORMAT_TEXT),
+    )
     return p.parse_args(argv)
 
 
 async def run(args: argparse.Namespace) -> None:
-    logging.basicConfig(
+    obs_logging.setup(
+        args.log_format,
         level=getattr(logging, args.zap_log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     log = logging.getLogger("tpu_operator")
     log.info("tpu-operator %s starting", __version__)
@@ -68,6 +78,10 @@ async def run(args: argparse.Namespace) -> None:
     namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "tpu-operator")
     client = ApiClient(Config.from_env())
     metrics = OperatorMetrics()
+    # ONE tracer/recorder pair for the whole process so /debug/traces sees
+    # every controller and the Event correlator dedups across them
+    tracer = Tracer(metrics)
+    recorder = EventRecorder(client, namespace)
     mgr = Manager(
         client,
         namespace,
@@ -78,6 +92,7 @@ async def run(args: argparse.Namespace) -> None:
         lease_duration=args.leader_lease_duration,
         renew_interval=args.leader_lease_retry_period,
         renew_deadline=args.leader_lease_renew_deadline,
+        tracer=tracer,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
@@ -85,11 +100,12 @@ async def run(args: argparse.Namespace) -> None:
     from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
-    reconciler = ClusterPolicyReconciler(client, namespace, metrics=metrics)
+    obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
+    reconciler = ClusterPolicyReconciler(client, namespace, **obs)
     reconciler.setup(mgr)
-    TPURuntimeReconciler(client, namespace, metrics=metrics).setup(mgr)
-    UpgradeReconciler(client, namespace, metrics=metrics).setup(mgr)
-    RemediationReconciler(client, namespace, metrics=metrics).setup(mgr)
+    TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
+    UpgradeReconciler(client, namespace, **obs).setup(mgr)
+    RemediationReconciler(client, namespace, **obs).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

@@ -152,6 +152,9 @@ IMAGE_ENVS = {
 # Node-level validation status files (validator/main.go:131-166 analogue).
 VALIDATION_DIR = "/run/tpu/validations"
 VALIDATION_ROOT_ENV = "TPU_VALIDATION_ROOT"  # test seam: relocate /run/tpu
+# structured-log opt-in for entrypoints without a flag surface (agents);
+# binaries with argparse also accept --log-format=json
+LOG_FORMAT_ENV = "TPU_OPERATOR_LOG_FORMAT"
 # ONE root knob: every node-local dir below derives from it
 RUN_TPU_DIR = VALIDATION_DIR.rsplit("/", 1)[0]
 # persistent XLA compilation cache (workload pods mount exactly this dir)

@@ -30,6 +30,9 @@ from tpu_operator.metrics import (
     RECONCILE_NOT_READY,
     RECONCILE_SUCCESS,
 )
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.render import Renderer
 from tpu_operator.state.manager import StateManager, SyncResults
 from tpu_operator.state.skel import SyncState
@@ -45,20 +48,37 @@ class ClusterPolicyReconciler:
         namespace: str,
         renderer: Optional[Renderer] = None,
         metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
     ):
         self.client = client
         self.namespace = namespace
         self.state_manager = StateManager(renderer)
         self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
+        # last observed per-operand sync state, for transition Events —
+        # keyed (policy name, operand) so a recreated or second policy
+        # starts from a clean slate instead of inheriting the old one's
+        self._last_operand_states: dict[tuple[str, str], str] = {}
 
     # ------------------------------------------------------------------
     async def reconcile(self, name: str) -> Optional[float]:
+        with self.tracer.reconcile("clusterpolicy", key=name):
+            return await self._reconcile(name)
+
+    async def _reconcile(self, name: str) -> Optional[float]:
         self.metrics.reconciliation_total.inc()
         try:
             obj = await self.client.get(GROUP, CLUSTER_POLICY_KIND, name)
         except ApiError as e:
             if e.not_found:
-                return None  # deleted; owned objects go via GC
+                # deleted; owned objects go via GC.  Drop the transition
+                # cache so a recreated policy's rollout re-emits its Events.
+                self._last_operand_states = {
+                    k: v for k, v in self._last_operand_states.items() if k[0] != name
+                }
+                return None
             raise
 
         policy = TPUClusterPolicy.from_obj(obj)
@@ -93,10 +113,14 @@ class ClusterPolicyReconciler:
             self.metrics.operand_state.labels(state=r.name).set(
                 -1 if r.state == SyncState.ERROR else (0 if r.state == SyncState.NOT_READY else 1)
             )
+        await self._emit_operand_events(policy, results)
 
         if results.error_states:
             self.metrics.reconciliation_status.set(RECONCILE_FAILED)
             self.metrics.reconciliation_failed_total.inc()
+            await self.recorder.warning(
+                policy.obj, obs_events.REASON_RECONCILE_FAILED, results.message()
+            )
             await self._update_status(policy, State.NOT_READY, results.message())
             # raising lets the workqueue apply exponential backoff
             raise RuntimeError(f"state errors: {results.message()}")
@@ -108,12 +132,49 @@ class ClusterPolicyReconciler:
 
         self.metrics.reconciliation_status.set(RECONCILE_SUCCESS)
         self.metrics.reconciliation_last_success_ts.set(time.time())
+        if deep_get(policy.obj, "status", "state") != State.READY:
+            await self.recorder.normal(
+                policy.obj, obs_events.REASON_POLICY_READY,
+                "all operand states ready",
+            )
         await self._update_status(policy, State.READY, "")
         if ctx.tpu_node_count == 0:
             # Ready but keep polling for TPU nodes appearing without a watch
             # event (NFD-missing 45s poll analogue).
             return consts.REQUEUE_NO_TPU_NODES_SECONDS
         return None
+
+    async def _emit_operand_events(
+        self, policy: TPUClusterPolicy, results: SyncResults
+    ) -> None:
+        """One Event per operand STATE TRANSITION (record.EventRecorder
+        pattern: the reference posts on every operand deploy/readiness
+        change, and the correlator collapses repeats)."""
+        reason_by_state = {
+            SyncState.READY: (self.recorder.normal, obs_events.REASON_OPERAND_READY),
+            SyncState.NOT_READY: (self.recorder.normal, obs_events.REASON_OPERAND_NOT_READY),
+            SyncState.ERROR: (self.recorder.warning, obs_events.REASON_OPERAND_ERROR),
+            SyncState.DISABLED: (self.recorder.normal, obs_events.REASON_OPERAND_DISABLED),
+        }
+        policy_name = deep_get(policy.obj, "metadata", "name", default="")
+        for r in results.results:
+            key = (policy_name, r.name)
+            prev = self._last_operand_states.get(key)
+            if r.state == prev:
+                continue
+            self._last_operand_states[key] = r.state
+            if prev is None and r.state in (SyncState.DISABLED, SyncState.IGNORE):
+                # first pass: a state that was never enabled is not a
+                # transition worth an Event
+                continue
+            post, reason = reason_by_state.get(r.state) or (None, None)
+            if post is None:
+                continue
+            await post(
+                policy.obj, reason,
+                f"operand state {r.name}: {prev or 'unknown'} -> {r.state}"
+                + (f" ({r.message})" if r.message else ""),
+            )
 
     async def _update_status(self, policy: TPUClusterPolicy, state: str, message: str) -> None:
         import copy

@@ -54,6 +54,9 @@ from tpu_operator.controllers.upgrade import (
 )
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.utils import deep_get
 
 log = logging.getLogger("tpu_operator.remediation")
@@ -72,13 +75,21 @@ class RemediationReconciler:
         client: ApiClient,
         namespace: str,
         metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
+        with self.tracer.reconcile("remediation", key=key):
+            return await self._reconcile(key)
+
+    async def _reconcile(self, key: str) -> Optional[float]:
         policy = await self._cluster_policy()
         if policy is None:
             return None
@@ -236,6 +247,23 @@ class RemediationReconciler:
                 "annotations": {consts.REMEDIATION_STATE_TS_ANNOTATION: ts},
             }},
         )
+        # state transitions all funnel through here -> one Event emission point
+        ref = obs_events.node_ref(node_name)
+        if state == REVALIDATING:
+            await self.recorder.normal(
+                ref, obs_events.REASON_REMEDIATION_STARTED,
+                f"re-validation started on {node_name}",
+            )
+        elif state == HEALTHY:
+            await self.recorder.normal(
+                ref, obs_events.REASON_REMEDIATION_HEALTHY,
+                f"re-validation passed on {node_name}",
+            )
+        elif state == FAILED:
+            await self.recorder.warning(
+                ref, obs_events.REASON_REMEDIATION_FAILED,
+                f"re-validation failed on {node_name}",
+            )
 
     async def _clear_request(self, node_name: str) -> None:
         await self.client.patch(

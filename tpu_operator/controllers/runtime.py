@@ -131,6 +131,7 @@ class Manager:
         lease_duration: float = 15.0,
         renew_interval: float = 5.0,
         renew_deadline: Optional[float] = None,
+        tracer=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -138,6 +139,8 @@ class Manager:
         self.health_port = health_port
         self.leader_elect = leader_elect
         self.metrics_registry = metrics_registry
+        # shared obs.trace.Tracer; its ring buffer backs /debug/traces
+        self.tracer = tracer
         # --leader-lease-renew-deadline analogue (cmd/gpu-operator
         # main.go:72-81): operators tune these for flaky control planes
         self.lease_duration = lease_duration
@@ -210,6 +213,7 @@ class Manager:
         health.router.add_get("/readyz", self._readyz)
         metrics = web.Application()
         metrics.router.add_get("/metrics", self._metrics)
+        metrics.router.add_get("/debug/traces", self._traces)
         # one server per port unless they coincide
         apps = {}
         if self.health_port >= 0:
@@ -217,6 +221,7 @@ class Manager:
         if self.metrics_port >= 0:
             if self.metrics_port == self.health_port and self.health_port > 0:
                 health.router.add_get("/metrics", self._metrics)
+                health.router.add_get("/debug/traces", self._traces)
             else:
                 apps[id(metrics)] = (self.metrics_port, metrics)
         for port, app in apps.values():
@@ -244,3 +249,10 @@ class Manager:
 
         data = generate_latest(self.metrics_registry if self.metrics_registry is not None else REGISTRY)
         return web.Response(body=data, content_type="text/plain")
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Recent reconcile span trees (newest first), JSON.  Schema per
+        trace: {name, kind, reconcile_id, start_ts, duration_s, attrs?,
+        error?, children?[...]} — see docs/OBSERVABILITY.md."""
+        traces = self.tracer.snapshot() if self.tracer is not None else []
+        return web.json_response({"traces": traces})

@@ -31,6 +31,9 @@ from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s.apply import create_or_update
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.render import Renderer, new_renderer
 from tpu_operator.state.nodepool import NodePool, get_node_pools, hashed_name
 from tpu_operator.state.render_data import ClusterContext, state_def
@@ -49,14 +52,22 @@ class TPURuntimeReconciler:
         namespace: str,
         renderer: Optional[Renderer] = None,
         metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
     ):
         self.client = client
         self.namespace = namespace
         self.renderer = renderer or new_renderer()
         self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
 
     # ------------------------------------------------------------------
     async def reconcile(self, name: str) -> Optional[float]:
+        with self.tracer.reconcile("tpuruntime", key=name):
+            return await self._reconcile(name)
+
+    async def _reconcile(self, name: str) -> Optional[float]:
         try:
             obj = await self.client.get(GROUP, TPU_RUNTIME_KIND, name)
         except ApiError as e:
@@ -76,6 +87,10 @@ class TPURuntimeReconciler:
 
         conflicts = await self._selector_conflicts(runtime)
         if conflicts:
+            await self.recorder.warning(
+                runtime.obj, obs_events.REASON_SELECTOR_CONFLICT,
+                f"nodeSelector overlaps other TPURuntime CRs on nodes: {conflicts[:3]}",
+            )
             await self._update_status(
                 runtime, State.NOT_READY,
                 f"nodeSelector overlaps other TPURuntime CRs on nodes: {conflicts[:3]}",

@@ -36,6 +36,9 @@ from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.utils import deep_get
 
 log = logging.getLogger("tpu_operator.upgrade")
@@ -88,13 +91,21 @@ class UpgradeReconciler:
         client: ApiClient,
         namespace: str,
         metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
     ):
         self.client = client
         self.namespace = namespace
         self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
+        with self.tracer.reconcile("upgrade", key=key):
+            return await self._reconcile(key)
+
+    async def _reconcile(self, key: str) -> Optional[float]:
         policy = await self._cluster_policy()
         if policy is None:
             return None
@@ -216,6 +227,24 @@ class UpgradeReconciler:
                 "annotations": {consts.UPGRADE_STATE_TS_ANNOTATION: ts},
             }},
         )
+        # milestone Events on the Node — every path into CORDON/DONE/FAILED
+        # funnels through here, so this is the single emission point
+        ref = obs_events.node_ref(node_name)
+        if state == CORDON:
+            await self.recorder.normal(
+                ref, obs_events.REASON_UPGRADE_STARTED,
+                f"runtime upgrade started on {node_name} (cordon -> drain -> swap -> validate)",
+            )
+        elif state == DONE:
+            await self.recorder.normal(
+                ref, obs_events.REASON_UPGRADE_DONE,
+                f"runtime upgrade completed and validated on {node_name}",
+            )
+        elif state == FAILED:
+            await self.recorder.warning(
+                ref, obs_events.REASON_UPGRADE_FAILED,
+                f"runtime upgrade failed on {node_name}; node left cordoned for intervention",
+            )
 
     async def _cordon(self, node_name: str, value: bool) -> None:
         await self.client.patch("", "Node", node_name, {"spec": {"unschedulable": value or None}})

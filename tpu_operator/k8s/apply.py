@@ -17,6 +17,7 @@ from typing import Optional
 from tpu_operator import consts
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.k8s import objects as obj_api
+from tpu_operator.obs import trace
 from tpu_operator.utils import object_hash
 
 log = logging.getLogger("tpu_operator.k8s.apply")
@@ -46,6 +47,21 @@ async def create_or_update(
     - sets the controller ownerReference when an owner is given
     - skips the update entirely when the desired-hash annotation matches
     """
+    with trace.span(
+        f"apply/{obj.get('kind', '')}",
+        kind=trace.KIND_APPLY,
+        object_kind=obj.get("kind", ""),
+        object_name=(obj.get("metadata") or {}).get("name", ""),
+    ):
+        return await _create_or_update(client, obj, owner, state_label)
+
+
+async def _create_or_update(
+    client: ApiClient,
+    obj: dict,
+    owner: Optional[dict],
+    state_label: Optional[str],
+) -> tuple[dict, bool]:
     obj = copy.deepcopy(obj)
     meta = obj.setdefault("metadata", {})
     if state_label:

@@ -21,6 +21,7 @@ from typing import Any, AsyncIterator, Optional
 import aiohttp
 
 from tpu_operator.k8s import objects as obj_api
+from tpu_operator.obs import trace
 
 log = logging.getLogger("tpu_operator.k8s")
 
@@ -167,18 +168,36 @@ class ApiClient:
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = content_type
-        async with sess.request(method, path, params=params, data=data, headers=headers) as resp:
-            text = await resp.text()
-            payload: Any = None
-            if text:
-                try:
-                    payload = json.loads(text)
-                except json.JSONDecodeError:
-                    payload = text
-            if resp.status >= 400:
-                reason = payload.get("reason", resp.reason) if isinstance(payload, dict) else str(resp.reason)
-                raise ApiError(resp.status, str(reason), payload)
-            return payload
+        # no-op unless a tracer is ambient (reconcile pass / activated CLI);
+        # feeds k8s_request_duration_seconds{verb} and the span tree
+        error: Optional[ApiError] = None
+        with trace.span(
+            f"k8s/{method}", kind=trace.KIND_K8S, verb=method, path=path
+        ) as sp:
+            async with sess.request(
+                method, path, params=params, data=data, headers=headers
+            ) as resp:
+                text = await resp.text()
+                payload: Any = None
+                if text:
+                    try:
+                        payload = json.loads(text)
+                    except json.JSONDecodeError:
+                        payload = text
+                if sp is not None:
+                    sp.attrs["status"] = resp.status
+                if resp.status >= 400:
+                    reason = payload.get("reason", resp.reason) if isinstance(payload, dict) else str(resp.reason)
+                    # raised OUTSIDE the span so routine control-flow 4xx
+                    # (get-before-create 404s, status conflicts) don't
+                    # error-flag healthy traces; server-side 5xx is a real
+                    # failure worth surfacing in /debug/traces
+                    error = ApiError(resp.status, str(reason), payload)
+                    if sp is not None and resp.status >= 500:
+                        sp.error = f"ApiError: {error}"
+        if error is not None:
+            raise error
+        return payload
 
     # ------------------------------------------------------------------
     # Typed-by-kind convenience API. All objects are plain dicts

@@ -3,19 +3,28 @@
 Reference analogue: controllers/operator_metrics.go:29-201 — reconciliation
 status/total/failed/last-success gauges+counters, node-count gauge, label
 presence gauge, and the upgrade-state gauge family fed by the upgrade
-controller (gpu_operator_nodes_upgrades_*).
+controller (gpu_operator_nodes_upgrades_*) — plus the duration Histograms
+controller-runtime emits for free in the reference
+(controller_runtime_reconcile_time_seconds and the rest_client families),
+fed here by the span layer in ``tpu_operator/obs/trace.py``.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from prometheus_client import CollectorRegistry, Counter, Gauge
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
 
 # reconciliation_status encodings (operator_metrics.go:52-64)
 RECONCILE_SUCCESS = 1
 RECONCILE_NOT_READY = 0
 RECONCILE_FAILED = -1
+
+# controller-runtime-ish latency buckets: sub-10ms fake-cluster calls up to
+# the 45s no-TPU poll / multi-minute operand rollouts
+DURATION_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
 
 
 class OperatorMetrics:
@@ -72,4 +81,35 @@ class OperatorMetrics:
         )
         self.auto_upgrade_enabled = g(
             "tpu_operator_runtime_auto_upgrade_enabled", "1 when auto-upgrade is on"
+        )
+        # duration Histograms, fed by the obs.trace span layer
+        h = lambda name, doc, label: Histogram(  # noqa: E731
+            name, doc, [label], registry=self.registry, buckets=DURATION_BUCKETS
+        )
+        self.reconcile_duration = h(
+            "tpu_operator_reconcile_duration_seconds",
+            "Reconcile pass duration per controller "
+            "(controller_runtime_reconcile_time_seconds analogue)",
+            "controller",
+        )
+        self.state_sync_duration = h(
+            "tpu_operator_state_sync_duration_seconds",
+            "Per-operand-state sync duration within a reconcile pass",
+            "state",
+        )
+        self.k8s_request_duration = h(
+            "tpu_operator_k8s_request_duration_seconds",
+            "Kubernetes API request latency by verb "
+            "(rest_client_request_duration_seconds analogue)",
+            "verb",
+        )
+        self.apply_duration = h(
+            "tpu_operator_apply_duration_seconds",
+            "create_or_update latency per object kind",
+            "kind",
+        )
+        self.workload_phase_duration = h(
+            "tpu_operator_workload_phase_duration_seconds",
+            "Validator component / workload check phase duration",
+            "phase",
         )

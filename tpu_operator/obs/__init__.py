@@ -1,0 +1,16 @@
+"""Shared observability layer: spans/traces, Kubernetes Events, JSON logs.
+
+The reference operator gets most of this for free from controller-runtime
+(reconcile duration histograms, workqueue metrics) and client-go
+(``record.EventRecorder`` with its dedup correlator); this package is the
+in-tree equivalent every controller and the apply layer report through:
+
+- ``obs.trace``   — context-manager spans with a contextvar-propagated
+  reconcile id, feeding the Prometheus Histograms on ``OperatorMetrics``
+  and an in-memory ring buffer served at ``/debug/traces``.
+- ``obs.events``  — a ``v1/Event`` recorder with client-go-style
+  dedup + count bumping.
+- ``obs.logging`` — structured JSON logging (opt-in via
+  ``--log-format=json``) whose records carry the active reconcile id,
+  controller, and operand state from the span context.
+"""

@@ -1,0 +1,167 @@
+"""Kubernetes EventRecorder: real ``v1/Event`` objects with dedup.
+
+Reference analogue: client-go's ``record.EventRecorder`` + EventCorrelator —
+the reference emits Events on every operand transition and upgrade action;
+repeated identical events bump ``count``/``lastTimestamp`` on the existing
+object instead of flooding etcd.  Here the correlation cache is in-process
+and keyed on (involvedObject, type, reason, message); posting is always
+best-effort — an Event that cannot be written must never fail a reconcile.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import logging
+import uuid
+from collections import OrderedDict
+from typing import Optional
+
+from tpu_operator.k8s.client import ApiClient, ApiError
+
+log = logging.getLogger("tpu_operator.obs.events")
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+# Event reasons (CamelCase like kubelet/client-go conventions).
+REASON_OPERAND_READY = "OperandReady"
+REASON_OPERAND_NOT_READY = "OperandNotReady"
+REASON_OPERAND_ERROR = "OperandError"
+REASON_OPERAND_DISABLED = "OperandDisabled"
+REASON_RECONCILE_FAILED = "ReconcileFailed"
+REASON_POLICY_READY = "Ready"
+REASON_UPGRADE_STARTED = "UpgradeStarted"
+REASON_UPGRADE_DONE = "UpgradeDone"
+REASON_UPGRADE_FAILED = "UpgradeFailed"
+REASON_REMEDIATION_STARTED = "RemediationStarted"
+REASON_REMEDIATION_HEALTHY = "RemediationHealthy"
+REASON_REMEDIATION_FAILED = "RemediationFailed"
+REASON_VALIDATION_FAILED = "ValidationFailed"
+REASON_SELECTOR_CONFLICT = "SelectorConflict"
+
+
+def node_ref(name: str) -> dict:
+    """Minimal involvedObject for a Node event when only the name is at
+    hand (upgrade/remediation state transitions patch by name)."""
+    return {"apiVersion": "v1", "kind": "Node", "metadata": {"name": name}}
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class EventRecorder:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        component: str = "tpu-operator",
+        cache_size: int = 256,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.component = component
+        self.cache_size = cache_size
+        # correlation key -> last posted Event object (live copy)
+        self._cache: OrderedDict[tuple, dict] = OrderedDict()
+
+    # ------------------------------------------------------------------
+    async def normal(self, involved: dict, reason: str, message: str) -> Optional[dict]:
+        return await self.event(involved, TYPE_NORMAL, reason, message)
+
+    async def warning(self, involved: dict, reason: str, message: str) -> Optional[dict]:
+        return await self.event(involved, TYPE_WARNING, reason, message)
+
+    async def event(
+        self, involved: dict, type_: str, reason: str, message: str
+    ) -> Optional[dict]:
+        """Post (or count-bump) an Event.  Never raises: Events are
+        evidence for humans/alerting, not reconcile control flow."""
+        try:
+            return await self._post(involved, type_, reason, message)
+        except Exception as e:  # noqa: BLE001
+            log.warning("dropped event %s/%s: %s", type_, reason, e)
+            return None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _key(involved: dict, type_: str, reason: str, message: str) -> tuple:
+        meta = involved.get("metadata", {}) or {}
+        return (
+            involved.get("kind", ""),
+            meta.get("namespace", ""),
+            meta.get("name", ""),
+            meta.get("uid", ""),
+            type_,
+            reason,
+            message,
+        )
+
+    async def _post(
+        self, involved: dict, type_: str, reason: str, message: str
+    ) -> Optional[dict]:
+        key = self._key(involved, type_, reason, message)
+        cached = self._cache.get(key)
+        if cached is not None:
+            # correlator hit: bump count/lastTimestamp on the live object
+            ev = copy.deepcopy(cached)
+            ev["count"] = int(ev.get("count", 1)) + 1
+            ev["lastTimestamp"] = _now()
+            try:
+                live = await self.client.update(ev)
+                self._cache[key] = live
+                self._cache.move_to_end(key)
+                return live
+            except ApiError as e:
+                if not (e.conflict or e.not_found):
+                    raise
+                # stale cache (Event GC'd or raced); fall through to create
+                self._cache.pop(key, None)
+
+        meta = involved.get("metadata", {}) or {}
+        uid = meta.get("uid", "")
+        if not uid and involved.get("kind") and meta.get("name"):
+            # name-only refs (node_ref from a patch-by-name transition):
+            # fill the uid so kubectl describe's involvedObject.uid field
+            # selector matches (client-go's recorder always carries it);
+            # best-effort — an unresolvable ref still posts by name
+            try:
+                av = involved.get("apiVersion", "")
+                group = av.split("/", 1)[0] if "/" in av else ""
+                live = await self.client.get(
+                    group, involved["kind"], meta["name"], meta.get("namespace")
+                )
+                uid = (live.get("metadata") or {}).get("uid", "")
+            except Exception:  # noqa: BLE001
+                pass
+        now = _now()
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:10]}",
+                "namespace": self.namespace,
+            },
+            "involvedObject": {
+                "apiVersion": involved.get("apiVersion", ""),
+                "kind": involved.get("kind", ""),
+                "name": meta.get("name", ""),
+                "namespace": meta.get("namespace", ""),
+                "uid": uid,
+            },
+            "type": type_,
+            "reason": reason,
+            "message": message[:1024],
+            "source": {"component": self.component},
+            "reportingComponent": self.component,
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+        live = await self.client.create(ev)
+        self._cache[key] = live
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_size:
+            self._cache.popitem(last=False)
+        return live

@@ -1,0 +1,63 @@
+"""Structured JSON logging correlated with the active span context.
+
+Reference analogue: the zap JSON logs controller-runtime managers emit.
+Opt-in via ``--log-format=json`` on the operator/validator binaries and the
+agent entrypoints (or ``TPU_OPERATOR_LOG_FORMAT=json`` for entrypoints
+without a flag surface).  Every JSON record carries the active reconcile
+id, controller, and operand state pulled from ``obs.trace.log_context()``,
+so one reconcile pass is greppable across the whole process's log stream.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+TEXT_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+FORMAT_TEXT = "text"
+FORMAT_JSON = "json"
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        from tpu_operator.obs import trace
+
+        out: dict = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        out.update(trace.log_context())
+        if record.exc_info:
+            out["exc_info"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class _StderrHandler(logging.StreamHandler):
+    """StreamHandler resolving ``sys.stderr`` at EMIT time (the pattern of
+    logging's lastResort handler): a handler pinned to the stderr of setup
+    time breaks when the stream is swapped and closed underneath it —
+    pytest's capture does exactly that per test."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
+def setup(fmt: str = FORMAT_TEXT, level: int = logging.INFO) -> None:
+    """Configure root logging in the requested format.  Replaces existing
+    root handlers (unlike ``basicConfig``) so re-invocation — tests, agent
+    oneshots — deterministically lands on the requested format."""
+    handler = _StderrHandler()
+    handler.setFormatter(
+        JsonFormatter() if fmt == FORMAT_JSON else logging.Formatter(TEXT_FORMAT)
+    )
+    root = logging.getLogger()
+    root.setLevel(level)
+    root.handlers[:] = [handler]

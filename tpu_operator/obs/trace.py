@@ -1,0 +1,228 @@
+"""Lightweight span/trace API with contextvar reconcile-id propagation.
+
+Fills the observability role controller-runtime's built-in instrumentation
+plays for the reference (per-controller reconcile duration histograms,
+controller_runtime_reconcile_* families): every reconcile pass opens a root
+span carrying a fresh reconcile id; nested spans (per-operand-state sync,
+k8s requests, apply calls, validator phases) inherit it through a
+contextvar, so one pass is correlatable across the four controllers, the
+apply layer, and the log stream without threading ids by hand.
+
+Completed spans feed the duration Histograms on ``OperatorMetrics`` (keyed
+by span kind) and completed ROOT spans are serialized into a bounded ring
+buffer the Manager serves as JSON at ``/debug/traces``.
+
+Spans are deliberately synchronous context managers: they only stamp
+timestamps on enter/exit, so wrapping ``await``-ing code is safe — each
+asyncio task carries its own context copy, and set/reset happen within the
+owning task.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from collections import deque
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+# Span kinds — each maps to one Histogram family on OperatorMetrics.
+KIND_RECONCILE = "reconcile"  # reconcile_duration_seconds{controller}
+KIND_STATE = "state"          # state_sync_duration_seconds{state}
+KIND_K8S = "k8s"              # k8s_request_duration_seconds{verb}
+KIND_APPLY = "apply"          # apply_duration_seconds{kind}
+KIND_PHASE = "phase"          # workload_phase_duration_seconds{phase}
+
+DEFAULT_MAX_TRACES = 64
+
+_current_tracer: ContextVar[Optional["Tracer"]] = ContextVar(
+    "tpu_operator_tracer", default=None
+)
+_current_span: ContextVar[Optional["Span"]] = ContextVar(
+    "tpu_operator_span", default=None
+)
+
+
+def new_reconcile_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+@dataclass
+class Span:
+    name: str
+    kind: str = ""
+    attrs: dict = field(default_factory=dict)
+    reconcile_id: str = ""
+    parent: Optional["Span"] = field(default=None, repr=False)
+    start_ts: float = 0.0  # wall clock, for humans reading /debug/traces
+    duration_s: Optional[float] = None
+    error: Optional[str] = None
+    children: list = field(default_factory=list)
+    _t0: float = field(default=0.0, repr=False)
+
+    def to_dict(self) -> dict:
+        d: dict = {
+            "name": self.name,
+            "kind": self.kind,
+            "reconcile_id": self.reconcile_id,
+            "start_ts": round(self.start_ts, 6),
+            "duration_s": self.duration_s,
+        }
+        attrs = {k: v for k, v in self.attrs.items() if v not in (None, "")}
+        if attrs:
+            d["attrs"] = attrs
+        if self.error:
+            d["error"] = self.error
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def reconcile_id() -> str:
+    sp = _current_span.get()
+    return sp.reconcile_id if sp is not None else ""
+
+
+def log_context() -> dict:
+    """The correlation fields a log record should carry: the active
+    reconcile id plus the nearest enclosing controller and operand state,
+    found by walking the span chain upward."""
+    out: dict = {}
+    sp = _current_span.get()
+    while sp is not None:
+        if sp.reconcile_id and "reconcile_id" not in out:
+            out["reconcile_id"] = sp.reconcile_id
+        if sp.kind == KIND_RECONCILE and "controller" not in out:
+            out["controller"] = sp.attrs.get("controller", "")
+        if sp.kind == KIND_STATE and "state" not in out:
+            out["state"] = sp.attrs.get("state", "")
+        sp = sp.parent
+    return out
+
+
+class Tracer:
+    """Span factory + completed-trace ring buffer.
+
+    One Tracer is shared by the manager and every reconciler so a single
+    ``/debug/traces`` endpoint sees all controllers; ``metrics`` (an
+    ``OperatorMetrics``) is optional — spans still form traces without it
+    (standalone validator / workload processes).
+    """
+
+    def __init__(self, metrics=None, max_traces: int = DEFAULT_MAX_TRACES):
+        self.metrics = metrics
+        self.traces: deque = deque(maxlen=max_traces)  # newest first
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def activate(self) -> Iterator["Tracer"]:
+        """Make this tracer ambient for the current context, so the
+        module-level ``span()`` used by library code (k8s client, apply,
+        workload checks) records into it without plumbing."""
+        token = _current_tracer.set(self)
+        try:
+            yield self
+        finally:
+            _current_tracer.reset(token)
+
+    @contextlib.contextmanager
+    def reconcile(self, controller: str, key: str = "") -> Iterator[Span]:
+        """Root span of one reconcile pass; mints the pass's reconcile id."""
+        with self.span(
+            f"reconcile/{controller}",
+            kind=KIND_RECONCILE,
+            reconcile_id=new_reconcile_id(),
+            controller=controller,
+            key=key,
+        ) as sp:
+            yield sp
+
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        kind: str = "",
+        reconcile_id: Optional[str] = None,
+        **attrs,
+    ) -> Iterator[Span]:
+        parent = _current_span.get()
+        rid = reconcile_id or (parent.reconcile_id if parent is not None else "")
+        sp = Span(
+            name=name,
+            kind=kind,
+            attrs=attrs,
+            reconcile_id=rid,
+            parent=parent,
+            start_ts=time.time(),
+            _t0=time.monotonic(),
+        )
+        if parent is not None:
+            parent.children.append(sp)
+        span_token = _current_span.set(sp)
+        tracer_token = _current_tracer.set(self)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.error = f"{type(e).__name__}: {e}"[:500]
+            raise
+        finally:
+            sp.duration_s = round(time.monotonic() - sp._t0, 6)
+            _current_span.reset(span_token)
+            _current_tracer.reset(tracer_token)
+            self._observe(sp)
+            if parent is None:
+                with self._lock:
+                    self.traces.appendleft(sp.to_dict())
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self.traces)
+
+    def _observe(self, sp: Span) -> None:
+        m = self.metrics
+        if m is None or sp.duration_s is None:
+            return
+        try:
+            if sp.kind == KIND_RECONCILE:
+                m.reconcile_duration.labels(
+                    controller=sp.attrs.get("controller", "")
+                ).observe(sp.duration_s)
+            elif sp.kind == KIND_STATE:
+                m.state_sync_duration.labels(
+                    state=sp.attrs.get("state", "")
+                ).observe(sp.duration_s)
+            elif sp.kind == KIND_K8S:
+                m.k8s_request_duration.labels(
+                    verb=sp.attrs.get("verb", "")
+                ).observe(sp.duration_s)
+            elif sp.kind == KIND_APPLY:
+                m.apply_duration.labels(
+                    kind=sp.attrs.get("object_kind", "")
+                ).observe(sp.duration_s)
+            elif sp.kind == KIND_PHASE:
+                m.workload_phase_duration.labels(
+                    phase=sp.attrs.get("phase", "")
+                ).observe(sp.duration_s)
+        except Exception:  # noqa: BLE001 — timing is evidence, not control flow
+            pass
+
+
+@contextlib.contextmanager
+def span(name: str, kind: str = "", **attrs) -> Iterator[Optional[Span]]:
+    """Span on the AMBIENT tracer; yields None (near-zero cost) when no
+    tracer is active — library code (k8s client, apply layer, workload
+    checks) instruments unconditionally and only pays when a reconcile
+    pass or an activated tracer is on the context."""
+    tracer = _current_tracer.get()
+    if tracer is None:
+        yield None
+        return
+    with tracer.span(name, kind=kind, **attrs) as sp:
+        yield sp

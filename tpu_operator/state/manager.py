@@ -13,6 +13,7 @@ from typing import Optional
 
 from tpu_operator.api.types import TPUClusterPolicy
 from tpu_operator.k8s.client import ApiClient
+from tpu_operator.obs import trace
 from tpu_operator.render import Renderer, new_renderer
 from tpu_operator.state.render_data import STATE_DEFS, ClusterContext
 from tpu_operator.state.skel import OperandState, StateResult, SyncState
@@ -64,7 +65,11 @@ class StateManager:
         out = SyncResults()
         for state in self.states:
             try:
-                result = await state.sync(client, ctx, policy)
+                # feeds state_sync_duration_seconds{state} + the span tree
+                with trace.span(
+                    f"state/{state.name}", kind=trace.KIND_STATE, state=state.name
+                ):
+                    result = await state.sync(client, ctx, policy)
             except Exception as e:  # noqa: BLE001
                 log.exception("state %s sync failed", state.name)
                 result = StateResult(state.name, SyncState.ERROR, str(e))

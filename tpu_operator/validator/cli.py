@@ -14,10 +14,14 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import sys
 from typing import Optional
 
 from tpu_operator import consts
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs import logging as obs_logging
+from tpu_operator.obs.trace import Tracer
 from tpu_operator.validator import status
 from tpu_operator.validator.components import ValidationError, Validator, ValidatorConfig
 
@@ -37,11 +41,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--resource-retries", type=int, default=consts.VALIDATOR_RESOURCE_RETRIES)
     p.add_argument("--metrics-port", type=int, default=8000)
     p.add_argument("--oneshot", action="store_true", help="metrics: one scrape pass then exit")
+    p.add_argument(
+        "--log-format",
+        choices=(obs_logging.FORMAT_TEXT, obs_logging.FORMAT_JSON),
+        default=os.environ.get(consts.LOG_FORMAT_ENV, obs_logging.FORMAT_TEXT),
+    )
     return p.parse_args(argv)
 
 
 async def run(args: argparse.Namespace) -> int:
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
+    obs_logging.setup(args.log_format)
     log = logging.getLogger("tpu-validator")
 
     if args.cleanup_all:
@@ -73,16 +82,31 @@ async def run(args: argparse.Namespace) -> int:
         return 0
 
     validator = Validator(config)
+    # ambient tracer: component phases feed span durations even standalone
+    tracer = Tracer()
     try:
-        if args.wait_only:
-            await validator.wait_ready(args.component)
-            log.info("%s-ready present", args.component)
-        else:
-            await validator.run(args.component)
-            log.info("%s validation succeeded", args.component)
+        with tracer.activate():
+            if args.wait_only:
+                await validator.wait_ready(args.component)
+                log.info("%s-ready present", args.component)
+            else:
+                await validator.run(args.component)
+                log.info("%s validation succeeded", args.component)
         return 0
     except ValidationError as e:
         log.error("%s validation failed: %s", args.component, e)
+        # gate failure -> Warning Event on the node (best-effort: the
+        # recorder never raises, and a client may not even exist for
+        # node-local-only components)
+        if validator._client is not None and config.node_name:
+            recorder = obs_events.EventRecorder(
+                validator._client, config.namespace, component="tpu-validator"
+            )
+            await recorder.warning(
+                obs_events.node_ref(config.node_name),
+                obs_events.REASON_VALIDATION_FAILED,
+                f"{args.component} validation failed: {e}",
+            )
         return 1
     finally:
         if validator._client is not None:

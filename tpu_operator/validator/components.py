@@ -31,6 +31,7 @@ from typing import Optional
 
 from tpu_operator import consts, hw
 from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.obs import trace
 from tpu_operator.utils import deep_get
 from tpu_operator.validator import status
 
@@ -254,7 +255,9 @@ class Validator:
         if handler is None:
             raise ValidationError(f"invalid component {component!r}; one of {self.COMPONENTS}")
         status.clear(component)
-        await handler()
+        # feeds workload_phase_duration_seconds{phase} when a tracer is ambient
+        with trace.span(f"validate/{component}", kind=trace.KIND_PHASE, phase=component):
+            await handler()
 
     async def wait_ready(self, component: str, retries: Optional[int] = None) -> None:
         """--wait-only: block until another pod's validation wrote the file

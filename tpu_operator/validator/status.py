@@ -123,7 +123,11 @@ def write_workload_results(results: dict, scope: str = "") -> None:
     try:
         path = workload_results_path(scope)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
+        # per-process tmp name: local workers sharing one validation root
+        # (spawn_local_workers, single-host multislice dryrun) must not
+        # interleave writes inside one shared tmp file; os.replace keeps
+        # the publish itself atomic, last writer wins whole-file
+        tmp = path + f".{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump({"ts": time.time(), **results}, f)
         os.replace(tmp, path)

@@ -316,7 +316,11 @@ def _run_checks(
     )
     ep_ok = bool(ep["ok"])
 
-    _enter_phase(wd, "done", process_id)
+    from tpu_operator.workloads.watchdog import TERMINAL_PHASE
+
+    # publishing the terminal phase BEFORE returning is what lets peers'
+    # watchdogs tell "finished and stopped beating" from "died mid-run"
+    _enter_phase(wd, TERMINAL_PHASE, process_id)
     return {
         "ok": (psum_ok and finite and decreasing and bw_ok and ring_ok
                and ra_ok and ep_ok),
@@ -552,8 +556,17 @@ def rendezvous_post_mortem(outcomes: list[dict]) -> dict:
             # (SIGABRT), but this worker was a victim, not the fault
             kind = "aborted-coordinator-loss"
             named_dead.add(0)
-        elif rc is not None and rc < 0 and not o.get("timed_out"):
-            kind = "killed"  # the injected fault itself (SIGKILL)
+        elif rc is not None and rc < 0 and (
+            not o.get("timed_out")
+            or '"fault_injected"' in (o.get("stdout_tail") or "")
+        ):
+            # the injected fault itself (SIGKILL).  A fault-killed worker
+            # whose drain also crossed the harness deadline is still a
+            # direct death — its fault_injected stdout marker proves it —
+            # so dead_members cannot under-report on a slow box.  But a
+            # harness kill of a worker that merely HUNG (timed_out, no
+            # marker) is not a death to attribute survivors' exits to.
+            kind = "killed"
             directly_dead.add(o["process_id"])
         else:
             kind = "failed"

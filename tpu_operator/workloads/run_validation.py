@@ -256,24 +256,35 @@ def main() -> int:
         budget = 0.0
     t_start = time.monotonic()
 
+    # local tracer so each check runs under a phase span: per-check
+    # durations land in the printed/drop-boxed evidence here, and in the
+    # workload_phase_duration histogram when run in an instrumented process
+    from tpu_operator.obs import trace
+
+    tracer = trace.Tracer()
     runners = check_runners()
-    for check in checks:
-        runner = runners.get(check)
-        if runner is None:
-            # validate the NAME even past the budget: a typo'd check must
-            # fail the pod, never be masked as a benign budget skip
-            result = {"ok": False, "error": f"unknown check {check}"}
-        elif budget and time.monotonic() - t_start > budget:
-            # chip-occupancy budget exhausted: remaining checks are
-            # SKIPPED evidence, not failures — the operator chose the
-            # budget; a probe that didn't run says nothing bad about
-            # the hardware
-            result = {"ok": True, "skipped": f"budget ({budget}s) exhausted"}
-        else:
-            result = runner()
-        print(json.dumps({"check": check, **result}), flush=True)
-        results[check] = result
-        ok = ok and bool(result.get("ok"))
+    with tracer.activate():
+        for check in checks:
+            runner = runners.get(check)
+            if runner is None:
+                # validate the NAME even past the budget: a typo'd check must
+                # fail the pod, never be masked as a benign budget skip
+                result = {"ok": False, "error": f"unknown check {check}"}
+            elif budget and time.monotonic() - t_start > budget:
+                # chip-occupancy budget exhausted: remaining checks are
+                # SKIPPED evidence, not failures — the operator chose the
+                # budget; a probe that didn't run says nothing bad about
+                # the hardware
+                result = {"ok": True, "skipped": f"budget ({budget}s) exhausted"}
+            else:
+                with trace.span(
+                    f"check/{check}", kind=trace.KIND_PHASE, phase=check
+                ) as sp:
+                    result = runner()
+                result.setdefault("duration_s", sp.duration_s)
+            print(json.dumps({"check": check, **result}), flush=True)
+            results[check] = result
+            ok = ok and bool(result.get("ok"))
 
     # node-local drop-box: the validator (mounting the same /run/tpu) merges
     # the measured numbers into its payloads → node-status exporter → the

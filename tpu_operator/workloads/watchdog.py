@@ -49,6 +49,11 @@ WATCHDOG_EXIT_CODE = 3
 
 DEFAULT_TIMEOUT_S = 20.0
 
+# the phase a worker publishes after its last check completes
+# (workloads/distributed.py run_worker); a peer parked here has exited
+# CLEANLY — its heartbeat stopping is success, not death
+TERMINAL_PHASE = "done"
+
 
 class PeerWatchdog:
     """Heartbeat-based peer liveness for one rendezvous.
@@ -123,11 +128,16 @@ class PeerWatchdog:
             f"{_KV_PREFIX}/hb/{self.process_id}", str(self._beat), True
         )
 
-    def _peer_phase(self, peer: int) -> Optional[str]:
+    # sentinel: the phase READ failed (transient KV error) — distinct from
+    # "peer never published a phase" (None); a cycle that cannot rule out
+    # clean completion must not declare death
+    _PHASE_UNKNOWN = object()
+
+    def _peer_phase(self, peer: int):
         try:
             return self.client.key_value_try_get(f"{_KV_PREFIX}/phase/{peer}")
-        except Exception:  # noqa: BLE001
-            return None
+        except Exception as e:  # noqa: BLE001
+            return None if "NOT_FOUND" in str(e) else self._PHASE_UNKNOWN
 
     def _write_inflight(self) -> None:
         from tpu_operator.validator import status as vstatus
@@ -196,11 +206,21 @@ class PeerWatchdog:
                 stale_since = prev[1] if prev else self._started
                 stale_for = now - stale_since
                 if stale_for > self.timeout:
+                    phase = self._peer_phase(peer)
+                    if phase == TERMINAL_PHASE or phase is self._PHASE_UNKNOWN:
+                        # cleanly-exited peer: it published 'done' before its
+                        # heartbeat stopped.  A survivor still mid-run (slow
+                        # host, longer check list) must not hard-kill its own
+                        # healthy validation over a finished sibling — and
+                        # when the phase read itself failed transiently, this
+                        # cycle cannot rule clean completion out, so the
+                        # verdict waits for the next healthy read.
+                        continue
                     dead.append(
                         {
                             "process_id": peer,
                             "stale_for_s": round(stale_for, 3),
-                            "phase": self._peer_phase(peer),
+                            "phase": phase,
                         }
                     )
             if kv_healthy:
