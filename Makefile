@@ -2,12 +2,18 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench lint counters-docs all image e2e-kind
 
 all: proto manifests test
 
-# default test target = lint gate + the tier-1 pytest line CI runs
-test: lint unit-test
+# default test target = lint gate + counter-catalogue drift check + the
+# tier-1 pytest line CI runs
+test: lint counters-docs unit-test
+
+# the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
+# and the docs/OBSERVABILITY.md catalogue may never drift
+counters-docs:
+	$(PYTHON) hack/check_counter_docs.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal

@@ -26,8 +26,10 @@ vs_baseline < 1.0 beats the reference budget (lower is better).
 from __future__ import annotations
 
 import asyncio
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -40,13 +42,19 @@ NS = "tpu-operator"
 
 # prior rounds' headline numbers, carried in the output so regressions are
 # visible round-over-round (the r01→r02 allreduce drop went unnoticed
-# because nothing juxtaposed them)
+# because nothing juxtaposed them).  This table is the BACKSTOP; the
+# regression detector below prefers the richer in-tree BENCH_r*.json
+# records and falls back here for rounds whose JSON is unrecoverable.
 PRIOR_ROUNDS = {
     "r01": {"join_s": 21.236, "allreduce_gbps": 7.20},
     "r02": {"join_s": 22.883, "allreduce_gbps": 5.81},
     "r03": {"join_s": 29.133, "allreduce_gbps": 5.84},
     "r04": {"join_s": 12.028, "allreduce_gbps": 6.97},
 }
+
+# metrics where a LOWER number is the improvement (times); everything else
+# compared higher-is-better
+LOWER_IS_BETTER = {"join_to_validated_s", "join_to_schedulable_s", "revalidation_s"}
 
 # populated by _exec_workload_pod as the fake kubelet executes the real
 # validation workload: one parsed JSON result per check
@@ -105,6 +113,22 @@ def _run_bench_module(module: str, timeout: float = 400) -> dict:
     env = {**os.environ}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["TPU_COMPILE_CACHE"] = "0"  # see _exec_workload_pod: tunnel artifact
+    # per-module flight record (obs.flight): per-step samples land beside
+    # the validation run's records under the bench's validation root.
+    # Recorders append-only, so clear the previous run's record before the
+    # subprocess starts — but ONLY for the path this launcher owns; an
+    # externally-set TPU_FLIGHT_RECORD is the caller's live record and is
+    # never deleted here
+    if "TPU_FLIGHT_RECORD" not in env:
+        env["TPU_FLIGHT_RECORD"] = os.path.join(
+            os.environ.get("TPU_VALIDATION_ROOT", "/tmp/tpu-bench-run"),
+            "workload-results",
+            f"flight-bench-{module.rsplit('.', 1)[-1]}.jsonl",
+        )
+        try:
+            os.remove(env["TPU_FLIGHT_RECORD"])
+        except OSError:
+            pass
     try:
         result = subprocess.run(
             [sys.executable, "-m", module],
@@ -192,6 +216,160 @@ def run_train_bench() -> dict:
         "tpu_operator.workloads.train_bench", "tokens_per_sec",
         "tokens_per_sec_runs", timeout=560,
     )
+
+
+def _bench_metrics(output: dict) -> dict:
+    """Flat comparable metric map from one round's printed bench JSON (the
+    shape main() emits; prior rounds' files carry the same)."""
+    detail = output.get("detail") or {}
+    matmul = detail.get("matmul") or {}
+    metrics: dict = {}
+
+    def put(key, value):
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            metrics[key] = value
+
+    put("join_to_validated_s", output.get("value"))
+    put("join_to_schedulable_s", detail.get("join_to_schedulable_s"))
+    put("revalidation_s", detail.get("revalidation_s"))
+    put("tflops", output.get("tflops") or matmul.get("tflops"))
+    put("mfu", output.get("mfu") or matmul.get("mfu"))
+    put("allreduce_gbps", (detail.get("allreduce") or {}).get("algbw_gbps"))
+    put("hbm_gbps", (detail.get("hbm") or {}).get("gbps"))
+    put("train_tokens_per_sec", (detail.get("train") or {}).get("tokens_per_sec"))
+    put("train_mfu", (detail.get("train") or {}).get("train_mfu"))
+    return metrics
+
+
+def _balanced_object(text: str, start: int):
+    """The balanced ``{...}`` starting at ``text[start]``; None when the
+    object runs past the end of the (truncated) text."""
+    depth = 0
+    in_str = esc = False
+    for i in range(start, len(text)):
+        c = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+            continue
+        if c == '"':
+            in_str = True
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i + 1]
+    return None
+
+
+def _scavenge_tail(tail: str):
+    """Partial metrics recovered from a FRONT-truncated stdout tail — the
+    shape BENCH_r04/r05 actually carry (``parsed`` null, the JSON line's
+    head cut off, so find('{"metric"') can never work).  Brace-match the
+    named detail sub-objects that survived the truncation; whatever parses
+    contributes to the prior-round baseline instead of silently dropping
+    the newest rounds from the comparison."""
+    detail: dict = {}
+    for key in ("matmul", "hbm", "allreduce", "train"):
+        m = re.search(r'"%s": *\{' % key, tail)
+        if not m:
+            continue
+        obj = _balanced_object(tail, m.end() - 1)
+        if obj is None:
+            continue
+        try:
+            detail[key] = json.loads(obj)
+        except json.JSONDecodeError:
+            continue
+    if not detail:
+        return None
+    parsed: dict = {"detail": detail}
+    m = re.search(
+        r'"metric": *"node_join_to_validated_seconds", *"value": *([0-9.]+)', tail
+    )
+    if m:
+        parsed["value"] = float(m.group(1))
+    return parsed
+
+
+def load_prior_rounds() -> dict:
+    """Round name → flat metrics, from the in-tree BENCH_r*.json records
+    (their ``parsed`` output when present, else the JSON line — or named
+    sub-objects — recovered from ``tail``), over the PRIOR_ROUNDS
+    backstop table.  Unrecoverable rounds are announced, not silently
+    skipped: a verdict computed against a stale round must say so."""
+    rounds: dict = {
+        name: {
+            "join_to_validated_s": vals["join_s"],
+            "allreduce_gbps": vals["allreduce_gbps"],
+        }
+        for name, vals in PRIOR_ROUNDS.items()
+    }
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        name = os.path.basename(path)[len("BENCH_"):-len(".json")]
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = record.get("parsed")
+        if not isinstance(parsed, dict):
+            # some rounds carry only a front-truncated stdout tail
+            tail = record.get("tail") or ""
+            start = tail.find('{"metric"')
+            if start >= 0:
+                try:
+                    parsed = json.loads(tail[start:])
+                except json.JSONDecodeError:
+                    parsed = None
+            if not isinstance(parsed, dict):
+                parsed = _scavenge_tail(tail)
+        metrics = _bench_metrics(parsed) if isinstance(parsed, dict) else {}
+        if metrics:
+            rounds[name] = {**rounds.get(name, {}), **metrics}
+        elif name not in rounds:
+            print(
+                f"  bench: prior round {name} unrecoverable; verdicts fall "
+                "back to older rounds for its metrics",
+                file=sys.stderr,
+            )
+    return rounds
+
+
+def regression_report(current: dict, rounds: dict) -> dict:
+    """Per-metric verdict (improved/flat/regressed, shared rule:
+    workloads/timing.regression_verdict) for the fresh run against the
+    LATEST prior round that recorded each metric — round-over-round drops
+    are caught by construction instead of by a reader juxtaposing files.
+    BENCH_REGRESSION_THRESHOLD overrides the 7% band."""
+    from tpu_operator.workloads.timing import regression_verdict
+
+    try:
+        threshold = float(os.environ.get("BENCH_REGRESSION_THRESHOLD", "") or 0.07)
+    except ValueError:
+        threshold = 0.07
+    report: dict = {}
+    for metric, value in sorted(current.items()):
+        prior_round = next(
+            (r for r in sorted(rounds, reverse=True) if metric in rounds[r]),
+            None,
+        )
+        if prior_round is None:
+            continue
+        verdict = regression_verdict(
+            value,
+            rounds[prior_round][metric],
+            threshold=threshold,
+            higher_is_better=metric not in LOWER_IS_BETTER,
+        )
+        if verdict is not None:
+            report[metric] = {"vs": prior_round, **verdict}
+    return report
 
 
 async def bench() -> dict:
@@ -373,19 +551,28 @@ def main() -> None:
         },
         "prior_rounds": PRIOR_ROUNDS,
     }
-    print(
-        json.dumps(
-            {
-                "metric": "node_join_to_validated_seconds",
-                "value": value,
-                "unit": "s",
-                "vs_baseline": round(value / BASELINE_SECONDS, 5),
-                "tflops": round(matmul.get("tflops") or 0.0, 2),
-                "mfu": matmul.get("mfu"),
-                "detail": detail,
-            }
+    output = {
+        "metric": "node_join_to_validated_seconds",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(value / BASELINE_SECONDS, 5),
+        "tflops": round(matmul.get("tflops") or 0.0, 2),
+        "mfu": matmul.get("mfu"),
+        "detail": detail,
+    }
+    # per-metric verdicts vs the in-tree prior rounds — the detector that
+    # makes an r01→r02-style drop impossible to miss: human-readable lines
+    # on stderr, machine-readable in the output JSON
+    report = regression_report(_bench_metrics(output), load_prior_rounds())
+    detail["regression"] = report
+    for metric, entry in report.items():
+        print(
+            f"  verdict {metric}: {entry['verdict']} vs {entry['vs']} "
+            f"({entry['prior']:.4g} -> {entry['current']:.4g}, "
+            f"{entry['delta_pct']:+.1f}%)",
+            file=sys.stderr,
         )
-    )
+    print(json.dumps(output))
 
 
 if __name__ == "__main__":

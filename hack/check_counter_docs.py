@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Counter-catalogue drift check (make counters-docs).
+
+The telemetry surface is the COUNTERS + WORKLOAD_COUNTERS tuples in
+tpu_operator/agents/metrics_agent.py; docs/OBSERVABILITY.md catalogues it
+for operators.  The two must not drift: every counter in code must appear
+in the docs, and every ``tpu_duty…``/``tpu_workload…``-style counter the
+docs catalogue must exist in code (a renamed counter must rename its row,
+not strand it).  Exits non-zero listing the drift.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+# metric families documented elsewhere in the file (operator histograms,
+# validator gauges) are not part of the agent counter catalogue
+_NON_AGENT_PREFIXES = ("tpu_operator_", "tpu_validator_")
+
+
+def main() -> int:
+    from tpu_operator.agents.metrics_agent import COUNTERS, WORKLOAD_COUNTERS
+
+    in_code = set(COUNTERS) | set(WORKLOAD_COUNTERS)
+    with open(DOCS) as f:
+        text = f.read()
+    documented = {
+        name
+        for name in re.findall(r"\btpu_[a-z0-9_]+\b", text)
+        if not name.startswith(_NON_AGENT_PREFIXES)
+        # the catalogue documents counters, not module paths like
+        # tpu_operator/agents — the prefix filter plus the counter
+        # vocabulary below keeps prose out
+        and (name in in_code or re.match(r"tpu_(workload|hbm|ici|duty|tensorcore)_", name))
+    }
+    missing_from_docs = sorted(in_code - documented)
+    missing_from_code = sorted(documented - in_code)
+    if missing_from_docs:
+        print("counters missing from docs/OBSERVABILITY.md:")
+        for name in missing_from_docs:
+            print(f"  {name}")
+    if missing_from_code:
+        print("documented counters absent from metrics_agent tuples:")
+        for name in missing_from_code:
+            print(f"  {name}")
+    if missing_from_docs or missing_from_code:
+        return 1
+    print(
+        f"counters-docs: {len(in_code)} counters in sync "
+        f"({len(COUNTERS)} chip + {len(WORKLOAD_COUNTERS)} workload)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

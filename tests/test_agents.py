@@ -107,6 +107,191 @@ def test_exporter_allowlist(tmp_path):
     assert "tpu_hbm_memory_usage_bytes" not in text
 
 
+async def test_push_to_agent_reexported_by_exporter(hw4, monkeypatch):
+    """The workload telemetry pipeline (ISSUE 2): POST /push → agent
+    /metrics serves source="workload" series → exporter re-exports them
+    with the node label, through the allowlist."""
+    from tpu_operator.agents import metrics_agent, metrics_exporter
+
+    monkeypatch.setenv("NODE_NAME", "tpu-node-0")
+    stop = asyncio.Event()
+    agent_task = asyncio.create_task(metrics_agent.serve(15556, stop, cache_ttl=0.0))
+    exp_task = asyncio.create_task(metrics_exporter.serve(19401, 15556, stop))
+    try:
+        await asyncio.sleep(0.2)
+        async with aiohttp.ClientSession() as http:
+            body = {
+                "source": "workload",
+                "workloads": {
+                    "matmul": {"counters": {
+                        "tpu_workload_achieved_tflops": 187.5,
+                        "tpu_workload_mfu": 0.95,
+                        "tpu_workload_steps_total": 3,
+                        "not_a_known_counter": 1.0,
+                    }},
+                    "train": {"counters": {
+                        "tpu_workload_tokens_per_sec": 120000.0,
+                    }},
+                },
+            }
+            async with http.post("http://127.0.0.1:15556/push", json=body) as r:
+                assert r.status == 200
+                assert (await r.json())["accepted"] == 2
+            async with http.get("http://127.0.0.1:15556/metrics") as r:
+                text = await r.text()
+            assert (
+                'tpu_workload_achieved_tflops{source="workload",workload="matmul"} 187.5'
+                in text
+            )
+            assert '# TYPE tpu_workload_steps_total counter' in text
+            assert '# HELP tpu_workload_mfu' in text
+            assert "not_a_known_counter" not in text
+            # chip series keep their exact shape alongside
+            assert 'tpu_duty_cycle_percent{chip="0"} 0.0' in text
+            async with http.get("http://127.0.0.1:19401/metrics") as r:
+                text = await r.text()
+            assert (
+                'tpu_workload_tokens_per_sec{node="tpu-node-0",'
+                'source="workload",workload="train"} 120000.0' in text
+            )
+            # the exporter's counter allowlist applies to workload series too
+            snapshot = await metrics_agent.collect()
+            snapshot["workloads"] = {"matmul": {"tpu_workload_mfu": 0.9}}
+            filtered = metrics_exporter.render(
+                snapshot, "n1", {"tpu_workload_mfu"}
+            )
+            assert 'tpu_workload_mfu' in filtered
+            assert "tpu_duty_cycle_percent" not in filtered
+            # malformed pushes are client errors, not crashes
+            async with http.post(
+                "http://127.0.0.1:15556/push", data=b"not json"
+            ) as r:
+                assert r.status == 400
+            async with http.post(
+                "http://127.0.0.1:15556/push", json={"workloads": "nope"}
+            ) as r:
+                assert r.status == 400
+    finally:
+        stop.set()
+        await asyncio.gather(agent_task, exp_task, return_exceptions=True)
+
+
+def test_push_store_ttl_expiry_merge_and_cap():
+    from tpu_operator.agents.metrics_agent import PushStore
+
+    store = PushStore(ttl=60)
+    assert store.push({"matmul": {"counters": {"tpu_workload_compile_seconds": 1.5}}}) == 1
+    # later windows MERGE: a counter recorded once must survive pushes
+    # that no longer carry it
+    assert store.push({"matmul": {"counters": {"tpu_workload_mfu": 0.5}}}) == 1
+    assert store.snapshot()["matmul"] == {
+        "tpu_workload_compile_seconds": 1.5,
+        "tpu_workload_mfu": 0.5,
+    }
+    # a workload that stopped pushing drops off after the TTL
+    store._entries["matmul"]["ts"] -= 61
+    assert store.snapshot() == {}
+    # series-cardinality cap: names past max_workloads are dropped, not grown
+    capped = PushStore(ttl=60, max_workloads=2)
+    pushed = capped.push(
+        {f"w{i}": {"counters": {"tpu_workload_mfu": 0.1}} for i in range(5)}
+    )
+    assert pushed == 2
+    assert len(capped.snapshot()) == 2
+
+
+def test_to_prometheus_help_and_label_escaping():
+    from tpu_operator.agents.metrics_agent import to_prometheus
+
+    snapshot = {"chips": {0: {"tpu_duty_cycle_percent": 1.0}}}
+    text = to_prometheus(snapshot, extra_labels={"node": 'we"ird\\node\nname'})
+    assert "# HELP tpu_duty_cycle_percent" in text
+    assert "# TYPE tpu_duty_cycle_percent gauge" in text
+    # exposition-format escaping: backslash, quote, newline — and no raw
+    # newline may leak out of a label into the exposition structure
+    assert 'node="we\\"ird\\\\node\\nname"' in text
+    assert all(
+        line.startswith(("#", "tpu_")) for line in text.splitlines() if line
+    )
+
+
+async def test_agent_ttl_cache_single_flight(hw4, monkeypatch):
+    """Concurrent scrapers inside the TTL window share ONE collect() pass
+    (the refresh lock restores the shared-sampler contract)."""
+    import time as time_mod
+
+    from tpu_operator.agents import metrics_agent
+
+    calls = 0
+
+    async def fake_collect(push_store=None):
+        nonlocal calls
+        calls += 1
+        await asyncio.sleep(0.05)
+        return {"ts": time_mod.time(), "chips": {0: {}}, "workloads": {}}
+
+    monkeypatch.setattr(metrics_agent, "collect", fake_collect)
+    stop = asyncio.Event()
+    task = asyncio.create_task(metrics_agent.serve(15557, stop, cache_ttl=30.0))
+    try:
+        await asyncio.sleep(0.2)
+        async with aiohttp.ClientSession() as http:
+            async def scrape():
+                async with http.get("http://127.0.0.1:15557/counters") as r:
+                    return await r.json()
+
+            results = await asyncio.gather(*(scrape() for _ in range(8)))
+        assert all("chips" in r for r in results)
+        assert calls == 1, "TTL window must collapse concurrent scrapes"
+    finally:
+        stop.set()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+async def test_exporter_falls_back_past_slow_agent(hw4, monkeypatch):
+    """An agent that hangs past the 2 s fetch budget must not wedge the
+    exporter: /metrics falls back to direct collection (which itself stays
+    fast — unreachable chip endpoints are scraped concurrently)."""
+    import time as time_mod
+
+    from tpu_operator.agents import metrics_exporter
+    from aiohttp import web
+
+    # one unreachable runtime endpoint: connection refused, instant
+    monkeypatch.setenv("TPU_RUNTIME_METRICS_PORTS", "19999")
+    monkeypatch.setenv("NODE_NAME", "tpu-node-0")
+
+    async def hang(request):
+        await asyncio.sleep(10)
+        return web.json_response({})
+
+    slow_app = web.Application()
+    slow_app.router.add_get("/counters", hang)
+    runner = web.AppRunner(slow_app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 15558)
+    await site.start()
+    stop = asyncio.Event()
+    task = asyncio.create_task(metrics_exporter.serve(19402, 15558, stop))
+    try:
+        await asyncio.sleep(0.2)
+        t0 = time_mod.monotonic()
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                "http://127.0.0.1:19402/metrics",
+                timeout=aiohttp.ClientTimeout(total=15),
+            ) as r:
+                text = await r.text()
+        elapsed = time_mod.monotonic() - t0
+        # 2 s agent budget + fast direct collection, nowhere near the 10 s hang
+        assert elapsed < 8, f"fallback took {elapsed:.1f}s"
+        assert 'tpu_duty_cycle_percent{node="tpu-node-0",chip="0"} 0.0' in text
+    finally:
+        stop.set()
+        await asyncio.gather(task, return_exceptions=True)
+        await runner.cleanup()
+
+
 # ---------------------------------------------------------------------------
 # runtime chain
 

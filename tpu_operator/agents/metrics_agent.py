@@ -5,10 +5,14 @@ hostPort that the exporter scrapes, so multiple consumers share one sampler.
 
 Counter sources, in order: the per-chip libtpu runtime metrics endpoints
 (localhost:8431+i, the ports the device plugin advertises via
-TPU_RUNTIME_METRICS_PORTS), else a zeroed counter set per discovered chip so
-the scrape pipeline stays shape-stable on idle/virtual hosts.
+TPU_RUNTIME_METRICS_PORTS) scraped CONCURRENTLY, else a zeroed counter set
+per discovered chip so the scrape pipeline stays shape-stable on
+idle/virtual hosts — plus whatever live workload telemetry has been pushed
+to ``/push`` (the obs.flight recorder's sink), re-exported as
+``source="workload"`` series alongside the chip counters.
 
-Serves JSON at /counters and Prometheus text at /metrics.
+Serves JSON at /counters, Prometheus text at /metrics, and accepts workload
+counter pushes at POST /push.
 """
 
 from __future__ import annotations
@@ -37,6 +41,39 @@ COUNTERS = (
     "tpu_ici_received_bytes_total",
 )
 
+# workload telemetry counters accepted on /push (fed by obs.flight
+# recorders inside validation/bench workloads); exported with
+# source="workload" + workload labels next to the per-chip series
+WORKLOAD_COUNTERS = (
+    "tpu_workload_step_duration_seconds",
+    "tpu_workload_compile_seconds",
+    "tpu_workload_achieved_gbps",
+    "tpu_workload_achieved_tflops",
+    "tpu_workload_mfu",
+    "tpu_workload_tokens_per_sec",
+    "tpu_workload_overhead_dominated",
+    "tpu_workload_steps_total",
+)
+
+# HELP text per counter: the exposition format wants a # HELP line per
+# family, and operators reading a raw scrape deserve better than a name
+COUNTER_HELP = {
+    "tpu_duty_cycle_percent": "Percent of time the TPU core was active",
+    "tpu_tensorcore_utilization_percent": "TensorCore (MXU) utilization percent",
+    "tpu_hbm_memory_total_bytes": "Total HBM capacity in bytes",
+    "tpu_hbm_memory_usage_bytes": "HBM bytes currently in use",
+    "tpu_ici_transmitted_bytes_total": "Bytes transmitted over ICI since runtime start",
+    "tpu_ici_received_bytes_total": "Bytes received over ICI since runtime start",
+    "tpu_workload_step_duration_seconds": "Last workload step wall time in seconds",
+    "tpu_workload_compile_seconds": "Workload compile (warmup) wall time in seconds",
+    "tpu_workload_achieved_gbps": "Workload-achieved bandwidth in GB/s",
+    "tpu_workload_achieved_tflops": "Workload-achieved compute in TFLOP/s",
+    "tpu_workload_mfu": "Workload model-flops utilization (0-1)",
+    "tpu_workload_tokens_per_sec": "Workload training/serving throughput in tokens/s",
+    "tpu_workload_overhead_dominated": "1 when the workload measurement was overhead-dominated",
+    "tpu_workload_steps_total": "Workload telemetry samples recorded",
+}
+
 
 async def scrape_runtime_endpoint(session: aiohttp.ClientSession, port: int) -> dict:
     """One chip's libtpu runtime metrics endpoint (Prometheus text)."""
@@ -59,10 +96,70 @@ async def scrape_runtime_endpoint(session: aiohttp.ClientSession, port: int) -> 
 BASE_METRICS_PORT = 8431  # device plugin advertises 8431 + chip_index
 
 
-async def collect() -> dict:
+class PushStore:
+    """Live workload counters pushed by obs.flight recorders.
+
+    Entries expire after ``ttl`` seconds: a workload that stopped pushing
+    (finished, crashed) must drop off /metrics instead of freezing its last
+    figures there forever.  Unknown counter names are rejected — the
+    exported surface is the WORKLOAD_COUNTERS catalogue, which the docs
+    drift-check (hack/check_counter_docs.py) pins.  Distinct workload
+    names are capped (``max_workloads``): the port is an unauthenticated
+    hostPort, and workload label values arrive from the network — without
+    a cap a chatty or hostile client could grow agent memory and
+    Prometheus series cardinality without bound."""
+
+    MAX_WORKLOADS = 64
+
+    def __init__(self, ttl: float = 300.0, max_workloads: int = MAX_WORKLOADS):
+        self.ttl = ttl
+        self.max_workloads = max_workloads
+        self._entries: dict[str, dict] = {}  # workload -> {ts, counters}
+
+    def push(self, workloads: dict) -> int:
+        accepted = 0
+        now = time.time()
+        for workload, entry in workloads.items():
+            if not isinstance(entry, dict):
+                continue
+            counters = {
+                k: float(v)
+                for k, v in (entry.get("counters") or {}).items()
+                if k in WORKLOAD_COUNTERS and isinstance(v, (int, float))
+            }
+            if not counters:
+                continue
+            name = str(workload)
+            if name not in self._entries and len(self._entries) >= self.max_workloads:
+                # prune expired entries first; past the cap, new names are
+                # dropped rather than growing the series set unboundedly
+                self.snapshot()
+                if len(self._entries) >= self.max_workloads:
+                    continue
+            # MERGE over the live entry: push windows carry only what
+            # changed since the last POST (the recorder drains pending),
+            # so a counter recorded once — compile_s — must survive later
+            # windows, not vanish mid-run before the TTL says so
+            live = self._entries.setdefault(name, {"ts": now, "counters": {}})
+            live["ts"] = now
+            live["counters"].update(counters)
+            accepted += 1
+        return accepted
+
+    def snapshot(self) -> dict[str, dict]:
+        now = time.time()
+        self._entries = {
+            w: e for w, e in self._entries.items() if now - e["ts"] <= self.ttl
+        }
+        return {w: dict(e["counters"]) for w, e in self._entries.items()}
+
+
+async def collect(push_store: Optional[PushStore] = None) -> dict:
     """Per-chip counter map {chip_index: {counter: value}}; chip identity is
     decoded from the port (port - 8431), matching the device plugin's
-    TPU_RUNTIME_METRICS_PORTS contract."""
+    TPU_RUNTIME_METRICS_PORTS contract.  Endpoints are scraped
+    CONCURRENTLY: four unreachable chips cost one 2 s timeout, not four
+    sequential ones blowing the exporter's own fetch budget."""
     chips = hw.chip_count()
     ports_env = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
     ports = [int(p) for p in ports_env.split(",") if p.strip().isdigit()]
@@ -70,19 +167,34 @@ async def collect() -> dict:
         ports = [BASE_METRICS_PORT + i for i in range(chips)]
     per_chip: dict[int, dict] = {}
     async with aiohttp.ClientSession() as session:
-        for port in ports:
-            chip = max(0, port - BASE_METRICS_PORT)
-            try:
-                per_chip[chip] = await scrape_runtime_endpoint(session, port)
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-                per_chip[chip] = {}
+        scraped = await asyncio.gather(
+            *(scrape_runtime_endpoint(session, port) for port in ports),
+            return_exceptions=True,
+        )
+    for port, result in zip(ports, scraped):
+        chip = max(0, port - BASE_METRICS_PORT)
+        per_chip[chip] = result if isinstance(result, dict) else {}
     # shape-stable zero fill
     for i in range(chips):
         per_chip.setdefault(i, {})
     for chip in per_chip.values():
         for counter in COUNTERS:
             chip.setdefault(counter, 0.0)
-    return {"ts": time.time(), "chips": per_chip}
+    snapshot = {"ts": time.time(), "chips": per_chip}
+    if push_store is not None:
+        snapshot["workloads"] = push_store.snapshot()
+    return snapshot
+
+
+def _escape_label(value) -> str:
+    """Prometheus exposition label escaping: backslash, quote, newline —
+    a node name with '"' or '\\' must not corrupt the exposition."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def to_prometheus(
@@ -91,27 +203,71 @@ def to_prometheus(
     allow: Optional[set] = None,
 ) -> str:
     """Prometheus text for a counter snapshot; shared with the exporter
-    (extra node labels + counter allowlist)."""
-    prefix = "".join(f'{k}="{v}",' for k, v in (extra_labels or {}).items())
+    (extra node labels + counter allowlist).  Chip counters render per
+    chip; pushed workload counters render per workload with a
+    ``source="workload"`` label.  Every family gets # HELP and # TYPE."""
+    prefix = "".join(
+        f'{k}="{_escape_label(v)}",' for k, v in (extra_labels or {}).items()
+    )
     lines = []
+
+    def _family(counter: str) -> None:
+        kind = "counter" if counter.endswith("_total") else "gauge"
+        lines.append(f"# HELP {counter} {COUNTER_HELP.get(counter, counter)}")
+        lines.append(f"# TYPE {counter} {kind}")
+
     for counter in COUNTERS:
         if allow is not None and counter not in allow:
             continue
-        kind = "counter" if counter.endswith("_total") else "gauge"
-        lines.append(f"# TYPE {counter} {kind}")
+        _family(counter)
         for chip, values in sorted(snapshot.get("chips", {}).items()):
-            lines.append(f'{counter}{{{prefix}chip="{chip}"}} {values.get(counter, 0.0)}')
+            lines.append(
+                f'{counter}{{{prefix}chip="{_escape_label(chip)}"}}'
+                f" {values.get(counter, 0.0)}"
+            )
+    workloads = snapshot.get("workloads") or {}
+    for counter in WORKLOAD_COUNTERS:
+        if allow is not None and counter not in allow:
+            continue
+        rows = [
+            (workload, counters[counter])
+            for workload, counters in sorted(workloads.items())
+            if counter in counters
+        ]
+        if not rows:
+            continue
+        _family(counter)
+        for workload, value in rows:
+            lines.append(
+                f'{counter}{{{prefix}source="workload",'
+                f'workload="{_escape_label(workload)}"}} {value}'
+            )
     return "\n".join(lines) + "\n"
 
 
-async def serve(port: int, stop: asyncio.Event, cache_ttl: float = 1.0) -> None:
+async def serve(
+    port: int,
+    stop: asyncio.Event,
+    cache_ttl: float = 1.0,
+    push_ttl: float = 300.0,
+) -> None:
     # shared-sampler contract: concurrent scrapers within the TTL reuse one
     # collection instead of re-hitting every per-chip runtime endpoint
     cache: dict = {"snapshot": {"ts": 0.0, "chips": {}}}
+    push_store = PushStore(ttl=push_ttl)
+    # the TTL check+collect must be atomic: without the lock, N scrapers
+    # arriving inside one TTL window each saw a stale ts and each ran a
+    # full collect() pass, defeating the shared-sampler contract
+    refresh_lock = asyncio.Lock()
 
     async def refresh() -> dict:
-        if time.time() - cache["snapshot"]["ts"] >= cache_ttl:
-            cache["snapshot"] = await collect()
+        async with refresh_lock:
+            if time.time() - cache["snapshot"]["ts"] >= cache_ttl:
+                cache["snapshot"] = await collect(push_store)
+            else:
+                # pushed counters are point-in-time already; serve the
+                # freshest even from a cached chip snapshot
+                cache["snapshot"]["workloads"] = push_store.snapshot()
         return cache["snapshot"]
 
     async def counters_handler(request: web.Request) -> web.Response:
@@ -120,9 +276,23 @@ async def serve(port: int, stop: asyncio.Event, cache_ttl: float = 1.0) -> None:
     async def metrics_handler(request: web.Request) -> web.Response:
         return web.Response(text=to_prometheus(await refresh()), content_type="text/plain")
 
+    async def push_handler(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — malformed push is a client bug, not a crash
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        workloads = body.get("workloads")
+        if not isinstance(workloads, dict):
+            return web.json_response(
+                {"error": "missing workloads map"}, status=400
+            )
+        accepted = push_store.push(workloads)
+        return web.json_response({"accepted": accepted})
+
     app = web.Application()
     app.router.add_get("/counters", counters_handler)
     app.router.add_get("/metrics", metrics_handler)
+    app.router.add_post("/push", push_handler)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, "0.0.0.0", port)
@@ -139,7 +309,11 @@ def main() -> None:
     port = int(os.environ.get("AGENT_PORT", "5555"))
 
     async def run() -> None:
-        await serve(port, base.stop_event())
+        await serve(
+            port,
+            base.stop_event(),
+            push_ttl=float(os.environ.get("WORKLOAD_PUSH_TTL", "300")),
+        )
 
     asyncio.run(run())
 

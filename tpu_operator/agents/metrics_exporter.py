@@ -47,38 +47,55 @@ def render(snapshot: dict, node: str, allow: Optional[set[str]]) -> str:
     return to_prometheus(snapshot, extra_labels={"node": node}, allow=allow)
 
 
-async def fetch_snapshot(agent_port: int) -> dict:
-    """Agent first (shared sampler); direct collection as fallback."""
+async def fetch_snapshot(
+    agent_port: int, session: Optional[aiohttp.ClientSession] = None
+) -> dict:
+    """Agent first (shared sampler); direct collection as fallback.
+
+    ``session`` is the exporter's long-lived ClientSession — constructing
+    one per scrape cost a TCP connect + TLS-less handshake every request
+    and leaked pressure under Prometheus's default 15 s scrape interval.
+    A bare call (tests, one-shots) still works without one."""
     try:
-        async with aiohttp.ClientSession() as session:
-            async with session.get(
-                f"http://127.0.0.1:{agent_port}/counters",
-                timeout=aiohttp.ClientTimeout(total=2),
-            ) as resp:
-                return await resp.json()
+        if session is None:
+            async with aiohttp.ClientSession() as one_shot:
+                return await _fetch(one_shot, agent_port)
+        return await _fetch(session, agent_port)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
         return await collect()
+
+
+async def _fetch(session: aiohttp.ClientSession, agent_port: int) -> dict:
+    async with session.get(
+        f"http://127.0.0.1:{agent_port}/counters",
+        timeout=aiohttp.ClientTimeout(total=2),
+    ) as resp:
+        return await resp.json()
 
 
 async def serve(port: int, agent_port: int, stop: asyncio.Event) -> None:
     node = os.environ.get("NODE_NAME", "")
     allow = load_allowlist(os.environ.get("METRICS_CONFIG_FILE"))
 
-    async def handler(request: web.Request) -> web.Response:
-        snapshot = await fetch_snapshot(agent_port)
-        return web.Response(text=render(snapshot, node, allow), content_type="text/plain")
+    async with aiohttp.ClientSession() as session:
 
-    app = web.Application()
-    app.router.add_get("/metrics", handler)
-    runner = web.AppRunner(app)
-    await runner.setup()
-    site = web.TCPSite(runner, "0.0.0.0", port)
-    await site.start()
-    log.info("metrics exporter on :%d (agent :%d)", port, agent_port)
-    try:
-        await stop.wait()
-    finally:
-        await runner.cleanup()
+        async def handler(request: web.Request) -> web.Response:
+            snapshot = await fetch_snapshot(agent_port, session)
+            return web.Response(
+                text=render(snapshot, node, allow), content_type="text/plain"
+            )
+
+        app = web.Application()
+        app.router.add_get("/metrics", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", port)
+        await site.start()
+        log.info("metrics exporter on :%d (agent :%d)", port, agent_port)
+        try:
+            await stop.wait()
+        finally:
+            await runner.cleanup()
 
 
 def main() -> None:

@@ -13,4 +13,8 @@ in-tree equivalent every controller and the apply layer report through:
 - ``obs.logging`` — structured JSON logging (opt-in via
   ``--log-format=json``) whose records carry the active reconcile id,
   controller, and operand state from the span context.
+- ``obs.flight``  — per-step workload flight recorder: JSONL samples
+  tagged with the active span id, persisted next to the workload's
+  result drop-box and pushed to the node metrics agent's ``/push``
+  endpoint for live ``source="workload"`` Prometheus series.
 """

@@ -39,6 +39,7 @@ REASON_REMEDIATION_HEALTHY = "RemediationHealthy"
 REASON_REMEDIATION_FAILED = "RemediationFailed"
 REASON_VALIDATION_FAILED = "ValidationFailed"
 REASON_SELECTOR_CONFLICT = "SelectorConflict"
+REASON_PERF_REGRESSED = "WorkloadPerfRegressed"
 
 
 def node_ref(name: str) -> dict:

@@ -1,0 +1,402 @@
+"""Per-step workload flight recorder + node telemetry push client.
+
+The validation/bench workloads used to print ONE JSON line at exit — a
+verdict with no history.  This module is the black-box recorder between a
+JAX step and a scrape-able time series:
+
+- ``record(check, phase, step=..., **metrics)`` appends one sample to an
+  in-memory ring; samples carry a wall-clock ``ts``, the workload ``check``
+  name, a ``phase`` (``compile`` / ``run`` / ``step`` / ``result``), an
+  optional step index, the metric map, and — when an ``obs.trace`` span is
+  active — the span id and reconcile id, so a flight record is joinable
+  against ``/debug/traces``.
+- Samples persist as a JSONL **flight record** next to the workload's
+  result drop-box (``validator.status.flight_record_path``), append-only —
+  local workers sharing one validation root accumulate samples instead of
+  overwriting each other; the per-node coordinator (the validator, or
+  bench.py's sequential launcher) clears the record before a fresh run.
+- Each sample also feeds the node's **metrics agent** over its ``/push``
+  endpoint (``TPU_METRICS_PUSH_URL``) from a background thread, throttled
+  to one POST per ``push_interval`` seconds with backoff on failures —
+  ``record()`` never touches the network, so a dead agent costs the
+  timed loops nothing — giving ``/metrics`` live ``source="workload"``
+  series while a bench is still running.
+
+Like ``obs.trace.span``, the module-level ``record()`` is a no-op unless a
+recorder is active — workload code instruments unconditionally and pays
+nothing in untracked processes.  Activation is either explicit
+(``activate(recorder)``, used by the validator's in-process checks) or
+ambient via ``TPU_FLIGHT_RECORD=<path>`` in the environment (used by
+run_validation subprocesses and bench.py), resolved lazily on the first
+``record()``.  Persistence is best-effort everywhere: telemetry must never
+fail a workload.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from contextvars import ContextVar
+from typing import Iterator, Optional
+
+from tpu_operator.obs import trace
+
+# environment contract (bench.py / run_validation / workload pods)
+RECORD_ENV = "TPU_FLIGHT_RECORD"
+PUSH_ENV = "TPU_METRICS_PUSH_URL"
+
+MAX_SAMPLES = 4096  # ring bound: telemetry, not a database
+_FLUSH_EVERY = 32   # samples between best-effort JSONL rewrites
+
+# sample metric key → canonical workload counter (agents.metrics_agent
+# WORKLOAD_COUNTERS); only mapped keys are pushed — the JSONL record keeps
+# every metric, the Prometheus surface keeps the stable catalogue
+COUNTER_KEYS = {
+    "step_s": "tpu_workload_step_duration_seconds",
+    "compile_s": "tpu_workload_compile_seconds",
+    "gbps": "tpu_workload_achieved_gbps",
+    "tflops": "tpu_workload_achieved_tflops",
+    "mfu": "tpu_workload_mfu",
+    "tokens_per_sec": "tpu_workload_tokens_per_sec",
+    "overhead_dominated": "tpu_workload_overhead_dominated",
+}
+
+# result keys worth a flight sample when a check only reports a summary
+# dict (record_result): the union of the workloads' headline figures,
+# normalized onto the sample metric vocabulary above
+_RESULT_KEYS = {
+    "gbps": "gbps",
+    "algbw_gbps": "gbps",
+    "busbw_gbps": "busbw_gbps",
+    "link_gbps": "gbps",
+    "cache_gbps": "gbps",
+    "tflops": "tflops",
+    "attn_tflops": "tflops",
+    "model_tflops": "tflops",
+    "mfu": "mfu",
+    "train_mfu": "mfu",
+    "tokens_per_sec": "tokens_per_sec",
+    "step_time_ms": "step_time_ms",
+    "decode_us": "decode_us",
+    "time_s": "time_s",
+    "duration_s": "duration_s",
+    "max_error": "max_error",
+    "overhead_dominated": "overhead_dominated",
+}
+
+
+class FlightRecorder:
+    """Bounded sample ring with JSONL persistence and throttled push."""
+
+    def __init__(
+        self,
+        path: str = "",
+        push_url: str = "",
+        run_id: str = "",
+        push_interval: float = 1.0,
+        max_samples: int = MAX_SAMPLES,
+    ):
+        self.path = path
+        self.push_url = push_url
+        self.run_id = run_id or f"{os.getpid()}-{int(time.time())}"
+        self.push_interval = push_interval
+        self.max_samples = max_samples
+        self.samples: list[dict] = []
+        self.dropped = 0
+        self._unflushed = 0
+        self._persisted = 0  # samples already written to the JSONL record
+        # latest counter values per check, merged across samples so one
+        # POST carries every workload's current figures; drained by the
+        # push thread (record() must NEVER block on the network — a
+        # blackholed agent inside a timed benchmark loop would inflate
+        # every step_s by the socket timeout)
+        self._pending: dict[str, dict] = {}
+        # cumulative samples per check for tpu_workload_steps_total: the
+        # exposed series must be monotonic (a per-window count would read
+        # as endless Prometheus counter resets)
+        self._step_counts: dict[str, int] = {}
+        self._push_lock = threading.Lock()
+        self._push_wake = threading.Event()
+        self._push_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def record(
+        self,
+        check: str,
+        phase: str = "step",
+        step: Optional[int] = None,
+        **metrics,
+    ) -> dict:
+        sample: dict = {
+            "ts": round(time.time(), 6),
+            "run_id": self.run_id,
+            "check": check,
+            "phase": phase,
+        }
+        if step is not None:
+            sample["step"] = step
+        sp = trace.current_span()
+        if sp is not None:
+            sample["span_id"] = sp.span_id
+            if sp.reconcile_id:
+                sample["reconcile_id"] = sp.reconcile_id
+        # non-finite floats (a NaN loss) would corrupt the JSONL record
+        # and the push payload; record their absence, not their poison
+        sample["metrics"] = {
+            k: v
+            for k, v in metrics.items()
+            if v is not None
+            and not (isinstance(v, float) and not math.isfinite(v))
+        }
+        if len(self.samples) >= self.max_samples:
+            # keep the newest: the tail of a long run is the evidence a
+            # regression hunt needs; count what fell off the front
+            self.samples.pop(0)
+            self.dropped += 1
+            if self._persisted > 0:
+                self._persisted -= 1
+        self.samples.append(sample)
+        self._queue_push(check, sample["metrics"])
+        self._unflushed += 1
+        if self.path and self._unflushed >= _FLUSH_EVERY:
+            self.flush()
+        return sample
+
+    def record_result(self, check: str, result: dict) -> Optional[dict]:
+        """One summary sample from a check's result dict (the generic hook
+        run_validation applies to EVERY check, so even workloads without
+        per-step instrumentation leave a flight trail)."""
+        if not isinstance(result, dict):
+            return None
+        metrics = {}
+        for key, name in _RESULT_KEYS.items():
+            value = result.get(key)
+            if isinstance(value, bool):
+                metrics[name] = float(value)
+            elif isinstance(value, (int, float)):
+                metrics[name] = value
+        if not result.get("ok", True):
+            metrics["failed"] = 1.0
+        return self.record(check, phase="result", **metrics)
+
+    # ------------------------------------------------------------------
+    def flush(self) -> None:
+        """Append the not-yet-persisted samples to the JSONL record.
+        Append-ONLY — never truncate: several local workers sharing one
+        validation root (spawn_local_workers, the concurrent partition
+        acceptance, single-host multislice dryrun) accumulate samples
+        instead of racing to erase each other's.  Staleness is the
+        coordinator's job: the validator (one per node) and bench.py
+        clear the record before a fresh run, when no writer is live;
+        a torn interleaved line is skipped by read_flight_record."""
+        self._unflushed = 0
+        if not self.path:
+            return
+        try:
+            new = self.samples[self._persisted:]
+            if not new:
+                return
+            lines = []
+            for sample in new:
+                # per-sample serialization: one non-JSON metric value (a
+                # stray numpy scalar) loses its own line, never the whole
+                # record from that point on
+                try:
+                    lines.append(json.dumps(sample) + "\n")
+                except (TypeError, ValueError):
+                    continue
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write("".join(lines))
+            self._persisted = len(self.samples)
+        except Exception:  # noqa: BLE001 — telemetry must never fail the workload
+            pass
+
+    def close(self) -> None:
+        self.flush()
+        self._closed = True
+        thread = self._push_thread
+        if thread is not None:
+            self._push_wake.set()
+            # bounded: a blackholed agent must not hold the workload's exit
+            thread.join(timeout=3.0)
+
+    # ------------------------------------------------------------------
+    def _queue_push(self, check: str, metrics: dict) -> None:
+        if not self.push_url or self._closed:
+            return
+        with self._push_lock:
+            counters = self._pending.setdefault(check, {})
+            for key, counter in COUNTER_KEYS.items():
+                value = metrics.get(key)
+                if isinstance(value, (bool, int, float)):
+                    counters[counter] = float(value)
+            self._step_counts[check] = self._step_counts.get(check, 0) + 1
+            counters["tpu_workload_steps_total"] = float(self._step_counts[check])
+        if self._push_thread is None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, name="flight-push", daemon=True
+            )
+            self._push_thread.start()
+        self._push_wake.set()
+
+    def _take_pending(self) -> Optional[dict]:
+        with self._push_lock:
+            if not self._pending:
+                return None
+            workloads = {
+                check: {"counters": dict(counters)}
+                for check, counters in self._pending.items()
+            }
+            self._pending.clear()
+        return workloads
+
+    def _requeue(self, workloads: dict) -> None:
+        """Put a failed push window back so once-recorded counters (a
+        compile_s) survive a transient agent outage; values recorded
+        since the take win over the failed window's."""
+        with self._push_lock:
+            for check, entry in workloads.items():
+                live = self._pending.setdefault(check, {})
+                merged = {**entry["counters"], **live}
+                live.clear()
+                live.update(merged)
+
+    def _push_loop(self) -> None:
+        """Background push thread: drains the pending counters at most once
+        per ``push_interval``, with exponential backoff on failures —
+        record() itself never touches the network, so a dead or blackholed
+        agent costs the measurements nothing."""
+        failures = 0
+        while True:
+            self._push_wake.wait(timeout=self.push_interval)
+            self._push_wake.clear()
+            if failures:
+                # backoff sleep bounded so close() isn't held long
+                time.sleep(min(30.0, 2.0 ** failures) if not self._closed else 0)
+            workloads = self._take_pending()
+            if workloads is None:
+                if self._closed:
+                    return
+                continue
+            body = json.dumps(
+                {
+                    "source": "workload",
+                    "run_id": self.run_id,
+                    "workloads": workloads,
+                }
+            ).encode()
+            req = urllib.request.Request(
+                self.push_url,
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=1.0):
+                    pass
+                failures = 0
+            except (urllib.error.URLError, OSError, ValueError):
+                failures += 1
+                self._requeue(workloads)
+            if self._closed and (failures or not self._pending):
+                return
+            # throttle between successful pushes
+            if not self._closed:
+                time.sleep(self.push_interval)
+
+
+# ---------------------------------------------------------------------------
+# ambient recorder (the obs.trace ambient-tracer pattern)
+
+_current: ContextVar[Optional[FlightRecorder]] = ContextVar(
+    "tpu_operator_flight", default=None
+)
+# lazily-resolved env recorder: subprocesses (bench modules, workload pods)
+# record without any in-module activation when TPU_FLIGHT_RECORD is set;
+# keyed on the env values so a changed environment (tests, re-exec'd
+# harnesses) rotates to a fresh recorder instead of serving a stale one
+_env_recorder: Optional[FlightRecorder] = None
+_env_key: Optional[tuple] = None
+
+
+def from_env() -> Optional[FlightRecorder]:
+    """A recorder configured from the environment, or None when untracked
+    (no TPU_FLIGHT_RECORD and no TPU_METRICS_PUSH_URL)."""
+    path = os.environ.get(RECORD_ENV, "")
+    push = os.environ.get(PUSH_ENV, "")
+    if not path and not push:
+        return None
+    return FlightRecorder(path=path, push_url=push)
+
+
+def recorder_for(path: str) -> FlightRecorder:
+    """Recorder persisting at ``path``, pushing to TPU_METRICS_PUSH_URL
+    when set — the construction rule every validation entry point
+    (run_validation, distributed, the validator's in-process checks)
+    shares.  Deliberately does NOT honor TPU_FLIGHT_RECORD: the drop-box
+    path is where the validator reads its flight evidence from
+    (status.flight_evidence); an env override would silently divorce the
+    samples from the evidence.  The env override is for standalone bench
+    modules, which resolve it through ``active()``."""
+    return FlightRecorder(path=path, push_url=os.environ.get(PUSH_ENV, ""))
+
+
+def active() -> Optional[FlightRecorder]:
+    recorder = _current.get()
+    if recorder is not None:
+        return recorder
+    global _env_recorder, _env_key
+    key = (os.environ.get(RECORD_ENV, ""), os.environ.get(PUSH_ENV, ""))
+    if key == ("", ""):
+        return None
+    if _env_key != key:
+        if _env_recorder is not None:
+            _env_recorder.close()
+        _env_recorder = FlightRecorder(path=key[0], push_url=key[1])
+        _env_key = key
+    return _env_recorder
+
+
+@contextlib.contextmanager
+def activate(recorder: FlightRecorder) -> Iterator[FlightRecorder]:
+    """Make ``recorder`` ambient for the current context; closes (final
+    flush + push) on exit."""
+    token = _current.set(recorder)
+    try:
+        yield recorder
+    finally:
+        _current.reset(token)
+        recorder.close()
+
+
+def record(
+    check: str, phase: str = "step", step: Optional[int] = None, **metrics
+) -> None:
+    """Sample on the AMBIENT recorder; no-op (near-zero cost) when no
+    recorder is active — workloads instrument unconditionally."""
+    recorder = active()
+    if recorder is not None:
+        recorder.record(check, phase=phase, step=step, **metrics)
+
+
+def record_result(check: str, result: dict) -> None:
+    recorder = active()
+    if recorder is not None:
+        recorder.record_result(check, result)
+
+
+def close_active() -> None:
+    """Final flush+push for the ambient/env recorder (subprocess mains call
+    this before exit; the activate() context manager does it for scoped
+    recorders)."""
+    recorder = active()
+    if recorder is not None:
+        recorder.close()

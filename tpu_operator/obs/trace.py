@@ -50,12 +50,17 @@ def new_reconcile_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
 @dataclass
 class Span:
     name: str
     kind: str = ""
     attrs: dict = field(default_factory=dict)
     reconcile_id: str = ""
+    span_id: str = field(default_factory=new_span_id)
     parent: Optional["Span"] = field(default=None, repr=False)
     start_ts: float = 0.0  # wall clock, for humans reading /debug/traces
     duration_s: Optional[float] = None
@@ -68,6 +73,7 @@ class Span:
             "name": self.name,
             "kind": self.kind,
             "reconcile_id": self.reconcile_id,
+            "span_id": self.span_id,
             "start_ts": round(self.start_ts, 6),
             "duration_s": self.duration_s,
         }

@@ -183,6 +183,42 @@ def _measured_from_results(results: Optional[dict]) -> dict:
     return out
 
 
+# measured (gate-relevant) metric keys compared round-over-round; the gate
+# FLOORS (min_gbps keys) are configuration and never "regress"
+_REGRESSION_KEYS = (
+    "algbw_gbps",
+    "ring_link_gbps",
+    "matmul_tflops",
+    "mfu",
+    "hbm_gbps",
+    "hbm_dma_gbps",
+)
+
+
+def _regression_threshold() -> float:
+    """Relative drop that counts as a regression (shared verdict rule,
+    workloads/timing.regression_verdict); PERF_REGRESSION_THRESHOLD
+    overrides the 7% default — including an explicit 0, which flags
+    every drop (the _env_floor explicit-zero rule)."""
+    return _env_floor("PERF_REGRESSION_THRESHOLD", lambda: 0.07)
+
+
+def _regressions_vs_prior(payload: dict, prior: dict) -> list[dict]:
+    """Gated metrics that regressed against the previous round's payload
+    (the one run() stashed before clearing the status file)."""
+    from tpu_operator.workloads import timing
+
+    threshold = _regression_threshold()
+    out = []
+    for key in _REGRESSION_KEYS:
+        verdict = timing.regression_verdict(
+            payload.get(key), prior.get(key), threshold=threshold
+        )
+        if verdict is not None and verdict["verdict"] == "regressed":
+            out.append({"metric": key, **verdict})
+    return out
+
+
 def _worker_id_of(node: dict) -> int:
     """The node's slice worker id; raises ValidationError on a malformed or
     missing label (silently collapsing to 0 would collide with the real
@@ -233,6 +269,11 @@ class Validator:
     def __init__(self, config: Optional[ValidatorConfig] = None, client: Optional[ApiClient] = None):
         self.config = config or ValidatorConfig()
         self._client = client
+        self._events = None
+        # per-component payload of the PREVIOUS validation round, stashed
+        # by run() before it clears the status file — the LHS of the
+        # round-over-round regression comparison
+        self._prior: dict[str, dict] = {}
 
     def client(self) -> ApiClient:
         if self._client is None:
@@ -240,6 +281,50 @@ class Validator:
 
             self._client = ApiClient(Config.from_env())
         return self._client
+
+    def events(self):
+        """Lazy EventRecorder (Events are evidence; posting never gates)."""
+        if self._events is None:
+            from tpu_operator.obs.events import EventRecorder
+
+            self._events = EventRecorder(
+                self.client(), self.config.namespace, component="tpu-validator"
+            )
+        return self._events
+
+    async def _finish_measured(
+        self, component: str, payload: dict, scope: str = ""
+    ) -> None:
+        """Shared evidence-finishing rule for the measured components
+        (jax, perf): attach the run's flight record (per-step samples with
+        span ids, joinable against /debug/traces) to the ready payload,
+        and when a gated metric regressed past the threshold vs the
+        previous round's payload, record it and post a Warning Event —
+        evidence and alerting, never a gate."""
+        evidence = status.flight_evidence(scope=scope)
+        if evidence is not None:
+            payload["flight"] = evidence
+        prior = self._prior.get(component)
+        if not prior:
+            return
+        regressions = _regressions_vs_prior(payload, prior)
+        if not regressions:
+            return
+        payload["regressions"] = regressions
+        if not self.config.node_name:
+            return
+        from tpu_operator.obs import events as obs_events
+
+        msg = "; ".join(
+            f"{r['metric']} {r['prior']:.4g}→{r['current']:.4g}"
+            f" ({r['delta_pct']:+.1f}%)"
+            for r in regressions
+        )
+        await self.events().warning(
+            obs_events.node_ref(self.config.node_name),
+            obs_events.REASON_PERF_REGRESSED,
+            f"{component} validation: {msg}",
+        )
 
     # ------------------------------------------------------------------
     async def run(self, component: str) -> None:
@@ -254,6 +339,9 @@ class Validator:
         }.get(component)
         if handler is None:
             raise ValidationError(f"invalid component {component!r}; one of {self.COMPONENTS}")
+        prior = status.read_status(component)
+        if prior is not None:
+            self._prior[component] = prior
         status.clear(component)
         # feeds workload_phase_duration_seconds{phase} when a tracer is ambient
         with trace.span(f"validate/{component}", kind=trace.KIND_PHASE, phase=component):
@@ -359,6 +447,11 @@ class Validator:
         host of the slice (SURVEY §7 hard parts 1 & 3: slice health is a set
         property; no reference analogue, GPU validation is node-local)."""
         await self.wait_ready("plugin", retries=self.config.resource_retries)
+        # fresh flight record for this round: recorders APPEND (concurrent
+        # local writers must never truncate each other), so the one
+        # per-node coordinator — this validator — clears stale samples
+        # here, before any writer starts
+        status.clear_flight_record()
         if self.config.with_workload:
             group = await self._slice_group()
             if group is not None:
@@ -393,28 +486,49 @@ class Validator:
                 "allreduce_min_gbps": min_gbps,
             }
             payload.update(_measured_from_results(status.read_workload_results()))
+            await self._finish_measured("jax", payload)
             status.write_ready("jax", payload)
             return
 
         def run_checks() -> dict:
             import jax
 
+            from tpu_operator.obs import flight
             from tpu_operator.workloads import collectives, compile_cache
 
             compile_cache.enable()
-            # minimal gate only — matmul/hbm/ring run post-ready via the
-            # perf component, and burn-in gates only where it is a real
-            # multi-chip acceptance test: the same split as the
-            # workload-pod path (single-chip burn-in runs post-ready)
-            results = {
-                "vector-add": collectives.vector_add(1 << 16),
-                "allreduce": collectives.allreduce_benchmark(size_mb=4, iters=3, warmup=1),
-            }
-            if len(jax.devices()) > 1:
-                results["burn-in"] = collectives.burn_in(steps=2)
-            for name, r in results.items():
-                if not r.get("ok"):
-                    raise ValidationError(f"jax check {name} failed: {r}")
+            # the in-process run leaves the same flight record a workload
+            # pod would — samples under per-check phase spans so they carry
+            # span ids exactly like run_validation's (explicit activation:
+            # executor threads don't inherit the event loop's contextvars)
+            recorder = flight.recorder_for(status.flight_record_path())
+            local_tracer = trace.Tracer()
+            with local_tracer.activate(), flight.activate(recorder):
+                # minimal gate only — matmul/hbm/ring run post-ready via the
+                # perf component, and burn-in gates only where it is a real
+                # multi-chip acceptance test: the same split as the
+                # workload-pod path (single-chip burn-in runs post-ready)
+                checks = [
+                    ("vector-add", lambda: collectives.vector_add(1 << 16)),
+                    (
+                        "allreduce",
+                        lambda: collectives.allreduce_benchmark(
+                            size_mb=4, iters=3, warmup=1
+                        ),
+                    ),
+                ]
+                if len(jax.devices()) > 1:
+                    checks.append(("burn-in", lambda: collectives.burn_in(steps=2)))
+                results = {}
+                for name, fn in checks:
+                    with trace.span(
+                        f"check/{name}", kind=trace.KIND_PHASE, phase=name
+                    ):
+                        results[name] = fn()
+                        flight.record_result(name, results[name])
+                for name, r in results.items():
+                    if not r.get("ok"):
+                        raise ValidationError(f"jax check {name} failed: {r}")
             # measured figures go through the SAME flag filter as the
             # workload path: the small in-process buffer is routinely
             # overhead-dominated on tunneled backends (a real run reported
@@ -427,6 +541,7 @@ class Validator:
             }
 
         payload = await asyncio.get_event_loop().run_in_executor(None, run_checks)
+        await self._finish_measured("jax", payload)
         status.write_ready("jax", payload)
 
     async def validate_perf(self) -> None:
@@ -463,6 +578,7 @@ class Validator:
                 # perf probes and later joined a slice must not keep
                 # exporting stale matmul/hbm figures to the alerts.
                 status.clear_workload_results(scope="perf")
+                status.clear_flight_record(scope="perf")
                 status.write_ready("perf", {
                     "ok": True,
                     "skipped": "multi-host slice member: node-local PJRT "
@@ -495,8 +611,10 @@ class Validator:
             budget = _env_floor("PERF_PROBE_BUDGET_S", lambda: 0.0)
             # clear the previous run's drop-box FIRST: a failed probe run
             # must surface as "no current measurements", never republish
-            # last round's healthy figures to the degradation alerts
+            # last round's healthy figures to the degradation alerts (the
+            # flight record clears with it — same staleness rule)
             status.clear_workload_results(scope="perf")
+            status.clear_flight_record(scope="perf")
             ok, error = True, None
             try:
                 await self.spawn_workload(
@@ -524,6 +642,7 @@ class Validator:
             def run_probes() -> dict:
                 import jax
 
+                from tpu_operator.obs import flight
                 from tpu_operator.workloads import (
                     collectives,
                     compile_cache,
@@ -590,20 +709,34 @@ class Validator:
                 budget = _env_floor("PERF_PROBE_BUDGET_S", lambda: 0.0)
                 t_start = time.monotonic()
                 out = {}
-                for probe_name, fn in probes.items():
-                    if budget and time.monotonic() - t_start > budget:
-                        out[probe_name] = {
-                            "ok": True,
-                            "skipped": f"budget ({budget}s) exhausted",
-                        }
-                        continue
-                    try:
-                        out[probe_name] = fn()
-                    except Exception as e:  # noqa: BLE001
-                        # post-ready, the chip is schedulable: a user pod
-                        # may own it and PJRT init can fail device-busy —
-                        # probes are opportunistic, record and move on
-                        out[probe_name] = {"ok": False, "error": str(e)}
+                # in-process probes leave the same scoped flight record a
+                # probe pod would, samples under per-probe phase spans for
+                # span ids (explicit activation: executor threads don't
+                # inherit the loop's contextvars)
+                recorder = flight.recorder_for(status.flight_record_path("perf"))
+                local_tracer = trace.Tracer()
+                with local_tracer.activate(), flight.activate(recorder):
+                    for probe_name, fn in probes.items():
+                        if budget and time.monotonic() - t_start > budget:
+                            out[probe_name] = {
+                                "ok": True,
+                                "skipped": f"budget ({budget}s) exhausted",
+                            }
+                            continue
+                        with trace.span(
+                            f"check/{probe_name}",
+                            kind=trace.KIND_PHASE,
+                            phase=probe_name,
+                        ):
+                            try:
+                                out[probe_name] = fn()
+                            except Exception as e:  # noqa: BLE001
+                                # post-ready, the chip is schedulable: a user
+                                # pod may own it and PJRT init can fail
+                                # device-busy — probes are opportunistic,
+                                # record and move on
+                                out[probe_name] = {"ok": False, "error": str(e)}
+                            flight.record_result(probe_name, out[probe_name])
                 return out
 
             results = await asyncio.get_event_loop().run_in_executor(None, run_probes)
@@ -620,6 +753,7 @@ class Validator:
         payload = {"ok": ok, **measured, "checks": results}
         if error:
             payload["error"] = error
+        await self._finish_measured("perf", payload, scope="perf")
         status.write_ready("perf", payload)
 
     # ------------------------------------------------------------------
@@ -877,6 +1011,7 @@ class Validator:
         # tombstone path the drop-box holds the last run's figures, which is
         # exactly the gauge family's "last measured" semantics
         payload.update(_measured_from_results(status.read_workload_results()))
+        await self._finish_measured("jax", payload)
         status.write_ready("jax", payload)
 
     async def _validate_group_rendezvous(
@@ -1242,6 +1377,17 @@ class Validator:
                             *(
                                 [{"name": "RESULTS_SCOPE", "value": results_scope}]
                                 if results_scope
+                                else []
+                            ),
+                            # live telemetry: the pod's flight recorder
+                            # pushes to the node metrics agent when the
+                            # validator knows its address (DS-injected)
+                            *(
+                                [{
+                                    "name": "TPU_METRICS_PUSH_URL",
+                                    "value": os.environ["TPU_METRICS_PUSH_URL"],
+                                }]
+                                if os.environ.get("TPU_METRICS_PUSH_URL")
                                 else []
                             ),
                             # the probe pod stops STARTING checks past this

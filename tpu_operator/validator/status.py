@@ -150,3 +150,56 @@ def read_workload_results(scope: str = "") -> Optional[dict]:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+def flight_record_path(scope: str = "") -> str:
+    """JSONL flight record (obs.flight per-step samples) of the LAST
+    validation/bench workload run, beside the results drop-box so workload
+    pods reach it through the same mount.  Scoped like the results file."""
+    root = os.path.dirname(validation_dir())
+    suffix = f"-{scope}" if scope else ""
+    return os.path.join(root, "workload-results", f"flight{suffix}.jsonl")
+
+
+def read_flight_record(scope: str = "") -> list[dict]:
+    """Parsed flight samples; a torn or missing record reads as fewer
+    samples, never an error (evidence is best-effort)."""
+    samples: list[dict] = []
+    try:
+        with open(flight_record_path(scope)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    samples.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return []
+    return samples
+
+
+def clear_flight_record(scope: str = "") -> None:
+    try:
+        os.remove(flight_record_path(scope))
+    except OSError:
+        pass
+
+
+def flight_evidence(scope: str = "", tail: int = 50) -> Optional[dict]:
+    """The flight record as ready-payload evidence: record path, sample
+    count, the span ids the samples carry (joinable against
+    ``/debug/traces``), and the newest ``tail`` samples — bounded so a
+    long bench cannot balloon a status file."""
+    samples = read_flight_record(scope)
+    if not samples:
+        return None
+    span_ids = sorted({s["span_id"] for s in samples if s.get("span_id")})
+    return {
+        "path": flight_record_path(scope),
+        "samples": len(samples),
+        "checks": sorted({s.get("check", "") for s in samples}),
+        "span_ids": span_ids,
+        "tail": samples[-tail:],
+    }

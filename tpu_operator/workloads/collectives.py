@@ -26,6 +26,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_operator.obs import flight
 from tpu_operator.workloads import timing
 
 
@@ -224,16 +225,27 @@ def allreduce_benchmark(
         overheads.append(time.perf_counter() - t0)
     overhead = min(overheads)
 
-    for _ in range(max(1, warmup)):
-        float(chain_err(x))  # compile + settle
+    compile_s = timing.timed(lambda: float(chain_err(x)))  # compile + settle
+    flight.record("allreduce", "compile", compile_s=compile_s)
+    for _ in range(max(1, warmup) - 1):
+        float(chain_err(x))
     raw = []
     max_err = 0.0
-    for _ in range(best_of):
+    size_bytes_per_rep = elems_per_dev * n * 2
+    for rep in range(best_of):
         t0 = time.perf_counter()
         # worst error across ALL reps: a corrupt repetition must fail the
         # check even when a later one is clean
         max_err = max(max_err, float(chain_err(x)))
         raw.append(time.perf_counter() - t0)
+        flight.record(
+            "allreduce", "step", step=rep,
+            step_s=raw[-1],
+            # amortized per-collective rate, floor NOT subtracted: the live
+            # per-step series is a monitoring signal, the verdict below
+            # applies the shared floor rule
+            gbps=size_bytes_per_rep * iters / raw[-1] / 1e9,
+        )
     # shared rule (workloads/timing.py): when the floor rivals the compute
     # (tiny buffers or a huge dispatch RTT) subtraction is meaningless —
     # report the unsubtracted, deflated rate and flag it so gates skip
@@ -382,8 +394,10 @@ def ring_benchmark(
         expected = distinct_total - x_in.astype(jnp.float32)
         return jnp.max(jnp.abs(acc - expected))
 
+    t_compile = time.perf_counter()
     acc0 = ring(x)  # compile + warm the timed program
     float(err(acc0, x))  # compile err for its real input types
+    flight.record("ring", "compile", compile_s=time.perf_counter() - t_compile)
     # floor: dispatch + readback of the SAME compiled err on a materialized
     # array — no recompile in the first sample, no ring execution
     floor = min(
@@ -391,10 +405,14 @@ def ring_benchmark(
     )
     raw = []
     max_err = 0.0
-    for _ in range(best_of):
+    for rep in range(best_of):
         t0 = time.perf_counter()
         max_err = max(max_err, float(err(ring(x), x)))
         raw.append(time.perf_counter() - t0)
+        flight.record(
+            "ring", "step", step=rep, step_s=raw[-1],
+            gbps=elems_per_dev * 2 * iters * n / raw[-1] / 1e9,
+        )
     # per-hop time: iters revolutions x n pipelined hops each (n-1
     # accumulating + 1 completing)
     times, overhead_dominated = timing.subtract_floor(
@@ -527,16 +545,26 @@ def burn_in_step(
 
 
 
-def _acceptance_run(mesh: Mesh, step, params, x, steps: int) -> dict:
+def _acceptance_run(
+    mesh: Mesh, step, params, x, steps: int, name: str = "burn-in"
+) -> dict:
     """Shared acceptance-loop contract (burn_in and transformer_burn_in):
     run ``steps`` jitted SGD steps, require finite and strictly-moving
     losses (a flat line means the step silently stopped training — the r1
-    failure mode)."""
+    failure mode).  Every SGD step leaves a flight-recorder sample (step
+    wall time; the first one carries the compile)."""
     losses = []
     t0 = time.perf_counter()
-    for _ in range(steps):
+    t_step = t0
+    for i in range(steps):
         loss, params = step(params, x)
         losses.append(float(loss))
+        now = time.perf_counter()
+        flight.record(
+            name, "compile" if i == 0 else "step", step=i,
+            step_s=now - t_step, loss=losses[-1],
+        )
+        t_step = now
     dt = time.perf_counter() - t0
     finite = all(np.isfinite(l) for l in losses)
     decreasing = len(losses) < 2 or losses[-1] < losses[0]
@@ -759,7 +787,7 @@ def transformer_burn_in(
     )
     return _acceptance_run(
         mesh, jax.jit(functools.partial(transformer_step, mesh, heads)),
-        params, x, steps,
+        params, x, steps, name="transformer",
     )
 
 
@@ -950,7 +978,7 @@ def transformer_pipeline_burn_in(
     )
     result = _acceptance_run(
         mesh, jax.jit(functools.partial(transformer_pipeline_step, mesh, heads)),
-        params, x, steps,
+        params, x, steps, name="transformer-pp",
     )
     if mesh.shape["pp"] == 1:
         # make_mesh3 degrades to pp=1 below 4 chips: the math still runs

@@ -598,6 +598,8 @@ def rendezvous_post_mortem(outcomes: list[dict]) -> dict:
 
 
 def main() -> int:
+    from tpu_operator.obs import flight
+    from tpu_operator.validator import status as vstatus
     from tpu_operator.workloads import compile_cache
 
     compile_cache.enable()
@@ -607,37 +609,34 @@ def main() -> int:
         os.environ.get("PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0") or "0")
     )
     steps = int(os.environ.get("BURN_IN_STEPS", "3"))
+    scope = os.environ.get("RESULTS_SCOPE", "")
     if num_processes > 1 and not coordinator:
         print(json.dumps({"ok": False, "error": "COORDINATOR_ADDRESS required"}))
         return 1
-    try:
-        result = run_worker(coordinator, num_processes, process_id, steps=steps)
-    except Exception as e:  # noqa: BLE001 — the exit code IS the validation verdict
-        evidence = {
-            "ok": False,
-            "process_id": process_id,
-            # the phase names WHERE the failure hit (e.g. a collective
-            # erroring because its peer died) — the post-mortem evidence
-            "phase": _LAST_PHASE,
-            "error": str(e),
-        }
-        print(json.dumps(evidence), flush=True)
-        from tpu_operator.validator import status as vstatus
-
-        vstatus.write_workload_results(
-            {"distributed": evidence},
-            scope=os.environ.get("RESULTS_SCOPE", ""),
-        )
-        return 1
+    # flight record beside the results drop-box (the pod mounts that dir);
+    # per-check samples flow from the instrumented collectives benchmarks
+    recorder = flight.recorder_for(vstatus.flight_record_path(scope))
+    with flight.activate(recorder):
+        try:
+            result = run_worker(coordinator, num_processes, process_id, steps=steps)
+        except Exception as e:  # noqa: BLE001 — the exit code IS the validation verdict
+            evidence = {
+                "ok": False,
+                "process_id": process_id,
+                # the phase names WHERE the failure hit (e.g. a collective
+                # erroring because its peer died) — the post-mortem evidence
+                "phase": _LAST_PHASE,
+                "error": str(e),
+            }
+            print(json.dumps(evidence), flush=True)
+            vstatus.write_workload_results({"distributed": evidence}, scope=scope)
+            return 1
+        flight.record_result("distributed", result)
     print(json.dumps(result), flush=True)
     # node-local drop-box for the validator → node-status exporter → alerts;
     # RESULTS_SCOPE (injected for the cross-slice pods) keeps DCN figures
     # from overwriting the slice's ICI figures
-    from tpu_operator.validator import status as vstatus
-
-    vstatus.write_workload_results(
-        {"distributed": result}, scope=os.environ.get("RESULTS_SCOPE", "")
-    )
+    vstatus.write_workload_results({"distributed": result}, scope=scope)
     return 0 if result["ok"] else 1
 
 

@@ -37,6 +37,7 @@ import sys
 import jax
 import jax.numpy as jnp
 
+from tpu_operator.obs import flight
 from tpu_operator.workloads import timing
 
 
@@ -63,11 +64,20 @@ def hbm_benchmark(
         return y[0] + y[n // 2]
 
     float(null(x))
-    float(chain(x))  # compile + warm
+    compile_s = timing.timed(lambda: float(chain(x)))  # compile + warm
+    flight.record("hbm", "compile", compile_s=compile_s)
     floor = min(
         timing.timed(lambda: float(null(x))) for _ in range(max(2, best_of))
     )
-    raw = sorted(timing.timed(lambda: float(chain(x))) for _ in range(best_of))
+    bytes_per_rep = 2 * x.nbytes * iters
+    raw = []
+    for rep in range(best_of):
+        raw.append(timing.timed(lambda: float(chain(x))))
+        flight.record(
+            "hbm", "step", step=rep, step_s=raw[-1],
+            gbps=bytes_per_rep / raw[-1] / 1e9,
+        )
+    raw = sorted(raw)
     times, overhead_dominated = timing.subtract_floor(raw, floor)
     dt = times[0]
     dt_median = times[len(times) // 2]
@@ -131,6 +141,8 @@ def main() -> int:
         best_of=int(os.environ.get("HBM_BEST_OF", "3")),
     )
     apply_hbm_gate(result, float(os.environ.get("HBM_MIN_GBPS", "0") or 0))
+    flight.record_result("hbm", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 

@@ -163,22 +163,32 @@ def _tile_reference(q_tile, k, v, tile_off, causal):
     return jnp.einsum("btk,bkd->btd", w.astype(v.dtype), v)
 
 
-def _amortized_time(chain_call, null_call, iters: int, best_of: int):
+def _amortized_time(
+    chain_call, null_call, iters: int, best_of: int, name: str = ""
+):
     """The one timing harness both probes run: compile/settle both
     programs, measure the dispatch+readback floor with the null program,
     wall-clock ``best_of`` chained runs, floor-subtract per iteration
     (workloads/timing.py rules).  Returns (per_iter_times_sorted,
     overhead_dominated, last_chain_value) — the full sorted sample list so
     callers publish best AND spread (error-bar rule), the value so callers
-    can fold finiteness into ok."""
+    can fold finiteness into ok.  ``name`` tags each repetition (and the
+    compile) in the flight record."""
+    from tpu_operator.obs import flight
+
+    t_compile = time.perf_counter()
     last = chain_call()  # compile + settle
+    if name:
+        flight.record(name, "compile", compile_s=time.perf_counter() - t_compile)
     null_call()
     overhead = min(timing.timed(null_call) for _ in range(3))
     raw = []
-    for _ in range(best_of):
+    for rep in range(best_of):
         t0 = time.perf_counter()
         last = chain_call()
         raw.append(time.perf_counter() - t0)
+        if name:
+            flight.record(name, "step", step=rep, step_s=raw[-1])
     times, dominated = timing.subtract_floor(raw, overhead, per=iters)
     return times, dominated, last
 
@@ -229,7 +239,8 @@ def prefill_benchmark(
         return jnp.sum(q[0, 0].astype(jnp.float32))
 
     times, overhead_dominated, _ = _amortized_time(
-        lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of
+        lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of,
+        name="longctx",
     )
     dt = times[0]
 
@@ -300,6 +311,10 @@ def main() -> int:
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = quick_check()
+    from tpu_operator.obs import flight
+
+    flight.record_result("longctx", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 
@@ -354,7 +369,8 @@ def decode_benchmark(
         return jnp.sum(q[:, -1].astype(jnp.float32))
 
     times, overhead_dominated, last = _amortized_time(
-        lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of
+        lambda: float(chain(q, k, v)), lambda: float(null(q)), iters, best_of,
+        name="decode",
     )
     dt = times[0]
 

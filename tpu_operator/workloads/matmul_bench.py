@@ -33,6 +33,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from tpu_operator.obs import flight
 from tpu_operator.workloads import timing
 
 
@@ -145,14 +146,23 @@ def _time_matmul(
     float(null(a))  # compile
     overhead = min(timing.timed(lambda: float(null(a))) for _ in range(3))
 
-    for _ in range(max(1, warmup)):
-        float(chain(a, b))  # compile + settle; scalar transfer forces sync
+    compile_s = timing.timed(lambda: float(chain(a, b)))  # compile + settle
+    flight.record("matmul", "compile", compile_s=compile_s, size=size)
+    for _ in range(max(1, warmup) - 1):
+        float(chain(a, b))  # scalar transfer forces sync
     raw = []
     checksum = 0.0
-    for _ in range(best_of):
+    flops_per_matmul = 2.0 * size * size * size
+    for rep in range(best_of):
         t0 = time.perf_counter()
         checksum = float(chain(a, b))
         raw.append(time.perf_counter() - t0)
+        flight.record(
+            "matmul", "step", step=rep, size=size, step_s=raw[-1],
+            # amortized, floor-unsubtracted live rate (shared-rule verdict
+            # applied below; the series is a monitoring signal)
+            tflops=flops_per_matmul * iters / raw[-1] / 1e12,
+        )
     # shared rule (workloads/timing.py): floor-subtract per-matmul time;
     # when the floor rivals the compute, fall back to the unsubtracted,
     # deflated rate and flag it so MFU gates skip rather than trust either
@@ -272,6 +282,8 @@ def main() -> int:
         best_of=int(os.environ.get("MATMUL_BEST_OF", "3")),
     )
     apply_mfu_gate(result, float(os.environ.get("MATMUL_MIN_MFU", "0")))
+    flight.record_result("matmul", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 

@@ -225,6 +225,9 @@ def acceptance(
     err, aux = program(x, wr, w1, w2)
     err = float(err)
     dt = time.perf_counter() - t0
+    from tpu_operator.obs import flight
+
+    flight.record("moe", "run", step_s=dt, tokens=n, max_error=err)
     return {
         "ok": bool(np.isfinite(err) and err < tol),
         "devices": p,
@@ -259,6 +262,10 @@ def main() -> int:
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = quick_check()
+    from tpu_operator.obs import flight
+
+    flight.record_result("moe", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 

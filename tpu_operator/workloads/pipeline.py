@@ -139,6 +139,9 @@ def acceptance(
     t0 = time.perf_counter()
     err = float(program(x, w1, w2))
     dt = time.perf_counter() - t0
+    from tpu_operator.obs import flight
+
+    flight.record("pipeline", "run", step_s=dt, max_error=err)
     return {
         "ok": bool(np.isfinite(err) and err < tol),
         "devices": p,
@@ -172,6 +175,10 @@ def main() -> int:
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = quick_check()
+    from tpu_operator.obs import flight
+
+    flight.record_result("pipeline", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 

@@ -258,12 +258,19 @@ def main() -> int:
 
     # local tracer so each check runs under a phase span: per-check
     # durations land in the printed/drop-boxed evidence here, and in the
-    # workload_phase_duration histogram when run in an instrumented process
-    from tpu_operator.obs import trace
+    # workload_phase_duration histogram when run in an instrumented process.
+    # The flight recorder runs alongside it: every check's per-step samples
+    # (and a summary sample per result) land in the JSONL flight record
+    # beside the results drop-box, tagged with the check span's id — and
+    # stream to the node metrics agent when TPU_METRICS_PUSH_URL is set.
+    from tpu_operator.obs import flight, trace
+    from tpu_operator.validator import status as vstatus
 
+    scope = os.environ.get("RESULTS_SCOPE", "")
+    recorder = flight.recorder_for(vstatus.flight_record_path(scope))
     tracer = trace.Tracer()
     runners = check_runners()
-    with tracer.activate():
+    with tracer.activate(), flight.activate(recorder):
         for check in checks:
             runner = runners.get(check)
             if runner is None:
@@ -279,9 +286,15 @@ def main() -> int:
             else:
                 with trace.span(
                     f"check/{check}", kind=trace.KIND_PHASE, phase=check
-                ) as sp:
+                ):
+                    t0 = time.monotonic()
                     result = runner()
-                result.setdefault("duration_s", sp.duration_s)
+                    result.setdefault(
+                        "duration_s", round(time.monotonic() - t0, 6)
+                    )
+                    # inside the span (the summary sample carries its id),
+                    # after the duration default (so it carries that too)
+                    flight.record_result(check, result)
             print(json.dumps({"check": check, **result}), flush=True)
             results[check] = result
             ok = ok and bool(result.get("ok"))

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import time
+from typing import Optional
 
 
 def gate_backends(env_var: str, default: str = "tpu") -> list[str]:
@@ -45,6 +46,47 @@ def subtract_floor(
     if dominated:
         times = sorted(t / per for t in raw)
     return times, dominated
+
+
+def regression_verdict(
+    current,
+    prior,
+    threshold: float = 0.07,
+    higher_is_better: bool = True,
+) -> Optional[dict]:
+    """The ONE round-over-round comparison rule (bench.py verdicts and the
+    validator's regression Events must agree on what "regressed" means):
+    relative delta against the prior value, verdict ``improved`` / ``flat``
+    / ``regressed`` outside/inside the ``threshold`` band.
+
+    The default band (7%) sits just above the measured run-to-run envelope
+    on the tunneled runner (±3-6%, within-run samples correlated — see
+    bench.py _best_of_runs): a single-run wobble must not page anyone, a
+    real drop (the r01→r02 19% allreduce loss) must.  Returns None when
+    either side is unusable (missing, zero prior, non-numeric) — absence
+    of a verdict is itself evidence the metric wasn't comparable."""
+    if (
+        not isinstance(current, (int, float))
+        or not isinstance(prior, (int, float))
+        or isinstance(current, bool)
+        or isinstance(prior, bool)
+        or prior == 0
+    ):
+        return None
+    delta = (current - prior) / abs(prior)
+    signed = delta if higher_is_better else -delta
+    if signed >= threshold:
+        verdict = "improved"
+    elif signed <= -threshold:
+        verdict = "regressed"
+    else:
+        verdict = "flat"
+    return {
+        "verdict": verdict,
+        "current": current,
+        "prior": prior,
+        "delta_pct": round(delta * 100, 2),
+    }
 
 
 def apply_min_gate(

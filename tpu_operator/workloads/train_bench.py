@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpu_operator.obs import flight
 from tpu_operator.workloads import timing
 
 
@@ -97,15 +98,24 @@ def train_benchmark(
     float(null(x))  # compile
     overhead = min(timing.timed(lambda: float(null(x))) for _ in range(3))
 
+    t_compile = time.perf_counter()
     loss, warm_params = run(params, x)  # compile + settle
     loss0 = float(loss)
+    flight.record(
+        "train", "compile", compile_s=time.perf_counter() - t_compile
+    )
 
     raw = []
-    for _ in range(best_of):
+    for rep in range(best_of):
         t0 = time.perf_counter()
         loss, warm_params = run(warm_params, x)
         float(loss)
         raw.append(time.perf_counter() - t0)
+        flight.record(
+            "train", "step", step=rep,
+            step_s=raw[-1] / steps,
+            tokens_per_sec=b * s * steps / raw[-1],
+        )
     times, overhead_dominated = timing.subtract_floor(raw, overhead, per=steps)
     step_s = times[0]
     step_s_median = times[len(times) // 2]
@@ -168,6 +178,8 @@ def main() -> int:
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = quick_check()
+    flight.record_result("train", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 

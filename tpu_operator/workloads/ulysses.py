@@ -120,6 +120,9 @@ def acceptance(
     t0 = time.perf_counter()
     err = float(program(qs, ks, vs))
     dt = time.perf_counter() - t0
+    from tpu_operator.obs import flight
+
+    flight.record("ulysses", "run", step_s=dt, seq=t, max_error=err)
     return {
         "ok": bool(np.isfinite(err) and err < tol),
         "devices": n,
@@ -152,6 +155,10 @@ def main() -> int:
     workloads.honor_cpu_platform_request()
     compile_cache.enable()
     result = quick_check()
+    from tpu_operator.obs import flight
+
+    flight.record_result("ulysses", result)
+    flight.close_active()
     print(json.dumps(result), flush=True)
     return 0 if result["ok"] else 1
 
