@@ -2,18 +2,23 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench lint counters-docs all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile lint counters-docs async-lint all image e2e-kind
 
 all: proto manifests test
 
-# default test target = lint gate + counter-catalogue drift check + the
-# tier-1 pytest line CI runs
-test: lint counters-docs unit-test
+# default test target = lint gate + counter-catalogue drift check +
+# async-blocking lint + the tier-1 pytest line CI runs
+test: lint counters-docs async-lint unit-test
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
 counters-docs:
 	$(PYTHON) hack/check_counter_docs.py
+
+# no time.sleep / blocking open / subprocess in async bodies under the
+# reconcile pipeline packages (docs/PERFORMANCE.md)
+async-lint:
+	$(PYTHON) hack/check_async_blocking.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal
@@ -54,6 +59,12 @@ bundle:
 
 bench:
 	$(PYTHON) bench.py
+
+# control-plane reconcile bench, small tier (chip-free; ~1 min).  Override
+# the tiers for the full sweep: make bench-reconcile RECONCILE_TIERS=10,100,500
+RECONCILE_TIERS ?= 10
+bench-reconcile:
+	$(PYTHON) bench.py --reconcile --tiers $(RECONCILE_TIERS)
 
 # single image for operator + operands (docker/Dockerfile)
 image:

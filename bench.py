@@ -54,7 +54,10 @@ PRIOR_ROUNDS = {
 
 # metrics where a LOWER number is the improvement (times); everything else
 # compared higher-is-better
-LOWER_IS_BETTER = {"join_to_validated_s", "join_to_schedulable_s", "revalidation_s"}
+LOWER_IS_BETTER = {
+    "join_to_validated_s", "join_to_schedulable_s", "revalidation_s",
+    "reconcile_converge_100n_s", "reconcile_steady_requests_per_pass_100n",
+}
 
 # populated by _exec_workload_pod as the fake kubelet executes the real
 # validation workload: one parsed JSON result per check
@@ -193,6 +196,155 @@ def _best_of_runs(module: str, metric: str, runs_key: str,
     return best
 
 
+RECONCILE_TIERS = (10, 100, 500)
+RECONCILE_CONVERGE_TIMEOUT = 240.0
+_RECONCILE_CONCURRENCY_KNOBS = (
+    "STATE_SYNC_CONCURRENCY", "APPLY_CONCURRENCY", "LIST_SWEEP_CONCURRENCY",
+    "NODE_PATCH_CONCURRENCY", "DELETE_CONCURRENCY",
+)
+
+
+def _write_requests(fc) -> int:
+    return sum(
+        n for (method, _), n in fc.request_counts.items()
+        if method in ("POST", "PUT", "PATCH", "DELETE")
+    )
+
+
+async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
+    """One control-plane tier: ``n_nodes`` TPU nodes join an empty fake
+    cluster at once.
+
+    Measures the part of convergence the OPERATOR owns — wall time from the
+    join until reconcile passes reach their zero-write fixed point (all
+    labels patched, all operand objects applied, status asserted) — plus
+    steady-state passes/sec and apiserver verbs per steady-state pass.  The
+    kubelet sim is off: pod-readiness waves are hardware time the control
+    plane cannot accelerate, and racing them makes the number measure the
+    testbed's CPU scheduling instead of the pipeline (the north-star bench
+    keeps covering the full join→validated path).  Requests pay a 5ms
+    emulated RTT — a production apiserver's typical latency under load — so
+    round-trip counts cost the wall time they cost outside an in-process
+    testbed.
+
+    ``cached=False`` is the pre-optimization baseline — live reads, serial
+    fan-outs, re-render every pass — so the cached run's improvement is
+    measured against the architecture it replaced, in the same process on
+    the same fake apiserver.
+    """
+    from tpu_operator import consts
+    from tpu_operator.api.types import TPUClusterPolicy
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler, informer_specs
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.k8s.informer import Informer
+    from tpu_operator.testing import FakeCluster, SimConfig
+
+    saved = {k: getattr(consts, k) for k in _RECONCILE_CONCURRENCY_KNOBS}
+    saved["RENDER_MEMO"] = consts.RENDER_MEMO
+    if not cached:
+        for k in _RECONCILE_CONCURRENCY_KNOBS:
+            setattr(consts, k, 1)
+        consts.RENDER_MEMO = False
+    try:
+        sim = SimConfig(enabled=False, api_latency=0.005)
+        async with FakeCluster(sim) as fc:
+            async with ApiClient(Config(base_url=fc.base_url)) as client:
+                reconciler = ClusterPolicyReconciler(client, NS)
+                informers: list = []
+                try:
+                    if cached:
+                        for group, kind, ns in informer_specs(NS):
+                            inf = Informer(client, group, kind, namespace=ns)
+                            reconciler.reader.add_informer(inf)
+                            informers.append(inf)
+                        for inf in informers:
+                            await inf.start()
+                    await client.create(TPUClusterPolicy.new().obj)
+                    await reconciler.reconcile("cluster-policy")  # settle empty cluster
+
+                    for i in range(n_nodes):
+                        s, h = divmod(i, 4)
+                        fc.add_node(
+                            f"tpu-{s}-{h}", topology="4x4",
+                            labels={
+                                consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                                consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                            },
+                        )
+
+                    async def drive_to_fixed_point(settle: float) -> int:
+                        """Passes until two consecutive passes write nothing
+                        (the second absorbs a cache-lag echo of no-op
+                        writes); returns the final pass's request total."""
+                        zero_writes = 0
+                        deadline = time.perf_counter() + RECONCILE_CONVERGE_TIMEOUT
+                        while True:
+                            fc.reset_request_counts()
+                            await reconciler.reconcile("cluster-policy")
+                            total = fc.total_requests()
+                            zero_writes = zero_writes + 1 if _write_requests(fc) == 0 else 0
+                            if zero_writes >= 2:
+                                return total
+                            if time.perf_counter() > deadline:
+                                raise TimeoutError(f"{n_nodes}-node tier never settled")
+                            await asyncio.sleep(settle)
+
+                    t0 = time.perf_counter()
+                    await drive_to_fixed_point(settle=0.01)
+                    converge_s = time.perf_counter() - t0
+
+                    # steady state: the fixed point's read-only pass
+                    fc.reset_request_counts()
+                    await reconciler.reconcile("cluster-policy")
+                    steady_requests = fc.total_requests()
+
+                    t1 = time.perf_counter()
+                    passes = 0
+                    while time.perf_counter() - t1 < 1.0:
+                        await reconciler.reconcile("cluster-policy")
+                        passes += 1
+                    passes_per_sec = passes / (time.perf_counter() - t1)
+                    return {
+                        "nodes": n_nodes,
+                        "converge_s": round(converge_s, 3),
+                        "steady_requests_per_pass": steady_requests,
+                        "steady_passes_per_sec": round(passes_per_sec, 2),
+                    }
+                finally:
+                    for inf in informers:
+                        await inf.stop()
+    finally:
+        for k, v in saved.items():
+            setattr(consts, k, v)
+
+
+def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
+    """Cached+concurrent reconcile pipeline across node tiers, plus the
+    serial+live baseline at the comparison tier (100 when present) so the
+    speedup/request ratios are measured, not asserted."""
+    out: dict = {"tiers": {}}
+    for n in tiers:
+        print(f"  reconcile bench: {n}-node tier (cached+concurrent)", file=sys.stderr)
+        out["tiers"][str(n)] = asyncio.run(_reconcile_tier(n, cached=True))
+    base_n = 100 if 100 in tiers else max(tiers)
+    print(f"  reconcile bench: {base_n}-node tier (serial+live baseline)", file=sys.stderr)
+    base = asyncio.run(_reconcile_tier(base_n, cached=False))
+    cur = out["tiers"][str(base_n)]
+    out["baseline"] = base
+    out["converge_speedup"] = round(base["converge_s"] / max(cur["converge_s"], 1e-9), 2)
+    out["steady_request_ratio"] = round(
+        base["steady_requests_per_pass"] / max(cur["steady_requests_per_pass"], 1), 2
+    )
+    print(
+        f"  reconcile bench: converge {base['converge_s']:.2f}s -> "
+        f"{cur['converge_s']:.2f}s ({out['converge_speedup']}x), steady verbs/pass "
+        f"{base['steady_requests_per_pass']} -> {cur['steady_requests_per_pass']} "
+        f"({out['steady_request_ratio']}x fewer)",
+        file=sys.stderr,
+    )
+    return out
+
+
 def run_matmul_bench() -> dict:
     """The compute third of the perf triad: bf16 matmul sweep → TFLOPs →
     MFU; best of two runs, both recorded (_best_of_runs)."""
@@ -238,6 +390,10 @@ def _bench_metrics(output: dict) -> dict:
     put("hbm_gbps", (detail.get("hbm") or {}).get("gbps"))
     put("train_tokens_per_sec", (detail.get("train") or {}).get("tokens_per_sec"))
     put("train_mfu", (detail.get("train") or {}).get("train_mfu"))
+    t100 = ((detail.get("reconcile") or {}).get("tiers") or {}).get("100") or {}
+    put("reconcile_converge_100n_s", t100.get("converge_s"))
+    put("reconcile_steady_requests_per_pass_100n", t100.get("steady_requests_per_pass"))
+    put("reconcile_steady_passes_per_sec_100n", t100.get("steady_passes_per_sec"))
     return metrics
 
 
@@ -475,8 +631,37 @@ async def bench() -> dict:
 
 
 def main() -> None:
+    # `bench.py --reconcile [--tiers 10,100]`: control-plane bench only
+    # (no chip needed) — the `make bench-reconcile` entry point
+    if "--reconcile" in sys.argv:
+        tiers = RECONCILE_TIERS
+        if "--tiers" in sys.argv:
+            try:
+                raw = sys.argv[sys.argv.index("--tiers") + 1]
+                tiers = tuple(int(t) for t in raw.split(",") if t)
+            except (IndexError, ValueError):
+                tiers = ()
+            if not tiers:
+                sys.exit("usage: bench.py --reconcile [--tiers N[,N...]]")
+        rec = run_reconcile_bench(tiers)
+        comparison = rec["baseline"]["nodes"]
+        cur = rec["tiers"][str(comparison)]
+        print(json.dumps({
+            "metric": "reconcile_steady_api_requests_per_pass",
+            "value": cur["steady_requests_per_pass"],
+            "unit": "requests",
+            "nodes": comparison,
+            "converge_speedup": rec["converge_speedup"],
+            "steady_request_ratio": rec["steady_request_ratio"],
+            "detail": rec,
+        }))
+        return
+
     result = asyncio.run(bench())
     value = result["join_to_validated_s"]
+
+    # phase 2d: control-plane reconcile tiers (fake cluster only, chip idle)
+    reconcile = run_reconcile_bench()
 
     # phase 3: compute + bandwidth detail on the now-free chip.
     # Detail numbers come from the COLD run only — the re-validation appended
@@ -549,6 +734,7 @@ def main() -> None:
             k: checks.get("burn-in", {}).get(k)
             for k in ("ok", "devices", "time_s")
         },
+        "reconcile": reconcile,
         "prior_rounds": PRIOR_ROUNDS,
     }
     output = {

@@ -145,6 +145,8 @@ async def test_validation_timeout_marks_failed():
     async with FakeCluster(SimConfig(enabled=False)) as fc:
         client = await _mk_cluster(fc, n_nodes=1)
         cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+        # LIST items omit TypeMeta (real-apiserver semantics); re-GET to mutate
+        cr = await client.get("tpu.google.com", "TPUClusterPolicy", cr["metadata"]["name"])
         cr["spec"]["libtpu"]["upgradePolicy"]["validationTimeoutSeconds"] = 1
         await client.update(cr)
         _runtime_pod(fc, "tpu-0")
@@ -181,6 +183,8 @@ async def test_done_node_re_upgrades_on_new_version():
             assert node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] == up.DONE
 
             cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+            # LIST items omit TypeMeta (real-apiserver semantics); re-GET to mutate
+            cr = await client.get("tpu.google.com", "TPUClusterPolicy", cr["metadata"]["name"])
             cr["spec"]["libtpu"]["libtpuVersion"] = "v3"
             await client.update(cr)
             await r.reconcile("upgrade")
@@ -230,6 +234,8 @@ async def test_disable_clears_labels():
             assert consts.UPGRADE_STATE_LABEL in node["metadata"]["labels"]
             # flip auto-upgrade off
             cr = (await client.list_items("tpu.google.com", "TPUClusterPolicy"))[0]
+            # LIST items omit TypeMeta (real-apiserver semantics); re-GET to mutate
+            cr = await client.get("tpu.google.com", "TPUClusterPolicy", cr["metadata"]["name"])
             cr["spec"]["libtpu"]["upgradePolicy"]["autoUpgrade"] = False
             await client.update(cr)
             await r.reconcile("upgrade")

@@ -181,6 +181,17 @@ UPGRADE_REQUEUE_SECONDS = 120.0      # upgrade_controller.go:58,196
 REMEDIATION_REQUEUE_SECONDS = 30.0   # validation rounds are minutes, not hours
 RATE_LIMIT_BASE_SECONDS = 0.1        # clusterpolicy_controller.go:354
 RATE_LIMIT_MAX_SECONDS = 3.0
+
+# Reconcile-pipeline fan-out bounds (docs/PERFORMANCE.md).  Read at call
+# time, not def time, so the reconcile bench can A/B a serial pipeline.
+# Ordering stays correct under fan-out because operand ordering is enforced
+# node-locally by init-container gates, not by apply order (state/manager.py).
+RENDER_MEMO = True                   # reuse rendered manifests while (ctx, spec) unchanged
+STATE_SYNC_CONCURRENCY = 4           # operand states synced at once
+APPLY_CONCURRENCY = 8                # create_or_update calls per state
+LIST_SWEEP_CONCURRENCY = 6           # labeled-list GVK sweeps at once
+NODE_PATCH_CONCURRENCY = 16          # node label PATCHes at once
+DELETE_CONCURRENCY = 8               # delete_collection fan-out
 VALIDATOR_SLEEP_SECONDS = 5.0        # validator/main.go:133-134
 VALIDATOR_WORKLOAD_RETRIES = 60      # :167-170
 VALIDATOR_RESOURCE_RETRIES = 30      # :171-174

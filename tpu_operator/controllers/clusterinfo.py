@@ -52,11 +52,10 @@ async def gather(client: ApiClient, namespace: str, nodes: Optional[list[dict]] 
             runtime = r
             break
 
+    # TTL-memoized on a CachedReader (one probe per 10min, not per pass)
     k8s_version = ""
     try:
-        info = await client._request("GET", "/version")
-        if isinstance(info, dict):
-            k8s_version = info.get("gitVersion", "")
+        k8s_version = await client.get_version()
     except (ApiError, OSError):
         pass
 

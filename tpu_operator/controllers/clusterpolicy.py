@@ -23,7 +23,9 @@ from tpu_operator.api.types import (
 )
 from tpu_operator.controllers import clusterinfo, labels
 from tpu_operator.controllers.runtime import Controller, Manager
-from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.k8s import objects as obj_api
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiClient, ApiError, count_api_requests
 from tpu_operator.metrics import (
     OperatorMetrics,
     RECONCILE_FAILED,
@@ -35,10 +37,26 @@ from tpu_operator.obs.events import EventRecorder
 from tpu_operator.obs.trace import Tracer
 from tpu_operator.render import Renderer
 from tpu_operator.state.manager import StateManager, SyncResults
-from tpu_operator.state.skel import SyncState
+from tpu_operator.state.skel import SUPPORTED_GVKS, SyncState
 from tpu_operator.utils import deep_get
 
 log = logging.getLogger("tpu_operator.clusterpolicy")
+
+
+def informer_specs(namespace: str) -> list[tuple[str, str, Optional[str]]]:
+    """(group, kind, namespace) tuples the CachedReader wants watched so a
+    steady-state reconcile pass is nearly API-free: the CR itself, Nodes,
+    the operator Namespace (PSA labels), and every operand-owned GVK
+    (namespaced kinds scoped to the operator namespace)."""
+    specs: list[tuple[str, str, Optional[str]]] = [
+        (GROUP, CLUSTER_POLICY_KIND, None),
+        ("", "Node", None),
+        ("", "Namespace", None),
+    ]
+    for group, kind in SUPPORTED_GVKS:
+        namespaced = obj_api.lookup(group, kind).namespaced
+        specs.append((group, kind, namespace if namespaced else None))
+    return specs
 
 
 class ClusterPolicyReconciler:
@@ -55,6 +73,10 @@ class ClusterPolicyReconciler:
         self.namespace = namespace
         self.state_manager = StateManager(renderer)
         self.metrics = metrics or OperatorMetrics()
+        # all reconcile-path reads/writes go through the reader; without
+        # registered informers (direct-drive tests) every read falls back
+        # live and behaviour is identical to the raw client
+        self.reader = CachedReader(client, metrics=self.metrics)
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
         # last observed per-operand sync state, for transition Events —
@@ -65,12 +87,18 @@ class ClusterPolicyReconciler:
     # ------------------------------------------------------------------
     async def reconcile(self, name: str) -> Optional[float]:
         with self.tracer.reconcile("clusterpolicy", key=name):
-            return await self._reconcile(name)
+            with count_api_requests() as counter:
+                try:
+                    return await self._reconcile(name)
+                finally:
+                    # informer watches run outside this context; the tally is
+                    # the pass's own live API footprint (0 when cache-served)
+                    self.metrics.api_requests_per_reconcile.observe(counter.n)
 
     async def _reconcile(self, name: str) -> Optional[float]:
         self.metrics.reconciliation_total.inc()
         try:
-            obj = await self.client.get(GROUP, CLUSTER_POLICY_KIND, name)
+            obj = await self.reader.get(GROUP, CLUSTER_POLICY_KIND, name)
         except ApiError as e:
             if e.not_found:
                 # deleted; owned objects go via GC.  Drop the transition
@@ -84,20 +112,20 @@ class ClusterPolicyReconciler:
         policy = TPUClusterPolicy.from_obj(obj)
 
         # Singleton guard: oldest CR wins; later ones are Ignored.
-        oldest = await clusterinfo.active_cluster_policy(self.client)
+        oldest = await clusterinfo.active_cluster_policy(self.reader)
         if oldest is None or oldest["metadata"]["name"] != name:
             await self._update_status(policy, State.IGNORED, "another TPUClusterPolicy is active")
             return None
 
-        nodes = await self.client.list_items("", "Node")
-        ctx = await clusterinfo.gather(self.client, self.namespace, nodes=nodes)
-        ctx.tpu_node_count = await labels.label_tpu_nodes(self.client, policy.spec, nodes=nodes)
-        await labels.label_slice_readiness(self.client, nodes)
+        nodes = await self.reader.list_items("", "Node")
+        ctx = await clusterinfo.gather(self.reader, self.namespace, nodes=nodes)
+        ctx.tpu_node_count = await labels.label_tpu_nodes(self.reader, policy.spec, nodes=nodes)
+        await labels.label_slice_readiness(self.reader, nodes)
         # BEFORE sync: under a restricted PSA default the privileged operand
         # pods the sync creates would be rejected at admission if the
         # namespace weren't labelled yet (in production the operator's own
         # namespace always exists; a fresh fake cluster labels on pass 2)
-        await labels.apply_pod_security_labels(self.client, self.namespace, policy.spec)
+        await labels.apply_pod_security_labels(self.reader, self.namespace, policy.spec)
         self.metrics.tpu_nodes_total.set(ctx.tpu_node_count)
         self.metrics.has_gke_tpu_labels.set(1 if ctx.tpu_node_count else 0)
 
@@ -107,7 +135,7 @@ class ClusterPolicyReconciler:
         # tpu-runtime-daemonset — two installers must never race over
         # /home/kubernetes/tpu (state_manager.go:955-965 bypass analogue,
         # done via the ordinary disable machinery instead).
-        results = await self.state_manager.sync(self.client, ctx, policy)
+        results = await self.state_manager.sync(self.reader, ctx, policy)
 
         for r in results.results:
             self.metrics.operand_state.labels(state=r.name).set(
@@ -197,11 +225,29 @@ class ClusterPolicyReconciler:
         if policy.obj.get("status") == old_status:
             return
         try:
-            await self.client.update_status(policy.obj)
+            # through the reader: the write-through keeps the cached CR's
+            # status current so the next pass doesn't re-assert it
+            await self.reader.update_status(policy.obj)
         except ApiError as e:
             if not e.conflict:
                 raise
-            # stale CR copy; next reconcile pass re-reads and re-asserts
+            # Stale CR copy (cached read lag or a concurrent spec writer):
+            # re-read LIVE, graft the computed status onto the fresh object,
+            # and retry the PUT once; a second conflict defers to the next
+            # pass rather than dropping the status silently every time.
+            name = deep_get(policy.obj, "metadata", "name", default="")
+            try:
+                fresh = await self.reader.live.get(GROUP, CLUSTER_POLICY_KIND, name)
+            except ApiError as e3:
+                if e3.not_found:
+                    return  # CR deleted under us; nothing to assert status on
+                raise  # transient failure: propagate for workqueue backoff
+            fresh["status"] = policy.obj.get("status")
+            try:
+                await self.reader.update_status(fresh)
+            except ApiError as e2:
+                if not e2.conflict:
+                    raise
 
     # ------------------------------------------------------------------
     # Watch wiring (SetupWithManager analogue).
@@ -212,6 +258,21 @@ class ClusterPolicyReconciler:
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")
         daemonsets = mgr.informer("apps", "DaemonSet", namespace=self.namespace)
+
+        # Back the CachedReader with informers on every GVK the reconcile
+        # chain reads.  The three event-wired ones above stay required
+        # (manager start blocks on their sync); the rest are optional — a
+        # kind whose API is absent (ServiceMonitor without the prometheus
+        # CRDs) must not hang startup, its reads simply stay live.
+        wired = {(GROUP, CLUSTER_POLICY_KIND), ("", "Node"), ("apps", "DaemonSet")}
+        for group, kind, ns in informer_specs(self.namespace):
+            if (group, kind) in wired:
+                continue
+            self.reader.add_informer(
+                mgr.informer(group, kind, namespace=ns, required=False)
+            )
+        for inf in (policies, nodes, daemonsets):
+            self.reader.add_informer(inf)
 
         async def on_policy(event_type: str, obj: dict) -> None:
             controller.enqueue(obj["metadata"]["name"])

@@ -23,7 +23,7 @@ from tpu_operator.api.types import TPUClusterPolicySpec
 from tpu_operator.controllers.clusterinfo import is_tpu_node
 from tpu_operator.k8s import nodeinfo
 from tpu_operator.k8s.client import ApiClient
-from tpu_operator.utils import deep_get
+from tpu_operator.utils import bounded_gather, deep_get
 
 log = logging.getLogger("tpu_operator.labels")
 
@@ -111,6 +111,7 @@ async def label_slice_readiness(
             groups.setdefault(key, []).append(node)
 
     result: dict[str, bool] = {}
+    patches: list[tuple[str, str]] = []  # (node name, label value)
     for key, members in groups.items():
         labels_of = {m["metadata"]["name"]: (deep_get(m, "metadata", "labels", default={}) or {}) for m in members}
         expected = max(nodeinfo.slice_hosts(m) for m in members)
@@ -121,10 +122,19 @@ async def label_slice_readiness(
         value = "true" if ready else "false"
         for m in members:
             if labels_of[m["metadata"]["name"]].get(consts.SLICE_READY_LABEL) != value:
-                await client.patch(
-                    "", "Node", m["metadata"]["name"],
-                    {"metadata": {"labels": {consts.SLICE_READY_LABEL: value}}},
-                )
+                patches.append((m["metadata"]["name"], value))
+    # per-node patches are independent; bounded fan-out keeps a big slice
+    # join from serializing hundreds of round-trips
+    await bounded_gather(
+        (
+            client.patch(
+                "", "Node", name,
+                {"metadata": {"labels": {consts.SLICE_READY_LABEL: value}}},
+            )
+            for name, value in patches
+        ),
+        limit=consts.NODE_PATCH_CONCURRENCY,
+    )
     return result
 
 
@@ -188,6 +198,7 @@ async def label_tpu_nodes(
     if nodes is None:
         nodes = await client.list_items("", "Node")
     tpu_count = 0
+    todo: list[tuple[str, dict]] = []  # (node name, label patch)
     for node in nodes:
         if is_tpu_node(node):
             tpu_count += 1
@@ -200,8 +211,16 @@ async def label_tpu_nodes(
             elif value is not None and current.get(key) != value:
                 patch_labels[key] = value
         if patch_labels:
-            await client.patch(
-                "", "Node", node["metadata"]["name"], {"metadata": {"labels": patch_labels}}
-            )
-            log.info("labelled node %s: %s", node["metadata"]["name"], patch_labels)
+            todo.append((node["metadata"]["name"], patch_labels))
+
+    async def patch_one(name: str, patch_labels: dict) -> None:
+        await client.patch("", "Node", name, {"metadata": {"labels": patch_labels}})
+        log.info("labelled node %s: %s", name, patch_labels)
+
+    # a 100-node join is 100 independent patches; fan out bounded instead of
+    # paying the round-trips serially
+    await bounded_gather(
+        (patch_one(name, patch) for name, patch in todo),
+        limit=consts.NODE_PATCH_CONCURRENCY,
+    )
     return tpu_count

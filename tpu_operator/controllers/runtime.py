@@ -73,7 +73,7 @@ class Controller:
         if delay <= 0:
             self.enqueue(key)
             return
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         existing = self._timers.get(key)
         if existing is not None:
             if existing.when() - loop.time() <= delay:
@@ -157,6 +157,11 @@ class Manager:
         key = f"{group}/{kind}/{kw.get('namespace') or ''}/{kw.get('label_selector') or ''}"
         if key not in self.informers:
             self.informers[key] = Informer(self.client, group, kind, **kw)
+        elif kw.get("required", True) and not self.informers[key].required:
+            # a stricter caller must win regardless of setup() order: a
+            # cache-backing (optional) registration must not silently strip
+            # another controller's informer of start/readyz gating
+            self.informers[key].required = True
         return self.informers[key]
 
     def add_controller(self, controller: Controller) -> Controller:
@@ -175,8 +180,11 @@ class Manager:
             await self.elector.start()
             await self.elector.is_leader.wait()
         await self._start_http()
+        # optional (cache-backing) informers start without blocking on sync:
+        # an unserved GVK keeps retrying in the background while reads of it
+        # fall back live (k8s/cache.py)
         for informer in self.informers.values():
-            await informer.start()
+            await informer.start(wait=informer.required)
         for controller in self.controllers:
             await controller.start()
         self.started.set()
@@ -241,7 +249,11 @@ class Manager:
         return web.Response(text="ok")
 
     async def _readyz(self, request: web.Request) -> web.Response:
-        synced = all(i.synced.is_set() for i in self.informers.values())
+        # only required informers gate readiness; an optional informer for an
+        # absent API (e.g. ServiceMonitor) never syncs and must not wedge it
+        synced = all(
+            i.synced.is_set() for i in self.informers.values() if i.required
+        )
         return web.Response(text="ok" if synced else "not ready", status=200 if synced else 503)
 
     async def _metrics(self, request: web.Request) -> web.Response:

@@ -10,6 +10,7 @@ pointless DaemonSet restarts).
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import logging
 from typing import Optional
@@ -47,13 +48,36 @@ async def create_or_update(
     - sets the controller ownerReference when an owner is given
     - skips the update entirely when the desired-hash annotation matches
     """
-    with trace.span(
-        f"apply/{obj.get('kind', '')}",
-        kind=trace.KIND_APPLY,
-        object_kind=obj.get("kind", ""),
-        object_name=(obj.get("metadata") or {}).get("name", ""),
-    ):
-        return await _create_or_update(client, obj, owner, state_label)
+    # in-flight gauge when the client is a CachedReader carrying metrics
+    inflight = getattr(client, "inflight_apply", None)
+    with inflight() if inflight is not None else contextlib.nullcontext():
+        with trace.span(
+            f"apply/{obj.get('kind', '')}",
+            kind=trace.KIND_APPLY,
+            object_kind=obj.get("kind", ""),
+            object_name=(obj.get("metadata") or {}).get("name", ""),
+        ):
+            return await _create_or_update(client, obj, owner, state_label)
+
+
+def _prepare_update(obj: dict, live: dict, gvk) -> None:
+    """Carry server-owned fields from ``live`` into the desired ``obj`` ahead
+    of a full-replace PUT: the resourceVersion for optimistic concurrency,
+    plus fields we do not manage (state_skel.go:358-380 analogue)."""
+    obj["metadata"]["resourceVersion"] = live["metadata"].get("resourceVersion")
+    if gvk.kind == "ServiceAccount":
+        for f in ("secrets", "imagePullSecrets"):
+            if f in live and f not in obj:
+                obj[f] = live[f]
+    if gvk.kind == "Service":
+        # immutable/server-allocated Service fields: a full-replace PUT that
+        # omits spec.clusterIP is a 422 on a real apiserver, wedging the
+        # owning state in ERROR on any Service drift
+        live_spec = live.get("spec") or {}
+        spec = obj.setdefault("spec", {})
+        for f in ("clusterIP", "clusterIPs", "ipFamilies", "ipFamilyPolicy", "healthCheckNodePort"):
+            if f in live_spec and f not in spec:
+                spec[f] = live_spec[f]
 
 
 async def _create_or_update(
@@ -72,41 +96,76 @@ async def _create_or_update(
     meta.setdefault("annotations", {})[consts.LAST_APPLIED_HASH_ANNOTATION] = h
 
     gvk = obj_api.gvk_of(obj)
+    # conflict/race recovery must re-read the apiserver, not the informer
+    # store — with a CachedReader the cached copy IS the stale copy
+    live_client = getattr(client, "live", client)
+
+    # The GET is served from the informer cache when the client is a
+    # CachedReader watching this GVK: a steady-state pass whose cached copy
+    # already carries the desired hash costs ZERO API requests.
+    live: Optional[dict] = None
     try:
         live = await client.get(gvk.group, gvk.kind, meta["name"], meta.get("namespace"))
     except ApiError as e:
         if not e.not_found:
             raise
-        created = await client.create(obj)
-        log.info("created %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
-        return created, True
 
-    live_hash = (live.get("metadata", {}).get("annotations") or {}).get(
-        consts.LAST_APPLIED_HASH_ANNOTATION
-    )
-    if live_hash == h:
-        return live, False
-
-    # Replace: keep server-side resourceVersion for optimistic concurrency,
-    # preserve ServiceAccount secrets-style server additions by carrying over
-    # fields we do not manage (state_skel.go:358-380 analogue).
-    obj["metadata"]["resourceVersion"] = live["metadata"].get("resourceVersion")
-    if gvk.kind == "ServiceAccount":
-        for f in ("secrets", "imagePullSecrets"):
-            if f in live and f not in obj:
-                obj[f] = live[f]
-    if gvk.kind == "Service":
-        # immutable/server-allocated Service fields: a full-replace PUT that
-        # omits spec.clusterIP is a 422 on a real apiserver, wedging the
-        # owning state in ERROR on any Service drift
-        live_spec = live.get("spec") or {}
-        spec = obj.setdefault("spec", {})
-        for f in ("clusterIP", "clusterIPs", "ipFamilies", "ipFamilyPolicy", "healthCheckNodePort"):
-            if f in live_spec and f not in spec:
-                spec[f] = live_spec[f]
-    updated = await client.update(obj)
-    log.info("updated %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
-    return updated, True
+    # Up to three rounds of create-if-absent / hash-skip / replace.  Every
+    # recoverable race — a lost get-before-create (409 AlreadyExists), a
+    # stale resourceVersion (informer lag or a concurrent writer, 409
+    # Conflict), or the object deleted under us (404 on PUT, or the 409'd
+    # creation finishing its termination) — re-reads LIVE and retries; the
+    # final round surfaces whatever the apiserver says.  A recreate after a
+    # deletion must start from the PRISTINE desired object: _prepare_update
+    # grafts server-allocated fields (Service clusterIP, SA secrets) from
+    # the now-deleted live copy, and resurrecting those in a POST is a 422.
+    pristine = copy.deepcopy(obj)
+    for round_ in range(3):
+        last = round_ == 2
+        if live is None:
+            try:
+                created = await client.create(obj)
+                log.info("created %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
+                return created, True
+            except ApiError as e:
+                if not e.already_exists or last:
+                    raise
+                # another pass/replica won the race; adopt the winner
+                try:
+                    live = await live_client.get(gvk.group, gvk.kind, meta["name"], meta.get("namespace"))
+                except ApiError as e2:
+                    if not e2.not_found:
+                        raise
+                    # the 409 came from an object mid-termination that has
+                    # since finished deleting; create again next round
+                continue
+        live_hash = (live.get("metadata", {}).get("annotations") or {}).get(
+            consts.LAST_APPLIED_HASH_ANNOTATION
+        )
+        if live_hash == h:
+            return live, False
+        _prepare_update(obj, live, gvk)
+        try:
+            updated = await client.update(obj)
+        except ApiError as e:
+            if e.not_found and not last:
+                # deleted under us (cached copy outlived the object)
+                obj = copy.deepcopy(pristine)
+                live = None
+                continue
+            if not e.conflict or last:
+                raise
+            try:
+                live = await live_client.get(gvk.group, gvk.kind, meta["name"], meta.get("namespace"))
+            except ApiError as e2:
+                if not e2.not_found:
+                    raise
+                obj = copy.deepcopy(pristine)
+                live = None
+            continue
+        log.info("updated %s %s/%s", gvk.kind, meta.get("namespace", ""), meta["name"])
+        return updated, True
+    raise AssertionError("unreachable: final round returns or raises")
 
 
 async def delete_if_exists(client: ApiClient, obj: dict) -> None:

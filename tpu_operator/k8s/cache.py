@@ -1,0 +1,193 @@
+"""Informer-backed cached read layer.
+
+Controller-runtime's split-client analogue: reads (get/list) are served from
+the shared informer stores when a live informer watches the requested GVK at
+the requested scope, with live-API fallback on any miss; writes always pass
+through to the real :class:`~tpu_operator.k8s.client.ApiClient`.  Steady-state
+reconcile passes become nearly API-free — the fan-out that used to pay one
+GET/LIST round-trip per object per pass reads local memory instead (see
+docs/PERFORMANCE.md for the measured budget).
+
+Correctness model: the cache may lag the apiserver by the watch-event
+propagation delay.  Readers that *mutate* based on a cached copy recover from
+staleness at write time — an optimistic-concurrency 409 re-reads live and
+retries (``k8s/apply.py``, ``_update_status``) — and a cached *miss* (object
+not in the store) always falls back to a live GET, so a just-created object
+is never misread as absent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import time
+from typing import Any, Iterator, Optional
+
+from tpu_operator.k8s import objects as obj_api
+from tpu_operator.k8s import selectors
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.k8s.informer import Informer
+
+VERSION_TTL_SECONDS = 600.0
+
+
+class CachedReader:
+    """Read-through cache over an ``ApiClient`` plus registered informers.
+
+    Drop-in for ``ApiClient`` anywhere in the reconcile chain: ``get`` /
+    ``list`` / ``list_items`` are intercepted; every other attribute
+    (create/update/patch/delete/update_status/watch/...) delegates to the
+    live client.  ``live`` exposes the raw client for reads that must bypass
+    the cache (conflict recovery).
+    """
+
+    def __init__(self, client: ApiClient, metrics: Optional[Any] = None):
+        self.live = client
+        self.metrics = metrics
+        self._informers: dict[tuple[str, str], Informer] = {}
+        self._version: Optional[str] = None
+        self._version_at = 0.0
+
+    def add_informer(self, informer: Informer) -> None:
+        self._informers[(informer.group, informer.kind)] = informer
+
+    def informer_for(self, group: str, kind: str, namespace: Optional[str]) -> Optional[Informer]:
+        """The informer able to serve reads of (group, kind) at ``namespace``
+        scope, or None (not watched / not yet synced / scope or selector
+        narrower than the request)."""
+        inf = self._informers.get((group, kind))
+        if inf is None or not inf.synced.is_set():
+            return None
+        if inf.namespace and inf.namespace != namespace:
+            return None
+        if inf.label_selector:
+            # a filtered watch cannot answer arbitrary reads of the kind
+            return None
+        return inf
+
+    # ------------------------------------------------------------------
+    def _hit(self, kind: str) -> None:
+        if self.metrics is not None:
+            self.metrics.cache_hits_total.labels(kind=kind).inc()
+
+    def _miss(self, kind: str) -> None:
+        if self.metrics is not None:
+            self.metrics.cache_misses_total.labels(kind=kind).inc()
+
+    @contextlib.contextmanager
+    def inflight_apply(self) -> Iterator[None]:
+        """Tracks tpu_operator_inflight_applies around one create_or_update
+        (the apply layer picks this up by duck-typing on its client)."""
+        gauge = getattr(self.metrics, "inflight_applies", None)
+        if gauge is not None:
+            gauge.inc()
+        try:
+            yield
+        finally:
+            if gauge is not None:
+                gauge.dec()
+
+    # ------------------------------------------------------------------
+    async def get(self, group: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        inf = self.informer_for(group, kind, namespace)
+        if inf is not None:
+            obj = inf.get(name, namespace or "")
+            if obj is not None:
+                self._hit(kind)
+                # deepcopy: callers mutate (hash stamping, status edits) and
+                # must never write into the informer's store
+                return copy.deepcopy(obj)
+            # absent from the store is NOT proof of absence (informer lag on
+            # a fresh create); only a live GET may conclude NotFound
+        self._miss(kind)
+        return await self.live.get(group, kind, name, namespace)
+
+    async def list(
+        self,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict:
+        inf = self.informer_for(group, kind, namespace)
+        if inf is not None and field_selector is None:
+            self._hit(kind)
+            items = inf.items()
+            if namespace:
+                items = [
+                    o for o in items if o.get("metadata", {}).get("namespace") == namespace
+                ]
+            if label_selector:
+                reqs = selectors.parse(label_selector)
+                items = [
+                    o for o in items
+                    if all(r.matches(o.get("metadata", {}).get("labels") or {}) for r in reqs)
+                ]
+            return {"items": copy.deepcopy(items)}
+        self._miss(kind)
+        return await self.live.list(group, kind, namespace, label_selector, field_selector)
+
+    async def list_items(self, *args, **kwargs) -> list[dict]:
+        return (await self.list(*args, **kwargs)).get("items", [])
+
+    async def get_version(self) -> str:
+        """TTL-memoized /version: one live probe per TTL window instead of
+        one per reconcile pass."""
+        now = time.monotonic()
+        if self._version is None or now - self._version_at > VERSION_TTL_SECONDS:
+            self._version = await self.live.get_version()
+            self._version_at = now
+        return self._version
+
+    # ------------------------------------------------------------------
+    # Read-your-writes: successful mutations are written through into the
+    # backing informer store immediately.  Without this, the pass AFTER a
+    # write reads the pre-write cache (watch-event lag) and re-issues the
+    # same mutation as a wasted no-op request; the watch later delivers the
+    # same object and the store converges regardless.
+
+    def _write_through(self, obj: Optional[dict]) -> None:
+        if not isinstance(obj, dict):
+            return
+        meta = obj.get("metadata") or {}
+        try:
+            gvk = obj_api.gvk_of(obj)
+        except Exception:  # noqa: BLE001 — unregistered kind: nothing watches it
+            return
+        inf = self._informers.get((gvk.group, gvk.kind))
+        if inf is None or not inf.synced.is_set() or not meta.get("name"):
+            return
+        inf.cache[(meta.get("namespace", "") or "", meta["name"])] = copy.deepcopy(obj)
+
+    async def create(self, obj: dict) -> dict:
+        created = await self.live.create(obj)
+        self._write_through(created)
+        return created
+
+    async def update(self, obj: dict) -> dict:
+        updated = await self.live.update(obj)
+        self._write_through(updated)
+        return updated
+
+    async def update_status(self, obj: dict) -> dict:
+        updated = await self.live.update_status(obj)
+        self._write_through(updated)
+        return updated
+
+    async def patch(self, group: str, kind: str, name: str, patch: Any, **kwargs) -> dict:
+        patched = await self.live.patch(group, kind, name, patch, **kwargs)
+        self._write_through(patched)
+        return patched
+
+    async def delete(self, group: str, kind: str, name: str,
+                     namespace: Optional[str] = None, **kwargs) -> Optional[dict]:
+        result = await self.live.delete(group, kind, name, namespace, **kwargs)
+        inf = self._informers.get((group, kind))
+        if inf is not None:
+            inf.cache.pop((namespace or "", name), None)
+        return result
+
+    # everything else passes straight through
+    def __getattr__(self, name: str):
+        return getattr(self.live, name)

@@ -10,18 +10,22 @@ point it at the in-process fake apiserver via ``Config(base_url=...)``).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import os
 import ssl
 import time
+from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Iterator, Optional
 
 import aiohttp
 
+from tpu_operator import consts
 from tpu_operator.k8s import objects as obj_api
 from tpu_operator.obs import trace
+from tpu_operator.utils import bounded_gather
 
 log = logging.getLogger("tpu_operator.k8s")
 
@@ -73,19 +77,53 @@ class ApiError(Exception):
     def not_found(self) -> bool:
         return self.status == 404
 
+    # A 409 is two distinct situations the apiserver distinguishes by reason:
+    # an optimistic-concurrency resourceVersion conflict ("Conflict") vs a
+    # get-before-create race lost to another writer ("AlreadyExists").  The
+    # recovery differs — conflict re-reads and retries, already-exists adopts
+    # the existing object — so the predicates must not alias.
     @property
     def conflict(self) -> bool:
-        return self.status == 409
+        return self.status == 409 and self.reason != "AlreadyExists"
 
     @property
     def already_exists(self) -> bool:
-        return self.status == 409
+        return self.status == 409 and self.reason == "AlreadyExists"
 
 
 @dataclass
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED | BOOKMARK | ERROR
     object: dict
+
+
+class RequestCounter:
+    """Mutable per-context API-request tally (see ``count_api_requests``)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+
+# Ambient request tally: a reconcile pass installs a counter here and every
+# ApiClient._request within that task tree (child tasks copy the context and
+# share the same counter object) increments it — informer background watches
+# run outside the pass's context and are excluded by construction.  Feeds
+# tpu_operator_k8s_requests_per_reconcile.
+_REQUEST_COUNTER: ContextVar[Optional[RequestCounter]] = ContextVar(
+    "tpu_operator_k8s_request_counter", default=None
+)
+
+
+@contextlib.contextmanager
+def count_api_requests() -> Iterator[RequestCounter]:
+    counter = RequestCounter()
+    token = _REQUEST_COUNTER.set(counter)
+    try:
+        yield counter
+    finally:
+        _REQUEST_COUNTER.reset(token)
 
 
 class ApiClient:
@@ -122,7 +160,7 @@ class ApiClient:
             if self._session and not self._session.closed:
                 # rebuild the session so the new Authorization header applies;
                 # hold a strong ref to the close task or it may be GC'd unrun
-                task = asyncio.get_event_loop().create_task(self._session.close())
+                task = asyncio.get_running_loop().create_task(self._session.close())
                 self._pending_closes.add(task)
                 task.add_done_callback(self._pending_closes.discard)
                 self._session = None
@@ -163,6 +201,9 @@ class ApiClient:
         content_type: str = "application/json",
     ) -> Any:
         sess = await self.session()
+        counter = _REQUEST_COUNTER.get()
+        if counter is not None:
+            counter.n += 1
         data = None
         headers = {}
         if body is not None:
@@ -202,6 +243,12 @@ class ApiClient:
     # ------------------------------------------------------------------
     # Typed-by-kind convenience API. All objects are plain dicts
     # ("unstructured") with apiVersion/kind/metadata.
+
+    async def get_version(self) -> str:
+        """Server version string (overridden with a TTL memo by CachedReader;
+        the version of a running control plane effectively never changes)."""
+        info = await self._request("GET", "/version")
+        return info.get("gitVersion", "") if isinstance(info, dict) else ""
 
     async def get(self, group: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
         info = obj_api.lookup(group, kind)
@@ -283,9 +330,18 @@ class ApiClient:
         self, group: str, kind: str, namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
     ) -> None:
-        for item in await self.list_items(group, kind, namespace, label_selector):
-            meta = item.get("metadata", {})
-            await self.delete(group, kind, meta["name"], meta.get("namespace"))
+        # items of one collection are independent; bounded fan-out
+        await bounded_gather(
+            (
+                self.delete(
+                    group, kind,
+                    item.get("metadata", {})["name"],
+                    item.get("metadata", {}).get("namespace"),
+                )
+                for item in await self.list_items(group, kind, namespace, label_selector)
+            ),
+            limit=consts.DELETE_CONCURRENCY,
+        )
 
     # ------------------------------------------------------------------
     async def watch(

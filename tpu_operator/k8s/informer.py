@@ -11,11 +11,17 @@ import logging
 import time
 from typing import Awaitable, Callable, Optional
 
+from tpu_operator.k8s import objects as obj_api
 from tpu_operator.k8s.client import ApiClient, ApiError
 
 log = logging.getLogger("tpu_operator.k8s.informer")
 
 Handler = Callable[[str, dict], Awaitable[None]]  # (event_type, object)
+
+# An API that answers 404/405 is not served in this cluster (e.g.
+# ServiceMonitor without prometheus-operator).  Poll for it appearing
+# (CRD installed later) at CRD-install cadence, not at the hot relist cap.
+ABSENT_API_RETRY_SECONDS = 300.0
 
 
 class Informer:
@@ -27,6 +33,7 @@ class Informer:
         namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
         resync_seconds: float = 600.0,
+        required: bool = True,
     ):
         self.client = client
         self.group = group
@@ -34,6 +41,11 @@ class Informer:
         self.namespace = namespace
         self.label_selector = label_selector
         self.resync_seconds = resync_seconds
+        # required informers gate manager start/readyz; optional ones back
+        # the CachedReader opportunistically — a kind whose API is absent
+        # (ServiceMonitor without prometheus-operator) must neither hang
+        # startup nor wedge readiness, reads just stay live until synced
+        self.required = required
         self.cache: dict[tuple[str, str], dict] = {}
         self.handlers: list[Handler] = []
         self._task: Optional[asyncio.Task] = None
@@ -42,15 +54,28 @@ class Informer:
     def add_handler(self, handler: Handler) -> None:
         self.handlers.append(handler)
 
+    def _stamp(self, item: dict) -> dict:
+        """LIST responses omit per-item TypeMeta on a real apiserver (it
+        lives on the List object); cache consumers — readiness checks,
+        update_status path building — need it, so stamp at ingest exactly
+        like the live-list path in state/skel.py does."""
+        item.setdefault("kind", self.kind)
+        try:
+            item.setdefault("apiVersion", obj_api.lookup(self.group, self.kind).gvk.api_version)
+        except KeyError:
+            pass
+        return item
+
     def get(self, name: str, namespace: str = "") -> Optional[dict]:
         return self.cache.get((namespace, name))
 
     def items(self) -> list[dict]:
         return list(self.cache.values())
 
-    async def start(self) -> None:
+    async def start(self, wait: bool = True) -> None:
         self._task = asyncio.create_task(self._run(), name=f"informer-{self.kind}")
-        await self.synced.wait()
+        if wait:
+            await self.synced.wait()
 
     async def stop(self) -> None:
         if self._task:
@@ -79,7 +104,7 @@ class Informer:
                 fresh: dict[tuple[str, str], dict] = {}
                 for item in listing.get("items", []):
                     meta = item.get("metadata", {})
-                    fresh[(meta.get("namespace", ""), meta["name"])] = item
+                    fresh[(meta.get("namespace", ""), meta["name"])] = self._stamp(item)
                 # diff against cache → synthetic events; keep the cache
                 # consistent with each event *before* handlers observe it
                 for key, item in fresh.items():
@@ -113,12 +138,18 @@ class Informer:
                     if evt.type == "DELETED":
                         self.cache.pop(key, None)
                     else:
-                        self.cache[key] = evt.object
+                        self.cache[key] = self._stamp(evt.object)
                     await self._dispatch(evt.type, evt.object)
             except asyncio.CancelledError:
                 raise
-            except (ApiError, OSError, asyncio.TimeoutError, Exception):  # noqa: BLE001
+            except (ApiError, OSError, asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
                 log.debug("informer %s stream reset; relisting", self.kind, exc_info=True)
+                # only optional informers slow-poll an unserved API; a
+                # required one hitting the operator-install CRD race must
+                # keep the fast backoff or manager start stalls for minutes
+                if isinstance(e, ApiError) and e.status in (404, 405) and not self.required:
+                    await asyncio.sleep(ABSENT_API_RETRY_SECONDS)
+                    continue
             # Only treat the cycle as healthy (reset backoff) if the watch ran
             # for a while; a watch that dies instantly (e.g. RBAC 403) must
             # keep backing off or we relist-hammer the apiserver.

@@ -139,7 +139,9 @@ class LeaderElector:
                 await self.client.create(lease)
                 return True
             except ApiError as e2:
-                if e2.conflict:
+                if e2.already_exists:
+                    # another replica created the lease between our GET and
+                    # POST — it holds leadership until the lease expires
                     return False
                 raise
 

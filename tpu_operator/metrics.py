@@ -26,6 +26,10 @@ DURATION_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
 
+# API-requests-per-reconcile buckets: a cached steady-state pass lands in the
+# 0 bucket; convergence passes over large clusters run to the hundreds
+REQUEST_COUNT_BUCKETS = (0, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000)
+
 
 class OperatorMetrics:
     """Instance-scoped registry so tests can run many operators per process."""
@@ -112,4 +116,28 @@ class OperatorMetrics:
             "tpu_operator_workload_phase_duration_seconds",
             "Validator component / workload check phase duration",
             "phase",
+        )
+        # cached + concurrent reconcile pipeline (docs/PERFORMANCE.md)
+        self.cache_hits_total = Counter(
+            "tpu_operator_informer_cache_hits_total",
+            "Reads served from the informer-backed CachedReader, by kind",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.cache_misses_total = Counter(
+            "tpu_operator_informer_cache_misses_total",
+            "Cached reads that fell back to a live API request, by kind",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.inflight_applies = g(
+            "tpu_operator_inflight_applies",
+            "create_or_update calls currently in flight (bounded fan-out)",
+        )
+        self.api_requests_per_reconcile = Histogram(
+            "tpu_operator_k8s_requests_per_reconcile",
+            "Kubernetes API requests issued within one reconcile pass "
+            "(0 = fully cache-served steady state)",
+            registry=self.registry,
+            buckets=REQUEST_COUNT_BUCKETS,
         )

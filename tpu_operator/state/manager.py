@@ -7,10 +7,12 @@ SyncState/Results aggregation — one engine, no legacy/declarative split.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
+from tpu_operator import consts
 from tpu_operator.api.types import TPUClusterPolicy
 from tpu_operator.k8s.client import ApiClient
 from tpu_operator.obs import trace
@@ -49,12 +51,19 @@ class StateManager:
     whole chain runs per pass — states are independent DaemonSets whose
     init-container gating enforces the node-level ordering, so applying all
     manifests up front converges faster than one-state-per-requeue while the
-    per-node file gates (validations dir) preserve correctness.
+    per-node file gates (validations dir) preserve correctness.  That same
+    independence makes the walk safe to run CONCURRENTLY (bounded): apply
+    order between states never was the ordering mechanism, the per-node
+    gates are.  Results stay in STATE_DEFS order regardless of completion
+    order, so status messages and transition Events are deterministic.
     """
 
-    def __init__(self, renderer: Optional[Renderer] = None):
+    def __init__(self, renderer: Optional[Renderer] = None, concurrency: Optional[int] = None):
         self.renderer = renderer or new_renderer()
         self.states = [OperandState(sdef, self.renderer) for sdef in STATE_DEFS]
+        # None → consts value at sync time (lets the reconcile bench A/B a
+        # serial walk without rebuilding the manager)
+        self.concurrency = concurrency
 
     async def sync(
         self,
@@ -62,16 +71,21 @@ class StateManager:
         ctx: ClusterContext,
         policy: TPUClusterPolicy,
     ) -> SyncResults:
+        limit = self.concurrency or consts.STATE_SYNC_CONCURRENCY
+        sem = asyncio.Semaphore(max(1, limit))
+
+        async def run(state: OperandState) -> StateResult:
+            async with sem:
+                try:
+                    # feeds state_sync_duration_seconds{state} + the span tree
+                    with trace.span(
+                        f"state/{state.name}", kind=trace.KIND_STATE, state=state.name
+                    ):
+                        return await state.sync(client, ctx, policy)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("state %s sync failed", state.name)
+                    return StateResult(state.name, SyncState.ERROR, str(e))
+
         out = SyncResults()
-        for state in self.states:
-            try:
-                # feeds state_sync_duration_seconds{state} + the span tree
-                with trace.span(
-                    f"state/{state.name}", kind=trace.KIND_STATE, state=state.name
-                ):
-                    result = await state.sync(client, ctx, policy)
-            except Exception as e:  # noqa: BLE001
-                log.exception("state %s sync failed", state.name)
-                result = StateResult(state.name, SyncState.ERROR, str(e))
-            out.results.append(result)
+        out.results = list(await asyncio.gather(*(run(s) for s in self.states)))
         return out

@@ -8,8 +8,10 @@ disabled-state deletion pattern (controllers/object_controls.go:267-274).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from dataclasses import dataclass, field as dc_field
+from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.types import TPUClusterPolicy
@@ -17,7 +19,7 @@ from tpu_operator.k8s.apply import create_or_update, delete_if_exists
 from tpu_operator.k8s.client import ApiClient
 from tpu_operator.render import Renderer
 from tpu_operator.state.render_data import ClusterContext, StateDef
-from tpu_operator.utils import deep_get
+from tpu_operator.utils import bounded_gather, deep_get, object_hash
 
 log = logging.getLogger("tpu_operator.state")
 
@@ -120,6 +122,11 @@ class OperandState:
     # rendered-object keys from the previous pass; when the set shrinks
     # (conditional template blocks turned off), strays are pruned by label
     _last_rendered: frozenset = dc_field(default=frozenset(), compare=False)
+    # (input hash, rendered objects) memo: rendering is pure in (ctx, spec)
+    # and is the CPU hot path of a steady-state pass, so identical inputs
+    # reuse the previous pass's manifests (safe: the apply layer deep-copies
+    # before mutating, nothing else writes into them)
+    _render_memo: Optional[tuple] = dc_field(default=None, compare=False)
 
     @property
     def name(self) -> str:
@@ -147,16 +154,19 @@ class OperandState:
             # (object_controls.go:4046-4053)
             return StateResult(self.name, SyncState.READY, "no TPU nodes; state skipped")
 
-        data = self.sdef.render_data(ctx, spec)
-        objs = self.renderer.render_dir(self.name, data)
-        applied = 0
-        live_objs: list[dict] = []
-        for obj in objs:
-            live, changed = await create_or_update(
-                client, obj, owner=policy.obj, state_label=self.name
-            )
-            live_objs.append(live)
-            applied += int(changed)
+        objs = self._render(ctx, policy)
+        # Bounded fan-out: one state's objects (SA/RBAC/ConfigMap/Service/DS)
+        # reference each other by NAME only — k8s resolves references at use
+        # time, not admission time — so apply order within a state is free.
+        results = await bounded_gather(
+            (
+                create_or_update(client, obj, owner=policy.obj, state_label=self.name)
+                for obj in objs
+            ),
+            limit=consts.APPLY_CONCURRENCY,
+        )
+        live_objs = [live for live, _ in results]
+        applied = sum(int(changed) for _, changed in results)
 
         # Prune objects that fell out of the rendered set (e.g. the
         # device-plugin RBAC after devicePlugin.config is removed, or a
@@ -175,6 +185,16 @@ class OperandState:
             message,
             applied,
         )
+
+    def _render(self, ctx: ClusterContext, policy: TPUClusterPolicy) -> list[dict]:
+        if not consts.RENDER_MEMO:
+            return self.renderer.render_dir(self.name, self.sdef.render_data(ctx, policy.spec))
+        key = object_hash([dataclasses.asdict(ctx), policy.obj.get("spec") or {}])
+        if self._render_memo is not None and self._render_memo[0] == key:
+            return self._render_memo[1]
+        objs = self.renderer.render_dir(self.name, self.sdef.render_data(ctx, policy.spec))
+        self._render_memo = (key, objs)
+        return objs
 
     def _readiness(self, live_objs: list[dict]) -> tuple[bool, str]:
         for obj in live_objs:
@@ -206,22 +226,29 @@ class OperandState:
         from tpu_operator.k8s import objects as obj_api
         from tpu_operator.k8s.client import ApiError
 
-        out: list[dict] = []
         selector = f"{consts.STATE_LABEL}={self.name}"
-        for group, kind in SUPPORTED_GVKS:
+
+        async def list_one(group: str, kind: str) -> list[dict]:
             ns = namespace if obj_api.lookup(group, kind).namespaced else None
             try:
                 items = await client.list_items(group, kind, ns, selector)
             except ApiError as e:
                 if e.status in (404, 405):  # API/kind not served in this cluster
-                    continue
+                    return []
                 raise
             # list responses omit item kind; stamp it for _obj_key/delete
             for item in items:
                 item.setdefault("kind", kind)
                 item.setdefault("apiVersion", obj_api.lookup(group, kind).gvk.api_version)
-            out.extend(items)
-        return out
+            return items
+
+        # fan the per-GVK lists out; flattened result keeps SUPPORTED_GVKS
+        # order, which delete_objects relies on as its deletion order
+        lists = await bounded_gather(
+            (list_one(group, kind) for group, kind in SUPPORTED_GVKS),
+            limit=consts.LIST_SWEEP_CONCURRENCY,
+        )
+        return [item for items in lists for item in items]
 
     async def delete_objects(self, client: ApiClient, namespace: str) -> int:
         deleted = 0

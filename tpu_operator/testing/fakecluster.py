@@ -36,6 +36,10 @@ class SimConfig:
     tick: float = 0.02
     pod_ready_delay: float = 0.05     # DS pod creation → Ready
     plugin_capacity_delay: float = 0.05  # plugin pod Ready → node advertises google.com/tpu
+    # per-request latency emulating a real apiserver's RTT (0 = localhost
+    # speed).  The reconcile bench sets this so request-count wins translate
+    # into the wall-time they buy against a non-in-process control plane.
+    api_latency: float = 0.0
     # Hook: given a workload pod dict, return final phase ("Succeeded"/"Failed").
     # Called in a thread for pods with restartPolicy != Always (validator
     # workload pods). None ⇒ auto-succeed after pod_ready_delay.
@@ -118,6 +122,23 @@ class Store:
             raise ApiException(404, "NotFound", f"{self.info.plural} {name} not found")
         return self.objects[k]
 
+    @staticmethod
+    def _is_noop(merged: dict, existing: dict) -> bool:
+        """True when ``merged`` changes nothing but (at most) the
+        resourceVersion — a real apiserver returns the stored object
+        unchanged for such writes (no rv bump, no watch event), and that
+        semantics matters: cache-lagged controllers re-asserting state must
+        not generate event storms that keep their own caches behind."""
+        if {k: v for k, v in merged.items() if k != "metadata"} != {
+            k: v for k, v in existing.items() if k != "metadata"
+        }:
+            return False
+        return {
+            k: v for k, v in merged.get("metadata", {}).items() if k != "resourceVersion"
+        } == {
+            k: v for k, v in existing.get("metadata", {}).items() if k != "resourceVersion"
+        }
+
     def update(self, obj: dict, namespace: Optional[str], name: str, status_only: bool = False) -> dict:
         existing = self.get(namespace, name)
         new_meta = obj.get("metadata", {})
@@ -141,6 +162,8 @@ class Store:
                 merged["metadata"]["generation"] = existing["metadata"].get("generation", 1) + 1
         merged["apiVersion"] = self.info.gvk.api_version
         merged["kind"] = self.info.gvk.kind
+        if self._is_noop(merged, existing):
+            return existing
         merged["metadata"]["resourceVersion"] = str(self.cluster.next_rv())
         self.objects[self.key(namespace, name)] = merged
         self._notify("MODIFIED", merged)
@@ -442,6 +465,8 @@ class FakeCluster:
         self.request_counts[key] = self.request_counts.get(key, 0) + 1
 
     async def _dispatch(self, request: web.Request, group: str, version: str, rest: str) -> web.StreamResponse:
+        if self.sim.api_latency:
+            await asyncio.sleep(self.sim.api_latency)
         try:
             parts = [p for p in rest.split("/") if p]
             namespace: Optional[str] = None
@@ -479,13 +504,22 @@ class FakeCluster:
         if request.method == "GET" and q.get("watch") in ("1", "true"):
             return await self._serve_watch(request, store, namespace)
         if request.method == "GET":
-            items = store.list(namespace, q.get("labelSelector", ""), q.get("fieldSelector", ""))
+            items = copy.deepcopy(
+                store.list(namespace, q.get("labelSelector", ""), q.get("fieldSelector", ""))
+            )
+            # real-apiserver fidelity: per-item TypeMeta is omitted in LIST
+            # responses (kind/apiVersion live on the List object) — consumers
+            # that need it must stamp it themselves (informer ingest,
+            # state/skel._list_labeled), and tests must catch them forgetting
+            for item in items:
+                item.pop("kind", None)
+                item.pop("apiVersion", None)
             return web.json_response(
                 {
                     "kind": store.info.gvk.kind + "List",
                     "apiVersion": store.info.gvk.api_version,
                     "metadata": {"resourceVersion": str(self._rv)},
-                    "items": copy.deepcopy(items),
+                    "items": items,
                 }
             )
         if request.method == "POST":

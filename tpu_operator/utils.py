@@ -6,9 +6,10 @@ a deterministic dump; GetFilesWithSuffix :33-58).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
-from typing import Any, Iterable, Iterator
+from typing import Any, Awaitable, Iterable, Iterator
 
 FNV1A_64_OFFSET = 0xCBF29CE484222325
 FNV1A_64_PRIME = 0x100000001B3
@@ -30,6 +31,39 @@ def object_hash(obj: Any) -> str:
     """
     dumped = json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
     return format(fnv1a_64(dumped.encode()), "x")
+
+
+async def bounded_gather(aws: Iterable[Awaitable], limit: int = 8) -> list:
+    """``asyncio.gather`` under a concurrency bound, results in input order.
+
+    Unlike bare gather with ``return_exceptions=False``, every task is
+    awaited to completion even when one fails (no orphaned coroutines
+    racing teardown); the first exception is re-raised afterwards.
+    """
+    sem = asyncio.Semaphore(max(1, limit))
+
+    async def _run(aw: Awaitable):
+        async with sem:
+            return await aw
+
+    aws = list(aws)
+    try:
+        results = await asyncio.gather(*(_run(aw) for aw in aws), return_exceptions=True)
+    finally:
+        # a hard cancel can kill wrapper tasks before they ever run; close
+        # any coroutine that never started or it warns at GC (no-op for
+        # finished ones, RuntimeError for the mid-await ones we must skip)
+        for aw in aws:
+            close = getattr(aw, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except RuntimeError:
+                    pass
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return results
 
 
 def files_with_suffix(root: str, *suffixes: str) -> list[str]:

@@ -1175,7 +1175,7 @@ class Validator:
         try:
             await client.create(service)
         except ApiError as e:
-            if not e.conflict:
+            if not e.already_exists:
                 raise
         for member in members:
             attrs = nodeinfo.attributes(member)
@@ -1244,7 +1244,7 @@ class Validator:
             except ApiError as e:
                 # another worker converged this name concurrently, or the old
                 # pod is still terminating; the next poll's epoch check decides
-                if not e.conflict:
+                if not e.already_exists:
                     raise
 
     async def _cleanup_group_workloads(
