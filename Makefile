@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile lint counters-docs async-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos lint counters-docs async-lint except-lint all image e2e-kind
 
 all: proto manifests test
 
-# default test target = lint gate + counter-catalogue drift check +
-# async-blocking lint + the tier-1 pytest line CI runs
-test: lint counters-docs async-lint unit-test
+# default test target = lint gates + counter-catalogue drift check +
+# the tier-1 pytest line CI runs + the seeded chaos acceptance soak
+test: lint counters-docs async-lint except-lint unit-test chaos
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -19,6 +19,11 @@ counters-docs:
 # reconcile pipeline packages (docs/PERFORMANCE.md)
 async-lint:
 	$(PYTHON) hack/check_async_blocking.py
+
+# no silent `except Exception: pass` under k8s/ and controllers/ — broad
+# swallows hide the failure taxonomy (docs/ROBUSTNESS.md)
+except-lint:
+	$(PYTHON) hack/check_exception_hygiene.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal
@@ -65,6 +70,16 @@ bench:
 RECONCILE_TIERS ?= 10
 bench-reconcile:
 	$(PYTHON) bench.py --reconcile --tiers $(RECONCILE_TIERS)
+
+# seeded chaos acceptance soak (chip-free; ~1 min): 100-node fake cluster,
+# 5% transient API errors + watch drops + one leader-lease steal must still
+# converge to Ready with zero duplicate creations and return to the
+# zero-write steady state once chaos stops (docs/ROBUSTNESS.md)
+CHAOS_NODES ?= 100
+CHAOS_SEED ?= 1
+CHAOS_ERROR_RATE ?= 0.05
+chaos:
+	$(PYTHON) bench.py --chaos --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED) --error-rate $(CHAOS_ERROR_RATE)
 
 # single image for operator + operands (docker/Dockerfile)
 image:

@@ -196,6 +196,233 @@ def _best_of_runs(module: str, metric: str, runs_key: str,
     return best
 
 
+CHAOS_CONVERGE_TIMEOUT = 300.0
+
+
+def _metric_total(metrics, family: str) -> float:
+    """Sum of a counter family's samples from an OperatorMetrics registry."""
+    total = 0.0
+    for fam in metrics.registry.collect():
+        if fam.name == family:
+            total += sum(s.value for s in fam.samples if s.name.endswith("_total"))
+    return total
+
+
+def _nonlease_writes(fc) -> int:
+    """Mutating requests excluding lease renewals (the elector's heartbeat
+    PUTs every renew_interval forever; they are not reconcile writes)."""
+    return sum(
+        n for (method, res), n in fc.request_counts.items()
+        if method in ("POST", "PUT", "PATCH", "DELETE")
+        and not res.startswith("coordination.k8s.io/")
+    )
+
+
+async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
+    """The chaos acceptance run (docs/ROBUSTNESS.md; `make chaos`).
+
+    A 100-node fake cluster behind a seeded fault schedule — transient
+    429/500/503/resets on ``error_rate`` of requests, post-commit 500s,
+    latency spikes + hard hangs, watch drops and 410 expiry, node NotReady
+    flaps — while the REAL manager (leader-elected, watch-driven) converges
+    the full reconcile-to-Ready pipeline.  Mid-flight the leader lease is
+    stolen once (step-down + fence + re-acquire), and after convergence a
+    100%-error blackout trips the circuit breaker into degraded mode, whose
+    recovery is then proven.  Once chaos stops the system must return to
+    its zero-write, zero-request steady state with ZERO duplicate object
+    creations across the whole run.
+    """
+    from tpu_operator import consts
+    from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.k8s import retry as retry_api
+    from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get
+
+    chaos = ChaosConfig(
+        seed=seed,
+        error_rate=error_rate,
+        post_commit_error_rate=error_rate / 5,
+        latency_spike_rate=0.05, latency_spike_s=(0.002, 0.03),
+        hang_rate=0.002, hang_s=10.0,
+        watch_drop_rate=0.3, watch_drop_after_s=(0.2, 2.0),
+        watch_gone_rate=0.05,
+        node_flap_interval=3.0, node_flap_down_s=0.3,
+    )
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05)
+    async with FakeCluster(sim, chaos=chaos) as fc:
+        # tight per-try timeout so injected hangs cost ~2s, not minutes
+        client = ApiClient(
+            Config(base_url=fc.base_url),
+            retry_policy=retry_api.RetryPolicy(
+                per_try_timeout=2.0, total_timeout=12.0,
+                budget=retry_api.RetryBudget(ratio=0.5, cap=20.0),
+            ),
+        )
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(
+            client, NS, metrics_port=-1, health_port=-1,
+            leader_elect=True, lease_duration=3.0, renew_interval=0.5,
+            renew_deadline=2.0, recorder=recorder, operator_metrics=metrics,
+        )
+        reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics, recorder=recorder)
+        reconciler.setup(mgr)
+        result: dict = {"nodes": n_nodes, "seed": seed, "error_rate": error_rate}
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new().obj)
+                for i in range(n_nodes):
+                    s, h = divmod(i, 4)
+                    fc.add_node(
+                        f"tpu-{s}-{h}", topology="4x4",
+                        labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        },
+                    )
+
+                async def _converged() -> bool:
+                    try:
+                        cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                        if deep_get(cr, "status", "state") != State.READY:
+                            return False
+                        nodes = await client.list_items("", "Node")
+                    except Exception:  # noqa: BLE001 — chaos; poll again
+                        return False
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                stole_at = None
+                lost = regained = False
+                while True:
+                    if stole_at is None and time.perf_counter() - t0 > 2.0:
+                        fc.steal_lease(NS)  # mid-convergence leadership loss
+                        stole_at = time.perf_counter()
+                    if stole_at is not None and not mgr.elector.is_leader.is_set():
+                        lost = True
+                    if lost and mgr.elector.is_leader.is_set():
+                        regained = True
+                    if regained and await _converged():
+                        break
+                    if time.perf_counter() - t0 > CHAOS_CONVERGE_TIMEOUT:
+                        raise TimeoutError(
+                            f"chaos soak never converged (lost={lost} regained={regained})"
+                        )
+                    await asyncio.sleep(0.1)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                result["leadership_lost"] = lost
+                result["leadership_regained"] = regained
+
+                # blackout: 100% errors until the breaker trips → degraded
+                # mode (reconciles paused); recovery closes it again
+                fc.chaos.force_error_rate = 1.0
+                t1 = time.perf_counter()
+                while not mgr.degraded:
+                    if time.perf_counter() - t1 > 60:
+                        raise TimeoutError("breaker never opened under blackout")
+                    await asyncio.sleep(0.05)
+                result["degraded_entered"] = True
+                result["breaker_state_during_blackout"] = client.breaker.state
+                fc.chaos.force_error_rate = None
+                while mgr.degraded:
+                    if time.perf_counter() - t1 > 120:
+                        raise TimeoutError("breaker never closed after blackout")
+                    await asyncio.sleep(0.05)
+                result["degraded_recovered"] = True
+
+                # chaos OFF: the system must return to the zero-write,
+                # zero-request steady state (informers resync, then every
+                # pass is cache-served)
+                fc.chaos.stop()
+                steady_requests = steady_writes = None
+                t2 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    writes = _nonlease_writes(fc)
+                    if counter.n == 0 and writes == 0:
+                        steady_requests, steady_writes = counter.n, writes
+                        break
+                    if time.perf_counter() - t2 > 90:
+                        steady_requests, steady_writes = counter.n, writes
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                result["steady_writes_per_pass"] = steady_writes
+
+                # Events are the human-facing evidence; the degraded-mode
+                # pair posts via the supervisor's retry queue, so give it a
+                # beat to flush after recovery
+                wanted = {"LeaderElected", "LeadershipLost", "DegradedMode",
+                          "DegradedModeRecovered", "Ready"}
+                t3 = time.perf_counter()
+                while True:
+                    reasons = {
+                        e.get("reason") for e in fc.store("", "events").objects.values()
+                    }
+                    if wanted <= reasons or time.perf_counter() - t3 > 30:
+                        break
+                    await asyncio.sleep(0.2)
+                result["event_reasons"] = sorted(wanted & reasons)
+                result["missing_event_reasons"] = sorted(wanted - reasons)
+        finally:
+            await client.close()
+
+        result["duplicate_creations"] = {
+            "/".join(k): v for k, v in fc.duplicate_creations().items()
+        }
+        result["retries_total"] = _metric_total(metrics, "tpu_operator_k8s_request_retries")
+        result["degraded_entered_total"] = _metric_total(
+            metrics, "tpu_operator_degraded_mode_entered"
+        )
+        result["faults_injected"] = fc.chaos.report()
+
+        failures = []
+        if result["duplicate_creations"]:
+            failures.append(f"duplicate creations: {result['duplicate_creations']}")
+        if result["steady_writes_per_pass"] != 0:
+            failures.append(f"steady writes/pass = {result['steady_writes_per_pass']} (want 0)")
+        if result["steady_requests_per_pass"] != 0:
+            failures.append(f"steady requests/pass = {result['steady_requests_per_pass']} (want 0)")
+        if not (lost and regained):
+            failures.append("leadership steal not observed (lost/regained)")
+        if result["retries_total"] <= 0:
+            failures.append("no retries recorded under chaos")
+        if result["missing_event_reasons"]:
+            failures.append(f"missing events: {result['missing_event_reasons']}")
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_chaos_soak(n_nodes: int = 100, seed: int = 1, error_rate: float = 0.05) -> dict:
+    print(
+        f"  chaos soak: {n_nodes} nodes, seed={seed}, error_rate={error_rate}",
+        file=sys.stderr,
+    )
+    result = asyncio.run(_chaos_soak(n_nodes, seed, error_rate))
+    for f in result["failures"]:
+        print(f"  chaos FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  chaos soak: converge {result.get('converge_s')}s, "
+        f"retries {result.get('retries_total'):.0f}, "
+        f"faults {sum(result.get('faults_injected', {}).values())}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 RECONCILE_TIERS = (10, 100, 500)
 RECONCILE_CONVERGE_TIMEOUT = 240.0
 _RECONCILE_CONCURRENCY_KNOBS = (
@@ -630,7 +857,38 @@ async def bench() -> dict:
                 }
 
 
+def _int_arg(flag: str, default: int) -> int:
+    if flag in sys.argv:
+        try:
+            return int(sys.argv[sys.argv.index(flag) + 1])
+        except (IndexError, ValueError):
+            sys.exit(f"usage: bench.py --chaos [{flag} N]")
+    return default
+
+
 def main() -> None:
+    # `bench.py --chaos [--nodes 100] [--seed 1] [--error-rate 0.05]`:
+    # seeded chaos acceptance soak (no chip needed) — `make chaos`
+    if "--chaos" in sys.argv:
+        rate = 0.05
+        if "--error-rate" in sys.argv:
+            try:
+                rate = float(sys.argv[sys.argv.index("--error-rate") + 1])
+            except (IndexError, ValueError):
+                sys.exit("usage: bench.py --chaos [--error-rate R]")
+        result = run_chaos_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+            error_rate=rate,
+        )
+        print(json.dumps({
+            "metric": "chaos_soak_converge_seconds",
+            "value": result.get("converge_s"),
+            "unit": "s",
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
     # `bench.py --reconcile [--tiers 10,100]`: control-plane bench only
     # (no chip needed) — the `make bench-reconcile` entry point
     if "--reconcile" in sys.argv:

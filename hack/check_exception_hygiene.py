@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Exception-hygiene lint (make test): no silently swallowed Exceptions.
+
+Sibling of check_async_blocking.py.  Walks ``tpu_operator/k8s`` and
+``tpu_operator/controllers`` and rejects handlers that catch ``Exception``
+(bare ``except:``, ``except Exception:``, or a tuple containing it) whose
+body is only ``pass``/``...`` — the pattern that hides the intended failure
+taxonomy: a broad clause swallowing everything indiscriminately turned the
+informer's 410-relist vs transient-backoff vs fatal distinction into mush
+(the PR 4 informer bug).  Swallowing a NARROW exception (``except ApiError:
+pass``) stays legal — that is an explicit decision about a named failure.
+Broad handlers must at least log.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGES = ("tpu_operator/k8s", "tpu_operator/controllers")
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _names(expr: ast.expr | None) -> set[str]:
+    """Exception class names named by an ``except`` clause."""
+    if expr is None:
+        return set(BROAD)  # bare except:
+    if isinstance(expr, ast.Tuple):
+        out: set[str] = set()
+        for el in expr.elts:
+            out |= _names(el)
+        return out
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.Attribute):
+        return {expr.attr}
+    return set()
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def check_file(path: str) -> list[str]:
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [f"{path}: syntax error: {e}"]
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _names(node.type) & BROAD and _is_silent(node.body):
+            problems.append(
+                f"{os.path.relpath(path, REPO)}:{node.lineno}: broad "
+                "`except Exception: pass` swallows the failure taxonomy — "
+                "narrow the clause or log what was caught"
+            )
+    return problems
+
+
+def main() -> int:
+    problems: list[str] = []
+    n_files = 0
+    for pkg in PACKAGES:
+        for dirpath, _, filenames in os.walk(os.path.join(REPO, pkg)):
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                n_files += 1
+                problems.extend(check_file(os.path.join(dirpath, name)))
+    if problems:
+        print("exception-hygiene lint failures:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"exception-hygiene: {n_files} files clean under {', '.join(PACKAGES)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,542 @@
+"""Chaos-harness tier-1 tests: seeded fault schedules against the real
+operator stack — apply-layer storms, informer watch faults, leadership
+fencing, degraded mode, and the upgrade/remediation machines under
+validator-pod crash-loops (docs/ROBUSTNESS.md)."""
+
+import asyncio
+import random
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
+from tpu_operator.k8s import retry as rt
+from tpu_operator.k8s.apply import create_or_update
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+def _client(fc, **policy_kw) -> ApiClient:
+    defaults = dict(
+        max_attempts=6, backoff_base=0.005, backoff_cap=0.02,
+        per_try_timeout=2.0, total_timeout=8.0, rng=random.Random(0),
+    )
+    defaults.update(policy_kw)
+    client = ApiClient(Config(base_url=fc.base_url), retry_policy=rt.RetryPolicy(**defaults))
+    # storms intentionally exceed the breaker threshold; degraded-mode tests
+    # install their own breaker explicitly
+    client.breaker = None
+    return client
+
+
+def _cm(name: str, data: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": NS},
+        "data": {"k": data},
+    }
+
+
+# ----------------------------------------------------------------------
+# create_or_update under fault storms (apply-layer resilience)
+
+async def test_create_or_update_survives_transient_storm_without_duplicates():
+    """Seeded 409/500/503/reset storm over the full apply path: every
+    desired generation eventually lands, and no object is ever created
+    twice (the PR 3 create-race adoption pinned under chaos)."""
+    chaos = ChaosConfig(seed=11, error_rate=0.3,
+                        error_weights={"429": 1, "500": 1, "503": 1, "reset": 1})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = _client(fc, max_attempts=8)
+        try:
+            for gen in range(12):
+                # the storm can exhaust one call's attempts (POST is never
+                # replayed after a 5xx) — the reconcile loop retries, chaos
+                # tests that the RETRIED call adopts instead of duplicating
+                for _ in range(20):
+                    try:
+                        live, _ = await create_or_update(client, _cm("storm", f"g{gen}"))
+                        break
+                    except (ApiError, OSError, asyncio.TimeoutError):
+                        continue
+                else:
+                    pytest.fail(f"generation {gen} never applied")
+                assert live["data"]["k"] == f"g{gen}"
+            assert fc.duplicate_creations() == {}
+            final = await client.get("", "ConfigMap", "storm", NS)
+            assert final["data"]["k"] == "g11"
+        finally:
+            await client.close()
+
+
+async def test_post_commit_failure_adopts_instead_of_duplicating():
+    """The nastiest case: the create COMMITS server-side but the client
+    sees a 500.  POST is not replayed; the next apply call GETs the
+    committed object and adopts it — zero duplicates by construction."""
+    chaos = ChaosConfig(seed=13, post_commit_error_rate=1.0)
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = _client(fc)
+        try:
+            with pytest.raises(ApiError) as ei:
+                await create_or_update(client, _cm("ghost", "v1"))
+            assert ei.value.status == 500
+            # ...but the mutation applied; stop failing responses and re-apply
+            fc.chaos.stop()
+            live, changed = await create_or_update(client, _cm("ghost", "v1"))
+            assert live["data"]["k"] == "v1"
+            assert changed is False  # adopted the committed copy, hash matched
+            assert fc.created_counts[("configmaps", NS, "ghost")] == 1
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Informer watch-fault taxonomy
+
+async def test_informer_survives_permanent_watch_410():
+    """410 Gone is protocol, not failure: the informer relists with a fresh
+    resourceVersion and keeps its cache current even when EVERY watch
+    request is answered Gone."""
+    chaos = ChaosConfig(seed=17, watch_gone_rate=1.0)
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        inf = Informer(client, "", "ConfigMap", namespace=NS, resync_seconds=30)
+        try:
+            await inf.start()
+            assert inf.synced.is_set()
+            fc.put(_cm("after-sync", "v1"))
+            for _ in range(100):
+                if inf.get("after-sync", NS) is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert inf.get("after-sync", NS) is not None
+        finally:
+            await inf.stop()
+            await client.close()
+
+
+async def test_informer_resumes_across_watch_drops():
+    chaos = ChaosConfig(seed=19, watch_drop_rate=1.0, watch_drop_after_s=(0.05, 0.15))
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        inf = Informer(client, "", "ConfigMap", namespace=NS, resync_seconds=30)
+        try:
+            await inf.start()
+            for i in range(5):
+                fc.put(_cm(f"cm-{i}", "x"))
+                await asyncio.sleep(0.05)
+            for _ in range(100):
+                if len(inf.items()) == 5:
+                    break
+                await asyncio.sleep(0.05)
+            assert {o["metadata"]["name"] for o in inf.items()} == {
+                f"cm-{i}" for i in range(5)
+            }
+        finally:
+            await inf.stop()
+            await client.close()
+
+
+async def test_informer_error_event_410_triggers_relist():
+    """Mid-stream ERROR carrying code 410 (apiserver closing an expired
+    window) must be handled like a Gone status: immediate relist, cache
+    intact."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        inf = Informer(client, "", "ConfigMap", namespace=NS, resync_seconds=30)
+        try:
+            await inf.start()
+            # inject the ERROR event straight into the live watch stream
+            store = fc.store("", "configmaps")
+            for queue, _, _ in store.watchers:
+                queue.put_nowait({"type": "ERROR", "object": {
+                    "kind": "Status", "code": 410, "reason": "Expired"}})
+            fc.put(_cm("post-expiry", "v1"))
+            for _ in range(100):
+                if inf.get("post-expiry", NS) is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert inf.get("post-expiry", NS) is not None
+        finally:
+            await inf.stop()
+            await client.close()
+
+
+async def test_watch_ring_expiry_returns_410():
+    """A watch resuming from before the replay ring's oldest retained event
+    cannot be caught up: the fake answers 410 Gone like a real apiserver
+    (previously it silently dropped the missed events)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            store = fc.store("", "configmaps")
+            for i in range(store.events.maxlen + 10):  # wrap the ring
+                fc.put(_cm("churn", f"v{i}"))
+            with pytest.raises(ApiError) as ei:
+                async for _ in client.watch("", "ConfigMap", NS, resource_version="1"):
+                    break
+            assert ei.value.status == 410
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Leadership fencing
+
+async def test_deposed_leader_issues_no_write_after_is_leader_clears():
+    """Regression for the split-brain window: the lease is stolen while a
+    reconcile loop writes continuously; from the instant ``is_leader``
+    clears, not one non-lease/non-event write reaches the apiserver."""
+    from tpu_operator.controllers.runtime import Controller, Manager
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        # lease_duration far past the observation window: the elector must
+        # NOT have legally re-acquired before the no-write assertion runs
+        mgr = Manager(client, NS, metrics_port=-1, health_port=-1,
+                      leader_elect=True, lease_duration=4.0,
+                      renew_interval=0.1, renew_deadline=0.5)
+        writes = {"n": 0}
+
+        async def hot_writer(key):
+            # a controller that mutates as fast as it can — worst case for
+            # an in-flight write racing a leadership loss
+            writes["n"] += 1
+            await client.patch("", "ConfigMap", "hot", {"data": {"n": str(writes["n"])}},
+                               namespace=NS)
+            return 0.0  # immediate requeue
+
+        fc.put(_cm("hot", "0"))
+        controller = mgr.add_controller(Controller("hot", hot_writer))
+        try:
+            async with mgr:
+                controller.enqueue("x")
+                for _ in range(100):
+                    if writes["n"] > 3:
+                        break
+                    await asyncio.sleep(0.02)
+                assert writes["n"] > 3, "writer never ran while leader"
+
+                fc.steal_lease(NS)
+                await asyncio.wait_for(_wait_cleared(mgr.elector.is_leader), timeout=5)
+                # one write may be IN FLIGHT at the clearing instant (it
+                # passed the fence before the renew failed) — let it drain,
+                # then freeze the ledger: from here on, zero new writes
+                await asyncio.sleep(0.1)
+                fc.reset_request_counts()
+                await asyncio.sleep(0.5)  # plenty of would-be write cycles
+                illegal = [
+                    (m, r) for (m, r), n in fc.request_counts.items()
+                    if m in ("POST", "PUT", "PATCH", "DELETE")
+                    and not r.startswith("coordination.k8s.io/")
+                    and r != "events"
+                ]
+                assert illegal == [], f"deposed leader wrote: {illegal}"
+                # direct write attempts are refused client-side by the fence
+                with pytest.raises(rt.FencedError):
+                    await client.patch("", "ConfigMap", "hot", {"data": {"n": "x"}},
+                                       namespace=NS)
+        finally:
+            await client.close()
+
+
+async def _wait_cleared(event: asyncio.Event) -> None:
+    while event.is_set():
+        await asyncio.sleep(0.01)
+
+
+async def test_leadership_reacquired_resumes_reconciles_with_events():
+    """After the rival's stolen lease expires the elector re-acquires,
+    controllers resume (the popped key survives suspension), and both
+    leadership transitions are posted as Events."""
+    from tpu_operator.controllers.runtime import Controller, Manager
+    from tpu_operator.obs.events import EventRecorder
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(client, NS, metrics_port=-1, health_port=-1,
+                      leader_elect=True, lease_duration=1.0,
+                      renew_interval=0.1, renew_deadline=0.5,
+                      recorder=recorder)
+        ticks = {"n": 0}
+
+        async def ticker(key):
+            ticks["n"] += 1
+            return 0.05
+
+        controller = mgr.add_controller(Controller("tick", ticker))
+        try:
+            async with mgr:
+                controller.enqueue("x")
+                await asyncio.sleep(0.2)
+                assert ticks["n"] > 0
+                fc.steal_lease(NS)
+                await asyncio.wait_for(_wait_cleared(mgr.elector.is_leader), timeout=5)
+                # rival never renews → lease expires → re-acquire
+                await asyncio.wait_for(mgr.elector.is_leader.wait(), timeout=10)
+                before = ticks["n"]
+                for _ in range(100):
+                    if ticks["n"] > before:
+                        break
+                    await asyncio.sleep(0.05)
+                assert ticks["n"] > before, "reconciles did not resume after re-election"
+                reasons = set()
+                for _ in range(100):
+                    reasons = {
+                        e.get("reason")
+                        for e in fc.store("", "events").objects.values()
+                    }
+                    if {"LeadershipLost", "LeaderElected"} <= reasons:
+                        break
+                    await asyncio.sleep(0.05)
+                assert {"LeadershipLost", "LeaderElected"} <= reasons
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Degraded mode (breaker open → pause; half-open probe → recovery)
+
+async def test_blackout_enters_degraded_mode_and_recovers():
+    from aiohttp import ClientSession
+
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+
+    chaos = ChaosConfig(seed=23)  # healthy until the blackout is forced
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = _client(fc, max_attempts=1, per_try_timeout=1.0, total_timeout=1.0)
+        client.breaker = rt.CircuitBreaker(failure_threshold=3, reset_seconds=0.2)
+        metrics = OperatorMetrics()
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(client, NS, metrics_port=-1, health_port=0,
+                      recorder=recorder, operator_metrics=metrics)
+        reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics,
+                                             recorder=recorder)
+        reconciler.setup(mgr)
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new().obj)
+                await asyncio.sleep(0.3)  # a few healthy reconcile cycles
+
+                fc.chaos.force_error_rate = 1.0
+                for _ in range(200):
+                    if mgr.degraded:
+                        break
+                    # reconcile-shaped traffic: already-connected watches
+                    # idle through a blackout, so the breaker only sees
+                    # failures when something actually talks to the API
+                    try:
+                        await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    except ApiError:
+                        pass
+                    await asyncio.sleep(0.05)
+                assert mgr.degraded, "breaker never opened under blackout"
+                assert client.breaker.state == rt.OPEN
+                assert metrics.api_breaker_state._value.get() == rt.OPEN
+
+                # /readyz reports the breaker state while degraded
+                async with ClientSession() as http:
+                    async with http.get(
+                        f"http://127.0.0.1:{mgr.health_port}/readyz"
+                    ) as r:
+                        assert r.status == 503
+                        # state may legitimately read open OR half-open at
+                        # probe time — both are degraded
+                        assert "degraded: api circuit breaker" in await r.text()
+
+                # recovery: half-open probes close the breaker, reconciles
+                # resume, and the DegradedMode Event pair lands
+                fc.chaos.force_error_rate = None
+                for _ in range(200):
+                    if not mgr.degraded:
+                        break
+                    try:
+                        # fails fast while OPEN; after the reset window this
+                        # is the half-open probe that closes the breaker
+                        await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    except ApiError:
+                        pass
+                    await asyncio.sleep(0.05)
+                assert not mgr.degraded, "degraded mode never recovered"
+                async with ClientSession() as http:
+                    async with http.get(
+                        f"http://127.0.0.1:{mgr.health_port}/readyz"
+                    ) as r:
+                        assert r.status == 200
+                reasons = set()
+                for _ in range(100):
+                    reasons = {
+                        e.get("reason")
+                        for e in fc.store("", "events").objects.values()
+                    }
+                    if {"DegradedMode", "DegradedModeRecovered"} <= reasons:
+                        break
+                    await asyncio.sleep(0.05)
+                assert {"DegradedMode", "DegradedModeRecovered"} <= reasons
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Upgrade / remediation state machines under validator crash-loops
+
+async def _crashloop_cluster(fc, spec: dict):
+    client = ApiClient(Config(base_url=fc.base_url))
+    await client.create(TPUClusterPolicy.new(spec=spec).obj)
+    node = fc.add_node("tpu-0")
+    node["metadata"]["labels"][consts.TFD_RUNTIME_VERSION_LABEL] = "v1"
+    node["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+    fc.put(node)
+    return client
+
+
+def _pod(fc, name, app, phase="Pending"):
+    fc.put({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": NS, "labels": {"app": app}},
+        "spec": {"nodeName": "tpu-0", "containers": [{"name": "c"}]},
+        "status": {"phase": phase},
+    })
+
+
+async def test_upgrade_validation_fails_under_validator_crashloop():
+    """Post-swap, the chaos actor crash-loops the fresh validator pod: the
+    upgrade machine must mark the node upgrade-failed and leave it
+    cordoned — never uncordon on flapping evidence, never hang."""
+    from tpu_operator.controllers import upgrade as up
+
+    chaos = ChaosConfig(seed=29, pod_crashloop_selector="app=tpu-operator-validator",
+                        pod_crashloop_rate=1.0, pod_restart_after_s=0.0)
+    async with FakeCluster(SimConfig(tick=0.01, pod_ready_delay=0.02), chaos=chaos) as fc:
+        client = await _crashloop_cluster(fc, {
+            "libtpu": {"libtpuVersion": "v2",
+                       "upgradePolicy": {"autoUpgrade": True,
+                                         "drain": {"enable": False}}},
+        })
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            _pod(fc, "tpu-runtime-tpu-0", "tpu-runtime", phase="Running")
+
+            async def state():
+                node = await client.get("", "Node", "tpu-0")
+                return node["metadata"]["labels"].get(consts.UPGRADE_STATE_LABEL, "")
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while await state() != up.VALIDATION:
+                await r.reconcile("upgrade")
+                # keep the runtime pod Running (the swap deletes it)
+                _pod(fc, "tpu-runtime-tpu-0", "tpu-runtime", phase="Running")
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+
+            # fresh validator pod appears, goes Running, and is crash-looped
+            # to Failed by chaos before it can be trusted
+            _pod(fc, "validator-fresh", "tpu-operator-validator")
+            while await state() == up.VALIDATION:
+                await r.reconcile("upgrade")
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            assert await state() == up.FAILED
+            node = await client.get("", "Node", "tpu-0")
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+async def test_remediation_fails_closed_under_validator_crashloop():
+    """A requested re-validation whose proof pod crash-loops must land in
+    remediation-failed with the node cordoned (fail closed), not flap to
+    healthy on a transient Running window."""
+    from tpu_operator.controllers import remediation as rem
+
+    chaos = ChaosConfig(seed=31, pod_crashloop_selector="app=tpu-operator-validator",
+                        pod_crashloop_rate=1.0, pod_restart_after_s=0.0)
+    async with FakeCluster(SimConfig(tick=0.01, pod_ready_delay=0.02), chaos=chaos) as fc:
+        client = await _crashloop_cluster(fc, {"remediation": {"enabled": True}})
+        try:
+            r = rem.RemediationReconciler(client, NS)
+            await client.patch(
+                "", "Node", "tpu-0",
+                {"metadata": {"labels": {consts.VALIDATE_REQUEST_LABEL: "requested"}}},
+            )
+            await r.reconcile("remediation")
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.REMEDIATION_STATE_LABEL] == rem.REVALIDATING
+
+            _pod(fc, "validator-fresh", "tpu-operator-validator")
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                await r.reconcile("remediation")
+                node = await client.get("", "Node", "tpu-0")
+                state = node["metadata"]["labels"].get(consts.REMEDIATION_STATE_LABEL)
+                if state in (rem.FAILED, rem.HEALTHY):
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            assert state == rem.FAILED
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Full-pipeline seeded smoke (small-tier sibling of `make chaos`)
+
+async def test_manager_converges_under_seeded_chaos():
+    """The tier-1 sized soak: a watch-driven manager converges an 8-node
+    cluster to Ready through a 5% seeded fault schedule with zero duplicate
+    creations, and returns to the zero-request steady state once chaos
+    stops."""
+    from tpu_operator.api.types import State
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.k8s.client import count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+
+    chaos = ChaosConfig(seed=37, error_rate=0.05, watch_drop_rate=0.3,
+                        watch_drop_after_s=(0.1, 0.8), watch_gone_rate=0.05,
+                        post_commit_error_rate=0.01)
+    async with FakeCluster(SimConfig(tick=0.01, pod_ready_delay=0.02), chaos=chaos) as fc:
+        client = _client(fc, max_attempts=8)
+        metrics = OperatorMetrics()
+        mgr = Manager(client, NS, metrics_port=-1, health_port=-1,
+                      operator_metrics=metrics)
+        reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics)
+        reconciler.setup(mgr)
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new().obj)
+                for i in range(8):
+                    fc.add_node(f"tpu-{i}")
+                deadline = asyncio.get_running_loop().time() + 120
+                while True:
+                    try:
+                        cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                        if deep_get(cr, "status", "state") == State.READY:
+                            break
+                    except (ApiError, OSError, asyncio.TimeoutError):
+                        pass
+                    assert asyncio.get_running_loop().time() < deadline, "never converged"
+                    await asyncio.sleep(0.1)
+
+                assert fc.duplicate_creations() == {}
+
+                fc.chaos.stop()
+                # steady state: passes return to the zero-request fixed point
+                for _ in range(60):
+                    await asyncio.sleep(0.3)
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    if counter.n == 0:
+                        break
+                assert counter.n == 0, f"steady pass still issues {counter.n} requests"
+        finally:
+            await client.close()

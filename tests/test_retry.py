@@ -1,0 +1,334 @@
+"""Retry policy / retry budget / circuit breaker / write fence unit tests,
+plus client-level behaviour against a chaos-injected fake apiserver
+(docs/ROBUSTNESS.md failure-mode catalogue)."""
+
+import asyncio
+import random
+
+import pytest
+
+from tpu_operator.k8s import retry as rt
+from tpu_operator.k8s.client import (
+    ApiClient,
+    ApiError,
+    BreakerOpenError,
+    Config,
+    request_policy,
+)
+from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+
+NS = "tpu-operator"
+
+
+# ----------------------------------------------------------------------
+# RetryPolicy
+
+def test_verb_classification_never_replays_post_on_5xx():
+    p = rt.RetryPolicy()
+    # ambiguous outcomes (5xx / timeout / reset) replay only idempotent verbs
+    for status in (500, 503, None):
+        assert p.retryable_verb("GET", status)
+        assert p.retryable_verb("PUT", status)
+        assert p.retryable_verb("DELETE", status)
+        assert p.retryable_verb("PATCH", status)
+        assert not p.retryable_verb("POST", status)
+    # 429 = explicitly not processed: every verb may retry, POST included
+    assert p.retryable_verb("POST", 429)
+
+
+def test_backoff_full_jitter_bounds_and_seeded_determinism():
+    p1 = rt.RetryPolicy(backoff_base=0.1, backoff_cap=2.0, rng=random.Random(42))
+    p2 = rt.RetryPolicy(backoff_base=0.1, backoff_cap=2.0, rng=random.Random(42))
+    seq1 = [p1.backoff(a) for a in range(1, 8)]
+    seq2 = [p2.backoff(a) for a in range(1, 8)]
+    assert seq1 == seq2  # seeded → replayable schedule
+    for attempt, delay in enumerate(seq1, start=1):
+        envelope = min(2.0, 0.1 * (2 ** (attempt - 1)))
+        assert 0.0 <= delay <= envelope
+    # jitter actually varies (not constant backoff)
+    assert len({round(d, 6) for d in seq1}) > 1
+
+
+def test_backoff_honors_retry_after_floor():
+    p = rt.RetryPolicy(backoff_base=0.001, backoff_cap=0.002, rng=random.Random(0))
+    assert p.backoff(1, retry_after=0.5) >= 0.5
+
+
+def test_retry_budget_bounds_retry_fraction():
+    b = rt.RetryBudget(ratio=0.5, cap=2.0)
+    # cap allows an initial burst of 2 retries, then the bucket is dry
+    assert b.allow_retry()
+    assert b.allow_retry()
+    assert not b.allow_retry()
+    # each regular request refills ratio tokens
+    b.record_request()
+    b.record_request()
+    assert b.allow_retry()
+    assert not b.allow_retry()
+
+
+# ----------------------------------------------------------------------
+# CircuitBreaker
+
+def test_breaker_full_lifecycle():
+    now = [0.0]
+    b = rt.CircuitBreaker(failure_threshold=3, reset_seconds=5.0, clock=lambda: now[0])
+    assert b.state == rt.CLOSED and b.allow()
+    # sub-threshold failures keep it closed; a success resets the streak
+    b.record_failure(); b.record_failure(); b.record_success()
+    assert b.state == rt.CLOSED
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == rt.OPEN
+    assert not b.allow()  # failing fast inside the reset window
+    now[0] = 5.1
+    assert b.allow()          # half-open: exactly one probe admitted
+    assert b.state == rt.HALF_OPEN
+    assert not b.allow()      # concurrent request while probe in flight
+    b.record_success()
+    assert b.state == rt.CLOSED and b.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    now = [0.0]
+    b = rt.CircuitBreaker(failure_threshold=1, reset_seconds=5.0, clock=lambda: now[0])
+    b.record_failure()
+    assert b.state == rt.OPEN
+    now[0] = 6.0
+    assert b.allow()
+    b.record_failure()  # probe failed → straight back to OPEN, fresh window
+    assert b.state == rt.OPEN
+    assert not b.allow()
+    assert b.opened_total == 2
+
+
+def test_breaker_ignores_logical_outcomes():
+    """404/409/422 prove the server is alive; only infra failures count —
+    enforced at the client layer by record_success on <500."""
+    b = rt.CircuitBreaker(failure_threshold=2)
+    b.record_failure()
+    b.record_success()  # what the client calls for any non-429 4xx
+    b.record_failure()
+    assert b.state == rt.CLOSED
+
+
+def test_breaker_429_is_neutral():
+    """A 429 must neither close the breaker from half-open (the server is
+    shedding load, not healthy) nor break a 500,429,500 failure streak."""
+    now = [0.0]
+    b = rt.CircuitBreaker(failure_threshold=2, reset_seconds=1.0, clock=lambda: now[0])
+    b.record_failure()
+    b.record_neutral()  # what the client calls for 429
+    b.record_failure()
+    assert b.state == rt.OPEN  # streak survived the interleaved 429
+    now[0] = 1.5
+    assert b.allow()  # half-open probe
+    b.record_neutral()  # probe answered 429: slot freed, state unchanged
+    assert b.state == rt.HALF_OPEN
+    assert b.allow()  # next probe admitted
+    b.record_success()
+    assert b.state == rt.CLOSED
+
+
+def test_breaker_probe_slot_never_wedges():
+    """A half-open probe whose task dies without a verdict (cancellation)
+    must not hold the slot forever: release_probe frees it immediately and
+    the staleness reclaim in allow() is the backstop."""
+    now = [0.0]
+    b = rt.CircuitBreaker(failure_threshold=1, reset_seconds=1.0, clock=lambda: now[0])
+    b.record_failure()
+    now[0] = 1.5
+    assert b.allow()         # probe admitted...
+    b.release_probe()        # ...but its task was cancelled mid-request
+    assert b.allow()         # slot free again at once
+    # backstop: a probe that simply never reports goes stale after the
+    # reset window and the slot is reclaimed
+    now[0] = 3.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == rt.CLOSED
+
+
+# ----------------------------------------------------------------------
+# WriteFence
+
+def test_fence_refuses_mutations_only_and_exempts_lease_and_events():
+    leading = [True]
+    f = rt.WriteFence(lambda: leading[0])
+    f.check("PUT", "/api/v1/nodes/n1")  # leader: anything goes
+    leading[0] = False
+    f.check("GET", "/api/v1/nodes/n1")  # reads always pass
+    with pytest.raises(rt.FencedError):
+        f.check("PUT", "/api/v1/nodes/n1")
+    with pytest.raises(rt.FencedError):
+        f.check("POST", "/api/v1/namespaces/x/pods")
+    # the elector must renew and replicas must report transitions
+    f.check("PUT", "/apis/coordination.k8s.io/v1/namespaces/x/leases/id")
+    f.check("POST", "/api/v1/namespaces/x/events")
+    assert f.refused_total == 2
+
+
+def test_fence_exemption_matches_collection_segment_not_substring():
+    """An object merely NAMED 'events' or 'leases' is still fenced — the
+    exemption keys on the URL's resource-collection segment."""
+    f = rt.WriteFence(lambda: False)
+    with pytest.raises(rt.FencedError):
+        f.check("PUT", "/api/v1/namespaces/tpu-operator/configmaps/events")
+    with pytest.raises(rt.FencedError):
+        f.check("PUT", "/api/v1/namespaces/events/configmaps/cm")
+    with pytest.raises(rt.FencedError):
+        f.check("DELETE", "/apis/apps/v1/namespaces/x/daemonsets/leases")
+    # a Lease outside coordination.k8s.io would not be the leader lock
+    f.check("POST", "/apis/events.k8s.io/v1/namespaces/x/events")  # new-style Events ok
+
+
+# ----------------------------------------------------------------------
+# Client-level behaviour against the chaos fake
+
+def _client(fc, **policy_kw) -> ApiClient:
+    defaults = dict(
+        backoff_base=0.005, backoff_cap=0.02, per_try_timeout=1.0,
+        total_timeout=5.0, rng=random.Random(0),
+    )
+    defaults.update(policy_kw)
+    client = ApiClient(Config(base_url=fc.base_url), retry_policy=rt.RetryPolicy(**defaults))
+    # storm tests run error rates far past the breaker threshold on purpose;
+    # breaker behaviour has its own tests below
+    client.breaker = None
+    return client
+
+
+async def test_get_retries_through_500_storm():
+    chaos = ChaosConfig(seed=5, verb_error_rates={"GET": 0.7},
+                        error_weights={"500": 1.0})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        fc.add_node("tpu-0")
+        client = _client(fc, max_attempts=8)
+        try:
+            hits = 0
+            for _ in range(10):
+                node = await client.get("", "Node", "tpu-0")
+                assert node["metadata"]["name"] == "tpu-0"
+                hits += 1
+            assert hits == 10  # every logical request eventually lands
+        finally:
+            await client.close()
+
+
+async def test_post_not_replayed_on_500_but_replayed_on_429():
+    """A POST answered 500 surfaces immediately (ambiguous: may have
+    committed); a POST answered 429 retries (explicitly not processed)."""
+    chaos = ChaosConfig(seed=1, verb_error_rates={"POST": 1.0},
+                        error_weights={"500": 1.0})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        client = _client(fc, max_attempts=5)
+        try:
+            with pytest.raises(ApiError) as ei:
+                await client.create({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "default"},
+                })
+            assert ei.value.status == 500
+            # only ONE wire attempt: no duplicate-minting replay
+            assert fc.request_counts[("POST", "configmaps")] == 1
+
+            fc.chaos.config.error_weights = {"429": 1.0}
+            fc.chaos.config.verb_error_rates = {"POST": 0.6}
+            created = await client.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm2", "namespace": "default"},
+            })
+            assert created["metadata"]["name"] == "cm2"
+        finally:
+            await client.close()
+
+
+async def test_hung_request_bounded_by_per_try_timeout():
+    """Satellite bugfix: every non-watch request now has a default timeout —
+    a hung apiserver connection surfaces as TimeoutError instead of
+    stalling the reconcile pass indefinitely."""
+    chaos = ChaosConfig(seed=2, hang_rate=1.0, hang_s=30.0)
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        fc.add_node("tpu-0")
+        client = _client(fc, max_attempts=2, per_try_timeout=0.2, total_timeout=1.0)
+        try:
+            t0 = asyncio.get_running_loop().time()
+            with pytest.raises((asyncio.TimeoutError, ApiError)):
+                await client.get("", "Node", "tpu-0")
+            assert asyncio.get_running_loop().time() - t0 < 5.0
+        finally:
+            await client.close()
+
+
+async def test_default_policy_has_timeouts():
+    """The out-of-the-box client (no explicit policy) carries the default
+    per-try/total timeouts — the regression this PR fixes."""
+    client = ApiClient(Config(base_url="http://127.0.0.1:1"))
+    assert client.retry_policy.per_try_timeout is not None
+    assert client.retry_policy.total_timeout is not None
+    assert client.breaker is not None
+    await client.close()
+
+
+async def test_breaker_trips_to_fail_fast_and_recovers_via_probe():
+    chaos = ChaosConfig(seed=3, error_rate=1.0, error_weights={"503": 1.0})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        fc.add_node("tpu-0")
+        client = _client(fc, max_attempts=1)
+        client.breaker = rt.CircuitBreaker(failure_threshold=3, reset_seconds=0.1)
+        try:
+            for _ in range(3):
+                with pytest.raises(ApiError):
+                    await client.get("", "Node", "tpu-0")
+            assert client.breaker.state == rt.OPEN
+            # inside the window: fail-fast without touching the wire
+            wire = fc.total_requests()
+            with pytest.raises(BreakerOpenError):
+                await client.get("", "Node", "tpu-0")
+            assert fc.total_requests() == wire
+            # server recovers; after the reset window one probe closes it
+            fc.chaos.stop()
+            await asyncio.sleep(0.15)
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["name"] == "tpu-0"
+            assert client.breaker.state == rt.CLOSED
+        finally:
+            await client.close()
+
+
+async def test_request_policy_contextvar_override():
+    """The elector's seam: a scoped policy (tight timeout, single attempt)
+    overrides the client default inside the context only."""
+    chaos = ChaosConfig(seed=4, error_rate=1.0, error_weights={"500": 1.0})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        fc.add_node("tpu-0")
+        client = _client(fc, max_attempts=8)
+        try:
+            fc.reset_request_counts()
+            with request_policy(rt.RetryPolicy(max_attempts=1, per_try_timeout=1.0,
+                                               total_timeout=1.0)):
+                with pytest.raises(ApiError):
+                    await client.get("", "Node", "tpu-0")
+            assert fc.request_counts[("GET", "nodes")] == 1  # no retries inside
+        finally:
+            await client.close()
+
+
+async def test_retries_feed_metrics_counter():
+    from tpu_operator.metrics import OperatorMetrics
+
+    chaos = ChaosConfig(seed=6, verb_error_rates={"GET": 0.8},
+                        error_weights={"503": 1.0})
+    async with FakeCluster(SimConfig(enabled=False), chaos=chaos) as fc:
+        fc.add_node("tpu-0")
+        client = _client(fc, max_attempts=10)
+        client.metrics = OperatorMetrics()
+        try:
+            await client.get("", "Node", "tpu-0")
+            total = 0.0
+            for fam in client.metrics.registry.collect():
+                if fam.name == "tpu_operator_k8s_request_retries":
+                    total += sum(s.value for s in fam.samples if s.name.endswith("_total"))
+            assert total >= 1
+        finally:
+            await client.close()

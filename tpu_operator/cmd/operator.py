@@ -78,6 +78,9 @@ async def run(args: argparse.Namespace) -> None:
     namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "tpu-operator")
     client = ApiClient(Config.from_env())
     metrics = OperatorMetrics()
+    # retry/breaker observability: the client feeds retries_total, the
+    # manager's supervisor syncs the breaker-state gauge
+    client.metrics = metrics
     # ONE tracer/recorder pair for the whole process so /debug/traces sees
     # every controller and the Event correlator dedups across them
     tracer = Tracer(metrics)
@@ -93,6 +96,8 @@ async def run(args: argparse.Namespace) -> None:
         renew_interval=args.leader_lease_retry_period,
         renew_deadline=args.leader_lease_renew_deadline,
         tracer=tracer,
+        recorder=recorder,
+        operator_metrics=metrics,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers

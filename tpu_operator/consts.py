@@ -196,5 +196,20 @@ VALIDATOR_SLEEP_SECONDS = 5.0        # validator/main.go:133-134
 VALIDATOR_WORKLOAD_RETRIES = 60      # :167-170
 VALIDATOR_RESOURCE_RETRIES = 30      # :171-174
 
+# API-request resilience envelope (k8s/retry.py; docs/ROBUSTNESS.md).  The
+# per-try timeout is the hung-connection bound — before it existed a stalled
+# apiserver socket parked a reconcile pass on aiohttp's 5-minute default.
+K8S_RETRY_MAX_ATTEMPTS = 4
+K8S_RETRY_BACKOFF_BASE_SECONDS = 0.1
+K8S_RETRY_BACKOFF_CAP_SECONDS = 2.0
+K8S_REQUEST_PER_TRY_TIMEOUT_SECONDS = 15.0
+K8S_REQUEST_TOTAL_TIMEOUT_SECONDS = 60.0
+K8S_RETRY_BUDGET_RATIO = 0.2         # ≤20% of sustained traffic may be retries
+# Circuit breaker: consecutive infrastructure failures (5xx/timeout/reset)
+# before the manager flips into degraded mode; reset window before a
+# half-open probe is admitted.
+K8S_BREAKER_FAILURE_THRESHOLD = 5
+K8S_BREAKER_RESET_SECONDS = 5.0
+
 # Leader election id (main.go:105-115 analogue: "53822513.nvidia.com").
 LEADER_ELECTION_ID = "53822513.tpu.google.com"

@@ -73,6 +73,10 @@ class ClusterPolicyReconciler:
         self.namespace = namespace
         self.state_manager = StateManager(renderer)
         self.metrics = metrics or OperatorMetrics()
+        # retry-policy observability: the client feeds
+        # k8s_request_retries_total; first reconciler to own metrics wires it
+        if getattr(client, "metrics", None) is None:
+            client.metrics = self.metrics
         # all reconcile-path reads/writes go through the reader; without
         # registered informers (direct-drive tests) every read falls back
         # live and behaviour is identical to the raw client
@@ -253,6 +257,9 @@ class ClusterPolicyReconciler:
     # Watch wiring (SetupWithManager analogue).
 
     def setup(self, mgr: Manager) -> Controller:
+        if mgr.operator_metrics is None:
+            # breaker-state gauge + degraded-mode counter for the supervisor
+            mgr.operator_metrics = self.metrics
         controller = mgr.add_controller(Controller("clusterpolicy", self.reconcile))
 
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)

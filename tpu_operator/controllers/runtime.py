@@ -12,14 +12,17 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Awaitable, Callable, Optional
 
 from aiohttp import web
 
 from tpu_operator import consts
+from tpu_operator.k8s import retry as retry_api
 from tpu_operator.k8s.client import ApiClient
 from tpu_operator.k8s.informer import Informer
 from tpu_operator.k8s.leader import LeaderElector
+from tpu_operator.obs import events as obs_events
 
 log = logging.getLogger("tpu_operator.controllers")
 
@@ -59,6 +62,10 @@ class Controller:
         self._pending: set[str] = set()  # dedupe: keys queued but not yet popped
         self._timers: dict[str, asyncio.TimerHandle] = {}
         self._task: Optional[asyncio.Task] = None
+        # run-permission gate installed by the manager: cleared while the
+        # process is degraded (breaker open) or deposed (lost leadership);
+        # None (standalone controller) means always-run
+        self.gate: Optional[asyncio.Event] = None
 
     def enqueue(self, key: str) -> None:
         if key in self._pending:
@@ -88,24 +95,51 @@ class Controller:
     async def start(self) -> None:
         self._task = asyncio.create_task(self._worker(), name=f"controller-{self.name}")
 
-    async def stop(self) -> None:
-        for t in self._timers.values():
-            t.cancel()
-        self._timers.clear()
+    async def _cancel_worker(self) -> None:
         if self._task:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:  # noqa: BLE001
+                log.debug("[%s] worker errored during stop", self.name, exc_info=True)
+            self._task = None
+
+    async def stop(self) -> None:
+        for t in self._timers.values():
+            t.cancel()
+        self._timers.clear()
+        await self._cancel_worker()
+
+    # -- pause/resume (degraded mode, leadership loss) ------------------
+    async def suspend(self) -> None:
+        """Cancel the worker (killing any in-flight reconcile) but keep the
+        queue and delayed timers: work accumulates while paused and drains
+        on resume instead of being forgotten."""
+        await self._cancel_worker()
+
+    async def resume(self) -> None:
+        if self._task is None or self._task.done():
+            await self.start()
 
     async def _worker(self) -> None:
         while True:
             key = await self._queue.get()
             self._pending.discard(key)
             try:
+                if self.gate is not None:
+                    # paused (degraded / not leader): hold the popped key
+                    # until the manager reopens the gate — belt to
+                    # suspend()'s braces, covering the race where a key is
+                    # popped as the gate closes
+                    await self.gate.wait()
                 requeue = await self.reconcile(key)
             except asyncio.CancelledError:
+                # suspended with the key popped (mid-reconcile or parked at
+                # the gate): the pass may be half-applied — requeue so the
+                # resumed worker finishes the job
+                self.enqueue(key)
                 raise
             except Exception:  # noqa: BLE001
                 delay = self.limiter.when(key)
@@ -132,6 +166,8 @@ class Manager:
         renew_interval: float = 5.0,
         renew_deadline: Optional[float] = None,
         tracer=None,
+        recorder=None,
+        operator_metrics=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -141,6 +177,12 @@ class Manager:
         self.metrics_registry = metrics_registry
         # shared obs.trace.Tracer; its ring buffer backs /debug/traces
         self.tracer = tracer
+        # EventRecorder for manager-level evidence (DegradedMode, leadership
+        # transitions); optional — tests without one just get logs
+        self.recorder = recorder
+        # OperatorMetrics for the breaker-state gauge; reconciler setup()
+        # fills it in when the binary didn't pass one explicitly
+        self.operator_metrics = operator_metrics
         # --leader-lease-renew-deadline analogue (cmd/gpu-operator
         # main.go:72-81): operators tune these for flaky control planes
         self.lease_duration = lease_duration
@@ -152,6 +194,17 @@ class Manager:
         self._runners: list[web.AppRunner] = []
         self.started = asyncio.Event()
         self.start_time = time.time()
+        # degraded-mode machinery: the gate is SET while reconciles may run
+        # (leader + breaker not open); the supervisor flips it and pauses /
+        # resumes controller workers on transitions
+        self._resume = asyncio.Event()
+        self._resume.set()
+        self.degraded = False
+        self._supervisor: Optional[asyncio.Task] = None
+        self._paused = False
+        # manager Events that failed to post (apiserver down is exactly when
+        # DegradedMode fires) are retried by the supervisor until they land
+        self._pending_events: deque[tuple[str, dict, str, str]] = deque(maxlen=64)
 
     def informer(self, group: str, kind: str, **kw) -> Informer:
         key = f"{group}/{kind}/{kw.get('namespace') or ''}/{kw.get('label_selector') or ''}"
@@ -165,6 +218,7 @@ class Manager:
         return self.informers[key]
 
     def add_controller(self, controller: Controller) -> Controller:
+        controller.gate = self._resume
         self.controllers.append(controller)
         return controller
 
@@ -177,6 +231,15 @@ class Manager:
                 renew_interval=self.renew_interval,
                 renew_deadline=self.renew_deadline,
             )
+            # Fence BEFORE the first write can happen: every mutating verb
+            # (lease + event traffic exempt) is refused by the client the
+            # instant is_leader clears — in-flight reconcile cancellation
+            # (supervisor) is cleanup, the fence is the guarantee.
+            self.client.fence = retry_api.WriteFence(self.elector.is_leader.is_set)
+            # client-go LeaderCallbacks analogue: every transition (the
+            # initial acquisition included) queues its Event synchronously
+            # at the moment the elector flips, not at supervisor cadence
+            self.elector.on_transition.append(self._on_leadership)
             await self.elector.start()
             await self.elector.is_leader.wait()
         await self._start_http()
@@ -187,6 +250,9 @@ class Manager:
             await informer.start(wait=informer.required)
         for controller in self.controllers:
             await controller.start()
+        self._supervisor = asyncio.create_task(
+            self._supervise(), name="manager-supervisor"
+        )
         self.started.set()
         log.info(
             "manager started: %d informers, %d controllers, ns=%s",
@@ -194,15 +260,129 @@ class Manager:
         )
 
     async def stop(self) -> None:
+        if self._supervisor:
+            self._supervisor.cancel()
+            try:
+                await self._supervisor
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001
+                log.debug("manager supervisor errored during stop", exc_info=True)
+            self._supervisor = None
         for controller in self.controllers:
             await controller.stop()
         for informer in self.informers.values():
             await informer.stop()
         if self.elector:
             await self.elector.stop()
+        if self.client.fence is not None:
+            self.client.fence = None
         for runner in self._runners:
             await runner.cleanup()
         self._runners.clear()
+
+    # ------------------------------------------------------------------
+    # Degraded mode + leadership supervision.
+
+    def _breaker_unhealthy(self) -> bool:
+        """Degraded until the breaker is fully CLOSED: HALF_OPEN still
+        fails fast for everything but its single probe, and treating it as
+        recovered would flap degraded-mode (events, /readyz, worker
+        suspend/resume churn) every reset window of a sustained outage."""
+        breaker = getattr(self.client, "breaker", None)
+        return breaker is not None and breaker.state != retry_api.CLOSED
+
+    def _is_leader(self) -> bool:
+        return not self.leader_elect or (
+            self.elector is not None and self.elector.is_leader.is_set()
+        )
+
+    async def _supervise(self) -> None:
+        """Drives the run/pause state machine: breaker OPEN → degraded mode
+        (reconciles pause, /readyz flips, DegradedMode Event + gauge);
+        half-open probes (informer relists, lease renewals) closing the
+        breaker restore service.  Leadership loss pauses the same way, with
+        the write fence already engaged synchronously by the elector."""
+        while True:
+            breaker = getattr(self.client, "breaker", None)
+            if self.operator_metrics is not None and breaker is not None:
+                self.operator_metrics.api_breaker_state.set(breaker.state)
+
+            degraded = self._breaker_unhealthy()
+            if degraded and not self.degraded:
+                self.degraded = True
+                log.warning("entering degraded mode: api circuit breaker open")
+                if self.operator_metrics is not None:
+                    self.operator_metrics.degraded_mode_total.inc()
+                self._queue_event(
+                    "warning", obs_events.namespace_ref(self.namespace),
+                    obs_events.REASON_DEGRADED,
+                    "apiserver circuit breaker open: reconciles paused, "
+                    "half-open probes will restore service",
+                )
+            elif not degraded and self.degraded:
+                self.degraded = False
+                log.info("leaving degraded mode: api circuit breaker closed")
+                self._queue_event(
+                    "normal", obs_events.namespace_ref(self.namespace),
+                    obs_events.REASON_DEGRADED_RECOVERED,
+                    "apiserver recovered: circuit breaker closed, reconciles resume",
+                )
+
+            # leadership-transition Events are queued by the elector's
+            # on_transition callback (_on_leadership) the instant they
+            # happen; this loop only drives pause/resume and the flush
+            should_run = self._is_leader() and not degraded
+            if should_run and self._paused:
+                self._paused = False
+                self._resume.set()
+                for c in self.controllers:
+                    await c.resume()
+                log.info("reconciles resumed")
+            elif not should_run and not self._paused:
+                self._paused = True
+                self._resume.clear()
+                # cancel in-flight reconciles; each cancelled worker
+                # re-enqueues its popped key so resume finishes the job
+                for c in self.controllers:
+                    await c.suspend()
+                log.warning(
+                    "reconciles paused (%s)",
+                    "degraded" if degraded else "not leader",
+                )
+            await self._flush_events()
+            await asyncio.sleep(0.05)
+
+    def _on_leadership(self, leader: bool) -> None:
+        ref = obs_events.lease_ref(self.namespace, consts.LEADER_ELECTION_ID)
+        ident = self.elector.identity if self.elector else "unknown"
+        if leader:
+            self._queue_event(
+                "normal", ref, obs_events.REASON_LEADER_ELECTED,
+                f"{ident} became leader",
+            )
+        else:
+            self._queue_event(
+                "warning", ref, obs_events.REASON_LEADERSHIP_LOST,
+                f"{ident} lost leadership; writers fenced and reconciles paused",
+            )
+
+    def _queue_event(self, level: str, ref: dict, reason: str, message: str) -> None:
+        if self.recorder is not None:
+            self._pending_events.append((level, ref, reason, message))
+
+    async def _flush_events(self) -> None:
+        """Post queued manager Events; keep what fails for the next tick —
+        DegradedMode fires exactly when posting is most likely to fail, and
+        the evidence must land once the apiserver is back."""
+        if self._breaker_unhealthy():
+            return  # pointless while failing fast; retried after recovery
+        while self._pending_events:
+            level, ref, reason, message = self._pending_events[0]
+            post = self.recorder.warning if level == "warning" else self.recorder.normal
+            if await post(ref, reason, message) is None:
+                return  # recorder swallowed a failure; retry next tick
+            self._pending_events.popleft()
 
     async def __aenter__(self) -> "Manager":
         await self.start()
@@ -249,12 +429,24 @@ class Manager:
         return web.Response(text="ok")
 
     async def _readyz(self, request: web.Request) -> web.Response:
+        # breaker state first: a degraded manager is not ready to act, and
+        # the probe text says WHY so kubectl-level triage needs no metrics
+        breaker = getattr(self.client, "breaker", None)
+        if self.degraded or self._breaker_unhealthy():
+            state = breaker.state_name if breaker is not None else "open"
+            return web.Response(
+                text=f"degraded: api circuit breaker {state}", status=503
+            )
         # only required informers gate readiness; an optional informer for an
         # absent API (e.g. ServiceMonitor) never syncs and must not wedge it
         synced = all(
             i.synced.is_set() for i in self.informers.values() if i.required
         )
-        return web.Response(text="ok" if synced else "not ready", status=200 if synced else 503)
+        suffix = f" (breaker {breaker.state_name})" if breaker is not None else ""
+        return web.Response(
+            text=("ok" if synced else "not ready") + suffix,
+            status=200 if synced else 503,
+        )
 
     async def _metrics(self, request: web.Request) -> web.Response:
         from prometheus_client import REGISTRY, generate_latest

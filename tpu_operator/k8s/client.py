@@ -24,6 +24,7 @@ import aiohttp
 
 from tpu_operator import consts
 from tpu_operator.k8s import objects as obj_api
+from tpu_operator.k8s import retry as retry_api
 from tpu_operator.obs import trace
 from tpu_operator.utils import bounded_gather
 
@@ -67,10 +68,13 @@ class Config:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, reason: str, body: Any = None):
+    def __init__(self, status: int, reason: str, body: Any = None,
+                 retry_after: Optional[float] = None):
         self.status = status
         self.reason = reason
         self.body = body
+        # parsed Retry-After (seconds) from a 429/503, honored by RetryPolicy
+        self.retry_after = retry_after
         super().__init__(f"{status} {reason}")
 
     @property
@@ -89,6 +93,29 @@ class ApiError(Exception):
     @property
     def already_exists(self) -> bool:
         return self.status == 409 and self.reason == "AlreadyExists"
+
+
+class BreakerOpenError(ApiError):
+    """Failed fast client-side: the circuit breaker is OPEN.
+
+    An ApiError subclass (status 503) so existing taxonomy — workqueue
+    backoff on reconcile failure, informer transient handling, best-effort
+    Event dropping — applies without new call-site cases."""
+
+    def __init__(self, path: str = ""):
+        super().__init__(503, "CircuitBreakerOpen",
+                         f"api circuit breaker open; failing fast ({path})")
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Seconds form of Retry-After only (the apiserver emits integers;
+    HTTP-date form is not worth a date parser here)."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
 
 
 @dataclass
@@ -126,14 +153,49 @@ def count_api_requests() -> Iterator[RequestCounter]:
         _REQUEST_COUNTER.reset(token)
 
 
+# Per-task RetryPolicy override (flows through the task tree like the request
+# counter).  The leader elector uses it to cap each lease call well inside its
+# renew deadline — a hung renew must surface before step-down, not after the
+# client-wide 60s total budget.
+_REQUEST_POLICY: ContextVar[Optional["retry_api.RetryPolicy"]] = ContextVar(
+    "tpu_operator_k8s_request_policy", default=None
+)
+
+
+@contextlib.contextmanager
+def request_policy(policy: retry_api.RetryPolicy) -> Iterator[None]:
+    token = _REQUEST_POLICY.set(policy)
+    try:
+        yield
+    finally:
+        _REQUEST_POLICY.reset(token)
+
+
 class ApiClient:
     TOKEN_REFRESH_SECONDS = 60.0
 
-    def __init__(self, config: Optional[Config] = None):
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        retry_policy: Optional[retry_api.RetryPolicy] = None,
+        breaker: Optional[retry_api.CircuitBreaker] = None,
+    ):
         self.config = config or Config.from_env()
         self._session: Optional[aiohttp.ClientSession] = None
         self._token_checked_at = 0.0
         self._pending_closes: set[asyncio.Task] = set()
+        # resilience envelope (k8s/retry.py): every non-watch request runs
+        # under a per-try timeout + bounded retries; the shared budget stops
+        # retry storms; the breaker flips the manager into degraded mode
+        self.retry_policy = retry_policy or retry_api.RetryPolicy(
+            budget=retry_api.RetryBudget(ratio=consts.K8S_RETRY_BUDGET_RATIO)
+        )
+        self.breaker = breaker if breaker is not None else retry_api.CircuitBreaker()
+        # installed by the manager under leader election; checked per request
+        self.fence: Optional[retry_api.WriteFence] = None
+        # OperatorMetrics for k8s_request_retries_total (wired by whoever
+        # owns both, e.g. ClusterPolicyReconciler / the operator binary)
+        self.metrics: Optional[Any] = None
 
     async def __aenter__(self) -> "ApiClient":
         await self.session()
@@ -200,23 +262,118 @@ class ApiClient:
         body: Any = None,
         content_type: str = "application/json",
     ) -> Any:
-        sess = await self.session()
-        counter = _REQUEST_COUNTER.get()
-        if counter is not None:
-            counter.n += 1
+        """One logical request = bounded attempts under a RetryPolicy.
+
+        Fence first (a deposed leader must not mutate), breaker second (an
+        open breaker fails fast without touching the wire), then attempts
+        with full-jitter backoff between them.  Non-idempotent verbs (POST)
+        are never replayed after an ambiguous failure — the apply layer's
+        get/adopt path recovers instead of risking duplicate side effects.
+        """
+        if self.fence is not None:
+            self.fence.check(method, path)
+        policy = _REQUEST_POLICY.get() or self.retry_policy
+        deadline = (
+            time.monotonic() + policy.total_timeout
+            if policy.total_timeout is not None
+            else None
+        )
         data = None
         headers = {}
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = content_type
+
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.breaker is not None and not self.breaker.allow():
+                raise BreakerOpenError(path)
+            try:
+                return await self._attempt(method, path, params, data, headers, policy)
+            except asyncio.CancelledError:
+                # the task died without a verdict — never leave a half-open
+                # probe slot held, or the breaker wedges permanently
+                if self.breaker is not None:
+                    self.breaker.release_probe()
+                raise
+            except ApiError as e:
+                if self.breaker is not None:
+                    # only 5xx counts toward tripping the breaker; other 4xx
+                    # proves the server alive and parsing; 429 is NEUTRAL —
+                    # a throttling server must not close the breaker from
+                    # half-open nor break a 500,429,500 failure streak
+                    if e.status >= 500:
+                        self.breaker.record_failure()
+                    elif e.status == 429:
+                        self.breaker.record_neutral()
+                    else:
+                        self.breaker.record_success()
+                if not (e.status >= 500 or e.status == 429):
+                    raise  # logical outcome (404/409/422/...): caller's business
+                if not self._may_retry(policy, method, e.status, attempt, deadline):
+                    raise
+                delay = policy.backoff(attempt, retry_after=e.retry_after)
+            except (aiohttp.ClientError, OSError, asyncio.TimeoutError):
+                # transport-level: connection refused/reset, hung socket
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if not self._may_retry(policy, method, None, attempt, deadline):
+                    raise
+                delay = policy.backoff(attempt)
+            if deadline is not None:
+                delay = min(delay, max(0.0, deadline - time.monotonic()))
+            if self.metrics is not None:
+                self.metrics.k8s_request_retries_total.labels(verb=method).inc()
+            log.debug("retrying %s %s (attempt %d) in %.3fs", method, path, attempt, delay)
+            await asyncio.sleep(delay)
+
+    def _may_retry(
+        self,
+        policy: retry_api.RetryPolicy,
+        method: str,
+        status: Optional[int],
+        attempt: int,
+        deadline: Optional[float],
+    ) -> bool:
+        if attempt >= policy.max_attempts:
+            return False
+        if deadline is not None and time.monotonic() >= deadline:
+            return False
+        if not policy.retryable_verb(method, status):
+            return False
+        return policy.budget is None or policy.budget.allow_retry()
+
+    async def _attempt(
+        self,
+        method: str,
+        path: str,
+        params: Optional[dict],
+        data: Optional[bytes],
+        headers: dict,
+        policy: retry_api.RetryPolicy,
+    ) -> Any:
+        sess = await self.session()
+        counter = _REQUEST_COUNTER.get()
+        if counter is not None:
+            counter.n += 1
+        if policy.budget is not None:
+            policy.budget.record_request()
+        # an explicit timeout=None would DISABLE aiohttp's session default
+        # (not inherit it) — only pass the kwarg when the policy sets one
+        timeout_kw: dict = {}
+        if policy.per_try_timeout is not None:
+            timeout_kw["timeout"] = aiohttp.ClientTimeout(total=policy.per_try_timeout)
         # no-op unless a tracer is ambient (reconcile pass / activated CLI);
-        # feeds k8s_request_duration_seconds{verb} and the span tree
+        # feeds k8s_request_duration_seconds{verb} and the span tree —
+        # one span per attempt so retries are visible in /debug/traces
         error: Optional[ApiError] = None
         with trace.span(
             f"k8s/{method}", kind=trace.KIND_K8S, verb=method, path=path
         ) as sp:
             async with sess.request(
-                method, path, params=params, data=data, headers=headers
+                method, path, params=params, data=data, headers=headers,
+                **timeout_kw,
             ) as resp:
                 text = await resp.text()
                 payload: Any = None
@@ -233,11 +390,16 @@ class ApiClient:
                     # (get-before-create 404s, status conflicts) don't
                     # error-flag healthy traces; server-side 5xx is a real
                     # failure worth surfacing in /debug/traces
-                    error = ApiError(resp.status, str(reason), payload)
+                    error = ApiError(
+                        resp.status, str(reason), payload,
+                        retry_after=_parse_retry_after(resp.headers.get("Retry-After")),
+                    )
                     if sp is not None and resp.status >= 500:
                         sp.error = f"ApiError: {error}"
         if error is not None:
             raise error
+        if self.breaker is not None:
+            self.breaker.record_success()
         return payload
 
     # ------------------------------------------------------------------

@@ -11,6 +11,8 @@ import logging
 import time
 from typing import Awaitable, Callable, Optional
 
+import aiohttp
+
 from tpu_operator.k8s import objects as obj_api
 from tpu_operator.k8s.client import ApiClient, ApiError
 
@@ -82,8 +84,10 @@ class Informer:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:  # noqa: BLE001
+                log.debug("informer %s task errored during stop", self.kind, exc_info=True)
 
     async def _dispatch(self, event_type: str, obj: dict) -> None:
         for handler in self.handlers:
@@ -93,9 +97,25 @@ class Informer:
                 log.exception("informer handler failed for %s %s", self.kind, event_type)
 
     async def _run(self) -> None:
+        """List+watch forever, with an explicit failure taxonomy:
+
+        - ``410 Gone`` (watch window expired — as an ERROR event mid-stream
+          or a status on the watch GET) is PROTOCOL, not failure: relist
+          immediately with a fresh resourceVersion, no backoff (client-go
+          reflector semantics).  Consecutive 410s still yield briefly so a
+          chaos-saturated apiserver isn't relist-hammered in a hot loop.
+        - transient errors (API 5xx/429, connection resets, timeouts) back
+          off exponentially; an unserved API (404/405) on an OPTIONAL
+          informer slow-polls at CRD-install cadence.
+        - anything else is a bug worth a loud log, but the informer keeps
+          running — a watch loop that dies silently starves every
+          controller fed by it.
+        """
         backoff = 0.05
+        consecutive_gone = 0
         while True:
             watch_started = 0.0
+            served = False  # did this cycle's watch deliver anything?
             try:
                 listing = await self.client.list(
                     self.group, self.kind, self.namespace, self.label_selector
@@ -132,7 +152,15 @@ class Informer:
                     if evt.type == "BOOKMARK":
                         continue
                     if evt.type == "ERROR":
+                        # the apiserver closes the window with a Status
+                        # object; code 410 means our resourceVersion expired
+                        if (evt.object or {}).get("code") == 410:
+                            raise ApiError(410, "Expired")
                         break
+                    # only REAL object events count as a healthy watch: a
+                    # stream that serves one bookmark (or an error status)
+                    # then dies must keep backing off, not reset it
+                    served = True
                     meta = evt.object.get("metadata", {})
                     key = (meta.get("namespace", ""), meta.get("name", ""))
                     if evt.type == "DELETED":
@@ -142,18 +170,37 @@ class Informer:
                     await self._dispatch(evt.type, evt.object)
             except asyncio.CancelledError:
                 raise
-            except (ApiError, OSError, asyncio.TimeoutError, Exception) as e:  # noqa: BLE001
-                log.debug("informer %s stream reset; relisting", self.kind, exc_info=True)
+            except ApiError as e:
+                if e.status == 410:
+                    # relist-with-fresh-rv is the protocol answer; only
+                    # repeated Gones (chaos, hot relist) earn a short yield
+                    consecutive_gone += 1
+                    log.debug("informer %s watch expired (410); relisting", self.kind)
+                    if consecutive_gone > 1:
+                        await asyncio.sleep(min(0.05 * consecutive_gone, 1.0))
+                    continue
                 # only optional informers slow-poll an unserved API; a
                 # required one hitting the operator-install CRD race must
                 # keep the fast backoff or manager start stalls for minutes
-                if isinstance(e, ApiError) and e.status in (404, 405) and not self.required:
+                if e.status in (404, 405) and not self.required:
                     await asyncio.sleep(ABSENT_API_RETRY_SECONDS)
                     continue
-            # Only treat the cycle as healthy (reset backoff) if the watch ran
-            # for a while; a watch that dies instantly (e.g. RBAC 403) must
-            # keep backing off or we relist-hammer the apiserver.
-            if watch_started and time.monotonic() - watch_started >= 1.0:
+                log.debug("informer %s API error; backing off", self.kind, exc_info=True)
+            except (OSError, asyncio.TimeoutError, aiohttp.ClientError):
+                log.debug("informer %s stream reset; relisting", self.kind, exc_info=True)
+            except Exception:  # noqa: BLE001 — unexpected: loud, but keep serving
+                log.exception("informer %s unexpected error; backing off", self.kind)
+            consecutive_gone = 0
+            # Reset backoff only for a cycle whose watch proved healthy: it
+            # served at least one event, or survived to (near) its natural
+            # resync timeout.  A watch that dies quickly WITHOUT serving
+            # anything (RBAC 403, chaos drop-on-connect) keeps backing off —
+            # previously any stream that lived ≥1s reset the backoff and a
+            # serve-nothing-die-young apiserver got relist-hammered.
+            healthy_window = min(self.resync_seconds, 30.0)
+            if served or (
+                watch_started and time.monotonic() - watch_started >= healthy_window
+            ):
                 backoff = 0.05
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, 5.0)

@@ -14,12 +14,18 @@ import logging
 import os
 import socket
 import time as _time
-from typing import Optional
+from typing import Callable, Optional
 
 from tpu_operator import consts
-from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.k8s import retry as retry_api
+from tpu_operator.k8s.client import ApiClient, ApiError, request_policy
 
 log = logging.getLogger("tpu_operator.k8s.leader")
+
+# (is_leader: bool) sync callbacks fired on every leadership transition —
+# the manager hooks these to fence writers / emit Events (client-go's
+# LeaderCallbacks OnStartedLeading/OnStoppedLeading analogue)
+TransitionCallback = Callable[[bool], None]
 
 
 def _now() -> str:
@@ -70,6 +76,16 @@ class LeaderElector:
         self.is_leader = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._last_renew = 0.0
+        self.on_transition: list[TransitionCallback] = []
+        # Lease calls run under a policy whose TOTAL budget fits inside one
+        # renew tick: a hung renew must surface (and count against the renew
+        # deadline) before step-down time, not after the client-wide 60s
+        # default.  One attempt per tick — the renew loop IS the retry loop.
+        self._lease_policy = retry_api.RetryPolicy(
+            max_attempts=1,
+            per_try_timeout=max(0.05, self.renew_interval * 0.9),
+            total_timeout=max(0.05, self.renew_interval * 0.9),
+        )
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name="leader-elector")
@@ -79,33 +95,61 @@ class LeaderElector:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:  # noqa: BLE001
+                log.debug("leader elector task errored during stop", exc_info=True)
+        self._set_leader(False)
         # best-effort release
         try:
-            lease = await self.client.get("coordination.k8s.io", "Lease", self.name, self.namespace)
-            if lease.get("spec", {}).get("holderIdentity") == self.identity:
-                lease["spec"]["holderIdentity"] = None
-                await self.client.update(lease)
-        except (ApiError, OSError):
+            with request_policy(self._lease_policy):
+                lease = await self.client.get(
+                    "coordination.k8s.io", "Lease", self.name, self.namespace
+                )
+                if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                    lease["spec"]["holderIdentity"] = None
+                    await self.client.update(lease)
+        except (ApiError, OSError, asyncio.TimeoutError):
             pass
+
+    def _set_leader(self, value: bool) -> None:
+        """Single transition point: flips the event and notifies callbacks
+        (fence/Events) synchronously, BEFORE any further await — a deposed
+        leader must be fenced the same instant ``is_leader`` clears."""
+        if value == self.is_leader.is_set():
+            return
+        if value:
+            log.info("became leader (%s)", self.identity)
+            self.is_leader.set()
+        else:
+            log.warning("lost leadership (%s)", self.identity)
+            self.is_leader.clear()
+        for cb in self.on_transition:
+            try:
+                cb(value)
+            except Exception:  # noqa: BLE001
+                log.exception("leadership transition callback failed")
 
     async def _run(self) -> None:
         while True:
             try:
-                acquired = await self._try_acquire_or_renew()
+                with request_policy(self._lease_policy):
+                    acquired = await self._try_acquire_or_renew()
                 if acquired:
                     self._last_renew = _time.monotonic()
-                    if not self.is_leader.is_set():
-                        log.info("became leader (%s)", self.identity)
-                        self.is_leader.set()
-                elif self.is_leader.is_set():
-                    log.warning("lost leadership (%s)", self.identity)
-                    self.is_leader.clear()
+                    self._set_leader(True)
+                else:
+                    self._set_leader(False)
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001
-                log.exception("leader election error")
+            except Exception as e:  # noqa: BLE001
+                if isinstance(e, (ApiError, OSError, asyncio.TimeoutError)):
+                    # expected while the apiserver is unhealthy (incl. the
+                    # breaker failing fast); the step-down guard below is the
+                    # real handling — no traceback spam every renew tick
+                    log.warning("leader election error: %s", e)
+                else:
+                    log.exception("leader election error")
                 # Step down if we cannot prove we still hold the lease: once
                 # our last successful renew is older than the lease duration,
                 # another replica may legitimately acquire it (split-brain
@@ -115,7 +159,7 @@ class LeaderElector:
                     and _time.monotonic() - self._last_renew > self.renew_deadline
                 ):
                     log.warning("renew deadline exceeded; stepping down (%s)", self.identity)
-                    self.is_leader.clear()
+                    self._set_leader(False)
             await asyncio.sleep(self.renew_interval if self.is_leader.is_set() else self.renew_interval / 2)
 
     async def _try_acquire_or_renew(self) -> bool:

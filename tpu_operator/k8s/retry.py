@@ -1,0 +1,266 @@
+"""API-request resilience: retry policy, retry budget, circuit breaker, fence.
+
+Reference analogue: controller-runtime inherits client-go's rate limiters and
+retry.OnError/RetryOnConflict helpers, and its manager stops serving when the
+apiserver stays unreachable.  Our hand-rolled :class:`ApiClient` gets the same
+discipline here, in one place, so every caller (reconcilers, informer relists,
+leader election, event recording) shares the behaviour:
+
+- :class:`RetryPolicy` — exponential backoff with FULL jitter (AWS
+  architecture-blog style: ``sleep = rand(0, min(cap, base * 2**attempt))``),
+  ``Retry-After`` honoring on 429/503, a per-attempt timeout so a hung
+  connection cannot stall a reconcile pass, a total per-request deadline, and
+  a verb classification that never blindly replays non-idempotent POSTs.
+- :class:`RetryBudget` — a token bucket (client-go/finagle style) bounding the
+  FRACTION of traffic that may be retries, so a degraded apiserver sees load
+  shed instead of a retry storm multiplying it.
+- :class:`CircuitBreaker` — consecutive infrastructure failures (5xx,
+  timeouts, connection resets) trip it OPEN; requests then fail fast with
+  :class:`BreakerOpenError` until the reset window elapses, after which
+  HALF_OPEN admits one probe at a time; a probe success closes it.  The
+  manager surfaces the state as degraded mode (``controllers/runtime.py``).
+- :class:`WriteFence` — refuses mutating verbs the instant leadership is
+  lost (lease renewal and Event posting stay exempt), closing the window
+  between the elector clearing ``is_leader`` and in-flight reconciles being
+  cancelled.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpu_operator import consts
+
+# HTTP verbs that mutate; everything else is read-only.
+MUTATING_VERBS = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+# Verbs safe to replay after an ambiguous failure: reads trivially; PUT and
+# DELETE by named-object idempotence (a PUT replay hits a resourceVersion
+# conflict at worst, a DELETE replay a 404 — both handled by callers); PATCH
+# because the operator only issues merge patches (RFC 7386 is idempotent).
+# POST is absent on purpose: a create that timed out may have COMMITTED, and
+# replaying it mints a duplicate object (or a duplicate Event) — the apply
+# layer recovers via its get/adopt path instead.
+IDEMPOTENT_VERBS = frozenset({"GET", "PUT", "PATCH", "DELETE"})
+
+# CircuitBreaker states (exported for the tpu_operator_api_breaker_state gauge:
+# 0 is healthy so the alert rule is a simple `> 0`).
+CLOSED = 0
+HALF_OPEN = 1
+OPEN = 2
+
+STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half-open", OPEN: "open"}
+
+
+class RetryBudget:
+    """Token bucket bounding the retry fraction of total traffic.
+
+    Each regular request earns ``ratio`` tokens (capped); each retry spends
+    one.  With ratio 0.2 at most ~20% of sustained traffic can be retries —
+    a hard-down apiserver gets probed, not hammered.
+    """
+
+    def __init__(self, ratio: float = 0.2, cap: float = 10.0):
+        self.ratio = ratio
+        self.cap = cap
+        self.tokens = cap
+
+    def record_request(self) -> None:
+        self.tokens = min(self.cap, self.tokens + self.ratio)
+
+    def allow_retry(self) -> bool:
+        if self.tokens < 1.0:
+            return False
+        self.tokens -= 1.0
+        return True
+
+
+@dataclass
+class RetryPolicy:
+    """Per-request retry/timeout behaviour for ``ApiClient._request``.
+
+    ``rng`` is injectable so chaos tests replay byte-identical schedules;
+    the default is module-level randomness, which is exactly what production
+    wants (fleet-wide jitter decorrelation).
+    """
+
+    max_attempts: int = consts.K8S_RETRY_MAX_ATTEMPTS
+    backoff_base: float = consts.K8S_RETRY_BACKOFF_BASE_SECONDS
+    backoff_cap: float = consts.K8S_RETRY_BACKOFF_CAP_SECONDS
+    # per-attempt timeout: a hung connection surfaces as TimeoutError here
+    # instead of stalling the reconcile pass until aiohttp's 5-minute default
+    per_try_timeout: Optional[float] = consts.K8S_REQUEST_PER_TRY_TIMEOUT_SECONDS
+    # wall-clock deadline across ALL attempts of one logical request
+    total_timeout: Optional[float] = consts.K8S_REQUEST_TOTAL_TIMEOUT_SECONDS
+    budget: Optional[RetryBudget] = None
+    rng: random.Random = field(default_factory=random.Random)
+
+    def retryable_verb(self, method: str, status: Optional[int]) -> bool:
+        """May this (verb, outcome) be replayed?  429 is retryable for every
+        verb — the server explicitly did not process the request; anything
+        ambiguous (5xx, timeout, reset: ``status None``) only for verbs whose
+        replay cannot duplicate a side effect."""
+        if status == 429:
+            return True
+        return method.upper() in IDEMPOTENT_VERBS
+
+    def backoff(self, attempt: int, retry_after: Optional[float] = None) -> float:
+        """Sleep before retry ``attempt`` (1-based): full jitter over the
+        exponential envelope, floored by any server-provided Retry-After."""
+        envelope = min(self.backoff_cap, self.backoff_base * (2 ** (attempt - 1)))
+        delay = self.rng.uniform(0.0, envelope)
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        return delay
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over apiserver infrastructure health.
+
+    Logical outcomes (404, 409, 422 …) are SUCCESSES here — the server
+    answered.  Only 5xx, timeouts, and connection failures count against the
+    threshold; 429 is deliberately neutral (a throttling server is alive).
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = consts.K8S_BREAKER_FAILURE_THRESHOLD,
+        reset_seconds: float = consts.K8S_BREAKER_RESET_SECONDS,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_seconds = reset_seconds
+        self._clock = clock
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_started_at = 0.0
+        # lifetime transition tally for tests/diagnostics
+        self.opened_total = 0
+
+    @property
+    def state_name(self) -> str:
+        return STATE_NAMES[self.state]
+
+    def allow(self) -> bool:
+        """May a request be issued right now?  OPEN fails fast until the
+        reset window elapses, then HALF_OPEN admits exactly one probe at a
+        time (concurrent requests keep failing fast until it reports)."""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self._clock() - self._opened_at < self.reset_seconds:
+                return False
+            self.state = HALF_OPEN
+            self._probe_inflight = False
+        # HALF_OPEN: single probe.  A probe that never reported (its task
+        # was cancelled mid-request, or it hung past any sane timeout) must
+        # not hold the slot forever — reclaim after the reset window so the
+        # breaker can never wedge permanently half-open.
+        if (
+            self._probe_inflight
+            and self._clock() - self._probe_started_at >= self.reset_seconds
+        ):
+            self._probe_inflight = False
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        self._probe_started_at = self._clock()
+        return True
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._probe_inflight = False
+        self.state = CLOSED
+
+    def record_neutral(self) -> None:
+        """Server answered but proved neither health nor failure (429: it
+        is alive yet shedding load).  Releases a probe slot without closing
+        the breaker or touching the failure streak — interleaved
+        500,429,500 traffic must still accumulate toward the threshold."""
+        self._probe_inflight = False
+
+    def release_probe(self) -> None:
+        """The in-flight request died without a verdict (task cancelled):
+        free the half-open slot immediately so the next request can probe."""
+        self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        self._probe_inflight = False
+        if self.state == HALF_OPEN:
+            # failed probe: straight back to OPEN for a fresh window
+            self._trip()
+            return
+        self.consecutive_failures += 1
+        if self.state == CLOSED and self.consecutive_failures >= self.failure_threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self.state = OPEN
+        self._opened_at = self._clock()
+        self.opened_total += 1
+        self.consecutive_failures = 0
+
+
+class FencedError(Exception):
+    """A mutating request was refused because this replica is not leader.
+
+    Raised client-side before anything reaches the wire; reconcile code
+    treats it like any other request failure (workqueue backoff), but by the
+    time it can fire the manager is already cancelling those workers."""
+
+    def __init__(self, method: str, path: str):
+        self.method = method
+        self.path = path
+        super().__init__(f"write fenced (not leader): {method} {path}")
+
+
+class WriteFence:
+    """Gate evaluated by ``ApiClient._request`` before every send.
+
+    ``allow`` is consulted live (not cached at install time) so the fence
+    engages the same instant ``LeaderElector.is_leader`` clears.  Lease
+    traffic must stay exempt (the elector needs it to re-acquire) and so do
+    Events (client-go replicas report leader-election transitions whether or
+    not they lead).
+    """
+
+    def __init__(self, allow: Callable[[], bool]):
+        self.allow = allow
+        self.refused_total = 0
+
+    @staticmethod
+    def _exempt(path: str) -> bool:
+        """True for Lease and Event traffic, matched on the RESOURCE
+        COLLECTION segment of the URL — a substring test would also exempt
+        any object merely *named* 'events' (e.g. a ConfigMap), reopening
+        the split-brain window the fence closes."""
+        segs = [s for s in path.split("?", 1)[0].split("/") if s]
+        # /api/v1/[namespaces/<ns>/]<plural>[/name...]
+        # /apis/<group>/<version>/[namespaces/<ns>/]<plural>[/name...]
+        if not segs:
+            return False
+        if segs[0] == "api":
+            rest, group = segs[2:], ""
+        elif segs[0] == "apis" and len(segs) >= 3:
+            rest, group = segs[3:], segs[1]
+        else:
+            return False
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            rest = rest[2:]
+        plural = rest[0] if rest else ""
+        if plural == "leases" and group == "coordination.k8s.io":
+            return True
+        return plural == "events" and group in ("", "events.k8s.io")
+
+    def check(self, method: str, path: str) -> None:
+        if method.upper() not in MUTATING_VERBS:
+            return
+        if self._exempt(path):
+            return
+        if not self.allow():
+            self.refused_total += 1
+            raise FencedError(method, path)

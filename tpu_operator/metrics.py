@@ -141,3 +141,19 @@ class OperatorMetrics:
             registry=self.registry,
             buckets=REQUEST_COUNT_BUCKETS,
         )
+        # API resilience surface (k8s/retry.py; docs/ROBUSTNESS.md)
+        self.api_breaker_state = g(
+            "tpu_operator_api_breaker_state",
+            "Apiserver circuit breaker: 0=closed, 1=half-open, 2=open "
+            "(open == manager in degraded mode; alert on > 0)",
+        )
+        self.k8s_request_retries_total = Counter(
+            "tpu_operator_k8s_request_retries_total",
+            "API request retries issued by the client retry policy, by verb",
+            ["verb"],
+            registry=self.registry,
+        )
+        self.degraded_mode_total = c(
+            "tpu_operator_degraded_mode_entered_total",
+            "Times the manager entered degraded mode (breaker opened)",
+        )

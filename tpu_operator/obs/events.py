@@ -40,6 +40,27 @@ REASON_REMEDIATION_FAILED = "RemediationFailed"
 REASON_VALIDATION_FAILED = "ValidationFailed"
 REASON_SELECTOR_CONFLICT = "SelectorConflict"
 REASON_PERF_REGRESSED = "WorkloadPerfRegressed"
+# resilience surface (docs/ROBUSTNESS.md): degraded mode + leadership
+REASON_DEGRADED = "DegradedMode"
+REASON_DEGRADED_RECOVERED = "DegradedModeRecovered"
+REASON_LEADER_ELECTED = "LeaderElected"
+REASON_LEADERSHIP_LOST = "LeadershipLost"
+
+
+def namespace_ref(name: str) -> dict:
+    """involvedObject for manager-scoped events (degraded mode has no
+    narrower object to hang evidence on than the operator namespace)."""
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}}
+
+
+def lease_ref(namespace: str, name: str) -> dict:
+    """involvedObject for leadership-transition events (client-go's leader
+    elector reports on the lock object itself)."""
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": namespace},
+    }
 
 
 def node_ref(name: str) -> dict:

@@ -7,6 +7,7 @@ operator under test runs its actual network/client/informer stack against an
 N-node simulated cluster, including kubelet-style DaemonSet scheduling.
 """
 
+from tpu_operator.testing.chaos import ChaosConfig, ChaosEngine
 from tpu_operator.testing.fakecluster import FakeCluster, SimConfig
 
-__all__ = ["FakeCluster", "SimConfig"]
+__all__ = ["ChaosConfig", "ChaosEngine", "FakeCluster", "SimConfig"]

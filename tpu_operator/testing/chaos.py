@@ -1,0 +1,182 @@
+"""Seeded fault injection for the fake apiserver (the chaos layer).
+
+The reference operator is tested against a healthy fake client; real clusters
+are not healthy.  ``ChaosConfig`` describes a reproducible fault schedule —
+everything is drawn from one ``random.Random(seed)`` so a failing run replays
+byte-identically — and ``ChaosEngine`` applies it at the fake apiserver's
+choke points:
+
+- per-request transient failures (429 with ``Retry-After``, 500, 503, raw
+  connection aborts), weighted per verb and per resource when configured
+- post-commit failures: the mutation IS applied server-side but the client
+  sees a 5xx — the case that punishes blind POST replay with duplicate
+  objects (the retry policy's non-idempotent classification plus the apply
+  layer's adopt path must absorb it)
+- latency spikes and hard hangs (flushing out missing request timeouts)
+- watch-stream faults: 410 Gone on connect and mid-stream drops (flushing
+  out informer relist/backoff taxonomy)
+- background actor faults driven by the sim loop: validator-style pod
+  crash-loops and node Ready-condition flaps
+- ``FakeCluster.steal_lease`` (one-shot, not rate-driven) for leadership
+  transitions
+
+``stop()`` freezes all injection so a soak can assert the system returns to
+its zero-write fixed point once chaos ends.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+# sentinel fault kinds returned by ChaosEngine.request_fault
+FAULT_429 = "429"
+FAULT_500 = "500"
+FAULT_503 = "503"
+FAULT_RESET = "reset"
+FAULT_HANG = "hang"
+
+
+@dataclass
+class ChaosConfig:
+    seed: int = 0
+    # chance any request draws a transient failure; per-verb / per-resource
+    # overrides win over the default (verb first, then resource plural)
+    error_rate: float = 0.0
+    verb_error_rates: dict = field(default_factory=dict)    # {"POST": 0.2}
+    kind_error_rates: dict = field(default_factory=dict)    # {"pods": 0.1} (plural)
+    # relative weights of the injected failure flavours
+    error_weights: dict = field(default_factory=lambda: {
+        FAULT_429: 1.0, FAULT_500: 1.0, FAULT_503: 1.0, FAULT_RESET: 1.0,
+    })
+    retry_after_s: float = 0.05      # Retry-After carried by injected 429s
+    # mutation applied server-side, then the response is swapped for a 500 —
+    # the ambiguous-failure case that makes POST replay mint duplicates
+    post_commit_error_rate: float = 0.0
+    # latency: every request may draw an extra uniform(lo, hi) sleep
+    latency_spike_rate: float = 0.0
+    latency_spike_s: tuple = (0.02, 0.2)
+    # hard hang: request parks until the client's per-try timeout fires
+    hang_rate: float = 0.0
+    hang_s: float = 30.0
+    # watch faults
+    watch_gone_rate: float = 0.0     # watch GET answered 410 Gone
+    watch_drop_rate: float = 0.0     # chance a watch stream is given a drop deadline
+    watch_drop_after_s: tuple = (0.1, 1.5)
+    # background actors (driven from the sim loop at sim.tick cadence)
+    pod_crashloop_selector: str = "" # label selector, e.g. app=tpu-operator-validator
+    pod_crashloop_rate: float = 0.0  # per matching Running pod per tick
+    pod_restart_after_s: float = 0.0 # 0 = stay Failed (deterministic tests)
+    node_flap_interval: float = 0.0  # seconds between NotReady flaps (0 = off)
+    node_flap_down_s: float = 0.5
+
+
+class ChaosEngine:
+    """Stateful, seeded interpreter of a :class:`ChaosConfig`.
+
+    All randomness flows through ``self.rng`` — never the module-level
+    ``random`` — so two engines with the same seed and the same call
+    sequence inject the same schedule.  ``injected`` tallies every fault for
+    the soak report.
+    """
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self.rng = random.Random(config.seed)
+        self.active = True
+        # set to override every error-rate knob at once (blackout phases)
+        self.force_error_rate: Optional[float] = None
+        self.injected: dict[str, int] = {}
+
+    def stop(self) -> None:
+        """Freeze all injection (steady-state measurement phase)."""
+        self.active = False
+
+    def resume(self) -> None:
+        self.active = True
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    # ------------------------------------------------------------------
+    def _rate_for(self, method: str, plural: str) -> float:
+        if self.force_error_rate is not None:
+            return self.force_error_rate
+        cfg = self.config
+        if method in cfg.verb_error_rates:
+            return cfg.verb_error_rates[method]
+        if plural in cfg.kind_error_rates:
+            return cfg.kind_error_rates[plural]
+        return cfg.error_rate
+
+    def latency_spike(self) -> float:
+        """Extra seconds to sleep before handling, 0 for none."""
+        if not self.active:
+            return 0.0
+        cfg = self.config
+        if cfg.latency_spike_rate and self.rng.random() < cfg.latency_spike_rate:
+            self._count("latency_spike")
+            return self.rng.uniform(*cfg.latency_spike_s)
+        return 0.0
+
+    def request_fault(self, method: str, plural: str) -> Optional[str]:
+        """Pre-dispatch fault for this request, or None.  Draws latency/hang
+        first so the two knobs compose; the transient flavour is weighted."""
+        if not self.active:
+            return None
+        cfg = self.config
+        if cfg.hang_rate and self.rng.random() < cfg.hang_rate:
+            self._count(FAULT_HANG)
+            return FAULT_HANG
+        rate = self._rate_for(method, plural)
+        if rate and self.rng.random() < rate:
+            kinds = [k for k, w in cfg.error_weights.items() if w > 0]
+            weights = [cfg.error_weights[k] for k in kinds]
+            kind = self.rng.choices(kinds, weights=weights)[0]
+            self._count(kind)
+            return kind
+        return None
+
+    def post_commit_fault(self, method: str) -> bool:
+        """Swap a SUCCESSFUL mutation's response for a 500 (the write stuck)."""
+        if not self.active or method not in ("POST", "PUT", "PATCH", "DELETE"):
+            return False
+        if (
+            self.config.post_commit_error_rate
+            and self.rng.random() < self.config.post_commit_error_rate
+        ):
+            self._count("post_commit_500")
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    def watch_gone(self) -> bool:
+        if not self.active:
+            return False
+        if self.config.watch_gone_rate and self.rng.random() < self.config.watch_gone_rate:
+            self._count("watch_410")
+            return True
+        return False
+
+    def watch_drop_after(self) -> Optional[float]:
+        """Seconds after which this watch stream is dropped, or None."""
+        if not self.active:
+            return None
+        cfg = self.config
+        if cfg.watch_drop_rate and self.rng.random() < cfg.watch_drop_rate:
+            self._count("watch_drop")
+            return self.rng.uniform(*cfg.watch_drop_after_s)
+        return None
+
+    # ------------------------------------------------------------------
+    def should_crash_pod(self) -> bool:
+        if not self.active or not self.config.pod_crashloop_rate:
+            return False
+        if self.rng.random() < self.config.pod_crashloop_rate:
+            self._count("pod_crash")
+            return True
+        return False
+
+    def report(self) -> dict:
+        return dict(sorted(self.injected.items()))

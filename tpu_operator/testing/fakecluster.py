@@ -25,6 +25,15 @@ from aiohttp import web
 from tpu_operator import consts
 from tpu_operator.k8s import objects as obj_api
 from tpu_operator.k8s import selectors
+from tpu_operator.testing.chaos import (
+    FAULT_429,
+    FAULT_500,
+    FAULT_503,
+    FAULT_HANG,
+    FAULT_RESET,
+    ChaosConfig,
+    ChaosEngine,
+)
 from tpu_operator.utils import deep_get, fnv1a_64
 
 log = logging.getLogger("tpu_operator.fakecluster")
@@ -113,6 +122,10 @@ class Store:
         obj.setdefault("apiVersion", self.info.gvk.api_version)
         obj.setdefault("kind", self.info.gvk.kind)
         self.objects[k] = obj
+        # duplicate-side-effect ledger: the chaos soak asserts no (kind,
+        # ns, name) is ever successfully created twice under fault storms
+        ck = (self.info.plural, meta.get("namespace", "") or "", name)
+        self.cluster.created_counts[ck] = self.cluster.created_counts.get(ck, 0) + 1
         self._notify("ADDED", obj)
         return obj
 
@@ -293,14 +306,17 @@ def _match_fields(field_selector: str, obj: dict) -> bool:
 class FakeCluster:
     """Runs the fake apiserver on 127.0.0.1:<port> plus simulators."""
 
-    def __init__(self, sim: Optional[SimConfig] = None):
+    def __init__(self, sim: Optional[SimConfig] = None, chaos: Optional[ChaosConfig] = None):
         self.sim = sim or SimConfig()
+        # fault-injection layer (testing/chaos.py): None = perfectly healthy
+        self.chaos: Optional[ChaosEngine] = ChaosEngine(chaos) if chaos else None
         self._rv = 0
         self.stores: dict[tuple[str, str], Store] = {}
         for (group, _kind), info in obj_api._REGISTRY.items():
             self.stores[(group, info.plural)] = self.stores.get((group, info.plural)) or Store(self, info)
         self._runner: Optional[web.AppRunner] = None
         self._sim_task: Optional[asyncio.Task] = None
+        self._chaos_task: Optional[asyncio.Task] = None
         self.port: Optional[int] = None
         self._pod_timers: dict[tuple[str, str], float] = {}
         # workload pods whose executor is currently running (concurrent:
@@ -311,12 +327,29 @@ class FakeCluster:
         # the control-plane scale tests prove reconcile passes stay
         # O(states + nodes) in requests, not O(states x nodes^2)
         self.request_counts: dict[tuple[str, str], int] = {}
+        # successful creations per (plural, ns, name) — duplicate detector
+        self.created_counts: dict[tuple[str, str, str], int] = {}
+        # chaos background-actor state
+        self._flapped_node: Optional[tuple[str, float]] = None
+        self._last_flap_at = 0.0
+        self._crash_restarts: dict[tuple[str, str], float] = {}
 
     def reset_request_counts(self) -> None:
         self.request_counts = {}
 
     def total_requests(self) -> int:
         return sum(self.request_counts.values())
+
+    def duplicate_creations(
+        self, exclude_plurals: tuple = ("pods", "events", "leases")
+    ) -> dict[tuple[str, str, str], int]:
+        """Objects successfully created more than once.  Pods (sim/crash-loop
+        churn), Events (uuid-suffixed), and Leases are excluded — the signal
+        is operand/config objects minted twice by a replayed create."""
+        return {
+            k: n for k, n in self.created_counts.items()
+            if n > 1 and k[0] not in exclude_plurals
+        }
 
     # ------------------------------------------------------------------
     def next_rv(self) -> int:
@@ -422,6 +455,8 @@ class FakeCluster:
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
         if self.sim.enabled:
             self._sim_task = asyncio.create_task(self._simulate())
+        if self.chaos is not None:
+            self._chaos_task = asyncio.create_task(self._chaos_actors())
         # default namespaces
         for ns in ("default", "kube-system", "tpu-operator"):
             try:
@@ -432,12 +467,15 @@ class FakeCluster:
                 pass
 
     async def stop(self) -> None:
-        if self._sim_task:
-            self._sim_task.cancel()
-            try:
-                await self._sim_task
-            except (asyncio.CancelledError, Exception):
-                pass
+        for task in (self._sim_task, self._chaos_task):
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                except Exception:  # noqa: BLE001
+                    log.debug("fake-cluster task errored during stop", exc_info=True)
         if self._runner:
             await self._runner.cleanup()
 
@@ -477,18 +515,32 @@ class FakeCluster:
             elif parts and parts[0] == "namespaces" and len(parts) == 2 and group == "":
                 # operations on the Namespace object itself
                 self._count_request(request.method, group, "namespaces")
-                return await self._handle_object(request, self.store("", "namespaces"), None, parts[1], None)
+                fault = await self._chaos_before(request, "namespaces")
+                if fault is not None:
+                    return fault
+                return self._chaos_after(
+                    request,
+                    await self._handle_object(request, self.store("", "namespaces"), None, parts[1], None),
+                )
             if not parts:
                 raise ApiException(404, "NotFound", "no resource")
             plural = parts[0]
             self._count_request(request.method, group, plural)
+            fault = await self._chaos_before(request, plural)
+            if fault is not None:
+                return fault
             name = parts[1] if len(parts) > 1 else None
             if len(parts) > 2:
                 subresource = parts[2]
             store = self.store(group, plural)
             if name is None:
-                return await self._handle_collection(request, store, namespace)
-            return await self._handle_object(request, store, namespace, name, subresource)
+                return self._chaos_after(
+                    request, await self._handle_collection(request, store, namespace)
+                )
+            return self._chaos_after(
+                request,
+                await self._handle_object(request, store, namespace, name, subresource),
+            )
         except ApiException as e:
             return e.response()
         except json.JSONDecodeError as e:
@@ -496,6 +548,47 @@ class FakeCluster:
         except Exception as e:  # noqa: BLE001
             log.exception("fake apiserver internal error")
             return ApiException(500, "InternalError", str(e)).response()
+
+    # ------------------------------------------------------------------
+    # Chaos choke points (testing/chaos.py).
+
+    async def _chaos_before(self, request: web.Request, plural: str) -> Optional[web.StreamResponse]:
+        """Pre-dispatch injection: latency spikes, hangs, connection aborts,
+        and transient 429/500/503 — the request never reaches a store."""
+        if self.chaos is None:
+            return None
+        spike = self.chaos.latency_spike()
+        if spike:
+            await asyncio.sleep(spike)
+        fault = self.chaos.request_fault(request.method, plural)
+        if fault is None:
+            return None
+        if fault == FAULT_HANG:
+            # park until well past any sane client timeout; the client's
+            # per-try deadline is what ends this request from its side
+            await asyncio.sleep(self.chaos.config.hang_s)
+            return ApiException(504, "Timeout", "chaos hang").response()
+        if fault == FAULT_RESET:
+            if request.transport is not None:
+                request.transport.abort()
+            return web.Response(status=500, text="chaos reset")
+        if fault == FAULT_429:
+            resp = ApiException(429, "TooManyRequests", "chaos throttle").response()
+            resp.headers["Retry-After"] = str(self.chaos.config.retry_after_s)
+            return resp
+        if fault == FAULT_500:
+            return ApiException(500, "InternalError", "chaos 500").response()
+        return ApiException(503, "ServiceUnavailable", "chaos 503").response()
+
+    def _chaos_after(self, request: web.Request, resp: web.StreamResponse) -> web.StreamResponse:
+        """Post-commit injection: the mutation WAS applied (store updated,
+        watch event emitted) but the client is answered 500 — the ambiguous
+        failure whose blind replay mints duplicate objects."""
+        if self.chaos is None or not self.chaos.post_commit_fault(request.method):
+            return resp
+        return ApiException(
+            500, "InternalError", "chaos post-commit failure (mutation applied)"
+        ).response()
 
     async def _handle_collection(
         self, request: web.Request, store: Store, namespace: Optional[str]
@@ -559,6 +652,18 @@ class FakeCluster:
         q = request.rel_url.query
         selector = q.get("labelSelector", "")
         rv0 = int(q.get("resourceVersion") or 0)
+        # real-apiserver watch-window semantics: when the replay ring has
+        # wrapped (events evicted) a client resuming from before the oldest
+        # retained event CANNOT be caught up — 410 Gone, client must relist.
+        # Chaos can also force the expiry to exercise the same client path.
+        ring_full = len(store.events) == (store.events.maxlen or 0)
+        expired = ring_full and store.events and rv0 and rv0 < store.events[0][0]
+        if expired or (self.chaos is not None and self.chaos.watch_gone()):
+            return ApiException(
+                410, "Expired", f"resourceVersion {rv0} is too old"
+            ).response()
+        drop_after = self.chaos.watch_drop_after() if self.chaos is not None else None
+        drop_deadline = time.monotonic() + drop_after if drop_after is not None else None
         resp = web.StreamResponse(
             status=200, headers={"Content-Type": "application/json", "Transfer-Encoding": "chunked"}
         )
@@ -578,6 +683,8 @@ class FakeCluster:
         store.watchers.append((queue, namespace, parsed_sel))
         try:
             while True:
+                if drop_deadline is not None and time.monotonic() >= drop_deadline:
+                    break  # chaos: stream dies mid-watch, client must resume
                 try:
                     evt = await asyncio.wait_for(queue.get(), timeout=0.2)
                 except asyncio.TimeoutError:
@@ -590,6 +697,112 @@ class FakeCluster:
         finally:
             store.watchers.remove((queue, namespace, parsed_sel))
         return resp
+
+    # ------------------------------------------------------------------
+    # Chaos background actors: crash-looping pods, NotReady node flaps.
+
+    async def _chaos_actors(self) -> None:
+        while True:
+            try:
+                now = time.monotonic()
+                self._chaos_crashloops(now)
+                self._chaos_node_flap(now)
+            except Exception:  # noqa: BLE001
+                log.exception("chaos actor error")
+            await asyncio.sleep(self.sim.tick)
+
+    def _chaos_crashloops(self, now: float) -> None:
+        """Pods matching ``pod_crashloop_selector`` flap Running → Failed
+        (restartCount bumped); with ``pod_restart_after_s`` they return to
+        Pending so the kubelet sim re-runs them — a true crash-loop."""
+        cfg = self.chaos.config
+        if not cfg.pod_crashloop_selector:
+            return
+        reqs = selectors.parse(cfg.pod_crashloop_selector)
+        pod_store = self.store("", "pods")
+        for pod in list(pod_store.objects.values()):
+            labels = pod["metadata"].get("labels") or {}
+            if not all(r.matches(labels) for r in reqs):
+                continue
+            ns = pod["metadata"].get("namespace")
+            name = pod["metadata"]["name"]
+            phase = deep_get(pod, "status", "phase")
+            restarts = deep_get(pod, "status", "containerStatuses", 0, "restartCount", default=0)
+            if phase == "Running" and self.chaos.should_crash_pod():
+                self._set_pod_phase(pod_store, ns, name, "Failed", restart_count=restarts + 1)
+                if cfg.pod_restart_after_s:
+                    self._crash_restarts[(ns, name)] = now + cfg.pod_restart_after_s
+            elif phase == "Failed" and self._crash_restarts.get((ns, name), float("inf")) <= now:
+                del self._crash_restarts[(ns, name)]
+                self._set_pod_phase(pod_store, ns, name, "Pending", restart_count=restarts)
+                self._pod_timers[(ns, name)] = now  # kubelet sim restarts it
+
+    def _chaos_node_flap(self, now: float) -> None:
+        """Every ``node_flap_interval`` seconds one random node goes
+        NotReady for ``node_flap_down_s`` then recovers — the condition
+        churn that drives predicate/watch storms in the operator."""
+        cfg = self.chaos.config
+        if not cfg.node_flap_interval:
+            return
+        node_store = self.store("", "nodes")
+        if self._flapped_node is not None:
+            name, restore_at = self._flapped_node
+            if now >= restore_at:
+                self._set_node_ready(node_store, name, True)
+                self._flapped_node = None
+            return
+        if not self.chaos.active or now - self._last_flap_at < cfg.node_flap_interval:
+            return
+        names = sorted(n for (_, n) in node_store.objects)
+        if not names:
+            return
+        name = self.chaos.rng.choice(names)
+        self._set_node_ready(node_store, name, False)
+        self.chaos._count("node_flap")
+        self._flapped_node = (name, now + cfg.node_flap_down_s)
+        self._last_flap_at = now
+
+    def _set_node_ready(self, node_store: Store, name: str, ready: bool) -> None:
+        try:
+            node = node_store.get(None, name)
+        except ApiException:
+            return
+        patched = copy.deepcopy(node)
+        conds = patched.setdefault("status", {}).setdefault("conditions", [])
+        for c in conds:
+            if c.get("type") == "Ready":
+                c["status"] = "True" if ready else "False"
+                break
+        else:
+            conds.append({"type": "Ready", "status": "True" if ready else "False"})
+        try:
+            node_store.update(patched, None, name, status_only=True)
+        except ApiException:
+            pass
+
+    def steal_lease(
+        self,
+        namespace: str,
+        name: str = consts.LEADER_ELECTION_ID,
+        holder: str = "chaos-rival",
+    ) -> dict:
+        """Overwrite the leader lease with a rival holder and a fresh
+        renewTime: the current leader's next renew sees an unexpired foreign
+        lease and must step down (then re-acquire once it expires, since the
+        rival never renews)."""
+        store = self.store("coordination.k8s.io", "leases")
+        lease = copy.deepcopy(store.get(namespace, name))
+        lease["spec"]["holderIdentity"] = holder
+        # microsecond renewTime: the second-truncated _now() would age the
+        # stolen lease by up to 1s, letting the victim re-acquire early
+        now = time.time()
+        lease["spec"]["renewTime"] = (
+            time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now))
+            + ".%06dZ" % int((now % 1) * 1e6)
+        )
+        if self.chaos is not None:
+            self.chaos._count("lease_steal")
+        return store.update(lease, namespace, name)
 
     # ------------------------------------------------------------------
     # Kubelet / controller simulators.
@@ -770,7 +983,9 @@ class FakeCluster:
             self._executing.discard((ns, name))
         self._set_pod_phase(pod_store, ns, name, final)
 
-    def _set_pod_phase(self, pod_store: Store, ns: str, name: str, phase: str) -> None:
+    def _set_pod_phase(
+        self, pod_store: Store, ns: str, name: str, phase: str, restart_count: int = 0
+    ) -> None:
         try:
             pod = pod_store.get(ns, name)
         except ApiException:
@@ -781,7 +996,11 @@ class FakeCluster:
             "phase": phase,
             "conditions": [{"type": "Ready", "status": "True" if phase == "Running" else "False"}],
             "containerStatuses": [
-                {"name": c.get("name", "main"), "ready": phase == "Running", "restartCount": 0}
+                {
+                    "name": c.get("name", "main"),
+                    "ready": phase == "Running",
+                    "restartCount": restart_count,
+                }
                 for c in containers
             ],
         }
