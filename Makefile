@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos lint counters-docs async-lint except-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health lint counters-docs async-lint except-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
-# the tier-1 pytest line CI runs + the seeded chaos acceptance soak
-test: lint counters-docs async-lint except-lint unit-test chaos
+# the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
+test: lint counters-docs async-lint except-lint unit-test chaos chaos-health
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -80,6 +80,15 @@ CHAOS_SEED ?= 1
 CHAOS_ERROR_RATE ?= 0.05
 chaos:
 	$(PYTHON) bench.py --chaos --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED) --error-rate $(CHAOS_ERROR_RATE)
+
+# node-health-engine acceptance soak (chip-free; ~1-2 min): injected agent
+# verdicts + NotReady flaps + validator crash-loops on a 100-node fake
+# cluster must produce detection -> bounded automatic remediation ->
+# recovery, never actuating past the disruption budget, never oscillating
+# a cordon, and flipping to observe-only (with Event) when a fleet-wide
+# signal source lies (docs/ROBUSTNESS.md "Node health engine")
+chaos-health:
+	$(PYTHON) bench.py --chaos-health --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # single image for operator + operands (docker/Dockerfile)
 image:

@@ -423,6 +423,271 @@ def run_chaos_soak(n_nodes: int = 100, seed: int = 1, error_rate: float = 0.05) 
     return result
 
 
+HEALTH_SOAK_TIMEOUT = 300.0
+
+
+async def _chaos_health_soak(n_nodes: int, seed: int) -> dict:
+    """The node-health-engine acceptance soak (`make chaos-health`;
+    docs/ROBUSTNESS.md "Node health engine").
+
+    A 100-node fake cluster under the health-relevant fault actors —
+    seeded agent verdicts flipping unhealthy (chip-scrape failures),
+    NotReady node flaps, validator-pod crash-loops — while the REAL
+    manager runs the full pipeline plus the remediation and health
+    controllers.  Asserts the closed loop end to end: signals are
+    detected (hysteresis trips), tripped nodes are remediated
+    automatically, concurrent actuations NEVER exceed the disruption
+    budget, no node's cordon oscillates under flapping signals, a
+    fleet-wide bad signal source flips the engine to observe-only with a
+    HealthBudgetExhausted Event, and once chaos stops every node
+    converges back to Ready with all engine state released.
+    """
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.health import HealthReconciler
+    from tpu_operator.controllers.remediation import RemediationReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get
+
+    chaos = ChaosConfig(
+        seed=seed,
+        # signal-plane faults only: this soak proves the health loop, the
+        # API-resilience storm has its own soak (`make chaos`)
+        # episodes must outlive several window/threshold (2 s) re-assert
+        # cadences even on a loaded testbed, or phase A detects nothing
+        agent_unhealthy_interval=2.0, agent_unhealthy_down_s=8.0,
+        node_flap_interval=2.0, node_flap_down_s=0.3,
+        pod_crashloop_selector="app=tpu-operator-validator",
+        pod_crashloop_rate=0.0005, pod_restart_after_s=0.5,
+    )
+    # hysteresis tuned to soak time-scale: a sustained unhealthy verdict
+    # (5 s) re-observes every window/threshold = 2 s → trips in ~4 s;
+    # clean_seconds=3 releases a few seconds after the signal clears
+    health_spec = {
+        "failureThreshold": 3, "windowSeconds": 6, "cleanSeconds": 3,
+        "escalationBackoffSeconds": 2, "maxUnhealthyPercent": "10%",
+        "flapMaxTrips": 4, "flapWindowSeconds": 60,
+    }
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05)
+    budget = max(0, int(n_nodes * 10 / 100))
+    result: dict = {"nodes": n_nodes, "seed": seed, "budget": budget}
+    async with FakeCluster(sim, chaos=chaos) as fc:
+        fc.chaos.stop()  # quiet until the pipeline has converged
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(
+            client, NS, metrics_port=-1, health_port=-1,
+            recorder=recorder, operator_metrics=metrics,
+        )
+        obs = dict(metrics=metrics, recorder=recorder)
+        ClusterPolicyReconciler(client, NS, **obs).setup(mgr)
+        RemediationReconciler(client, NS, **obs).setup(mgr)
+        health = HealthReconciler(client, NS, **obs)
+        health_ctrl = health.setup(mgr)
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "health": health_spec,
+                    "remediation": {"maxParallel": 4,
+                                    "validationTimeoutSeconds": 30},
+                }).obj)
+                for i in range(n_nodes):
+                    s, h = divmod(i, 4)
+                    fc.add_node(
+                        f"tpu-{s}-{h}", topology="4x4",
+                        labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        },
+                    )
+
+                async def _nodes() -> list:
+                    return [
+                        n for n in await client.list_items("", "Node")
+                    ]
+
+                async def _converged() -> bool:
+                    cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await _nodes()
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > HEALTH_SOAK_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-chaos")
+                    await asyncio.sleep(0.2)
+                result["pre_chaos_converge_s"] = round(time.perf_counter() - t0, 3)
+
+                # -- phase A: chaos on — detection + bounded remediation --
+                fc.chaos.resume()
+                max_escalated = 0
+                cordon_flips: dict[str, int] = {}
+                last_cordon: dict[str, bool] = {}
+                # generous windows: the engine reads through the informer
+                # cache, which drains a multi-second event backlog after
+                # heavy churn — detection latency includes watch lag, as on
+                # any informer-backed controller
+                t1 = time.perf_counter()
+                while time.perf_counter() - t1 < 35.0:
+                    escalated = 0
+                    for n in await _nodes():
+                        name = n["metadata"]["name"]
+                        anns = deep_get(n, "metadata", "annotations", default={}) or {}
+                        if anns.get(consts.HEALTH_ESCALATION_ANNOTATION):
+                            escalated += 1
+                        cordoned = bool(deep_get(n, "spec", "unschedulable"))
+                        if cordoned != last_cordon.get(name, False):
+                            cordon_flips[name] = cordon_flips.get(name, 0) + 1
+                            last_cordon[name] = cordoned
+                    max_escalated = max(max_escalated, escalated)
+                    await asyncio.sleep(0.1)
+                trips_a = _metric_total(metrics, "tpu_operator_health_trips")
+                result["phase_a_trips"] = trips_a
+                result["phase_a_max_escalated"] = max_escalated
+
+                # -- phase B: fleet-wide bad signal → budget exhaustion --
+                fc.chaos.stop()
+                bad = [f"tpu-{s}-{h}" for s in range(n_nodes // 8)
+                       for h in range(4)]  # half the fleet
+                for name in bad:
+                    fc.set_agent_health(name, "unhealthy", "chip-scrape-failed")
+                t2 = time.perf_counter()
+                observe_only = False
+                while time.perf_counter() - t2 < 60.0:
+                    escalated = 0
+                    for n in await _nodes():
+                        name = n["metadata"]["name"]
+                        anns = deep_get(n, "metadata", "annotations", default={}) or {}
+                        if anns.get(consts.HEALTH_ESCALATION_ANNOTATION):
+                            escalated += 1
+                        cordoned = bool(deep_get(n, "spec", "unschedulable"))
+                        if cordoned != last_cordon.get(name, False):
+                            cordon_flips[name] = cordon_flips.get(name, 0) + 1
+                            last_cordon[name] = cordoned
+                    max_escalated = max(max_escalated, escalated)
+                    if health._observe_only:
+                        observe_only = True
+                        break
+                    await asyncio.sleep(0.1)
+                result["observe_only_entered"] = observe_only
+                result["max_escalated"] = max_escalated
+
+                # -- phase C: signals clear → full recovery ---------------
+                for name in bad:
+                    fc.set_agent_health(name, "ok")
+                t3 = time.perf_counter()
+                recovered = False
+                while time.perf_counter() - t3 < 120.0:
+                    health_ctrl.enqueue("health")
+                    nodes = await _nodes()
+                    clean = True
+                    for n in nodes:
+                        name = n["metadata"]["name"]
+                        labels = deep_get(n, "metadata", "labels", default={}) or {}
+                        anns = deep_get(n, "metadata", "annotations", default={}) or {}
+                        cordoned = bool(deep_get(n, "spec", "unschedulable"))
+                        if cordoned != last_cordon.get(name, False):
+                            cordon_flips[name] = cordon_flips.get(name, 0) + 1
+                            last_cordon[name] = cordoned
+                        if (
+                            labels.get(consts.HEALTH_STATE_LABEL)
+                            or anns.get(consts.HEALTH_ESCALATION_ANNOTATION)
+                            or cordoned
+                            or not all(
+                                c.get("status") == "True"
+                                for c in deep_get(n, "status", "conditions", default=[])
+                                if c.get("type") == "Ready"
+                            )
+                        ):
+                            clean = False
+                            break
+                    if clean and not health._observe_only:
+                        recovered = True
+                        break
+                    await asyncio.sleep(0.25)
+                result["recovered"] = recovered
+                result["recovery_s"] = round(time.perf_counter() - t3, 3)
+
+                reasons = {
+                    e.get("reason") for e in fc.store("", "events").objects.values()
+                }
+                result["event_reasons"] = sorted(
+                    reasons & {"NodeUnhealthy", "NodeRecovered", "NodeQuarantined",
+                               "HealthBudgetExhausted", "HealthBudgetRestored",
+                               "RemediationStarted", "RemediationHealthy"}
+                )
+        finally:
+            await client.close()
+
+        result["trips_total"] = _metric_total(metrics, "tpu_operator_health_trips")
+        result["actuations_total"] = _metric_total(
+            metrics, "tpu_operator_health_actuations"
+        )
+        result["actuations_denied_total"] = _metric_total(
+            metrics, "tpu_operator_health_actuations_denied"
+        )
+        result["max_cordon_flips_per_node"] = max(cordon_flips.values(), default=0)
+        result["faults_injected"] = fc.chaos.report()
+
+        failures = []
+        if result["phase_a_trips"] <= 0:
+            failures.append("no hysteresis trips under live chaos (detection failed)")
+        if result["trips_total"] <= 0:
+            failures.append("no hysteresis trips recorded (detection failed)")
+        if result["actuations_total"] <= 0:
+            failures.append("no automatic actuations recorded")
+        if result["max_escalated"] > budget:
+            failures.append(
+                f"actuations exceeded budget: {result['max_escalated']} > {budget}"
+            )
+        if not result["observe_only_entered"]:
+            failures.append("budget exhaustion never flipped observe-only")
+        if "HealthBudgetExhausted" not in result["event_reasons"]:
+            failures.append("HealthBudgetExhausted Event not posted")
+        # ≤ 2 transitions = at most one cordon + one uncordon; any third
+        # flip is the oscillation the hysteresis exists to prevent
+        if result["max_cordon_flips_per_node"] > 2:
+            failures.append(
+                f"cordon oscillation: a node flipped "
+                f"{result['max_cordon_flips_per_node']} times"
+            )
+        if not recovered:
+            failures.append("cluster never converged back to Ready/clean")
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_chaos_health_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  chaos-health soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_chaos_health_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  chaos-health FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  chaos-health soak: trips {result.get('trips_total'):.0f}, "
+        f"actuations {result.get('actuations_total'):.0f} "
+        f"(max concurrent {result.get('max_escalated')} <= budget {result.get('budget')}), "
+        f"recovery {result.get('recovery_s')}s, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 RECONCILE_TIERS = (10, 100, 500)
 RECONCILE_CONVERGE_TIMEOUT = 240.0
 _RECONCILE_CONCURRENCY_KNOBS = (
@@ -867,6 +1132,21 @@ def _int_arg(flag: str, default: int) -> int:
 
 
 def main() -> None:
+    # `bench.py --chaos-health [--nodes 100] [--seed 1]`: node-health-engine
+    # acceptance soak (no chip needed) — `make chaos-health`
+    if "--chaos-health" in sys.argv:
+        result = run_chaos_health_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "chaos_health_recovery_seconds",
+            "value": result.get("recovery_s"),
+            "unit": "s",
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
     # `bench.py --chaos [--nodes 100] [--seed 1] [--error-rate 0.05]`:
     # seeded chaos acceptance soak (no chip needed) — `make chaos`
     if "--chaos" in sys.argv:

@@ -19,7 +19,15 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGES = ("tpu_operator/k8s", "tpu_operator/controllers")
+# controllers/ (incl. the health engine), the API plumbing, the obs layer
+# whose Events are the health engine's evidence channel, and the node
+# agents that publish its signal plane
+PACKAGES = (
+    "tpu_operator/k8s",
+    "tpu_operator/controllers",
+    "tpu_operator/obs",
+    "tpu_operator/agents",
+)
 
 BROAD = {"Exception", "BaseException"}
 

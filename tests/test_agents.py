@@ -224,7 +224,7 @@ async def test_agent_ttl_cache_single_flight(hw4, monkeypatch):
 
     calls = 0
 
-    async def fake_collect(push_store=None):
+    async def fake_collect(push_store=None, scrape_errors=None):
         nonlocal calls
         calls += 1
         await asyncio.sleep(0.05)

@@ -1,0 +1,477 @@
+"""Node health engine tests: hysteresis trip/untrip, the escalation
+ladder, the disruption budget's observe-only mode, slice-peer
+degradation, upgrade-machine deference, flap suppression, and the
+agent-side verdict publisher (controllers/health.py;
+docs/ROBUSTNESS.md "Node health engine")."""
+
+import asyncio
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.controllers import health as hm
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+# hysteresis tuned for test time-scale: 2 observations in a 10s window
+# trip; 0.2s of silence untrips; ladder rungs advance immediately
+FAST_HEALTH = {
+    "failureThreshold": 2, "windowSeconds": 10, "cleanSeconds": 0.2,
+    "escalationBackoffSeconds": 0, "maxUnhealthyPercent": "100%",
+    "flapMaxTrips": 99, "flapWindowSeconds": 60,
+}
+
+
+async def _mk_cluster(fc, n_nodes=1, health=None, spec_extra=None, **node_kw):
+    client = ApiClient(Config(base_url=fc.base_url))
+    spec = {"health": {**FAST_HEALTH, **(health or {})}, **(spec_extra or {})}
+    await client.create(TPUClusterPolicy.new(spec=spec).obj)
+    for i in range(n_nodes):
+        fc.add_node(f"tpu-{i}", **node_kw)
+    return client
+
+
+async def _trip(fc, r, names=("tpu-0",)):
+    """Drive two discrete unhealthy episodes through the engine — the
+    engine must SEE the ok state between them for the second to count as
+    a transition (exactly how a sampling controller perceives flaps).
+    Leaves the verdict asserted unhealthy (the node stays tripped)."""
+    for name in names:
+        fc.set_agent_health(name, "unhealthy", "x")
+    await r.reconcile("health")               # observation 1 (transition)
+    for name in names:
+        fc.set_agent_health(name, "ok")
+    await r.reconcile("health")               # engine sees the recovery
+    for name in names:
+        fc.set_agent_health(name, "unhealthy", "x")
+    await r.reconcile("health")               # observation 2 → trip
+
+async def _node(client, name):
+    return await client.get("", "Node", name)
+
+
+def _state(node):
+    return deep_get(node, "metadata", "labels", default={}).get(
+        consts.HEALTH_STATE_LABEL, ""
+    )
+
+
+def _step(node):
+    return deep_get(node, "metadata", "annotations", default={}).get(
+        consts.HEALTH_ESCALATION_ANNOTATION, ""
+    )
+
+
+def _event_reasons(fc):
+    return {e.get("reason") for e in fc.store("", "events").objects.values()}
+
+
+def _runtime_pod(fc, node_name, phase="Running"):
+    fc.put({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"tpu-runtime-{node_name}", "namespace": NS,
+                     "labels": {"app": "tpu-runtime"}},
+        "spec": {"nodeName": node_name, "containers": [{"name": "c"}]},
+        "status": {"phase": phase},
+    })
+
+
+async def test_one_bad_observation_never_trips(validation_root):
+    """A single bad scrape (one unhealthy verdict blip) stays below the
+    hysteresis threshold: no trip, no cordon, no remediation request."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        try:
+            r = hm.HealthReconciler(client, NS)
+            fc.set_agent_health("tpu-0", "unhealthy", "chip-scrape-failed")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""
+            assert _step(node) == ""
+            assert not deep_get(node, "spec", "unschedulable")
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert consts.VALIDATE_REQUEST_LABEL not in labels
+        finally:
+            await client.close()
+
+
+async def test_hysteresis_trips_and_injects_remediation(validation_root):
+    """K discrete failure observations inside the window trip the node and
+    the first ladder rung hands it to the remediation machine (the same
+    channel an admin would use)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)
+            node = await _node(client, "tpu-0")
+            assert _state(node) == consts.HEALTH_TRIPPED
+            assert _step(node) == hm.STEP_REMEDIATE
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert labels[consts.VALIDATE_REQUEST_LABEL] == "requested"
+            # never cordoned at the remediate rung
+            assert not deep_get(node, "spec", "unschedulable")
+            assert "NodeUnhealthy" in _event_reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_sustained_agent_verdict_trips_within_window(validation_root):
+    """A verdict STUCK unhealthy re-observes at window/threshold cadence:
+    sustained failure trips without any discrete transition."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, health={"failureThreshold": 2, "windowSeconds": 2}
+        )
+        try:
+            r = hm.HealthReconciler(client, NS)
+            fc.set_agent_health("tpu-0", "unhealthy", "chip-scrape-failed")
+            await r.reconcile("health")
+            await asyncio.sleep(1.1)  # past the 1s re-assert cadence
+            await r.reconcile("health")
+            assert _state(await _node(client, "tpu-0")) == consts.HEALTH_TRIPPED
+        finally:
+            await client.close()
+
+
+async def test_untrip_requires_sustained_clean_then_releases(validation_root):
+    """While the bad verdict is still asserted the node stays tripped no
+    matter how long ago it tripped; cleanSeconds of silence releases
+    everything (state label, escalation, request left to remediation)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, health={"cleanSeconds": 0.3})
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)
+            await asyncio.sleep(0.4)
+            await r.reconcile("health")  # still asserted → still tripped
+            assert _state(await _node(client, "tpu-0")) == consts.HEALTH_TRIPPED
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")  # sees recovery; clean clock starts
+            await asyncio.sleep(0.4)
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""
+            assert _step(node) == ""
+            assert "NodeRecovered" in _event_reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_escalation_ladder_to_quarantine(validation_root):
+    """remediate → restart-runtime → quarantine: each rung acts once, the
+    quarantine rung cordons AND taints, and recovery releases both."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, health={"cleanSeconds": 5})
+        _runtime_pod(fc, "tpu-0")
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)
+            assert _step(await _node(client, "tpu-0")) == hm.STEP_REMEDIATE
+
+            # the remediation machine finishes (request label cleared) but
+            # signals continue → next rung restarts the runtime pod
+            await client.patch("", "Node", "tpu-0", {"metadata": {"labels": {
+                consts.VALIDATE_REQUEST_LABEL: None,
+            }}})
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _step(node) == hm.STEP_RESTART_RUNTIME
+            pods = await client.list_items(
+                "", "Pod", NS, label_selector="app=tpu-runtime"
+            )
+            assert pods == []  # deleted for restart
+
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _step(node) == hm.STEP_QUARANTINE
+            assert _state(node) == consts.HEALTH_QUARANTINED
+            assert deep_get(node, "spec", "unschedulable") is True
+            taints = deep_get(node, "spec", "taints") or []
+            assert any(t["key"] == consts.HEALTH_TAINT_KEY for t in taints)
+            anns = deep_get(node, "metadata", "annotations", default={})
+            assert anns[consts.HEALTH_CORDONED_ANNOTATION] == "true"
+            assert "NodeQuarantined" in _event_reasons(fc)
+
+            # recovery: signal clears long enough → full release
+            fc.set_agent_health("tpu-0", "ok")
+            policy = await client.get(
+                "tpu.google.com", "TPUClusterPolicy", "cluster-policy"
+            )
+            policy["spec"]["health"]["cleanSeconds"] = 0.1
+            await client.update(policy)
+            await r.reconcile("health")  # sees the recovery
+            await asyncio.sleep(0.3)
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""
+            assert not deep_get(node, "spec", "unschedulable")
+            assert not (deep_get(node, "spec", "taints") or [])
+        finally:
+            await client.close()
+
+
+async def test_budget_exhaustion_flips_observe_only(validation_root):
+    """More unhealthy nodes than maxUnhealthyPercent allows → no node not
+    already on the ladder is actuated, the HealthBudgetExhausted Warning
+    posts, and recovery below the budget resumes actuation."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, n_nodes=10, health={"maxUnhealthyPercent": "20%"}
+        )
+        names = tuple(f"tpu-{i}" for i in range(5))
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r, names)  # 5 trip at once > budget 2
+            assert r._observe_only
+            for name in names:
+                node = await _node(client, name)
+                labels = deep_get(node, "metadata", "labels", default={})
+                # observed, never actuated: no request, no cordon, no step
+                assert consts.VALIDATE_REQUEST_LABEL not in labels
+                assert not deep_get(node, "spec", "unschedulable")
+                assert _step(node) == ""
+                assert _state(node) == consts.HEALTH_OBSERVE
+            assert "HealthBudgetExhausted" in _event_reasons(fc)
+
+            # fleet recovers below the budget → actuation resumes
+            for name in names[1:]:
+                fc.set_agent_health(name, "ok")
+            await r.reconcile("health")  # sees the recoveries
+            await asyncio.sleep(0.3)     # past cleanSeconds
+            await r.reconcile("health")
+            assert not r._observe_only
+            node = await _node(client, "tpu-0")
+            assert _step(node) == hm.STEP_REMEDIATE
+            assert "HealthBudgetRestored" in _event_reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_budget_hard_caps_concurrent_actuations(validation_root):
+    """Within-budget unhealthy counts still never put more than budget
+    nodes on the ladder at once (entry is hard-gated, not merely flipped
+    by the observe-only threshold)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, n_nodes=10, health={"maxUnhealthyPercent": "2"}
+        )
+        try:
+            r = hm.HealthReconciler(client, NS)
+            # exactly at the budget: not exhausted, both actuated
+            await _trip(fc, r, ("tpu-0", "tpu-1"))
+            assert not r._observe_only
+            on_ladder = 0
+            for i in range(10):
+                if _step(await _node(client, f"tpu-{i}")):
+                    on_ladder += 1
+            assert on_ladder == 2
+        finally:
+            await client.close()
+
+
+async def test_slice_peers_degraded_never_cordoned(validation_root):
+    """One unhealthy host on a multi-host slice marks every peer
+    slice-degraded (label + degraded-by annotation only); peers are never
+    cordoned or remediated, and the mark clears with the sick host."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, n_nodes=4, topology="4x4",
+            labels={consts.GKE_NODEPOOL_LABEL: "pool-0"},
+        )
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)
+            for i in (1, 2, 3):
+                node = await _node(client, f"tpu-{i}")
+                assert _state(node) == consts.HEALTH_SLICE_DEGRADED
+                anns = deep_get(node, "metadata", "annotations", default={})
+                assert anns[consts.HEALTH_DEGRADED_BY_ANNOTATION] == "tpu-0"
+                assert not deep_get(node, "spec", "unschedulable")
+                labels = deep_get(node, "metadata", "labels", default={})
+                assert consts.VALIDATE_REQUEST_LABEL not in labels
+
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")  # sees the recovery
+            await asyncio.sleep(0.3)     # past cleanSeconds
+            await r.reconcile("health")
+            for i in (0, 1, 2, 3):
+                node = await _node(client, f"tpu-{i}")
+                assert _state(node) == ""
+        finally:
+            await client.close()
+
+
+async def test_upgrade_machine_owns_the_node(validation_root):
+    """A node mid-upgrade is marked tripped but NEVER actuated — the
+    upgrade machine owns its cordon and pods; actuation begins once the
+    upgrade reaches a terminal state (remediation-controller deference)."""
+    from tpu_operator.controllers import upgrade as up
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc)
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await client.patch("", "Node", "tpu-0", {"metadata": {"labels": {
+                consts.UPGRADE_STATE_LABEL: up.DRAIN,
+            }}})
+            await _trip(fc, r)
+            node = await _node(client, "tpu-0")
+            assert _state(node) == consts.HEALTH_TRIPPED
+            assert _step(node) == ""
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert consts.VALIDATE_REQUEST_LABEL not in labels
+
+            await client.patch("", "Node", "tpu-0", {"metadata": {"labels": {
+                consts.UPGRADE_STATE_LABEL: up.DONE,
+            }}})
+            await r.reconcile("health")
+            assert _step(await _node(client, "tpu-0")) == hm.STEP_REMEDIATE
+        finally:
+            await client.close()
+
+
+async def test_flap_suppression_goes_straight_to_quarantine(validation_root):
+    """A node that keeps tripping and recovering is a flapper: past
+    flapMaxTrips it skips the ladder and quarantines — the oscillation
+    (cordon/uncordon churn) the engine exists to prevent."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, health={"flapMaxTrips": 2, "cleanSeconds": 0.05}
+        )
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)  # trip 1 → remediate rung
+            assert _step(await _node(client, "tpu-0")) == hm.STEP_REMEDIATE
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")  # sees the recovery
+            await asyncio.sleep(0.2)
+            await r.reconcile("health")  # clean → released
+            assert _step(await _node(client, "tpu-0")) == ""
+
+            await _trip(fc, r)  # trip 2 inside the flap window → quarantine
+            node = await _node(client, "tpu-0")
+            assert _step(node) == hm.STEP_QUARANTINE
+            assert deep_get(node, "spec", "unschedulable") is True
+        finally:
+            await client.close()
+
+
+async def test_disabled_engine_releases_everything(validation_root):
+    """health.enabled=false clears engine state labels, escalation
+    bookkeeping, our cordon and taint — remediation _clear_labels
+    analogue."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, health={"flapMaxTrips": 1, "cleanSeconds": 60}
+        )
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r)
+            node = await _node(client, "tpu-0")
+            assert _step(node) == hm.STEP_QUARANTINE  # flapMaxTrips=1
+            assert deep_get(node, "spec", "unschedulable") is True
+
+            policy = await client.get(
+                "tpu.google.com", "TPUClusterPolicy", "cluster-policy"
+            )
+            policy["spec"]["health"]["enabled"] = False
+            await client.update(policy)
+            await r.reconcile("health")
+            node = await _node(client, "tpu-0")
+            assert _state(node) == ""
+            assert _step(node) == ""
+            assert not deep_get(node, "spec", "unschedulable")
+            assert not (deep_get(node, "spec", "taints") or [])
+        finally:
+            await client.close()
+
+
+async def test_node_error_does_not_stall_the_fleet(validation_root):
+    """A poisoned node whose patches always fail must not abort actuation
+    for the rest of the fleet (per-node ApiError isolation)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=3)
+        real_patch = client.patch
+
+        async def flaky_patch(group, kind, name, patch, *a, **kw):
+            if kind == "Node" and name == "tpu-0":
+                raise ApiError(500, "boom")
+            return await real_patch(group, kind, name, patch, *a, **kw)
+
+        client.patch = flaky_patch
+        try:
+            r = hm.HealthReconciler(client, NS)
+            await _trip(fc, r, ("tpu-0", "tpu-1", "tpu-2"))
+            for i in (1, 2):
+                node = await _node(client, f"tpu-{i}")
+                assert _step(node) == hm.STEP_REMEDIATE
+        finally:
+            await client.close()
+
+
+# ----------------------------------------------------------------------
+# Signal plane: the node-status-exporter's verdict assessor/publisher.
+
+async def test_health_publisher_reports_regression_and_recovery(
+    validation_root,
+):
+    """A validator component losing its ready marker publishes an
+    unhealthy verdict with the reason code; re-proving publishes ok.
+    Writes are on-change only."""
+    from tpu_operator.agents.node_status_exporter import HealthPublisher
+    from tpu_operator.validator import status as vstatus
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-0")
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            pub = HealthPublisher(client, "tpu-0")
+            vstatus.write_ready("jax", {"ok": True})
+            verdict, reason = await pub.step(None)
+            assert verdict == "ok"
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == "ok"
+
+            vstatus.clear("jax")  # proof LOST, not merely absent
+            verdict, reason = await pub.step(None)
+            assert verdict == "unhealthy"
+            assert "validator-regressed:jax" in reason
+            node = await client.get("", "Node", "tpu-0")
+            assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == "unhealthy"
+            anns = node["metadata"]["annotations"]
+            assert "validator-regressed:jax" in anns[consts.TPU_HEALTH_REASON_ANNOTATION]
+
+            vstatus.write_ready("jax", {"ok": True})
+            verdict, _ = await pub.step(None)
+            assert verdict == "ok"
+        finally:
+            await client.close()
+
+
+async def test_health_publisher_flags_scrape_error_growth(validation_root):
+    """A climbing tpu_chip_scrape_errors_total between assessments is the
+    chip-scrape-failed signal; a flat counter is not."""
+    from tpu_operator.agents.node_status_exporter import HealthPublisher
+
+    def counters(n):
+        return {"chips": {"0": {"tpu_chip_scrape_errors_total": n}}}
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-0")
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            pub = HealthPublisher(client, "tpu-0")
+            verdict, _ = await pub.step(counters(3))  # baseline
+            assert verdict == "ok"
+            verdict, _ = await pub.step(counters(3))  # flat
+            assert verdict == "ok"
+            verdict, reason = await pub.step(counters(5))  # climbing
+            assert verdict == "unhealthy"
+            assert "chip-scrape-failed" in reason
+        finally:
+            await client.close()

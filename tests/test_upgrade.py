@@ -335,3 +335,131 @@ async def test_drain_evicts_replicaset_pods_without_force():
             assert await r._drain_step(node, pol) is True  # gone → drained
         finally:
             await client.close()
+
+
+# ----------------------------------------------------------------------
+# PR 5 satellites: parse_max_unavailable edges, maxParallelUpgrades=0,
+# per-node error isolation, drain grace + skip-drain.
+
+def test_parse_max_unavailable_edge_cases():
+    """The floor-at-1 contract on every degenerate input: an upgrade that
+    can never admit a node would deadlock, so 0/garbage parse to 1."""
+    assert up.parse_max_unavailable("0", 16) == 1
+    assert up.parse_max_unavailable("0%", 16) == 1
+    assert up.parse_max_unavailable("150%", 10) == 15  # >100% is legal
+    assert up.parse_max_unavailable("-3", 10) == 1
+    assert up.parse_max_unavailable("25%%", 10) == 1
+    assert up.parse_max_unavailable("", 0) == 1   # empty on a 0-node cluster
+    assert up.parse_max_unavailable(None, 0) == 1
+    assert up.parse_max_unavailable("25%", 0) == 1
+
+
+async def test_max_parallel_zero_means_unbounded():
+    """maxParallelUpgrades: 0 = no parallelism bound (the schema's
+    minimum:0 and the reference DriverUpgradePolicySpec semantics);
+    maxUnavailable remains the only admission backstop."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(
+            fc, n_nodes=4, max_parallel=0, max_unavailable="100%"
+        )
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            states = []
+            for i in range(4):
+                node = await client.get("", "Node", f"tpu-{i}")
+                states.append(
+                    node["metadata"]["labels"].get(consts.UPGRADE_STATE_LABEL)
+                )
+            # every node admitted in one pass (cordon or already draining)
+            assert all(s in (up.CORDON, up.DRAIN) for s in states)
+        finally:
+            await client.close()
+
+
+async def test_per_node_api_error_does_not_abort_the_pass():
+    """A poisoned node whose state patch always fails must not starve the
+    mark-required/admission loops for the nodes behind it (one mid-loop
+    ApiError used to abort the whole upgrade pass)."""
+    from tpu_operator.k8s.client import ApiError
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=3, max_parallel=3)
+        real_patch = client.patch
+
+        async def flaky_patch(group, kind, name, patch, *a, **kw):
+            if kind == "Node" and name == "tpu-0":
+                raise ApiError(500, "boom")
+            return await real_patch(group, kind, name, patch, *a, **kw)
+
+        client.patch = flaky_patch
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            await r.reconcile("upgrade")
+            states = {}
+            for i in range(3):
+                node = await client.get("", "Node", f"tpu-{i}")
+                states[f"tpu-{i}"] = node["metadata"]["labels"].get(
+                    consts.UPGRADE_STATE_LABEL, ""
+                )
+            assert states["tpu-0"] == ""  # poisoned node skipped
+            # ...but its siblings progressed through mark + admission
+            assert all(s for n, s in states.items() if n != "tpu-0")
+        finally:
+            await client.close()
+
+
+async def test_drain_grace_period_propagates_to_delete():
+    """drain.gracePeriodSeconds rides the DELETE as DeleteOptions; the
+    default (absent) preserves each pod's own termination grace."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            node = await client.get("", "Node", "tpu-0")
+            _tpu_pod(fc, "rs-pod", "tpu-0", owner_kind="ReplicaSet")
+            pol = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "timeoutSeconds": 30,
+                          "gracePeriodSeconds": 7}}}}
+            ).spec.libtpu.upgrade_policy
+            await r._drain_step(node, pol)
+            grace = [
+                g for (plural, _, name, g) in fc.delete_options
+                if plural == "pods" and name == "rs-pod"
+            ]
+            assert grace == ["7"]
+
+            # default: no gracePeriodSeconds query param at all
+            _tpu_pod(fc, "rs-pod-2", "tpu-0", owner_kind="ReplicaSet")
+            default_pol = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "timeoutSeconds": 30}}}}
+            ).spec.libtpu.upgrade_policy
+            await r._drain_step(node, default_pol)
+            grace = [
+                g for (plural, _, name, g) in fc.delete_options
+                if plural == "pods" and name == "rs-pod-2"
+            ]
+            assert grace == [None]
+        finally:
+            await client.close()
+
+
+async def test_skip_drain_label_exempts_pod():
+    """A pod labelled tpu.google.com/skip-drain=true is neither evicted
+    nor allowed to block the drain — even a bare pod without force."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = await _mk_cluster(fc, n_nodes=1)
+        try:
+            r = up.UpgradeReconciler(client, NS)
+            node = await client.get("", "Node", "tpu-0")
+            pod = _tpu_pod(fc, "checkpointer", "tpu-0")  # bare pod
+            pod["metadata"]["labels"] = {consts.SKIP_DRAIN_LABEL: "true"}
+            fc.put(pod)
+            no_force = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "force": False, "timeoutSeconds": 30}}}}
+            ).spec.libtpu.upgrade_policy
+            # drains to completion immediately; the pod survives
+            assert await r._drain_step(node, no_force) is True
+            assert await client.get("", "Pod", "checkpointer", "default")
+        finally:
+            await client.close()

@@ -39,6 +39,10 @@ COUNTERS = (
     "tpu_hbm_memory_usage_bytes",
     "tpu_ici_transmitted_bytes_total",
     "tpu_ici_received_bytes_total",
+    # agent-synthesized (never scraped): cumulative failed scrapes of the
+    # chip's runtime metrics endpoint — the node-local health signal the
+    # node-status-exporter turns into a tpu-health verdict
+    "tpu_chip_scrape_errors_total",
 )
 
 # workload telemetry counters accepted on /push (fed by obs.flight
@@ -64,6 +68,7 @@ COUNTER_HELP = {
     "tpu_hbm_memory_usage_bytes": "HBM bytes currently in use",
     "tpu_ici_transmitted_bytes_total": "Bytes transmitted over ICI since runtime start",
     "tpu_ici_received_bytes_total": "Bytes received over ICI since runtime start",
+    "tpu_chip_scrape_errors_total": "Failed scrapes of the chip's runtime metrics endpoint since agent start",
     "tpu_workload_step_duration_seconds": "Last workload step wall time in seconds",
     "tpu_workload_compile_seconds": "Workload compile (warmup) wall time in seconds",
     "tpu_workload_achieved_gbps": "Workload-achieved bandwidth in GB/s",
@@ -154,18 +159,28 @@ class PushStore:
         return {w: dict(e["counters"]) for w, e in self._entries.items()}
 
 
-async def collect(push_store: Optional[PushStore] = None) -> dict:
+async def collect(
+    push_store: Optional[PushStore] = None,
+    scrape_errors: Optional[dict] = None,
+) -> dict:
     """Per-chip counter map {chip_index: {counter: value}}; chip identity is
     decoded from the port (port - 8431), matching the device plugin's
     TPU_RUNTIME_METRICS_PORTS contract.  Endpoints are scraped
     CONCURRENTLY: four unreachable chips cost one 2 s timeout, not four
-    sequential ones blowing the exporter's own fetch budget."""
+    sequential ones blowing the exporter's own fetch budget.
+
+    ``scrape_errors`` (chip → cumulative failures, owned by the caller so
+    it persists across collections) feeds the agent-synthesized
+    ``tpu_chip_scrape_errors_total`` counter: an unreachable runtime
+    endpoint must be VISIBLE as a health signal, not silently zero-filled
+    into the same shape as an idle chip."""
     chips = hw.chip_count()
     ports_env = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
     ports = [int(p) for p in ports_env.split(",") if p.strip().isdigit()]
     if not ports:
         ports = [BASE_METRICS_PORT + i for i in range(chips)]
     per_chip: dict[int, dict] = {}
+    scrape_errors = scrape_errors if scrape_errors is not None else {}
     async with aiohttp.ClientSession() as session:
         scraped = await asyncio.gather(
             *(scrape_runtime_endpoint(session, port) for port in ports),
@@ -173,13 +188,20 @@ async def collect(push_store: Optional[PushStore] = None) -> dict:
         )
     for port, result in zip(ports, scraped):
         chip = max(0, port - BASE_METRICS_PORT)
-        per_chip[chip] = result if isinstance(result, dict) else {}
+        if isinstance(result, dict):
+            per_chip[chip] = result
+        else:
+            per_chip[chip] = {}
+            scrape_errors[chip] = scrape_errors.get(chip, 0) + 1
     # shape-stable zero fill
     for i in range(chips):
         per_chip.setdefault(i, {})
-    for chip in per_chip.values():
+    for chip, counters in per_chip.items():
         for counter in COUNTERS:
-            chip.setdefault(counter, 0.0)
+            counters.setdefault(counter, 0.0)
+        counters["tpu_chip_scrape_errors_total"] = float(
+            scrape_errors.get(chip, 0)
+        )
     snapshot = {"ts": time.time(), "chips": per_chip}
     if push_store is not None:
         snapshot["workloads"] = push_store.snapshot()
@@ -255,6 +277,7 @@ async def serve(
     # collection instead of re-hitting every per-chip runtime endpoint
     cache: dict = {"snapshot": {"ts": 0.0, "chips": {}}}
     push_store = PushStore(ttl=push_ttl)
+    scrape_errors: dict[int, int] = {}  # chip → cumulative failed scrapes
     # the TTL check+collect must be atomic: without the lock, N scrapers
     # arriving inside one TTL window each saw a stale ts and each ran a
     # full collect() pass, defeating the shared-sampler contract
@@ -263,7 +286,7 @@ async def serve(
     async def refresh() -> dict:
         async with refresh_lock:
             if time.time() - cache["snapshot"]["ts"] >= cache_ttl:
-                cache["snapshot"] = await collect(push_store)
+                cache["snapshot"] = await collect(push_store, scrape_errors)
             else:
                 # pushed counters are point-in-time already; serve the
                 # freshest even from a cached chip snapshot

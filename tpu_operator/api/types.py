@@ -220,6 +220,13 @@ class DrainSpec(SpecBase):
     timeout_seconds: int = 300
     delete_empty_dir: bool = False
     pod_selector: str = ""
+    # termination grace handed to evicted workload pods; None preserves each
+    # pod's own terminationGracePeriodSeconds (the historical behavior),
+    # 0 = immediate.  Pods labelled tpu.google.com/skip-drain=true are
+    # exempt from the drain entirely (neither evicted nor blocking).
+    grace_period_seconds: Optional[int] = field(
+        default=None, metadata={"minimum": 0}
+    )
     extra_fields: dict = field(default_factory=dict)
 
 
@@ -243,6 +250,9 @@ class UpgradePolicySpec(SpecBase):
     """Driver auto-upgrade policy (clusterpolicy_types.go DriverUpgradePolicySpec)."""
 
     auto_upgrade: bool = False
+    # 0 = unbounded parallelism (reference DriverUpgradePolicySpec
+    # semantics — the schema's minimum:0 and the controller agree;
+    # maxUnavailable stays the availability backstop)
     max_parallel_upgrades: int = field(default=1, metadata={"minimum": 0})
     max_unavailable: Optional[str] = "25%"
     # post-swap validation budget before the node is marked upgrade-failed
@@ -463,6 +473,41 @@ class RemediationSpec(SpecBase):
     extra_fields: dict = field(default_factory=dict)
 
 
+@dataclass
+class HealthSpec(SpecBase):
+    """Autonomous node health engine (controllers/health.py;
+    docs/ROBUSTNESS.md "Node health engine").
+
+    Hysteresis: ``failureThreshold`` failure observations within
+    ``windowSeconds`` trip a node (one bad scrape never cordons anything);
+    untripping requires ``cleanSeconds`` of sustained silence.  Tripped
+    nodes climb an escalation ladder — auto-remediation via the
+    remediation machine, then a runtime-pod restart, then quarantine
+    (cordon + taint) — each rung given ``escalationBackoffSeconds`` to
+    prove itself.  ``maxUnhealthyPercent`` is the cluster-wide disruption
+    budget: when more nodes are unhealthy than it allows, the engine stops
+    actuating and flips to observe-only (``HealthBudgetExhausted`` Event),
+    the degraded-mode philosophy that a confused controller fails static.
+    """
+
+    enabled: bool = True
+    failure_threshold: int = field(default=3, metadata={"minimum": 1})
+    # windows are seconds and may be fractional (sub-second in tests)
+    window_seconds: float = field(default=300, metadata={"minimum": 0})
+    clean_seconds: float = field(default=120, metadata={"minimum": 0})
+    # flap suppression: this many trips inside flapWindowSeconds and the
+    # node escalates straight to quarantine instead of oscillating through
+    # remediate/recover cycles
+    flap_max_trips: int = field(default=3, metadata={"minimum": 1})
+    flap_window_seconds: float = field(default=1800, metadata={"minimum": 0})
+    escalation_backoff_seconds: int = field(default=300, metadata={"minimum": 0})
+    # "25%" or absolute "5"; parses to an absolute node ceiling ≥ 0 where
+    # 0 (and any unparsable value) means observe-only — a misread budget
+    # must fail static, never actuate unbounded
+    max_unhealthy_percent: str = "20%"
+    extra_fields: dict = field(default_factory=dict)
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -502,6 +547,7 @@ class TPUClusterPolicySpec(SpecBase):
         },
     )
     remediation: RemediationSpec = field(default_factory=RemediationSpec)
+    health: HealthSpec = field(default_factory=HealthSpec)
     extra_fields: dict = field(default_factory=dict)
 
     # -- enable gates (isStateEnabled analogue, state_manager.go:994-1036) --

@@ -101,6 +101,7 @@ async def run(args: argparse.Namespace) -> None:
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
+    from tpu_operator.controllers.health import HealthReconciler
     from tpu_operator.controllers.remediation import RemediationReconciler
     from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
     from tpu_operator.controllers.upgrade import UpgradeReconciler
@@ -111,6 +112,7 @@ async def run(args: argparse.Namespace) -> None:
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)
     RemediationReconciler(client, namespace, **obs).setup(mgr)
+    HealthReconciler(client, namespace, **obs).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

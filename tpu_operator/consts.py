@@ -39,6 +39,25 @@ REMEDIATION_STATE_LABEL = "tpu.google.com/tpu-remediation-state"
 # (SURVEY §7 hard part 1; no reference analogue, GPUs are node-local).
 SLICE_READY_LABEL = "tpu.google.com/tpu.slice.ready"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# Node health engine (controllers/health.py; docs/ROBUSTNESS.md).
+# The VERDICT label is the signal plane's publication channel: the node's
+# own agents (node-status-exporter; chip scrape failures, validator check
+# regressions) write ok|unhealthy here with a reason code in the paired
+# annotation.  The operator's detection plane consumes it alongside the
+# signals only the control plane can see (Ready flaps, validator
+# crash-loops, runtime restart storms).
+TPU_HEALTH_LABEL = "tpu.google.com/tpu-health"            # ok | unhealthy
+TPU_HEALTH_REASON_ANNOTATION = "tpu.google.com/tpu-health-reason"
+# The ENGINE's own per-node state label (hysteresis output, never written
+# by agents): tripped | observe | quarantined | slice-degraded.
+HEALTH_STATE_LABEL = "tpu.google.com/tpu-health-state"
+HEALTH_OK = "ok"
+HEALTH_UNHEALTHY = "unhealthy"
+HEALTH_TRIPPED = "tripped"
+HEALTH_OBSERVE = "observe"          # tripped but budget-gated: no actuation
+HEALTH_QUARANTINED = "quarantined"
+HEALTH_SLICE_DEGRADED = "slice-degraded"  # peer-of-unhealthy-host, label only
+
 # Admin/TFD-applied multislice membership: slices (node pools) sharing a
 # value form one DCN-connected multislice group; the validator then also
 # proves a cross-slice rendezvous before gating jax-ready (no reference
@@ -102,6 +121,25 @@ UPGRADE_STATE_TS_ANNOTATION = "tpu.google.com/tpu-runtime-upgrade-state-ts"
 # an admin's own cordon
 REMEDIATION_STATE_TS_ANNOTATION = "tpu.google.com/tpu-remediation-state-ts"
 REMEDIATION_CORDONED_ANNOTATION = "tpu.google.com/tpu-remediation-cordoned"
+# Health-engine actuation bookkeeping: the escalation annotation records the
+# node's current rung on the ladder (remediate -> restart-runtime ->
+# quarantine) with its entry timestamp beside it; the cordoned annotation
+# marks a quarantine cordon as OURS (an admin's own cordon is never undone,
+# remediation-controller convention).
+HEALTH_ESCALATION_ANNOTATION = "tpu.google.com/tpu-health-escalation"
+HEALTH_ESCALATION_TS_ANNOTATION = "tpu.google.com/tpu-health-escalation-ts"
+HEALTH_CORDONED_ANNOTATION = "tpu.google.com/tpu-health-cordoned"
+# which slice peer(s) degraded this node — engine-owned, deliberately NOT
+# the agent's reason annotation (the agent must keep publishing its own
+# verdict reasons without the engine clobbering them)
+HEALTH_DEGRADED_BY_ANNOTATION = "tpu.google.com/tpu-health-degraded-by"
+# NoSchedule taint keyed on the health label; applied only at the
+# quarantine rung (DS-operand pods tolerate it like they tolerate cordons)
+HEALTH_TAINT_KEY = TPU_HEALTH_LABEL
+# Pod-level drain opt-out honored by the upgrade drain step: a pod carrying
+# this label is neither evicted nor allowed to block the drain (the
+# workload owns its own lifecycle — checkpoint-on-SIGTERM jobs etc.)
+SKIP_DRAIN_LABEL = "tpu.google.com/skip-drain"
 
 # ---------------------------------------------------------------------------
 # Ordered operand state names (controllers/state_manager.go:795-813 analogue).
@@ -179,6 +217,10 @@ REQUEUE_NOT_READY_SECONDS = 5.0      # clusterpolicy_controller.go:165,193
 REQUEUE_NO_TPU_NODES_SECONDS = 45.0  # :199 (NFD-missing poll analogue)
 UPGRADE_REQUEUE_SECONDS = 120.0      # upgrade_controller.go:58,196
 REMEDIATION_REQUEUE_SECONDS = 30.0   # validation rounds are minutes, not hours
+# Health-engine cadence: hysteresis windows are tens of seconds, and a
+# sustained bad signal must accumulate observations between passes, so the
+# engine requeues much faster than the upgrade machine
+HEALTH_REQUEUE_SECONDS = 10.0
 RATE_LIMIT_BASE_SECONDS = 0.1        # clusterpolicy_controller.go:354
 RATE_LIMIT_MAX_SECONDS = 3.0
 

@@ -1,0 +1,751 @@
+"""Autonomous node health engine: signals → hysteresis → bounded actuation.
+
+The reference GPU operator leaves the health loop open at observability
+(its node-status-exporter feeds Prometheus and a human takes it from
+there); our remediation controller inherited that shape — it acts only
+when someone hand-labels a node ``tpu.validate=requested``.  At fleet
+scale humans cannot be the failure detector (Tenplex, arxiv 2312.05181:
+accelerator clusters must treat node degradation as a continuous,
+automatically-handled event; CRIUgpu, arxiv 2502.16631: detection must
+precede any recovery action).  This controller closes the loop in three
+planes:
+
+**Signal plane** — inputs, each tagged with a reason code:
+
+- the node's own agents publish a verdict on the
+  ``tpu.google.com/tpu-health`` label (node-status-exporter: chip scrape
+  failures, validator check regressions, flight-recorder error rates),
+  reason in the paired annotation;
+- signals only the control plane can see: Node Ready condition flaps,
+  validator-pod crash-loops (phase Failed / restartCount climbing), and
+  runtime-DS restart storms.
+
+**Detection plane** — per-node hysteresis: ``failureThreshold`` discrete
+failure observations inside ``windowSeconds`` trip the node (one bad
+scrape never cordons anything); a *continuously asserted* bad signal
+(agent verdict stuck unhealthy, Ready stuck False) re-observes every
+``window/threshold`` seconds, so a sustained failure trips within one
+window.  Untripping requires ``cleanSeconds`` of silence AND no
+currently-asserted bad signal, so a flapping node cannot oscillate the
+actuation plane; ``flapMaxTrips`` trips inside ``flapWindowSeconds``
+escalates straight to quarantine.
+
+**Actuation plane** — tripped nodes climb an escalation ladder, each rung
+given ``escalationBackoffSeconds`` to prove itself:
+
+    remediate (inject ``tpu.validate=requested`` into the remediation
+    machine) → restart-runtime (delete the node's OnDelete runtime-DS
+    pod) → quarantine (cordon + ``tpu.google.com/tpu-health:NoSchedule``
+    taint, annotation-marked as ours)
+
+all gated by the cluster-wide disruption budget
+(``health.maxUnhealthyPercent``): when more nodes are unhealthy than the
+budget allows — a lying fleet-wide signal source, not a fleet-wide
+hardware failure — the engine posts a ``HealthBudgetExhausted`` Warning
+Event and flips to observe-only, mirroring degraded mode's fail-static
+philosophy.  Slice-aware: an unhealthy host marks its multi-host slice
+peers ``slice-degraded`` (label only, never cordoned — the slice is
+already broken as a unit, breaking the peers harder helps nobody), and
+nodes owned by the upgrade machine's non-terminal states are never
+actuated, exactly as remediation defers today.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import (
+    CLUSTER_POLICY_KIND,
+    GROUP,
+    HealthSpec,
+    TPUClusterPolicy,
+)
+from tpu_operator.controllers import clusterinfo, nodestate
+from tpu_operator.controllers.remediation import (
+    REQUESTED as REMEDIATION_REQUESTED,
+    REVALIDATING as REMEDIATION_REVALIDATING,
+)
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.controllers.upgrade import (
+    NON_TERMINAL_STATES as UPGRADE_NON_TERMINAL,
+    VALIDATOR_POD_SELECTOR,
+)
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.health")
+
+RECONCILE_KEY = "health"
+
+RUNTIME_POD_SELECTOR = "app=tpu-runtime"
+
+# escalation-ladder rungs, recorded in HEALTH_ESCALATION_ANNOTATION
+STEP_REMEDIATE = "remediate"
+STEP_RESTART_RUNTIME = "restart-runtime"
+STEP_QUARANTINE = "quarantine"
+LADDER = (STEP_REMEDIATE, STEP_RESTART_RUNTIME, STEP_QUARANTINE)
+
+# signal reason codes (operator-derived; agent-published reasons pass
+# through verbatim with an "agent:" prefix)
+SIGNAL_NOT_READY = "node-not-ready"
+SIGNAL_VALIDATOR_CRASHLOOP = "validator-crashloop"
+SIGNAL_RUNTIME_RESTARTS = "runtime-restarts"
+
+
+def parse_budget(value: Optional[str], total: int) -> int:
+    """``"25%"`` or ``"3"`` → absolute actuation ceiling ≥ 0.
+
+    Deliberately NOT :func:`upgrade.parse_max_unavailable`: that helper
+    floors at 1 because an upgrade that can never admit a node would
+    deadlock, while a health budget of 0 is a *meaningful* configuration
+    (observe-only mode) — and an unparsable budget must fail static (0,
+    never actuate), not fail open."""
+    if value is None or not str(value).strip():
+        return 0
+    value = str(value).strip()
+    try:
+        if value.endswith("%"):
+            return max(0, int(total * int(value[:-1]) / 100))
+        return max(0, int(value))
+    except ValueError:
+        return 0
+
+
+@dataclass
+class _Track:
+    """Per-node in-memory hysteresis state.
+
+    The *escalation* state lives on the Node (annotation) and survives
+    operator restarts; the observation window is intentionally in-memory —
+    after a restart the engine re-observes for up to one window before
+    re-tripping, which is the safe direction (no actuation off stale
+    evidence)."""
+
+    window: deque = field(default_factory=deque)   # (monotonic_ts, reason)
+    trips: deque = field(default_factory=deque)    # monotonic trip times
+    born: float = field(default_factory=time.monotonic)
+    tripped: bool = False
+    last_ready: Optional[bool] = None
+    last_agent_bad: bool = False
+    # pod name -> restartCount, for validator/runtime restart-storm deltas
+    restarts: dict = field(default_factory=dict)
+    # pod name -> phase, to observe Failed transitions exactly once
+    phases: dict = field(default_factory=dict)
+    # reason -> last observation ts, re-assert throttle for sustained signals
+    last_seen: dict = field(default_factory=dict)
+    reasons: list = field(default_factory=list)    # last pass's live reasons
+
+
+class HealthReconciler:
+    """The closed health loop; see the module docstring for the planes."""
+
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
+        # the 10s observation cadence reads Nodes + two Pod selectors every
+        # pass — served from the informer stores once setup() registers
+        # them, so a healthy steady-state fleet costs zero API reads
+        # (docs/PERFORMANCE.md discipline); standalone (no setup) stays
+        # live.  Writes ALSO go through the reader: its write-through keeps
+        # the next (possibly cache-served) pass coherent with this pass's
+        # own patches — read-your-writes, never a re-fired actuation off a
+        # lagging watch
+        self.reader = CachedReader(client, self.metrics)
+        self._tracks: dict[str, _Track] = {}
+        self._observe_only = False
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, key: str) -> Optional[float]:
+        with self.tracer.reconcile("health", key=key):
+            return await self._reconcile(key)
+
+    async def _reconcile(self, key: str) -> Optional[float]:
+        policy = await self._cluster_policy()
+        if policy is None:
+            return None
+        spec = policy.spec.health
+        nodes = [
+            n for n in await self.reader.list_items("", "Node")
+            if clusterinfo.is_tpu_node(n)
+        ]
+        if not spec.enabled:
+            for node in nodes:
+                if self._engine_state(node) or self._escalation(node):
+                    try:
+                        await self._release(node, reason="health engine disabled")
+                    except ApiError as e:
+                        # per-node isolation: the rest of the fleet still
+                        # gets released this pass; the requeue retries this
+                        # node
+                        log.error(
+                            "health disable-release on %s failed: %s",
+                            node["metadata"]["name"], e,
+                        )
+            self._tracks.clear()
+            self._observe_only = False
+            self._report(nodes)
+            return consts.HEALTH_REQUEUE_SECONDS
+
+        now = time.monotonic()
+        pods_by_node = await self._pods_by_node()
+        remediation_on = policy.spec.remediation.enabled
+
+        # -- detection: observe signals, run hysteresis per node ---------
+        for node in nodes:
+            name = node["metadata"]["name"]
+            track = self._tracks.setdefault(name, _Track())
+            self._observe(node, pods_by_node.get(name, []), track, spec, now)
+            self._hysteresis(name, track, spec, now)
+        # nodes that left the cluster must not pin budget accounting
+        live_names = {n["metadata"]["name"] for n in nodes}
+        for gone in set(self._tracks) - live_names:
+            del self._tracks[gone]
+
+        # -- disruption budget -------------------------------------------
+        budget = parse_budget(spec.max_unhealthy_percent, len(nodes))
+        unhealthy = sum(1 for t in self._tracks.values() if t.tripped)
+        exhausted = unhealthy > budget
+        if exhausted and not self._observe_only:
+            self._observe_only = True
+            log.warning(
+                "health budget exhausted (%d unhealthy > budget %d of %d "
+                "nodes): observe-only", unhealthy, budget, len(nodes),
+            )
+            await self.recorder.warning(
+                obs_events.namespace_ref(self.namespace),
+                obs_events.REASON_HEALTH_BUDGET_EXHAUSTED,
+                f"{unhealthy} nodes unhealthy exceeds disruption budget "
+                f"{budget} ({spec.max_unhealthy_percent} of {len(nodes)}); "
+                "auto-remediation suspended, observing only",
+            )
+        elif not exhausted and self._observe_only:
+            self._observe_only = False
+            log.info("health budget restored (%d <= %d): actuation resumes",
+                     unhealthy, budget)
+            await self.recorder.normal(
+                obs_events.namespace_ref(self.namespace),
+                obs_events.REASON_HEALTH_BUDGET_RESTORED,
+                f"unhealthy nodes back within budget ({unhealthy} <= {budget}); "
+                "auto-remediation resumed",
+            )
+
+        # -- release, then actuate -----------------------------------------
+        # Releases run FIRST so a recovered node frees its ladder slot
+        # before any new escalation claims one: the concurrent-actuation
+        # ceiling holds even mid-pass.
+        released: set[str] = set()
+        for node in nodes:
+            name = node["metadata"]["name"]
+            track = self._tracks[name]
+            if track.tripped:
+                continue
+            if now - track.born < spec.clean_seconds:
+                # a freshly-(re)started engine has no observation history:
+                # escalations persisted on the Node (quarantine cordons
+                # included) are released only after the node has been
+                # OBSERVED clean for a full clean interval — never off the
+                # absence of evidence
+                continue
+            try:
+                if await self._maybe_release(node, track):
+                    released.add(name)
+            except ApiError as e:
+                log.error("health release on %s failed: %s", name, e)
+        # nodes with an escalation annotation hold a budget slot; entry is
+        # hard-gated on len(on_ladder) < budget — "zero actuation beyond
+        # the budget" is enforced by construction, not by the observe-only
+        # flip alone
+        on_ladder = {
+            n["metadata"]["name"] for n in nodes
+            if n["metadata"]["name"] not in released and self._escalation(n)
+        }
+        for node in nodes:
+            name = node["metadata"]["name"]
+            track = self._tracks[name]
+            if not track.tripped:
+                continue
+            try:
+                await self._actuate(
+                    node, track, spec, remediation_on, on_ladder, budget
+                )
+            except ApiError as e:
+                # per-node isolation: one node's apiserver hiccup must not
+                # stall detection/actuation for the rest of the fleet
+                log.error("health actuation on %s failed: %s", name, e)
+
+        await self._sync_slice_peers(nodes)
+        self._report(nodes)
+        return self._requeue_after(spec)
+
+    @staticmethod
+    def _requeue_after(spec: HealthSpec) -> float:
+        """Observations only happen during passes, so the engine must
+        sample at least twice per sustained re-assert interval
+        (window/threshold) — a requeue slower than the cadence could never
+        accumulate enough observations to trip a stuck-bad signal, and
+        event kicks alone cannot be relied on in a quiet cluster."""
+        reassert = spec.window_seconds / max(1, spec.failure_threshold)
+        return min(consts.HEALTH_REQUEUE_SECONDS, max(0.5, reassert / 2))
+
+    # ------------------------------------------------------------------
+    # Signal plane: one pass of observations for a node.
+
+    def _observe(
+        self, node: dict, pods: list[dict], track: _Track, spec: HealthSpec,
+        now: float,
+    ) -> None:
+        reasons: list[str] = []
+        # sustained signals re-observe at window/threshold cadence so a
+        # stuck-bad signal trips within one full window; discrete events
+        # (flaps, crashes) are observed exactly once per occurrence
+        reassert = spec.window_seconds / max(1, spec.failure_threshold)
+
+        def observe(reason: str, sustained: bool = False) -> None:
+            reasons.append(reason)
+            if sustained and now - track.last_seen.get(reason, -1e9) < reassert:
+                return
+            track.last_seen[reason] = now
+            track.window.append((now, reason))
+
+        # agent-published verdict (signal plane's node-local half): each
+        # ok→unhealthy transition is a discrete observation; a verdict
+        # STUCK unhealthy re-observes at the sustained cadence
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        agent_bad = labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY
+        if agent_bad:
+            agent_reason = (
+                deep_get(node, "metadata", "annotations", default={}) or {}
+            ).get(consts.TPU_HEALTH_REASON_ANNOTATION) or "unspecified"
+            observe(f"agent:{agent_reason}", sustained=track.last_agent_bad)
+        track.last_agent_bad = agent_bad
+
+        # Node Ready condition: the False *state* is sustained-bad; each
+        # True->False transition is additionally a discrete flap event
+        ready = self._node_ready(node)
+        if ready is False:
+            if track.last_ready is not False:
+                observe(SIGNAL_NOT_READY)            # the flap edge
+                track.last_seen[SIGNAL_NOT_READY] = now
+            else:
+                observe(SIGNAL_NOT_READY, sustained=True)
+        track.last_ready = ready
+
+        # validator crash-loops / runtime restart storms: phase Failed
+        # transitions and restartCount deltas, both discrete.  Bookkeeping
+        # is pruned to the pods that exist THIS pass: DS recreations mint
+        # fresh pod names every cycle, and dead entries would otherwise
+        # accumulate for the operator's lifetime
+        live_pods = {p["metadata"]["name"] for p in pods}
+        for stale in set(track.phases) - live_pods:
+            track.phases.pop(stale, None)
+            track.restarts.pop(stale, None)
+        for pod in pods:
+            meta = pod["metadata"]
+            pod_labels = meta.get("labels") or {}
+            if pod_labels.get("app") == "tpu-operator-validator":
+                signal = SIGNAL_VALIDATOR_CRASHLOOP
+            elif pod_labels.get("app") == "tpu-runtime":
+                signal = SIGNAL_RUNTIME_RESTARTS
+            else:
+                continue
+            pname = meta["name"]
+            phase = deep_get(pod, "status", "phase")
+            restarts = deep_get(
+                pod, "status", "containerStatuses", 0, "restartCount", default=0
+            )
+            crashed = (
+                phase == "Failed" and track.phases.get(pname) != "Failed"
+            ) or restarts > track.restarts.get(pname, restarts)
+            track.phases[pname] = phase
+            track.restarts[pname] = restarts
+            if crashed:
+                observe(signal)
+        track.reasons = sorted(set(reasons))
+
+    @staticmethod
+    def _node_ready(node: dict) -> Optional[bool]:
+        for cond in deep_get(node, "status", "conditions", default=[]) or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return None
+
+    async def _pods_by_node(self) -> dict[str, list[dict]]:
+        """Operand pods grouped by node, one LIST per selector per pass
+        (never per node — O(2) requests on a 500-node fleet)."""
+        out: dict[str, list[dict]] = {}
+        for selector in (VALIDATOR_POD_SELECTOR, RUNTIME_POD_SELECTOR):
+            for pod in await self.reader.list_items(
+                "", "Pod", self.namespace, label_selector=selector
+            ):
+                node = deep_get(pod, "spec", "nodeName")
+                if node:
+                    out.setdefault(node, []).append(pod)
+        return out
+
+    # ------------------------------------------------------------------
+    # Detection plane: hysteresis + flap bookkeeping.
+
+    def _hysteresis(
+        self, name: str, track: _Track, spec: HealthSpec, now: float
+    ) -> None:
+        while track.window and track.window[0][0] < now - spec.window_seconds:
+            track.window.popleft()
+        while track.trips and track.trips[0] < now - spec.flap_window_seconds:
+            track.trips.popleft()
+        if not track.tripped:
+            if len(track.window) >= spec.failure_threshold:
+                track.tripped = True
+                track.trips.append(now)
+                self.metrics.health_trips_total.inc()
+                log.warning(
+                    "node %s tripped unhealthy (%d signals in %ds window: %s)",
+                    name, len(track.window), spec.window_seconds,
+                    ", ".join(sorted({r for _, r in track.window})),
+                )
+        else:
+            last_signal = track.window[-1][0] if track.window else -1e9
+            clean = (
+                not track.reasons
+                and now - last_signal >= spec.clean_seconds
+            )
+            if clean:
+                track.tripped = False
+                # a fresh episode starts from zero evidence: the old
+                # window must not instantly re-trip a recovered node
+                track.window.clear()
+                track.last_seen.clear()
+                log.info("node %s clean for %ss: untripped",
+                         name, spec.clean_seconds)
+
+    def _flapping(self, track: _Track, spec: HealthSpec) -> bool:
+        return len(track.trips) >= spec.flap_max_trips
+
+    # ------------------------------------------------------------------
+    # Actuation plane: the escalation ladder under the budget.
+
+    def _engine_state(self, node: dict) -> str:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return labels.get(consts.HEALTH_STATE_LABEL, "")
+
+    def _escalation(self, node: dict) -> str:
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+        return anns.get(consts.HEALTH_ESCALATION_ANNOTATION, "")
+
+    def _escalation_age(self, node: dict) -> float:
+        return nodestate.state_age(node, consts.HEALTH_ESCALATION_TS_ANNOTATION)
+
+    def _upgrade_owns(self, node: dict) -> bool:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return labels.get(consts.UPGRADE_STATE_LABEL, "") in UPGRADE_NON_TERMINAL
+
+    async def _actuate(
+        self, node: dict, track: _Track, spec: HealthSpec,
+        remediation_on: bool, on_ladder: set, budget: int,
+    ) -> None:
+        name = node["metadata"]["name"]
+        step = self._escalation(node)
+
+        if self._upgrade_owns(node):
+            # the upgrade machine owns this node's cordon and pods right
+            # now; mark the verdict, actuate once it reaches a terminal
+            # state (remediation-controller deference, identically)
+            await self._mark_state(node, consts.HEALTH_TRIPPED, track)
+            return
+        if not step and (self._observe_only or len(on_ladder) >= budget):
+            # budget gate: nodes not yet on the ladder are observed, never
+            # actuated
+            self.metrics.health_actuations_denied_total.inc()
+            await self._mark_state(node, consts.HEALTH_OBSERVE, track)
+            return
+        if step and self._observe_only:
+            # fail static: nodes mid-ladder park on their current rung —
+            # a lying fleet-wide signal must not march nodes into
+            # quarantine while the engine cannot trust its inputs
+            return
+
+        # a node parked on the quarantine rung keeps its quarantined label;
+        # everything else on the ladder reads tripped
+        await self._mark_state(
+            node,
+            consts.HEALTH_QUARANTINED if step == STEP_QUARANTINE
+            else consts.HEALTH_TRIPPED,
+            track,
+        )
+
+        if not step:
+            on_ladder.add(name)
+            # flap suppression: a node that keeps tripping goes straight to
+            # quarantine — walking it through remediate/recover again is
+            # exactly the oscillation the engine exists to prevent
+            if self._flapping(track, spec):
+                await self._enter_quarantine(node, flapping=True)
+            elif remediation_on:
+                await self._enter_remediate(name)
+            else:
+                await self._enter_restart_runtime(name)
+            return
+
+        if step == STEP_REMEDIATE:
+            if await self._remediation_busy(node):
+                return  # the remediation machine is working; let it finish
+            if self._escalation_age(node) >= spec.escalation_backoff_seconds:
+                await self._enter_restart_runtime(name)
+        elif step == STEP_RESTART_RUNTIME:
+            if self._escalation_age(node) >= spec.escalation_backoff_seconds:
+                await self._enter_quarantine(node)
+        # STEP_QUARANTINE is terminal while tripped; release handles exit
+
+    async def _remediation_busy(self, node: dict) -> bool:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return (
+            labels.get(consts.VALIDATE_REQUEST_LABEL) == REMEDIATION_REQUESTED
+            or labels.get(consts.REMEDIATION_STATE_LABEL) == REMEDIATION_REVALIDATING
+        )
+
+    async def _mark_state(self, node: dict, state: str, track: _Track) -> None:
+        if self._engine_state(node) == state:
+            return
+        name = node["metadata"]["name"]
+        reasons = ", ".join(track.reasons) or "signals cleared"
+        await self.reader.patch(
+            "", "Node", name,
+            {"metadata": {
+                "labels": {consts.HEALTH_STATE_LABEL: state},
+            }},
+        )
+        if state in (consts.HEALTH_TRIPPED, consts.HEALTH_OBSERVE):
+            await self.recorder.warning(
+                obs_events.node_ref(name), obs_events.REASON_NODE_UNHEALTHY,
+                f"{name} unhealthy ({reasons})"
+                + ("; budget exhausted, observing only"
+                   if state == consts.HEALTH_OBSERVE else ""),
+            )
+
+    async def _set_step(self, node_name: str, step: str) -> None:
+        await self.reader.patch(
+            "", "Node", node_name,
+            {"metadata": {"annotations": {
+                consts.HEALTH_ESCALATION_ANNOTATION: step,
+                consts.HEALTH_ESCALATION_TS_ANNOTATION: nodestate.now_ts(),
+            }}},
+        )
+        self.metrics.health_actuations_total.labels(action=step).inc()
+
+    async def _enter_remediate(self, node_name: str) -> None:
+        """Rung 1: hand the node to the existing remediation machine — the
+        same channel an admin (or alert automation) uses, so its
+        parallelism bound, upgrade deference, and cordon etiquette all
+        apply unchanged."""
+        await self._set_step(node_name, STEP_REMEDIATE)
+        await self.reader.patch(
+            "", "Node", node_name,
+            {"metadata": {"labels": {
+                consts.VALIDATE_REQUEST_LABEL: REMEDIATION_REQUESTED,
+            }}},
+        )
+        log.warning("health: injected re-validation request on %s", node_name)
+
+    async def _enter_restart_runtime(self, node_name: str) -> None:
+        """Rung 2: delete the node's OnDelete runtime-DS pod — the
+        runtime-manager init chain re-prepares the chips on recreate (the
+        lightest intervention that touches the runtime itself)."""
+        await self._set_step(node_name, STEP_RESTART_RUNTIME)
+        for pod in await self.client.list_items(
+            "", "Pod", self.namespace,
+            label_selector=RUNTIME_POD_SELECTOR,
+            field_selector=f"spec.nodeName={node_name}",
+        ):
+            await self.reader.delete(
+                "", "Pod", pod["metadata"]["name"], self.namespace
+            )
+            log.warning(
+                "health: restarted runtime pod %s on %s",
+                pod["metadata"]["name"], node_name,
+            )
+
+    async def _enter_quarantine(self, node: dict, flapping: bool = False) -> None:
+        """Rung 3: take the node out of scheduling — cordon plus NoSchedule
+        taint (the taint survives an admin uncordon; both are marked ours
+        and released only by a clean recovery)."""
+        name = node["metadata"]["name"]
+        await self._set_step(name, STEP_QUARANTINE)
+        anns = {consts.HEALTH_CORDONED_ANNOTATION: "true"}
+        taints = [
+            t for t in (deep_get(node, "spec", "taints") or [])
+            if t.get("key") != consts.HEALTH_TAINT_KEY
+        ] + [{
+            "key": consts.HEALTH_TAINT_KEY,
+            "value": consts.HEALTH_UNHEALTHY,
+            "effect": "NoSchedule",
+        }]
+        await self.reader.patch(
+            "", "Node", name,
+            {
+                "spec": {"unschedulable": True, "taints": taints},
+                "metadata": {
+                    "labels": {consts.HEALTH_STATE_LABEL: consts.HEALTH_QUARANTINED},
+                    "annotations": anns,
+                },
+            },
+        )
+        await self.recorder.warning(
+            obs_events.node_ref(name), obs_events.REASON_NODE_QUARANTINED,
+            f"{name} quarantined (cordon + taint): "
+            + ("flapping past suppression threshold"
+               if flapping else "escalation ladder exhausted"),
+        )
+        log.error("health: quarantined %s%s", name,
+                  " (flap suppression)" if flapping else "")
+
+    # ------------------------------------------------------------------
+    # Recovery.
+
+    async def _maybe_release(self, node: dict, track: _Track) -> bool:
+        if self._engine_state(node) in ("", consts.HEALTH_SLICE_DEGRADED) \
+                and not self._escalation(node):
+            return False
+        await self._release(node, reason="sustained clean")
+        await self.recorder.normal(
+            obs_events.node_ref(node["metadata"]["name"]),
+            obs_events.REASON_NODE_RECOVERED,
+            f"{node['metadata']['name']} healthy again; "
+            "quarantine/escalation released",
+        )
+        return True
+
+    async def _release(self, node: dict, reason: str) -> None:
+        """Undo everything the engine did to a node: taint, our cordon (an
+        admin's own cordon is never undone), escalation bookkeeping, state
+        label.  The injected remediation request is left to the remediation
+        machine — yanking the label mid-revalidation would strand it."""
+        name = node["metadata"]["name"]
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+        patch: dict = {
+            "metadata": {
+                "labels": {consts.HEALTH_STATE_LABEL: None},
+                "annotations": {
+                    consts.HEALTH_ESCALATION_ANNOTATION: None,
+                    consts.HEALTH_ESCALATION_TS_ANNOTATION: None,
+                    consts.HEALTH_CORDONED_ANNOTATION: None,
+                    consts.HEALTH_DEGRADED_BY_ANNOTATION: None,
+                },
+            },
+        }
+        taints = deep_get(node, "spec", "taints") or []
+        kept = [t for t in taints if t.get("key") != consts.HEALTH_TAINT_KEY]
+        spec_patch: dict = {}
+        if len(kept) != len(taints):
+            spec_patch["taints"] = kept or None
+        if anns.get(consts.HEALTH_CORDONED_ANNOTATION) == "true":
+            spec_patch["unschedulable"] = None
+        if spec_patch:
+            patch["spec"] = spec_patch
+        await self.reader.patch("", "Node", name, patch)
+        log.info("health: released %s (%s)", name, reason)
+
+    # ------------------------------------------------------------------
+    # Slice semantics.
+
+    async def _sync_slice_peers(self, nodes: list[dict]) -> None:
+        """One unhealthy host degrades the whole multi-host slice: peers
+        get the ``slice-degraded`` state label (schedulers/operators can
+        see the slice is broken as a unit) but are NEVER cordoned — their
+        hardware is fine, and evicting them cannot fix the sick host."""
+        by_pool: dict[str, list[dict]] = {}
+        for node in nodes:
+            attrs = nodeinfo.attributes(node)
+            if attrs.slice_hosts > 1 and attrs.nodepool:
+                by_pool.setdefault(attrs.nodepool, []).append(node)
+        for pool, members in by_pool.items():
+            sick = sorted(
+                n["metadata"]["name"] for n in members
+                if self._tracks.get(n["metadata"]["name"], _Track()).tripped
+            )
+            for node in members:
+                name = node["metadata"]["name"]
+                state = self._engine_state(node)
+                try:
+                    if sick and name not in sick:
+                        if state == "":
+                            await self.reader.patch(
+                                "", "Node", name,
+                                {"metadata": {
+                                    "labels": {
+                                        consts.HEALTH_STATE_LABEL:
+                                            consts.HEALTH_SLICE_DEGRADED,
+                                    },
+                                    "annotations": {
+                                        consts.HEALTH_DEGRADED_BY_ANNOTATION:
+                                            ",".join(sick),
+                                    },
+                                }},
+                            )
+                    elif state == consts.HEALTH_SLICE_DEGRADED and not sick:
+                        await self.reader.patch(
+                            "", "Node", name,
+                            {"metadata": {
+                                "labels": {consts.HEALTH_STATE_LABEL: None},
+                                "annotations": {
+                                    consts.HEALTH_DEGRADED_BY_ANNOTATION: None,
+                                },
+                            }},
+                        )
+                except ApiError as e:
+                    # per-node isolation, same as the actuate/release loops
+                    log.error("slice-peer mark on %s failed: %s", name, e)
+
+    # ------------------------------------------------------------------
+    def _report(self, nodes: list[dict]) -> None:
+        self.metrics.health_unhealthy_nodes.set(
+            sum(1 for t in self._tracks.values() if t.tripped)
+        )
+        self.metrics.health_degraded_nodes.set(
+            sum(
+                1 for n in nodes
+                if self._engine_state(n) == consts.HEALTH_SLICE_DEGRADED
+            )
+        )
+        self.metrics.health_observe_only.set(1 if self._observe_only else 0)
+
+    async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
+        obj = await clusterinfo.active_cluster_policy(self.reader)
+        return TPUClusterPolicy(obj) if obj else None
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(Controller("health", self.reconcile))
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+        # optional (cache-backing only): an unsynced Pod informer must not
+        # block manager start — pod reads fall back live until it syncs
+        pods = mgr.informer("", "Pod", namespace=self.namespace, required=False)
+        for inf in (policies, nodes, pods):
+            self.reader.add_informer(inf)
+
+        async def kick(event_type: str, obj: dict) -> None:
+            controller.enqueue(RECONCILE_KEY)
+
+        policies.add_handler(kick)
+        nodes.add_handler(kick)
+        return controller

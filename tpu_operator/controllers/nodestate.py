@@ -1,0 +1,77 @@
+"""Shared per-node state-machine plumbing for the node controllers.
+
+The upgrade, remediation, and health controllers all drive per-node state
+machines the same way: a state label plus a timestamp annotation recording
+when the node entered that state (the timestamps survive operator restarts
+and drive the machines' timeouts).  The parsing/age helpers lived in
+``controllers/upgrade.py`` and were imported privately by the remediation
+controller; they are promoted here so all three machines share one
+implementation.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from tpu_operator.k8s.client import ApiClient
+from tpu_operator.utils import deep_get
+
+# the wire format _set_state writes; parse_ts also accepts the second-only
+# variant some tooling (kubectl annotate, older rounds) leaves behind
+TS_FORMAT = "%Y-%m-%dT%H:%M:%S.%fZ"
+_TS_FORMATS = (TS_FORMAT, "%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_ts(ts: str) -> Optional[datetime.datetime]:
+    """State-timestamp annotation → aware UTC datetime, None when malformed."""
+    for fmt in _TS_FORMATS:
+        try:
+            return datetime.datetime.strptime(ts, fmt).replace(
+                tzinfo=datetime.timezone.utc
+            )
+        except ValueError:
+            continue
+    return None
+
+
+def now_ts() -> str:
+    """The timestamp format every state annotation carries."""
+    return datetime.datetime.now(datetime.timezone.utc).strftime(TS_FORMAT)
+
+
+def state_age(node: dict, ts_annotation: str) -> float:
+    """Seconds since the node entered its current state per ``ts_annotation``
+    (0.0 when the annotation is absent or malformed — a machine must never
+    time a node out off a timestamp it cannot read)."""
+    ts = deep_get(node, "metadata", "annotations", default={}).get(ts_annotation)
+    entered = parse_ts(ts) if ts else None
+    if entered is None:
+        return 0.0
+    return (
+        datetime.datetime.now(datetime.timezone.utc) - entered
+    ).total_seconds()
+
+
+async def patch_state(
+    client: ApiClient,
+    node_name: str,
+    label: str,
+    state: Optional[str],
+    ts_annotation: str,
+    extra_labels: Optional[dict] = None,
+    extra_annotations: Optional[dict] = None,
+) -> None:
+    """Write a state-label transition: the label and its entry timestamp move
+    atomically in one PATCH (a state without a timestamp would age as 0.0
+    forever; a timestamp without the state would be orphaned metadata).
+    ``state=None`` clears both."""
+    labels = {label: state, **(extra_labels or {})}
+    annotations = {
+        ts_annotation: now_ts() if state is not None else None,
+        **(extra_annotations or {}),
+    }
+    await client.patch(
+        "", "Node", node_name,
+        {"metadata": {"labels": labels, "annotations": annotations}},
+    )

@@ -39,18 +39,16 @@ and the controller drives the per-node machine on
 
 from __future__ import annotations
 
-import datetime
 import logging
 from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
-from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers import clusterinfo, nodestate
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.controllers.upgrade import (
     NON_TERMINAL_STATES as UPGRADE_NON_TERMINAL,
     VALIDATOR_POD_SELECTOR,
-    _parse_ts,
 )
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
@@ -222,30 +220,13 @@ class RemediationReconciler:
         return anns.get(consts.REMEDIATION_CORDONED_ANNOTATION) == "true"
 
     def _state_age(self, node: dict) -> float:
-        ts = deep_get(node, "metadata", "annotations", default={}).get(
-            consts.REMEDIATION_STATE_TS_ANNOTATION
-        )
-        entered = _parse_ts(ts) if ts else None
-        if entered is None:
-            return 0.0
-        return (
-            datetime.datetime.now(datetime.timezone.utc) - entered
-        ).total_seconds()
+        return nodestate.state_age(node, consts.REMEDIATION_STATE_TS_ANNOTATION)
 
     async def _set_state(self, node_name: str, state: Optional[str]) -> None:
-        ts = (
-            datetime.datetime.now(datetime.timezone.utc).strftime(
-                "%Y-%m-%dT%H:%M:%S.%fZ"
-            )
-            if state is not None
-            else None
-        )
-        await self.client.patch(
-            "", "Node", node_name,
-            {"metadata": {
-                "labels": {consts.REMEDIATION_STATE_LABEL: state},
-                "annotations": {consts.REMEDIATION_STATE_TS_ANNOTATION: ts},
-            }},
+        await nodestate.patch_state(
+            self.client, node_name,
+            consts.REMEDIATION_STATE_LABEL, state,
+            consts.REMEDIATION_STATE_TS_ANNOTATION,
         )
         # state transitions all funnel through here -> one Event emission point
         ref = obs_events.node_ref(node_name)

@@ -24,13 +24,12 @@ reflects back into the label the controller validates against.
 
 from __future__ import annotations
 
-import datetime
 import logging
 from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy  # noqa: F401 (GROUP/KIND used in setup watches)
-from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers import clusterinfo, nodestate
 from tpu_operator.controllers.labels import node_advertises_tpu
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s import nodeinfo
@@ -63,13 +62,9 @@ RECONCILE_KEY = "upgrade"
 VALIDATOR_POD_SELECTOR = "app=tpu-operator-validator"
 
 
-def _parse_ts(ts: str) -> Optional[datetime.datetime]:
-    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
-        try:
-            return datetime.datetime.strptime(ts, fmt).replace(tzinfo=datetime.timezone.utc)
-        except ValueError:
-            continue
-    return None
+# promoted to controllers/nodestate.py (shared with remediation + health);
+# the alias keeps the historical private import path working
+_parse_ts = nodestate.parse_ts
 
 
 def parse_max_unavailable(value: Optional[str], total: int) -> int:
@@ -124,14 +119,20 @@ class UpgradeReconciler:
         # Mark out-of-date nodes (BuildState analogue).  DONE nodes become
         # eligible again when a NEW version is pinned (v2 done, v3 pinned →
         # re-required); FAILED stays sticky until operator intervention,
-        # matching the reference machine's failed-state semantics.
+        # matching the reference machine's failed-state semantics.  Each
+        # node's patch is isolated: one mid-loop ApiError must not abort the
+        # whole pass for every node behind it.
         for node in nodes:
             name = node["metadata"]["name"]
             if states[name] and states[name] != DONE:
                 continue
             current = nodeinfo.attributes(node).runtime_version
             if desired and current and current != desired:
-                await self._set_state(name, REQUIRED)
+                try:
+                    await self._set_state(name, REQUIRED)
+                except ApiError as e:
+                    log.error("upgrade mark-required on %s failed: %s", name, e)
+                    continue
                 states[name] = REQUIRED
 
         in_progress = sum(1 for s in states.values() if s in IN_PROGRESS_STATES)
@@ -139,17 +140,25 @@ class UpgradeReconciler:
             1 for n in nodes
             if deep_get(n, "spec", "unschedulable") or not node_advertises_tpu(n)
         )
-        max_parallel = max(1, up.max_parallel_upgrades)
+        # maxParallelUpgrades: 0 = unbounded (the reference
+        # DriverUpgradePolicySpec semantics the schema's minimum:0 always
+        # promised); maxUnavailable remains the availability backstop
+        max_parallel = up.max_parallel_upgrades if up.max_parallel_upgrades > 0 else len(nodes)
         max_unavailable = parse_max_unavailable(up.max_unavailable, len(nodes))
 
-        # Admit required nodes into the pipeline within bounds (ApplyState).
+        # Admit required nodes into the pipeline within bounds (ApplyState);
+        # per-node failures skip the node, they do not starve the rest.
         for node in nodes:
             name = node["metadata"]["name"]
             if states[name] != REQUIRED:
                 continue
             if in_progress >= max_parallel or unavailable >= max_unavailable:
                 break
-            await self._set_state(name, CORDON)
+            try:
+                await self._set_state(name, CORDON)
+            except ApiError as e:
+                log.error("upgrade admission on %s failed: %s", name, e)
+                continue
             states[name] = CORDON
             in_progress += 1
             unavailable += 1
@@ -215,17 +224,9 @@ class UpgradeReconciler:
         return nodeinfo.attributes(node).upgrade_state
 
     async def _set_state(self, node_name: str, state: Optional[str]) -> None:
-        ts = (
-            datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
-            if state is not None
-            else None
-        )
-        await self.client.patch(
-            "", "Node", node_name,
-            {"metadata": {
-                "labels": {consts.UPGRADE_STATE_LABEL: state},
-                "annotations": {consts.UPGRADE_STATE_TS_ANNOTATION: ts},
-            }},
+        await nodestate.patch_state(
+            self.client, node_name,
+            consts.UPGRADE_STATE_LABEL, state, consts.UPGRADE_STATE_TS_ANNOTATION,
         )
         # milestone Events on the Node — every path into CORDON/DONE/FAILED
         # funnels through here, so this is the single emission point
@@ -251,13 +252,7 @@ class UpgradeReconciler:
 
     def _state_age(self, node: dict) -> float:
         """Seconds since the node entered its current upgrade state."""
-        ts = deep_get(node, "metadata", "annotations", default={}).get(
-            consts.UPGRADE_STATE_TS_ANNOTATION
-        )
-        entered = _parse_ts(ts) if ts else None
-        if entered is None:
-            return 0.0
-        return (datetime.datetime.now(datetime.timezone.utc) - entered).total_seconds()
+        return nodestate.state_age(node, consts.UPGRADE_STATE_TS_ANNOTATION)
 
     async def _drain_step(self, node: dict, up) -> bool:
         """One non-blocking drain pass: delete TPU workload pods that are not
@@ -279,6 +274,11 @@ class UpgradeReconciler:
             if not pod_requests_tpu(pod):
                 continue
             meta = pod["metadata"]
+            if (meta.get("labels") or {}).get(consts.SKIP_DRAIN_LABEL) == "true":
+                # pod-level opt-out: the workload manages its own lifecycle
+                # (e.g. checkpoints on the runtime pod's SIGTERM) — neither
+                # evicted nor allowed to block the drain
+                continue
             refs = meta.get("ownerReferences") or []
             if any(r.get("kind") == "DaemonSet" for r in refs):
                 # kubectl drain --ignore-daemonsets semantics: the DS would
@@ -291,7 +291,12 @@ class UpgradeReconciler:
                 continue
             remaining = True
             if not meta.get("deletionTimestamp"):
-                await self.client.delete("", "Pod", meta["name"], meta.get("namespace"))
+                # the workload gets the spec'd termination grace (None
+                # preserves the pod's own terminationGracePeriodSeconds)
+                await self.client.delete(
+                    "", "Pod", meta["name"], meta.get("namespace"),
+                    grace_period_seconds=up.drain.grace_period_seconds,
+                )
                 log.info("evicted TPU pod %s/%s", meta.get("namespace"), meta["name"])
         return not remaining
 

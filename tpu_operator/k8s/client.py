@@ -476,13 +476,21 @@ class ApiClient:
     async def delete(
         self, group: str, kind: str, name: str, namespace: Optional[str] = None,
         ignore_not_found: bool = True,
+        grace_period_seconds: Optional[int] = None,
     ) -> Optional[dict]:
         info = obj_api.lookup(group, kind)
         path = obj_api.resource_path(
             info.gvk.group, info.gvk.version, info.plural, info.namespaced, namespace, name
         )
+        # DeleteOptions subset: None keeps the object's own grace (the
+        # apiserver default); 0 is an immediate delete
+        params = (
+            {"gracePeriodSeconds": str(grace_period_seconds)}
+            if grace_period_seconds is not None
+            else None
+        )
         try:
-            return await self._request("DELETE", path)
+            return await self._request("DELETE", path, params=params)
         except ApiError as e:
             if e.not_found and ignore_not_found:
                 return None

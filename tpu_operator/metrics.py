@@ -86,6 +86,35 @@ class OperatorMetrics:
         self.auto_upgrade_enabled = g(
             "tpu_operator_runtime_auto_upgrade_enabled", "1 when auto-upgrade is on"
         )
+        # node health engine (controllers/health.py; docs/ROBUSTNESS.md)
+        self.health_unhealthy_nodes = g(
+            "tpu_operator_nodes_health_unhealthy",
+            "Nodes currently tripped by the health engine's hysteresis",
+        )
+        self.health_degraded_nodes = g(
+            "tpu_operator_nodes_health_degraded",
+            "Healthy nodes marked slice-degraded because a slice peer is unhealthy",
+        )
+        self.health_observe_only = g(
+            "tpu_operator_health_observe_only",
+            "1 while the disruption budget is exhausted and the engine "
+            "observes without actuating (alert: a fleet-wide signal source "
+            "is probably lying)",
+        )
+        self.health_trips_total = c(
+            "tpu_operator_health_trips_total",
+            "Nodes tripped unhealthy by the hysteresis detector",
+        )
+        self.health_actuations_total = Counter(
+            "tpu_operator_health_actuations_total",
+            "Escalation-ladder actions taken on tripped nodes",
+            ["action"],  # remediate | restart-runtime | quarantine
+            registry=self.registry,
+        )
+        self.health_actuations_denied_total = c(
+            "tpu_operator_health_actuations_denied_total",
+            "Actuations withheld because the disruption budget was exhausted",
+        )
         # duration Histograms, fed by the obs.trace span layer
         h = lambda name, doc, label: Histogram(  # noqa: E731
             name, doc, [label], registry=self.registry, buckets=DURATION_BUCKETS

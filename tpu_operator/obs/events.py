@@ -40,6 +40,12 @@ REASON_REMEDIATION_FAILED = "RemediationFailed"
 REASON_VALIDATION_FAILED = "ValidationFailed"
 REASON_SELECTOR_CONFLICT = "SelectorConflict"
 REASON_PERF_REGRESSED = "WorkloadPerfRegressed"
+# node health engine (controllers/health.py; docs/ROBUSTNESS.md)
+REASON_NODE_UNHEALTHY = "NodeUnhealthy"
+REASON_NODE_RECOVERED = "NodeRecovered"
+REASON_NODE_QUARANTINED = "NodeQuarantined"
+REASON_HEALTH_BUDGET_EXHAUSTED = "HealthBudgetExhausted"
+REASON_HEALTH_BUDGET_RESTORED = "HealthBudgetRestored"
 # resilience surface (docs/ROBUSTNESS.md): degraded mode + leadership
 REASON_DEGRADED = "DegradedMode"
 REASON_DEGRADED_RECOVERED = "DegradedModeRecovered"
@@ -155,8 +161,8 @@ class EventRecorder:
                     group, involved["kind"], meta["name"], meta.get("namespace")
                 )
                 uid = (live.get("metadata") or {}).get("uid", "")
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("could not resolve uid for event ref %s: %s", key, e)
         now = _now()
         ev = {
             "apiVersion": "v1",

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import math
 import os
 import threading
@@ -216,8 +217,10 @@ class FlightRecorder:
             with open(self.path, "a") as f:
                 f.write("".join(lines))
             self._persisted = len(self.samples)
-        except Exception:  # noqa: BLE001 — telemetry must never fail the workload
-            pass
+        except Exception as e:  # noqa: BLE001 — telemetry must never fail the workload
+            logging.getLogger("tpu_operator.obs.flight").debug(
+                "flight flush failed: %s", e
+            )
 
     def close(self) -> None:
         self.flush()

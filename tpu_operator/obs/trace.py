@@ -21,6 +21,7 @@ owning task.
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 import time
 import uuid
@@ -216,8 +217,10 @@ class Tracer:
                 m.workload_phase_duration.labels(
                     phase=sp.attrs.get("phase", "")
                 ).observe(sp.duration_s)
-        except Exception:  # noqa: BLE001 — timing is evidence, not control flow
-            pass
+        except Exception as e:  # noqa: BLE001 — timing is evidence, not control flow
+            logging.getLogger("tpu_operator.obs.trace").debug(
+                "span metric emission failed: %s", e
+            )
 
 
 @contextlib.contextmanager

@@ -31,10 +31,15 @@ class ClusterContext:
     openshift: bool = False
 
 
-# Default toleration: GKE TPU node pools carry the google.com/tpu taint.
+# Default tolerations: GKE TPU node pools carry the google.com/tpu taint,
+# and operand pods must keep running on health-quarantined nodes — the
+# recovery proof (validator re-run, agent verdicts) comes from exactly the
+# pods the quarantine taint would otherwise evict on reschedule
+# (docs/ROBUSTNESS.md "Node health engine").
 _DEFAULT_TOLERATIONS = [
     {"key": consts.TPU_RESOURCE, "operator": "Exists", "effect": "NoSchedule"},
     {"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"},
+    {"key": consts.HEALTH_TAINT_KEY, "operator": "Exists", "effect": "NoSchedule"},
 ]
 
 
@@ -247,7 +252,10 @@ STATE_DEFS: list[StateDef] = [
     StateDef("state-metrics-exporter", lambda s: s.metrics_exporter, "metrics-exporter", _metrics_exporter_extras),
     StateDef("tpu-feature-discovery", lambda s: s.feature_discovery, "feature-discovery", _feature_discovery_extras),
     StateDef("state-slice-manager", lambda s: s.slice_manager, "slice-manager", _slice_manager_extras),
-    StateDef("state-node-status-exporter", lambda s: s.node_status_exporter, "node-status-exporter"),
+    StateDef(
+        "state-node-status-exporter", lambda s: s.node_status_exporter,
+        "node-status-exporter", _metrics_agent_extras,
+    ),
     StateDef("state-sandbox-validation", lambda s: s.validator, "validator"),
     StateDef("state-vfio-manager", lambda s: s.vfio_manager, "vfio-manager"),
     StateDef("state-vm-runtime", lambda s: s.vm_runtime, "vm-runtime", _vm_runtime_extras),

@@ -70,6 +70,13 @@ class ChaosConfig:
     pod_restart_after_s: float = 0.0 # 0 = stay Failed (deterministic tests)
     node_flap_interval: float = 0.0  # seconds between NotReady flaps (0 = off)
     node_flap_down_s: float = 0.5
+    # agent-verdict faults: every interval one random node's (simulated)
+    # node-status-exporter publishes tpu-health=unhealthy with the reason
+    # code below, recovering to ok after down_s — the signal-plane input
+    # the health engine's hysteresis must judge (chip scrape failures etc.)
+    agent_unhealthy_interval: float = 0.0  # 0 = off
+    agent_unhealthy_down_s: float = 3.0
+    agent_unhealthy_reason: str = "chip-scrape-failed"
 
 
 class ChaosEngine:

@@ -333,6 +333,13 @@ class FakeCluster:
         self._flapped_node: Optional[tuple[str, float]] = None
         self._last_flap_at = 0.0
         self._crash_restarts: dict[tuple[str, str], float] = {}
+        # agent-verdict faults: node name -> restore deadline (overlapping
+        # episodes allowed — that is what exhausts a health budget)
+        self._unhealthy_nodes: dict[str, float] = {}
+        self._last_agent_fault_at = 0.0
+        # DELETE options observed per object: (plural, ns, name, grace) —
+        # lets tests assert drain grace propagation without a real kubelet
+        self.delete_options: list[tuple[str, str, str, Optional[str]]] = []
 
     def reset_request_counts(self) -> None:
         self.request_counts = {}
@@ -643,6 +650,10 @@ class FakeCluster:
             body = await request.json()
             return web.json_response(store.patch(namespace, name, body, status_only=status_only))
         if request.method == "DELETE":
+            self.delete_options.append((
+                store.info.plural, namespace or "", name,
+                request.rel_url.query.get("gracePeriodSeconds"),
+            ))
             return web.json_response(store.delete(namespace, name))
         raise ApiException(405, "MethodNotAllowed", request.method)
 
@@ -707,6 +718,7 @@ class FakeCluster:
                 now = time.monotonic()
                 self._chaos_crashloops(now)
                 self._chaos_node_flap(now)
+                self._chaos_agent_health(now)
             except Exception:  # noqa: BLE001
                 log.exception("chaos actor error")
             await asyncio.sleep(self.sim.tick)
@@ -761,6 +773,54 @@ class FakeCluster:
         self.chaos._count("node_flap")
         self._flapped_node = (name, now + cfg.node_flap_down_s)
         self._last_flap_at = now
+
+    def _chaos_agent_health(self, now: float) -> None:
+        """Every ``agent_unhealthy_interval`` seconds one random node's
+        simulated node-status-exporter publishes an ``unhealthy`` verdict
+        on the tpu-health label (reason code attached), recovering to
+        ``ok`` after ``agent_unhealthy_down_s``.  Episodes OVERLAP — many
+        simultaneous verdicts are exactly how a lying signal source
+        exhausts the health engine's disruption budget."""
+        cfg = self.chaos.config
+        if not cfg.agent_unhealthy_interval:
+            return
+        for name, restore_at in list(self._unhealthy_nodes.items()):
+            if now >= restore_at:
+                del self._unhealthy_nodes[name]
+                self.set_agent_health(name, consts.HEALTH_OK)
+        if not self.chaos.active:
+            return
+        if now - self._last_agent_fault_at < cfg.agent_unhealthy_interval:
+            return
+        node_store = self.store("", "nodes")
+        names = sorted(n for (_, n) in node_store.objects)
+        if not names:
+            return
+        name = self.chaos.rng.choice(names)
+        self.set_agent_health(
+            name, consts.HEALTH_UNHEALTHY, cfg.agent_unhealthy_reason
+        )
+        self.chaos._count("agent_unhealthy")
+        self._unhealthy_nodes[name] = now + cfg.agent_unhealthy_down_s
+        self._last_agent_fault_at = now
+
+    def set_agent_health(
+        self, name: str, verdict: str, reason: str = ""
+    ) -> None:
+        """Directly publish a node's tpu-health verdict label, the way its
+        node-status-exporter would (test/soak driver)."""
+        node_store = self.store("", "nodes")
+        try:
+            node_store.patch(None, name, {
+                "metadata": {
+                    "labels": {consts.TPU_HEALTH_LABEL: verdict},
+                    "annotations": {
+                        consts.TPU_HEALTH_REASON_ANNOTATION: reason or None,
+                    },
+                },
+            })
+        except ApiException:
+            pass
 
     def _set_node_ready(self, node_store: Store, name: str, ready: bool) -> None:
         try:
