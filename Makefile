@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health lint counters-docs async-lint except-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health fleet-obs lint counters-docs async-lint except-lint metric-labels all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
 # the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint unit-test chaos chaos-health
+test: lint counters-docs async-lint except-lint metric-labels unit-test chaos chaos-health fleet-obs
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -19,6 +19,12 @@ counters-docs:
 # reconcile pipeline packages (docs/PERFORMANCE.md)
 async-lint:
 	$(PYTHON) hack/check_async_blocking.py
+
+# no unbounded label values (pod uid, node at fleet scale, timestamps) on
+# prometheus_client registrations in tpu_operator/ — per-entity series
+# belong in the fleet aggregator's rings (docs/OBSERVABILITY.md)
+metric-labels:
+	$(PYTHON) hack/check_metric_labels.py
 
 # no silent `except Exception: pass` under k8s/ and controllers/ — broad
 # swallows hide the failure taxonomy (docs/ROBUSTNESS.md)
@@ -89,6 +95,16 @@ chaos:
 # signal source lies (docs/ROBUSTNESS.md "Node health engine")
 chaos-health:
 	$(PYTHON) bench.py --chaos-health --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
+# cluster under seeded node flaps; injected gated-metric regression must
+# fire SLOBurnRate inside the evaluation window and SLORecovered after the
+# fault clears, /debug/fleet percentiles must match ground truth, the
+# controller saturation gauges must move under load and return to idle,
+# and aggregation must add ZERO steady-state API verbs per reconcile pass
+# (docs/OBSERVABILITY.md "Fleet telemetry & SLOs")
+fleet-obs:
+	$(PYTHON) bench.py --fleet-obs --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # single image for operator + operands (docker/Dockerfile)
 image:

@@ -688,6 +688,351 @@ def run_chaos_health_soak(n_nodes: int = 100, seed: int = 1) -> dict:
     return result
 
 
+FLEET_OBS_TIMEOUT = 300.0
+
+
+def _gauge_value(metrics, family: str, **labels) -> float:
+    """Current value of one gauge sample from an OperatorMetrics registry."""
+    for fam in metrics.registry.collect():
+        if fam.name == family:
+            for s in fam.samples:
+                if s.name == family and all(
+                    s.labels.get(k) == v for k, v in labels.items()
+                ):
+                    return s.value
+    return 0.0
+
+
+def _hist_count(metrics, family: str, **labels) -> float:
+    for fam in metrics.registry.collect():
+        if fam.name == family:
+            for s in fam.samples:
+                if s.name == family + "_count" and all(
+                    s.labels.get(k) == v for k, v in labels.items()
+                ):
+                    return s.value
+    return 0.0
+
+
+def _ground_truth_quantile(values: list, q: float) -> float:
+    """Independent linear-interpolated quantile (mirrors what a reader
+    would compute by hand) to pin /debug/fleet rollups against."""
+    vs = sorted(values)
+    if len(vs) == 1:
+        return vs[0]
+    pos = q * (len(vs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
+
+
+async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
+    """The fleet-telemetry acceptance soak (`make fleet-obs`;
+    docs/OBSERVABILITY.md "Fleet telemetry & SLOs").
+
+    A 100-node fake cluster converges under the real watch-driven manager
+    while seeded node flaps churn the queues; simulated per-node agents
+    push gated workload metrics to the operator's fleet ingest route.
+    Asserts the whole plane end to end: /debug/fleet percentiles match the
+    ground-truth samples, the exemplar span ids join against
+    /debug/traces?reconcile_id=, join→validated transitions produce fleet
+    samples, a pushed gated-metric regression fires SLOBurnRate within the
+    evaluation window and SLORecovered after the fault clears, the
+    controller saturation gauges move under load and return to idle, and
+    aggregation adds ZERO steady-state API verbs per reconcile pass.
+    """
+    import random
+
+    import aiohttp
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get
+
+    rng = random.Random(seed)
+    chaos = ChaosConfig(
+        seed=seed,
+        node_flap_interval=1.0, node_flap_down_s=0.3,
+    )
+    # multi-window burn rate tuned to soak time-scale: the 10s window
+    # proves the regression is real, the 3s window proves it is current
+    # (and clears it a few seconds after the fault stops)
+    slos = [{
+        "name": "workload-mfu", "metric": "tpu_workload_mfu",
+        "comparison": "ge", "threshold": 0.8, "objective": 0.95,
+        "windows": [3, 10], "burnRateThreshold": 2.0, "minSamples": 5,
+    }]
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim, chaos=chaos) as fc:
+        fc.chaos.stop()  # quiet until the pipeline has converged
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        recorder = EventRecorder(client, NS)
+        fleet = FleetAggregator(metrics)
+        tracer = Tracer(metrics, fleet=fleet)
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, recorder=recorder,
+            operator_metrics=metrics, tracer=tracer, fleet=fleet,
+            fleet_eval_interval=0.25,
+        )
+        reconciler = ClusterPolicyReconciler(
+            client, NS, metrics=metrics, tracer=tracer, recorder=recorder,
+            fleet=fleet,
+        )
+        ctrl = reconciler.setup(mgr)
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "observability": {"slos": slos},
+                }).obj)
+                for i in range(n_nodes):
+                    s, h = divmod(i, 4)
+                    fc.add_node(
+                        f"tpu-{s}-{h}", topology="4x4",
+                        labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        },
+                    )
+
+                async def _converged() -> bool:
+                    cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > FLEET_OBS_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                push_url = f"http://127.0.0.1:{mgr.metrics_port}/push"
+                base_url = f"http://127.0.0.1:{mgr.metrics_port}"
+
+                # -- phase A: healthy pushes + flap churn → load signals --
+                fc.chaos.resume()
+                ground_truth: list[float] = []
+                max_depth = 0.0
+                max_busy = 0.0
+                async with aiohttp.ClientSession() as http:
+                    for burst in range(6):
+                        # a queue burst the saturation gauges must see:
+                        # unknown keys reconcile to not-found immediately
+                        # but wait their turn behind the real key
+                        for j in range(10):
+                            ctrl.enqueue(f"burst-{burst}-{j}")
+                        for i in range(0, n_nodes, 4):
+                            node = f"tpu-{i // 4}-0"
+                            value = round(rng.uniform(0.86, 0.98), 4)
+                            ground_truth.append(value)
+                            async with http.post(push_url, json={
+                                "node": node,
+                                "workloads": {"train": {"counters": {
+                                    "tpu_workload_mfu": value,
+                                }}},
+                                "chips": {"scrape_errors_total": float(burst)},
+                            }) as resp:
+                                assert resp.status == 200, await resp.text()
+                        for _ in range(10):
+                            max_depth = max(max_depth, _gauge_value(
+                                metrics, "tpu_operator_controller_queue_depth",
+                                controller="clusterpolicy",
+                            ))
+                            max_busy = max(max_busy, _gauge_value(
+                                metrics, "tpu_operator_controller_busy_fraction",
+                                controller="clusterpolicy",
+                            ))
+                            await asyncio.sleep(0.03)
+
+                    result["max_queue_depth"] = max_depth
+                    result["max_busy_fraction"] = round(max_busy, 4)
+                    result["queue_latency_samples"] = _hist_count(
+                        metrics, "tpu_operator_controller_queue_latency_seconds",
+                        controller="clusterpolicy",
+                    )
+
+                    # -- rollup fidelity vs ground truth ------------------
+                    async with http.get(f"{base_url}/debug/fleet") as resp:
+                        snap = await resp.json()
+                    roll = (snap["metrics"].get("tpu_workload_mfu") or {}).get("3600s")
+                    result["rollup"] = roll
+                    rollup_ok = roll is not None and roll["count"] == len(ground_truth)
+                    if rollup_ok:
+                        for q, frac in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+                            want = _ground_truth_quantile(ground_truth, frac)
+                            if abs(roll[q] - want) > max(1e-9, 0.01 * abs(want)):
+                                rollup_ok = False
+                                result["rollup_mismatch"] = {
+                                    "quantile": q, "got": roll[q], "want": want,
+                                }
+                    result["rollup_ok"] = rollup_ok
+                    result["join_samples"] = (
+                        (snap["metrics"].get("join_to_validated_seconds") or {})
+                        .get("3600s") or {}
+                    ).get("count", 0)
+
+                    # exemplar → trace join: a reconcile exemplar's id must
+                    # land a filtered /debug/traces hit
+                    exemplars = snap.get("exemplars", {}).get(
+                        "reconcile_duration_seconds", []
+                    )
+                    exemplar_joined = False
+                    for ex in reversed(exemplars):
+                        rid = ex.get("reconcile_id")
+                        if not rid:
+                            continue
+                        async with http.get(
+                            f"{base_url}/debug/traces",
+                            params={"reconcile_id": rid},
+                        ) as resp:
+                            traces = (await resp.json())["traces"]
+                        if traces and traces[0]["reconcile_id"] == rid:
+                            exemplar_joined = True
+                            break
+                    result["exemplar_joined"] = exemplar_joined
+
+                    # -- phase B: gated-metric regression → SLO burn ------
+                    bad_nodes = [f"tpu-{s}-1" for s in range(8)]
+                    t_bad = time.perf_counter()
+                    fired = False
+                    while time.perf_counter() - t_bad < 30.0 and not fired:
+                        for node in bad_nodes:
+                            async with http.post(push_url, json={
+                                "node": node,
+                                "workloads": {"train": {"counters": {
+                                    "tpu_workload_mfu": round(rng.uniform(0.2, 0.4), 4),
+                                }}},
+                            }) as resp:
+                                assert resp.status == 200
+                        reasons = {
+                            e.get("reason")
+                            for e in fc.store("", "events").objects.values()
+                        }
+                        fired = "SLOBurnRate" in reasons
+                        await asyncio.sleep(0.25)
+                    result["slo_fired"] = fired
+                    result["slo_fired_after_s"] = round(time.perf_counter() - t_bad, 3)
+                    result["slo_breached_gauge"] = _gauge_value(
+                        metrics, "tpu_operator_slo_breached", slo="workload-mfu"
+                    )
+
+                    # -- phase C: fault clears → recovery -----------------
+                    t_rec = time.perf_counter()
+                    recovered = False
+                    while time.perf_counter() - t_rec < 30.0 and not recovered:
+                        for node in bad_nodes:
+                            async with http.post(push_url, json={
+                                "node": node,
+                                "workloads": {"train": {"counters": {
+                                    "tpu_workload_mfu": round(rng.uniform(0.9, 0.97), 4),
+                                }}},
+                            }) as resp:
+                                assert resp.status == 200
+                        reasons = {
+                            e.get("reason")
+                            for e in fc.store("", "events").objects.values()
+                        }
+                        recovered = "SLORecovered" in reasons
+                        await asyncio.sleep(0.25)
+                    result["slo_recovered"] = recovered
+                    result["slo_recovered_after_s"] = round(
+                        time.perf_counter() - t_rec, 3
+                    )
+
+                # -- steady state: aggregation must cost zero API verbs ---
+                fc.chaos.stop()
+                steady_requests = None
+                t2 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    if counter.n == 0 or time.perf_counter() - t2 > 60:
+                        steady_requests = counter.n
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                # the burst keys drained long ago: queue empty, worker idle
+                result["idle_queue_depth"] = _gauge_value(
+                    metrics, "tpu_operator_controller_queue_depth",
+                    controller="clusterpolicy",
+                )
+                result["idle_busy_fraction"] = round(_gauge_value(
+                    metrics, "tpu_operator_controller_busy_fraction",
+                    controller="clusterpolicy",
+                ), 4)
+        finally:
+            await client.close()
+
+        result["faults_injected"] = fc.chaos.report()
+        failures = []
+        if not result.get("rollup_ok"):
+            failures.append(f"/debug/fleet rollup mismatch: {result.get('rollup_mismatch') or result.get('rollup')}")
+        if result.get("join_samples", 0) < n_nodes // 2:
+            failures.append(
+                f"join_to_validated fleet samples: {result.get('join_samples')} "
+                f"< {n_nodes // 2}"
+            )
+        if not result.get("exemplar_joined"):
+            failures.append("no reconcile exemplar joined /debug/traces?reconcile_id=")
+        if not result.get("slo_fired"):
+            failures.append("SLOBurnRate never fired on the injected regression")
+        if not result.get("slo_recovered"):
+            failures.append("SLORecovered never posted after the fault cleared")
+        if result.get("max_queue_depth", 0) < 1:
+            failures.append("controller queue-depth gauge never rose under load")
+        if result.get("max_busy_fraction", 0) <= 0:
+            failures.append("controller busy-fraction gauge never rose under load")
+        if result.get("queue_latency_samples", 0) <= 0:
+            failures.append("no queue-latency observations recorded")
+        if result.get("idle_queue_depth") != 0:
+            failures.append(
+                f"queue depth did not return to idle: {result.get('idle_queue_depth')}"
+            )
+        if result.get("steady_requests_per_pass") != 0:
+            failures.append(
+                "fleet aggregation broke the zero-API steady state: "
+                f"{result.get('steady_requests_per_pass')} verbs/pass"
+            )
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_fleet_obs_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  fleet-obs soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_fleet_obs_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  fleet-obs FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  fleet-obs soak: rollup count {((result.get('rollup') or {}).get('count'))}, "
+        f"SLO fired {result.get('slo_fired_after_s')}s / recovered "
+        f"{result.get('slo_recovered_after_s')}s, max depth "
+        f"{result.get('max_queue_depth'):.0f}, busy {result.get('max_busy_fraction')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 RECONCILE_TIERS = (10, 100, 500)
 RECONCILE_CONVERGE_TIMEOUT = 240.0
 _RECONCILE_CONCURRENCY_KNOBS = (
@@ -729,6 +1074,7 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
     from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler, informer_specs
     from tpu_operator.k8s.client import ApiClient, Config
     from tpu_operator.k8s.informer import Informer
+    from tpu_operator.obs.fleet import FleetAggregator
     from tpu_operator.testing import FakeCluster, SimConfig
 
     saved = {k: getattr(consts, k) for k in _RECONCILE_CONCURRENCY_KNOBS}
@@ -741,7 +1087,13 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
         sim = SimConfig(enabled=False, api_latency=0.005)
         async with FakeCluster(sim) as fc:
             async with ApiClient(Config(base_url=fc.base_url)) as client:
-                reconciler = ClusterPolicyReconciler(client, NS)
+                # fleet-obs assertion tier: the cached pipeline runs WITH
+                # the fleet aggregator collecting node evidence + span
+                # durations every pass, so the steady-state verbs/pass
+                # figure measures aggregation's API cost — which must be 0
+                # (all reads ride the CachedReader; ingest is push-based)
+                fleet = FleetAggregator() if cached else None
+                reconciler = ClusterPolicyReconciler(client, NS, fleet=fleet)
                 informers: list = []
                 try:
                     if cached:
@@ -796,12 +1148,18 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                         await reconciler.reconcile("cluster-policy")
                         passes += 1
                     passes_per_sec = passes / (time.perf_counter() - t1)
-                    return {
+                    out = {
                         "nodes": n_nodes,
                         "converge_s": round(converge_s, 3),
                         "steady_requests_per_pass": steady_requests,
                         "steady_passes_per_sec": round(passes_per_sec, 2),
                     }
+                    if fleet is not None:
+                        # proof the aggregator was live while the steady
+                        # figure was measured, not a vacuous zero
+                        out["fleet_series"] = fleet.series_count()
+                        out["fleet_obs_zero_api"] = steady_requests == 0
+                    return out
                 finally:
                     for inf in informers:
                         await inf.stop()
@@ -834,6 +1192,17 @@ def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
         f"({out['steady_request_ratio']}x fewer)",
         file=sys.stderr,
     )
+    # fleet-obs assertion tier: aggregation rode every cached pass above;
+    # it may not cost a single steady-state API verb
+    out["fleet_obs_zero_api"] = all(
+        t.get("fleet_obs_zero_api", True) for t in out["tiers"].values()
+    )
+    if not out["fleet_obs_zero_api"]:
+        print(
+            "  reconcile bench FAILURE: fleet aggregation added steady-state "
+            "API verbs (want 0)",
+            file=sys.stderr,
+        )
     return out
 
 
@@ -1132,6 +1501,21 @@ def _int_arg(flag: str, default: int) -> int:
 
 
 def main() -> None:
+    # `bench.py --fleet-obs [--nodes 100] [--seed 1]`: fleet telemetry
+    # plane acceptance soak (no chip needed) — `make fleet-obs`
+    if "--fleet-obs" in sys.argv:
+        result = run_fleet_obs_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "fleet_obs_slo_fired_seconds",
+            "value": result.get("slo_fired_after_s"),
+            "unit": "s",
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
     # `bench.py --chaos-health [--nodes 100] [--seed 1]`: node-health-engine
     # acceptance soak (no chip needed) — `make chaos-health`
     if "--chaos-health" in sys.argv:
@@ -1193,7 +1577,7 @@ def main() -> None:
             "steady_request_ratio": rec["steady_request_ratio"],
             "detail": rec,
         }))
-        return
+        sys.exit(0 if rec["fleet_obs_zero_api"] else 1)
 
     result = asyncio.run(bench())
     value = result["join_to_validated_s"]

@@ -1,0 +1,734 @@
+"""Fleet telemetry plane tests (ISSUE 7 acceptance, alongside the
+`make fleet-obs` soak): ring/rollup fidelity, push ingest with size caps,
+join→validated derivation, multi-window SLO burn-rate semantics, the
+health engine's SLO signal, controller saturation metrics, /debug/fleet +
+/debug/traces filtering, and the metrics agent's fleet forward hop."""
+
+import asyncio
+import json
+
+import aiohttp
+from prometheus_client import generate_latest
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import fleet as fleet_api
+from tpu_operator.obs.fleet import FleetAggregator, quantile
+from tpu_operator.obs.trace import Tracer
+from tpu_operator.testing import FakeCluster, SimConfig
+
+NS = "tpu-operator"
+
+
+def _metric_sample(metrics: OperatorMetrics, family: str, **labels) -> float:
+    # counters collect() under the un-suffixed family name with _total
+    # sample names; gauges collect under the family name directly
+    bare = family[: -len("_total")] if family.endswith("_total") else family
+    for fam in metrics.registry.collect():
+        if fam.name == bare:
+            for s in fam.samples:
+                if s.name == family and all(
+                    s.labels.get(k) == v for k, v in labels.items()
+                ):
+                    return s.value
+    return 0.0
+
+
+# ----------------------------------------------------------------------
+# aggregator: rings, rollups, caps
+
+
+def test_rollup_percentiles_match_ground_truth():
+    fleet = FleetAggregator()
+    values = [float(v) for v in (5, 1, 9, 3, 7, 2, 8, 4, 6, 10)]
+    now = 1000.0
+    for i, v in enumerate(values):
+        assert fleet.ingest(
+            "tpu_workload_mfu", v, {"node": f"n{i % 3}"}, ts=now - i
+        )
+    roll = fleet.rollup("tpu_workload_mfu", 60.0, now=now)
+    assert roll["count"] == 10
+    assert roll["min"] == 1.0 and roll["max"] == 10.0
+    assert roll["mean"] == 5.5
+    # linear interpolation, pinned by hand: p50 of 1..10 = 5.5
+    assert roll["p50"] == 5.5
+    assert abs(roll["p90"] - 9.1) < 1e-9
+    assert abs(roll["p99"] - 9.91) < 1e-9
+    # windowing: only samples newer than the cutoff count
+    assert fleet.rollup("tpu_workload_mfu", 3.5, now=now)["count"] == 4
+    assert fleet.rollup("tpu_workload_mfu", 60.0, now=now + 120) is None
+
+
+def test_ring_bound_and_series_cap():
+    fleet = FleetAggregator(ring_samples=8, max_series=2)
+    for i in range(20):
+        fleet.ingest("tpu_workload_mfu", float(i), {"node": "a"}, ts=float(i))
+    # ring kept the newest 8
+    rows = fleet.window_samples("tpu_workload_mfu", 1e9, now=100.0)
+    assert len(rows) == 8
+    assert {v for v, _ in rows} == {float(i) for i in range(12, 20)}
+    # second series fits, third hits the cap
+    assert fleet.ingest("tpu_workload_mfu", 1.0, {"node": "b"})
+    assert not fleet.ingest("tpu_workload_mfu", 1.0, {"node": "c"})
+
+
+def test_ingest_rejects_unknown_metric_and_bad_values():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    assert not fleet.ingest("evil_metric", 1.0)
+    assert not fleet.ingest("tpu_workload_mfu", float("nan"))
+    assert not fleet.ingest("tpu_workload_mfu", "wat")
+    assert fleet.ingest("reconcile_duration_seconds", 0.1)
+    assert _metric_sample(
+        metrics, "tpu_operator_fleet_push_rejected_total",
+        reason="unknown-metric",
+    ) == 1
+    assert _metric_sample(
+        metrics, "tpu_operator_fleet_push_rejected_total", reason="bad-shape",
+    ) == 2
+
+
+def test_ingest_push_parses_agent_payload():
+    fleet = FleetAggregator()
+    accepted = fleet.ingest_push({
+        "node": "tpu-0-0",
+        "workloads": {
+            "train": {"counters": {"tpu_workload_mfu": 0.9,
+                                   "tpu_workload_tokens_per_sec": 1000.0}},
+            "bogus": {"counters": {"not_a_counter": 1.0}},
+        },
+        "chips": {"scrape_errors_total": 3},
+    })
+    assert accepted == 3  # two workload counters + the chip errors
+    rows = fleet.window_samples("tpu_workload_mfu", 60.0)
+    assert rows == [(0.9, {"node": "tpu-0-0", "workload": "train"})]
+    assert fleet.window_samples("chip_scrape_errors_total", 60.0) == [
+        (3.0, {"node": "tpu-0-0"})
+    ]
+    assert fleet.nodes_reporting(60.0) == 1
+
+
+def test_collect_nodes_join_transition_only():
+    fleet = FleetAggregator()
+
+    def node(name: str, validated: bool) -> dict:
+        obj = {
+            "metadata": {
+                "name": name,
+                "creationTimestamp": "2026-08-04T00:00:00Z",
+                "labels": {},
+            },
+            "status": {"allocatable": {}},
+        }
+        if validated:
+            obj["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+        return obj
+
+    t0 = fleet_api._parse_k8s_ts("2026-08-04T00:00:00Z")
+    # first sight already validated: NOT a join (restarted operator)
+    fleet.collect_nodes([node("old", True)], now=t0 + 50)
+    assert fleet.rollup("join_to_validated_seconds", 1e9, now=t0 + 50) is None
+    # unvalidated → validated transition ingests exactly once
+    fleet.collect_nodes([node("fresh", False)], now=t0 + 10)
+    fleet.collect_nodes([node("fresh", True)], now=t0 + 30)
+    roll = fleet.rollup("join_to_validated_seconds", 1e9, now=t0 + 30)
+    assert roll["count"] == 1 and abs(roll["p50"] - 30.0) < 1.5
+    # a lagging watch briefly showing it unvalidated must not re-count
+    fleet.collect_nodes([node("fresh", False)], now=t0 + 31)
+    fleet.collect_nodes([node("fresh", True)], now=t0 + 32)
+    assert fleet.rollup("join_to_validated_seconds", 1e9, now=t0 + 32)["count"] == 1
+    # health verdict count series rides the same pass
+    assert fleet.rollup("health_verdict_unhealthy_nodes", 1e9, now=t0 + 32)
+
+
+# ----------------------------------------------------------------------
+# SLO engine: burn-rate math + multi-window semantics
+
+
+def _mfu_slo(**over) -> dict:
+    return {
+        "name": "mfu", "metric": "tpu_workload_mfu", "comparison": "ge",
+        "threshold": 0.8, "objective": 0.9, "windows": [10, 100],
+        "burnRateThreshold": 1.0, "minSamples": 1,
+        "feedHealthEngine": True, **over,
+    }
+
+
+def test_slo_burn_rate_math_and_gauges():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    fleet.configure_slos([_mfu_slo()])
+    now = 1000.0
+    # 4 good + 1 bad in the short window → bad_frac 0.2, budget 0.1 → 2.0x
+    for i, v in enumerate((0.9, 0.95, 0.9, 0.85, 0.3)):
+        fleet.ingest("tpu_workload_mfu", v, {"node": f"n{i}"}, ts=now - 1)
+    transitions = fleet.evaluate_slos(now=now)
+    assert [(k, n) for k, n, _ in transitions] == [("fired", "mfu")]
+    assert abs(_metric_sample(
+        metrics, "tpu_operator_slo_burn_rate", slo="mfu", window="10s",
+    ) - 2.0) < 1e-9
+    assert _metric_sample(metrics, "tpu_operator_slo_breached", slo="mfu") == 1
+    assert fleet.node_slo_offenders("n4") == ["mfu"]
+    assert fleet.node_slo_offenders("n0") == []
+    # second evaluation while still burning: no duplicate transition
+    assert fleet.evaluate_slos(now=now) == []
+    # telemetry going dark is NOT recovery: the short window is empty but
+    # the long window still holds the burning evidence — the breach holds
+    assert fleet.evaluate_slos(now=now + 50) == []
+    assert _metric_sample(metrics, "tpu_operator_slo_breached", slo="mfu") == 1
+    # fresh GOOD samples in the short window recover it
+    for i in range(4):
+        fleet.ingest("tpu_workload_mfu", 0.95, {"node": f"n{i}"}, ts=now + 49)
+    transitions = fleet.evaluate_slos(now=now + 50)
+    assert [(k, n) for k, n, _ in transitions] == [("recovered", "mfu")]
+    assert _metric_sample(metrics, "tpu_operator_slo_breached", slo="mfu") == 0
+    assert fleet.node_slo_offenders("n4") == []
+
+
+def test_slo_breach_ages_out_when_every_window_is_dark():
+    """No good samples ever arrive (the workload stopped): the breach
+    holds while ANY window still has evidence, and recovers only once the
+    episode has aged out of even the longest window."""
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo()])
+    now = 1000.0
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"}, ts=now - 1)
+    assert [(k, n) for k, n, _ in fleet.evaluate_slos(now=now)] == [("fired", "mfu")]
+    # short window dark, long window still burning → held
+    assert fleet.evaluate_slos(now=now + 50) == []
+    # everything aged out → recovered with the aged-out message
+    transitions = fleet.evaluate_slos(now=now + 200)
+    assert [(k, n) for k, n, _ in transitions] == [("recovered", "mfu")]
+    assert "aged out" in transitions[0][2]
+
+
+def test_slo_health_coupling_is_opt_in():
+    """feedHealthEngine defaults OFF: fleet ingest is an unauthenticated
+    route, so a breached SLO must not feed node offenders into the health
+    engine's actuation ladder unless the operator opted that SLO in."""
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo(feedHealthEngine=False)])
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "victim"})
+    assert [k for k, _, _ in fleet.evaluate_slos()] == ["fired"]
+    assert fleet.node_slo_offenders("victim") == []
+    # same breach with the opt-in set feeds the signal
+    fleet.configure_slos([_mfu_slo()])
+    fleet.evaluate_slos()
+    assert fleet.node_slo_offenders("victim") == ["mfu"]
+
+
+def test_retained_slo_with_changed_windows_drops_stale_burn_gauges():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    fleet.configure_slos([_mfu_slo(windows=[10, 100])])
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"})
+    fleet.evaluate_slos()
+    text = generate_latest(metrics.registry).decode()
+    assert 'window="100s"' in text
+    # same name, shrunk windows: the dropped window's gauge must go too
+    fleet.configure_slos([_mfu_slo(windows=[10])])
+    text = generate_latest(metrics.registry).decode()
+    assert 'window="100s"' not in text
+    assert 'window="10s"' in text
+
+
+def test_export_drops_stale_quantiles_when_window_empties():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    now = 1000.0
+    fleet.ingest("tpu_workload_mfu", 0.9, {"node": "n"}, ts=now)
+    fleet.export(window_s=60.0, now=now)
+    assert _metric_sample(
+        metrics, "tpu_operator_fleet_quantile",
+        metric="tpu_workload_mfu", quantile="p50",
+    ) == 0.9
+    # samples age out of the window → the gauge must vanish, not freeze
+    fleet.export(window_s=60.0, now=now + 3600)
+    text = generate_latest(metrics.registry).decode()
+    assert 'metric="tpu_workload_mfu"' not in text
+
+
+async def test_fleet_forwarder_filters_and_caps_like_push_store():
+    from tpu_operator.agents.metrics_agent import FleetForwarder, PushStore
+
+    # interval huge so the drain task this spawns never actually POSTs
+    fwd = FleetForwarder("http://127.0.0.1:1/push", interval=600.0)
+    fwd.queue({
+        "train": {"counters": {"tpu_workload_mfu": 0.9,
+                               "not_in_catalogue": 1.0,
+                               "tpu_workload_evil_subversion": 2.0}},
+        "junk-only": {"counters": {"whatever": 1.0}},
+    })
+    # only catalogue counters forwarded; junk-only contributed nothing
+    assert fwd._pending == {
+        "train": {"counters": {"tpu_workload_mfu": 0.9}}
+    }
+    # distinct workload names capped like the agent's own surface
+    for i in range(PushStore.MAX_WORKLOADS + 20):
+        fwd.queue({f"w{i}": {"counters": {"tpu_workload_mfu": 0.5}}})
+    assert len(fwd._pending) <= PushStore.MAX_WORKLOADS + 1
+    if fwd._task is not None:
+        fwd._task.cancel()
+
+
+def test_removed_slo_drops_its_gauges():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    fleet.configure_slos([_mfu_slo()])
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"})
+    fleet.evaluate_slos()
+    assert _metric_sample(metrics, "tpu_operator_slo_breached", slo="mfu") == 1
+    fleet.configure_slos([])
+    # the gauges are gone, not latched at their last value
+    text = generate_latest(metrics.registry).decode()
+    assert 'tpu_operator_slo_breached{slo="mfu"}' not in text
+    assert 'tpu_operator_slo_burn_rate{slo="mfu"' not in text
+
+
+def test_slo_requires_every_window_burning():
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo(windows=[10, 1000], minSamples=1)])
+    now = 5000.0
+    # old GOOD samples fill the long window; fresh bad ones burn the short
+    for i in range(50):
+        fleet.ingest("tpu_workload_mfu", 0.95, {"node": "n"}, ts=now - 500 - i)
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"}, ts=now - 1)
+    # short window burns 10x, long window only (1/51)/0.1 ≈ 0.2x → no fire
+    assert fleet.evaluate_slos(now=now) == []
+    # sustained badness fills the long window too → fires
+    for i in range(20):
+        fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"}, ts=now - 2 - i)
+    transitions = fleet.evaluate_slos(now=now)
+    assert [(k, n) for k, n, _ in transitions] == [("fired", "mfu")]
+
+
+def test_slo_min_samples_gates_empty_windows():
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo(minSamples=5)])
+    now = 100.0
+    for i in range(3):
+        fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"}, ts=now - i)
+    # 3 bad samples < minSamples → no evidence, no fire
+    assert fleet.evaluate_slos(now=now) == []
+
+
+def test_slo_reconfigure_preserves_and_drops_state():
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo()])
+    now = 200.0
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "n"}, ts=now - 1)
+    assert fleet.evaluate_slos(now=now)
+    assert fleet.slo_engine.breached["mfu"]
+    # same name survives a re-parse (reconcile passes reconfigure each time)
+    fleet.configure_slos([_mfu_slo()])
+    assert fleet.slo_engine.breached["mfu"]
+    # removal drops the state
+    fleet.configure_slos([])
+    assert fleet.slo_engine.breached == {}
+
+
+# ----------------------------------------------------------------------
+# health engine consumes SLO offenders as a sustained central signal
+
+
+async def test_health_engine_observes_slo_offender():
+    from tpu_operator.controllers.health import HealthReconciler, _Track
+    from tpu_operator.api.types import HealthSpec
+
+    fleet = FleetAggregator()
+    fleet.configure_slos([_mfu_slo()])
+    fleet.ingest("tpu_workload_mfu", 0.1, {"node": "tpu-node-0"})
+    fleet.evaluate_slos()
+    assert fleet.node_slo_offenders("tpu-node-0") == ["mfu"]
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-node-0")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            engine = HealthReconciler(client, NS, fleet=fleet)
+            node = await client.get("", "Node", "tpu-node-0")
+            track = _Track()
+            engine._observe(node, [], track, HealthSpec(), now=100.0)
+            assert "slo:mfu" in track.reasons
+            assert any(r == "slo:mfu" for _, r in track.window)
+            # sustained semantics: an immediate second pass re-lists the
+            # reason but does not double-observe inside the reassert gap
+            engine._observe(node, [], track, HealthSpec(), now=100.5)
+            assert sum(1 for _, r in track.window if r == "slo:mfu") == 1
+
+
+# ----------------------------------------------------------------------
+# manager surface: /push (capped), /debug/fleet, /debug/traces filters
+
+
+async def test_manager_push_route_cap_and_debug_fleet():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            fleet = FleetAggregator(metrics)
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1,
+                metrics_registry=metrics.registry, operator_metrics=metrics,
+                fleet=fleet, fleet_eval_interval=0.05,
+            )
+            async with mgr:
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                async with aiohttp.ClientSession() as http:
+                    async with http.post(f"{base}/push", json={
+                        "node": "n0",
+                        "workloads": {"train": {"counters": {
+                            "tpu_workload_mfu": 0.93,
+                        }}},
+                    }) as resp:
+                        assert resp.status == 200
+                        assert (await resp.json())["accepted"] == 1
+                    # payload cap: 413, counted
+                    big = json.dumps({
+                        "node": "n0",
+                        "workloads": {"x": {"counters": {
+                            "tpu_workload_mfu": 0.1}}},
+                        "pad": "x" * (consts.PUSH_MAX_BYTES + 10),
+                    })
+                    async with http.post(
+                        f"{base}/push", data=big,
+                        headers={"Content-Type": "application/json"},
+                    ) as resp:
+                        assert resp.status == 413
+                    async with http.post(f"{base}/push", data=b"{nope") as resp:
+                        assert resp.status == 400
+                    # a large under-cap body sent CHUNKED (no
+                    # Content-Length, spans many reads) must arrive whole
+                    # — read_json_capped loops instead of trusting one
+                    # StreamReader.read() call
+
+                    async def chunks():
+                        body = json.dumps({
+                            "node": "n1",
+                            "workloads": {"train": {"counters": {
+                                "tpu_workload_tokens_per_sec": 123.0,
+                            }}},
+                            "pad": "z" * 100_000,
+                        }).encode()
+                        for i in range(0, len(body), 4096):
+                            yield body[i:i + 4096]
+
+                    async with http.post(
+                        f"{base}/push", data=chunks(),
+                        headers={"Content-Type": "application/json"},
+                    ) as resp:
+                        assert resp.status == 200
+                        assert (await resp.json())["accepted"] == 1
+                    # /debug/fleet serves the rollup + gauges got exported
+                    # by the fleet loop
+                    await asyncio.sleep(0.15)
+                    async with http.get(f"{base}/debug/fleet") as resp:
+                        assert resp.status == 200
+                        snap = await resp.json()
+                    assert snap["metrics"]["tpu_workload_mfu"]["3600s"]["count"] == 1
+                    # two series: the small push's mfu + the chunked
+                    # push's tokens_per_sec
+                    assert snap["series"] == 2
+            assert _metric_sample(
+                metrics, "tpu_operator_fleet_push_rejected_total",
+                reason="too-large",
+            ) == 1
+            assert _metric_sample(
+                metrics, "tpu_operator_fleet_push_rejected_total",
+                reason="bad-json",
+            ) == 1
+            assert _metric_sample(
+                metrics, "tpu_operator_fleet_quantile",
+                metric="tpu_workload_mfu", quantile="p50",
+            ) == 0.93
+
+
+async def test_debug_traces_filtering_and_limit():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            tracer = Tracer()
+            with tracer.reconcile("clusterpolicy", key="cp") as sp_cp:
+                pass
+            with tracer.reconcile("health", key="health"):
+                pass
+            with tracer.reconcile("health", key="health"):
+                pass
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1, tracer=tracer,
+            )
+            async with mgr:
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base}/debug/traces") as resp:
+                        assert len((await resp.json())["traces"]) == 3
+                    async with http.get(
+                        f"{base}/debug/traces",
+                        params={"controller": "health"},
+                    ) as resp:
+                        traces = (await resp.json())["traces"]
+                    assert len(traces) == 2
+                    assert all(
+                        t["attrs"]["controller"] == "health" for t in traces
+                    )
+                    async with http.get(
+                        f"{base}/debug/traces",
+                        params={"controller": "health", "limit": "1"},
+                    ) as resp:
+                        assert len((await resp.json())["traces"]) == 1
+                    # the exemplar-join path: one reconcile id → its trace
+                    async with http.get(
+                        f"{base}/debug/traces",
+                        params={"reconcile_id": sp_cp.reconcile_id},
+                    ) as resp:
+                        traces = (await resp.json())["traces"]
+                    assert len(traces) == 1
+                    assert traces[0]["reconcile_id"] == sp_cp.reconcile_id
+                    async with http.get(
+                        f"{base}/debug/traces", params={"limit": "wat"},
+                    ) as resp:
+                        assert resp.status == 400
+
+
+# ----------------------------------------------------------------------
+# controller saturation metrics
+
+
+async def test_controller_saturation_metrics():
+    metrics = OperatorMetrics()
+    seen: list[str] = []
+    gate = asyncio.Event()
+
+    async def reconcile(key: str):
+        seen.append(key)
+        await asyncio.sleep(0.01)
+        if key == "requeue-me" and len(seen) < 20:
+            return 0.001 if seen.count("requeue-me") == 1 else None
+        if key == "fail-me" and seen.count("fail-me") == 1:
+            raise RuntimeError("boom")
+        if key == "last":
+            gate.set()
+        return None
+
+    ctrl = Controller("t", reconcile, metrics=metrics)
+    await ctrl.start()
+    try:
+        for i in range(5):
+            ctrl.enqueue(f"k{i}")
+        # depth gauge saw the burst before the worker drained it
+        assert _metric_sample(
+            metrics, "tpu_operator_controller_queue_depth", controller="t"
+        ) == 5
+        ctrl.enqueue("requeue-me")
+        ctrl.enqueue("fail-me")
+        ctrl.enqueue("last")
+        await asyncio.wait_for(gate.wait(), timeout=10)
+        await asyncio.sleep(0.1)  # let the requeued keys finish
+    finally:
+        await ctrl.stop()
+    text = generate_latest(metrics.registry).decode()
+    assert 'tpu_operator_controller_queue_latency_seconds_count{controller="t"}' in text
+    assert _metric_sample(
+        metrics, "tpu_operator_controller_requeues_total",
+        controller="t", reason="scheduled",
+    ) >= 1
+    assert _metric_sample(
+        metrics, "tpu_operator_controller_requeues_total",
+        controller="t", reason="failure",
+    ) >= 1
+    busy = _metric_sample(
+        metrics, "tpu_operator_controller_busy_fraction", controller="t"
+    )
+    assert 0.0 < busy <= 1.0
+
+
+# ----------------------------------------------------------------------
+# reconciler wiring: SLO config from the CR, span exemplars, zero extra API
+
+
+async def test_reconciler_feeds_fleet_and_configures_slos():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("tpu-0-0", topology="4x4")
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            await client.create(TPUClusterPolicy.new(spec={
+                "observability": {"slos": [_mfu_slo()]},
+            }).obj)
+            metrics = OperatorMetrics()
+            fleet = FleetAggregator(metrics)
+            tracer = Tracer(metrics, fleet=fleet)
+            reconciler = ClusterPolicyReconciler(
+                client, NS, metrics=metrics, tracer=tracer, fleet=fleet,
+            )
+            await reconciler.reconcile("cluster-policy")
+            # the CR's SLOs reached the engine
+            assert set(fleet.slo_engine.slos) == {"mfu"}
+            # every reconcile span became a fleet sample with an exemplar
+            # span id joinable against the tracer's ring
+            rows = fleet.window_samples("reconcile_duration_seconds", 60.0)
+            assert rows and rows[0][1] == {"controller": "clusterpolicy"}
+            exemplar = fleet.snapshot()["exemplars"]["reconcile_duration_seconds"][-1]
+            rids = {t["reconcile_id"] for t in tracer.snapshot()}
+            assert exemplar["reconcile_id"] in rids
+
+
+# ----------------------------------------------------------------------
+# the agent's fleet forward hop
+
+
+async def test_metrics_agent_forwards_pushes_to_fleet_url(monkeypatch):
+    from aiohttp import web
+
+    from tpu_operator.agents import metrics_agent
+
+    received: list[dict] = []
+
+    async def sink(request):
+        received.append(await request.json())
+        return web.json_response({"accepted": 1})
+
+    sink_app = web.Application()
+    sink_app.router.add_post("/push", sink)
+    runner = web.AppRunner(sink_app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    sink_port = site._server.sockets[0].getsockname()[1]
+
+    monkeypatch.setenv("TPU_RUNTIME_METRICS_PORTS", "19997")  # refused fast
+    monkeypatch.setenv(consts.FLEET_PUSH_ENV, f"http://127.0.0.1:{sink_port}/push")
+    monkeypatch.setenv("NODE_NAME", "tpu-7-3")
+    stop = asyncio.Event()
+    agent_task = asyncio.create_task(
+        metrics_agent.serve(15561, stop, cache_ttl=0.0)
+    )
+    await asyncio.sleep(0.2)
+    try:
+        async with aiohttp.ClientSession() as http:
+            async with http.post("http://127.0.0.1:15561/push", json={
+                "workloads": {"train": {"counters": {
+                    "tpu_workload_mfu": 0.88,
+                    "tpu_workload_steps_total": 4,
+                }}},
+            }) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["accepted"] == 1
+            # oversized body: 413 at the agent, nothing forwarded for it
+            big = json.dumps({
+                "workloads": {"x": {"counters": {"tpu_workload_mfu": 0.1}}},
+                "pad": "y" * (consts.PUSH_MAX_BYTES + 1),
+            })
+            async with http.post(
+                "http://127.0.0.1:15561/push", data=big,
+                headers={"Content-Type": "application/json"},
+            ) as resp:
+                assert resp.status == 413
+        for _ in range(100):
+            if received:
+                break
+            await asyncio.sleep(0.05)
+        assert received, "agent never forwarded the accepted push"
+        body = received[0]
+        assert body["node"] == "tpu-7-3"
+        assert body["workloads"]["train"]["counters"]["tpu_workload_mfu"] == 0.88
+        assert "scrape_errors_total" in body["chips"]
+        # only the accepted window was forwarded
+        assert "x" not in body["workloads"]
+    finally:
+        stop.set()
+        await asyncio.gather(agent_task, return_exceptions=True)
+        await runner.cleanup()
+
+
+# ----------------------------------------------------------------------
+# end to end: pushes → burn → SLOBurnRate Event → recovery
+
+
+async def test_slo_events_end_to_end():
+    from tpu_operator.obs.events import EventRecorder
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            fleet = FleetAggregator(metrics)
+            fleet.configure_slos([_mfu_slo(windows=[1, 4])])
+            recorder = EventRecorder(client, NS)
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1,
+                metrics_registry=metrics.registry, operator_metrics=metrics,
+                recorder=recorder, fleet=fleet, fleet_eval_interval=0.05,
+            )
+            async with mgr:
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                async with aiohttp.ClientSession() as http:
+                    async def push(value: float) -> None:
+                        async with http.post(f"{base}/push", json={
+                            "node": "n0",
+                            "workloads": {"train": {"counters": {
+                                "tpu_workload_mfu": value,
+                            }}},
+                        }) as resp:
+                            assert resp.status == 200
+
+                    async def reasons() -> set:
+                        return {
+                            e.get("reason")
+                            for e in fc.store("", "events").objects.values()
+                        }
+
+                    for _ in range(6):
+                        await push(0.2)
+                    for _ in range(100):
+                        if "SLOBurnRate" in await reasons():
+                            break
+                        await push(0.2)
+                        await asyncio.sleep(0.05)
+                    assert "SLOBurnRate" in await reasons()
+                    # fault clears: good pushes + the short window draining
+                    for _ in range(100):
+                        if "SLORecovered" in await reasons():
+                            break
+                        await push(0.95)
+                        await asyncio.sleep(0.05)
+                    assert "SLORecovered" in await reasons()
+
+
+# ----------------------------------------------------------------------
+# spec plumbing: admission + round-trip
+
+
+async def test_malformed_slo_rejected_at_admission():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            from tpu_operator.k8s.client import ApiError
+
+            try:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "observability": {"slos": [{"metric": "x"}]},  # no name
+                }).obj)
+                raise AssertionError("nameless SLO passed admission")
+            except ApiError as e:
+                assert e.status == 422
+            # a well-formed entry is accepted and round-trips
+            await client.create(TPUClusterPolicy.new(spec={
+                "observability": {"slos": [_mfu_slo()]},
+            }).obj)
+            from tpu_operator.api.types import (
+                CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy as TCP,
+            )
+
+            obj = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+            spec = TCP.from_obj(obj).spec
+            assert spec.observability.slos[0]["name"] == "mfu"
+
+
+# ----------------------------------------------------------------------
+# shared helpers
+
+
+def test_quantile_helper_edges():
+    assert quantile([3.0], 0.99) == 3.0
+    assert quantile([1.0, 2.0], 0.5) == 1.5
+    vals = sorted(float(i) for i in range(1, 101))
+    assert quantile(vals, 0.5) == 50.5
+    assert abs(quantile(vals, 0.99) - 99.01) < 1e-9

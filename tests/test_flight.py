@@ -107,6 +107,96 @@ def test_push_requeue_preserves_once_recorded_counters():
     }
 
 
+def test_push_loop_agent_down_backs_off_and_never_blocks_record():
+    """A refused push endpoint (agent pod down) must cost the timed
+    workload loop nothing: record() stays off the network, the push thread
+    requeues the failed window and backs off, and close() returns inside
+    its bound instead of hanging on the dead socket."""
+    import socket
+    import time as _time
+
+    # a port with nothing listening (bound then closed → refused fast)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    recorder = flight.FlightRecorder(
+        push_url=f"http://127.0.0.1:{dead_port}/push", push_interval=0.05
+    )
+    t0 = _time.monotonic()
+    for i in range(50):
+        recorder.record("matmul", "step", step=i, step_s=0.5, compile_s=1.5)
+    record_elapsed = _time.monotonic() - t0
+    assert record_elapsed < 1.0, f"record() blocked {record_elapsed:.2f}s"
+    # give the push thread a few failed attempts
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        with recorder._push_lock:
+            pending = dict(recorder._pending.get("matmul") or {})
+        if pending.get("tpu_workload_compile_seconds") == 1.5:
+            break
+        _time.sleep(0.05)
+    # the failed window was requeued: once-recorded counters survive
+    assert pending.get("tpu_workload_compile_seconds") == 1.5
+    assert pending.get("tpu_workload_step_duration_seconds") == 0.5
+    t1 = _time.monotonic()
+    recorder.close()
+    assert _time.monotonic() - t1 < 4.0, "close() hung on a dead agent"
+
+
+def test_push_loop_slow_agent_is_bounded_by_socket_timeout():
+    """A blackholed agent (accepts the TCP connection, never answers) is
+    the nastier failure: the POST must die on its own 1s socket timeout,
+    record() must never feel it, and close() must still return promptly."""
+    import socket
+    import threading
+    import time as _time
+
+    server = socket.socket()
+    server.bind(("127.0.0.1", 0))
+    server.listen(8)
+    port = server.getsockname()[1]
+    conns: list = []
+    alive = True
+
+    def accept_and_hang():
+        while alive:
+            try:
+                conn, _ = server.accept()
+            except OSError:
+                return
+            conns.append(conn)  # never read, never respond
+
+    thread = threading.Thread(target=accept_and_hang, daemon=True)
+    thread.start()
+    try:
+        recorder = flight.FlightRecorder(
+            push_url=f"http://127.0.0.1:{port}/push", push_interval=0.05
+        )
+        t0 = _time.monotonic()
+        recorder.record("hbm", "step", step=0, gbps=500.0)
+        assert _time.monotonic() - t0 < 0.5, "record() waited on the socket"
+        # the push thread hits the 1s urlopen timeout and requeues
+        deadline = _time.monotonic() + 6.0
+        requeued = False
+        while _time.monotonic() < deadline:
+            with recorder._push_lock:
+                requeued = bool(recorder._pending.get("hbm"))
+            if requeued:
+                break
+            _time.sleep(0.05)
+        assert requeued, "timed-out window was not requeued"
+        t1 = _time.monotonic()
+        recorder.close()
+        assert _time.monotonic() - t1 < 4.0, "close() hung on a slow agent"
+    finally:
+        alive = False
+        server.close()
+        for conn in conns:
+            conn.close()
+
+
 def test_recorder_ring_is_bounded():
     recorder = flight.FlightRecorder(max_samples=10)
     for i in range(25):

@@ -12,7 +12,11 @@ to ``/push`` (the obs.flight recorder's sink), re-exported as
 ``source="workload"`` series alongside the chip counters.
 
 Serves JSON at /counters, Prometheus text at /metrics, and accepts workload
-counter pushes at POST /push.
+counter pushes at POST /push (size-capped; 413 past the limit).  With
+``TPU_FLEET_PUSH_URL`` set, accepted pushes are forwarded — node-tagged,
+with the cumulative chip scrape-error total — to the operator's fleet
+ingest route (obs/fleet.py), giving the control plane live fleet-wide
+workload telemetry without scraping anything.
 """
 
 from __future__ import annotations
@@ -26,10 +30,16 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from tpu_operator import hw
+from tpu_operator import consts, hw
 from tpu_operator.agents import base
+from tpu_operator.obs.fleet import read_json_capped
 
 log = logging.getLogger("tpu_operator.metrics_agent")
+
+# fleet forward hop: at most one POST to the operator per this many
+# seconds; windows merge while throttled (the flight recorder's own push
+# discipline, one level up)
+FLEET_FORWARD_INTERVAL = 1.0
 
 # canonical counter names (tpu_ prefix mirrors DCGM_FI_* naming discipline)
 COUNTERS = (
@@ -101,6 +111,101 @@ async def scrape_runtime_endpoint(session: aiohttp.ClientSession, port: int) -> 
 BASE_METRICS_PORT = 8431  # device plugin advertises 8431 + chip_index
 
 
+class FleetForwarder:
+    """The agent's hop onto the operator's fleet telemetry plane.
+
+    When ``TPU_FLEET_PUSH_URL`` is set (the DS template points it at the
+    operator metrics Service), every accepted workload push is merged into
+    a pending window and forwarded — with the node name and the cumulative
+    chip scrape-error total — to the operator's ``POST /push`` ingest
+    route, throttled to one POST per ``interval`` with exponential backoff
+    on failure.  Event-driven only: a quiet node forwards nothing, so the
+    hop adds zero steady-state traffic."""
+
+    def __init__(
+        self,
+        url: str,
+        node_name: str = "",
+        scrape_errors: Optional[dict] = None,
+        interval: float = FLEET_FORWARD_INTERVAL,
+    ):
+        self.url = url
+        self.node_name = node_name
+        self.scrape_errors = scrape_errors if scrape_errors is not None else {}
+        self.interval = interval
+        self.forwarded = 0
+        self.failures = 0
+        self._pending: dict[str, dict] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def queue(self, workloads: dict) -> None:
+        """Merge a push window for forwarding.  The SAME validation and
+        cardinality discipline as PushStore applies — only catalogue
+        counters, distinct workload names capped — or the unauthenticated
+        hostPort could grow the pending map and the operator's fleet
+        series without bound through the hop while the agent's own
+        surface stays clean."""
+        if not self.url:
+            return
+        for check, entry in workloads.items():
+            counters = {
+                k: float(v)
+                for k, v in (
+                    (entry or {}).get("counters") or {}
+                ).items()
+                if isinstance(entry, dict)
+                and k in WORKLOAD_COUNTERS
+                and isinstance(v, (int, float))
+            }
+            if not counters:
+                continue
+            name = str(check)
+            if (
+                name not in self._pending
+                and len(self._pending) >= PushStore.MAX_WORKLOADS
+            ):
+                continue
+            live = self._pending.setdefault(name, {"counters": {}})
+            live["counters"].update(counters)
+        if self._pending and (self._task is None or self._task.done()):
+            self._task = asyncio.create_task(self._drain())
+
+    async def _drain(self) -> None:
+        backoff = 0
+        # one session for the drain's lifetime: keep-alive to the operator
+        # Service instead of a fresh connector + DNS lookup per POST —
+        # at fleet scale that is one connection per node, not one per push
+        async with aiohttp.ClientSession() as session:
+            while self._pending:
+                window, self._pending = self._pending, {}
+                body = {
+                    "node": self.node_name,
+                    "workloads": window,
+                    "chips": {
+                        "scrape_errors_total": float(
+                            sum(self.scrape_errors.values())
+                        ),
+                    },
+                }
+                try:
+                    async with session.post(
+                        self.url, json=body,
+                        timeout=aiohttp.ClientTimeout(total=2),
+                    ) as resp:
+                        ok = resp.status < 400
+                except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                    ok = False
+                if ok:
+                    self.forwarded += 1
+                    backoff = 0
+                else:
+                    self.failures += 1
+                    backoff = min(5, backoff + 1)
+                    # merge the failed window back; counters recorded since win
+                    for check, entry in window.items():
+                        live = self._pending.setdefault(check, {"counters": {}})
+                        live["counters"] = {**entry["counters"], **live["counters"]}
+                await asyncio.sleep(self.interval * (2**backoff if backoff else 1))
 class PushStore:
     """Live workload counters pushed by obs.flight recorders.
 
@@ -278,6 +383,16 @@ async def serve(
     cache: dict = {"snapshot": {"ts": 0.0, "chips": {}}}
     push_store = PushStore(ttl=push_ttl)
     scrape_errors: dict[int, int] = {}  # chip → cumulative failed scrapes
+    fleet_url = os.environ.get(consts.FLEET_PUSH_ENV, "")
+    forwarder = (
+        FleetForwarder(
+            fleet_url,
+            node_name=os.environ.get("NODE_NAME", ""),
+            scrape_errors=scrape_errors,
+        )
+        if fleet_url
+        else None
+    )
     # the TTL check+collect must be atomic: without the lock, N scrapers
     # arriving inside one TTL window each saw a stale ts and each ran a
     # full collect() pass, defeating the shared-sampler contract
@@ -300,16 +415,22 @@ async def serve(
         return web.Response(text=to_prometheus(await refresh()), content_type="text/plain")
 
     async def push_handler(request: web.Request) -> web.Response:
-        try:
-            body = await request.json()
-        except Exception:  # noqa: BLE001 — malformed push is a client bug, not a crash
-            return web.json_response({"error": "invalid JSON"}, status=400)
+        # size-capped read (413 past PUSH_MAX_BYTES): the hostPort is
+        # unauthenticated and an unbounded body is an allocation amplifier
+        body, error = await read_json_capped(request)
+        if error is not None:
+            return error
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be an object"}, status=400)
         workloads = body.get("workloads")
         if not isinstance(workloads, dict):
             return web.json_response(
                 {"error": "missing workloads map"}, status=400
             )
         accepted = push_store.push(workloads)
+        if accepted and forwarder is not None:
+            # fleet hop: accepted windows ride on to the operator's ingest
+            forwarder.queue(workloads)
         return web.json_response({"accepted": accepted})
 
     app = web.Application()

@@ -473,6 +473,74 @@ class RemediationSpec(SpecBase):
     extra_fields: dict = field(default_factory=dict)
 
 
+# Fleet metric names an SLO may target (obs/fleet.py FLEET_METRICS is the
+# authoritative catalogue; admission stays permissive — an SLO against a
+# metric nobody feeds simply never accumulates samples and never burns).
+SLO_ITEM_SCHEMA = {
+    "type": "object",
+    "required": ["name", "metric"],
+    "properties": {
+        "name": {"type": "string", "pattern": r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$"},
+        "metric": {"type": "string", "pattern": r"^[a-z0-9_]{1,128}$"},
+        "objective": {"type": "number", "minimum": 0, "maximum": 1},
+        "threshold": {"type": "number"},
+        "comparison": {"type": "string", "enum": ["le", "ge"]},
+        "windows": {"type": "array", "items": {"type": "number", "minimum": 0}},
+        "burnRateThreshold": {"type": "number", "minimum": 0},
+        "minSamples": {"type": "integer", "minimum": 1},
+        "feedHealthEngine": {"type": "boolean"},
+    },
+}
+
+
+@dataclass
+class SLOSpec(SpecBase):
+    """One declarative SLO over a fleet metric (obs/fleet.py SLOEngine;
+    docs/OBSERVABILITY.md "Fleet telemetry & SLOs").
+
+    A sample is GOOD when ``value <comparison> threshold`` (``le`` for
+    latency-style metrics, ``ge`` for throughput/utilization-style); with no
+    ``threshold`` every sample is good unless it arrives flagged bad.  The
+    burn rate per window is ``bad_fraction / (1 - objective)``; the engine
+    fires ``SLOBurnRate`` when EVERY configured window burns past
+    ``burnRateThreshold`` (multi-window discipline: the long window proves
+    the budget spend is real, the short window proves it is still
+    happening) and ``SLORecovered`` once the shortest window goes quiet."""
+
+    name: str = ""
+    metric: str = ""
+    objective: float = field(default=0.99, metadata={"minimum": 0, "maximum": 1})
+    threshold: Optional[float] = None
+    comparison: str = field(default="le", metadata={"enum": ["le", "ge"]})
+    # window lengths in seconds, evaluated together (multi-window burn rate)
+    windows: list = field(default_factory=lambda: [300.0, 3600.0])
+    burn_rate_threshold: float = field(default=1.0, metadata={"minimum": 0})
+    # windows with fewer samples than this are treated as no-evidence
+    min_samples: int = field(default=1, metadata={"minimum": 1})
+    # opt-in: while breached, nodes among this SLO's bad samples feed the
+    # health engine's hysteresis as sustained ``slo:<name>`` signals.
+    # Default OFF because fleet ingest is an unauthenticated route — an
+    # operator enables actuation coupling only for SLOs whose metric
+    # sources it trusts (see docs/OBSERVABILITY.md trust boundary note).
+    feed_health_engine: bool = False
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class ObservabilitySpec(SpecBase):
+    """Fleet telemetry plane knobs (obs/fleet.py; the reference operator has
+    no analogue — observability stops at per-process Prometheus there)."""
+
+    # declarative SLOs evaluated by the in-operator burn-rate engine; a
+    # malformed entry is rejected at admission with its path, not silently
+    # dropped at evaluation time
+    slos: list = field(
+        default_factory=list,
+        metadata={"items_schema": SLO_ITEM_SCHEMA},
+    )
+    extra_fields: dict = field(default_factory=dict)
+
+
 @dataclass
 class HealthSpec(SpecBase):
     """Autonomous node health engine (controllers/health.py;
@@ -548,6 +616,7 @@ class TPUClusterPolicySpec(SpecBase):
     )
     remediation: RemediationSpec = field(default_factory=RemediationSpec)
     health: HealthSpec = field(default_factory=HealthSpec)
+    observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
     extra_fields: dict = field(default_factory=dict)
 
     # -- enable gates (isStateEnabled analogue, state_manager.go:994-1036) --

@@ -24,6 +24,7 @@ from tpu_operator.k8s.client import ApiClient, Config
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.obs import logging as obs_logging
 from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.fleet import FleetAggregator
 from tpu_operator.obs.trace import Tracer
 from tpu_operator.version import __version__
 
@@ -81,9 +82,11 @@ async def run(args: argparse.Namespace) -> None:
     # retry/breaker observability: the client feeds retries_total, the
     # manager's supervisor syncs the breaker-state gauge
     client.metrics = metrics
-    # ONE tracer/recorder pair for the whole process so /debug/traces sees
-    # every controller and the Event correlator dedups across them
-    tracer = Tracer(metrics)
+    # ONE tracer/recorder/fleet triple for the whole process so
+    # /debug/traces sees every controller, the Event correlator dedups
+    # across them, and every reconcile span lands in the fleet aggregator
+    fleet = FleetAggregator(metrics)
+    tracer = Tracer(metrics, fleet=fleet)
     recorder = EventRecorder(client, namespace)
     mgr = Manager(
         client,
@@ -98,6 +101,7 @@ async def run(args: argparse.Namespace) -> None:
         tracer=tracer,
         recorder=recorder,
         operator_metrics=metrics,
+        fleet=fleet,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
@@ -107,12 +111,12 @@ async def run(args: argparse.Namespace) -> None:
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
     obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
-    reconciler = ClusterPolicyReconciler(client, namespace, **obs)
+    reconciler = ClusterPolicyReconciler(client, namespace, fleet=fleet, **obs)
     reconciler.setup(mgr)
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)
     RemediationReconciler(client, namespace, **obs).setup(mgr)
-    HealthReconciler(client, namespace, **obs).setup(mgr)
+    HealthReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

@@ -253,5 +253,21 @@ K8S_RETRY_BUDGET_RATIO = 0.2         # ≤20% of sustained traffic may be retrie
 K8S_BREAKER_FAILURE_THRESHOLD = 5
 K8S_BREAKER_RESET_SECONDS = 5.0
 
+# ---------------------------------------------------------------------------
+# Fleet telemetry plane (obs/fleet.py; docs/OBSERVABILITY.md "Fleet
+# telemetry & SLOs").  The aggregator is an in-operator TSDB-lite: bounded
+# ring-buffer series fed by the operator's own spans, the node agents'
+# push hop, and informer-cached node evidence — never by extra API reads.
+FLEET_PUSH_ENV = "TPU_FLEET_PUSH_URL"   # agents forward /push traffic here
+FLEET_RING_SAMPLES = 512                # samples kept per (metric, labels) series
+FLEET_MAX_SERIES = 8192                 # distinct series ceiling (cardinality guard)
+FLEET_EVAL_SECONDS = 1.0                # SLO burn-rate evaluation cadence
+# default rollup windows served by /debug/fleet (seconds)
+FLEET_WINDOWS = (60.0, 300.0, 3600.0)
+# ingest/push payload ceiling, enforced with a 413 on BOTH the metrics
+# agent's POST /push and the operator's fleet ingest route — both ports are
+# unauthenticated, and an unbounded body is an allocation amplifier
+PUSH_MAX_BYTES = 256 * 1024
+
 # Leader election id (main.go:105-115 analogue: "53822513.nvidia.com").
 LEADER_ELECTION_ID = "53822513.tpu.google.com"

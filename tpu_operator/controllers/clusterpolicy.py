@@ -68,6 +68,7 @@ class ClusterPolicyReconciler:
         metrics: Optional[OperatorMetrics] = None,
         tracer: Optional[Tracer] = None,
         recorder: Optional[EventRecorder] = None,
+        fleet=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -83,6 +84,14 @@ class ClusterPolicyReconciler:
         self.reader = CachedReader(client, metrics=self.metrics)
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
+        # obs.fleet.FleetAggregator: this reconciler feeds it the zero-API
+        # node evidence (join→validated transitions, health verdict counts
+        # — the pass already holds the cached node list) and keeps its SLO
+        # config in sync with the CR spec.  The tracer's fleet sink makes
+        # every completed reconcile span a fleet duration sample.
+        self.fleet = fleet
+        if fleet is not None and self.tracer.fleet is None:
+            self.tracer.fleet = fleet
         # last observed per-operand sync state, for transition Events —
         # keyed (policy name, operand) so a recreated or second policy
         # starts from a clean slate instead of inheriting the old one's
@@ -122,6 +131,12 @@ class ClusterPolicyReconciler:
             return None
 
         nodes = await self.reader.list_items("", "Node")
+        if self.fleet is not None:
+            # cached reads only: SLO config from the CR already in hand,
+            # node evidence from the list this pass performs anyway —
+            # aggregation adds zero API verbs (bench.py --reconcile pins it)
+            self.fleet.configure_slos(policy.spec.observability.slos)
+            self.fleet.collect_nodes(nodes)
         ctx = await clusterinfo.gather(self.reader, self.namespace, nodes=nodes)
         ctx.tpu_node_count = await labels.label_tpu_nodes(self.reader, policy.spec, nodes=nodes)
         await labels.label_slice_readiness(self.reader, nodes)
@@ -260,6 +275,15 @@ class ClusterPolicyReconciler:
         if mgr.operator_metrics is None:
             # breaker-state gauge + degraded-mode counter for the supervisor
             mgr.operator_metrics = self.metrics
+        # fleet aggregator flows either way: a manager-owned one reaches the
+        # reconciler's node-evidence collection, a reconciler-owned one
+        # backs the manager's /push + /debug/fleet + SLO loop
+        if mgr.fleet is None and self.fleet is not None:
+            mgr.fleet = self.fleet
+        elif self.fleet is None and mgr.fleet is not None:
+            self.fleet = mgr.fleet
+            if self.tracer.fleet is None:
+                self.tracer.fleet = mgr.fleet
         controller = mgr.add_controller(Controller("clusterpolicy", self.reconcile))
 
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)

@@ -157,9 +157,15 @@ class HealthReconciler:
         metrics: Optional[OperatorMetrics] = None,
         tracer: Optional[Tracer] = None,
         recorder: Optional[EventRecorder] = None,
+        fleet=None,
     ):
         self.client = client
         self.namespace = namespace
+        # obs.fleet.FleetAggregator (optional): breached SLOs whose bad
+        # samples carry this node's label become sustained central signals
+        # — a fleet-level regression (gated workload metrics tanking on a
+        # node) feeds the same hysteresis as the node-local verdicts
+        self.fleet = fleet
         self.metrics = metrics or OperatorMetrics()
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
@@ -339,6 +345,17 @@ class HealthReconciler:
             ).get(consts.TPU_HEALTH_REASON_ANNOTATION) or "unspecified"
             observe(f"agent:{agent_reason}", sustained=track.last_agent_bad)
         track.last_agent_bad = agent_bad
+
+        # fleet SLO engine (obs/fleet.py): a breached SLO that names this
+        # node among its current offenders is a sustained central signal —
+        # it re-asserts while the breach holds and stops contributing the
+        # moment the burn clears (the SLOEngine refreshes offender sets
+        # every evaluation)
+        if self.fleet is not None:
+            for slo_name in self.fleet.node_slo_offenders(
+                node["metadata"]["name"]
+            ):
+                observe(f"slo:{slo_name}", sustained=True)
 
         # Node Ready condition: the False *state* is sustained-bad; each
         # True->False transition is additionally a discrete flap event
@@ -734,6 +751,10 @@ class HealthReconciler:
 
     # ------------------------------------------------------------------
     def setup(self, mgr: Manager) -> Controller:
+        if self.fleet is None and mgr.fleet is not None:
+            # central-signal hookup without explicit plumbing: whatever
+            # aggregator the manager ended up with feeds the hysteresis
+            self.fleet = mgr.fleet
         controller = mgr.add_controller(Controller("health", self.reconcile))
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")

@@ -51,17 +51,35 @@ class RateLimiter:
         self.failures.pop(key, None)
 
 
-class Controller:
-    """One reconcile loop fed by a deduplicating delayed workqueue."""
+# busy-fraction EWMA weight: one loop iteration (wait + work) contributes
+# this much; ~0.2 settles in a handful of iterations without jittering on
+# a single slow pass
+_BUSY_EWMA_ALPHA = 0.2
 
-    def __init__(self, name: str, reconcile: ReconcileFn):
+
+class Controller:
+    """One reconcile loop fed by a deduplicating delayed workqueue.
+
+    Saturation-instrumented (the controller-runtime workqueue metrics
+    analogue, docs/OBSERVABILITY.md "Fleet telemetry & SLOs"): queue depth,
+    enqueue→pop wait latency, requeue counts by reason, and an EWMA
+    worker busy fraction — the per-controller signals reconcile-plane
+    sharding will balance on.  ``metrics`` is stamped by the Manager
+    (``add_controller``/``start``); a standalone controller just skips the
+    bookkeeping.
+    """
+
+    def __init__(self, name: str, reconcile: ReconcileFn, metrics=None):
         self.name = name
         self.reconcile = reconcile
         self.limiter = RateLimiter()
+        self.metrics = metrics
         self._queue: asyncio.Queue[str] = asyncio.Queue()
         self._pending: set[str] = set()  # dedupe: keys queued but not yet popped
+        self._enqueued_ts: dict[str, float] = {}  # key -> monotonic enqueue time
         self._timers: dict[str, asyncio.TimerHandle] = {}
         self._task: Optional[asyncio.Task] = None
+        self._busy_fraction = 0.0
         # run-permission gate installed by the manager: cleared while the
         # process is degraded (breaker open) or deposed (lost leadership);
         # None (standalone controller) means always-run
@@ -71,7 +89,34 @@ class Controller:
         if key in self._pending:
             return
         self._pending.add(key)
+        self._enqueued_ts[key] = time.monotonic()
         self._queue.put_nowait(key)
+        self._report_depth()
+
+    def _report_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.controller_queue_depth.labels(
+                controller=self.name
+            ).set(len(self._pending))
+
+    def _count_requeue(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.controller_requeues_total.labels(
+                controller=self.name, reason=reason
+            ).inc()
+
+    def _observe_iteration(self, idle_s: float, busy_s: float) -> None:
+        total = idle_s + busy_s
+        if total <= 0:
+            return
+        self._busy_fraction = (
+            (1 - _BUSY_EWMA_ALPHA) * self._busy_fraction
+            + _BUSY_EWMA_ALPHA * (busy_s / total)
+        )
+        if self.metrics is not None:
+            self.metrics.controller_busy_fraction.labels(
+                controller=self.name
+            ).set(round(self._busy_fraction, 4))
 
     def enqueue_after(self, key: str, delay: float) -> None:
         """Delayed add; an earlier timer for the same key is replaced only if
@@ -125,8 +170,16 @@ class Controller:
 
     async def _worker(self) -> None:
         while True:
+            wait_t0 = time.monotonic()
             key = await self._queue.get()
+            popped = time.monotonic()
             self._pending.discard(key)
+            self._report_depth()
+            enqueued_at = self._enqueued_ts.pop(key, None)
+            if self.metrics is not None and enqueued_at is not None:
+                self.metrics.controller_queue_latency.labels(
+                    controller=self.name
+                ).observe(max(0.0, popped - enqueued_at))
             try:
                 if self.gate is not None:
                     # paused (degraded / not leader): hold the popped key
@@ -144,10 +197,14 @@ class Controller:
             except Exception:  # noqa: BLE001
                 delay = self.limiter.when(key)
                 log.exception("[%s] reconcile %s failed; retrying in %.2fs", self.name, key, delay)
+                self._count_requeue("failure")
+                self._observe_iteration(popped - wait_t0, time.monotonic() - popped)
                 self.enqueue_after(key, delay)
                 continue
+            self._observe_iteration(popped - wait_t0, time.monotonic() - popped)
             self.limiter.forget(key)
             if requeue is not None:
+                self._count_requeue("scheduled")
                 self.enqueue_after(key, requeue)
 
 
@@ -168,6 +225,8 @@ class Manager:
         tracer=None,
         recorder=None,
         operator_metrics=None,
+        fleet=None,
+        fleet_eval_interval: float = consts.FLEET_EVAL_SECONDS,
     ):
         self.client = client
         self.namespace = namespace
@@ -183,6 +242,12 @@ class Manager:
         # OperatorMetrics for the breaker-state gauge; reconciler setup()
         # fills it in when the binary didn't pass one explicitly
         self.operator_metrics = operator_metrics
+        # obs.fleet.FleetAggregator: backs the /push ingest route and
+        # /debug/fleet, and drives the SLO burn-rate loop.  Reconciler
+        # setup() adopts/donates it the same way as operator_metrics.
+        self.fleet = fleet
+        self.fleet_eval_interval = fleet_eval_interval
+        self._fleet_task: Optional[asyncio.Task] = None
         # --leader-lease-renew-deadline analogue (cmd/gpu-operator
         # main.go:72-81): operators tune these for flaky control planes
         self.lease_duration = lease_duration
@@ -219,6 +284,10 @@ class Manager:
 
     def add_controller(self, controller: Controller) -> Controller:
         controller.gate = self._resume
+        if controller.metrics is None:
+            # saturation series ride the shared registry; setup() order may
+            # fill operator_metrics later, so start() backfills stragglers
+            controller.metrics = self.operator_metrics
         self.controllers.append(controller)
         return controller
 
@@ -249,10 +318,16 @@ class Manager:
         for informer in self.informers.values():
             await informer.start(wait=informer.required)
         for controller in self.controllers:
+            if controller.metrics is None:
+                controller.metrics = self.operator_metrics
             await controller.start()
         self._supervisor = asyncio.create_task(
             self._supervise(), name="manager-supervisor"
         )
+        if self.fleet is not None:
+            self._fleet_task = asyncio.create_task(
+                self._fleet_loop(), name="manager-fleet"
+            )
         self.started.set()
         log.info(
             "manager started: %d informers, %d controllers, ns=%s",
@@ -260,15 +335,17 @@ class Manager:
         )
 
     async def stop(self) -> None:
-        if self._supervisor:
-            self._supervisor.cancel()
-            try:
-                await self._supervisor
-            except asyncio.CancelledError:
-                pass
-            except Exception:  # noqa: BLE001
-                log.debug("manager supervisor errored during stop", exc_info=True)
-            self._supervisor = None
+        for task_attr in ("_supervisor", "_fleet_task"):
+            task = getattr(self, task_attr)
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                except Exception:  # noqa: BLE001
+                    log.debug("manager %s errored during stop", task_attr, exc_info=True)
+                setattr(self, task_attr, None)
         for controller in self.controllers:
             await controller.stop()
         for informer in self.informers.values():
@@ -353,6 +430,41 @@ class Manager:
             await self._flush_events()
             await asyncio.sleep(0.05)
 
+    async def _fleet_loop(self) -> None:
+        """SLO burn-rate evaluation + fleet gauge export at a fixed cadence.
+        Breach/recovery transitions post through the same retry-until-
+        posted Event queue as degraded mode — an SLOBurnRate that fires
+        during an apiserver wobble must still land as evidence."""
+        from tpu_operator.obs import events as fleet_events
+
+        while True:
+            try:
+                if not self._is_leader():
+                    # a standby replica keeps ingesting whatever reaches it
+                    # but must not evaluate: only the leader may post
+                    # SLOBurnRate evidence, or an HA pair double-fires
+                    await asyncio.sleep(self.fleet_eval_interval)
+                    continue
+                transitions = self.fleet.evaluate_slos()
+                for kind, slo, message in transitions:
+                    if kind == "fired":
+                        self._queue_event(
+                            "warning", fleet_events.namespace_ref(self.namespace),
+                            fleet_events.REASON_SLO_BURN_RATE, message,
+                        )
+                        log.warning("SLO burn: %s", message)
+                    else:
+                        self._queue_event(
+                            "normal", fleet_events.namespace_ref(self.namespace),
+                            fleet_events.REASON_SLO_RECOVERED, message,
+                        )
+                        log.info("SLO recovered: %s", message)
+                if self.operator_metrics is not None:
+                    self.fleet.export()
+            except Exception:  # noqa: BLE001 — telemetry loop must not die
+                log.exception("fleet evaluation pass failed")
+            await asyncio.sleep(self.fleet_eval_interval)
+
     def _on_leadership(self, leader: bool) -> None:
         ref = obs_events.lease_ref(self.namespace, consts.LEADER_ELECTION_ID)
         ident = self.elector.identity if self.elector else "unknown"
@@ -402,6 +514,8 @@ class Manager:
         metrics = web.Application()
         metrics.router.add_get("/metrics", self._metrics)
         metrics.router.add_get("/debug/traces", self._traces)
+        metrics.router.add_get("/debug/fleet", self._fleet_snapshot)
+        metrics.router.add_post("/push", self._fleet_push)
         # one server per port unless they coincide
         apps = {}
         if self.health_port >= 0:
@@ -410,6 +524,8 @@ class Manager:
             if self.metrics_port == self.health_port and self.health_port > 0:
                 health.router.add_get("/metrics", self._metrics)
                 health.router.add_get("/debug/traces", self._traces)
+                health.router.add_get("/debug/fleet", self._fleet_snapshot)
+                health.router.add_post("/push", self._fleet_push)
             else:
                 apps[id(metrics)] = (self.metrics_port, metrics)
         for port, app in apps.values():
@@ -457,6 +573,60 @@ class Manager:
     async def _traces(self, request: web.Request) -> web.Response:
         """Recent reconcile span trees (newest first), JSON.  Schema per
         trace: {name, kind, reconcile_id, start_ts, duration_s, attrs?,
-        error?, children?[...]} — see docs/OBSERVABILITY.md."""
+        error?, children?[...]} — see docs/OBSERVABILITY.md.
+
+        Query params: ``?reconcile_id=`` / ``?controller=`` filter (the
+        exemplar ids on /debug/fleet and flight records join here), and
+        ``?limit=N`` caps the response (newest first)."""
         traces = self.tracer.snapshot() if self.tracer is not None else []
+        q = request.rel_url.query
+        rid = q.get("reconcile_id", "")
+        controller = q.get("controller", "")
+        if rid:
+            traces = [t for t in traces if t.get("reconcile_id") == rid]
+        if controller:
+            traces = [
+                t for t in traces
+                if (t.get("attrs") or {}).get("controller") == controller
+            ]
+        limit = q.get("limit", "")
+        if limit:
+            try:
+                traces = traces[: max(0, int(limit))]
+            except ValueError:
+                return web.json_response(
+                    {"error": f"invalid limit {limit!r}"}, status=400
+                )
         return web.json_response({"traces": traces})
+
+    async def _fleet_snapshot(self, request: web.Request) -> web.Response:
+        """Windowed fleet rollups + exemplars + SLO state (obs/fleet.py;
+        docs/OBSERVABILITY.md "Fleet telemetry & SLOs")."""
+        if self.fleet is None:
+            return web.json_response(
+                {"error": "fleet aggregation not enabled"}, status=404
+            )
+        return web.json_response(self.fleet.snapshot())
+
+    async def _fleet_push(self, request: web.Request) -> web.Response:
+        """Fleet ingest: the hop the node metrics agents forward their
+        /push traffic through (TPU_FLEET_PUSH_URL).  Same payload cap as
+        the agent route — both are unauthenticated ports."""
+        from tpu_operator.obs import fleet as fleet_api
+
+        if self.fleet is None:
+            return web.json_response(
+                {"error": "fleet aggregation not enabled"}, status=404
+            )
+        body, error = await fleet_api.read_json_capped(request)
+        if error is not None:
+            if error.status == 413 and self.operator_metrics is not None:
+                self.operator_metrics.fleet_push_rejected_total.labels(
+                    reason="too-large"
+                ).inc()
+            elif error.status == 400 and self.operator_metrics is not None:
+                self.operator_metrics.fleet_push_rejected_total.labels(
+                    reason="bad-json"
+                ).inc()
+            return error
+        return web.json_response({"accepted": self.fleet.ingest_push(body)})

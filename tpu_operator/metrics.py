@@ -186,3 +186,92 @@ class OperatorMetrics:
             "tpu_operator_degraded_mode_entered_total",
             "Times the manager entered degraded mode (breaker opened)",
         )
+        # controller saturation surface (controllers/runtime.py; the signals
+        # reconcile-plane sharding will shed load on — docs/OBSERVABILITY.md
+        # "Fleet telemetry & SLOs")
+        self.controller_queue_depth = Gauge(
+            "tpu_operator_controller_queue_depth",
+            "Keys queued (not yet popped) per controller workqueue",
+            ["controller"],
+            registry=self.registry,
+        )
+        self.controller_queue_latency = Histogram(
+            "tpu_operator_controller_queue_latency_seconds",
+            "Time a key waited in the workqueue between enqueue and pop "
+            "(workqueue_queue_duration_seconds analogue)",
+            ["controller"],
+            registry=self.registry,
+            buckets=DURATION_BUCKETS,
+        )
+        self.controller_requeues_total = Counter(
+            "tpu_operator_controller_requeues_total",
+            "Keys re-enqueued per controller: reason=failure (reconcile "
+            "raised, backoff applied) or scheduled (reconcile asked for a "
+            "delayed revisit)",
+            ["controller", "reason"],
+            registry=self.registry,
+        )
+        self.controller_busy_fraction = Gauge(
+            "tpu_operator_controller_busy_fraction",
+            "EWMA fraction of wall time the controller worker spent "
+            "reconciling vs waiting for work (1.0 = saturated worker)",
+            ["controller"],
+            registry=self.registry,
+        )
+        # fleet telemetry plane (obs/fleet.py): windowed fleet rollups +
+        # aggregator health.  Only ROLLUPS are exported — per-node series
+        # stay inside the ring so operator-registry cardinality is bounded
+        # by the metric catalogue, not the fleet size.
+        self.fleet_quantile = Gauge(
+            "tpu_operator_fleet_quantile",
+            "Windowed fleet rollup per metric (default window): "
+            "quantile is p50/p90/p99/min/max/mean/count",
+            ["metric", "quantile"],
+            registry=self.registry,
+        )
+        self.fleet_series = g(
+            "tpu_operator_fleet_series",
+            "Distinct (metric, labels) series currently held in the "
+            "aggregator's ring buffers",
+        )
+        self.fleet_nodes_reporting = g(
+            "tpu_operator_fleet_nodes_reporting",
+            "Distinct node label values seen across fleet series in the "
+            "default window",
+        )
+        self.fleet_samples_ingested_total = Counter(
+            "tpu_operator_fleet_samples_ingested_total",
+            "Samples ingested into the fleet aggregator, by source "
+            "(span | push | node)",
+            ["source"],
+            registry=self.registry,
+        )
+        self.fleet_push_rejected_total = Counter(
+            "tpu_operator_fleet_push_rejected_total",
+            "Fleet ingest pushes rejected, by reason "
+            "(too-large | bad-json | bad-shape | unknown-metric | series-cap)",
+            ["reason"],
+            registry=self.registry,
+        )
+        # declarative SLO engine (obs/fleet.py SLOEngine)
+        self.slo_burn_rate = Gauge(
+            "tpu_operator_slo_burn_rate",
+            "Error-budget burn rate per SLO per evaluation window "
+            "(1.0 = spending exactly the budget; alert thresholds are "
+            "per-SLO burnRateThreshold)",
+            ["slo", "window"],
+            registry=self.registry,
+        )
+        self.slo_breached = Gauge(
+            "tpu_operator_slo_breached",
+            "1 while the SLO's multi-window burn-rate condition holds "
+            "(SLOBurnRate fired, SLORecovered pending)",
+            ["slo"],
+            registry=self.registry,
+        )
+        self.slo_transitions_total = Counter(
+            "tpu_operator_slo_transitions_total",
+            "SLO breach/recovery transitions, by kind (fired | recovered)",
+            ["slo", "kind"],
+            registry=self.registry,
+        )

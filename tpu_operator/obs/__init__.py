@@ -17,4 +17,9 @@ in-tree equivalent every controller and the apply layer report through:
   tagged with the active span id, persisted next to the workload's
   result drop-box and pushed to the node metrics agent's ``/push``
   endpoint for live ``source="workload"`` Prometheus series.
+- ``obs.fleet``   — the fleet telemetry plane: ring-buffer time series
+  aggregating spans, the agents' push hop, and informer-cached node
+  evidence into windowed rollups (``/debug/fleet``,
+  ``tpu_operator_fleet_*``) plus the declarative SLO burn-rate engine
+  (``SLOBurnRate``/``SLORecovered`` Events, health-engine signal).
 """

@@ -46,6 +46,10 @@ REASON_NODE_RECOVERED = "NodeRecovered"
 REASON_NODE_QUARANTINED = "NodeQuarantined"
 REASON_HEALTH_BUDGET_EXHAUSTED = "HealthBudgetExhausted"
 REASON_HEALTH_BUDGET_RESTORED = "HealthBudgetRestored"
+# fleet SLO engine (obs/fleet.py; docs/OBSERVABILITY.md "Fleet telemetry
+# & SLOs"): multi-window burn-rate breach / recovery
+REASON_SLO_BURN_RATE = "SLOBurnRate"
+REASON_SLO_RECOVERED = "SLORecovered"
 # resilience surface (docs/ROBUSTNESS.md): degraded mode + leadership
 REASON_DEGRADED = "DegradedMode"
 REASON_DEGRADED_RECOVERED = "DegradedModeRecovered"

@@ -1,0 +1,724 @@
+"""Fleet telemetry plane: in-operator aggregation + declarative SLOs.
+
+PRs 1-2 gave every *process* eyes — spans, Events, flight records, per-node
+agent pushes — but nothing saw the *fleet*: join→validated latency,
+workload-regression rates, and controller-queue saturation existed only as
+scattered per-node samples.  This module is the aggregation layer the
+scale roadmap (sharding, scored placement, elastic pools) gates on:
+
+- :class:`FleetAggregator` — a TSDB-lite: fixed-size ring-buffer time
+  series keyed on ``(metric, labels)``, ingesting
+
+  * the operator's own spans (reconcile durations, tagged with exemplar
+    span ids so an SLO breach jumps straight to ``/debug/traces``),
+  * the node agents' push hop (``metrics_agent`` forwards its ``/push``
+    traffic to the operator's fleet ingest route when
+    ``TPU_FLEET_PUSH_URL`` is set),
+  * informer-cached node evidence (join→validated transitions, health
+    verdict counts) — collected during reconcile passes that already hold
+    the node list, so aggregation adds ZERO steady-state API verbs.
+
+  Windowed rollups (count/min/max/mean/p50/p90/p99) are served as JSON at
+  ``/debug/fleet`` and exported as bounded ``tpu_operator_fleet_*`` gauges
+  — per-node series stay inside the ring; only rollups reach Prometheus,
+  so registry cardinality is bounded by the metric catalogue, not the
+  fleet size (hack/check_metric_labels.py enforces the same discipline
+  tree-wide).
+
+- :class:`SLOEngine` — multi-window burn-rate evaluation of the
+  ``observability.slos`` ClusterPolicy spec: the burn rate per window is
+  ``bad_fraction / (1 - objective)``; a breach requires EVERY window to
+  burn past the threshold (the long window proves the budget spend is
+  real, the short window proves it is still happening — the Google-SRE
+  multi-window discipline), recovery requires the shortest window to go
+  quiet.  Transitions emit ``SLOBurnRate`` / ``SLORecovered`` Events via
+  the Manager and feed the health engine as an additional central signal
+  (per-node offender sets).
+
+Everything here is best-effort telemetry: ingest never raises into a
+reconcile pass, and a full ring simply forgets the oldest samples.
+"""
+
+from __future__ import annotations
+
+import calendar
+import json
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import SLOSpec
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.obs.fleet")
+
+# ---------------------------------------------------------------------------
+# Fleet metric catalogue.  Push ingest accepts exactly these names (plus the
+# tpu_workload_* family pattern, which mirrors the metrics agent's
+# WORKLOAD_COUNTERS without importing the agents package) — an unknown name
+# is rejected and counted, never silently stored: the exported rollup
+# surface must stay the documented catalogue.
+METRIC_RECONCILE_DURATION = "reconcile_duration_seconds"
+METRIC_JOIN_TO_VALIDATED = "join_to_validated_seconds"
+METRIC_HEALTH_UNHEALTHY = "health_verdict_unhealthy_nodes"
+METRIC_CHIP_SCRAPE_ERRORS = "chip_scrape_errors_total"
+
+_WORKLOAD_METRIC_PREFIX = "tpu_workload_"
+_METRIC_NAME_MAX = 128
+
+OPERATOR_METRICS_CATALOGUE = (
+    METRIC_RECONCILE_DURATION,
+    METRIC_JOIN_TO_VALIDATED,
+    METRIC_HEALTH_UNHEALTHY,
+    METRIC_CHIP_SCRAPE_ERRORS,
+)
+
+# ingest sources (fleet_samples_ingested_total label values)
+SOURCE_SPAN = "span"
+SOURCE_PUSH = "push"
+SOURCE_NODE = "node"
+
+_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+# exemplars kept per metric: enough to jump from a breach to a handful of
+# recent traces, small enough to never matter
+_EXEMPLARS_PER_METRIC = 8
+
+
+def _valid_metric_name(name: str) -> bool:
+    if not isinstance(name, str) or not name or len(name) > _METRIC_NAME_MAX:
+        return False
+    if name in OPERATOR_METRICS_CATALOGUE:
+        return True
+    return name.startswith(_WORKLOAD_METRIC_PREFIX) and name.replace(
+        "_", ""
+    ).isalnum() and name == name.lower()
+
+
+def quantile(sorted_values: list, q: float) -> float:
+    """Linear-interpolated quantile over an ascending list (the numpy
+    'linear' method, so tests can pin rollups against hand-computed ground
+    truth)."""
+    if not sorted_values:
+        raise ValueError("quantile of empty list")
+    if len(sorted_values) == 1:
+        return float(sorted_values[0])
+    pos = q * (len(sorted_values) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return float(sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac)
+
+
+def _parse_k8s_ts(value: str) -> Optional[float]:
+    """``2026-08-04T12:00:00Z`` → unix seconds (the only shape the fake and
+    real apiservers emit for creationTimestamp); None when unparsable.
+    timegm, not mktime: the timestamp is UTC, and a local-zone conversion
+    would skew every join sample by the DST offset."""
+    try:
+        return float(calendar.timegm(time.strptime(value, "%Y-%m-%dT%H:%M:%SZ")))
+    except (TypeError, ValueError):
+        return None
+
+
+async def read_json_capped(request, limit: int = consts.PUSH_MAX_BYTES):
+    """Size-guarded JSON body read shared by the metrics agent's ``/push``
+    and the operator's fleet ingest route (both unauthenticated ports).
+    Returns ``(body, None)`` or ``(None, error_response)`` — 413 past the
+    cap (declared Content-Length or actual bytes), 400 on bad JSON."""
+    from aiohttp import web
+
+    if request.content_length is not None and request.content_length > limit:
+        return None, web.json_response(
+            {"error": f"payload exceeds {limit} bytes"}, status=413
+        )
+    # read() must LOOP: StreamReader.read(n) returns whatever is buffered
+    # once any bytes arrive, and a body spanning several TCP segments would
+    # otherwise be truncated into a spurious 400
+    chunks: list[bytes] = []
+    remaining = limit + 1
+    while remaining > 0:
+        chunk = await request.content.read(remaining)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    body = b"".join(chunks)
+    if len(body) > limit:
+        return None, web.json_response(
+            {"error": f"payload exceeds {limit} bytes"}, status=413
+        )
+    try:
+        return json.loads(body), None
+    except (UnicodeDecodeError, ValueError):
+        return None, web.json_response({"error": "invalid JSON"}, status=400)
+
+
+def _window_labels(windows: Iterable) -> set:
+    out = set()
+    for w in windows or []:
+        try:
+            out.add(f"{float(w):g}s")
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class _Series:
+    __slots__ = ("samples", "ordered")
+
+    def __init__(self, maxlen: int):
+        # (ts, value) tuples, append-only, oldest evicted by the ring bound
+        self.samples: deque = deque(maxlen=maxlen)
+        # True while appends arrive in non-decreasing ts order (the live
+        # path always does; tests ingest synthetic timestamps) — lets
+        # window scans walk newest-first and stop at the cutoff instead of
+        # touching every sample of every ring each evaluation
+        self.ordered = True
+
+    def append(self, ts: float, value: float) -> None:
+        if self.samples and ts < self.samples[-1][0]:
+            self.ordered = False
+        self.samples.append((ts, value))
+
+    def window(self, cutoff: float) -> Iterable[tuple[float, float]]:
+        if not self.ordered:
+            return [s for s in self.samples if s[0] >= cutoff]
+        out = []
+        for s in reversed(self.samples):
+            if s[0] < cutoff:
+                break
+            out.append(s)
+        return out
+
+
+class SLOEngine:
+    """Multi-window burn-rate evaluation over a :class:`FleetAggregator`."""
+
+    def __init__(self, aggregator: "FleetAggregator", metrics=None):
+        self.aggregator = aggregator
+        self.metrics = metrics
+        self.slos: dict[str, SLOSpec] = {}
+        self.breached: dict[str, bool] = {}
+        # slo name -> {node -> bad sample count} in the shortest window,
+        # refreshed each evaluation while breached (health-engine signal)
+        self._offenders: dict[str, dict[str, int]] = {}
+
+    def configure(self, slo_dicts: Iterable[dict]) -> None:
+        """(Re)parse the declarative spec; breach state survives for SLOs
+        that keep their name, removed SLOs drop their state and gauges."""
+        parsed: dict[str, SLOSpec] = {}
+        for entry in slo_dicts or []:
+            if not isinstance(entry, dict):
+                continue
+            slo = SLOSpec.from_dict(entry)
+            if not slo.name or not slo.metric or not slo.windows:
+                continue
+            parsed[slo.name] = slo
+        for gone in set(self.slos) - set(parsed):
+            self._drop_gauges(gone, self.slos[gone].windows)
+            self.breached.pop(gone, None)
+            self._offenders.pop(gone, None)
+        for kept, slo in parsed.items():
+            old = self.slos.get(kept)
+            if old is None:
+                continue
+            # a retained SLO whose window set changed must drop the
+            # no-longer-evaluated window label sets, or their burn gauges
+            # freeze at the last value forever
+            self._drop_burn_windows(
+                kept, _window_labels(old.windows) - _window_labels(slo.windows)
+            )
+        self.slos = parsed
+
+    def _drop_burn_windows(self, name: str, window_labels: Iterable[str]) -> None:
+        if self.metrics is None:
+            return
+        for label in window_labels:
+            try:
+                self.metrics.slo_burn_rate.remove(name, label)
+            except KeyError:
+                pass
+
+    def _drop_gauges(self, name: str, windows: Iterable[float]) -> None:
+        """A deleted SLO must not leave slo_breached latched at its last
+        value — Prometheus would page on a ghost forever."""
+        if self.metrics is None:
+            return
+        try:
+            self.metrics.slo_breached.remove(name)
+        except KeyError:
+            pass
+        self._drop_burn_windows(name, _window_labels(windows))
+
+    # ------------------------------------------------------------------
+    def _good(self, slo: SLOSpec, value: float) -> bool:
+        if slo.threshold is None:
+            return True
+        if slo.comparison == "ge":
+            return value >= slo.threshold
+        return value <= slo.threshold
+
+    def _window_burn(
+        self, slo: SLOSpec, window_s: float, now: float
+    ) -> tuple[Optional[float], dict[str, int]]:
+        """(burn rate or None when the window lacks evidence, per-node bad
+        counts).  Burn 0.0 means samples exist and all are good."""
+        rows = self.aggregator.window_samples(slo.metric, window_s, now)
+        if len(rows) < max(1, slo.min_samples):
+            return None, {}
+        bad = 0
+        bad_nodes: dict[str, int] = {}
+        for value, labels in rows:
+            if not self._good(slo, value):
+                bad += 1
+                node = labels.get("node", "")
+                if node:
+                    bad_nodes[node] = bad_nodes.get(node, 0) + 1
+        budget = max(1e-9, 1.0 - slo.objective)
+        return (bad / len(rows)) / budget, bad_nodes
+
+    def evaluate(self, now: Optional[float] = None) -> list[tuple[str, str, str]]:
+        """One evaluation pass over every configured SLO.  Returns breach
+        transitions as ``(kind, slo_name, message)`` with kind ``fired`` or
+        ``recovered`` — the caller (Manager) turns them into Events."""
+        now = time.time() if now is None else now
+        transitions: list[tuple[str, str, str]] = []
+        for name, slo in self.slos.items():
+            windows = sorted(float(w) for w in slo.windows if float(w) > 0)
+            if not windows:
+                continue
+            burns: dict[float, Optional[float]] = {}
+            offenders: dict[str, int] = {}
+            for w in windows:
+                burn, bad_nodes = self._window_burn(slo, w, now)
+                burns[w] = burn
+                if w == windows[0]:
+                    offenders = bad_nodes
+                if self.metrics is not None:
+                    self.metrics.slo_burn_rate.labels(
+                        slo=name, window=f"{w:g}s"
+                    ).set(burn or 0.0)
+            was = self.breached.get(name, False)
+            all_burning = all(
+                b is not None and b >= slo.burn_rate_threshold
+                for b in burns.values()
+            )
+            # recovery needs EVIDENCE of recovery: the shortest window must
+            # hold samples and burn under the threshold.  Telemetry going
+            # dark right after a breach (agents crashed, push hop down)
+            # must NOT clear the alert it caused — the breach holds until
+            # good samples arrive or the whole episode ages out of even
+            # the longest window (nothing left to judge).
+            short_quiet = (
+                burns[windows[0]] is not None
+                and burns[windows[0]] < slo.burn_rate_threshold
+            )
+            all_dark = all(b is None for b in burns.values())
+            if not was and all_burning:
+                self.breached[name] = True
+                self._offenders[name] = offenders
+                detail = ", ".join(
+                    f"{w:g}s={burns[w]:.2f}x" for w in windows
+                )
+                transitions.append((
+                    "fired", name,
+                    f"SLO {name} ({slo.metric}) burning past "
+                    f"{slo.burn_rate_threshold:g}x on every window: {detail}",
+                ))
+            elif was and (short_quiet or all_dark):
+                self.breached[name] = False
+                self._offenders.pop(name, None)
+                transitions.append((
+                    "recovered", name,
+                    f"SLO {name} ({slo.metric}) "
+                    + (
+                        "burn rate back under "
+                        f"{slo.burn_rate_threshold:g}x in the "
+                        f"{windows[0]:g}s window"
+                        if short_quiet
+                        else "episode aged out of every window (no samples "
+                             "left to judge)"
+                    ),
+                ))
+            elif was:
+                # still breached: keep the offender set current so the
+                # health engine tracks the nodes that are bad NOW
+                self._offenders[name] = offenders
+            if self.metrics is not None:
+                self.metrics.slo_breached.labels(slo=name).set(
+                    1 if self.breached.get(name) else 0
+                )
+                for kind, tname, _ in transitions:
+                    if tname == name:
+                        self.metrics.slo_transitions_total.labels(
+                            slo=name, kind=kind
+                        ).inc()
+        return transitions
+
+    # ------------------------------------------------------------------
+    def breached_slos(self) -> dict[str, SLOSpec]:
+        return {n: self.slos[n] for n, b in self.breached.items() if b and n in self.slos}
+
+    def node_offenders(self, node: str) -> list[str]:
+        """SLO names currently breached with this node among the bad
+        samples of the shortest window — the health engine observes these
+        as sustained ``slo:<name>`` signals.  Only SLOs that opted in via
+        ``feedHealthEngine`` participate: fleet ingest is unauthenticated,
+        and a spoofed push must not be able to march nodes onto the
+        remediation ladder unless the operator explicitly coupled that
+        SLO to actuation."""
+        return sorted(
+            name
+            for name, bad_nodes in self._offenders.items()
+            if self.breached.get(name)
+            and node in bad_nodes
+            and name in self.slos
+            and self.slos[name].feed_health_engine
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            name: {
+                "metric": slo.metric,
+                "objective": slo.objective,
+                "threshold": slo.threshold,
+                "comparison": slo.comparison,
+                "windows": [float(w) for w in slo.windows],
+                "burn_rate_threshold": slo.burn_rate_threshold,
+                "breached": bool(self.breached.get(name)),
+                "offenders": sorted((self._offenders.get(name) or {})),
+            }
+            for name, slo in self.slos.items()
+        }
+
+
+class FleetAggregator:
+    """Ring-buffer fleet time series + rollups + the SLO engine.
+
+    Thread-safe on a plain lock: ingest arrives from the event loop (push
+    route, reconcile passes) and from span completion, which validator-side
+    tracers may drive off-loop."""
+
+    def __init__(
+        self,
+        metrics=None,
+        ring_samples: int = consts.FLEET_RING_SAMPLES,
+        max_series: int = consts.FLEET_MAX_SERIES,
+    ):
+        self.metrics = metrics
+        self.ring_samples = ring_samples
+        self.max_series = max_series
+        # metric → labels-key → series: window scans touch only the
+        # queried metric's bucket, not every series in the aggregator
+        self._series: dict[str, dict[tuple, _Series]] = {}
+        self._n_series = 0
+        self._exemplars: dict[str, deque] = {}
+        # metrics whose rollup gauges are currently exported; emptied
+        # windows remove their label sets instead of freezing stale values
+        self._exported: set[str] = set()
+        self._lock = threading.Lock()
+        self.slo_engine = SLOEngine(self, metrics)
+        # join→validated transition tracking: node -> last seen validated?
+        self._node_validated: dict[str, bool] = {}
+        # nodes whose join has been ingested: once per node LIFETIME — a
+        # lagging watch briefly showing a node unvalidated again must not
+        # re-fire the transition and double-count the join
+        self._node_joined: set[str] = set()
+        # throttle for the gauge-style health verdict series
+        self._last_unhealthy: Optional[tuple[float, float]] = None  # (ts, count)
+
+    # ------------------------------------------------------------------
+    # Ingest.
+
+    def ingest(
+        self,
+        metric: str,
+        value: float,
+        labels: Optional[dict] = None,
+        ts: Optional[float] = None,
+        exemplar: Optional[dict] = None,
+        source: str = SOURCE_PUSH,
+    ) -> bool:
+        """One sample; False when rejected (bad name/value, series cap)."""
+        if not _valid_metric_name(metric):
+            self._reject("unknown-metric")
+            return False
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            self._reject("bad-shape")
+            return False
+        if not math.isfinite(value):
+            self._reject("bad-shape")
+            return False
+        labels_key = tuple(sorted((labels or {}).items()))
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            bucket = self._series.setdefault(metric, {})
+            series = bucket.get(labels_key)
+            if series is None:
+                if self._n_series >= self.max_series:
+                    if not bucket:
+                        del self._series[metric]
+                    self._reject("series-cap")
+                    return False
+                series = bucket[labels_key] = _Series(self.ring_samples)
+                self._n_series += 1
+            series.append(ts, value)
+            if exemplar:
+                self._exemplars.setdefault(
+                    metric, deque(maxlen=_EXEMPLARS_PER_METRIC)
+                ).append({"ts": round(ts, 3), "value": value, **exemplar})
+        if self.metrics is not None:
+            self.metrics.fleet_samples_ingested_total.labels(source=source).inc()
+        return True
+
+    def _reject(self, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.fleet_push_rejected_total.labels(reason=reason).inc()
+
+    def observe_span(self, span) -> None:
+        """Span-completion hook (obs.trace.Tracer.fleet): reconcile root
+        spans become fleet duration samples carrying exemplar span ids, so
+        a breach on the reconcile-latency SLO points at concrete traces
+        (``/debug/traces?reconcile_id=``)."""
+        try:
+            from tpu_operator.obs import trace as obs_trace
+
+            if span.kind != obs_trace.KIND_RECONCILE or span.duration_s is None:
+                return
+            self.ingest(
+                METRIC_RECONCILE_DURATION,
+                span.duration_s,
+                {"controller": span.attrs.get("controller", "")},
+                exemplar={
+                    "span_id": span.span_id,
+                    "reconcile_id": span.reconcile_id,
+                },
+                source=SOURCE_SPAN,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry must never fail a span
+            log.debug("fleet span observation failed: %s", e)
+
+    def ingest_push(self, body: Any) -> int:
+        """One forwarded agent push::
+
+            {"node": "tpu-0-0",
+             "workloads": {"train": {"counters": {"tpu_workload_mfu": 0.95}}},
+             "chips": {"scrape_errors_total": 3}}
+
+        Returns accepted sample count; malformed shapes are counted and
+        skipped, never raised (the route answers 400/413 for body-level
+        problems before this runs)."""
+        if not isinstance(body, dict):
+            self._reject("bad-shape")
+            return 0
+        node = str(body.get("node") or "")
+        accepted = 0
+        workloads = body.get("workloads")
+        if isinstance(workloads, dict):
+            for check, entry in workloads.items():
+                counters = (entry or {}).get("counters") if isinstance(entry, dict) else None
+                if not isinstance(counters, dict):
+                    self._reject("bad-shape")
+                    continue
+                for counter, value in counters.items():
+                    labels = {"workload": str(check)}
+                    if node:
+                        labels["node"] = node
+                    if self.ingest(counter, value, labels, source=SOURCE_PUSH):
+                        accepted += 1
+        chips = body.get("chips")
+        if isinstance(chips, dict):
+            value = chips.get("scrape_errors_total")
+            if value is not None and self.ingest(
+                METRIC_CHIP_SCRAPE_ERRORS, value,
+                {"node": node} if node else {}, source=SOURCE_PUSH,
+            ):
+                accepted += 1
+        return accepted
+
+    def collect_nodes(self, nodes: list[dict], now: Optional[float] = None) -> None:
+        """Derive fleet samples from informer-cached Node objects during a
+        reconcile pass — the pass already holds the list, so this costs
+        zero API verbs.  join→validated is TRANSITION-only: a node first
+        seen already validated contributes nothing (a restarted operator
+        must not re-ingest stale joins with inflated values)."""
+        now = time.time() if now is None else now
+        live: set[str] = set()
+        unhealthy = 0
+        for node in nodes:
+            name = deep_get(node, "metadata", "name", default="")
+            if not name:
+                continue
+            live.add(name)
+            labels = deep_get(node, "metadata", "labels", default={}) or {}
+            if labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY:
+                unhealthy += 1
+            validated = consts.TPU_RESOURCE in (
+                deep_get(node, "status", "allocatable") or {}
+            )
+            prev = self._node_validated.get(name)
+            self._node_validated[name] = validated
+            if validated and prev is False and name not in self._node_joined:
+                self._node_joined.add(name)
+                created = _parse_k8s_ts(
+                    deep_get(node, "metadata", "creationTimestamp", default="")
+                )
+                if created is not None:
+                    self.ingest(
+                        METRIC_JOIN_TO_VALIDATED,
+                        max(0.0, now - created),
+                        {"node": name},
+                        ts=now,
+                        source=SOURCE_NODE,
+                    )
+        for gone in set(self._node_validated) - live:
+            del self._node_validated[gone]
+            self._node_joined.discard(gone)
+        # gauge-style series, throttled: ingest on change or every 5s
+        last = self._last_unhealthy
+        if last is None or last[1] != unhealthy or now - last[0] >= 5.0:
+            self._last_unhealthy = (now, float(unhealthy))
+            self.ingest(
+                METRIC_HEALTH_UNHEALTHY, float(unhealthy), ts=now,
+                source=SOURCE_NODE,
+            )
+
+    # ------------------------------------------------------------------
+    # SLO plumbing.
+
+    def configure_slos(self, slo_dicts: Iterable[dict]) -> None:
+        self.slo_engine.configure(slo_dicts)
+
+    def evaluate_slos(self, now: Optional[float] = None) -> list[tuple[str, str, str]]:
+        return self.slo_engine.evaluate(now)
+
+    def node_slo_offenders(self, node: str) -> list[str]:
+        return self.slo_engine.node_offenders(node)
+
+    # ------------------------------------------------------------------
+    # Rollups.
+
+    def window_samples(
+        self, metric: str, window_s: float, now: Optional[float] = None
+    ) -> list[tuple[float, dict]]:
+        """``(value, labels)`` for every sample of ``metric`` within the
+        window, across all series."""
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        out: list[tuple[float, dict]] = []
+        with self._lock:
+            for labels_key, series in (self._series.get(metric) or {}).items():
+                labels = dict(labels_key)
+                for _ts, value in series.window(cutoff):
+                    out.append((value, labels))
+        return out
+
+    def rollup(
+        self, metric: str, window_s: float, now: Optional[float] = None
+    ) -> Optional[dict]:
+        values = sorted(v for v, _ in self.window_samples(metric, window_s, now))
+        if not values:
+            return None
+        return {
+            "count": len(values),
+            "min": values[0],
+            "max": values[-1],
+            "mean": sum(values) / len(values),
+            **{q: quantile(values, frac) for q, frac in _QUANTILES},
+        }
+
+    def metrics_held(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return self._n_series
+
+    def nodes_reporting(self, window_s: float, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        nodes: set[str] = set()
+        with self._lock:
+            for bucket in self._series.values():
+                for labels_key, series in bucket.items():
+                    node = dict(labels_key).get("node")
+                    if not node or node in nodes or not series.samples:
+                        continue
+                    newest = (
+                        series.samples[-1][0]
+                        if series.ordered
+                        else max(ts for ts, _ in series.samples)
+                    )
+                    if newest >= cutoff:
+                        nodes.add(node)
+        return len(nodes)
+
+    def snapshot(
+        self,
+        windows: Iterable[float] = consts.FLEET_WINDOWS,
+        now: Optional[float] = None,
+    ) -> dict:
+        """The ``/debug/fleet`` document: per-metric windowed rollups,
+        recent exemplars (joinable against ``/debug/traces``), SLO state,
+        and aggregator health."""
+        now = time.time() if now is None else now
+        windows = [float(w) for w in windows]
+        metrics: dict[str, dict] = {}
+        for metric in self.metrics_held():
+            per_window = {
+                f"{w:g}s": self.rollup(metric, w, now) for w in windows
+            }
+            metrics[metric] = {k: v for k, v in per_window.items() if v}
+        with self._lock:
+            exemplars = {m: list(d) for m, d in self._exemplars.items() if d}
+            n_series = self._n_series
+        return {
+            "ts": round(now, 3),
+            "windows_s": windows,
+            "series": n_series,
+            "nodes_reporting": self.nodes_reporting(max(windows), now),
+            "metrics": metrics,
+            "exemplars": exemplars,
+            "slos": self.slo_engine.snapshot(),
+        }
+
+    def export(
+        self, window_s: float = 300.0, now: Optional[float] = None
+    ) -> None:
+        """Refresh the bounded ``tpu_operator_fleet_*`` gauges from the
+        default window's rollups (called by the Manager's fleet loop)."""
+        if self.metrics is None:
+            return
+        now = time.time() if now is None else now
+        _QUANTILE_KEYS = ("p50", "p90", "p99", "min", "max", "mean", "count")
+        for metric in self.metrics_held():
+            roll = self.rollup(metric, window_s, now)
+            if roll is None:
+                # a metric whose samples aged out of the window must drop
+                # its label sets, not freeze hours-stale rollups on the
+                # registry with no staleness marker
+                if metric in self._exported:
+                    self._exported.discard(metric)
+                    for q in _QUANTILE_KEYS:
+                        try:
+                            self.metrics.fleet_quantile.remove(metric, q)
+                        except KeyError:
+                            pass
+                continue
+            self._exported.add(metric)
+            for q in _QUANTILE_KEYS:
+                self.metrics.fleet_quantile.labels(
+                    metric=metric, quantile=q
+                ).set(roll[q])
+        self.metrics.fleet_series.set(self.series_count())
+        self.metrics.fleet_nodes_reporting.set(
+            self.nodes_reporting(window_s, now)
+        )

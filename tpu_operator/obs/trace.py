@@ -123,8 +123,12 @@ class Tracer:
     (standalone validator / workload processes).
     """
 
-    def __init__(self, metrics=None, max_traces: int = DEFAULT_MAX_TRACES):
+    def __init__(self, metrics=None, max_traces: int = DEFAULT_MAX_TRACES, fleet=None):
         self.metrics = metrics
+        # optional obs.fleet.FleetAggregator sink: completed reconcile root
+        # spans become fleet duration samples carrying exemplar span ids,
+        # so an SLO breach jumps straight to /debug/traces?reconcile_id=
+        self.fleet = fleet
         self.traces: deque = deque(maxlen=max_traces)  # newest first
         self._lock = threading.Lock()
 
@@ -193,6 +197,8 @@ class Tracer:
             return list(self.traces)
 
     def _observe(self, sp: Span) -> None:
+        if self.fleet is not None:
+            self.fleet.observe_span(sp)  # swallows its own failures
         m = self.metrics
         if m is None or sp.duration_s is None:
             return

@@ -166,7 +166,16 @@ def _device_plugin_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> di
 
 
 def _metrics_agent_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
-    return {"metrics_agent": {"host_port": spec.metrics_agent.host_port}}
+    return {
+        "metrics_agent": {
+            "host_port": spec.metrics_agent.host_port,
+            # the fleet telemetry hop (obs/fleet.py): agents forward their
+            # /push traffic to the operator metrics Service's ingest route
+            "fleet_push_url": (
+                f"http://tpu-operator-metrics.{ctx.namespace}.svc:8080/push"
+            ),
+        },
+    }
 
 
 def _metrics_exporter_extras(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
