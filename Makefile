@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health fleet-obs lint counters-docs async-lint except-lint metric-labels all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
 # the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint metric-labels unit-test chaos chaos-health fleet-obs
+test: lint counters-docs async-lint except-lint metric-labels trace-lint unit-test chaos chaos-health fleet-obs
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -30,6 +30,12 @@ metric-labels:
 # swallows hide the failure taxonomy (docs/ROBUSTNESS.md)
 except-lint:
 	$(PYTHON) hack/check_exception_hygiene.py
+
+# pod-side span call sites must run under an adopted/activated tracer and
+# every TPU_* env contract the render layer stamps must be documented
+# (docs/OBSERVABILITY.md "Causal tracing & explain")
+trace-lint:
+	$(PYTHON) hack/check_trace_propagation.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal

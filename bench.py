@@ -740,6 +740,14 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
     evaluation window and SLORecovered after the fault clears, the
     controller saturation gauges move under load and return to idle, and
     aggregation adds ZERO steady-state API verbs per reconcile pass.
+
+    The causal-tracing phase (ISSUE 8 acceptance) follows ONE trace id end
+    to end: rendered validator DS env (TPU_TRACEPARENT) → adopted
+    validator-side span → flight sample → join-phase push → fleet
+    exemplar → /debug/explain trace link → /debug/traces?trace_id= hit;
+    join-phase rollups must sum to join_to_validated within 2% with
+    compile dominant, and a deploy-gated stuck node's /debug/explain must
+    name the correct blocking phase.
     """
     import random
 
@@ -753,7 +761,10 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
     from tpu_operator.controllers.runtime import Manager
     from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
     from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs import trace as trace_api
     from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.explain import ExplainEngine
     from tpu_operator.obs.fleet import FleetAggregator
     from tpu_operator.obs.trace import Tracer
     from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
@@ -782,15 +793,17 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
         recorder = EventRecorder(client, NS)
         fleet = FleetAggregator(metrics)
         tracer = Tracer(metrics, fleet=fleet)
+        explain = ExplainEngine(fleet=fleet, tracer=tracer)
+        recorder.sink = explain.observe_event
         mgr = Manager(
             client, NS, metrics_port=0, health_port=-1,
             metrics_registry=metrics.registry, recorder=recorder,
             operator_metrics=metrics, tracer=tracer, fleet=fleet,
-            fleet_eval_interval=0.25,
+            explain=explain, fleet_eval_interval=0.25,
         )
         reconciler = ClusterPolicyReconciler(
             client, NS, metrics=metrics, tracer=tracer, recorder=recorder,
-            fleet=fleet,
+            fleet=fleet, explain=explain,
         )
         ctrl = reconciler.setup(mgr)
         try:
@@ -957,6 +970,160 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
                         time.perf_counter() - t_rec, 3
                     )
 
+                    # -- phase D: causal tracing end to end ---------------
+                    # D1: the rendered validator DS carries the rollout
+                    # trace context (operator → pod env)
+                    ds = await client.get(
+                        "apps", "DaemonSet", "tpu-operator-validator", NS
+                    )
+                    ds_env = deep_get(
+                        ds, "spec", "template", "spec", "containers", 0,
+                        "env", default=[],
+                    ) or []
+                    traceparent = next(
+                        (e.get("value", "") for e in ds_env
+                         if e.get("name") == trace_api.TRACEPARENT_ENV), "",
+                    )
+                    rollout_ctx = trace_api.TraceContext.parse(traceparent)
+                    result["rendered_traceparent"] = traceparent
+                    sample_trace_ok = False
+                    if rollout_ctx is not None:
+                        # D2: validator-side adoption — a flight sample
+                        # recorded under an adopted phase span carries the
+                        # SAME trace id (pod env → spans → flight record)
+                        local_rec = flight_api.FlightRecorder()
+                        local_tracer = trace_api.Tracer()
+                        with local_tracer.adopt(rollout_ctx):
+                            with local_tracer.span(
+                                "validate/jax", kind=trace_api.KIND_PHASE,
+                                phase="jax",
+                            ):
+                                sample = local_rec.record(
+                                    "allreduce", phase="compile", compile_s=8.0
+                                )
+                        sample_trace_ok = (
+                            sample.get("trace_id") == rollout_ctx.trace_id
+                        )
+                    result["flight_sample_trace_ok"] = sample_trace_ok
+
+                    # D3: per-node join-phase pushes (the simulated
+                    # validator/agent hop), each summing EXACTLY to the
+                    # node's measured join_to_validated and
+                    # compile-dominant — the before-picture ROADMAP item
+                    # 5's compile cache must beat
+                    fracs = {
+                        "runtime-ready": 0.10, "validator-scheduled": 0.12,
+                        "plugin-advertised": 0.13, "compile": 0.45,
+                        "collective": 0.20,
+                    }
+                    phased_nodes = 0
+                    explained_ok = False
+                    for i in range(n_nodes):
+                        node = f"tpu-{i // 4}-{i % 4}"
+                        async with http.get(
+                            f"{base_url}/debug/explain", params={"node": node}
+                        ) as resp:
+                            doc = await resp.json()
+                        total = (doc.get("join") or {}).get(
+                            "join_to_validated_seconds"
+                        )
+                        if total is None:
+                            continue
+                        async with http.post(push_url, json={
+                            "node": node,
+                            "trace_id": rollout_ctx.trace_id if rollout_ctx else "",
+                            "join_phases": {
+                                p: round(total * f, 6) for p, f in fracs.items()
+                            },
+                        }) as resp:
+                            assert resp.status == 200, await resp.text()
+                        phased_nodes += 1
+                        if not explained_ok:
+                            # D5: the explain doc for a validated node must
+                            # close the loop — trace id linked, verdict
+                            # validated, and /debug/traces?trace_id= hits
+                            async with http.get(
+                                f"{base_url}/debug/explain",
+                                params={"node": node},
+                            ) as resp:
+                                doc = await resp.json()
+                            tid = rollout_ctx.trace_id if rollout_ctx else "-"
+                            linked = tid in (doc.get("trace_ids") or [])
+                            verdict = (doc.get("blocking_on") or {}).get("state")
+                            async with http.get(
+                                f"{base_url}/debug/traces",
+                                params={"trace_id": tid},
+                            ) as resp:
+                                traced = (await resp.json())["traces"]
+                            explained_ok = (
+                                linked and verdict == "validated" and bool(traced)
+                            )
+                    result["join_phase_nodes"] = phased_nodes
+                    result["explain_trace_joined"] = explained_ok
+
+                    # D4: join-phase rollups must reconcile against the
+                    # headline metric (sum of per-phase means within 2% of
+                    # the join mean) with compile the dominant phase
+                    async with http.get(f"{base_url}/debug/fleet") as resp:
+                        snap = await resp.json()
+                    per_phase = (snap.get("join_phases") or {}).get("3600s") or {}
+                    join_roll = (
+                        snap["metrics"].get("join_to_validated_seconds") or {}
+                    ).get("3600s") or {}
+                    phase_sum = sum(
+                        r["mean"] for r in per_phase.values()
+                    ) if per_phase else 0.0
+                    join_mean = join_roll.get("mean", 0.0)
+                    result["join_phase_sum_mean"] = round(phase_sum, 4)
+                    result["join_mean"] = round(join_mean, 4)
+                    result["join_phase_sum_ok"] = (
+                        join_mean > 0
+                        and abs(phase_sum - join_mean) <= 0.02 * join_mean
+                    )
+                    compile_mean = (per_phase.get("compile") or {}).get("mean", 0.0)
+                    result["compile_dominant"] = bool(per_phase) and all(
+                        compile_mean > r["mean"]
+                        for p, r in per_phase.items() if p != "compile"
+                    )
+
+                    # D6: a node whose operands are deploy-gated off never
+                    # advertises google.com/tpu — /debug/explain must name
+                    # the first missing critical-path phase as blocking
+                    stuck = "tpu-stuck-0"
+                    fc.add_node(stuck, labels={
+                        consts.OPERANDS_LABEL: "false",
+                        consts.GKE_NODEPOOL_LABEL: "pool-stuck",
+                        consts.GKE_TPU_WORKER_ID_LABEL: "0",
+                    })
+                    t_stuck = time.perf_counter()
+                    while time.perf_counter() - t_stuck < 15.0:
+                        async with http.get(
+                            f"{base_url}/debug/explain", params={"node": stuck}
+                        ) as resp:
+                            doc = await resp.json()
+                        if doc.get("known"):
+                            break
+                        await asyncio.sleep(0.2)
+                    # the first three segments arrived; compile has not
+                    async with http.post(push_url, json={
+                        "node": stuck,
+                        "join_phases": {
+                            "runtime-ready": 1.5, "validator-scheduled": 2.0,
+                            "plugin-advertised": 1.0,
+                        },
+                    }) as resp:
+                        assert resp.status == 200
+                    async with http.get(
+                        f"{base_url}/debug/explain", params={"node": stuck}
+                    ) as resp:
+                        doc = await resp.json()
+                    verdict = doc.get("blocking_on") or {}
+                    result["stuck_verdict"] = verdict
+                    result["stuck_blocking_ok"] = (
+                        verdict.get("state") == "joining"
+                        and verdict.get("phase") == "compile"
+                    )
+
                 # -- steady state: aggregation must cost zero API verbs ---
                 fc.chaos.stop()
                 steady_requests = None
@@ -997,6 +1164,34 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
             failures.append("SLOBurnRate never fired on the injected regression")
         if not result.get("slo_recovered"):
             failures.append("SLORecovered never posted after the fault cleared")
+        if not result.get("rendered_traceparent"):
+            failures.append(
+                "rendered validator DS carries no TPU_TRACEPARENT env"
+            )
+        if not result.get("flight_sample_trace_ok"):
+            failures.append(
+                "flight sample under an adopted tracer lost the rollout trace id"
+            )
+        if not result.get("explain_trace_joined"):
+            failures.append(
+                "/debug/explain never joined the propagated trace id back to "
+                "/debug/traces"
+            )
+        if not result.get("join_phase_sum_ok"):
+            failures.append(
+                "join-phase rollups do not sum to join_to_validated within 2%: "
+                f"phases {result.get('join_phase_sum_mean')} vs join "
+                f"{result.get('join_mean')}"
+            )
+        if not result.get("compile_dominant"):
+            failures.append(
+                "compile is not the dominant join phase in the rollups"
+            )
+        if not result.get("stuck_blocking_ok"):
+            failures.append(
+                "/debug/explain mis-named the stuck node's blocking phase: "
+                f"{result.get('stuck_verdict')}"
+            )
         if result.get("max_queue_depth", 0) < 1:
             failures.append("controller queue-depth gauge never rose under load")
         if result.get("max_busy_fraction", 0) <= 0:
@@ -1027,6 +1222,10 @@ def run_fleet_obs_soak(n_nodes: int = 100, seed: int = 1) -> dict:
         f"SLO fired {result.get('slo_fired_after_s')}s / recovered "
         f"{result.get('slo_recovered_after_s')}s, max depth "
         f"{result.get('max_queue_depth'):.0f}, busy {result.get('max_busy_fraction')}, "
+        f"join phases on {result.get('join_phase_nodes')} nodes "
+        f"(sum {result.get('join_phase_sum_mean')} vs join {result.get('join_mean')}, "
+        f"compile dominant {result.get('compile_dominant')}), "
+        f"trace joined {result.get('explain_trace_joined')}, "
         f"{'OK' if result['ok'] else 'FAILED'}",
         file=sys.stderr,
     )

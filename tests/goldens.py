@@ -28,7 +28,13 @@ CONFIGS: list[tuple[str, ClusterContext, dict]] = [
     ),
     (
         "full-spec",
-        ClusterContext(namespace="tpu-system", service_monitors_available=True, tpu_node_count=4),
+        ClusterContext(
+            namespace="tpu-system", service_monitors_available=True,
+            tpu_node_count=4,
+            # fixed rollout trace context: pins the TPU_TRACEPARENT env +
+            # pod-annotation rendering (obs/trace.py propagation contract)
+            traceparent="3f2a9c11d05e-9c1d05e3f2aa-3f2a9c11d05e",
+        ),
         {
             "operator": {"runtimeClass": "tpu-rc", "defaultRuntime": "containerd"},
             "daemonsets": {

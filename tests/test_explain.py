@@ -1,0 +1,794 @@
+"""Causal tracing & explain tests (ISSUE 8 acceptance, alongside the
+`make fleet-obs` soak): TraceContext serialization, Tracer.adopt, the
+pinned /debug/traces ring, flight-sample trace stamping, Event
+reconcile/trace-id annotations, join-phase ingest + rollups, the
+ExplainEngine's timelines and blocking verdicts, the /debug/explain
+route, and the FakeCluster cross-process propagation round trip."""
+
+import asyncio
+
+import aiohttp
+
+from tpu_operator import consts
+from tpu_operator.api.types import GROUP, CLUSTER_POLICY_KIND, State, TPUClusterPolicy
+from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+from tpu_operator.controllers.runtime import Manager
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import flight
+from tpu_operator.obs import trace as trace_api
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.explain import ExplainEngine
+from tpu_operator.obs.fleet import JOIN_PHASES, FleetAggregator
+from tpu_operator.obs.trace import TraceContext, Tracer
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+# ----------------------------------------------------------------------
+# TraceContext + adoption
+
+
+def test_trace_context_roundtrip_and_malformed():
+    ctx = TraceContext("aabbccddeeff", "112233445566", "778899aabbcc")
+    assert TraceContext.parse(ctx.serialize()) == ctx
+    # span-less context serializes with a 0 placeholder
+    bare = TraceContext("aabbccddeeff")
+    assert bare.serialize() == "aabbccddeeff-0"
+    assert TraceContext.parse(bare.serialize()) == bare
+    for bad in ("", "zz-xx", "abc", "a-b-c-d", "AABB-cc", "g" * 12 + "-0", None):
+        assert TraceContext.parse(bad) is None
+
+
+def test_adopt_joins_remote_trace():
+    tracer = Tracer()
+    ctx = TraceContext("aabbccddeeff", "112233445566", "778899aabbcc")
+    with tracer.adopt(ctx):
+        with trace_api.span("child-process-root") as sp:
+            assert sp.trace_id == "aabbccddeeff"
+            assert sp.remote_parent == "112233445566"
+            assert sp.reconcile_id == "778899aabbcc"
+            with trace_api.span("nested") as inner:
+                assert inner.trace_id == "aabbccddeeff"
+    # serialized into the ring with the remote link
+    top = tracer.snapshot()[0]
+    assert top["trace_id"] == "aabbccddeeff"
+    assert top["remote_parent"] == "112233445566"
+
+
+def test_adopt_none_degrades_to_local_trace():
+    tracer = Tracer()
+    with tracer.adopt(None):
+        with trace_api.span("standalone") as sp:
+            assert sp.trace_id and sp.remote_parent == ""
+
+
+def test_from_env_contract(monkeypatch):
+    monkeypatch.setenv(trace_api.TRACEPARENT_ENV, "aabbccddeeff-112233445566")
+    ctx = TraceContext.from_env()
+    assert ctx.trace_id == "aabbccddeeff" and ctx.span_id == "112233445566"
+    monkeypatch.setenv(trace_api.TRACEPARENT_ENV, "not a context")
+    assert TraceContext.from_env() is None
+
+
+# ----------------------------------------------------------------------
+# /debug/traces ring: env-sized, pinned, tombstoned
+
+
+def test_ring_cap_configurable_via_env(monkeypatch):
+    monkeypatch.setenv(trace_api.MAX_TRACES_ENV, "3")
+    tracer = Tracer()
+    assert tracer.max_traces == 3
+    for i in range(6):
+        with tracer.span(f"t{i}"):
+            pass
+    assert len(tracer.snapshot()) == 3
+    monkeypatch.setenv(trace_api.MAX_TRACES_ENV, "bogus")
+    assert Tracer().max_traces == trace_api.DEFAULT_MAX_TRACES
+
+
+def test_pinned_trace_survives_eviction():
+    pinned_ids = set()
+    tracer = Tracer(max_traces=2, pinned=lambda: pinned_ids)
+    with tracer.span("keep-me") as sp:
+        pass
+    pinned_ids.add(sp.trace_id)
+    for i in range(5):
+        with tracer.span(f"churn-{i}"):
+            pass
+    names = [t["name"] for t in tracer.snapshot()]
+    assert "keep-me" in names
+    assert len(names) <= 2 + len(pinned_ids)
+    # released pin → next eviction drops it
+    pinned_ids.clear()
+    with tracer.span("one-more"):
+        pass
+    assert "keep-me" not in [t["name"] for t in tracer.snapshot()]
+
+
+def test_explicit_pin_replaced_by_key():
+    tracer = Tracer(max_traces=1)
+    with tracer.span("rollout-1") as sp1:
+        pass
+    tracer.pin("rollout/policy", sp1.trace_id)
+    with tracer.span("rollout-2") as sp2:
+        pass
+    assert "rollout-1" in [t["name"] for t in tracer.snapshot()]
+    # new rollout replaces the pin; the old trace becomes evictable
+    tracer.pin("rollout/policy", sp2.trace_id)
+    with tracer.span("churn"):
+        pass
+    names = [t["name"] for t in tracer.snapshot()]
+    assert "rollout-1" not in names and "rollout-2" in names
+
+
+def test_all_pinned_overflow_tombstones():
+    ids = set()
+    tracer = Tracer(max_traces=1, pinned=lambda: ids)
+    for i in range(7):
+        with tracer.span(f"t{i}") as sp:
+            pass
+        ids.add(sp.trace_id)
+    snap = tracer.snapshot()
+    tombstones = [t for t in snap if t.get("evicted")]
+    # past the 4×cap hard bound, the oldest pinned history collapses to
+    # tombstones: ids stay joinable, span trees are honestly marked gone
+    assert tombstones and all("children" not in t for t in tombstones)
+    assert all(t.get("trace_id") for t in tombstones)
+    # the oldest entries are the tombstoned ones
+    assert snap[-1].get("evicted") and not snap[0].get("evicted")
+
+
+# ----------------------------------------------------------------------
+# flight samples + push payloads carry the propagated trace
+
+
+def test_flight_sample_trace_from_span_and_env(monkeypatch):
+    tracer = Tracer()
+    rec = flight.FlightRecorder()
+    with tracer.adopt(TraceContext("aabbccddeeff", "112233445566")):
+        with tracer.span("validate/jax", kind=trace_api.KIND_PHASE, phase="jax"):
+            sample = rec.record("allreduce", phase="compile", compile_s=2.0)
+    assert sample["trace_id"] == "aabbccddeeff"
+    # no span active: the recorder's env-resolved context is the fallback
+    monkeypatch.setenv(trace_api.TRACEPARENT_ENV, "ddeeff001122-0")
+    rec2 = flight.FlightRecorder()
+    sample2 = rec2.record("allreduce", phase="step", step_s=0.1)
+    assert sample2["trace_id"] == "ddeeff001122"
+
+
+def test_push_join_phases_validates_and_posts(monkeypatch):
+    posted = {}
+
+    async def handler(request):
+        posted.update(await request.json())
+        return aiohttp.web.json_response({"accepted": 0})
+
+    from aiohttp import web
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/push", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        url = f"http://127.0.0.1:{port}/push"
+        loop = asyncio.get_event_loop()
+        ok = await loop.run_in_executor(
+            None,
+            lambda: flight.push_join_phases(
+                "node-1",
+                {"compile": 9.2, "collective": 0.8, "bogus": float("nan"),
+                 "negative": -1.0, "str": "x"},
+                trace_id="aabbccddeeff",
+                url=url,
+            ),
+        )
+        await runner.cleanup()
+        return ok
+
+    assert asyncio.run(run())
+    assert posted["node"] == "node-1"
+    assert posted["trace_id"] == "aabbccddeeff"
+    # non-finite / negative / non-numeric segments never leave the process
+    assert posted["join_phases"] == {"compile": 9.2, "collective": 0.8}
+    # no url / empty phases: no-op, not an error
+    assert not flight.push_join_phases("node-1", {"compile": 1.0}, url="")
+    assert not flight.push_join_phases("node-1", {}, url="http://127.0.0.1:1")
+
+
+# ----------------------------------------------------------------------
+# join-phase ingest + rollups + gauges
+
+
+def test_join_phase_ingest_bounded_vocabulary():
+    fleet = FleetAggregator()
+    accepted = fleet.ingest_push({
+        "node": "n1", "trace_id": "aabbccddeeff",
+        "join_phases": {"compile": 9.0, "collective": 1.0, "made-up": 3.0},
+    })
+    assert accepted == 2
+    join = fleet.node_join("n1")
+    assert set(join["phases"]) == {"compile", "collective"}
+    assert join["phases"]["compile"]["seconds"] == 9.0
+    assert join["phases"]["compile"]["trace_id"] == "aabbccddeeff"
+    # the propagated id is referenced by the exemplars → pinned set
+    assert "aabbccddeeff" in fleet.referenced_trace_ids()
+
+
+def test_join_phase_rollup_and_gauge_export():
+    metrics = OperatorMetrics()
+    fleet = FleetAggregator(metrics)
+    for node, scale in (("n1", 1.0), ("n2", 3.0)):
+        fleet.ingest_push({
+            "node": node,
+            "join_phases": {"compile": 9.0 * scale, "collective": 1.0 * scale},
+        })
+    roll = fleet.join_phase_rollup(3600.0)
+    assert roll["compile"]["count"] == 2 and roll["compile"]["mean"] == 18.0
+    fleet.export()
+    for fam in metrics.registry.collect():
+        if fam.name == "tpu_operator_join_phase_seconds":
+            samples = {
+                (s.labels["phase"], s.labels["quantile"]): s.value
+                for s in fam.samples
+            }
+    assert samples[("compile", "mean")] == 18.0
+    assert samples[("collective", "max")] == 3.0
+    # an emptied window drops its label sets instead of freezing
+    fleet2 = FleetAggregator(metrics)
+    fleet2.export()
+
+
+def test_workload_push_trace_exemplar():
+    fleet = FleetAggregator()
+    fleet.ingest_push({
+        "node": "n1", "trace_id": "aabbccddeeff",
+        "workloads": {"train": {"counters": {"tpu_workload_mfu": 0.9}}},
+    })
+    snap = fleet.snapshot()
+    exemplars = snap["exemplars"]["tpu_workload_mfu"]
+    assert exemplars[-1]["trace_id"] == "aabbccddeeff"
+
+
+def test_slo_breach_pins_exemplar_traces():
+    fleet = FleetAggregator()
+    now = 1000.0
+    fleet.configure_slos([{
+        "name": "mfu", "metric": "tpu_workload_mfu", "comparison": "ge",
+        "threshold": 0.8, "objective": 0.9, "windows": [10],
+        "burnRateThreshold": 1.0, "minSamples": 1,
+    }])
+    fleet.ingest(
+        "tpu_workload_mfu", 0.2, {"node": "n1"}, ts=now,
+        exemplar={"trace_id": "aabbccddeeff"},
+    )
+    assert fleet.evaluate_slos(now=now + 1)[0][0] == "fired"
+    assert "aabbccddeeff" in fleet.referenced_trace_ids()
+    # recovery releases the breach pin (evaluate once the bad sample has
+    # aged out of the 10s window and only good samples remain)
+    for i in range(5):
+        fleet.ingest("tpu_workload_mfu", 0.95, {"node": "n1"}, ts=now + 3 + i)
+    assert fleet.evaluate_slos(now=now + 12)[0][0] == "recovered"
+    assert fleet.slo_engine.breach_trace_ids() == set()
+
+
+# ----------------------------------------------------------------------
+# Event annotations
+
+
+async def test_event_carries_reconcile_and_trace_annotations():
+    async with FakeCluster() as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            recorder = EventRecorder(client, NS)
+            tracer = Tracer()
+            with tracer.reconcile("clusterpolicy", key="p"):
+                from tpu_operator.obs import events as obs_events
+
+                ev = await recorder.warning(
+                    obs_events.node_ref("n1"), "NodeUnhealthy", "sick"
+                )
+                sp = trace_api.current_span()
+                anns = ev["metadata"]["annotations"]
+                assert anns[consts.EVENT_RECONCILE_ID_ANNOTATION] == sp.reconcile_id
+                assert anns[consts.EVENT_TRACE_ID_ANNOTATION] == sp.trace_id
+            # correlator repeat refreshes the ids to the LATEST pass
+            with tracer.reconcile("clusterpolicy", key="p"):
+                ev2 = await recorder.warning(
+                    obs_events.node_ref("n1"), "NodeUnhealthy", "sick"
+                )
+                sp2 = trace_api.current_span()
+                assert ev2["count"] == 2
+                assert (
+                    ev2["metadata"]["annotations"][consts.EVENT_TRACE_ID_ANNOTATION]
+                    == sp2.trace_id
+                )
+
+
+# ----------------------------------------------------------------------
+# ExplainEngine
+
+
+def _node(name, validated=False, labels=None, annotations=None,
+          unschedulable=False, ready=True, created="1970-01-01T00:01:00Z"):
+    # created defaults to unix ts 60.0 so tests can use small synthetic
+    # `now` values and still get a chronologically-ordered timeline
+    return {
+        "metadata": {
+            "name": name,
+            "creationTimestamp": created,
+            "labels": labels or {},
+            "annotations": annotations or {},
+        },
+        "spec": {"unschedulable": unschedulable} if unschedulable else {},
+        "status": {
+            "allocatable": {consts.TPU_RESOURCE: "4"} if validated else {},
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ],
+        },
+    }
+
+
+def test_explain_timeline_narrates_transitions():
+    engine = ExplainEngine()
+    engine.observe_nodes([_node("n1")], now=100.0)
+    engine.observe_nodes([_node("n1", validated=True)], now=110.0)
+    engine.observe_nodes(
+        [_node("n1", validated=True,
+               labels={consts.TPU_HEALTH_LABEL: consts.HEALTH_UNHEALTHY},
+               annotations={consts.TPU_HEALTH_REASON_ANNOTATION: "scrape-errors"})],
+        now=120.0,
+    )
+    engine.observe_nodes(
+        [_node("n1", validated=True, ready=False, unschedulable=True)],
+        now=130.0,
+    )
+    doc = engine.snapshot("n1", now=140.0)
+    details = [e["detail"] for e in doc["timeline"]]
+    assert details[0] == "node joined the cluster"
+    assert any("node validated" in d for d in details)
+    assert any("agent health verdict" in d and "unhealthy" in d for d in details)
+    assert any("Ready condition False" in d for d in details)
+    assert any("node cordoned" in d for d in details)
+    # the verdict tracks the ownership hierarchy, not just the last entry
+    assert doc["blocking_on"]["state"] == "validated"
+
+
+def test_explain_blocking_ownership_hierarchy():
+    engine = ExplainEngine()
+    # health engine owns it
+    engine.observe_nodes([_node(
+        "n1", validated=True,
+        labels={consts.HEALTH_STATE_LABEL: consts.HEALTH_QUARANTINED},
+        annotations={consts.HEALTH_ESCALATION_ANNOTATION: "quarantine"},
+    )])
+    assert engine.snapshot("n1")["blocking_on"]["state"] == "health"
+    # upgrade machine
+    engine.observe_nodes([_node(
+        "n2", validated=True,
+        labels={consts.UPGRADE_STATE_LABEL: "pod-restart-required"},
+    )])
+    v = engine.snapshot("n2")["blocking_on"]
+    assert v["state"] == "upgrade" and v["phase"] == "pod-restart-required"
+    # remediation
+    engine.observe_nodes([_node(
+        "n3", validated=True,
+        labels={consts.VALIDATE_REQUEST_LABEL: "requested"},
+    )])
+    assert engine.snapshot("n3")["blocking_on"]["state"] == "remediation"
+    # unknown node
+    assert engine.snapshot("ghost")["blocking_on"]["state"] == "unknown"
+
+
+def test_explain_upgrade_states_track_the_upgrade_machine():
+    """The ownership verdict must recognize EVERY state the upgrade
+    machine actually sets (controllers/upgrade.py NON_TERMINAL_STATES) —
+    an inlined copy drifted once and missed drain-required."""
+    from tpu_operator.controllers.upgrade import NON_TERMINAL_STATES
+
+    engine = ExplainEngine()
+    for state in NON_TERMINAL_STATES:
+        engine.observe_nodes([_node(
+            "n1", validated=True,
+            labels={consts.UPGRADE_STATE_LABEL: state},
+        )])
+        v = engine.snapshot("n1")["blocking_on"]
+        assert v["state"] == "upgrade" and v["phase"] == state, state
+    # terminal states release ownership
+    engine.observe_nodes([_node(
+        "n1", validated=True, labels={consts.UPGRADE_STATE_LABEL: "upgrade-done"},
+    )])
+    assert engine.snapshot("n1")["blocking_on"]["state"] == "validated"
+
+
+def test_rollout_trace_cache_is_per_policy():
+    """Two policies (second one Ignored by the singleton guard, but still
+    reconciled) must not thrash one shared rollout-trace slot — that would
+    re-mint the context every pass and rewrite every DaemonSet."""
+    from tpu_operator.api.types import TPUClusterPolicy as TCP
+
+    reconciler = ClusterPolicyReconciler.__new__(ClusterPolicyReconciler)
+    reconciler.tracer = Tracer()
+    reconciler._rollout_trace = {}
+    pa = TCP.new(name="policy-a", spec={})
+    pb = TCP.new(name="policy-b", spec={"cdi": {"enabled": True}})
+    a1 = reconciler._rollout_traceparent(pa)
+    b1 = reconciler._rollout_traceparent(pb)
+    assert a1 != b1
+    # interleaved passes keep each policy's context STABLE
+    assert reconciler._rollout_traceparent(pa) == a1
+    assert reconciler._rollout_traceparent(pb) == b1
+    # a spec change re-mints only that policy's context
+    pa2 = TCP.new(name="policy-a", spec={"cdi": {"enabled": True}})
+    a2 = reconciler._rollout_traceparent(pa2)
+    assert a2 != a1
+    assert reconciler._rollout_traceparent(pb) == b1
+
+
+def test_join_phase_map_prunes_invented_node_names():
+    """Phase entries for node names never seen in the informer list must
+    be reaped by collect_nodes — the push port is unauthenticated and
+    invented names must not pin the per-node cap forever."""
+    fleet = FleetAggregator()
+    for i in range(10):
+        fleet.ingest_push({
+            "node": f"fake-{i}", "join_phases": {"compile": 1.0},
+        })
+    assert len(fleet._node_join_phases) == 10
+    real = {
+        "metadata": {"name": "real-1", "labels": {},
+                     "creationTimestamp": "1970-01-01T00:01:00Z"},
+        "status": {"allocatable": {}},
+    }
+    fleet.ingest_push({"node": "real-1", "join_phases": {"compile": 2.0}})
+    fleet.collect_nodes([real], now=100.0)
+    assert set(fleet._node_join_phases) == {"real-1"}
+
+
+def test_explain_event_for_unknown_node_does_not_leak_timeline():
+    engine = ExplainEngine()
+    from tpu_operator.obs import events as obs_events
+
+    engine.observe_nodes([_node("n1")])
+    engine.observe_nodes([])  # n1 departs; timeline pruned
+    # a trailing Event racing the deletion must not resurrect it
+    engine.observe_event(obs_events.node_ref("n1"), "Warning", "NodeUnhealthy", "x")
+    engine.observe_slo("fired", "mfu", "burn", offenders=["n1", "ghost"])
+    assert engine.nodes() == []
+    assert engine._timelines == {}
+
+
+def test_explain_joining_verdict_names_first_missing_phase():
+    fleet = FleetAggregator()
+    engine = ExplainEngine(fleet=fleet)
+    engine.observe_nodes([_node("n1")], now=1000.0)
+    # nothing pushed yet: blocked on the first phase
+    v = engine.snapshot("n1", now=1010.0)["blocking_on"]
+    assert v["state"] == "joining" and v["phase"] == JOIN_PHASES[0]
+    fleet.ingest_push({"node": "n1", "join_phases": {
+        "runtime-ready": 1.0, "validator-scheduled": 2.0,
+        "plugin-advertised": 1.0,
+    }})
+    v = engine.snapshot("n1")["blocking_on"]
+    assert v["phase"] == "compile"
+    assert "waiting: validator compile" in v["detail"]
+    assert v["waiting_s"] >= 0.0
+
+
+def test_explain_event_and_slo_hooks():
+    engine = ExplainEngine()
+    engine.observe_nodes([_node("n1")])
+    from tpu_operator.obs import events as obs_events
+
+    engine.observe_event(obs_events.node_ref("n1"), "Warning", "NodeUnhealthy", "sick")
+    # non-node events never land on node timelines
+    engine.observe_event(obs_events.namespace_ref(NS), "Warning", "DegradedMode", "x")
+    engine.observe_slo("fired", "mfu", "burning", offenders=["n1"])
+    doc = engine.snapshot("n1")
+    kinds = [e["kind"] for e in doc["timeline"]]
+    assert "event" in kinds and "slo" in kinds
+    assert sum(1 for k in kinds if k == "event") == 1
+
+
+def test_explain_prunes_departed_nodes():
+    engine = ExplainEngine(max_entries=4)
+    engine.observe_nodes([_node("n1"), _node("n2")])
+    engine.observe_nodes([_node("n1")])
+    assert engine.nodes() == ["n1"]
+    # ring bound: a flapping node cannot grow its timeline without bound
+    for i in range(10):
+        engine.observe_nodes([_node("n1", ready=bool(i % 2))])
+    assert len(engine.snapshot("n1")["timeline"]) <= 4
+
+
+# ----------------------------------------------------------------------
+# validator-side segment derivation
+
+
+def test_join_phase_segments_telescope(validation_root):
+    from tpu_operator.validator import status as vstatus
+
+    created = 1000.0
+    for component, ts in (("libtpu", 1002.0), ("pjrt", 1005.0),
+                          ("plugin", 1006.0), ("jax", 1016.0)):
+        vstatus.write_ready(component, {})
+        # pin the ts the derivation reads (write_ready stamps wall clock)
+        import json
+
+        path = vstatus.status_path(component)
+        with open(path) as f:
+            payload = json.load(f)
+        payload["ts"] = ts
+        with open(path, "w") as f:
+            json.dump(payload, f)
+    # compile evidence in the flight record: per-check max, summed
+    rec = flight.FlightRecorder(path=vstatus.flight_record_path())
+    rec.record("allreduce", phase="compile", compile_s=4.0)
+    rec.record("allreduce", phase="compile", compile_s=4.0)  # re-record: max, not sum
+    rec.record("burn-in", phase="compile", compile_s=2.0)
+    rec.flush()
+    phases = vstatus.join_phase_segments(created)
+    assert phases["runtime-ready"] == 2.0
+    assert phases["validator-scheduled"] == 3.0
+    assert phases["plugin-advertised"] == 1.0
+    assert phases["compile"] == 6.0
+    assert phases["collective"] == 4.0
+    # telescoping: the sum is exactly jax-ready minus creation
+    assert abs(sum(phases.values()) - 16.0) < 1e-6
+    # partial evidence: only the segments that exist
+    vstatus.clear("jax")
+    partial = vstatus.join_phase_segments(created)
+    assert "compile" not in partial and "runtime-ready" in partial
+
+
+# ----------------------------------------------------------------------
+# the cross-process round trip (ISSUE 8 satellite): trace id minted in a
+# clusterpolicy reconcile → rendered validator pod env → flight samples →
+# fleet exemplar → /debug/explain
+
+
+async def test_trace_propagation_round_trip(monkeypatch):
+    async with FakeCluster(SimConfig(pod_ready_delay=0.02, tick=0.01)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            fleet = FleetAggregator(metrics)
+            tracer = Tracer(metrics, fleet=fleet)
+            explain = ExplainEngine(fleet=fleet, tracer=tracer)
+            reconciler = ClusterPolicyReconciler(
+                client, NS, metrics=metrics, tracer=tracer, fleet=fleet,
+                explain=explain,
+            )
+            await client.create(TPUClusterPolicy.new().obj)
+            fc.add_node("tpu-node-0")
+            for _ in range(30):
+                await reconciler.reconcile("cluster-policy")
+                obj = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                if deep_get(obj, "status", "state") == State.READY:
+                    break
+                await asyncio.sleep(0.05)
+
+            # 1. the rendered validator DS env + pod annotation carry the
+            #    rollout trace context
+            ds = await client.get("apps", "DaemonSet", "tpu-operator-validator", NS)
+            env = deep_get(
+                ds, "spec", "template", "spec", "containers", 0, "env",
+                default=[],
+            )
+            traceparent = next(
+                e["value"] for e in env if e["name"] == trace_api.TRACEPARENT_ENV
+            )
+            ctx = TraceContext.parse(traceparent)
+            assert ctx is not None and ctx.trace_id
+            anns = deep_get(
+                ds, "spec", "template", "metadata", "annotations", default={}
+            )
+            assert anns[consts.TRACEPARENT_ANNOTATION] == traceparent
+            # every init container of the validation chain carries it too
+            for init in deep_get(
+                ds, "spec", "template", "spec", "initContainers", default=[]
+            ):
+                assert any(
+                    e.get("name") == trace_api.TRACEPARENT_ENV
+                    and e.get("value") == traceparent
+                    for e in init.get("env", [])
+                )
+
+            # the minted trace is in (and pinned into) /debug/traces
+            assert any(
+                t.get("trace_id") == ctx.trace_id for t in tracer.snapshot()
+            )
+
+            # 2. a run_validation-style adopted workload leaves flight
+            #    samples stamped with the SAME trace id
+            monkeypatch.setenv(trace_api.TRACEPARENT_ENV, traceparent)
+            pod_tracer = Tracer()
+            rec = flight.FlightRecorder()
+            with pod_tracer.adopt(TraceContext.from_env()):
+                with pod_tracer.span(
+                    "check/allreduce", kind=trace_api.KIND_PHASE, phase="allreduce"
+                ):
+                    sample = rec.record("allreduce", phase="compile", compile_s=7.5)
+            assert sample["trace_id"] == ctx.trace_id
+
+            # 3. the agent-hop push (node-tagged, trace-stamped) lands in
+            #    the fleet with the trace id as exemplar
+            fleet.ingest_push({
+                "node": "tpu-node-0",
+                "trace_id": ctx.trace_id,
+                "workloads": {"allreduce": {"counters": {
+                    "tpu_workload_compile_seconds": 7.5,
+                }}},
+                "join_phases": {
+                    "runtime-ready": 1.0, "validator-scheduled": 1.5,
+                    "plugin-advertised": 0.5, "compile": 7.5,
+                    "collective": 1.0,
+                },
+            })
+            assert ctx.trace_id in fleet.referenced_trace_ids()
+
+            # 4. /debug/explain closes the loop: the node's document links
+            #    the trace id back to the reconcile trace in the ring
+            doc = explain.snapshot("tpu-node-0")
+            assert ctx.trace_id in doc["trace_ids"]
+            assert any(
+                t.get("trace_id") == ctx.trace_id for t in doc["traces"]
+            )
+            assert doc["blocking_on"]["state"] == "validated"
+            assert doc["join"]["phases"]["compile"]["seconds"] == 7.5
+
+            # 5. stability: the rollout context must not rotate while the
+            #    spec is unchanged (render memo + zero-write steady state)
+            await reconciler.reconcile("cluster-policy")
+            ds2 = await client.get(
+                "apps", "DaemonSet", "tpu-operator-validator", NS
+            )
+            env2 = deep_get(
+                ds2, "spec", "template", "spec", "containers", 0, "env",
+                default=[],
+            )
+            assert traceparent == next(
+                e["value"] for e in env2 if e["name"] == trace_api.TRACEPARENT_ENV
+            )
+
+
+# ----------------------------------------------------------------------
+# /debug/explain route on the Manager
+
+
+async def test_debug_explain_route():
+    async with FakeCluster() as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            fleet = FleetAggregator(metrics)
+            tracer = Tracer(metrics, fleet=fleet)
+            explain = ExplainEngine(fleet=fleet, tracer=tracer)
+            explain.observe_nodes([_node("n1", validated=True)])
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1,
+                metrics_registry=metrics.registry, tracer=tracer,
+                fleet=fleet, explain=explain,
+            )
+            async with mgr:
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base}/debug/explain") as resp:
+                        assert (await resp.json())["nodes"] == ["n1"]
+                    async with http.get(
+                        f"{base}/debug/explain", params={"node": "n1"}
+                    ) as resp:
+                        doc = await resp.json()
+                    assert doc["node"] == "n1" and doc["known"]
+                    assert doc["blocking_on"]["state"] == "validated"
+                    async with http.get(
+                        f"{base}/debug/explain", params={"node": "ghost"}
+                    ) as resp:
+                        assert (await resp.json())["blocking_on"]["state"] == "unknown"
+
+
+async def test_debug_explain_404_when_disabled():
+    async with FakeCluster() as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = Manager(client, NS, metrics_port=0, health_port=-1)
+            async with mgr:
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(
+                        f"http://127.0.0.1:{mgr.metrics_port}/debug/explain"
+                    ) as resp:
+                        assert resp.status == 404
+
+
+# ----------------------------------------------------------------------
+# the agent forward hop relays join phases + trace ids
+
+
+async def test_agent_forwards_join_phases_and_trace(monkeypatch):
+    from aiohttp import web
+
+    from tpu_operator.agents import metrics_agent
+
+    received = []
+
+    async def ingest(request):
+        received.append(await request.json())
+        return web.json_response({"accepted": 1})
+
+    app = web.Application()
+    app.router.add_post("/push", ingest)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    monkeypatch.setenv("NODE_NAME", "n1")
+    fwd = metrics_agent.FleetForwarder(
+        f"http://127.0.0.1:{port}/push", node_name="n1", interval=0.01
+    )
+    fwd.queue(
+        {"train": {"counters": {"tpu_workload_mfu": 0.9}}},
+        trace_id="aabbccddeeff",
+        join_phases={"compile": 9.0, "bogus-phase": 1.0},
+    )
+    for _ in range(100):
+        if fwd.forwarded:
+            break
+        await asyncio.sleep(0.02)
+    await runner.cleanup()
+    assert received, "forward hop never posted"
+    body = received[0]
+    assert body["node"] == "n1"
+    assert body["trace_id"] == "aabbccddeeff"
+    # catalogue discipline holds through the hop
+    assert body["join_phases"] == {"compile": 9.0}
+    assert body["workloads"]["train"]["counters"]["tpu_workload_mfu"] == 0.9
+
+
+async def test_agent_env_traceparent_is_stamp_of_last_resort(monkeypatch):
+    from tpu_operator.agents import metrics_agent
+
+    monkeypatch.setenv(trace_api.TRACEPARENT_ENV, "ddeeff001122-0")
+    fwd = metrics_agent.FleetForwarder("http://example.invalid/push")
+    assert fwd._env_trace_id == "ddeeff001122"
+
+
+async def test_agent_push_route_accepts_join_phase_only_body():
+    """A validator join-phase report has no workloads map; the route must
+    accept it (200, accepted 0) instead of 400ing the critical-path
+    evidence away."""
+    import socket
+
+    from tpu_operator.agents import metrics_agent
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stop = asyncio.Event()
+    serve_task = asyncio.create_task(metrics_agent.serve(port, stop))
+    try:
+        async with aiohttp.ClientSession() as http:
+            body = {"node": "n1", "join_phases": {"compile": 9.0}}
+            for _ in range(50):
+                try:
+                    async with http.post(
+                        f"http://127.0.0.1:{port}/push", json=body
+                    ) as resp:
+                        assert resp.status == 200
+                        assert (await resp.json())["accepted"] == 0
+                    break
+                except aiohttp.ClientConnectorError:
+                    await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("agent never came up")
+            # a body with neither map is still a 400
+            async with http.post(
+                f"http://127.0.0.1:{port}/push", json={"node": "n1"}
+            ) as resp:
+                assert resp.status == 400
+    finally:
+        stop.set()
+        await serve_task

@@ -32,7 +32,8 @@ from aiohttp import web
 
 from tpu_operator import consts, hw
 from tpu_operator.agents import base
-from tpu_operator.obs.fleet import read_json_capped
+from tpu_operator.obs.fleet import JOIN_PHASES, read_json_capped
+from tpu_operator.obs.trace import TraceContext
 
 log = logging.getLogger("tpu_operator.metrics_agent")
 
@@ -136,15 +137,29 @@ class FleetForwarder:
         self.forwarded = 0
         self.failures = 0
         self._pending: dict[str, dict] = {}
+        # join-phase segments awaiting forward ({phase: seconds}, merged
+        # like counters) and the newest propagated trace id of the window.
+        # The agent's own TPU_TRACEPARENT (DS-injected rollout context) is
+        # the stamp of last resort: a push without one still joins the
+        # rollout trace, just not a workload-specific span.
+        self._pending_join: dict[str, float] = {}
+        env_ctx = TraceContext.from_env()
+        self._env_trace_id = env_ctx.trace_id if env_ctx is not None else ""
+        self._pending_trace = ""
         self._task: Optional[asyncio.Task] = None
 
-    def queue(self, workloads: dict) -> None:
+    def queue(
+        self,
+        workloads: dict,
+        trace_id: str = "",
+        join_phases: Optional[dict] = None,
+    ) -> None:
         """Merge a push window for forwarding.  The SAME validation and
         cardinality discipline as PushStore applies — only catalogue
-        counters, distinct workload names capped — or the unauthenticated
-        hostPort could grow the pending map and the operator's fleet
-        series without bound through the hop while the agent's own
-        surface stays clean."""
+        counters and catalogue join phases, distinct workload names capped
+        — or the unauthenticated hostPort could grow the pending map and
+        the operator's fleet series without bound through the hop while
+        the agent's own surface stays clean."""
         if not self.url:
             return
         for check, entry in workloads.items():
@@ -167,7 +182,14 @@ class FleetForwarder:
                 continue
             live = self._pending.setdefault(name, {"counters": {}})
             live["counters"].update(counters)
-        if self._pending and (self._task is None or self._task.done()):
+        for phase, seconds in (join_phases or {}).items():
+            if phase in JOIN_PHASES and isinstance(seconds, (int, float)):
+                self._pending_join[phase] = float(seconds)
+        if trace_id and isinstance(trace_id, str) and len(trace_id) <= 32:
+            self._pending_trace = trace_id
+        if (self._pending or self._pending_join) and (
+            self._task is None or self._task.done()
+        ):
             self._task = asyncio.create_task(self._drain())
 
     async def _drain(self) -> None:
@@ -176,8 +198,11 @@ class FleetForwarder:
         # Service instead of a fresh connector + DNS lookup per POST —
         # at fleet scale that is one connection per node, not one per push
         async with aiohttp.ClientSession() as session:
-            while self._pending:
+            while self._pending or self._pending_join:
                 window, self._pending = self._pending, {}
+                join_window, self._pending_join = self._pending_join, {}
+                trace_id = self._pending_trace or self._env_trace_id
+                self._pending_trace = ""
                 body = {
                     "node": self.node_name,
                     "workloads": window,
@@ -187,6 +212,10 @@ class FleetForwarder:
                         ),
                     },
                 }
+                if join_window:
+                    body["join_phases"] = join_window
+                if trace_id:
+                    body["trace_id"] = trace_id
                 try:
                     async with session.post(
                         self.url, json=body,
@@ -205,6 +234,9 @@ class FleetForwarder:
                     for check, entry in window.items():
                         live = self._pending.setdefault(check, {"counters": {}})
                         live["counters"] = {**entry["counters"], **live["counters"]}
+                    self._pending_join = {**join_window, **self._pending_join}
+                    if trace_id and not self._pending_trace:
+                        self._pending_trace = trace_id
                 await asyncio.sleep(self.interval * (2**backoff if backoff else 1))
 class PushStore:
     """Live workload counters pushed by obs.flight recorders.
@@ -423,14 +455,23 @@ async def serve(
         if not isinstance(body, dict):
             return web.json_response({"error": "body must be an object"}, status=400)
         workloads = body.get("workloads")
-        if not isinstance(workloads, dict):
+        join_phases = body.get("join_phases")
+        if not isinstance(workloads, dict) and not isinstance(join_phases, dict):
             return web.json_response(
                 {"error": "missing workloads map"}, status=400
             )
-        accepted = push_store.push(workloads)
-        if accepted and forwarder is not None:
-            # fleet hop: accepted windows ride on to the operator's ingest
-            forwarder.queue(workloads)
+        accepted = push_store.push(workloads) if isinstance(workloads, dict) else 0
+        if forwarder is not None and (
+            accepted or isinstance(join_phases, dict)
+        ):
+            # fleet hop: accepted windows — and the validator's join-phase
+            # report with its propagated trace id — ride on to the
+            # operator's ingest
+            forwarder.queue(
+                workloads if isinstance(workloads, dict) else {},
+                trace_id=body.get("trace_id") or "",
+                join_phases=join_phases if isinstance(join_phases, dict) else None,
+            )
         return web.json_response({"accepted": accepted})
 
     app = web.Application()

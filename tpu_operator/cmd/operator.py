@@ -24,6 +24,7 @@ from tpu_operator.k8s.client import ApiClient, Config
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.obs import logging as obs_logging
 from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.explain import ExplainEngine
 from tpu_operator.obs.fleet import FleetAggregator
 from tpu_operator.obs.trace import Tracer
 from tpu_operator.version import __version__
@@ -82,12 +83,18 @@ async def run(args: argparse.Namespace) -> None:
     # retry/breaker observability: the client feeds retries_total, the
     # manager's supervisor syncs the breaker-state gauge
     client.metrics = metrics
-    # ONE tracer/recorder/fleet triple for the whole process so
+    # ONE tracer/recorder/fleet/explain quad for the whole process so
     # /debug/traces sees every controller, the Event correlator dedups
-    # across them, and every reconcile span lands in the fleet aggregator
+    # across them, every reconcile span lands in the fleet aggregator, and
+    # /debug/explain narrates from all of it.  The tracer pins traces the
+    # fleet still references (exemplars, unresolved SLO breaches) against
+    # ring eviction; the recorder's sink lands every Event on the explain
+    # timeline even when the apiserver drops the post.
     fleet = FleetAggregator(metrics)
     tracer = Tracer(metrics, fleet=fleet)
     recorder = EventRecorder(client, namespace)
+    explain = ExplainEngine(fleet=fleet, tracer=tracer)
+    recorder.sink = explain.observe_event
     mgr = Manager(
         client,
         namespace,
@@ -102,6 +109,7 @@ async def run(args: argparse.Namespace) -> None:
         recorder=recorder,
         operator_metrics=metrics,
         fleet=fleet,
+        explain=explain,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
@@ -111,7 +119,9 @@ async def run(args: argparse.Namespace) -> None:
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
     obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
-    reconciler = ClusterPolicyReconciler(client, namespace, fleet=fleet, **obs)
+    reconciler = ClusterPolicyReconciler(
+        client, namespace, fleet=fleet, explain=explain, **obs
+    )
     reconciler.setup(mgr)
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)

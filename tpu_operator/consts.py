@@ -141,6 +141,17 @@ HEALTH_TAINT_KEY = TPU_HEALTH_LABEL
 # workload owns its own lifecycle — checkpoint-on-SIGTERM jobs etc.)
 SKIP_DRAIN_LABEL = "tpu.google.com/skip-drain"
 
+# Cross-process causal tracing (obs/trace.py; docs/OBSERVABILITY.md
+# "Causal tracing & explain").  The operator mints a trace context per
+# rollout and stamps it into rendered operand pod templates — as the
+# TPU_TRACEPARENT env var (the contract child processes adopt) and as this
+# annotation (so kubectl describe pod shows the originating trace).
+TRACEPARENT_ANNOTATION = "tpu.google.com/traceparent"
+# Events carry the posting pass's ids so `kubectl get events -o yaml`
+# joins to /debug/traces?reconcile_id= and /debug/explain without guesswork.
+EVENT_RECONCILE_ID_ANNOTATION = "tpu.google.com/reconcile-id"
+EVENT_TRACE_ID_ANNOTATION = "tpu.google.com/trace-id"
+
 # ---------------------------------------------------------------------------
 # Ordered operand state names (controllers/state_manager.go:795-813 analogue).
 # The sandbox/VM chain keeps its slots (survey §2.4 last row) but is disabled

@@ -33,6 +33,7 @@ from tpu_operator.metrics import (
     RECONCILE_SUCCESS,
 )
 from tpu_operator.obs import events as obs_events
+from tpu_operator.obs import trace as obs_trace
 from tpu_operator.obs.events import EventRecorder
 from tpu_operator.obs.trace import Tracer
 from tpu_operator.render import Renderer
@@ -69,6 +70,7 @@ class ClusterPolicyReconciler:
         tracer: Optional[Tracer] = None,
         recorder: Optional[EventRecorder] = None,
         fleet=None,
+        explain=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -92,6 +94,17 @@ class ClusterPolicyReconciler:
         self.fleet = fleet
         if fleet is not None and self.tracer.fleet is None:
             self.tracer.fleet = fleet
+        # obs.explain.ExplainEngine: fed the cached node list each pass
+        # (zero API verbs) so /debug/explain narrates state transitions
+        self.explain = explain
+        # rollout trace context per policy: name -> (spec hash, serialized
+        # TraceContext), minted once per SPEC CHANGE from the reconcile
+        # span observing it.  Per-pass minting would defeat the render
+        # memo and rewrite every DaemonSet every pass — the trace id the
+        # pods carry is the trace of the reconcile that STARTED the
+        # rollout.  Keyed by name (not one slot) so a second policy can
+        # never thrash the active one's context.
+        self._rollout_trace: dict[str, tuple[str, str]] = {}
         # last observed per-operand sync state, for transition Events —
         # keyed (policy name, operand) so a recreated or second policy
         # starts from a clean slate instead of inheriting the old one's
@@ -115,10 +128,14 @@ class ClusterPolicyReconciler:
         except ApiError as e:
             if e.not_found:
                 # deleted; owned objects go via GC.  Drop the transition
-                # cache so a recreated policy's rollout re-emits its Events.
+                # cache so a recreated policy's rollout re-emits its Events,
+                # and release the rollout trace pin — nothing references it
+                # once the policy's operands are gone.
                 self._last_operand_states = {
                     k: v for k, v in self._last_operand_states.items() if k[0] != name
                 }
+                if self._rollout_trace.pop(name, None) is not None:
+                    self.tracer.pin(f"rollout/{name}", "")
                 return None
             raise
 
@@ -137,7 +154,12 @@ class ClusterPolicyReconciler:
             # aggregation adds zero API verbs (bench.py --reconcile pins it)
             self.fleet.configure_slos(policy.spec.observability.slos)
             self.fleet.collect_nodes(nodes)
+        if self.explain is not None:
+            # same zero-API discipline: the explain timeline narrates the
+            # node list this pass already holds
+            self.explain.observe_nodes(nodes)
         ctx = await clusterinfo.gather(self.reader, self.namespace, nodes=nodes)
+        ctx.traceparent = self._rollout_traceparent(policy)
         ctx.tpu_node_count = await labels.label_tpu_nodes(self.reader, policy.spec, nodes=nodes)
         await labels.label_slice_readiness(self.reader, nodes)
         # BEFORE sync: under a restricted PSA default the privileged operand
@@ -190,6 +212,34 @@ class ClusterPolicyReconciler:
             # event (NFD-missing 45s poll analogue).
             return consts.REQUEUE_NO_TPU_NODES_SECONDS
         return None
+
+    def _rollout_traceparent(self, policy: TPUClusterPolicy) -> str:
+        """The serialized trace context stamped into rendered operand pods.
+
+        Minted from THIS pass's reconcile span, but only when the spec
+        changed — while (generation, spec) is stable every pass returns the
+        cached value, so rendered manifests stay byte-identical (render
+        memo hit, zero apply churn) and the pods keep pointing at the trace
+        of the reconcile that initiated their rollout."""
+        from tpu_operator.utils import object_hash
+
+        policy_name = deep_get(policy.obj, "metadata", "name", default="")
+        key = object_hash(policy.obj.get("spec") or {})
+        cached = self._rollout_trace.get(policy_name)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sp = obs_trace.current_span()
+        ctx = (
+            sp.context()
+            if sp is not None
+            else obs_trace.TraceContext(obs_trace.new_trace_id())
+        )
+        self._rollout_trace[policy_name] = (key, ctx.serialize())
+        # every rendered pod's TPU_TRACEPARENT points at this trace for the
+        # rollout's lifetime — pin it against ring eviction (a new rollout
+        # replaces the pin, releasing the old trace)
+        self.tracer.pin(f"rollout/{policy_name}", ctx.trace_id)
+        return ctx.serialize()
 
     async def _emit_operand_events(
         self, policy: TPUClusterPolicy, results: SyncResults
@@ -284,6 +334,14 @@ class ClusterPolicyReconciler:
             self.fleet = mgr.fleet
             if self.tracer.fleet is None:
                 self.tracer.fleet = mgr.fleet
+        # the explain engine flows the same way (manager serves
+        # /debug/explain, this reconciler feeds it node evidence)
+        if mgr.explain is None and self.explain is not None:
+            mgr.explain = self.explain
+        elif self.explain is None and mgr.explain is not None:
+            self.explain = mgr.explain
+        if self.explain is not None and self.recorder.sink is None:
+            self.recorder.sink = self.explain.observe_event
         controller = mgr.add_controller(Controller("clusterpolicy", self.reconcile))
 
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)

@@ -226,6 +226,7 @@ class Manager:
         recorder=None,
         operator_metrics=None,
         fleet=None,
+        explain=None,
         fleet_eval_interval: float = consts.FLEET_EVAL_SECONDS,
     ):
         self.client = client
@@ -246,6 +247,10 @@ class Manager:
         # /debug/fleet, and drives the SLO burn-rate loop.  Reconciler
         # setup() adopts/donates it the same way as operator_metrics.
         self.fleet = fleet
+        # obs.explain.ExplainEngine: backs /debug/explain; fed node
+        # evidence by the clusterpolicy reconciler and SLO episodes by the
+        # fleet loop below.  Flows through setup() like the aggregator.
+        self.explain = explain
         self.fleet_eval_interval = fleet_eval_interval
         self._fleet_task: Optional[asyncio.Task] = None
         # --leader-lease-renew-deadline analogue (cmd/gpu-operator
@@ -445,7 +450,12 @@ class Manager:
                     # SLOBurnRate evidence, or an HA pair double-fires
                     await asyncio.sleep(self.fleet_eval_interval)
                     continue
+                # offender sets BEFORE evaluation: a recovery pops its
+                # offenders, and the explain timeline must still name the
+                # nodes the episode was about
+                prior_offenders = self.fleet.slo_engine.breached_offenders()
                 transitions = self.fleet.evaluate_slos()
+                current_offenders = self.fleet.slo_engine.breached_offenders()
                 for kind, slo, message in transitions:
                     if kind == "fired":
                         self._queue_event(
@@ -459,6 +469,12 @@ class Manager:
                             fleet_events.REASON_SLO_RECOVERED, message,
                         )
                         log.info("SLO recovered: %s", message)
+                    if self.explain is not None:
+                        offenders = (
+                            current_offenders if kind == "fired"
+                            else prior_offenders
+                        ).get(slo, [])
+                        self.explain.observe_slo(kind, slo, message, offenders)
                 if self.operator_metrics is not None:
                     self.fleet.export()
             except Exception:  # noqa: BLE001 — telemetry loop must not die
@@ -515,6 +531,7 @@ class Manager:
         metrics.router.add_get("/metrics", self._metrics)
         metrics.router.add_get("/debug/traces", self._traces)
         metrics.router.add_get("/debug/fleet", self._fleet_snapshot)
+        metrics.router.add_get("/debug/explain", self._explain)
         metrics.router.add_post("/push", self._fleet_push)
         # one server per port unless they coincide
         apps = {}
@@ -525,6 +542,7 @@ class Manager:
                 health.router.add_get("/metrics", self._metrics)
                 health.router.add_get("/debug/traces", self._traces)
                 health.router.add_get("/debug/fleet", self._fleet_snapshot)
+                health.router.add_get("/debug/explain", self._explain)
                 health.router.add_post("/push", self._fleet_push)
             else:
                 apps[id(metrics)] = (self.metrics_port, metrics)
@@ -575,15 +593,19 @@ class Manager:
         trace: {name, kind, reconcile_id, start_ts, duration_s, attrs?,
         error?, children?[...]} — see docs/OBSERVABILITY.md.
 
-        Query params: ``?reconcile_id=`` / ``?controller=`` filter (the
-        exemplar ids on /debug/fleet and flight records join here), and
+        Query params: ``?reconcile_id=`` / ``?trace_id=`` /
+        ``?controller=`` filter (the exemplar ids on /debug/fleet, flight
+        records, and /debug/explain's trace links join here), and
         ``?limit=N`` caps the response (newest first)."""
         traces = self.tracer.snapshot() if self.tracer is not None else []
         q = request.rel_url.query
         rid = q.get("reconcile_id", "")
+        tid = q.get("trace_id", "")
         controller = q.get("controller", "")
         if rid:
             traces = [t for t in traces if t.get("reconcile_id") == rid]
+        if tid:
+            traces = [t for t in traces if t.get("trace_id") == tid]
         if controller:
             traces = [
                 t for t in traces
@@ -598,6 +620,20 @@ class Manager:
                     {"error": f"invalid limit {limit!r}"}, status=400
                 )
         return web.json_response({"traces": traces})
+
+    async def _explain(self, request: web.Request) -> web.Response:
+        """Per-node causal timeline + blocking-on verdict
+        (obs/explain.py; docs/OBSERVABILITY.md "Causal tracing &
+        explain").  ``?node=<name>`` selects the node; without it the
+        known node names are listed so the reader can pick one."""
+        if self.explain is None:
+            return web.json_response(
+                {"error": "explain engine not enabled"}, status=404
+            )
+        node = request.rel_url.query.get("node", "")
+        if not node:
+            return web.json_response({"nodes": self.explain.nodes()})
+        return web.json_response(self.explain.snapshot(node))
 
     async def _fleet_snapshot(self, request: web.Request) -> web.Response:
         """Windowed fleet rollups + exemplars + SLO state (obs/fleet.py;

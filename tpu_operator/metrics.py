@@ -229,6 +229,15 @@ class OperatorMetrics:
             ["metric", "quantile"],
             registry=self.registry,
         )
+        self.join_phase_seconds = Gauge(
+            "tpu_operator_join_phase_seconds",
+            "Windowed fleet rollup of the join->validated critical path, "
+            "per propagated phase segment (runtime-ready / "
+            "validator-scheduled / plugin-advertised / compile / "
+            "collective); quantile is p50/p90/p99/min/max/mean/count",
+            ["phase", "quantile"],
+            registry=self.registry,
+        )
         self.fleet_series = g(
             "tpu_operator_fleet_series",
             "Distinct (metric, labels) series currently held in the "

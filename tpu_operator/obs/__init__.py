@@ -7,7 +7,10 @@ in-tree equivalent every controller and the apply layer report through:
 
 - ``obs.trace``   — context-manager spans with a contextvar-propagated
   reconcile id, feeding the Prometheus Histograms on ``OperatorMetrics``
-  and an in-memory ring buffer served at ``/debug/traces``.
+  and an in-memory ring buffer served at ``/debug/traces``; the
+  serializable ``TraceContext`` (``TPU_TRACEPARENT``) + ``Tracer.adopt``
+  carry one trace id across process boundaries (operator → rendered pod
+  env → validator phases → flight samples → fleet exemplars).
 - ``obs.events``  — a ``v1/Event`` recorder with client-go-style
   dedup + count bumping.
 - ``obs.logging`` — structured JSON logging (opt-in via
@@ -21,5 +24,11 @@ in-tree equivalent every controller and the apply layer report through:
   aggregating spans, the agents' push hop, and informer-cached node
   evidence into windowed rollups (``/debug/fleet``,
   ``tpu_operator_fleet_*``) plus the declarative SLO burn-rate engine
-  (``SLOBurnRate``/``SLORecovered`` Events, health-engine signal).
+  (``SLOBurnRate``/``SLORecovered`` Events, health-engine signal) and
+  the join→validated critical-path breakdown
+  (``join_phase_seconds{node,phase}`` →
+  ``tpu_operator_join_phase_seconds``).
+- ``obs.explain`` — the per-node causal timeline + blocking-on verdict
+  behind ``GET /debug/explain?node=``: node state transitions, deduped
+  Events, SLO episodes, and propagated trace links in one document.
 """

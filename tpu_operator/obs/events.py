@@ -17,7 +17,9 @@ import uuid
 from collections import OrderedDict
 from typing import Optional
 
+from tpu_operator import consts
 from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.obs import trace as obs_trace
 
 log = logging.getLogger("tpu_operator.obs.events")
 
@@ -97,6 +99,11 @@ class EventRecorder:
         self.cache_size = cache_size
         # correlation key -> last posted Event object (live copy)
         self._cache: OrderedDict[tuple, dict] = OrderedDict()
+        # optional observer (obs.explain.ExplainEngine.observe_event):
+        # called for every emitted Event — including ones whose API post
+        # fails, because the timeline is evidence precisely when the
+        # apiserver is wobbling.  Never allowed to raise into a post.
+        self.sink = None
 
     # ------------------------------------------------------------------
     async def normal(self, involved: dict, reason: str, message: str) -> Optional[dict]:
@@ -110,6 +117,11 @@ class EventRecorder:
     ) -> Optional[dict]:
         """Post (or count-bump) an Event.  Never raises: Events are
         evidence for humans/alerting, not reconcile control flow."""
+        if self.sink is not None:
+            try:
+                self.sink(involved, type_, reason, message)
+            except Exception as e:  # noqa: BLE001
+                log.debug("event sink failed: %s", e)
         try:
             return await self._post(involved, type_, reason, message)
         except Exception as e:  # noqa: BLE001
@@ -134,12 +146,26 @@ class EventRecorder:
         self, involved: dict, type_: str, reason: str, message: str
     ) -> Optional[dict]:
         key = self._key(involved, type_, reason, message)
+        # the posting pass's correlation ids: kubectl get events -o yaml
+        # joins to /debug/traces and /debug/explain through these
+        trace_anns = {}
+        rid = obs_trace.reconcile_id()
+        tid = obs_trace.trace_id()
+        if rid:
+            trace_anns[consts.EVENT_RECONCILE_ID_ANNOTATION] = rid
+        if tid:
+            trace_anns[consts.EVENT_TRACE_ID_ANNOTATION] = tid
         cached = self._cache.get(key)
         if cached is not None:
             # correlator hit: bump count/lastTimestamp on the live object
             ev = copy.deepcopy(cached)
             ev["count"] = int(ev.get("count", 1)) + 1
             ev["lastTimestamp"] = _now()
+            if trace_anns:
+                # a repeat names the LATEST pass that observed it — the
+                # join should lead to current evidence, not the first
+                # occurrence hours ago
+                ev["metadata"].setdefault("annotations", {}).update(trace_anns)
             try:
                 live = await self.client.update(ev)
                 self._cache[key] = live
@@ -174,6 +200,7 @@ class EventRecorder:
             "metadata": {
                 "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:10]}",
                 "namespace": self.namespace,
+                **({"annotations": trace_anns} if trace_anns else {}),
             },
             "involvedObject": {
                 "apiVersion": involved.get("apiVersion", ""),

@@ -1,0 +1,369 @@
+"""Per-node causal explain engine: ``GET /debug/explain?node=``.
+
+PRs 1/2/6 left "why is node X not validated" spread over four surfaces —
+``/debug/traces``, Events, node labels, and the fleet rollups — each
+correlated by hand.  This module stitches them into ONE time-ordered
+narrative per node:
+
+- **Node state transitions** observed from the informer-cached node list a
+  reconcile pass already holds (``observe_nodes`` — zero API verbs, the
+  ``collect_nodes`` discipline): join, validated, Ready flaps, cordons,
+  agent health verdicts, health-engine hysteresis/escalation states,
+  upgrade and remediation machine states, slice readiness.
+- **Kubernetes Events** involving the node, fed by the EventRecorder's
+  sink hook at post time (already deduped by its correlator).
+- **SLO breach episodes** naming the node among their offenders, fed by
+  the Manager's fleet loop on every fired/recovered transition.
+- **Propagated traces**: the join-phase pushes carry the
+  ``TPU_TRACEPARENT`` trace id minted by the operator
+  (state/render_data.py), so the snapshot links the node straight to the
+  reconcile span trees in ``/debug/traces?trace_id=``.
+
+The headline field is the machine-readable ``blocking_on`` verdict: what
+this node is waiting on RIGHT NOW ("waiting: validator compile, 9.2s so
+far"), derived from the ownership hierarchy (health engine > upgrade >
+remediation > join critical path) and the join-phase segments the
+validator pushed (obs/fleet.py ``JOIN_PHASES``).
+
+Everything here is bounded evidence: per-node timelines are rings,
+departed nodes are pruned with the fleet aggregator's node map, and a
+snapshot never performs I/O.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Iterable, Optional
+
+from tpu_operator import consts
+from tpu_operator.obs import fleet as fleet_api
+from tpu_operator.utils import deep_get
+
+# timeline entries kept per node: enough to tell the node's story across a
+# few join/upgrade/remediation episodes, small enough that 10k nodes hold
+# ~10k rings of dicts, not a database
+TIMELINE_MAX = 128
+
+# entry kinds, for readers filtering the narrative
+KIND_NODE = "node"            # join/validated/Ready/cordon transitions
+KIND_HEALTH = "health"        # agent verdicts + health-engine states
+KIND_UPGRADE = "upgrade"      # upgrade machine state label
+KIND_REMEDIATION = "remediation"
+KIND_EVENT = "event"          # deduped Kubernetes Events on the node
+KIND_SLO = "slo"              # fleet SLO episodes naming this node
+
+# label/annotation fields whose transitions the timeline narrates,
+# (field key, entry kind, human name)
+_WATCHED_LABELS = (
+    (consts.TPU_HEALTH_LABEL, KIND_HEALTH, "agent health verdict"),
+    (consts.HEALTH_STATE_LABEL, KIND_HEALTH, "health engine state"),
+    (consts.UPGRADE_STATE_LABEL, KIND_UPGRADE, "upgrade state"),
+    (consts.REMEDIATION_STATE_LABEL, KIND_REMEDIATION, "remediation state"),
+    (consts.VALIDATE_REQUEST_LABEL, KIND_REMEDIATION, "re-validation request"),
+    (consts.SLICE_READY_LABEL, KIND_NODE, "slice readiness"),
+)
+_WATCHED_ANNOTATIONS = (
+    (consts.HEALTH_ESCALATION_ANNOTATION, KIND_HEALTH, "health escalation rung"),
+    (consts.TPU_HEALTH_REASON_ANNOTATION, KIND_HEALTH, "agent health reason"),
+    (consts.HEALTH_DEGRADED_BY_ANNOTATION, KIND_HEALTH, "slice-degraded by"),
+)
+
+def _upgrade_active_states() -> tuple:
+    """The states in which the upgrade machine owns the node — the ONE
+    source of truth in controllers/upgrade.py, imported lazily so the obs
+    layer carries no controller import at module load (an inlined copy
+    here drifted once already: it missed drain-required)."""
+    from tpu_operator.controllers.upgrade import NON_TERMINAL_STATES
+
+    return NON_TERMINAL_STATES
+
+
+class ExplainEngine:
+    """Stitches node evidence into ``/debug/explain`` documents."""
+
+    def __init__(self, fleet=None, tracer=None, max_entries: int = TIMELINE_MAX):
+        # obs.fleet.FleetAggregator: per-node join evidence + SLO state
+        self.fleet = fleet
+        # obs.trace.Tracer: the /debug/traces ring the snapshot links into
+        self.tracer = tracer
+        self.max_entries = max_entries
+        self._timelines: dict[str, deque] = {}
+        # last observed field snapshot per node, for transition detection
+        self._last: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Ingest: informer-cached node evidence (zero API verbs).
+
+    def observe_nodes(self, nodes: list[dict], now: Optional[float] = None) -> None:
+        """One pass over the cached node list: append a timeline entry per
+        observed transition.  Called from the clusterpolicy reconcile pass
+        that already holds the list — same zero-API discipline as
+        ``FleetAggregator.collect_nodes``."""
+        now = time.time() if now is None else now
+        live: set[str] = set()
+        for node in nodes:
+            name = deep_get(node, "metadata", "name", default="")
+            if not name:
+                continue
+            live.add(name)
+            self._observe_node(name, node, now)
+        with self._lock:
+            for gone in set(self._last) - live:
+                del self._last[gone]
+                self._timelines.pop(gone, None)
+
+    def _observe_node(self, name: str, node: dict, now: float) -> None:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        anns = deep_get(node, "metadata", "annotations", default={}) or {}
+        fields: dict = {
+            "validated": consts.TPU_RESOURCE
+            in (deep_get(node, "status", "allocatable") or {}),
+            "ready": self._ready(node),
+            "unschedulable": bool(deep_get(node, "spec", "unschedulable")),
+        }
+        for key, _, _ in _WATCHED_LABELS:
+            fields[key] = labels.get(key, "")
+        for key, _, _ in _WATCHED_ANNOTATIONS:
+            fields[key] = anns.get(key, "")
+        with self._lock:
+            prev = self._last.get(name)
+            self._last[name] = fields
+            if prev is None:
+                # first sight: anchor the timeline at the node's join; the
+                # current non-default states are recorded once so a
+                # restarted operator still explains a mid-episode node
+                created = fleet_api._parse_k8s_ts(
+                    deep_get(node, "metadata", "creationTimestamp", default="")
+                )
+                self._append(name, created or now, KIND_NODE, "node joined the cluster")
+                prev = {
+                    "validated": False, "ready": True, "unschedulable": False,
+                    **{k: "" for k, _, _ in _WATCHED_LABELS},
+                    **{k: "" for k, _, _ in _WATCHED_ANNOTATIONS},
+                }
+            if fields["validated"] != prev["validated"]:
+                self._append(
+                    name, now, KIND_NODE,
+                    "node validated (google.com/tpu advertised)"
+                    if fields["validated"]
+                    else "node lost validation (google.com/tpu withdrawn)",
+                )
+            if fields["ready"] != prev["ready"] and fields["ready"] is not None:
+                self._append(
+                    name, now, KIND_NODE,
+                    "Ready condition True" if fields["ready"]
+                    else "Ready condition False",
+                )
+            if fields["unschedulable"] != prev["unschedulable"]:
+                self._append(
+                    name, now, KIND_NODE,
+                    "node cordoned" if fields["unschedulable"] else "node uncordoned",
+                )
+            for key, kind, title in (*_WATCHED_LABELS, *_WATCHED_ANNOTATIONS):
+                if fields[key] != prev.get(key, ""):
+                    frm, to = prev.get(key, ""), fields[key]
+                    self._append(
+                        name, now, kind,
+                        f"{title}: {frm or '(none)'} -> {to or '(cleared)'}",
+                        field=key,
+                    )
+
+    @staticmethod
+    def _ready(node: dict) -> Optional[bool]:
+        for cond in deep_get(node, "status", "conditions", default=[]) or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return None
+
+    # ------------------------------------------------------------------
+    # Ingest: Events + SLO episodes (push hooks).
+
+    def observe_event(
+        self, involved: dict, type_: str, reason: str, message: str
+    ) -> None:
+        """EventRecorder sink: node-involved Events join the timeline at
+        post time, already deduped by the recorder's correlator."""
+        if involved.get("kind") != "Node":
+            return
+        name = deep_get(involved, "metadata", "name", default="")
+        if not name:
+            return
+        with self._lock:
+            if name not in self._last:
+                # unknown (or already-departed) node: a trailing Event
+                # racing node deletion must not resurrect a timeline the
+                # prune loop (keyed on observed nodes) would never reap
+                return
+            self._append(
+                name, time.time(), KIND_EVENT,
+                f"{type_}/{reason}: {message}"[:512],
+                reason=reason,
+            )
+
+    def observe_slo(
+        self, kind: str, slo: str, message: str, offenders: Iterable[str] = ()
+    ) -> None:
+        """Manager fleet-loop hook: a fired/recovered SLO transition lands
+        on every offender node's timeline."""
+        now = time.time()
+        with self._lock:
+            for node in offenders:
+                if node not in self._last:
+                    continue  # same no-resurrection rule as observe_event
+                self._append(
+                    node, now, KIND_SLO,
+                    f"SLO {slo} {kind}: {message}"[:512],
+                    slo=slo,
+                )
+
+    def _append(self, node: str, ts: float, kind: str, detail: str, **extra) -> None:
+        ring = self._timelines.get(node)
+        if ring is None:
+            ring = self._timelines[node] = deque(maxlen=self.max_entries)
+        ring.append({"ts": round(ts, 3), "kind": kind, "detail": detail, **extra})
+
+    # ------------------------------------------------------------------
+    # The /debug/explain document.
+
+    def nodes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._last)
+
+    def snapshot(self, node: str, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            timeline = sorted(
+                self._timelines.get(node, ()), key=lambda e: e["ts"]
+            )
+            fields = dict(self._last.get(node) or {})
+        join = self.fleet.node_join(node) if self.fleet is not None else {
+            "validated": False, "phases": {},
+        }
+        # the engine's OWN observation of allocatable is authoritative too:
+        # a fleet fed by a different process (or none) must not make a
+        # validated node read as mid-join
+        join["validated"] = bool(join.get("validated") or fields.get("validated"))
+        slos = self._node_slos(node)
+        trace_ids = sorted({
+            entry.get("trace_id", "")
+            for entry in join.get("phases", {}).values()
+            if entry.get("trace_id")
+        })
+        doc = {
+            "node": node,
+            "ts": round(now, 3),
+            "known": bool(fields),
+            "blocking_on": self._blocking_on(node, fields, join, slos, now),
+            "join": join,
+            "slos_breached": slos,
+            "timeline": timeline,
+            "trace_ids": trace_ids,
+            "traces": self._linked_traces(trace_ids),
+        }
+        return doc
+
+    def _node_slos(self, node: str) -> list[str]:
+        if self.fleet is None:
+            return []
+        return sorted(
+            name
+            for name, offenders in self.fleet.slo_engine.breached_offenders().items()
+            if node in offenders
+        )
+
+    def _linked_traces(self, trace_ids: list[str]) -> list[dict]:
+        """Summaries of ring traces this node's propagated ids point at —
+        enough to jump to ``/debug/traces?trace_id=`` without guessing."""
+        if self.tracer is None or not trace_ids:
+            return []
+        wanted = set(trace_ids)
+        out = []
+        for trace in self.tracer.snapshot():
+            if trace.get("trace_id") in wanted:
+                out.append({
+                    k: trace[k]
+                    for k in ("name", "trace_id", "reconcile_id",
+                              "start_ts", "duration_s", "evicted")
+                    if k in trace
+                })
+        return out
+
+    def _blocking_on(
+        self, node: str, fields: dict, join: dict, slos: list, now: float
+    ) -> dict:
+        """The machine-readable verdict: what owns this node's progress
+        right now, in ownership-hierarchy order (health actuation >
+        upgrade machine > remediation machine > join critical path)."""
+        if not fields:
+            return {"state": "unknown", "detail": f"node {node} never observed"}
+        health_state = fields.get(consts.HEALTH_STATE_LABEL, "")
+        escalation = fields.get(consts.HEALTH_ESCALATION_ANNOTATION, "")
+        if health_state in (consts.HEALTH_QUARANTINED, consts.HEALTH_TRIPPED,
+                            consts.HEALTH_OBSERVE) or escalation:
+            reason = fields.get(consts.TPU_HEALTH_REASON_ANNOTATION, "")
+            return {
+                "state": "health",
+                "phase": escalation or health_state,
+                "detail": (
+                    f"health engine owns the node "
+                    f"(state={health_state or 'tripped'}"
+                    + (f", rung={escalation}" if escalation else "")
+                    + (f", reason={reason}" if reason else "")
+                    + ")"
+                ),
+            }
+        upgrade = fields.get(consts.UPGRADE_STATE_LABEL, "")
+        if upgrade in _upgrade_active_states():
+            return {
+                "state": "upgrade",
+                "phase": upgrade,
+                "detail": f"runtime upgrade machine owns the node ({upgrade})",
+            }
+        remediation = fields.get(consts.REMEDIATION_STATE_LABEL, "")
+        request = fields.get(consts.VALIDATE_REQUEST_LABEL, "")
+        if remediation == "revalidating" or request == "requested":
+            return {
+                "state": "remediation",
+                "phase": remediation or "requested",
+                "detail": "re-validation in progress",
+            }
+        if not join.get("validated"):
+            return self._joining_verdict(join, now)
+        verdict: dict = {"state": "validated", "phase": "", "detail": "node validated"}
+        if slos:
+            verdict["detail"] += (
+                "; breaching SLO " + ", ".join(slos) + " (see slos_breached)"
+            )
+        return verdict
+
+    def _joining_verdict(self, join: dict, now: float) -> dict:
+        """Mid-join: the first missing phase of the propagated critical
+        path is what the node is waiting on; elapsed counts from the
+        newest received segment (or the join itself)."""
+        phases = join.get("phases") or {}
+        waiting = next(
+            (p for p in fleet_api.JOIN_PHASES if p not in phases),
+            fleet_api.JOIN_PHASES[-1],
+        )
+        newest = max((e.get("ts", 0.0) for e in phases.values()), default=0.0)
+        elapsed = max(0.0, now - newest) if newest else None
+        detail = f"waiting: {self._phase_label(waiting)}"
+        if elapsed is not None:
+            detail += f", {elapsed:.1f}s so far"
+        out = {"state": "joining", "phase": waiting, "detail": detail}
+        if elapsed is not None:
+            out["waiting_s"] = round(elapsed, 3)
+        return out
+
+    @staticmethod
+    def _phase_label(phase: str) -> str:
+        return {
+            "runtime-ready": "tpu runtime container",
+            "validator-scheduled": "validator scheduling + PJRT probe",
+            "plugin-advertised": "device plugin advertising google.com/tpu",
+            "compile": "validator compile",
+            "collective": "validation collective",
+        }.get(phase, phase)

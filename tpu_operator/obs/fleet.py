@@ -64,6 +64,7 @@ log = logging.getLogger("tpu_operator.obs.fleet")
 # surface must stay the documented catalogue.
 METRIC_RECONCILE_DURATION = "reconcile_duration_seconds"
 METRIC_JOIN_TO_VALIDATED = "join_to_validated_seconds"
+METRIC_JOIN_PHASE = "join_phase_seconds"
 METRIC_HEALTH_UNHEALTHY = "health_verdict_unhealthy_nodes"
 METRIC_CHIP_SCRAPE_ERRORS = "chip_scrape_errors_total"
 
@@ -73,8 +74,23 @@ _METRIC_NAME_MAX = 128
 OPERATOR_METRICS_CATALOGUE = (
     METRIC_RECONCILE_DURATION,
     METRIC_JOIN_TO_VALIDATED,
+    METRIC_JOIN_PHASE,
     METRIC_HEALTH_UNHEALTHY,
     METRIC_CHIP_SCRAPE_ERRORS,
+)
+
+# join→validated critical-path phases, in pipeline order (the validator
+# derives the segments from its status-file timestamps + flight record —
+# validator/status.join_phase_segments — and pushes them through the agent
+# hop).  Ingest accepts ONLY these names: the phase label must stay a
+# bounded vocabulary or the exported rollup family grows with attacker
+# input (both push ports are unauthenticated).
+JOIN_PHASES = (
+    "runtime-ready",
+    "validator-scheduled",
+    "plugin-advertised",
+    "compile",
+    "collective",
 )
 
 # ingest sources (fleet_samples_ingested_total label values)
@@ -88,6 +104,11 @@ _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 # recent traces, small enough to never matter
 _EXEMPLARS_PER_METRIC = 8
 
+# distinct node names the per-node join-phase map may hold: pushes arrive
+# from an unauthenticated port, and an invented node name must not grow
+# operator memory without bound (live nodes are pruned by collect_nodes)
+_JOIN_PHASE_MAX_NODES = 4096
+
 
 def _valid_metric_name(name: str) -> bool:
     if not isinstance(name, str) or not name or len(name) > _METRIC_NAME_MAX:
@@ -97,6 +118,16 @@ def _valid_metric_name(name: str) -> bool:
     return name.startswith(_WORKLOAD_METRIC_PREFIX) and name.replace(
         "_", ""
     ).isalnum() and name == name.lower()
+
+
+def _roll(sorted_values: list) -> dict:
+    return {
+        "count": len(sorted_values),
+        "min": sorted_values[0],
+        "max": sorted_values[-1],
+        "mean": sum(sorted_values) / len(sorted_values),
+        **{q: quantile(sorted_values, frac) for q, frac in _QUANTILES},
+    }
 
 
 def quantile(sorted_values: list, q: float) -> float:
@@ -207,6 +238,12 @@ class SLOEngine:
         # slo name -> {node -> bad sample count} in the shortest window,
         # refreshed each evaluation while breached (health-engine signal)
         self._offenders: dict[str, dict[str, int]] = {}
+        # slo name -> trace/reconcile ids of the metric's exemplars at the
+        # moment the breach fired, held until recovery: the traces a human
+        # follows from the SLOBurnRate Event must survive ring eviction
+        # (obs.trace.Tracer pins on referenced_trace_ids) for as long as
+        # the breach is unresolved
+        self._breach_trace_ids: dict[str, set] = {}
 
     def configure(self, slo_dicts: Iterable[dict]) -> None:
         """(Re)parse the declarative spec; breach state survives for SLOs
@@ -223,6 +260,7 @@ class SLOEngine:
             self._drop_gauges(gone, self.slos[gone].windows)
             self.breached.pop(gone, None)
             self._offenders.pop(gone, None)
+            self._breach_trace_ids.pop(gone, None)
         for kept, slo in parsed.items():
             old = self.slos.get(kept)
             if old is None:
@@ -322,6 +360,9 @@ class SLOEngine:
             if not was and all_burning:
                 self.breached[name] = True
                 self._offenders[name] = offenders
+                self._breach_trace_ids[name] = (
+                    self.aggregator.exemplar_trace_ids(slo.metric)
+                )
                 detail = ", ".join(
                     f"{w:g}s={burns[w]:.2f}x" for w in windows
                 )
@@ -333,6 +374,7 @@ class SLOEngine:
             elif was and (short_quiet or all_dark):
                 self.breached[name] = False
                 self._offenders.pop(name, None)
+                self._breach_trace_ids.pop(name, None)
                 transitions.append((
                     "recovered", name,
                     f"SLO {name} ({slo.metric}) "
@@ -363,6 +405,25 @@ class SLOEngine:
     # ------------------------------------------------------------------
     def breached_slos(self) -> dict[str, SLOSpec]:
         return {n: self.slos[n] for n, b in self.breached.items() if b and n in self.slos}
+
+    def breached_offenders(self) -> dict[str, list]:
+        """{breached slo name: sorted offender nodes} — the explain engine
+        and the Manager's SLO hooks read through this instead of the raw
+        offender bookkeeping."""
+        return {
+            name: sorted(bad_nodes)
+            for name, bad_nodes in self._offenders.items()
+            if self.breached.get(name)
+        }
+
+    def breach_trace_ids(self) -> set:
+        """Trace/reconcile ids referenced by UNRESOLVED breaches (pinned
+        against /debug/traces ring eviction until recovery)."""
+        out: set = set()
+        for name, ids in self._breach_trace_ids.items():
+            if self.breached.get(name):
+                out |= ids
+        return out
 
     def node_offenders(self, node: str) -> list[str]:
         """SLO names currently breached with this node among the bad
@@ -421,6 +482,7 @@ class FleetAggregator:
         # metrics whose rollup gauges are currently exported; emptied
         # windows remove their label sets instead of freezing stale values
         self._exported: set[str] = set()
+        self._exported_phases: set[str] = set()
         self._lock = threading.Lock()
         self.slo_engine = SLOEngine(self, metrics)
         # join→validated transition tracking: node -> last seen validated?
@@ -429,6 +491,12 @@ class FleetAggregator:
         # lagging watch briefly showing a node unvalidated again must not
         # re-fire the transition and double-count the join
         self._node_joined: set[str] = set()
+        # per-node join evidence behind /debug/explain: the measured
+        # join→validated seconds (node -> value) and the pushed phase
+        # segments (node -> {phase: {"seconds", "ts", "trace_id"}}) — both
+        # pruned with the node-validated map when nodes leave the cluster
+        self._node_join_seconds: dict[str, float] = {}
+        self._node_join_phases: dict[str, dict[str, dict]] = {}
         # throttle for the gauge-style health verdict series
         self._last_unhealthy: Optional[tuple[float, float]] = None  # (ts, count)
 
@@ -499,6 +567,7 @@ class FleetAggregator:
                 exemplar={
                     "span_id": span.span_id,
                     "reconcile_id": span.reconcile_id,
+                    "trace_id": span.trace_id,
                 },
                 source=SOURCE_SPAN,
             )
@@ -508,9 +577,18 @@ class FleetAggregator:
     def ingest_push(self, body: Any) -> int:
         """One forwarded agent push::
 
-            {"node": "tpu-0-0",
+            {"node": "tpu-0-0", "trace_id": "9c1d05e3f2aa",
              "workloads": {"train": {"counters": {"tpu_workload_mfu": 0.95}}},
+             "join_phases": {"compile": 9.2, "collective": 0.8},
              "chips": {"scrape_errors_total": 3}}
+
+        ``trace_id`` is the propagated TPU_TRACEPARENT trace (the flight
+        recorder stamps it, the agent hop forwards it): it rides every
+        ingested sample as an exemplar, joining the push back to the
+        operator reconcile that minted the context.  ``join_phases``
+        (validator-pushed critical-path segments) become bounded
+        ``join_phase_seconds{node,phase}`` samples and feed the per-node
+        evidence behind ``/debug/explain``.
 
         Returns accepted sample count; malformed shapes are counted and
         skipped, never raised (the route answers 400/413 for body-level
@@ -519,6 +597,9 @@ class FleetAggregator:
             self._reject("bad-shape")
             return 0
         node = str(body.get("node") or "")
+        raw_trace = body.get("trace_id")
+        trace_id = raw_trace if isinstance(raw_trace, str) and len(raw_trace) <= 32 else ""
+        exemplar = {"node": node, "trace_id": trace_id} if trace_id else None
         accepted = 0
         workloads = body.get("workloads")
         if isinstance(workloads, dict):
@@ -531,8 +612,14 @@ class FleetAggregator:
                     labels = {"workload": str(check)}
                     if node:
                         labels["node"] = node
-                    if self.ingest(counter, value, labels, source=SOURCE_PUSH):
+                    if self.ingest(
+                        counter, value, labels, exemplar=exemplar,
+                        source=SOURCE_PUSH,
+                    ):
                         accepted += 1
+        accepted += self._ingest_join_phases(
+            node, body.get("join_phases"), trace_id
+        )
         chips = body.get("chips")
         if isinstance(chips, dict):
             value = chips.get("scrape_errors_total")
@@ -541,6 +628,48 @@ class FleetAggregator:
                 {"node": node} if node else {}, source=SOURCE_PUSH,
             ):
                 accepted += 1
+        return accepted
+
+    def _ingest_join_phases(
+        self, node: str, phases: Any, trace_id: str
+    ) -> int:
+        """Validator-pushed critical-path segments.  Phase names outside
+        :data:`JOIN_PHASES` are rejected (bounded label vocabulary on an
+        unauthenticated port); node names beyond the per-node map cap are
+        dropped for the explain evidence but still sampled into the ring
+        (the ring has its own series cap)."""
+        if not isinstance(phases, dict) or not node:
+            if phases is not None:
+                self._reject("bad-shape")
+            return 0
+        now = time.time()
+        accepted = 0
+        for phase, seconds in phases.items():
+            if phase not in JOIN_PHASES:
+                self._reject("unknown-metric")
+                continue
+            if not self.ingest(
+                METRIC_JOIN_PHASE,
+                seconds,
+                {"node": node, "phase": phase},
+                ts=now,
+                exemplar={"node": node, "phase": phase, "trace_id": trace_id}
+                if trace_id
+                else None,
+                source=SOURCE_PUSH,
+            ):
+                continue
+            accepted += 1
+            with self._lock:
+                if (
+                    node in self._node_join_phases
+                    or len(self._node_join_phases) < _JOIN_PHASE_MAX_NODES
+                ):
+                    self._node_join_phases.setdefault(node, {})[phase] = {
+                        "seconds": float(seconds),
+                        "ts": round(now, 3),
+                        "trace_id": trace_id,
+                    }
         return accepted
 
     def collect_nodes(self, nodes: list[dict], now: Optional[float] = None) -> None:
@@ -571,9 +700,11 @@ class FleetAggregator:
                     deep_get(node, "metadata", "creationTimestamp", default="")
                 )
                 if created is not None:
+                    join_s = max(0.0, now - created)
+                    self._node_join_seconds[name] = join_s
                     self.ingest(
                         METRIC_JOIN_TO_VALIDATED,
-                        max(0.0, now - created),
+                        join_s,
                         {"node": name},
                         ts=now,
                         source=SOURCE_NODE,
@@ -581,6 +712,15 @@ class FleetAggregator:
         for gone in set(self._node_validated) - live:
             del self._node_validated[gone]
             self._node_joined.discard(gone)
+            self._node_join_seconds.pop(gone, None)
+        with self._lock:
+            # prune against the LIVE set, not just departed known nodes:
+            # the push port is unauthenticated, and phase entries for
+            # invented node names (never in the informer list) would
+            # otherwise hold the per-node cap forever, starving real
+            # joins of their explain evidence
+            for gone in set(self._node_join_phases) - live:
+                del self._node_join_phases[gone]
         # gauge-style series, throttled: ingest on change or every 5s
         last = self._last_unhealthy
         if last is None or last[1] != unhealthy or now - last[0] >= 5.0:
@@ -589,6 +729,56 @@ class FleetAggregator:
                 METRIC_HEALTH_UNHEALTHY, float(unhealthy), ts=now,
                 source=SOURCE_NODE,
             )
+
+    # ------------------------------------------------------------------
+    # Per-node join evidence (the /debug/explain data plane).
+
+    def node_join(self, node: str) -> dict:
+        """This node's join→validated evidence: ``validated`` (bool),
+        ``join_to_validated_seconds`` (present once the transition was
+        observed), and the pushed ``phases`` segments in pipeline order."""
+        with self._lock:
+            phases = {
+                phase: dict(entry)
+                for phase, entry in (self._node_join_phases.get(node) or {}).items()
+            }
+        out: dict = {
+            "validated": bool(self._node_validated.get(node)),
+            "phases": {p: phases[p] for p in JOIN_PHASES if p in phases},
+        }
+        join_s = self._node_join_seconds.get(node)
+        if join_s is not None:
+            out["join_to_validated_seconds"] = round(join_s, 3)
+        return out
+
+    def known_nodes(self) -> list[str]:
+        return sorted(self._node_validated)
+
+    def exemplar_trace_ids(self, metric: str) -> set:
+        """Non-empty trace/reconcile ids referenced by a metric's current
+        exemplars."""
+        with self._lock:
+            exemplars = list(self._exemplars.get(metric) or ())
+        return {
+            ex[key]
+            for ex in exemplars
+            for key in ("trace_id", "reconcile_id")
+            if ex.get(key)
+        }
+
+    def referenced_trace_ids(self) -> set:
+        """Every trace/reconcile id a /debug/fleet reader could currently
+        be holding: live exemplars across all metrics plus the exemplar
+        sets snapshotted by unresolved SLO breaches.  The Tracer pins these
+        against ring eviction (obs/trace.py) so the join never dangles."""
+        with self._lock:
+            metrics = list(self._exemplars)
+        out: set = set()
+        for metric in metrics:
+            out |= self.exemplar_trace_ids(metric)
+        out |= self.slo_engine.breach_trace_ids()
+        out.discard("")
+        return out
 
     # ------------------------------------------------------------------
     # SLO plumbing.
@@ -626,12 +816,22 @@ class FleetAggregator:
         values = sorted(v for v, _ in self.window_samples(metric, window_s, now))
         if not values:
             return None
+        return _roll(values)
+
+    def join_phase_rollup(
+        self, window_s: float, now: Optional[float] = None
+    ) -> dict[str, dict]:
+        """Per-phase rollups of ``join_phase_seconds`` within the window —
+        the critical-path breakdown behind ``tpu_operator_join_phase_seconds``
+        and the bench's compile-dominance gate."""
+        by_phase: dict[str, list] = {}
+        for value, labels in self.window_samples(METRIC_JOIN_PHASE, window_s, now):
+            phase = labels.get("phase", "")
+            if phase:
+                by_phase.setdefault(phase, []).append(value)
         return {
-            "count": len(values),
-            "min": values[0],
-            "max": values[-1],
-            "mean": sum(values) / len(values),
-            **{q: quantile(values, frac) for q, frac in _QUANTILES},
+            phase: _roll(sorted(values))
+            for phase, values in by_phase.items()
         }
 
     def metrics_held(self) -> list[str]:
@@ -680,12 +880,18 @@ class FleetAggregator:
         with self._lock:
             exemplars = {m: list(d) for m, d in self._exemplars.items() if d}
             n_series = self._n_series
+        join_phases = {
+            f"{w:g}s": roll
+            for w in windows
+            if (roll := self.join_phase_rollup(w, now))
+        }
         return {
             "ts": round(now, 3),
             "windows_s": windows,
             "series": n_series,
             "nodes_reporting": self.nodes_reporting(max(windows), now),
             "metrics": metrics,
+            "join_phases": join_phases,
             "exemplars": exemplars,
             "slos": self.slo_engine.snapshot(),
         }
@@ -717,6 +923,24 @@ class FleetAggregator:
             for q in _QUANTILE_KEYS:
                 self.metrics.fleet_quantile.labels(
                     metric=metric, quantile=q
+                ).set(roll[q])
+        # the join critical path gets its own bounded family
+        # (tpu_operator_join_phase_seconds{phase,quantile}): phase is the
+        # fixed JOIN_PHASES vocabulary, so cardinality is 5 × 7 regardless
+        # of fleet size
+        per_phase = self.join_phase_rollup(window_s, now)
+        for phase in self._exported_phases - set(per_phase):
+            self._exported_phases.discard(phase)
+            for q in _QUANTILE_KEYS:
+                try:
+                    self.metrics.join_phase_seconds.remove(phase, q)
+                except KeyError:
+                    pass
+        for phase, roll in per_phase.items():
+            self._exported_phases.add(phase)
+            for q in _QUANTILE_KEYS:
+                self.metrics.join_phase_seconds.labels(
+                    phase=phase, quantile=q
                 ).set(roll[q])
         self.metrics.fleet_series.set(self.series_count())
         self.metrics.fleet_nodes_reporting.set(

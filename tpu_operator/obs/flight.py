@@ -105,6 +105,12 @@ class FlightRecorder:
     ):
         self.path = path
         self.push_url = push_url
+        # cross-process trace context (TPU_TRACEPARENT, stamped into the
+        # pod env by the operator): samples without an enclosing span still
+        # carry the propagated trace id, and every push window names it so
+        # the agent hop and fleet ingest can exemplar-link the trace
+        env_ctx = trace.TraceContext.from_env()
+        self.trace_id = env_ctx.trace_id if env_ctx is not None else ""
         self.run_id = run_id or f"{os.getpid()}-{int(time.time())}"
         self.push_interval = push_interval
         self.max_samples = max_samples
@@ -148,6 +154,12 @@ class FlightRecorder:
             sample["span_id"] = sp.span_id
             if sp.reconcile_id:
                 sample["reconcile_id"] = sp.reconcile_id
+        # the propagated trace id: from the enclosing span when one is
+        # active (an adopted tracer already joined the remote trace),
+        # else straight from the TPU_TRACEPARENT contract
+        tid = (sp.trace_id if sp is not None else "") or self.trace_id
+        if tid:
+            sample["trace_id"] = tid
         # non-finite floats (a NaN loss) would corrupt the JSONL record
         # and the push payload; record their absence, not their poison
         sample["metrics"] = {
@@ -289,13 +301,14 @@ class FlightRecorder:
                 if self._closed:
                     return
                 continue
-            body = json.dumps(
-                {
-                    "source": "workload",
-                    "run_id": self.run_id,
-                    "workloads": workloads,
-                }
-            ).encode()
+            payload = {
+                "source": "workload",
+                "run_id": self.run_id,
+                "workloads": workloads,
+            }
+            if self.trace_id:
+                payload["trace_id"] = self.trace_id
+            body = json.dumps(payload).encode()
             req = urllib.request.Request(
                 self.push_url,
                 data=body,
@@ -357,8 +370,14 @@ def active() -> Optional[FlightRecorder]:
     if recorder is not None:
         return recorder
     global _env_recorder, _env_key
-    key = (os.environ.get(RECORD_ENV, ""), os.environ.get(PUSH_ENV, ""))
-    if key == ("", ""):
+    key = (
+        os.environ.get(RECORD_ENV, ""),
+        os.environ.get(PUSH_ENV, ""),
+        # a changed trace context rotates the recorder too: samples must
+        # carry the CURRENT propagated trace id, not the one at first use
+        os.environ.get(trace.TRACEPARENT_ENV, ""),
+    )
+    if key[:2] == ("", ""):
         return None
     if _env_key != key:
         if _env_recorder is not None:
@@ -403,3 +422,46 @@ def close_active() -> None:
     recorder = active()
     if recorder is not None:
         recorder.close()
+
+
+def push_join_phases(
+    node: str,
+    phases: dict,
+    trace_id: str = "",
+    url: str = "",
+    timeout: float = 2.0,
+) -> bool:
+    """One-shot POST of a node's join→validated phase segments to the
+    metrics agent (``TPU_METRICS_PUSH_URL``), which forwards them to the
+    operator's fleet ingest where they become
+    ``join_phase_seconds{node,phase}`` samples — the critical-path
+    decomposition behind ``/debug/explain`` and the
+    ``tpu_operator_join_phase_seconds`` rollups.  Blocking by design: the
+    validator calls it through ``run_in_executor`` AFTER jax-ready is
+    written, off the readiness critical path.  Best-effort like every
+    telemetry hop — returns False instead of raising."""
+    url = url or os.environ.get(PUSH_ENV, "")
+    clean = {
+        str(k): float(v)
+        for k, v in (phases or {}).items()
+        if isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and math.isfinite(float(v))
+        and float(v) >= 0.0
+    }
+    if not url or not node or not clean:
+        return False
+    body: dict = {"source": "workload", "node": node, "join_phases": clean}
+    if trace_id:
+        body["trace_id"] = trace_id
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout):
+            return True
+    except (urllib.error.URLError, OSError, ValueError):
+        return False

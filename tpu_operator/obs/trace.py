@@ -8,9 +8,19 @@ k8s requests, apply calls, validator phases) inherit it through a
 contextvar, so one pass is correlatable across the four controllers, the
 apply layer, and the log stream without threading ids by hand.
 
+Cross-PROCESS causality rides a serializable :class:`TraceContext`
+(``trace_id``/``span_id``/``reconcile_id``) carried in the
+``TPU_TRACEPARENT`` env var: the operator mints one per rollout, stamps it
+into the rendered operand/validator pods (state/render_data.py), and every
+downstream process — validator components, workload pods, flight recorders,
+the agents' push hop — ``Tracer.adopt()``\\ s it, so its spans and samples
+join the originating trace instead of starting disconnected ones.
+
 Completed spans feed the duration Histograms on ``OperatorMetrics`` (keyed
 by span kind) and completed ROOT spans are serialized into a bounded ring
-buffer the Manager serves as JSON at ``/debug/traces``.
+buffer the Manager serves as JSON at ``/debug/traces``
+(``TPU_OPERATOR_MAX_TRACES`` sizes it; traces referenced by live fleet
+exemplars or an unresolved SLO breach are pinned against eviction).
 
 Spans are deliberately synchronous context managers: they only stamp
 timestamps on enter/exit, so wrapping ``await``-ing code is safe — each
@@ -22,13 +32,14 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 import time
 import uuid
 from collections import deque
 from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 # Span kinds — each maps to one Histogram family on OperatorMetrics.
 KIND_RECONCILE = "reconcile"  # reconcile_duration_seconds{controller}
@@ -38,6 +49,10 @@ KIND_APPLY = "apply"          # apply_duration_seconds{kind}
 KIND_PHASE = "phase"          # workload_phase_duration_seconds{phase}
 
 DEFAULT_MAX_TRACES = 64
+MAX_TRACES_ENV = "TPU_OPERATOR_MAX_TRACES"
+# the cross-process trace-context contract (docs/OBSERVABILITY.md "Causal
+# tracing & explain"): <trace_id>-<span_id>[-<reconcile_id>], 12-hex ids
+TRACEPARENT_ENV = "TPU_TRACEPARENT"
 
 _current_tracer: ContextVar[Optional["Tracer"]] = ContextVar(
     "tpu_operator_tracer", default=None
@@ -45,6 +60,8 @@ _current_tracer: ContextVar[Optional["Tracer"]] = ContextVar(
 _current_span: ContextVar[Optional["Span"]] = ContextVar(
     "tpu_operator_span", default=None
 )
+
+_HEX = set("0123456789abcdef")
 
 
 def new_reconcile_id() -> str:
@@ -55,13 +72,61 @@ def new_span_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The serializable cross-process slice of a span: enough for a child
+    process to JOIN the trace (trace id), LINK to its remote parent span,
+    and correlate logs (reconcile id)."""
+
+    trace_id: str
+    span_id: str = ""
+    reconcile_id: str = ""
+
+    def serialize(self) -> str:
+        parts = [self.trace_id, self.span_id or "0"]
+        if self.reconcile_id:
+            parts.append(self.reconcile_id)
+        return "-".join(parts)
+
+    @staticmethod
+    def parse(value: str) -> Optional["TraceContext"]:
+        """None on anything malformed — a corrupt env var must degrade to
+        an untraced process, never crash a workload."""
+        if not isinstance(value, str) or not value:
+            return None
+        parts = value.strip().split("-")
+        if len(parts) not in (2, 3):
+            return None
+        trace_id = parts[0]
+        if not trace_id or len(trace_id) > 32 or set(trace_id) - _HEX:
+            return None
+        span_id = parts[1] if parts[1] != "0" else ""
+        reconcile_id = parts[2] if len(parts) == 3 else ""
+        for part in (span_id, reconcile_id):
+            if part and (len(part) > 32 or set(part) - _HEX):
+                return None
+        return TraceContext(trace_id, span_id, reconcile_id)
+
+    @staticmethod
+    def from_env() -> Optional["TraceContext"]:
+        return TraceContext.parse(os.environ.get(TRACEPARENT_ENV, ""))
+
+
 @dataclass
 class Span:
     name: str
     kind: str = ""
     attrs: dict = field(default_factory=dict)
     reconcile_id: str = ""
+    trace_id: str = ""
     span_id: str = field(default_factory=new_span_id)
+    # remote parent span id (set on root spans opened under an adopted
+    # TraceContext): the cross-process link /debug/traces readers follow
+    remote_parent: str = ""
     parent: Optional["Span"] = field(default=None, repr=False)
     start_ts: float = 0.0  # wall clock, for humans reading /debug/traces
     duration_s: Optional[float] = None
@@ -69,15 +134,21 @@ class Span:
     children: list = field(default_factory=list)
     _t0: float = field(default=0.0, repr=False)
 
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id, self.reconcile_id)
+
     def to_dict(self) -> dict:
         d: dict = {
             "name": self.name,
             "kind": self.kind,
             "reconcile_id": self.reconcile_id,
+            "trace_id": self.trace_id,
             "span_id": self.span_id,
             "start_ts": round(self.start_ts, 6),
             "duration_s": self.duration_s,
         }
+        if self.remote_parent:
+            d["remote_parent"] = self.remote_parent
         attrs = {k: v for k, v in self.attrs.items() if v not in (None, "")}
         if attrs:
             d["attrs"] = attrs
@@ -95,6 +166,18 @@ def current_span() -> Optional[Span]:
 def reconcile_id() -> str:
     sp = _current_span.get()
     return sp.reconcile_id if sp is not None else ""
+
+
+def trace_id() -> str:
+    sp = _current_span.get()
+    return sp.trace_id if sp is not None else ""
+
+
+def current_traceparent() -> str:
+    """The active span's serialized context, ready for a ``TPU_TRACEPARENT``
+    env var / pod annotation; empty when untraced."""
+    sp = _current_span.get()
+    return sp.context().serialize() if sp is not None else ""
 
 
 def log_context() -> dict:
@@ -123,14 +206,41 @@ class Tracer:
     (standalone validator / workload processes).
     """
 
-    def __init__(self, metrics=None, max_traces: int = DEFAULT_MAX_TRACES, fleet=None):
+    def __init__(
+        self,
+        metrics=None,
+        max_traces: Optional[int] = None,
+        fleet=None,
+        pinned: Optional[Callable[[], set]] = None,
+    ):
         self.metrics = metrics
         # optional obs.fleet.FleetAggregator sink: completed reconcile root
         # spans become fleet duration samples carrying exemplar span ids,
         # so an SLO breach jumps straight to /debug/traces?reconcile_id=
         self.fleet = fleet
-        self.traces: deque = deque(maxlen=max_traces)  # newest first
+        if max_traces is None:
+            try:
+                max_traces = max(1, int(os.environ.get(MAX_TRACES_ENV, "")))
+            except ValueError:
+                max_traces = DEFAULT_MAX_TRACES
+        self.max_traces = max_traces
+        # zero-arg callable returning the trace/reconcile ids that must
+        # survive eviction (live fleet exemplars, unresolved SLO breaches);
+        # defaults to the fleet sink's own referenced set when it has one
+        self.pinned = pinned
+        # explicit pins, keyed so a new holder REPLACES its predecessor
+        # (e.g. the clusterpolicy reconciler pins the live rollout trace —
+        # every rendered pod's TPU_TRACEPARENT points at it, so it must
+        # stay resolvable for the rollout's lifetime, and re-pinning on the
+        # next spec change releases the old one)
+        self._pins: dict[str, str] = {}
+        self.traces: deque = deque()  # newest first; evicted by _evict
         self._lock = threading.Lock()
+        # adoption point for cross-process propagation: root spans opened
+        # while set JOIN this remote context instead of minting a trace id
+        self._adopted: ContextVar[Optional[TraceContext]] = ContextVar(
+            "tpu_operator_adopted", default=None
+        )
 
     @contextlib.contextmanager
     def activate(self) -> Iterator["Tracer"]:
@@ -142,6 +252,21 @@ class Tracer:
             yield self
         finally:
             _current_tracer.reset(token)
+
+    @contextlib.contextmanager
+    def adopt(self, ctx: Optional[TraceContext]) -> Iterator["Tracer"]:
+        """Activate this tracer AND join the remote trace context: root
+        spans opened inside inherit ``ctx.trace_id`` (and the reconcile id
+        when the local span doesn't mint one), with ``ctx.span_id`` recorded
+        as their remote parent.  ``None`` degrades to plain activation, so
+        call sites pass ``TraceContext.from_env()`` unconditionally."""
+        token = self._adopted.set(ctx) if ctx is not None else None
+        try:
+            with self.activate():
+                yield self
+        finally:
+            if token is not None:
+                self._adopted.reset(token)
 
     @contextlib.contextmanager
     def reconcile(self, controller: str, key: str = "") -> Iterator[Span]:
@@ -164,12 +289,23 @@ class Tracer:
         **attrs,
     ) -> Iterator[Span]:
         parent = _current_span.get()
+        adopted = self._adopted.get() if parent is None else None
         rid = reconcile_id or (parent.reconcile_id if parent is not None else "")
+        if not rid and adopted is not None:
+            rid = adopted.reconcile_id
+        if parent is not None:
+            tid = parent.trace_id
+        elif adopted is not None:
+            tid = adopted.trace_id
+        else:
+            tid = new_trace_id()
         sp = Span(
             name=name,
             kind=kind,
             attrs=attrs,
             reconcile_id=rid,
+            trace_id=tid,
+            remote_parent=adopted.span_id if adopted is not None else "",
             parent=parent,
             start_ts=time.time(),
             _t0=time.monotonic(),
@@ -191,6 +327,79 @@ class Tracer:
             if parent is None:
                 with self._lock:
                     self.traces.appendleft(sp.to_dict())
+                    self._evict()
+
+    def pin(self, key: str, trace_id: str) -> None:
+        """Pin ``trace_id`` against ring eviction under ``key``; a later
+        pin with the same key replaces it (and an empty id releases it)."""
+        with self._lock:
+            if trace_id:
+                self._pins[key] = trace_id
+            else:
+                self._pins.pop(key, None)
+
+    def _pinned_ids(self) -> set:
+        out = set(self._pins.values())
+        pinned = self.pinned
+        if pinned is None and self.fleet is not None:
+            pinned = getattr(self.fleet, "referenced_trace_ids", None)
+        if pinned is None:
+            return out
+        try:
+            return out | set(pinned())
+        except Exception as e:  # noqa: BLE001 — eviction must never fail a span
+            logging.getLogger("tpu_operator.obs.trace").debug(
+                "pinned-trace lookup failed: %s", e
+            )
+            return out
+
+    def _evict(self) -> None:
+        """Enforce the ring policy (lock held).  UNPINNED traces obey
+        ``max_traces``, oldest dropped first; pinned traces — referenced by
+        a live fleet exemplar, an unresolved SLO breach, or an explicit
+        pin like the live rollout context — don't count against the cap
+        and survive whole (they are being held on behalf of readers whose
+        ids must not dangle).  A pathologically large pinned history is
+        still bounded: past a hard limit of 4× the cap, the oldest traces
+        collapse to tombstones — the id stays joinable, the span tree is
+        honestly marked evicted instead of silently vanishing."""
+        if len(self.traces) <= self.max_traces:
+            return
+        pinned_ids = self._pinned_ids()
+
+        def pinned(trace: dict) -> bool:
+            return bool(pinned_ids) and not trace.get("evicted") and (
+                trace.get("trace_id") in pinned_ids
+                or trace.get("reconcile_id") in pinned_ids
+            )
+
+        overflow = (
+            sum(1 for t in self.traces if not pinned(t)) - self.max_traces
+        )
+        if overflow > 0:
+            kept = []
+            for trace in reversed(self.traces):  # oldest → newest
+                if overflow > 0 and not pinned(trace):
+                    overflow -= 1
+                    continue
+                kept.append(trace)
+            kept.reverse()
+            self.traces = deque(kept)
+        extra = len(self.traces) - self.max_traces * 4
+        idx = len(self.traces) - 1
+        while extra > 0 and idx >= 0:
+            trace = self.traces[idx]
+            if not trace.get("evicted"):
+                self.traces[idx] = {
+                    "name": trace.get("name", ""),
+                    "kind": trace.get("kind", ""),
+                    "trace_id": trace.get("trace_id", ""),
+                    "reconcile_id": trace.get("reconcile_id", ""),
+                    "start_ts": trace.get("start_ts"),
+                    "evicted": True,
+                }
+                extra -= 1
+            idx -= 1
 
     def snapshot(self) -> list[dict]:
         with self._lock:

@@ -29,6 +29,15 @@ class ClusterContext:
     service_monitors_available: bool = False
     tpu_node_count: int = 0
     openshift: bool = False
+    # serialized obs.trace.TraceContext of the reconcile that initiated the
+    # current rollout, minted by the clusterpolicy reconciler ONCE per spec
+    # change (NOT per pass — a per-pass value would defeat the render memo
+    # and rewrite every DaemonSet every reconcile, breaking the zero-write
+    # steady state bench.py pins).  Rendered into operand pod templates as
+    # the TPU_TRACEPARENT env contract + pod annotation, so validator
+    # phases, workload flight records, and the agents' push hop all join
+    # the operator's trace.  Empty (dev/standalone renders) renders nothing.
+    traceparent: str = ""
 
 
 # Default tolerations: GKE TPU node pools carry the google.com/tpu taint,
@@ -84,6 +93,11 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         # states without an operand spec run no pods
         "image_pull_secrets": [],
         "deploy_label_prefix": consts.DEPLOY_LABEL_PREFIX,
+        # cross-process trace propagation (obs/trace.py TraceContext):
+        # macros render it as the TPU_TRACEPARENT env + the traceparent
+        # pod annotation on every operand/validator pod template
+        "traceparent": ctx.traceparent,
+        "traceparent_annotation": consts.TRACEPARENT_ANNOTATION,
         "validation_dir": consts.VALIDATION_DIR,
         "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
         "compile_cache_dir": consts.COMPILE_CACHE_DIR,

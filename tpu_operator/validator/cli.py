@@ -21,7 +21,7 @@ from typing import Optional
 from tpu_operator import consts
 from tpu_operator.obs import events as obs_events
 from tpu_operator.obs import logging as obs_logging
-from tpu_operator.obs.trace import Tracer
+from tpu_operator.obs.trace import TraceContext, Tracer
 from tpu_operator.validator import status
 from tpu_operator.validator.components import ValidationError, Validator, ValidatorConfig
 
@@ -82,10 +82,14 @@ async def run(args: argparse.Namespace) -> int:
         return 0
 
     validator = Validator(config)
-    # ambient tracer: component phases feed span durations even standalone
+    # ambient tracer: component phases feed span durations even standalone.
+    # The operator stamps TPU_TRACEPARENT into the validator DS env — the
+    # adopted context makes these phase spans (and every flight sample
+    # under them) part of the operator's rollout trace instead of an
+    # unlinked local one; absent env degrades to a standalone trace.
     tracer = Tracer()
     try:
-        with tracer.activate():
+        with tracer.adopt(TraceContext.from_env()):
             if args.wait_only:
                 await validator.wait_ready(args.component)
                 log.info("%s-ready present", args.component)

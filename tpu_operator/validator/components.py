@@ -346,6 +346,48 @@ class Validator:
         # feeds workload_phase_duration_seconds{phase} when a tracer is ambient
         with trace.span(f"validate/{component}", kind=trace.KIND_PHASE, phase=component):
             await handler()
+        if component == "jax":
+            # jax-ready just landed: report the join critical-path
+            # segments (status-file timestamps + flight compile samples)
+            # through the agent push hop, tagged with the propagated trace
+            # id — the fleet turns them into join_phase_seconds rollups
+            # and /debug/explain's blocking verdict.  Strictly after the
+            # gate, strictly best-effort.
+            await self._push_join_phases()
+
+    async def _push_join_phases(self) -> None:
+        """One POST of this node's join-phase segments to the metrics
+        agent (TPU_METRICS_PUSH_URL), carrying the adopted trace id so the
+        fleet exemplar joins back to the operator's rollout trace.  Never
+        raises — the join is already proven; this is its breakdown."""
+        if not self.config.node_name or not os.environ.get("TPU_METRICS_PUSH_URL"):
+            return
+        try:
+            created: Optional[float] = None
+            node = await self.client().get("", "Node", self.config.node_name)
+            raw = deep_get(node, "metadata", "creationTimestamp", default="")
+            if raw:
+                from tpu_operator.obs.fleet import _parse_k8s_ts
+
+                created = _parse_k8s_ts(raw)
+            segments = status.join_phase_segments(created)
+            if not segments:
+                return
+            env_ctx = trace.TraceContext.from_env()
+            tid = trace.trace_id() or (env_ctx.trace_id if env_ctx else "")
+            from tpu_operator.obs import flight
+
+            await asyncio.get_event_loop().run_in_executor(
+                None,
+                functools.partial(
+                    flight.push_join_phases,
+                    self.config.node_name,
+                    segments,
+                    trace_id=tid,
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry must never fail a gate
+            log.debug("join-phase push failed: %s", e)
 
     async def wait_ready(self, component: str, retries: Optional[int] = None) -> None:
         """--wait-only: block until another pod's validation wrote the file
@@ -503,7 +545,7 @@ class Validator:
             # executor threads don't inherit the event loop's contextvars)
             recorder = flight.recorder_for(status.flight_record_path())
             local_tracer = trace.Tracer()
-            with local_tracer.activate(), flight.activate(recorder):
+            with local_tracer.adopt(trace.TraceContext.from_env()), flight.activate(recorder):
                 # minimal gate only — matmul/hbm/ring run post-ready via the
                 # perf component, and burn-in gates only where it is a real
                 # multi-chip acceptance test: the same split as the
@@ -715,7 +757,7 @@ class Validator:
                 # inherit the loop's contextvars)
                 recorder = flight.recorder_for(status.flight_record_path("perf"))
                 local_tracer = trace.Tracer()
-                with local_tracer.activate(), flight.activate(recorder):
+                with local_tracer.adopt(trace.TraceContext.from_env()), flight.activate(recorder):
                     for probe_name, fn in probes.items():
                         if budget and time.monotonic() - t_start > budget:
                             out[probe_name] = {
@@ -1388,6 +1430,23 @@ class Validator:
                                     "value": os.environ["TPU_METRICS_PUSH_URL"],
                                 }]
                                 if os.environ.get("TPU_METRICS_PUSH_URL")
+                                else []
+                            ),
+                            # cross-process trace propagation: the spawned
+                            # pod continues the validator's ACTIVE span
+                            # when one is live (its samples link under the
+                            # validate/<component> phase), else relays the
+                            # DS-injected rollout context verbatim
+                            *(
+                                [{
+                                    "name": trace.TRACEPARENT_ENV,
+                                    "value": (
+                                        trace.current_traceparent()
+                                        or os.environ[trace.TRACEPARENT_ENV]
+                                    ),
+                                }]
+                                if trace.current_traceparent()
+                                or os.environ.get(trace.TRACEPARENT_ENV)
                                 else []
                             ),
                             # the probe pod stops STARTING checks past this

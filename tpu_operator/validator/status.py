@@ -187,6 +187,51 @@ def clear_flight_record(scope: str = "") -> None:
         pass
 
 
+def join_phase_segments(node_created_ts: Optional[float] = None) -> dict:
+    """Derive this node's join→validated critical-path segments
+    (obs/fleet.py ``JOIN_PHASES``) from evidence already on disk: the
+    ``ts`` stamps the status files carry and the flight record's compile
+    samples.  The segments telescope — their sum is jax-ready minus node
+    creation — so the fleet's per-phase rollups reconcile against
+    ``join_to_validated_seconds`` instead of being a separate estimate.
+
+    Absent files contribute nothing (a partially-joined node reports the
+    segments it has; ``/debug/explain`` turns the first missing one into
+    the blocking verdict).  Best-effort like all evidence."""
+
+    def ts(component: str) -> Optional[float]:
+        st = read_status(component)
+        value = st.get("ts") if st else None
+        return float(value) if isinstance(value, (int, float)) else None
+
+    libtpu, pjrt, plugin, jax_ready = (
+        ts("libtpu"), ts("pjrt"), ts("plugin"), ts("jax")
+    )
+    phases: dict = {}
+    if libtpu is not None and node_created_ts is not None:
+        phases["runtime-ready"] = max(0.0, libtpu - node_created_ts)
+    if pjrt is not None and libtpu is not None:
+        phases["validator-scheduled"] = max(0.0, pjrt - libtpu)
+    if plugin is not None and pjrt is not None:
+        phases["plugin-advertised"] = max(0.0, plugin - pjrt)
+    if jax_ready is not None and plugin is not None:
+        tail_s = max(0.0, jax_ready - plugin)
+        # compile time from the flight record: per check, the largest
+        # compile_s sample (re-records of the same check must not double
+        # count), summed across checks, clamped into the gate tail
+        compile_s = 0.0
+        per_check: dict = {}
+        for sample in read_flight_record():
+            value = (sample.get("metrics") or {}).get("compile_s")
+            if isinstance(value, (int, float)) and value >= 0:
+                check = sample.get("check", "")
+                per_check[check] = max(per_check.get(check, 0.0), float(value))
+        compile_s = min(tail_s, sum(per_check.values()))
+        phases["compile"] = compile_s
+        phases["collective"] = max(0.0, tail_s - compile_s)
+    return {k: round(v, 6) for k, v in phases.items()}
+
+
 def flight_evidence(scope: str = "", tail: int = 50) -> Optional[dict]:
     """The flight record as ready-payload evidence: record path, sample
     count, the span ids the samples carry (joinable against

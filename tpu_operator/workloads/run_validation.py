@@ -268,9 +268,12 @@ def main() -> int:
 
     scope = os.environ.get("RESULTS_SCOPE", "")
     recorder = flight.recorder_for(vstatus.flight_record_path(scope))
+    # adopt the propagated trace context (TPU_TRACEPARENT, injected by the
+    # validator's pod spec from the operator's rollout trace): the check
+    # spans and flight samples below join that trace end to end
     tracer = trace.Tracer()
     runners = check_runners()
-    with tracer.activate(), flight.activate(recorder):
+    with tracer.adopt(trace.TraceContext.from_env()), flight.activate(recorder):
         for check in checks:
             runner = runners.get(check)
             if runner is None:
