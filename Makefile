@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
 # the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint metric-labels trace-lint unit-test chaos chaos-health fleet-obs
+test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint unit-test chaos chaos-health chaos-migrate fleet-obs
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -36,6 +36,12 @@ except-lint:
 # (docs/OBSERVABILITY.md "Causal tracing & explain")
 trace-lint:
 	$(PYTHON) hack/check_trace_propagation.py
+
+# no bare `open(..., 'w')` on checkpoint/result/status surfaces — every
+# publish must go through tmp+replace so a crash can never leave a torn
+# file a reader would trust (docs/ROBUSTNESS.md "Live migration")
+atomic-lint:
+	$(PYTHON) hack/check_atomic_writes.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal
@@ -101,6 +107,16 @@ chaos:
 # signal source lies (docs/ROBUSTNESS.md "Node health engine")
 chaos-health:
 	$(PYTHON) bench.py --chaos-health --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# live-migration acceptance soak (chip-free; ~2 min): real CPU-backend
+# training jobs on a 100-node fake cluster; a seeded mid-training
+# quarantine must cost a bounded number of steps, not the job — the
+# healthy job checkpoints, reschedules onto a SMALLER slice (4x4 -> 2x4
+# reshard) and resumes; a chaos-slowed checkpoint falls back to evict
+# with drain_evictions_total{reason=timeout}; a chaos-torn snapshot is
+# never restored (docs/ROBUSTNESS.md "Live migration")
+chaos-migrate:
+	$(PYTHON) bench.py --chaos-migrate --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must

@@ -688,6 +688,470 @@ def run_chaos_health_soak(n_nodes: int = 100, seed: int = 1) -> dict:
     return result
 
 
+MIGRATE_SOAK_TIMEOUT = 300.0
+
+
+def _counter_value(metrics, family: str, **labels) -> float:
+    """Sum of a labelled counter family's samples matching ``labels``."""
+    total = 0.0
+    for fam in metrics.registry.collect():
+        if fam.name == family:
+            total += sum(
+                s.value for s in fam.samples
+                if s.name.endswith("_total")
+                and all(s.labels.get(k) == v for k, v in labels.items())
+            )
+    return total
+
+
+def _read_events(path: str) -> list:
+    """Parsed event lines from a training job's TPU_JOB_RESULT_FILE."""
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return []
+    return events
+
+
+async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
+    """The live-migration acceptance soak (`make chaos-migrate`;
+    docs/ROBUSTNESS.md "Live migration").
+
+    A 100-node fake cluster (one 4x4 pool, the rest 2x4) converges under
+    the real manager, then REAL training jobs — subprocesses running
+    ``workloads/checkpoint.py``'s sharded SGD loop on the CPU backend —
+    start on a 4x4 node.  A seeded agent fault quarantines that node
+    mid-training and the health engine's drain must settle every job
+    through the migration phase:
+
+    - the healthy job checkpoints on the migrate annotation (delivered via
+      the fake kubelet's downward-API mirror), is rescheduled onto a 2x4
+      node (its 4x4 slice peers are slice-degraded, so the restore lands
+      on a SMALLER mesh) and resumes within the checkpoint-age step bound
+      — never from step 0;
+    - a job whose checkpoint is chaos-slowed past migration.timeoutSeconds
+      falls back to evict with ``drain_evictions_total{reason=timeout}``
+      and the MigrationTimedOut Event;
+    - a job chaos-killed mid-snapshot leaves a TORN snapshot that is never
+      restored: loading its checkpoint dir must return the last *complete*
+      periodic snapshot, hash-verified.
+    """
+    import subprocess
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.health import HealthReconciler
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get, topology_chips
+    from tpu_operator.workloads import checkpoint as ckpt_api
+
+    workdir = tempfile.mkdtemp(prefix="chaos-migrate-")
+    job_procs: dict[str, subprocess.Popen] = {}
+    signal_files: dict[str, str] = {}
+
+    def _train_executor(pod: dict) -> str:
+        """Fake-kubelet executor: train-job pods run the REAL migratable
+        training loop in a subprocess (device count forced to the pod's
+        topology); everything else auto-succeeds."""
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "train-job":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        topo = env.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={topology_chips(topo)}"
+        )
+        sig = os.path.join(workdir, f"{name}.annotations")
+        signal_files[name] = sig
+        env[consts.MIGRATE_SIGNAL_FILE_ENV] = sig
+        env["TPU_VALIDATION_ROOT"] = os.path.join(workdir, f"vroot-{name}")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.checkpoint"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            return "Failed"
+        job_procs[name] = proc
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return "Failed"
+        return "Succeeded" if proc.returncode == 0 else "Failed"
+
+    chaos = ChaosConfig(seed=seed)  # request faults off: this soak proves
+    # the migration machine; the API storm has its own soak (`make chaos`)
+    # hysteresis/ladder tuned to soak time-scale: a sustained bad verdict
+    # trips in ~2s and reaches quarantine ~1s later; migration gets 8s of
+    # checkpoint patience before the timeout->evict fallback
+    health_spec = {
+        "failureThreshold": 2, "windowSeconds": 4, "cleanSeconds": 3,
+        "escalationBackoffSeconds": 1, "maxUnhealthyPercent": "10%",
+        "flapMaxTrips": 99, "flapWindowSeconds": 60,
+    }
+    migration_timeout = 8
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_train_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim, chaos=chaos) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        recorder = EventRecorder(client, NS)
+        from tpu_operator.controllers.runtime import Manager
+
+        mgr = Manager(
+            client, NS, metrics_port=-1, health_port=-1,
+            recorder=recorder, operator_metrics=metrics,
+        )
+        obs = dict(metrics=metrics, recorder=recorder)
+        ClusterPolicyReconciler(client, NS, **obs).setup(mgr)
+        health = HealthReconciler(client, NS, **obs)
+        health.setup(mgr)
+
+        async def _mirror_annotations() -> None:
+            """The fake kubelet's downward-API volume: pod annotations
+            rewritten into each registered job's signal file."""
+            pod_store = fc.store("", "pods")
+            while True:
+                for (_, name), pod in list(pod_store.objects.items()):
+                    sig = signal_files.get(name)
+                    if not sig:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    text = "".join(
+                        f'{k}="{v}"\n' for k, v in sorted(anns.items())
+                    )
+                    try:
+                        with open(sig) as f:
+                            current = f.read()
+                    except OSError:
+                        current = None
+                    if current != text:
+                        tmp = sig + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(text)
+                        os.replace(tmp, sig)
+                await asyncio.sleep(0.05)
+
+        mirror = asyncio.create_task(_mirror_annotations())
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "health": health_spec,
+                    "remediation": {"enabled": False},
+                    "migration": {"timeoutSeconds": migration_timeout},
+                }).obj)
+                # pool-0 is the 4x4 slice the jobs start on; every other
+                # pool is 2x4 — the only healthy shape left once pool-0
+                # degrades, forcing the reshard-on-restore path
+                for i in range(n_nodes):
+                    s, h = divmod(i, 4)
+                    fc.add_node(
+                        f"tpu-{s}-{h}", topology="4x4" if s == 0 else "2x4",
+                        labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        },
+                    )
+
+                async def _converged() -> bool:
+                    cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > MIGRATE_SOAK_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+
+                # -- launch the three training jobs on the 4x4 node -------
+                def _job(name: str, extra_env: dict) -> dict:
+                    res_file = os.path.join(workdir, f"{name}.jsonl")
+                    env = {
+                        consts.CKPT_DIR_ENV: os.path.join(workdir, f"ckpt-{name}"),
+                        consts.JOB_TOPOLOGY_ENV: "4x4",
+                        "TPU_JOB_RESULT_FILE": res_file,
+                        "TRAIN_STEPS": "1000000",
+                        "TRAIN_STEP_SLEEP_S": "0.05",
+                        "TPU_CKPT_EVERY": "25",
+                        **extra_env,
+                    }
+                    return {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {
+                            "name": name, "namespace": "default",
+                            "labels": {
+                                "app": "train-job",
+                                consts.MIGRATE_HANDLER_LABEL:
+                                    consts.MIGRATION_HANDLER_CHECKPOINT,
+                            },
+                        },
+                        "spec": {
+                            "nodeName": "tpu-0-0",
+                            "restartPolicy": "Never",
+                            "containers": [{
+                                "name": "train",
+                                "image": "train-bench:dev",
+                                "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                                "env": [
+                                    {"name": k, "value": v}
+                                    for k, v in env.items()
+                                ],
+                            }],
+                        },
+                    }
+
+                res = {j: os.path.join(workdir, f"{j}.jsonl")
+                       for j in ("job-happy", "job-slow", "job-torn")}
+                await client.create(_job("job-happy", {}))
+                # seeded chaos faults, drawn while the knob is up so the
+                # engine owns (and counts) the injection schedule
+                fc.chaos.config.slow_checkpoint_s = 60.0
+                slow_fault = fc.chaos.checkpoint_fault()
+                fc.chaos.config.slow_checkpoint_s = 0.0
+                await client.create(_job("job-slow", {
+                    ckpt_api.FAULT_ENV: slow_fault or "slow:60",
+                    "TPU_CKPT_EVERY": "0",  # only the (slowed) final snapshot
+                }))
+                fc.chaos.config.kill_during_checkpoint = True
+                kill_fault = fc.chaos.checkpoint_fault()
+                fc.chaos.config.kill_during_checkpoint = False
+                await client.create(_job("job-torn", {
+                    ckpt_api.FAULT_ENV: kill_fault or "kill",
+                }))
+
+                # -- wait for real training progress (periodic snapshots) --
+                def _max_step(events: list, kinds=("progress", "checkpointed")) -> int:
+                    return max(
+                        (e.get("step", 0) for e in events if e.get("event") in kinds),
+                        default=0,
+                    )
+
+                t1 = time.perf_counter()
+                while True:
+                    happy = _read_events(res["job-happy"])
+                    torn = _read_events(res["job-torn"])
+                    slow = _read_events(res["job-slow"])
+                    if (
+                        _max_step(happy) >= 25 and _max_step(torn) >= 25
+                        and any(e.get("event") == "started" for e in slow)
+                    ):
+                        break
+                    if time.perf_counter() - t1 > 120:
+                        raise TimeoutError(
+                            f"jobs never made pre-migration progress "
+                            f"(happy={_max_step(happy)} torn={_max_step(torn)})"
+                        )
+                    await asyncio.sleep(0.25)
+                result["pre_migration_steps"] = {
+                    "job-happy": _max_step(happy), "job-torn": _max_step(torn),
+                }
+
+                # -- seeded mid-training quarantine on the jobs' node -----
+                fc.set_agent_health("tpu-0-0", "unhealthy", "chip-scrape-failed")
+                t2 = time.perf_counter()
+                restored = None
+                timed_out = failed = False
+                while time.perf_counter() - t2 < 120.0:
+                    happy = _read_events(res["job-happy"])
+                    restored = next(
+                        (e for e in happy if e.get("event") == "restored"), None
+                    )
+                    timed_out = _counter_value(
+                        metrics, "tpu_operator_drain_evictions",
+                        controller="health", reason="timeout",
+                    ) >= 1
+                    failed = _counter_value(
+                        metrics, "tpu_operator_drain_evictions",
+                        controller="health", reason="failed",
+                    ) >= 1
+                    if restored is not None and timed_out and failed:
+                        break
+                    await asyncio.sleep(0.25)
+                result["migrate_settle_s"] = round(time.perf_counter() - t2, 3)
+                result["restored"] = restored
+                result["timeout_eviction"] = timed_out
+                result["failed_eviction"] = failed
+
+                # -- the restored job must keep training on the new mesh --
+                resumed_ok = progressed = False
+                mesh_shrunk = False
+                bound_ok = False
+                if restored is not None:
+                    resumed = int(restored.get("resumed_from_step", 0))
+                    checkpointed = next(
+                        (e.get("step", -1) for e in happy
+                         if e.get("event") == "checkpointed"
+                         and e.get("trigger") == "migrate-signal"), -1,
+                    )
+                    pre_steps = result["pre_migration_steps"]["job-happy"]
+                    resumed_ok = resumed > 0
+                    # checkpoint-age bound: the signal snapshot catches the
+                    # LIVE step (zero loss at checkpoint time), so the
+                    # restore must resume at a step at least as far as the
+                    # last progress ever observed before the quarantine
+                    bound_ok = resumed >= checkpointed >= pre_steps
+                    mesh_shrunk = restored.get("mesh") == [2, 4] and (
+                        restored.get("from_mesh") == [4, 4]
+                    )
+                    t3 = time.perf_counter()
+                    while time.perf_counter() - t3 < 60.0:
+                        happy = _read_events(res["job-happy"])
+                        if _max_step(happy) > resumed:
+                            progressed = True
+                            break
+                        await asyncio.sleep(0.25)
+                result["resumed_from_step"] = (
+                    restored.get("resumed_from_step") if restored else None
+                )
+                result["step_bound_ok"] = bound_ok
+                result["restore_mesh_shrunk"] = mesh_shrunk
+                result["post_restore_progress"] = progressed
+
+                # -- torn snapshot must never be restorable ---------------
+                torn_events = _read_events(res["job-torn"])
+                torn_good = _max_step(torn_events, kinds=("progress",))
+                torn_ckpt = ckpt_api.load_checkpoint(
+                    os.path.join(workdir, "ckpt-job-torn")
+                )
+                result["torn_last_good_step"] = torn_good
+                result["torn_restored_step"] = (
+                    torn_ckpt.step if torn_ckpt else None
+                )
+                result["torn_never_restored"] = (
+                    torn_ckpt is not None and torn_ckpt.step == torn_good
+                )
+                result["torn_fault_injected"] = any(
+                    e.get("event") == "checkpointed"
+                    and e.get("trigger") == "migrate-signal"
+                    for e in torn_events
+                ) is False
+
+                reasons = {
+                    e.get("reason") for e in fc.store("", "events").objects.values()
+                }
+                result["event_reasons"] = sorted(reasons & {
+                    "MigrationRequested", "MigrationCompleted",
+                    "MigrationTimedOut", "MigrationFailed",
+                    "WorkloadEvicted", "NodeQuarantined",
+                })
+        finally:
+            mirror.cancel()
+            try:
+                await mirror
+            except asyncio.CancelledError:
+                pass
+            await client.close()
+            for proc in job_procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+
+        result["migrations"] = {
+            outcome: _counter_value(
+                metrics, "tpu_operator_migrations", outcome=outcome
+            )
+            for outcome in ("requested", "migrated", "timeout", "failed")
+        }
+        result["evictions"] = {
+            reason: _counter_value(
+                metrics, "tpu_operator_drain_evictions",
+                controller="health", reason=reason,
+            )
+            for reason in ("migrated", "timeout", "failed", "no-handler", "forced")
+        }
+        result["faults_injected"] = fc.chaos.report()
+
+        failures = []
+        if result.get("restored") is None:
+            failures.append("the healthy job was never restored after the quarantine")
+        if not result.get("resumed_from_step"):
+            failures.append("restore resumed from step 0 (the job was lost)")
+        if not result.get("step_bound_ok"):
+            failures.append(
+                "checkpoint-age step bound violated: "
+                f"resumed={result.get('resumed_from_step')} "
+                f"pre={result.get('pre_migration_steps')}"
+            )
+        if not result.get("restore_mesh_shrunk"):
+            failures.append(
+                f"restore did not reshard 4x4 -> 2x4: {result.get('restored')}"
+            )
+        if not result.get("post_restore_progress"):
+            failures.append("restored job made no further training progress")
+        if not result.get("timeout_eviction"):
+            failures.append(
+                "slow-checkpoint job never fell back to evict "
+                "(drain_evictions_total{reason=timeout} == 0)"
+            )
+        if not result.get("failed_eviction"):
+            failures.append(
+                "torn-checkpoint job never fell back to evict "
+                "(drain_evictions_total{reason=failed} == 0)"
+            )
+        if not result.get("torn_never_restored"):
+            failures.append(
+                "torn snapshot corrupted the restore chain: last good "
+                f"step {result.get('torn_last_good_step')} vs restored "
+                f"{result.get('torn_restored_step')}"
+            )
+        if result["migrations"].get("migrated", 0) < 1:
+            failures.append("tpu_operator_migrations_total{outcome=migrated} == 0")
+        for reason in ("MigrationRequested", "MigrationCompleted",
+                       "MigrationTimedOut", "WorkloadEvicted", "NodeQuarantined"):
+            if reason not in result["event_reasons"]:
+                failures.append(f"{reason} Event not posted")
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_chaos_migrate_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  chaos-migrate soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_chaos_migrate_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  chaos-migrate FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  chaos-migrate soak: resumed from step {result.get('resumed_from_step')} "
+        f"(mesh 4x4 -> 2x4: {result.get('restore_mesh_shrunk')}), "
+        f"migrations {result.get('migrations')}, "
+        f"evictions {result.get('evictions')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 FLEET_OBS_TIMEOUT = 300.0
 
 
@@ -1710,6 +2174,21 @@ def main() -> None:
             "metric": "fleet_obs_slo_fired_seconds",
             "value": result.get("slo_fired_after_s"),
             "unit": "s",
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --chaos-migrate [--nodes 100] [--seed 1]`: live-migration
+    # acceptance soak (CPU-backend training subprocesses) — `make chaos-migrate`
+    if "--chaos-migrate" in sys.argv:
+        result = run_chaos_migrate_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "chaos_migrate_resumed_from_step",
+            "value": result.get("resumed_from_step"),
+            "unit": "steps",
             "ok": result["ok"],
             "detail": result,
         }))

@@ -27,6 +27,9 @@ PACKAGES = (
     "tpu_operator/controllers",
     "tpu_operator/obs",
     "tpu_operator/agents",
+    # the workloads own the checkpoint/migration evidence chain now — a
+    # silently swallowed error there hides a torn-snapshot taxonomy
+    "tpu_operator/workloads",
 )
 
 BROAD = {"Exception", "BaseException"}

@@ -83,6 +83,9 @@ CONFIGS: list[tuple[str, ClusterContext, dict]] = [
                 "jax": {"env": [{"name": "WITH_WORKLOAD", "value": "true"}]},
             },
             "sandboxWorkloads": {"enabled": True, "defaultWorkload": "container"},
+            # pins the TPU_MIGRATION_TIMEOUT_SECONDS env contract the
+            # validator pods carry (docs/ROBUSTNESS.md "Live migration")
+            "migration": {"enabled": True, "timeoutSeconds": 90},
             "cdi": {"enabled": True, "default": True},
             "vfioManager": {"repository": "gcr.io/acme", "image": "tpu-vfio-manager", "version": "v0.1"},
             "sandboxDevicePlugin": {"repository": "gcr.io/acme", "image": "tpu-sandbox-plugin", "version": "v0.1"},

@@ -1,0 +1,315 @@
+"""Checkpoint layer tests: atomic snapshot publish, torn-manifest/hash
+rejection with fallback, Tenplex-style reshard-on-restore (bitwise), request
+coalescing, the migration signal contract, and the migratable train loop's
+checkpoint→resume round trip on a different mesh shape
+(workloads/checkpoint.py; docs/ROBUSTNESS.md "Live migration")."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.workloads import checkpoint as cp
+
+
+def _np_params():
+    rng = np.random.default_rng(3)
+    return {
+        "w1": rng.standard_normal((16, 32)).astype(np.float32),
+        "w2": rng.standard_normal((32, 16)).astype(np.float32),
+    }
+
+
+def _mesh(dp, mp, offset=0):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[offset:offset + dp * mp]
+    return Mesh(np.array(devices).reshape(dp, mp), ("dp", "mp"))
+
+
+SPECS = {"w1": (None, "mp"), "w2": ("mp", None)}
+
+
+def test_save_load_roundtrip_numpy(tmp_path):
+    d = str(tmp_path)
+    arrays = _np_params()
+    cp.save_checkpoint(d, 7, arrays, mesh_shape=(2, 4), specs=SPECS)
+    ck = cp.load_checkpoint(d)
+    assert ck is not None and ck.step == 7 and ck.mesh_shape == (2, 4)
+    for k, v in arrays.items():
+        assert ck.arrays[k].tobytes() == v.tobytes()
+    assert ck.specs["w1"] == (None, "mp")
+
+
+def test_bf16_roundtrip_bitwise(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path)
+    w = (np.arange(64, dtype=np.float32).reshape(8, 8) / 7.0).astype(jnp.bfloat16)
+    cp.save_checkpoint(d, 1, {"w": w})
+    ck = cp.load_checkpoint(d)
+    assert str(ck.arrays["w"].dtype) == "bfloat16"
+    assert ck.arrays["w"].tobytes() == w.tobytes()
+
+
+def test_reshard_restore_bitwise_on_smaller_mesh(tmp_path):
+    """The acceptance property: a snapshot taken under a (2,4) mesh restores
+    bitwise-identically under (1,4) — the shards carry global index ranges,
+    so the new mesh just cuts the same tensors along different lines."""
+    d = str(tmp_path)
+    mesh24 = _mesh(2, 4)
+    params = {
+        k: cp._place(mesh24, v, SPECS[k]) for k, v in _np_params().items()
+    }
+    host = {k: np.asarray(v) for k, v in params.items()}
+    cp.save_checkpoint(d, 42, params, mesh_shape=(2, 4), specs=SPECS)
+
+    mesh14 = _mesh(1, 4)
+    ck = cp.load_checkpoint(d, mesh=mesh14)
+    assert ck.step == 42
+    for k in params:
+        restored = np.asarray(ck.arrays[k])
+        assert restored.tobytes() == host[k].tobytes(), k
+        # and the restored array is actually sharded on the target mesh
+        assert ck.arrays[k].sharding.mesh.shape["mp"] == 4
+
+
+def test_torn_manifest_rejected_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    arrays = _np_params()
+    cp.save_checkpoint(d, 1, arrays)
+    good = cp.load_checkpoint(d).path
+    cp.save_checkpoint(d, 2, arrays)
+    newest = cp.load_checkpoint(d).path
+    assert newest != good
+    # tear the newest manifest mid-write
+    with open(os.path.join(newest, cp.MANIFEST_NAME), "w") as f:
+        f.write('{"version": 1, "step": 2, "arrays": {"w1": {"sha')
+    ck = cp.load_checkpoint(d)
+    assert ck is not None and ck.step == 1  # the older COMPLETE snapshot
+    # a torn-only directory restores nothing at all
+    with open(os.path.join(good, cp.MANIFEST_NAME), "w") as f:
+        f.write("")
+    assert cp.load_checkpoint(d) is None
+
+
+def test_shard_hash_mismatch_rejected(tmp_path):
+    d = str(tmp_path)
+    arrays = _np_params()
+    cp.save_checkpoint(d, 1, arrays)
+    cp.save_checkpoint(d, 2, arrays)
+    newest = cp.load_checkpoint(d).path
+    shard = next(
+        n for n in sorted(os.listdir(newest)) if n.endswith(".bin")
+    )
+    with open(os.path.join(newest, shard), "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff\xff\xff\xff")
+    ck = cp.load_checkpoint(d)
+    assert ck.step == 1  # bit-rot detected, fallback
+
+
+def test_truncated_shard_rejected(tmp_path):
+    d = str(tmp_path)
+    cp.save_checkpoint(d, 1, _np_params())
+    cp.save_checkpoint(d, 2, _np_params())
+    newest = cp.load_checkpoint(d).path
+    shard = next(n for n in sorted(os.listdir(newest)) if n.endswith(".bin"))
+    path = os.path.join(newest, shard)
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert cp.load_checkpoint(d).step == 1
+
+
+def test_stale_latest_pointer_falls_back_to_scan(tmp_path):
+    d = str(tmp_path)
+    cp.save_checkpoint(d, 3, _np_params())
+    with open(os.path.join(d, cp.LATEST_NAME), "w") as f:
+        f.write("step-99999999")  # crashed writer's dangling pointer
+    assert cp.load_checkpoint(d).step == 3
+
+
+def test_fault_before_manifest_never_publishes(tmp_path):
+    """A crash after the shard files but before the manifest (the chaos
+    kill_during_checkpoint point) must leave the PREVIOUS snapshot
+    authoritative — the torn attempt is debris, not evidence."""
+    d = str(tmp_path)
+    cp.save_checkpoint(d, 1, _np_params())
+
+    def boom():
+        raise RuntimeError("killed mid-snapshot")
+
+    with pytest.raises(RuntimeError):
+        cp.save_checkpoint(d, 2, _np_params(), fault=boom)
+    ck = cp.load_checkpoint(d)
+    assert ck.step == 1
+    # the torn tmp dir is swept by the next successful snapshot's GC
+    cp.save_checkpoint(d, 3, _np_params())
+    assert not any(".tmp-" in n for n in os.listdir(d))
+
+
+def test_gc_keeps_newest(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        cp.save_checkpoint(d, step, _np_params(), keep=2)
+    dirs = cp._snapshot_dirs(d)
+    assert dirs == ["step-00000004", "step-00000003"]
+
+
+def test_concurrent_snapshot_requests_coalesce(tmp_path):
+    """Two threads requesting a snapshot at once produce ONE writer: the
+    loser returns the in-flight/previous path instead of racing a second
+    write into the same step directory."""
+    d = str(tmp_path)
+    writer = cp.Checkpointer(d)
+    arrays = _np_params()
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_fault():
+        started.set()
+        release.wait(timeout=10)
+
+    results = {}
+
+    # drive the coalescing through the Checkpointer: thread A holds the
+    # lock mid-save, thread B's request must not block on a second write
+    def a():
+        with writer._lock:
+            writer._saving = True
+        try:
+            results["a"] = cp.save_checkpoint(d, 5, arrays, fault=slow_fault)
+            with writer._lock:
+                writer._last_step, writer._last_path = 5, results["a"]
+        finally:
+            with writer._lock:
+                writer._saving = False
+
+    ta = threading.Thread(target=a)
+    ta.start()
+    started.wait(timeout=10)
+    # while A is mid-snapshot, B coalesces to the previous path (None here)
+    assert writer.save(5, arrays) is None
+    # ...but a FINAL request must NOT coalesce away: it parks until the
+    # in-flight writer finishes, then writes its own snapshot — exiting 0
+    # on a snapshot that never ran would hand the migration coordinator a
+    # false checkpoint-complete
+    final_done = threading.Event()
+
+    def final():
+        results["final"] = writer.save(6, arrays, final=True)
+        final_done.set()
+
+    tf = threading.Thread(target=final)
+    tf.start()
+    assert not final_done.wait(timeout=0.2)  # blocked behind A
+    release.set()
+    ta.join(timeout=10)
+    tf.join(timeout=10)
+    assert results["final"] is not None and results["final"].endswith(
+        "step-00000006"
+    )
+    # after A published, a re-request of the same step is a no-op
+    assert writer.save(5, arrays) == results["a"]
+    assert sorted(cp._snapshot_dirs(d)) == [
+        "step-00000005", "step-00000006",
+    ]
+
+
+def test_migration_signal_file_formats(tmp_path):
+    sig = tmp_path / "annotations"
+    s = cp.MigrationSignal(str(sig), install_sigterm=False)
+    assert s.requested() is False          # absent file
+    sig.write_text('other.io/key="x"\n')
+    assert s.requested() is False          # unrelated annotations
+    sig.write_text(f'{consts.MIGRATE_ANNOTATION}="requested"\n')
+    assert s.requested() is True           # downward-API quoting
+    sig.write_text(f"{consts.MIGRATE_ANNOTATION}=requested\n")
+    assert s.requested() is True           # plain test-file form
+    sig.write_text(f'{consts.MIGRATE_ANNOTATION}="denied"\n')
+    assert s.requested() is False
+
+    s2 = cp.MigrationSignal("", install_sigterm=False)
+    assert s2.requested() is False
+    s2._on_sigterm(15, None)
+    assert s2.requested() is True          # SIGTERM fallback channel
+
+
+def test_env_fault_parses_slow(monkeypatch):
+    monkeypatch.setenv(cp.FAULT_ENV, "slow:0.01")
+    fault = cp._env_fault()
+    assert fault is not None
+    fault()  # sleeps 10ms, returns
+    monkeypatch.delenv(cp.FAULT_ENV)
+    assert cp._env_fault() is None
+
+
+def test_migratable_training_resumes_on_smaller_mesh(tmp_path):
+    """The full loop: train on a (2,4) mesh with periodic snapshots, then
+    resume on a (1,4) mesh — the restore must land exactly on the last
+    snapshot's step (bounded loss), reshard, and keep training."""
+    d = str(tmp_path)
+    events = []
+    r1 = cp.run_migratable_training(
+        d, "2x4", steps=5, ckpt_every=2,
+        signal_source=cp.MigrationSignal("", install_sigterm=False),
+        progress=events.append,
+    )
+    assert r1["ok"] and r1["step"] == 5 and r1["checkpointed_step"] == 4
+    r2 = cp.run_migratable_training(
+        d, "1x4", steps=9, ckpt_every=3,
+        signal_source=cp.MigrationSignal("", install_sigterm=False),
+        progress=events.append,
+    )
+    assert r2["ok"]
+    assert r2["resumed_from_step"] == 4     # last complete snapshot
+    assert r2["mesh"] == [1, 4]             # reshard onto the smaller mesh
+    assert r2["step"] == 9                  # and training continued
+    restored = next(e for e in events if e.get("event") == "restored")
+    assert restored["from_mesh"] == [2, 4]
+
+
+def test_training_degrades_topology_to_available_devices(tmp_path):
+    """A restore pod created unpinned keeps its OLD slice shape's env; if
+    the scheduler later lands it on fewer chips, the loop trains on the
+    mesh actually present instead of dying with a valid snapshot in hand
+    (the 8-device test env stands in for the shrunk slice)."""
+    d = str(tmp_path)
+    r = cp.run_migratable_training(
+        d, "4x4", steps=3, ckpt_every=0,   # 16 declared, 8 present
+        signal_source=cp.MigrationSignal("", install_sigterm=False),
+    )
+    assert r["ok"] and r["mesh"] == [1, 8] and r["step"] == 3
+
+
+def test_migratable_training_checkpoints_on_signal(tmp_path):
+    d = str(tmp_path)
+    sig_file = tmp_path / "sig"
+    sig = cp.MigrationSignal(str(sig_file), install_sigterm=False)
+    fired = {}
+
+    def progress(e):
+        # inject the drain signal mid-run, exactly as the downward-API
+        # mirror would while the loop is training
+        if e.get("event") == "progress" and "at" not in fired:
+            fired["at"] = e["step"]
+            sig_file.write_text(
+                f'{consts.MIGRATE_ANNOTATION}="requested"\n'
+            )
+
+    r = cp.run_migratable_training(
+        d, "1x4", steps=1000, ckpt_every=2, signal_source=sig,
+        progress=progress,
+    )
+    assert r["migrated_out"] is True
+    assert r["checkpointed_step"] == r["step"]  # zero steps lost
+    ck = cp.load_checkpoint(d)
+    assert ck.step == r["checkpointed_step"]
+    manifest = json.load(open(os.path.join(ck.path, cp.MANIFEST_NAME)))
+    assert manifest["mesh"] == [1, 4]

@@ -1,0 +1,600 @@
+"""Live-migration drain-phase tests: the per-pod annotate→await→reschedule
+machine, timeout/crash fallback to evict, eviction accounting, healthy-slice
+target selection, and the upgrade/remediation/health integrations
+(controllers/migration.py; docs/ROBUSTNESS.md "Live migration")."""
+
+import datetime
+
+from tpu_operator import consts
+from tpu_operator.api.types import MigrationSpec, TPUClusterPolicy
+from tpu_operator.controllers import migration as mig
+from tpu_operator.controllers import health as hm
+from tpu_operator.controllers import remediation as rm
+from tpu_operator.controllers import upgrade as up
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+def _train_pod(fc, name, node_name, handler=True, phase="Running", env=None):
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "labels": (
+                {consts.MIGRATE_HANDLER_LABEL: consts.MIGRATION_HANDLER_CHECKPOINT}
+                if handler else {}
+            ),
+        },
+        "spec": {"nodeName": node_name, "containers": [{
+            "name": "train",
+            "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+            "env": env or [{"name": consts.JOB_TOPOLOGY_ENV, "value": "4x4"}],
+        }]},
+        "status": {"phase": phase},
+    }
+    fc.put(pod)
+    return pod
+
+
+def _node(name, topology="4x4", labels=None, unschedulable=False, tpu_cap=True):
+    node = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+            **(labels or {}),
+        }, "annotations": {}},
+        "spec": {"unschedulable": unschedulable or None},
+        "status": {"allocatable": (
+            {consts.TPU_RESOURCE: "4"} if tpu_cap else {}
+        )},
+    }
+    return node
+
+
+def _counter(metrics, family, **labels):
+    total = 0.0
+    for fam in metrics.registry.collect():
+        if fam.name == family:
+            total += sum(
+                s.value for s in fam.samples
+                if s.name.endswith("_total")
+                and all(s.labels.get(k) == v for k, v in labels.items())
+            )
+    return total
+
+
+def _events(fc):
+    return {e.get("reason") for e in fc.store("", "events").objects.values()}
+
+
+async def _get_pod(client, name):
+    return await client.get("", "Pod", name, "default")
+
+
+def _age_out(fc, name, seconds=3600):
+    """Backdate a pod's migrate-ts so the timeout machine fires now."""
+    pod = fc.store("", "pods").get("default", name)
+    past = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(seconds=seconds)
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    pod["metadata"]["annotations"][consts.MIGRATE_TS_ANNOTATION] = past
+    fc.put(pod)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator machine.
+
+
+async def test_drain_requests_then_migrates_onto_healthy_slice():
+    """Happy path: annotate → (workload checkpoints, exits 0) → replacement
+    created on a healthy node with the topology env rewritten, source pod
+    cleared, migrated outcome counted and Events posted."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            coord = mig.MigrationCoordinator(client, NS, metrics=metrics)
+            nodes = [
+                fc.put(_node("src", "4x4")),
+                fc.put(_node("tgt", "2x4")),
+            ]
+            pod = _train_pod(fc, "job", "src")
+            spec = MigrationSpec(timeout_seconds=60)
+
+            status = await coord.drain_pod(pod, spec, "upgrade", nodes=nodes)
+            assert status == mig.PENDING
+            live = await _get_pod(client, "job")
+            anns = live["metadata"]["annotations"]
+            assert anns[consts.MIGRATE_ANNOTATION] == consts.MIGRATE_REQUESTED
+            assert anns[consts.MIGRATE_TS_ANNOTATION]
+            assert "MigrationRequested" in _events(fc)
+
+            # idempotent while the workload checkpoints
+            assert await coord.drain_pod(live, spec, "upgrade", nodes=nodes) == mig.PENDING
+
+            live["status"]["phase"] = "Succeeded"  # checkpoint complete
+            fc.put(live)
+            live = await _get_pod(client, "job")
+            assert await coord.drain_pod(live, spec, "upgrade", nodes=nodes) == mig.MIGRATED
+
+            repl = await _get_pod(client, "job-mig1")
+            # scheduled via selector, never nodeName: a full target must
+            # leave the restore Pending, not kubelet-rejected terminally
+            assert deep_get(repl, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "tgt"
+            assert "nodeName" not in repl["spec"]
+            env = {e["name"]: e.get("value")
+                   for e in repl["spec"]["containers"][0]["env"]}
+            assert env[consts.JOB_TOPOLOGY_ENV] == "2x4"  # reshard contract
+            ranns = repl["metadata"]["annotations"]
+            assert ranns[consts.MIGRATED_FROM_ANNOTATION] == "src"
+            assert ranns[consts.MIGRATE_GENERATION_ANNOTATION] == "1"
+            assert consts.MIGRATE_ANNOTATION not in ranns
+            pods = {p["metadata"]["name"]
+                    for p in await client.list_items("", "Pod", "default")}
+            assert "job" not in pods  # source husk cleared
+            assert _counter(metrics, "tpu_operator_migrations", outcome="migrated") == 1
+            assert _counter(metrics, "tpu_operator_drain_evictions",
+                            controller="upgrade", reason="migrated") == 1
+            assert "MigrationCompleted" in _events(fc)
+        finally:
+            await client.close()
+
+
+async def test_timeout_falls_back_to_evict():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            coord = mig.MigrationCoordinator(client, NS, metrics=metrics)
+            pod = _train_pod(fc, "job", "src")
+            spec = MigrationSpec(timeout_seconds=5)
+            assert await coord.drain_pod(pod, spec, "health") == mig.PENDING
+            _age_out(fc, "job")
+            live = await _get_pod(client, "job")
+            assert await coord.drain_pod(live, spec, "health") == mig.TIMEOUT
+            pods = await client.list_items("", "Pod", "default")
+            assert pods == []
+            assert _counter(metrics, "tpu_operator_drain_evictions",
+                            controller="health", reason="timeout") == 1
+            assert _counter(metrics, "tpu_operator_migrations", outcome="timeout") == 1
+            assert {"MigrationTimedOut", "WorkloadEvicted"} <= _events(fc)
+        finally:
+            await client.close()
+
+
+async def test_crashed_checkpoint_falls_back_immediately():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            coord = mig.MigrationCoordinator(client, NS, metrics=metrics)
+            pod = _train_pod(fc, "job", "src")
+            spec = MigrationSpec(timeout_seconds=3600)
+            assert await coord.drain_pod(pod, spec, "health") == mig.PENDING
+            live = await _get_pod(client, "job")
+            live["status"]["phase"] = "Failed"  # died mid-snapshot
+            fc.put(live)
+            live = await _get_pod(client, "job")
+            assert await coord.drain_pod(live, spec, "health") == mig.FAILED
+            assert _counter(metrics, "tpu_operator_drain_evictions",
+                            controller="health", reason="failed") == 1
+            assert "MigrationFailed" in _events(fc)
+        finally:
+            await client.close()
+
+
+async def test_no_handler_pod_keeps_historical_evict_with_grace():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            coord = mig.MigrationCoordinator(client, NS, metrics=metrics)
+            pod = _train_pod(fc, "plain", "src", handler=False)
+            status = await coord.drain_pod(
+                pod, MigrationSpec(), "upgrade", grace_period_seconds=7
+            )
+            assert status == mig.NO_HANDLER
+            grace = [g for (plural, _, name, g) in fc.delete_options
+                     if plural == "pods" and name == "plain"]
+            assert grace == ["7"]
+            assert _counter(metrics, "tpu_operator_drain_evictions",
+                            controller="upgrade", reason="no-handler") == 1
+        finally:
+            await client.close()
+
+
+async def test_migration_disabled_keeps_historical_evict():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            coord = mig.MigrationCoordinator(client, NS)
+            pod = _train_pod(fc, "job", "src")  # handler label present
+            status = await coord.drain_pod(
+                pod, MigrationSpec(enabled=False), "upgrade"
+            )
+            assert status == mig.NO_HANDLER
+            assert await client.list_items("", "Pod", "default") == []
+        finally:
+            await client.close()
+
+
+async def test_terminating_and_completed_pods():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            coord = mig.MigrationCoordinator(client, NS)
+            spec = MigrationSpec()
+            term = _train_pod(fc, "term", "src")
+            term["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+            assert await coord.drain_pod(term, spec, "upgrade") == mig.PENDING
+            # a pod that finished on its own has nothing to checkpoint:
+            # cleared without minting a restore pod, counted as `completed`
+            # (NOT no-handler — the eviction counter must never overstate
+            # lost jobs) and without the lost-progress Warning
+            done = _train_pod(fc, "done", "src", phase="Succeeded")
+            assert await coord.drain_pod(done, spec, "upgrade") == mig.COMPLETED
+            names = {p["metadata"]["name"]
+                     for p in await client.list_items("", "Pod", "default")}
+            assert "done" not in names and not any("mig" in n for n in names)
+            assert "WorkloadEvicted" not in _events(fc)
+        finally:
+            await client.close()
+
+
+async def test_pending_pod_relocated_not_evicted():
+    """A migratable pod that never started (e.g. a restore pinned to a node
+    that degraded before it ran) has no process to checkpoint and nothing
+    to lose: the drain relocates it directly instead of burning the
+    timeout and evicting a job whose snapshot is perfectly valid."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            coord = mig.MigrationCoordinator(client, NS, metrics=metrics)
+            nodes = [fc.put(_node("src", "4x4")), fc.put(_node("ok", "2x4"))]
+            pod = _train_pod(fc, "restore", "src", phase="Pending")
+            status = await coord.drain_pod(
+                pod, MigrationSpec(timeout_seconds=5), "health", nodes=nodes
+            )
+            assert status == mig.MIGRATED
+            repl = await _get_pod(client, "restore-mig1")
+            assert deep_get(repl, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "ok"
+        finally:
+            await client.close()
+
+
+async def test_unreadable_migrate_ts_still_times_out():
+    """A migrate=requested pod whose timestamp annotation is missing or
+    garbled must still hit the timeout fallback — an unreadable clock
+    disarms the wedge-guard otherwise (the health drain has no outer
+    timeout)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            coord = mig.MigrationCoordinator(client, NS)
+            for name, ts in (("no-ts", None), ("bad-ts", "not-a-timestamp")):
+                pod = _train_pod(fc, name, "src")
+                anns = {consts.MIGRATE_ANNOTATION: consts.MIGRATE_REQUESTED}
+                if ts is not None:
+                    anns[consts.MIGRATE_TS_ANNOTATION] = ts
+                pod["metadata"]["annotations"] = anns
+                fc.put(pod)
+                live = await _get_pod(client, name)
+                status = await coord.drain_pod(
+                    live, MigrationSpec(timeout_seconds=3600), "health"
+                )
+                assert status == mig.TIMEOUT, name
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# Target selection + replacement construction (pure functions).
+
+
+def test_pick_target_prefers_same_topology_then_largest():
+    nodes = [
+        _node("src", "4x4"),
+        _node("small", "2x4"),
+        _node("same", "4x4"),
+        _node("big", "8x8"),
+    ]
+    assert mig.pick_target(nodes, "src")["metadata"]["name"] == "same"
+    # same shape gone → the largest remaining mesh wins
+    nodes = [n for n in nodes if n["metadata"]["name"] != "same"]
+    assert mig.pick_target(nodes, "src")["metadata"]["name"] == "big"
+
+
+def test_pick_target_skips_unhealthy_capacity():
+    nodes = [
+        _node("src", "4x4"),
+        _node("cordoned", "4x4", unschedulable=True),
+        _node("quarantined", "4x4",
+              labels={consts.HEALTH_STATE_LABEL: consts.HEALTH_QUARANTINED}),
+        _node("degraded", "4x4",
+              labels={consts.HEALTH_STATE_LABEL: consts.HEALTH_SLICE_DEGRADED}),
+        _node("agent-bad", "4x4",
+              labels={consts.TPU_HEALTH_LABEL: consts.HEALTH_UNHEALTHY}),
+        _node("upgrading", "4x4",
+              labels={consts.UPGRADE_STATE_LABEL: up.DRAIN}),
+        _node("no-chips", "4x4", tpu_cap=False),
+        _node("ok", "2x4"),
+    ]
+    assert mig.pick_target(nodes, "src")["metadata"]["name"] == "ok"
+    nodes = [n for n in nodes if n["metadata"]["name"] != "ok"]
+    assert mig.pick_target(nodes, "src") is None
+
+
+def test_build_replacement_unpinned_when_no_target():
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "job", "namespace": "default",
+                     "labels": {"app": "train-job"},
+                     "annotations": {consts.MIGRATE_ANNOTATION: "requested",
+                                     consts.MIGRATE_TS_ANNOTATION: "x"}},
+        "spec": {"nodeName": "src", "containers": [{"name": "c", "env": []}]},
+    }
+    repl = mig.build_replacement(pod, None)
+    assert "nodeName" not in repl["spec"]  # scheduler's call once capacity returns
+    assert repl["metadata"]["labels"] == {"app": "train-job"}
+    assert consts.MIGRATE_ANNOTATION not in repl["metadata"]["annotations"]
+
+
+def test_build_replacement_generation_chain():
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "job-mig1", "namespace": "default",
+                     "annotations": {
+                         consts.MIGRATE_GENERATION_ANNOTATION: "1",
+                         consts.MIGRATE_ANNOTATION: "requested",
+                     }},
+        "spec": {"nodeName": "a", "containers": [{"name": "c"}]},
+    }
+    repl = mig.build_replacement(pod, _node("b", "2x4"))
+    # second hop does not stack suffixes: job-mig1 -> job-mig2
+    assert repl["metadata"]["name"] == "job-mig2"
+    assert deep_get(repl, "spec", "nodeSelector",
+                    "kubernetes.io/hostname") == "b"
+    env = {e["name"]: e["value"]
+           for e in repl["spec"]["containers"][0]["env"]}
+    assert env[consts.JOB_TOPOLOGY_ENV] == "2x4"
+
+
+def test_build_replacement_long_names_never_collide():
+    """63-char truncation must not land two distinct long-named sources on
+    the same replacement name (the 409 adoption would silently drop one
+    job's restore), and the name stays deterministic per source."""
+    def _pod(name):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "annotations": {}},
+            "spec": {"nodeName": "src", "containers": [{"name": "c"}]},
+        }
+
+    long_a = "trainer-" + "x" * 70 + "-0"
+    long_b = "trainer-" + "x" * 70 + "-1"
+    ra = mig.build_replacement(_pod(long_a), None)
+    rb = mig.build_replacement(_pod(long_b), None)
+    assert len(ra["metadata"]["name"]) <= 63
+    assert ra["metadata"]["name"] != rb["metadata"]["name"]
+    # deterministic: the create-409 replay-adoption depends on it
+    assert ra["metadata"]["name"] == \
+        mig.build_replacement(_pod(long_a), None)["metadata"]["name"]
+
+
+# ---------------------------------------------------------------------------
+# Drain-path integrations.
+
+
+async def test_upgrade_drain_waits_on_migration():
+    """The upgrade drain step holds the node in DRAIN while a migratable
+    pod checkpoints, then completes once it is rescheduled."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            await client.create(TPUClusterPolicy.new().obj)
+            r = up.UpgradeReconciler(client, NS)
+            node = fc.add_node("tpu-0", topology="4x4")
+            tgt = fc.add_node("tpu-1", topology="2x4")
+            tgt["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(tgt)
+            _train_pod(fc, "job", "tpu-0")
+            pol = TPUClusterPolicy.new(spec={"libtpu": {"upgradePolicy": {
+                "drain": {"enable": True, "timeoutSeconds": 600}}}}
+            ).spec.libtpu.upgrade_policy
+            mspec = MigrationSpec(timeout_seconds=600)
+            nodes = await client.list_items("", "Node")
+
+            assert await r._drain_step(node, pol, mspec, nodes) is False
+            live = await _get_pod(client, "job")
+            assert live["metadata"]["annotations"][consts.MIGRATE_ANNOTATION]
+            live["status"]["phase"] = "Succeeded"
+            fc.put(live)
+            # the reschedule pass still reports draining (a deleted pod
+            # runs out its grace holding the chips); the NEXT pass finds
+            # the node empty and concludes drained
+            assert await r._drain_step(node, pol, mspec, nodes) is False
+            assert await r._drain_step(node, pol, mspec, nodes) is True
+            repl = await _get_pod(client, "job-mig1")
+            assert deep_get(repl, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "tpu-1"
+        finally:
+            await client.close()
+
+
+async def test_remediation_admission_waits_for_workload_drain():
+    """A validate request on a node running a migratable training pod must
+    not race the re-validation onto occupied chips: admission defers until
+    the migration settles, then proceeds."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            await client.create(TPUClusterPolicy.new(spec={
+                "migration": {"timeoutSeconds": 600},
+            }).obj)
+            for name, topo in (("tpu-0", "4x4"), ("tpu-1", "2x4")):
+                n = fc.add_node(name, topology=topo)
+                n["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+                fc.put(n)
+            _train_pod(fc, "job", "tpu-0")
+            node = fc.store("", "nodes").get(None, "tpu-0")
+            node["metadata"]["labels"][consts.VALIDATE_REQUEST_LABEL] = "requested"
+            fc.put(node)
+
+            r = rm.RemediationReconciler(client, NS)
+            await r.reconcile("remediation")
+            node = await client.get("", "Node", "tpu-0")
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert labels.get(consts.REMEDIATION_STATE_LABEL) is None  # deferred
+            live = await _get_pod(client, "job")
+            assert live["metadata"]["annotations"][consts.MIGRATE_ANNOTATION]
+
+            live["status"]["phase"] = "Succeeded"
+            fc.put(live)
+            await r.reconcile("remediation")  # migration completes...
+            await r.reconcile("remediation")  # ...then admission lands
+            node = await client.get("", "Node", "tpu-0")
+            labels = deep_get(node, "metadata", "labels", default={})
+            assert labels.get(consts.REMEDIATION_STATE_LABEL) == rm.REVALIDATING
+            repl = await _get_pod(client, "job-mig1")
+            assert deep_get(repl, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "tpu-1"
+        finally:
+            await client.close()
+
+
+async def test_health_quarantine_ignores_non_handler_pods(validation_root):
+    """Even with migration ENABLED, the quarantine drain acts only on pods
+    that opted in: a plain workload pod is never deleted by the health
+    engine (its historical hands-off behavior, preserved under the
+    default-on feature)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            await client.create(TPUClusterPolicy.new(spec={
+                "health": {
+                    "failureThreshold": 2, "windowSeconds": 10,
+                    "cleanSeconds": 5, "escalationBackoffSeconds": 0,
+                    "maxUnhealthyPercent": "100%",
+                    "flapMaxTrips": 99, "flapWindowSeconds": 60,
+                },
+                "remediation": {"enabled": False},
+            }).obj)
+            n = fc.add_node("tpu-0", topology="4x4")
+            n["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(n)
+            _train_pod(fc, "plain", "tpu-0", handler=False)
+            r = hm.HealthReconciler(client, NS)
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            for _ in range(3):
+                await r.reconcile("health")
+            node = await client.get("", "Node", "tpu-0")
+            assert deep_get(node, "spec", "unschedulable")  # quarantined
+            live = await _get_pod(client, "plain")          # pod untouched
+            assert consts.MIGRATE_ANNOTATION not in (
+                live["metadata"].get("annotations") or {}
+            )
+        finally:
+            await client.close()
+
+
+async def test_health_quarantine_hands_off_when_migration_disabled(validation_root):
+    """migration.enabled=false restores the pre-migration health engine
+    exactly: quarantine cordons and taints but never deletes a workload
+    pod (the opt-out must not introduce uncheckpointed job loss)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            await client.create(TPUClusterPolicy.new(spec={
+                "health": {
+                    "failureThreshold": 2, "windowSeconds": 10,
+                    "cleanSeconds": 5, "escalationBackoffSeconds": 0,
+                    "maxUnhealthyPercent": "100%",
+                    "flapMaxTrips": 99, "flapWindowSeconds": 60,
+                },
+                "remediation": {"enabled": False},
+                "migration": {"enabled": False},
+            }).obj)
+            n = fc.add_node("tpu-0", topology="4x4")
+            n["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+            fc.put(n)
+            _train_pod(fc, "job", "tpu-0")
+            r = hm.HealthReconciler(client, NS)
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            for _ in range(3):
+                await r.reconcile("health")
+            node = await client.get("", "Node", "tpu-0")
+            assert deep_get(node, "spec", "unschedulable")  # quarantined
+            live = await _get_pod(client, "job")            # pod untouched
+            assert consts.MIGRATE_ANNOTATION not in (
+                live["metadata"].get("annotations") or {}
+            )
+        finally:
+            await client.close()
+
+
+async def test_health_quarantine_drains_workloads_through_migration(validation_root):
+    """The quarantine rung settles the node's training pods through the
+    migration machine instead of stranding them on the dead node."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            metrics = OperatorMetrics()
+            await client.create(TPUClusterPolicy.new(spec={
+                "health": {
+                    "failureThreshold": 2, "windowSeconds": 10,
+                    "cleanSeconds": 5, "escalationBackoffSeconds": 0,
+                    "maxUnhealthyPercent": "100%",
+                    "flapMaxTrips": 99, "flapWindowSeconds": 60,
+                },
+                "remediation": {"enabled": False},
+                "migration": {"timeoutSeconds": 600},
+            }).obj)
+            for name, topo in (("tpu-0", "4x4"), ("tpu-1", "2x4")):
+                n = fc.add_node(name, topology=topo)
+                n["status"]["allocatable"][consts.TPU_RESOURCE] = "4"
+                fc.put(n)
+            _train_pod(fc, "job", "tpu-0")
+
+            r = hm.HealthReconciler(client, NS, metrics=metrics)
+            # two discrete unhealthy episodes trip tpu-0
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "ok")
+            await r.reconcile("health")
+            fc.set_agent_health("tpu-0", "unhealthy", "x")
+            await r.reconcile("health")       # trip → restart-runtime rung
+            await r.reconcile("health")       # → quarantine + drain begins
+            live = await _get_pod(client, "job")
+            assert live["metadata"]["annotations"][consts.MIGRATE_ANNOTATION]
+
+            live["status"]["phase"] = "Succeeded"
+            fc.put(live)
+            await r.reconcile("health")       # reschedule
+            repl = await _get_pod(client, "job-mig1")
+            assert deep_get(repl, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "tpu-1"
+            env = {e["name"]: e.get("value")
+                   for e in repl["spec"]["containers"][0]["env"]}
+            assert env[consts.JOB_TOPOLOGY_ENV] == "2x4"
+            assert _counter(metrics, "tpu_operator_drain_evictions",
+                            controller="health", reason="migrated") == 1
+        finally:
+            await client.close()

@@ -542,6 +542,35 @@ class ObservabilitySpec(SpecBase):
 
 
 @dataclass
+class MigrationSpec(SpecBase):
+    """Live workload migration: checkpoint–reshard–restore instead of evict
+    (controllers/migration.py; docs/ROBUSTNESS.md "Live migration").
+
+    When enabled, every drain path the operator owns (upgrade cordon→drain,
+    remediation admission, health-engine quarantine) gives workload pods
+    carrying the ``tpu.google.com/migration-handler: checkpoint`` label a
+    chance to snapshot before losing the node: the pod is annotated
+    ``tpu.google.com/migrate=requested``, the workload checkpoints (atomic
+    sharded dump, workloads/checkpoint.py) and exits 0, and the coordinator
+    reschedules a restore pod onto a healthy slice chosen via the existing
+    slice labels — resharding Tenplex-style when the target slice shape is
+    smaller.  ``timeoutSeconds`` bounds the wait; past it (or on a crashed
+    checkpoint) the drain falls back to the historical evict, so migration
+    can delay a drain but never wedge it.  Strictly opt-in per pod: the
+    health/remediation drains act only on handler-labelled pods (they
+    never deleted workload pods historically, and enabling this feature
+    must not change that for jobs that did not ask); the upgrade drain
+    keeps its historical evict for unlabelled pods, now counted."""
+
+    enabled: bool = True
+    # how long a drain waits for an annotated workload to reach Succeeded
+    # (checkpoint complete) before falling back to evict; 0 = no patience
+    # (annotate, then evict on the next pass — effectively advisory)
+    timeout_seconds: int = field(default=120, metadata={"minimum": 0})
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
 class HealthSpec(SpecBase):
     """Autonomous node health engine (controllers/health.py;
     docs/ROBUSTNESS.md "Node health engine").
@@ -616,6 +645,7 @@ class TPUClusterPolicySpec(SpecBase):
     )
     remediation: RemediationSpec = field(default_factory=RemediationSpec)
     health: HealthSpec = field(default_factory=HealthSpec)
+    migration: MigrationSpec = field(default_factory=MigrationSpec)
     observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
     extra_fields: dict = field(default_factory=dict)
 

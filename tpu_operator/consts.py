@@ -141,6 +141,37 @@ HEALTH_TAINT_KEY = TPU_HEALTH_LABEL
 # workload owns its own lifecycle — checkpoint-on-SIGTERM jobs etc.)
 SKIP_DRAIN_LABEL = "tpu.google.com/skip-drain"
 
+# Live workload migration (controllers/migration.py + workloads/checkpoint.py;
+# docs/ROBUSTNESS.md "Live migration").  A workload pod opts into
+# checkpoint–reshard–restore by carrying the handler label; every drain path
+# (upgrade, remediation, health quarantine) then annotates the pod
+# ``migrate=requested`` instead of deleting it, waits for the workload to
+# snapshot and exit 0 (bounded by migration.timeoutSeconds), and reschedules
+# a restore pod onto a healthy slice.  Pods without the handler label keep
+# the historical evict behavior.
+MIGRATE_HANDLER_LABEL = "tpu.google.com/migration-handler"   # value: checkpoint
+MIGRATION_HANDLER_CHECKPOINT = "checkpoint"
+MIGRATE_ANNOTATION = "tpu.google.com/migrate"                # value: requested
+MIGRATE_REQUESTED = "requested"
+# when the drain stamped the migrate request (drives migration.timeoutSeconds)
+MIGRATE_TS_ANNOTATION = "tpu.google.com/migrate-ts"
+# restore-pod bookkeeping: which node the job was checkpointed away from,
+# and the migration hop count (suffixes the replacement pod's name)
+MIGRATED_FROM_ANNOTATION = "tpu.google.com/migrated-from"
+MIGRATE_GENERATION_ANNOTATION = "tpu.google.com/migrate-generation"
+# workload-side env contract (workloads/checkpoint.py): the downward-API
+# annotations file the job polls for the migrate request (SIGTERM is the
+# fallback signal), the shared checkpoint directory, and the (dp x mp)
+# topology the job should mesh over — rewritten by the migration
+# coordinator when the restore lands on a different slice shape
+MIGRATE_SIGNAL_FILE_ENV = "TPU_MIGRATE_SIGNAL_FILE"
+CKPT_DIR_ENV = "TPU_CKPT_DIR"
+JOB_TOPOLOGY_ENV = "TPU_JOB_TOPOLOGY"
+# rendered into validator/operand pod env so checkpoint-on-drain workloads
+# know the operator's patience window (snapshot work past it is wasted —
+# the drain falls back to evict)
+MIGRATION_TIMEOUT_ENV = "TPU_MIGRATION_TIMEOUT_SECONDS"
+
 # Cross-process causal tracing (obs/trace.py; docs/OBSERVABILITY.md
 # "Causal tracing & explain").  The operator mints a trace context per
 # rollout and stamps it into rendered operand pod templates — as the

@@ -65,7 +65,7 @@ from tpu_operator.api.types import (
     HealthSpec,
     TPUClusterPolicy,
 )
-from tpu_operator.controllers import clusterinfo, nodestate
+from tpu_operator.controllers import clusterinfo, migration as mig, nodestate
 from tpu_operator.controllers.remediation import (
     REQUESTED as REMEDIATION_REQUESTED,
     REVALIDATING as REMEDIATION_REVALIDATING,
@@ -178,6 +178,14 @@ class HealthReconciler:
         # own patches — read-your-writes, never a re-fired actuation off a
         # lagging watch
         self.reader = CachedReader(client, self.metrics)
+        # quarantine's workload drain: checkpoint→reschedule→restore
+        # instead of stranding the training job on a dead node
+        # (controllers/migration.py); routed through the reader so the
+        # pod writes stay read-your-writes coherent with cached passes
+        self.migration = mig.MigrationCoordinator(
+            self.reader, namespace, metrics=self.metrics,
+            recorder=self.recorder,
+        )
         self._tracks: dict[str, _Track] = {}
         self._observe_only = False
 
@@ -293,7 +301,8 @@ class HealthReconciler:
                 continue
             try:
                 await self._actuate(
-                    node, track, spec, remediation_on, on_ladder, budget
+                    node, track, spec, remediation_on, on_ladder, budget,
+                    policy.spec.migration, nodes,
                 )
             except ApiError as e:
                 # per-node isolation: one node's apiserver hiccup must not
@@ -479,6 +488,7 @@ class HealthReconciler:
     async def _actuate(
         self, node: dict, track: _Track, spec: HealthSpec,
         remediation_on: bool, on_ladder: set, budget: int,
+        migration_spec=None, nodes: Optional[list] = None,
     ) -> None:
         name = node["metadata"]["name"]
         step = self._escalation(node)
@@ -517,6 +527,7 @@ class HealthReconciler:
             # exactly the oscillation the engine exists to prevent
             if self._flapping(track, spec):
                 await self._enter_quarantine(node, flapping=True)
+                await self._drain_workloads(node, migration_spec, nodes)
             elif remediation_on:
                 await self._enter_remediate(name)
             else:
@@ -531,7 +542,49 @@ class HealthReconciler:
         elif step == STEP_RESTART_RUNTIME:
             if self._escalation_age(node) >= spec.escalation_backoff_seconds:
                 await self._enter_quarantine(node)
-        # STEP_QUARANTINE is terminal while tripped; release handles exit
+                await self._drain_workloads(node, migration_spec, nodes)
+        elif step == STEP_QUARANTINE:
+            # terminal while tripped (release handles exit), but the node's
+            # training jobs must not rot with it: each pass advances their
+            # checkpoint→reschedule→restore machines until the node is empty
+            await self._drain_workloads(node, migration_spec, nodes)
+
+    async def _drain_workloads(
+        self, node: dict, migration_spec, nodes: Optional[list]
+    ) -> None:
+        """Settle the quarantined node's TPU workload pods through the
+        migration phase.  Disabled migration keeps the historical behavior
+        — the health engine never deleted workload pods before this
+        subsystem existed, and the opt-out flag must restore exactly that,
+        not introduce uncheckpointed job loss on quarantine.  The
+        all-namespace pod list happens ONLY while a node sits on the
+        quarantine rung — the healthy steady state stays API-free
+        (docs/PERFORMANCE.md discipline)."""
+        if migration_spec is None or not migration_spec.enabled:
+            return
+        name = node["metadata"]["name"]
+        pods = await self.reader.list_items(
+            "", "Pod", field_selector=f"spec.nodeName={name}"
+        )
+        # OPTED-IN pods only: the health engine never deleted workload
+        # pods before this subsystem, and a default-on migration feature
+        # must not start evicting jobs that never asked for it — pods
+        # without the handler label stay untouched, exactly as before
+        for pod in mig.workload_pods(pods, name):
+            if not mig.is_migratable(pod):
+                continue
+            try:
+                await self.migration.drain_pod(
+                    pod, migration_spec, "health", nodes=nodes or []
+                )
+            except ApiError as e:
+                # per-pod isolation: one pod's apiserver hiccup must not
+                # strand its siblings' migrations this pass
+                log.error(
+                    "health migration step on %s/%s failed: %s",
+                    self.migration.namespace_of(pod),
+                    pod["metadata"]["name"], e,
+                )
 
     async def _remediation_busy(self, node: dict) -> bool:
         labels = deep_get(node, "metadata", "labels", default={}) or {}

@@ -1,0 +1,469 @@
+"""Live workload migration: the checkpoint→reschedule→restore drain phase.
+
+Every drain path the operator owns — the upgrade machine's cordon→drain,
+remediation's chip-freeing admission, and the health engine's quarantine
+rung — used to end in ``client.delete`` on the training pod: the job's
+progress died with the node.  This module turns that delete into a
+migration (CRIUgpu's thesis: transparent checkpoint/restore is the
+production answer to *planned* disruption), shared by all three
+controllers so the signal contract, timeout ladder, accounting, and target
+selection cannot drift apart:
+
+1. **annotate** — the pod gets ``tpu.google.com/migrate=requested`` (plus a
+   timestamp).  The workload sees it through its downward-API annotations
+   mount (``TPU_MIGRATE_SIGNAL_FILE``; SIGTERM on eviction is the
+   fallback), snapshots its training state atomically
+   (workloads/checkpoint.py) and exits 0.
+2. **await checkpoint-complete** — pod phase ``Succeeded`` IS the
+   completion status: the workload only exits 0 after its snapshot
+   published.  The wait is bounded by ``migration.timeoutSeconds``; past it
+   the drain falls back to the historical evict (reason ``timeout``), and a
+   pod that *crashed* mid-checkpoint falls back immediately (``failed``) —
+   migration may delay a drain, never wedge it.
+3. **reschedule** — a restore pod (same spec, fresh name) is created on a
+   healthy slice chosen via the existing slice labels, skipping cordoned /
+   quarantined / upgrading / agent-unhealthy nodes.  When the healthiest
+   target carries a *different* ICI topology (a quarantine-shrunk fleet),
+   the coordinator rewrites the pod's ``TPU_JOB_TOPOLOGY`` env so the
+   workload reshards its checkpoint Tenplex-style onto the smaller mesh.
+
+Only pods that opt in (``tpu.google.com/migration-handler: checkpoint``)
+ride this ladder.  Pods that did not opt in keep exactly their historical
+treatment per path: the upgrade drain's evict (now counted per pod), and
+the health/remediation paths' hands-off (those controllers never deleted
+workload pods before this subsystem, and a default-on feature must not
+start).  Every workload-pod deletion on a drain path lands in
+``tpu_operator_drain_evictions_total{controller,reason}`` with a per-pod
+Event, so migrated-vs-lost outcomes are measurable fleet-wide.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import logging
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import MigrationSpec
+from tpu_operator.controllers import nodestate
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.utils import deep_get, topology_chips
+
+log = logging.getLogger("tpu_operator.migration")
+
+# drain_pod return statuses: the pod still holds the node only on PENDING
+PENDING = "pending"
+MIGRATED = "migrated"
+TIMEOUT = "timeout"
+FAILED = "failed"
+FORCED = "forced"
+NO_HANDLER = "no-handler"
+# the pod finished on its own before any migrate request — cleanup, not a
+# loss (distinct from no-handler so the eviction counter never overstates
+# lost jobs)
+COMPLETED = "completed"
+
+
+def is_migratable(pod: dict) -> bool:
+    labels = deep_get(pod, "metadata", "labels", default={}) or {}
+    return (
+        labels.get(consts.MIGRATE_HANDLER_LABEL)
+        == consts.MIGRATION_HANDLER_CHECKPOINT
+    )
+
+
+def workload_pods(pods: list[dict], node_name: str) -> list[dict]:
+    """The TPU workload pods a drain of ``node_name`` must settle: requests
+    chips, not DaemonSet-owned (operands drain via the runtime swap), not
+    opted out via the skip-drain label."""
+    from tpu_operator.agents.runtime_manager import pod_requests_tpu
+
+    out = []
+    for pod in pods:
+        if deep_get(pod, "spec", "nodeName") != node_name:
+            continue
+        if not pod_requests_tpu(pod):
+            continue
+        meta = pod["metadata"]
+        if (meta.get("labels") or {}).get(consts.SKIP_DRAIN_LABEL) == "true":
+            continue
+        refs = meta.get("ownerReferences") or []
+        if any(r.get("kind") == "DaemonSet" for r in refs):
+            continue
+        out.append(pod)
+    return out
+
+
+class MigrationCoordinator:
+    """The shared drain phase.  Stateless between passes: the per-pod
+    machine lives on the pod itself (migrate annotation + timestamp), so a
+    restarted operator resumes every in-flight migration where it stood."""
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        # ``client`` may be a raw ApiClient or a CachedReader — the health
+        # engine passes its reader so migration writes stay read-your-writes
+        # coherent with its cache-served passes
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+        self.recorder = recorder or EventRecorder(
+            getattr(client, "client", client), namespace
+        )
+
+    # ------------------------------------------------------------------
+    async def drain_pod(
+        self,
+        pod: dict,
+        spec: MigrationSpec,
+        controller: str,
+        nodes: Optional[list[dict]] = None,
+        force: bool = False,
+        grace_period_seconds: Optional[int] = None,
+    ) -> str:
+        """One non-blocking step of the migrate-instead-of-evict machine.
+
+        Returns :data:`PENDING` while the migration is in flight (the
+        caller's drain revisits next pass) or the terminal outcome of the
+        step taken THIS pass.  A terminal outcome means the evict/cleanup
+        was *issued*, not that the node is already empty: a gracefully
+        terminating pod still holds its chips, so callers must treat any
+        pod they processed as still draining and only conclude "drained"
+        from a pass that finds no workload pods left (the
+        deletionTimestamp guard below keeps later passes PENDING until the
+        pod object is gone).  ``nodes`` is the caller's already-listed
+        node set (target selection must not cost extra API reads per pod);
+        ``force`` records the drain's force semantics in the eviction
+        reason; ``grace_period_seconds`` is passed through to the fallback
+        evict exactly as the historical delete did."""
+        meta = pod["metadata"]
+        anns = meta.get("annotations") or {}
+        if meta.get("deletionTimestamp"):
+            return PENDING  # already terminating; let it finish
+
+        if not spec.enabled or not is_migratable(pod):
+            reason = FORCED if force else NO_HANDLER
+            await self.evict(pod, controller, reason, grace_period_seconds)
+            return reason
+
+        phase = deep_get(pod, "status", "phase")
+        if phase in (None, "Pending"):
+            # never started: no process can observe the migrate signal and
+            # no progress exists to checkpoint — relocate the pod directly
+            # (a restore pod pinned to a node that degraded before it
+            # started must not be timeout-evicted with a valid snapshot
+            # in hand)
+            await self._reschedule(pod, nodes or [], controller)
+            return MIGRATED
+        if not anns.get(consts.MIGRATE_ANNOTATION):
+            if phase in ("Succeeded", "Failed"):
+                # finished on its own before any migrate request — nothing
+                # to checkpoint, nothing to reschedule, nothing LOST: clear
+                # the husk without the lost-progress warning
+                await self.evict(
+                    pod, controller, COMPLETED, grace_period_seconds,
+                    warn=False,
+                )
+                return COMPLETED
+            await self._request(pod, controller)
+            return PENDING
+        if phase == "Succeeded":
+            await self._reschedule(pod, nodes or [], controller)
+            return MIGRATED
+        if phase == "Failed":
+            # crashed mid-checkpoint: the snapshot layer guarantees the torn
+            # attempt is not observable, but this pod can no longer complete
+            # — fall back to evict now rather than burning the timeout
+            self.metrics.migrations_total.labels(outcome=FAILED).inc()
+            await self.recorder.warning(
+                obs_events.pod_ref(meta["name"], self.namespace_of(pod)),
+                obs_events.REASON_MIGRATION_FAILED,
+                f"workload {meta['name']} crashed before completing its "
+                "checkpoint; falling back to evict (the last complete "
+                "snapshot remains restorable)",
+            )
+            await self.evict(pod, controller, FAILED, grace_period_seconds)
+            return FAILED
+
+        # explicit parse, NOT nodestate.state_age: that helper reads an
+        # absent/garbled timestamp as age 0.0 (safe for node machines with
+        # outer timeouts), which here would make the timeout unreachable
+        # and wedge the quarantine drain forever — an unreadable clock on
+        # a migrate-requested pod must fire the fallback, not disarm it
+        ts = anns.get(consts.MIGRATE_TS_ANNOTATION, "")
+        entered = nodestate.parse_ts(ts) if ts else None
+        if entered is None:
+            age = float("inf")
+        else:
+            age = (
+                datetime.datetime.now(datetime.timezone.utc) - entered
+            ).total_seconds()
+        if age > float(spec.timeout_seconds):
+            self.metrics.migrations_total.labels(outcome=TIMEOUT).inc()
+            await self.recorder.warning(
+                obs_events.pod_ref(meta["name"], self.namespace_of(pod)),
+                obs_events.REASON_MIGRATION_TIMEOUT,
+                f"workload {meta['name']} did not complete its checkpoint "
+                f"within migration.timeoutSeconds={spec.timeout_seconds}; "
+                "falling back to evict",
+            )
+            await self.evict(pod, controller, TIMEOUT, grace_period_seconds)
+            return TIMEOUT
+        return PENDING
+
+    @staticmethod
+    def namespace_of(pod: dict) -> str:
+        return deep_get(pod, "metadata", "namespace", default="default") or "default"
+
+    # ------------------------------------------------------------------
+    async def _request(self, pod: dict, controller: str) -> None:
+        meta = pod["metadata"]
+        await self.client.patch(
+            "", "Pod", meta["name"],
+            {"metadata": {"annotations": {
+                consts.MIGRATE_ANNOTATION: consts.MIGRATE_REQUESTED,
+                consts.MIGRATE_TS_ANNOTATION: nodestate.now_ts(),
+            }}},
+            namespace=self.namespace_of(pod),
+        )
+        self.metrics.migrations_total.labels(outcome="requested").inc()
+        await self.recorder.normal(
+            obs_events.pod_ref(meta["name"], self.namespace_of(pod)),
+            obs_events.REASON_MIGRATION_REQUESTED,
+            f"{controller} drain requested live migration of {meta['name']} "
+            "(checkpoint, then reschedule)",
+        )
+        log.info("migration requested on %s/%s (%s drain)",
+                 self.namespace_of(pod), meta["name"], controller)
+
+    async def evict(
+        self,
+        pod: dict,
+        controller: str,
+        reason: str,
+        grace_period_seconds: Optional[int] = None,
+        warn: bool = True,
+    ) -> None:
+        """Delete a workload pod on a drain path with the shared accounting:
+        `drain_evictions_total{controller,reason}` plus (when ``warn``) the
+        per-pod lost-progress Event.  Public — the upgrade drain routes its
+        historical non-migratable evicts through here so every drain-path
+        deletion is counted the same way."""
+        meta = pod["metadata"]
+        ns = self.namespace_of(pod)
+        await self.client.delete(
+            "", "Pod", meta["name"], ns,
+            grace_period_seconds=grace_period_seconds,
+        )
+        self.metrics.drain_evictions_total.labels(
+            controller=controller, reason=reason
+        ).inc()
+        if warn and reason != MIGRATED:
+            await self.recorder.warning(
+                obs_events.pod_ref(meta["name"], ns),
+                obs_events.REASON_WORKLOAD_EVICTED,
+                f"{controller} drain evicted {meta['name']} ({reason}); "
+                "job progress since its last checkpoint is lost",
+            )
+        log.warning("evicted workload pod %s/%s (%s drain, %s)",
+                    ns, meta["name"], controller, reason)
+
+    # ------------------------------------------------------------------
+    async def _reschedule(
+        self, pod: dict, nodes: list[dict], controller: str
+    ) -> None:
+        """Checkpoint complete: mint the restore pod on the best healthy
+        slice, then clear the source pod.  The restore pod's creation comes
+        FIRST so a crash between the two steps duplicates nothing worse
+        than a Succeeded husk (the replacement name is deterministic per
+        migration generation — re-creating it answers 409 AlreadyExists,
+        absorbed below)."""
+        meta = pod["metadata"]
+        ns = self.namespace_of(pod)
+        source_node = deep_get(pod, "spec", "nodeName", default="")
+        target = pick_target(nodes, source_node)
+        replacement = build_replacement(pod, target)
+        try:
+            await self.client.create(replacement)
+        except Exception as e:  # noqa: BLE001 — replay-safe: adopt our own prior create
+            from tpu_operator.k8s.client import ApiError
+
+            if not (isinstance(e, ApiError) and e.already_exists):
+                raise
+        await self.client.delete("", "Pod", meta["name"], ns)
+        self.metrics.migrations_total.labels(outcome=MIGRATED).inc()
+        self.metrics.drain_evictions_total.labels(
+            controller=controller, reason=MIGRATED
+        ).inc()
+        target_name = target["metadata"]["name"] if target else "<unscheduled>"
+        target_topo = _topology_of(target) if target else ""
+        await self.recorder.normal(
+            obs_events.pod_ref(meta["name"], ns),
+            obs_events.REASON_MIGRATION_COMPLETED,
+            f"checkpoint complete; {meta['name']} rescheduled as "
+            f"{replacement['metadata']['name']} onto {target_name}"
+            + (f" (topology {target_topo})" if target_topo else ""),
+        )
+        log.info(
+            "migrated %s/%s -> %s on %s (%s drain)",
+            ns, meta["name"], replacement["metadata"]["name"],
+            target_name, controller,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Target selection + restore-pod construction (module functions: pure over
+# their inputs, unit-testable without a cluster).
+
+
+def _topology_of(node: dict) -> str:
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    return (
+        labels.get(consts.TFD_ICI_TOPOLOGY_LABEL)
+        or labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    )
+
+
+def node_is_healthy_target(node: dict, source_node: str) -> bool:
+    """A node the scheduler may safely hand a restored job: advertises TPU
+    capacity, schedulable, not owned by the upgrade machine, and carrying
+    no health-engine verdict (quarantined / tripped / slice-degraded nodes
+    are exactly what the job is fleeing)."""
+    name = node["metadata"]["name"]
+    if name == source_node:
+        return False
+    if deep_get(node, "spec", "unschedulable"):
+        return False
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    if labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY:
+        return False
+    if labels.get(consts.HEALTH_STATE_LABEL, "") not in ("", consts.HEALTH_OK):
+        return False
+    from tpu_operator.controllers.upgrade import NON_TERMINAL_STATES
+
+    if labels.get(consts.UPGRADE_STATE_LABEL, "") in NON_TERMINAL_STATES:
+        return False
+    return consts.TPU_RESOURCE in (
+        deep_get(node, "status", "allocatable") or {}
+    )
+
+
+def pick_target(nodes: list[dict], source_node: str) -> Optional[dict]:
+    """Best healthy slice for the restore pod: same-topology nodes win
+    (restore without resharding), then the largest remaining shape — a
+    quarantine-shrunk fleet hands back the biggest mesh it still has.
+    None when no healthy capacity exists (the restore pod is created
+    unpinned and waits for the scheduler/capacity)."""
+    source_topo = ""
+    by_name = {n["metadata"]["name"]: n for n in nodes}
+    if source_node in by_name:
+        source_topo = _topology_of(by_name[source_node])
+    candidates = [n for n in nodes if node_is_healthy_target(n, source_node)]
+    if not candidates:
+        return None
+
+    def rank(node: dict) -> tuple:
+        topo = _topology_of(node)
+        try:
+            chips = topology_chips(topo) if topo else 0
+        except ValueError:
+            chips = 0
+        return (
+            0 if (topo and topo == source_topo) else 1,  # same shape first
+            -chips,                                       # then biggest mesh
+            node["metadata"]["name"],                     # deterministic
+        )
+
+    return sorted(candidates, key=rank)[0]
+
+
+def build_replacement(pod: dict, target: Optional[dict]) -> dict:
+    """The restore pod: the source spec, re-pinned to the target node, with
+    ``TPU_JOB_TOPOLOGY`` rewritten to the target's slice shape so the
+    workload reshards its checkpoint onto the mesh it actually gets.  The
+    checkpoint-dir env rides along untouched — shared storage is the
+    contract that makes the snapshot reachable from the new node."""
+    meta = pod["metadata"]
+    anns = meta.get("annotations") or {}
+    try:
+        generation = int(anns.get(consts.MIGRATE_GENERATION_ANNOTATION, "0"))
+    except ValueError:
+        generation = 0
+    generation += 1
+    base = meta["name"]
+    prior = f"-mig{generation - 1}"
+    if generation > 1 and base.endswith(prior):
+        base = base[: -len(prior)]
+    suffix = f"-mig{generation}"
+    if len(base) + len(suffix) > 63:
+        # deterministic per source (the create-409 adoption below depends
+        # on replaying the SAME name), but hash-disambiguated: two long
+        # source names sharing a prefix must never truncate onto each
+        # other's replacement — that would silently drop one job's restore
+        from tpu_operator.utils import fnv1a_64
+
+        digest = format(fnv1a_64(base.encode()) & 0xFFFFFFFF, "08x")
+        base = f"{base[:63 - len(suffix) - 9]}-{digest}"
+    name = base + suffix
+
+    replacement = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": meta.get("namespace"),
+            "labels": dict(meta.get("labels") or {}),
+            "annotations": {
+                k: v for k, v in anns.items()
+                if k not in (consts.MIGRATE_ANNOTATION,
+                             consts.MIGRATE_TS_ANNOTATION)
+            },
+        },
+        "spec": copy.deepcopy(pod.get("spec") or {}),
+    }
+    replacement["metadata"]["annotations"].update({
+        consts.MIGRATED_FROM_ANNOTATION: deep_get(
+            pod, "spec", "nodeName", default=""
+        ),
+        consts.MIGRATE_GENERATION_ANNOTATION: str(generation),
+    })
+    replacement["spec"].pop("nodeName", None)
+    if target is not None:
+        # pin via nodeSelector, NOT spec.nodeName: nodeName bypasses the
+        # scheduler, so a target that filled up between selection and
+        # kubelet admission would reject the pod terminally (OutOfTpu,
+        # never rescheduled) — with the selector the pod waits Pending
+        # until the scheduler can actually bind it there
+        selector = replacement["spec"].setdefault("nodeSelector", {})
+        selector["kubernetes.io/hostname"] = target["metadata"]["name"]
+        topo = _topology_of(target)
+        if topo:
+            for container in replacement["spec"].get("containers") or []:
+                env = container.setdefault("env", [])
+                for entry in env:
+                    if entry.get("name") == consts.JOB_TOPOLOGY_ENV:
+                        entry["value"] = topo
+                        break
+                else:
+                    env.append(
+                        {"name": consts.JOB_TOPOLOGY_ENV, "value": topo}
+                    )
+    else:
+        # no healthy capacity right now: clear any hostname pin a prior
+        # hop left behind so the scheduler may place the pod anywhere
+        # once capacity returns
+        (replacement["spec"].get("nodeSelector") or {}).pop(
+            "kubernetes.io/hostname", None
+        )
+    return replacement
+
+

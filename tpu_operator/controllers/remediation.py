@@ -44,7 +44,7 @@ from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
-from tpu_operator.controllers import clusterinfo, nodestate
+from tpu_operator.controllers import clusterinfo, migration as mig, nodestate
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.controllers.upgrade import (
     NON_TERMINAL_STATES as UPGRADE_NON_TERMINAL,
@@ -81,6 +81,12 @@ class RemediationReconciler:
         self.metrics = metrics or OperatorMetrics()
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
+        # a re-validation occupies the node's chips: training pods holding
+        # them are drained through the checkpoint→reschedule→restore phase
+        # first (controllers/migration.py), never silently raced
+        self.migration = mig.MigrationCoordinator(
+            client, namespace, metrics=self.metrics, recorder=self.recorder
+        )
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
@@ -132,6 +138,12 @@ class RemediationReconciler:
             if in_progress >= max_parallel:
                 break
             try:
+                if not await self._drain_workloads(node, policy, nodes):
+                    # a training pod still holds the chips: its checkpoint→
+                    # reschedule machine is in flight — admission waits (the
+                    # request label persists; retried next pass) instead of
+                    # racing the re-validation workload onto occupied chips
+                    continue
                 await self._delete_validator_pods(name)
                 await self._set_state(name, REVALIDATING)
             except ApiError as e:
@@ -271,6 +283,35 @@ class RemediationReconciler:
             await self._cordon(name, False)
         await self._set_state(name, None)
         await self._clear_request(name)
+
+    async def _drain_workloads(
+        self, node: dict, policy: TPUClusterPolicy, nodes: list[dict]
+    ) -> bool:
+        """Advance the node's TPU workload pods through the migration phase;
+        True once the node's chips are free — which means a pass that finds
+        NO workload pods left: a pod evicted this pass still runs out its
+        termination grace holding the chips, so admission may only proceed
+        on a later, empty pass.  Disabled migration keeps the historical
+        hands-off behavior (remediation never touched workload pods).  The
+        all-namespace pod list runs only for nodes with a pending validate
+        request — the quiet steady state costs nothing."""
+        if not policy.spec.migration.enabled:
+            return True
+        name = node["metadata"]["name"]
+        pods = await self.client.list_items(
+            "", "Pod", field_selector=f"spec.nodeName={name}"
+        )
+        # OPTED-IN pods only (health-engine rule, identically): pods
+        # without the handler label keep the historical hands-off
+        # behavior — admission proceeds around them as it always did
+        workloads = [
+            p for p in mig.workload_pods(pods, name) if mig.is_migratable(p)
+        ]
+        for pod in workloads:
+            await self.migration.drain_pod(
+                pod, policy.spec.migration, "remediation", nodes=nodes
+            )
+        return not workloads
 
     async def _delete_validator_pods(self, node_name: str) -> None:
         """Clear every validator pod on the node so the DS-recreated pod is

@@ -29,7 +29,7 @@ from typing import Optional
 
 from tpu_operator import consts
 from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy  # noqa: F401 (GROUP/KIND used in setup watches)
-from tpu_operator.controllers import clusterinfo, nodestate
+from tpu_operator.controllers import clusterinfo, migration as mig, nodestate
 from tpu_operator.controllers.labels import node_advertises_tpu
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s import nodeinfo
@@ -94,6 +94,11 @@ class UpgradeReconciler:
         self.metrics = metrics or OperatorMetrics()
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
+        # the checkpoint→reschedule→restore drain phase shared with the
+        # remediation and health machines (controllers/migration.py)
+        self.migration = mig.MigrationCoordinator(
+            client, namespace, metrics=self.metrics, recorder=self.recorder
+        )
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
@@ -172,7 +177,9 @@ class UpgradeReconciler:
                     await self._cordon(name, True)
                     await self._set_state(name, DRAIN)
                 elif state == DRAIN:
-                    drained = await self._drain_step(node, up)
+                    drained = await self._drain_step(
+                        node, up, policy.spec.migration, nodes
+                    )
                     if drained:
                         await self._request_runtime_swap(node)
                         await self._set_state(name, POD_RESTART)
@@ -254,36 +261,52 @@ class UpgradeReconciler:
         """Seconds since the node entered its current upgrade state."""
         return nodestate.state_age(node, consts.UPGRADE_STATE_TS_ANNOTATION)
 
-    async def _drain_step(self, node: dict, up) -> bool:
-        """One non-blocking drain pass: delete TPU workload pods that are not
-        already terminating, report whether the node is drained.  The node
-        stays in DRAIN across requeues until empty — drain.timeoutSeconds is
-        enforced against the state-entry timestamp, never by sleeping inside
-        the reconcile worker (a stuck finalizer must not stall every other
-        node's upgrade)."""
+    async def _drain_step(
+        self, node: dict, up, migration_spec=None,
+        nodes: Optional[list[dict]] = None,
+    ) -> bool:
+        """One non-blocking drain pass: settle every TPU workload pod on
+        the node, report whether it is drained.  Pods carrying the
+        checkpoint migration handler ride the migrate-instead-of-evict
+        phase (controllers/migration.py): annotate → await the checkpoint →
+        reschedule onto a healthy slice — the drain waits on them exactly
+        like the historical delete waited on termination.  Everything else
+        keeps the historical evict, now counted per pod in
+        ``drain_evictions_total{controller=upgrade}``.  The node stays in
+        DRAIN across requeues until empty — drain.timeoutSeconds is
+        enforced against the state-entry timestamp, never by sleeping
+        inside the reconcile worker (a stuck finalizer must not stall every
+        other node's upgrade)."""
         if not up.drain.enable:
             return True
-        from tpu_operator.agents.runtime_manager import pod_requests_tpu
+        from tpu_operator.api.types import MigrationSpec
 
+        if migration_spec is None:
+            migration_spec = MigrationSpec()
         name = node["metadata"]["name"]
         pods = await self.client.list_items(
             "", "Pod", field_selector=f"spec.nodeName={name}"
         )
         remaining = False
-        for pod in pods:
-            if not pod_requests_tpu(pod):
-                continue
+        # shared eligibility filter (TPU request, skip-drain opt-out,
+        # DaemonSet exclusion): one implementation with the remediation
+        # and health drains so the three paths can never select different
+        # pod sets (controllers/migration.py workload_pods)
+        for pod in mig.workload_pods(pods, name):
             meta = pod["metadata"]
-            if (meta.get("labels") or {}).get(consts.SKIP_DRAIN_LABEL) == "true":
-                # pod-level opt-out: the workload manages its own lifecycle
-                # (e.g. checkpoints on the runtime pod's SIGTERM) — neither
-                # evicted nor allowed to block the drain
-                continue
             refs = meta.get("ownerReferences") or []
-            if any(r.get("kind") == "DaemonSet" for r in refs):
-                # kubectl drain --ignore-daemonsets semantics: the DS would
-                # instantly recreate the pod, so deleting or counting it can
-                # never converge; operands drain via the runtime swap instead
+            if migration_spec.enabled and mig.is_migratable(pod):
+                await self.migration.drain_pod(
+                    pod, migration_spec, "upgrade", nodes=nodes,
+                    force=up.drain.force,
+                    grace_period_seconds=up.drain.grace_period_seconds,
+                )
+                # ANY outcome this pass still counts the node as draining:
+                # even a completed/evicted pod runs out its termination
+                # grace holding the chips — only a later pass that no
+                # longer lists the pod concludes drained (the historical
+                # delete path's semantics, kept for migrations)
+                remaining = True
                 continue
             if not refs and not up.drain.force:
                 # bare pod: blocks the drain until timeout unless force
@@ -292,12 +315,14 @@ class UpgradeReconciler:
             remaining = True
             if not meta.get("deletionTimestamp"):
                 # the workload gets the spec'd termination grace (None
-                # preserves the pod's own terminationGracePeriodSeconds)
-                await self.client.delete(
-                    "", "Pod", meta["name"], meta.get("namespace"),
-                    grace_period_seconds=up.drain.grace_period_seconds,
+                # preserves the pod's own terminationGracePeriodSeconds);
+                # the coordinator's evict path keeps those semantics and
+                # adds the per-pod eviction accounting
+                await self.migration.evict(
+                    pod, "upgrade",
+                    mig.FORCED if up.drain.force else mig.NO_HANDLER,
+                    up.drain.grace_period_seconds,
                 )
-                log.info("evicted TPU pod %s/%s", meta.get("namespace"), meta["name"])
         return not remaining
 
     def _node_pods(self, node_name: str, label_selector: str):

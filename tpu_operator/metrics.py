@@ -115,6 +115,30 @@ class OperatorMetrics:
             "tpu_operator_health_actuations_denied_total",
             "Actuations withheld because the disruption budget was exhausted",
         )
+        # live workload migration (controllers/migration.py;
+        # docs/ROBUSTNESS.md "Live migration")
+        self.migrations_total = Counter(
+            "tpu_operator_migrations_total",
+            "Workload-pod migration outcomes along the drain paths: "
+            "requested (migrate annotation stamped), migrated (checkpoint "
+            "complete, restore pod rescheduled), timeout (checkpoint never "
+            "completed inside migration.timeoutSeconds), failed (workload "
+            "crashed mid-checkpoint)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.drain_evictions_total = Counter(
+            "tpu_operator_drain_evictions_total",
+            "Workload-pod deletions along the operator's drain paths, by "
+            "owning controller (upgrade | remediation | health) and reason: "
+            "migrated (deleted after a completed checkpoint+reschedule), "
+            "timeout (migration fell back to evict), failed (checkpoint "
+            "crashed), forced (drain.force), no-handler (pod never opted "
+            "into migration), completed (pod finished on its own before "
+            "any migrate request — cleanup, nothing lost)",
+            ["controller", "reason"],
+            registry=self.registry,
+        )
         # duration Histograms, fed by the obs.trace span layer
         h = lambda name, doc, label: Histogram(  # noqa: E731
             name, doc, [label], registry=self.registry, buckets=DURATION_BUCKETS

@@ -44,6 +44,13 @@ REASON_SELECTOR_CONFLICT = "SelectorConflict"
 REASON_PERF_REGRESSED = "WorkloadPerfRegressed"
 # node health engine (controllers/health.py; docs/ROBUSTNESS.md)
 REASON_NODE_UNHEALTHY = "NodeUnhealthy"
+# live workload migration (controllers/migration.py; docs/ROBUSTNESS.md
+# "Live migration"): the checkpoint→reschedule→restore drain phase
+REASON_MIGRATION_REQUESTED = "MigrationRequested"
+REASON_MIGRATION_COMPLETED = "MigrationCompleted"
+REASON_MIGRATION_TIMEOUT = "MigrationTimedOut"
+REASON_MIGRATION_FAILED = "MigrationFailed"
+REASON_WORKLOAD_EVICTED = "WorkloadEvicted"
 REASON_NODE_RECOVERED = "NodeRecovered"
 REASON_NODE_QUARANTINED = "NodeQuarantined"
 REASON_HEALTH_BUDGET_EXHAUSTED = "HealthBudgetExhausted"
@@ -71,6 +78,16 @@ def lease_ref(namespace: str, name: str) -> dict:
     return {
         "apiVersion": "coordination.k8s.io/v1",
         "kind": "Lease",
+        "metadata": {"name": name, "namespace": namespace},
+    }
+
+
+def pod_ref(name: str, namespace: str) -> dict:
+    """involvedObject for per-pod drain/migration events (the evidence an
+    operator of a lost training job greps for first)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
         "metadata": {"name": name, "namespace": namespace},
     }
 

@@ -98,6 +98,14 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         # pod annotation on every operand/validator pod template
         "traceparent": ctx.traceparent,
         "traceparent_annotation": consts.TRACEPARENT_ANNOTATION,
+        # live-migration patience window (migration.timeoutSeconds): stamped
+        # into validator pod env (and through it the workload pods it
+        # spawns) so a checkpoint-on-drain workload knows how long the
+        # operator waits before falling back to evict — snapshot work past
+        # it is wasted.  0 renders nothing (migration disabled).
+        "migration_timeout_seconds": (
+            spec.migration.timeout_seconds if spec.migration.enabled else 0
+        ),
         "validation_dir": consts.VALIDATION_DIR,
         "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
         "compile_cache_dir": consts.COMPILE_CACHE_DIR,

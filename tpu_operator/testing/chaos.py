@@ -77,6 +77,14 @@ class ChaosConfig:
     agent_unhealthy_interval: float = 0.0  # 0 = off
     agent_unhealthy_down_s: float = 3.0
     agent_unhealthy_reason: str = "chip-scrape-failed"
+    # checkpoint faults (workloads/checkpoint.py TPU_CKPT_FAULT contract;
+    # applied to signal-triggered snapshots only): kill_during_checkpoint
+    # SIGKILLs the worker after the shard files but before the manifest —
+    # the torn snapshot that must never be restored; slow_checkpoint_s
+    # injects that much latency mid-snapshot so migration.timeoutSeconds
+    # fires and the drain's timeout→evict fallback is exercised
+    kill_during_checkpoint: bool = False
+    slow_checkpoint_s: float = 0.0
 
 
 class ChaosEngine:
@@ -177,6 +185,23 @@ class ChaosEngine:
         return None
 
     # ------------------------------------------------------------------
+    def checkpoint_fault(self) -> Optional[str]:
+        """``TPU_CKPT_FAULT`` env value for a workload being launched, or
+        None.  The launcher (the fake kubelet's pod executor) stamps the
+        value into the worker env and workloads/checkpoint.py interprets
+        it at the canonical torn point of its next final snapshot (shard
+        files written, manifest not yet published)."""
+        if not self.active:
+            return None
+        cfg = self.config
+        if cfg.kill_during_checkpoint:
+            self._count("ckpt_kill")
+            return "kill"
+        if cfg.slow_checkpoint_s:
+            self._count("ckpt_slow")
+            return f"slow:{cfg.slow_checkpoint_s:g}"
+        return None
+
     def should_crash_pod(self) -> bool:
         if not self.active or not self.config.pod_crashloop_rate:
             return False

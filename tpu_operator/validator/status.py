@@ -46,8 +46,12 @@ def write_ready(component: str, payload: Optional[dict] = None) -> str:
     path = status_path(component)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     data = {"component": component, "ts": time.time(), **(payload or {})}
-    with open(path, "w") as f:
+    # tmp+replace: the ready markers gate the whole init chain — a reader
+    # (validator, exporter, upgrade controller) must never parse a torn one
+    tmp = path + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         json.dump(data, f)
+    os.replace(tmp, path)
     return path
 
 
@@ -91,11 +95,14 @@ def cleanup_all() -> int:
 
 def write_marker(name: str) -> str:
     """Dot-file markers for intra-chain handoff (.libtpu-ctr-ready analogue
-    of .driver-ctr-ready, validator/main.go:606-635)."""
+    of .driver-ctr-ready, validator/main.go:606-635); tmp+replace so a
+    handoff reader never sees a half-written timestamp."""
     path = os.path.join(validation_dir(), name)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
+    tmp = path + f".{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         f.write(str(time.time()))
+    os.replace(tmp, path)
     return path
 
 

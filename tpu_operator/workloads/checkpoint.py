@@ -1,0 +1,686 @@
+"""Migratable training state: atomic sharded checkpoints + reshard-on-restore.
+
+The drain paths used to end a training job with ``client.delete`` — the
+job's progress died with the node.  This module is the workload half of the
+live-migration story (CRIUgpu: checkpoint/restore is the production answer
+to planned disruption; Tenplex: a checkpoint is a *parallelizable tensor
+collection* — taken under one slice shape, restorable under another):
+
+- :func:`save_checkpoint` writes an atomic snapshot: every array is dumped
+  as its device shards (raw bytes + the shard's *global index ranges* +
+  a content hash), the manifest (step, mesh shape, partition specs, hashes)
+  is written last inside a temp directory, and the whole directory is
+  published with ``os.replace`` — a ``LATEST`` pointer (itself tmp+replace)
+  names the newest complete snapshot.  A crash at ANY byte leaves the
+  previous snapshot authoritative; a torn snapshot is never observable.
+- :func:`load_checkpoint` verifies the manifest (version, shard presence,
+  sizes, content hashes) and falls back to the next-newest *valid* snapshot
+  on any corruption.  Because shards carry global index ranges rather than
+  device ranks, restore reassembles the global tensors and re-places them
+  under ANY target mesh — a job checkpointed on a 4x4 mesh resumes on 2x4
+  bitwise-identically.
+- :class:`Checkpointer` serializes snapshot requests (concurrent requests
+  coalesce onto the in-flight snapshot) and owns retention.
+- :class:`MigrationSignal` watches the drain signal: the pod annotation
+  ``tpu.google.com/migrate=requested`` via a downward-API annotations file
+  (``TPU_MIGRATE_SIGNAL_FILE``), with SIGTERM as the fallback for clusters
+  that deliver nothing richer.
+- :func:`main` is a reference migratable training job (the chaos-migrate
+  soak's payload): a real sharded SGD loop over the ``TPU_JOB_TOPOLOGY``
+  mesh that checkpoints every ``TPU_CKPT_EVERY`` steps and on the drain
+  signal, then exits 0 — the "checkpoint complete" status the migration
+  coordinator awaits — and restores (resharding) on the next launch.
+
+Every phase is recorded on the ambient flight recorder (obs.flight), so a
+migration shows up in the job's flight record as checkpoint/restore phases
+joinable against the operator's trace ids.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from tpu_operator import consts
+from tpu_operator.obs import flight
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+LATEST_NAME = "LATEST"
+_STEP_DIR_RE = re.compile(r"^step-(\d{8})$")
+
+# fault-injection env (testing/chaos.py checkpoint faults): applied to
+# signal-triggered (final) snapshots only, so periodic snapshots stay good
+# and the soak can prove a torn final snapshot never shadows them.
+#   kill      SIGKILL self after the shard files, before the manifest
+#   slow:<s>  sleep <s> seconds mid-snapshot (drives the timeout->evict path)
+FAULT_ENV = "TPU_CKPT_FAULT"
+
+
+class CheckpointError(Exception):
+    """A snapshot that must not be trusted (torn manifest, hash mismatch)."""
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _np_dtype(name: str):
+    """numpy dtype for a manifest dtype name; bfloat16 etc. resolve through
+    ml_dtypes (always present beside jax)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _shards_of(value) -> list[tuple[tuple, np.ndarray]]:
+    """(global index ranges, host data) per distinct shard of ``value``.
+
+    jax arrays contribute their addressable shards deduplicated by global
+    index (replicated dims put the same shard on many devices); anything
+    else is one full-coverage shard.  Index ranges — not device ranks — are
+    what make the collection restorable under a different mesh."""
+    shards = getattr(value, "addressable_shards", None)
+    if shards is None:
+        arr = np.asarray(value)
+        index = tuple((0, d) for d in arr.shape)
+        return [(index, arr)]
+    seen: dict[tuple, np.ndarray] = {}
+    shape = value.shape
+    for shard in shards:
+        index = tuple(
+            (sl.start or 0, sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(shard.index, shape)
+        )
+        if index not in seen:
+            seen[index] = np.asarray(shard.data)
+    return sorted(seen.items())
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    step: int,
+    arrays: dict,
+    mesh_shape: Optional[tuple] = None,
+    specs: Optional[dict] = None,
+    extra: Optional[dict] = None,
+    keep: int = 2,
+    fault: Optional[Callable[[], None]] = None,
+) -> str:
+    """Write one atomic snapshot; returns the published snapshot dir.
+
+    ``specs`` maps array name -> partition spec as a list (e.g.
+    ``["dp", None]``: dim 0 sharded over the mesh's dp axis) recorded for
+    restore-time placement.  ``fault`` is the test seam invoked after the
+    shard files exist but before the manifest — the canonical torn point.
+    """
+    final = os.path.join(ckpt_dir, f"step-{step:08d}")
+    tmp = final + f".tmp-{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    t0 = time.perf_counter()
+    manifest: dict = {
+        "version": MANIFEST_VERSION,
+        "step": int(step),
+        "mesh": list(mesh_shape) if mesh_shape else None,
+        "ts": time.time(),
+        "arrays": {},
+        "extra": extra or {},
+    }
+    for name, value in arrays.items():
+        dtype = getattr(value, "dtype", None) or np.asarray(value).dtype
+        entry: dict = {
+            "shape": list(np.shape(value)),
+            "dtype": str(dtype.name),
+            "spec": list((specs or {}).get(name) or []),
+            "shards": [],
+        }
+        for i, (index, data) in enumerate(_shards_of(value)):
+            fname = f"{name}-{i:05d}.bin"
+            blob = np.ascontiguousarray(data).tobytes()
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(blob)
+            entry["shards"].append({
+                "file": fname,
+                "index": [list(r) for r in index],
+                "bytes": len(blob),
+                "sha256": _sha256(blob),
+            })
+        manifest["arrays"][name] = entry
+    if fault is not None:
+        fault()  # torn point: shards on disk, no manifest yet
+    # manifest last, inside the tmp dir, itself via tmp+replace; then the
+    # directory rename publishes the snapshot as one atomic unit
+    mtmp = os.path.join(tmp, MANIFEST_NAME + ".tmp")
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(mtmp, os.path.join(tmp, MANIFEST_NAME))
+    if os.path.isdir(final):
+        _rmtree(final)  # a re-snapshot of the same step replaces it whole
+    os.replace(tmp, final)
+    _publish_latest(ckpt_dir, os.path.basename(final))
+    _gc(ckpt_dir, keep=keep)
+    flight.record(
+        "migration", "checkpoint", step=step,
+        checkpoint_s=time.perf_counter() - t0,
+        arrays=float(len(arrays)),
+    )
+    return final
+
+
+def _publish_latest(ckpt_dir: str, name: str) -> None:
+    tmp = os.path.join(ckpt_dir, LATEST_NAME + f".tmp-{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(name)
+    os.replace(tmp, os.path.join(ckpt_dir, LATEST_NAME))
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _snapshot_dirs(ckpt_dir: str) -> list[str]:
+    """Complete snapshot dir names, newest first (tmp debris excluded)."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    return sorted((n for n in names if _STEP_DIR_RE.match(n)), reverse=True)
+
+
+def _gc(ckpt_dir: str, keep: int) -> None:
+    for name in _snapshot_dirs(ckpt_dir)[keep:]:
+        _rmtree(os.path.join(ckpt_dir, name))
+    # stale tmp dirs from crashed snapshots are debris, not evidence
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return
+    for name in names:
+        if ".tmp-" in name and not os.path.isfile(os.path.join(ckpt_dir, name)):
+            _rmtree(os.path.join(ckpt_dir, name))
+
+
+def _read_manifest(snap_dir: str) -> dict:
+    """Parse one snapshot's manifest and validate its STRUCTURE; raises
+    CheckpointError on a missing/truncated/malformed manifest.  Shard
+    content (presence, size, hash) is verified by :func:`_assemble` on the
+    single read that also reconstructs the tensors — multi-GB checkpoints
+    must not pay restore I/O twice."""
+    path = os.path.join(snap_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointError(f"unreadable manifest at {path}: {e}") from e
+    if manifest.get("version") != MANIFEST_VERSION:
+        raise CheckpointError(
+            f"manifest version {manifest.get('version')!r} != {MANIFEST_VERSION}"
+        )
+    if not isinstance(manifest.get("arrays"), dict) or "step" not in manifest:
+        raise CheckpointError(f"malformed manifest at {path}")
+    return manifest
+
+
+@dataclass
+class Checkpoint:
+    """One verified snapshot, reassembled: global numpy arrays (or, when a
+    target mesh was given, jax arrays placed under the recorded specs)."""
+
+    step: int
+    arrays: dict
+    mesh_shape: Optional[tuple]
+    specs: dict
+    path: str
+    extra: dict = field(default_factory=dict)
+
+
+def _assemble(snap_dir: str, entry: dict) -> np.ndarray:
+    """Reconstruct one global array, verifying every shard (presence, size,
+    content hash) on the same single read; raises CheckpointError on any
+    tear so the caller falls back to an older complete snapshot."""
+    shape = tuple(entry["shape"])
+    out = np.empty(shape, dtype=_np_dtype(entry["dtype"]))
+    for shard in entry["shards"]:
+        spath = os.path.join(snap_dir, shard.get("file", ""))
+        try:
+            with open(spath, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise CheckpointError(f"missing shard {spath}: {e}") from e
+        if len(blob) != shard.get("bytes"):
+            raise CheckpointError(
+                f"shard {spath} truncated: {len(blob)} != {shard.get('bytes')}"
+            )
+        if _sha256(blob) != shard.get("sha256"):
+            raise CheckpointError(f"shard {spath} content hash mismatch")
+        index = tuple(slice(a, b) for a, b in shard["index"])
+        piece_shape = tuple(b - a for a, b in shard["index"])
+        out[index] = np.frombuffer(blob, dtype=out.dtype).reshape(piece_shape)
+    return out
+
+
+def load_checkpoint(ckpt_dir: str, mesh=None) -> Optional[Checkpoint]:
+    """Newest *valid* snapshot, or None.  Corrupt snapshots (torn manifest,
+    hash mismatch) are skipped — never restored — and the scan falls back
+    to older complete ones; the LATEST pointer is an optimization, the
+    manifest verification is the authority."""
+    order = _snapshot_dirs(ckpt_dir)
+    try:
+        with open(os.path.join(ckpt_dir, LATEST_NAME)) as f:
+            latest = f.read().strip()
+        if latest in order:  # try the pointer first
+            order = [latest] + [n for n in order if n != latest]
+    except OSError:
+        pass
+    t0 = time.perf_counter()
+    for name in order:
+        snap_dir = os.path.join(ckpt_dir, name)
+        try:
+            manifest = _read_manifest(snap_dir)
+            arrays = {
+                aname: _assemble(snap_dir, entry)
+                for aname, entry in manifest["arrays"].items()
+            }
+        except CheckpointError:
+            continue
+        specs = {
+            aname: tuple(entry.get("spec") or ())
+            for aname, entry in manifest["arrays"].items()
+        }
+        if mesh is not None:
+            arrays = {
+                aname: _place(mesh, arrays[aname], specs[aname])
+                for aname in arrays
+            }
+        ckpt = Checkpoint(
+            step=int(manifest["step"]),
+            arrays=arrays,
+            mesh_shape=tuple(manifest["mesh"]) if manifest.get("mesh") else None,
+            specs=specs,
+            path=snap_dir,
+            extra=manifest.get("extra") or {},
+        )
+        flight.record(
+            "migration", "restore", step=ckpt.step,
+            restore_s=time.perf_counter() - t0,
+            arrays=float(len(arrays)),
+        )
+        return ckpt
+    return None
+
+
+def _place(mesh, array: np.ndarray, spec: tuple):
+    """Device-place a reassembled global array under ``mesh`` with its
+    recorded partition spec — the Tenplex reshard: the collection carries
+    global index ranges, so ANY mesh shape reconstructs bitwise-equal
+    tensors, just cut along different lines."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    names = tuple(spec[i] if i < len(spec) else None for i in range(array.ndim))
+    sharding = NamedSharding(mesh, P(*names))
+    return jax.make_array_from_callback(
+        array.shape, sharding, lambda idx: array[idx]
+    )
+
+
+class Checkpointer:
+    """Snapshot coordinator: serializes writes, coalesces concurrent
+    requests, applies the seeded chaos faults to *final* snapshots only."""
+
+    def __init__(self, ckpt_dir: str, keep: int = 2):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._saving = False
+        self._last_step: Optional[int] = None
+        self._last_path: Optional[str] = None
+
+    def save(
+        self,
+        step: int,
+        arrays: dict,
+        mesh_shape: Optional[tuple] = None,
+        specs: Optional[dict] = None,
+        extra: Optional[dict] = None,
+        final: bool = False,
+    ) -> Optional[str]:
+        """Snapshot ``step``; concurrent callers coalesce — while a snapshot
+        is being written, other requests return the in-flight/previous path
+        instead of racing a second writer into the same directory.  A
+        re-request of an already-persisted step is a no-op — EXCEPT for
+        ``final`` (signal-triggered) snapshots, which ALWAYS write: the
+        drain signal can land exactly on a periodic-checkpoint step, and
+        the migration snapshot is the authoritative one (it may carry
+        state the periodic pass did not, and skipping it would also skip
+        the chaos fault seam the torn-snapshot soak drives through it).
+        A final request that races an in-flight periodic save therefore
+        WAITS for the writer to finish and then writes its own snapshot,
+        instead of returning the stale path — exiting 0 on a snapshot that
+        never ran would hand the coordinator a false checkpoint-complete."""
+        while True:
+            with self._lock:
+                if not self._saving:
+                    if self._last_step == step and not final:
+                        return self._last_path
+                    self._saving = True
+                    break
+                if not final:
+                    return self._last_path
+            time.sleep(0.01)  # final: outwait the in-flight writer
+        try:
+            path = save_checkpoint(
+                self.ckpt_dir, step, arrays, mesh_shape=mesh_shape,
+                specs=specs, extra=extra, keep=self.keep,
+                fault=_env_fault() if final else None,
+            )
+            with self._lock:
+                self._last_step, self._last_path = step, path
+            return path
+        finally:
+            with self._lock:
+                self._saving = False
+
+
+def _env_fault() -> Optional[Callable[[], None]]:
+    """The chaos checkpoint fault as a callable, from TPU_CKPT_FAULT."""
+    spec = os.environ.get(FAULT_ENV, "")
+    if not spec:
+        return None
+    kind, _, arg = spec.partition(":")
+
+    def fault() -> None:
+        if kind == "kill":
+            print(json.dumps({"fault_injected": "kill-during-checkpoint"}),
+                  flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif kind == "slow":
+            try:
+                time.sleep(float(arg or 0))
+            except ValueError:
+                pass
+
+    return fault
+
+
+class MigrationSignal:
+    """The drain signal, from either channel:
+
+    - downward-API annotations file (``TPU_MIGRATE_SIGNAL_FILE``): the pod
+      mounts ``metadata.annotations`` and the kubelet rewrites the file when
+      the migration coordinator stamps ``tpu.google.com/migrate=requested``
+      — the rich channel, no API access needed in the workload;
+    - SIGTERM: the fallback every Kubernetes eviction already delivers.
+    """
+
+    def __init__(self, annotations_file: Optional[str] = None,
+                 install_sigterm: bool = True):
+        self.annotations_file = (
+            annotations_file
+            if annotations_file is not None
+            else os.environ.get(consts.MIGRATE_SIGNAL_FILE_ENV, "")
+        )
+        self._sigterm = threading.Event()
+        if install_sigterm:
+            try:
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+            except ValueError:
+                pass  # non-main thread (tests): file channel only
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self._sigterm.set()
+
+    @property
+    def sigterm(self) -> bool:
+        return self._sigterm.is_set()
+
+    def requested(self) -> bool:
+        if self._sigterm.is_set():
+            return True
+        if not self.annotations_file:
+            return False
+        try:
+            with open(self.annotations_file) as f:
+                text = f.read()
+        except OSError:
+            return False
+        return self._parse(text)
+
+    @staticmethod
+    def _parse(text: str) -> bool:
+        """Downward-API format (``key="value"`` lines, values Go-quoted);
+        plain ``key=value`` accepted for hand-written test files."""
+        for line in text.splitlines():
+            key, sep, value = line.partition("=")
+            if not sep or key.strip() != consts.MIGRATE_ANNOTATION:
+                continue
+            if value.strip().strip('"') == consts.MIGRATE_REQUESTED:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference migratable training job (the chaos-migrate soak's payload).
+
+
+def _mesh_from_topology(topology: str):
+    """(dp, mp) Mesh over exactly topology-many devices: the first topology
+    dim is dp, the rest collapse into mp — "4x4" → 4x4, "2x4" → 2x4.
+
+    When FEWER devices exist than the topology names, the mesh degrades to
+    (1, all-devices) instead of crashing: a restore pod created unpinned
+    (no healthy capacity at migration time) keeps the env of its OLD slice
+    shape, and the scheduler may later bind it to a smaller one — the
+    checkpoint reshards under any mesh, so training on the shape actually
+    present beats dying with a valid snapshot in hand."""
+    import jax
+
+    from tpu_operator.utils import parse_topology, topology_chips
+
+    dims = parse_topology(topology)
+    chips = topology_chips(topology)
+    devices = jax.devices()
+    if len(devices) < chips:
+        print(json.dumps({
+            "event": "topology-degraded", "declared": topology,
+            "devices": len(devices),
+        }), flush=True)
+        dp, mp = 1, len(devices)
+    else:
+        dp = dims[0]
+        mp = chips // dp
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:dp * mp]).reshape(dp, mp), ("dp", "mp"))
+
+
+def run_migratable_training(
+    ckpt_dir: str,
+    topology: str,
+    steps: int = 50,
+    ckpt_every: int = 10,
+    step_sleep_s: float = 0.0,
+    d_model: int = 32,
+    d_hidden: int = 64,
+    signal_source: Optional[MigrationSignal] = None,
+    progress: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """The migratable train loop: restore → step → periodic checkpoint →
+    (on drain signal) final checkpoint + clean exit.
+
+    Returns a result dict with ``ok``, ``steps_done``,
+    ``resumed_from_step`` (0 when cold), ``checkpointed_step`` (the step
+    the final snapshot holds, -1 when the run finished without one) and the
+    mesh actually used — the evidence the chaos-migrate soak asserts its
+    step bound over.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sig = signal_source or MigrationSignal()
+    mesh = _mesh_from_topology(topology)
+    dp, mp = mesh.shape["dp"], mesh.shape["mp"]
+    specs = {"w1": (None, "mp"), "w2": ("mp", None)}
+
+    start_step = 0
+    resumed_from = 0
+    ckpt = load_checkpoint(ckpt_dir, mesh=mesh)
+    if ckpt is not None:
+        params = {"w1": ckpt.arrays["w1"], "w2": ckpt.arrays["w2"]}
+        start_step = resumed_from = ckpt.step
+        if progress is not None:
+            progress({"event": "restored", "resumed_from_step": ckpt.step,
+                      "from_mesh": list(ckpt.mesh_shape or ()),
+                      "mesh": [dp, mp]})
+    else:
+        params = {
+            k: _place(mesh, np.asarray(v), specs[k])
+            for k, v in _init_params(d_model, d_hidden).items()
+        }
+        if progress is not None:
+            progress({"event": "started", "mesh": [dp, mp]})
+
+    global_batch = 8 * dp
+    gx = np.random.default_rng(7).standard_normal(
+        (global_batch, d_model), dtype=np.float32
+    ).astype(jnp.bfloat16)
+    x = jax.make_array_from_callback(
+        (global_batch, d_model), NamedSharding(mesh, P("dp", None)),
+        lambda idx: gx[idx],
+    )
+
+    # Plain-jit GSPMD step (no shard_map dependency): the dp-sharded batch
+    # through the mp-sharded Megatron MLP; the partitioner inserts the mp
+    # psum and dp gradient reduction from the shardings alone.
+    def loss_fn(p, xs):
+        h = jnp.maximum(xs.astype(jnp.bfloat16) @ p["w1"], 0)
+        y = h @ p["w2"]
+        return jnp.mean(jnp.square(y.astype(jnp.float32)))
+
+    @jax.jit
+    def step_fn(p, xs):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xs)
+        new = {
+            k: (p[k].astype(jnp.float32)
+                - 0.05 * grads[k].astype(jnp.float32)).astype(p[k].dtype)
+            for k in p
+        }
+        return loss, new
+
+    ckpt_writer = Checkpointer(ckpt_dir)
+    ckpt_writer._last_step = resumed_from or None
+
+    def snapshot(step: int, final: bool) -> Optional[str]:
+        host = {k: np.asarray(v) for k, v in params.items()}
+        return ckpt_writer.save(
+            step, host, mesh_shape=(dp, mp), specs=specs, final=final,
+        )
+
+    checkpointed = resumed_from if ckpt is not None else -1
+    step = start_step
+    losses: list[float] = []
+    while step < steps:
+        if sig.requested():
+            snapshot(step, final=True)
+            checkpointed = step
+            if progress is not None:
+                progress({"event": "checkpointed", "step": step,
+                          "trigger": "migrate-signal"})
+            break
+        loss, params = step_fn(params, x)
+        losses.append(float(loss))
+        step += 1
+        flight.record("migration", "step", step=step, step_s=step_sleep_s)
+        if ckpt_every and step % ckpt_every == 0 and step < steps:
+            snapshot(step, final=False)
+            checkpointed = step
+            if progress is not None:
+                progress({"event": "progress", "step": step})
+        if step_sleep_s:
+            time.sleep(step_sleep_s)
+
+    finite = all(math.isfinite(l) for l in losses) if losses else True
+    return {
+        "ok": finite,
+        "steps_done": step - start_step,
+        "step": step,
+        "resumed_from_step": resumed_from,
+        "checkpointed_step": checkpointed,
+        "migrated_out": bool(sig.requested()),
+        "mesh": [dp, mp],
+        "topology": topology,
+        "losses_finite": finite,
+        "backend": jax.default_backend(),
+    }
+
+
+def _init_params(d_model: int, d_hidden: int) -> dict:
+    rng = np.random.default_rng(0)
+    scale = 1.0 / np.sqrt(d_model)
+    import jax.numpy as jnp
+
+    return {
+        "w1": (rng.standard_normal((d_model, d_hidden), dtype=np.float32)
+               * scale).astype(jnp.bfloat16),
+        "w2": (rng.standard_normal((d_hidden, d_model), dtype=np.float32)
+               * scale).astype(jnp.bfloat16),
+    }
+
+
+def main() -> int:
+    from tpu_operator import workloads
+    from tpu_operator.validator import status as vstatus
+
+    workloads.honor_cpu_platform_request()
+    ckpt_dir = os.environ.get(consts.CKPT_DIR_ENV, "")
+    if not ckpt_dir:
+        print(json.dumps({"ok": False, "error": f"{consts.CKPT_DIR_ENV} required"}))
+        return 1
+    os.makedirs(ckpt_dir, exist_ok=True)
+    topology = os.environ.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+    result_file = os.environ.get("TPU_JOB_RESULT_FILE", "")
+
+    def progress(event: dict) -> None:
+        line = json.dumps({"ts": round(time.time(), 3), **event})
+        print(line, flush=True)
+        if result_file:
+            try:
+                with open(result_file, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+
+    recorder = flight.recorder_for(vstatus.flight_record_path("migration"))
+    with flight.activate(recorder):
+        result = run_migratable_training(
+            ckpt_dir,
+            topology,
+            steps=int(os.environ.get("TRAIN_STEPS", "50")),
+            ckpt_every=int(os.environ.get("TPU_CKPT_EVERY", "10")),
+            step_sleep_s=float(os.environ.get("TRAIN_STEP_SLEEP_S", "0") or 0),
+            progress=progress,
+        )
+        flight.record_result("migration", result)
+    progress({"event": "result", **result})
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

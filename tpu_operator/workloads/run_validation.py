@@ -214,8 +214,12 @@ def main() -> int:
         count = int(os.environ.get("WORKLOAD_BARRIER_COUNT", "1") or 1)
         budget = float(os.environ.get("WORKLOAD_BARRIER_TIMEOUT_S", "120") or 120)
         os.makedirs(barrier_dir, exist_ok=True)
-        with open(os.path.join(barrier_dir, f"{os.getpid()}.ready"), "w") as f:
+        # tmp+replace: a member crashing mid-announce must not leave a torn
+        # .ready file the barrier count would trust
+        marker = os.path.join(barrier_dir, f"{os.getpid()}.ready")
+        with open(marker + ".tmp", "w") as f:
             f.write(str(os.getpid()))
+        os.replace(marker + ".tmp", marker)
         deadline = time.monotonic() + budget
         while True:
             present = [n for n in os.listdir(barrier_dir) if n.endswith(".ready")]

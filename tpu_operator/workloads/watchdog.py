@@ -35,6 +35,7 @@ classifies both shapes from the worker outcomes.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import threading
@@ -118,8 +119,10 @@ class PeerWatchdog:
             self.client.key_value_set(
                 f"{_KV_PREFIX}/phase/{self.process_id}", name, True
             )
-        except Exception:  # noqa: BLE001 — phase is evidence, not control flow
-            pass
+        except Exception as e:  # noqa: BLE001 — phase is evidence, not control flow
+            logging.getLogger("tpu_operator.watchdog").debug(
+                "phase KV publish failed (drop-box record still holds): %s", e
+            )
 
     # ------------------------------------------------------------------
     def _publish_beat(self) -> None:
