@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
 # the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint unit-test chaos chaos-health chaos-migrate fleet-obs
+test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint unit-test chaos chaos-health chaos-migrate fleet-obs
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -42,6 +42,14 @@ trace-lint:
 # file a reader would trust (docs/ROBUSTNESS.md "Live migration")
 atomic-lint:
 	$(PYTHON) hack/check_atomic_writes.py
+
+# no hand-rolled `while True: sleep` poll loops and no full-fleet Node
+# lists inside per-key reconcile paths under controllers/ — periodic work
+# rides the workqueue's scheduled-requeue API and per-node work stays
+# node-scoped; explicit full-resync entry points are allowlisted
+# (docs/PERFORMANCE.md "Delta reconcile & sharding")
+delta-lint:
+	$(PYTHON) hack/check_delta_paths.py
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
 # plumbing): slow-marked tests excluded, collection errors non-fatal
@@ -83,9 +91,12 @@ bundle:
 bench:
 	$(PYTHON) bench.py
 
-# control-plane reconcile bench, small tier (chip-free; ~1 min).  Override
-# the tiers for the full sweep: make bench-reconcile RECONCILE_TIERS=10,100,500
-RECONCILE_TIERS ?= 10
+# control-plane reconcile bench on the sharded delta plane (chip-free).
+# The default sweep is the ISSUE-10 acceptance tiers — gated on the
+# zero-write fixed point, steady verbs/pass 0 with the fleet aggregator
+# live, and O(1) single-node-event verb cost at EVERY tier (~4-5 min).
+# Override for a quick check: make bench-reconcile RECONCILE_TIERS=10,100
+RECONCILE_TIERS ?= 2000,5000,10000
 bench-reconcile:
 	$(PYTHON) bench.py --reconcile --tiers $(RECONCILE_TIERS)
 

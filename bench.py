@@ -272,7 +272,18 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
             renew_deadline=2.0, recorder=recorder, operator_metrics=metrics,
         )
         reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics, recorder=recorder)
-        reconciler.setup(mgr)
+        # the soak runs on the SHARDED delta plane (ISSUE 10): node events
+        # ride hash-ring worker shards, and a mid-soak shard handoff below
+        # must cause zero duplicate creations (shard write fences)
+        from tpu_operator.controllers.nodes import NodeReconciler
+        from tpu_operator.controllers.plane import NodePlane
+
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            metrics=metrics, resync_seconds=20.0,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
         result: dict = {"nodes": n_nodes, "seed": seed, "error_rate": error_rate}
         try:
             async with mgr:
@@ -302,11 +313,24 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
 
                 t0 = time.perf_counter()
                 stole_at = None
+                handoff_shard = None
+                handoff_restored = False
                 lost = regained = False
                 while True:
                     if stole_at is None and time.perf_counter() - t0 > 2.0:
                         fc.steal_lease(NS)  # mid-convergence leadership loss
                         stole_at = time.perf_counter()
+                        # mid-soak shard handoff: rip one shard out of the
+                        # ring while its queue is full of node keys — the
+                        # moved keys re-route and in-flight writes fence
+                        handoff_shard = plane.shard_ids[0]
+                        plane.remove_shard(handoff_shard)
+                    if (
+                        handoff_shard is not None and not handoff_restored
+                        and time.perf_counter() - stole_at > 3.0
+                    ):
+                        plane.add_shard(handoff_shard)  # second handoff back
+                        handoff_restored = True
                     if stole_at is not None and not mgr.elector.is_leader.is_set():
                         lost = True
                     if lost and mgr.elector.is_leader.is_set():
@@ -321,6 +345,12 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
                 result["converge_s"] = round(time.perf_counter() - t0, 3)
                 result["leadership_lost"] = lost
                 result["leadership_regained"] = regained
+                result["shard_handoffs"] = _metric_total(
+                    metrics, "tpu_operator_shard_handoffs"
+                )
+                result["shard_fence_rejections"] = _metric_total(
+                    metrics, "tpu_operator_shard_fence_rejections"
+                )
 
                 # blackout: 100% errors until the breaker trips → degraded
                 # mode (reconciles paused); recovery closes it again
@@ -396,6 +426,10 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
             failures.append(f"steady requests/pass = {result['steady_requests_per_pass']} (want 0)")
         if not (lost and regained):
             failures.append("leadership steal not observed (lost/regained)")
+        if result["shard_handoffs"] < 2:
+            failures.append(
+                f"mid-soak shard handoff not exercised: {result['shard_handoffs']}"
+            )
         if result["retries_total"] <= 0:
             failures.append("no retries recorded under chaos")
         if result["missing_event_reasons"]:
@@ -1313,9 +1347,17 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
                     for burst in range(6):
                         # a queue burst the saturation gauges must see:
                         # unknown keys reconcile to not-found immediately
-                        # but wait their turn behind the real key
+                        # but wait their turn behind the real key.  Depth is
+                        # sampled synchronously after the adds — the
+                        # workqueue's processing/dirty semantics mean a
+                        # re-added in-flight key no longer counts as
+                        # pending, so the transient is short
                         for j in range(10):
                             ctrl.enqueue(f"burst-{burst}-{j}")
+                        max_depth = max(max_depth, _gauge_value(
+                            metrics, "tpu_operator_controller_queue_depth",
+                            controller="clusterpolicy",
+                        ))
                         for i in range(0, n_nodes, 4):
                             node = f"tpu-{i // 4}-0"
                             value = round(rng.uniform(0.86, 0.98), 4)
@@ -1697,7 +1739,11 @@ def run_fleet_obs_soak(n_nodes: int = 100, seed: int = 1) -> dict:
 
 
 RECONCILE_TIERS = (10, 100, 500)
-RECONCILE_CONVERGE_TIMEOUT = 240.0
+RECONCILE_CONVERGE_TIMEOUT = 420.0
+# O(1) gate for the event-driven delta path: one injected node event may
+# cost at most this many API verbs to converge, at EVERY tier — a bound
+# that scales with fleet size is exactly the regression this pins against
+SINGLE_EVENT_VERB_BUDGET = 5
 _RECONCILE_CONCURRENCY_KNOBS = (
     "STATE_SYNC_CONCURRENCY", "APPLY_CONCURRENCY", "LIST_SWEEP_CONCURRENCY",
     "NODE_PATCH_CONCURRENCY", "DELETE_CONCURRENCY",
@@ -1711,30 +1757,40 @@ def _write_requests(fc) -> int:
     )
 
 
+def _peak_rss_mb() -> float:
+    """Process high-water RSS in MB (ru_maxrss is KB on Linux)."""
+    import resource
+
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
 async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
     """One control-plane tier: ``n_nodes`` TPU nodes join an empty fake
     cluster at once.
 
-    Measures the part of convergence the OPERATOR owns — wall time from the
-    join until reconcile passes reach their zero-write fixed point (all
-    labels patched, all operand objects applied, status asserted) — plus
-    steady-state passes/sec and apiserver verbs per steady-state pass.  The
-    kubelet sim is off: pod-readiness waves are hardware time the control
-    plane cannot accelerate, and racing them makes the number measure the
-    testbed's CPU scheduling instead of the pipeline (the north-star bench
-    keeps covering the full join→validated path).  Requests pay a 5ms
-    emulated RTT — a production apiserver's typical latency under load — so
+    ``cached=True`` runs the fleet-scale DELTA plane (ISSUE 10): informer
+    node events enqueue only the affected key onto hash-ring worker shards
+    (``controllers/plane.py``), per-node reconciles do bounded work through
+    the ``CachedReader``, and the clusterpolicy full walk runs only as the
+    resync safety net.  Measured per tier: converge-to-zero-write wall
+    time, steady-state verbs per full-resync pass (gated 0 with the fleet
+    aggregator live), the verb cost of ONE injected node event (gated
+    O(1) — ``SINGLE_EVENT_VERB_BUDGET`` — independent of fleet size), peak
+    RSS, and full-pass passes/sec.  Requests pay a 5ms emulated RTT so
     round-trip counts cost the wall time they cost outside an in-process
-    testbed.
+    testbed; the kubelet sim is off (pod-readiness waves are hardware time).
 
     ``cached=False`` is the pre-optimization baseline — live reads, serial
-    fan-outs, re-render every pass — so the cached run's improvement is
-    measured against the architecture it replaced, in the same process on
-    the same fake apiserver.
+    fan-outs, re-render every pass, full-state walks per event — so the
+    delta run's improvement is measured against the architecture it
+    replaced, in the same process on the same fake apiserver.
     """
     from tpu_operator import consts
     from tpu_operator.api.types import TPUClusterPolicy
     from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler, informer_specs
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.k8s import workqueue as wq
     from tpu_operator.k8s.client import ApiClient, Config
     from tpu_operator.k8s.informer import Informer
     from tpu_operator.obs.fleet import FleetAggregator
@@ -1758,14 +1814,33 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                 fleet = FleetAggregator() if cached else None
                 reconciler = ClusterPolicyReconciler(client, NS, fleet=fleet)
                 informers: list = []
+                plane = None
                 try:
                     if cached:
                         for group, kind, ns in informer_specs(NS):
                             inf = Informer(client, group, kind, namespace=ns)
                             reconciler.reader.add_informer(inf)
                             informers.append(inf)
+                            if (group, kind) == ("", "Node"):
+                                node_informer = inf
                         for inf in informers:
                             await inf.start()
+                        # the sharded delta plane, wired exactly like
+                        # ClusterPolicyReconciler.setup(mgr, plane=...)
+                        plane = NodePlane(
+                            NodeReconciler(reconciler.reader, NS),
+                            shards=consts.NODE_SHARDS,
+                            resync_seconds=0,  # resync driven explicitly below
+                        )
+
+                        async def on_node(event_type: str, obj: dict) -> None:
+                            plane.enqueue(
+                                obj["metadata"]["name"],
+                                priority=wq.PRIORITY_NORMAL,
+                            )
+
+                        node_informer.add_handler(on_node)
+                        await plane.start()
                     await client.create(TPUClusterPolicy.new().obj)
                     await reconciler.reconcile("cluster-policy")  # settle empty cluster
 
@@ -1780,16 +1855,30 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                         )
 
                     async def drive_to_fixed_point(settle: float) -> int:
-                        """Passes until two consecutive passes write nothing
+                        """Until two consecutive full passes write nothing
                         (the second absorbs a cache-lag echo of no-op
-                        writes); returns the final pass's request total."""
+                        writes) AND the delta plane is drained; returns the
+                        final pass's request total."""
                         zero_writes = 0
                         deadline = time.perf_counter() + RECONCILE_CONVERGE_TIMEOUT
                         while True:
+                            if plane is not None and not plane.quiesced():
+                                # let the shards drain before burning a
+                                # full safety-net pass on the same work
+                                if time.perf_counter() > deadline:
+                                    raise TimeoutError(
+                                        f"{n_nodes}-node tier: plane never drained"
+                                    )
+                                await asyncio.sleep(settle)
+                                fc.reset_request_counts()
+                                continue
                             fc.reset_request_counts()
                             await reconciler.reconcile("cluster-policy")
                             total = fc.total_requests()
-                            zero_writes = zero_writes + 1 if _write_requests(fc) == 0 else 0
+                            quiet = _write_requests(fc) == 0 and (
+                                plane is None or plane.quiesced()
+                            )
+                            zero_writes = zero_writes + 1 if quiet else 0
                             if zero_writes >= 2:
                                 return total
                             if time.perf_counter() > deadline:
@@ -1800,10 +1889,50 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                     await drive_to_fixed_point(settle=0.01)
                     converge_s = time.perf_counter() - t0
 
-                    # steady state: the fixed point's read-only pass
+                    # steady state: full-resync sweep (every node key LOW
+                    # through the shards + the safety-net full pass) at the
+                    # fixed point must cost ZERO verbs
                     fc.reset_request_counts()
+                    if plane is not None:
+                        plane.resync()
+                        deadline = time.perf_counter() + 60
+                        while not plane.quiesced():
+                            if time.perf_counter() > deadline:
+                                raise TimeoutError("steady resync never drained")
+                            await asyncio.sleep(0.01)
                     await reconciler.reconcile("cluster-policy")
                     steady_requests = fc.total_requests()
+
+                    # single injected node event: the O(1) acceptance gate.
+                    # Strip an operator-owned label out-of-band (no client
+                    # request) and count every verb the plane spends
+                    # restoring it — must stay under the budget at 10k
+                    # exactly as at 100.
+                    single_event_verbs = None
+                    if plane is not None:
+                        victim = "tpu-0-0"
+                        fc.store("", "nodes").patch(
+                            None, victim,
+                            {"metadata": {"labels": {consts.TPU_COUNT_LABEL: None}}},
+                        )
+                        # wait for the watch event to reach the plane
+                        deadline = time.perf_counter() + 30
+                        fc.reset_request_counts()
+                        healed = False
+                        while time.perf_counter() < deadline:
+                            await asyncio.sleep(0.02)
+                            if not plane.quiesced():
+                                continue
+                            labels = (
+                                fc.get_obj("", "Node", victim)["metadata"]
+                                .get("labels") or {}
+                            )
+                            if labels.get(consts.TPU_COUNT_LABEL):
+                                healed = True
+                                break
+                        single_event_verbs = fc.total_requests()
+                        if not healed:
+                            single_event_verbs = -1  # sentinel: never healed
 
                     t1 = time.perf_counter()
                     passes = 0
@@ -1816,7 +1945,15 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                         "converge_s": round(converge_s, 3),
                         "steady_requests_per_pass": steady_requests,
                         "steady_passes_per_sec": round(passes_per_sec, 2),
+                        "peak_rss_mb": _peak_rss_mb(),
                     }
+                    if plane is not None:
+                        out["single_event_verbs"] = single_event_verbs
+                        out["single_event_ok"] = (
+                            single_event_verbs is not None
+                            and 0 <= single_event_verbs <= SINGLE_EVENT_VERB_BUDGET
+                        )
+                        out["shards"] = len(plane.shard_ids)
                     if fleet is not None:
                         # proof the aggregator was live while the steady
                         # figure was measured, not a vacuous zero
@@ -1824,6 +1961,8 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
                         out["fleet_obs_zero_api"] = steady_requests == 0
                     return out
                 finally:
+                    if plane is not None:
+                        await plane.stop()
                     for inf in informers:
                         await inf.stop()
     finally:
@@ -1832,14 +1971,33 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
 
 
 def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
-    """Cached+concurrent reconcile pipeline across node tiers, plus the
-    serial+live baseline at the comparison tier (100 when present) so the
-    speedup/request ratios are measured, not asserted."""
+    """Delta-plane reconcile across node tiers (2k/5k/10k in the full
+    sweep), plus the serial+live full-walk baseline at the comparison tier
+    so the speedup/request ratios are measured, not asserted.
+
+    Gated per tier (exit-1 material, not just reported): zero-write fixed
+    point reached inside the timeout, steady-state verbs per full-resync
+    pass == 0 with the fleet aggregator live, and a single injected node
+    event costing <= SINGLE_EVENT_VERB_BUDGET verbs — the O(1) bound that
+    must hold at 10k exactly as at 100."""
     out: dict = {"tiers": {}}
     for n in tiers:
-        print(f"  reconcile bench: {n}-node tier (cached+concurrent)", file=sys.stderr)
-        out["tiers"][str(n)] = asyncio.run(_reconcile_tier(n, cached=True))
-    base_n = 100 if 100 in tiers else max(tiers)
+        print(f"  reconcile bench: {n}-node tier (delta plane, sharded)", file=sys.stderr)
+        tier = asyncio.run(_reconcile_tier(n, cached=True))
+        out["tiers"][str(n)] = tier
+        print(
+            f"  reconcile bench: {n}n converge {tier['converge_s']:.2f}s, "
+            f"steady verbs/pass {tier['steady_requests_per_pass']}, "
+            f"single-event verbs {tier.get('single_event_verbs')}, "
+            f"peak RSS {tier['peak_rss_mb']}MB",
+            file=sys.stderr,
+        )
+    # serial full-walk baseline: capped at 100 nodes — a serial live walk
+    # at the 2k+ tiers measures only the testbed's patience
+    base_n = 100 if (100 in tiers or min(tiers) > 100) else min(tiers)
+    if str(base_n) not in out["tiers"]:
+        print(f"  reconcile bench: {base_n}-node comparison tier (delta plane)", file=sys.stderr)
+        out["tiers"][str(base_n)] = asyncio.run(_reconcile_tier(base_n, cached=True))
     print(f"  reconcile bench: {base_n}-node tier (serial+live baseline)", file=sys.stderr)
     base = asyncio.run(_reconcile_tier(base_n, cached=False))
     cur = out["tiers"][str(base_n)]
@@ -1860,12 +2018,23 @@ def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
     out["fleet_obs_zero_api"] = all(
         t.get("fleet_obs_zero_api", True) for t in out["tiers"].values()
     )
+    failures = []
     if not out["fleet_obs_zero_api"]:
-        print(
-            "  reconcile bench FAILURE: fleet aggregation added steady-state "
-            "API verbs (want 0)",
-            file=sys.stderr,
-        )
+        failures.append("fleet aggregation added steady-state API verbs (want 0)")
+    for n, tier in out["tiers"].items():
+        if tier.get("steady_requests_per_pass") != 0:
+            failures.append(
+                f"{n}n steady verbs/pass = {tier.get('steady_requests_per_pass')} (want 0)"
+            )
+        if "single_event_ok" in tier and not tier["single_event_ok"]:
+            failures.append(
+                f"{n}n single-node-event verbs = {tier.get('single_event_verbs')} "
+                f"(budget {SINGLE_EVENT_VERB_BUDGET}; O(1) bound violated)"
+            )
+    for f in failures:
+        print(f"  reconcile bench FAILURE: {f}", file=sys.stderr)
+    out["failures"] = failures
+    out["gates_ok"] = not failures
     return out
 
 
@@ -1914,10 +2083,21 @@ def _bench_metrics(output: dict) -> dict:
     put("hbm_gbps", (detail.get("hbm") or {}).get("gbps"))
     put("train_tokens_per_sec", (detail.get("train") or {}).get("tokens_per_sec"))
     put("train_mfu", (detail.get("train") or {}).get("train_mfu"))
-    t100 = ((detail.get("reconcile") or {}).get("tiers") or {}).get("100") or {}
+    tiers = ((detail.get("reconcile") or {}).get("tiers") or {})
+    t100 = tiers.get("100") or {}
     put("reconcile_converge_100n_s", t100.get("converge_s"))
     put("reconcile_steady_requests_per_pass_100n", t100.get("steady_requests_per_pass"))
     put("reconcile_steady_passes_per_sec_100n", t100.get("steady_passes_per_sec"))
+    # delta-plane satellites: the O(1) single-event verb cost and peak RSS
+    # recorded per tier, keyed to the largest tier the round ran (the gate
+    # itself is per-tier; these rows make regressions visible round over
+    # round in the verdict output)
+    if tiers:
+        biggest = str(max(int(k) for k in tiers))
+        tb = tiers[biggest] or {}
+        put(f"reconcile_single_event_verbs_{biggest}n", tb.get("single_event_verbs"))
+        put(f"reconcile_peak_rss_mb_{biggest}n", tb.get("peak_rss_mb"))
+        put(f"reconcile_converge_{biggest}n_s", tb.get("converge_s"))
     return metrics
 
 
@@ -2255,7 +2435,7 @@ def main() -> None:
             "steady_request_ratio": rec["steady_request_ratio"],
             "detail": rec,
         }))
-        sys.exit(0 if rec["fleet_obs_zero_api"] else 1)
+        sys.exit(0 if rec["gates_ok"] else 1)
 
     result = asyncio.run(bench())
     value = result["join_to_validated_s"]

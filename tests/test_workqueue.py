@@ -1,0 +1,320 @@
+"""Workqueue framework + hash-ring sharding contract tests
+(docs/PERFORMANCE.md "Delta reconcile & sharding")."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tpu_operator.k8s import workqueue as wq
+from tpu_operator.k8s.sharding import HashRing
+from tpu_operator.metrics import OperatorMetrics
+
+pytestmark = pytest.mark.asyncio
+
+
+# ----------------------------------------------------------------------
+# dedup / coalescing
+
+
+async def test_burst_enqueue_coalesces_to_one_pending():
+    q = wq.WorkQueue("t")
+    for _ in range(100):
+        q.add("node-1")
+    assert len(q) == 1
+    assert await q.get() == "node-1"
+    q.done("node-1")
+    assert q.idle
+
+
+async def test_readd_during_processing_requeues_after_done():
+    q = wq.WorkQueue("t")
+    q.add("k")
+    key = await q.get()
+    # events arrive while the reconcile is in flight: they must coalesce
+    # into exactly ONE follow-up run, never a concurrent one
+    q.add("k")
+    q.add("k")
+    assert len(q) == 0  # deferred to the dirty set, not pending
+    q.done(key)
+    assert len(q) == 1
+    assert await q.get() == "k"
+    q.done("k")
+    assert q.idle
+
+
+async def test_coalesced_adds_counted():
+    metrics = OperatorMetrics()
+    q = wq.WorkQueue("t", metrics=metrics)
+    q.add("a")
+    q.add("a")
+    q.add("a")
+    assert (
+        metrics.workqueue_coalesced_total.labels(queue="t")._value.get() == 2
+    )
+
+
+# ----------------------------------------------------------------------
+# priority classes
+
+
+async def test_high_priority_preempts_backlog():
+    q = wq.WorkQueue("t")
+    for i in range(50):
+        q.add(f"sweep-{i}", priority=wq.PRIORITY_LOW)
+    q.add("delta", priority=wq.PRIORITY_NORMAL)
+    q.add("drain-me", priority=wq.PRIORITY_HIGH)
+    first = await q.get()
+    q.done(first)
+    second = await q.get()
+    q.done(second)
+    assert first == "drain-me"
+    assert second == "delta"
+
+
+async def test_pending_key_upgraded_in_place():
+    q = wq.WorkQueue("t")
+    for i in range(10):
+        q.add(f"sweep-{i}", priority=wq.PRIORITY_LOW)
+    q.add("node-x", priority=wq.PRIORITY_LOW)
+    assert len(q) == 11
+    # health evidence arrives: same key, stronger class — no duplicate entry
+    q.add("node-x", priority=wq.PRIORITY_HIGH)
+    assert len(q) == 11
+    assert await q.get() == "node-x"
+    q.done("node-x")
+
+
+async def test_depth_gauge_reports_per_priority():
+    metrics = OperatorMetrics()
+    q = wq.WorkQueue("t", metrics=metrics)
+    q.add("a", priority=wq.PRIORITY_HIGH)
+    q.add("b", priority=wq.PRIORITY_LOW)
+    q.add("c", priority=wq.PRIORITY_LOW)
+    assert metrics.workqueue_depth.labels(queue="t", priority="high")._value.get() == 1
+    assert metrics.workqueue_depth.labels(queue="t", priority="low")._value.get() == 2
+    assert metrics.controller_queue_depth.labels(controller="t")._value.get() == 3
+
+
+# ----------------------------------------------------------------------
+# fairness lanes
+
+
+async def test_fairness_across_two_policies():
+    q = wq.WorkQueue("t")
+    # policy-a storms 50 keys before policy-b's two arrive; round-robin
+    # across lanes must interleave b's keys instead of starving them
+    for i in range(50):
+        q.add(f"a-{i}", lane="policy-a")
+    q.add("b-0", lane="policy-b")
+    q.add("b-1", lane="policy-b")
+    order = []
+    for _ in range(6):
+        key = await q.get()
+        order.append(key)
+        q.done(key)
+    assert "b-0" in order[:3], order
+    assert "b-1" in order[:5], order
+
+
+async def test_single_lane_preserves_fifo():
+    q = wq.WorkQueue("t")
+    for i in range(5):
+        q.add(f"k{i}")
+    popped = []
+    for _ in range(5):
+        key = await q.get()
+        popped.append(key)
+        q.done(key)
+    assert popped == [f"k{i}" for i in range(5)]
+
+
+# ----------------------------------------------------------------------
+# backoff / scheduled requeue
+
+
+async def test_fail_backoff_grows_and_caps():
+    q = wq.WorkQueue("t", base=0.1, cap=0.5)
+    delays = []
+    for _ in range(5):
+        q.add("k")
+        key = await q.get()
+        delays.append(q.fail(key))
+        q.done(key)
+        # cancel the backoff timer: we only assert the schedule
+        q._timers.pop("k", None) and None
+        for t in list(q._timers.values()):
+            t.cancel()
+        q._timers.clear()
+    assert delays == [0.1, 0.2, 0.4, 0.5, 0.5]
+    q.forget("k")
+    q.add("k")
+    key = await q.get()
+    assert q.fail(key) == 0.1  # streak reset
+
+
+async def test_fail_schedules_requeue_and_immediate_add_wins():
+    q = wq.WorkQueue("t", base=5.0, cap=5.0)
+    q.add("k")
+    key = await q.get()
+    q.fail(key)  # scheduled 5s out
+    q.done(key)
+    assert len(q) == 0
+    q.add("k")  # fresh event: immediate add must beat the backoff timer
+    assert len(q) == 1
+    assert await q.get() == "k"
+    q.done("k")
+    assert not q._timers  # the immediate add cancelled the backoff timer
+
+
+async def test_add_after_earlier_timer_wins():
+    q = wq.WorkQueue("t")
+    q.add_after("k", 5.0)
+    q.add_after("k", 0.01)
+    await asyncio.sleep(0.05)
+    assert len(q) == 1
+    q.add_after("k2", 0.01)
+    q.add_after("k2", 5.0)  # later timer must NOT replace the earlier one
+    await asyncio.sleep(0.05)
+    assert len(q) == 2
+
+
+async def test_retries_total_counted():
+    metrics = OperatorMetrics()
+    q = wq.WorkQueue("t", metrics=metrics)
+    q.add("k")
+    key = await q.get()
+    q.fail(key)
+    q.done(key)
+    assert metrics.workqueue_retries_total.labels(queue="t")._value.get() == 1
+
+
+# ----------------------------------------------------------------------
+# shutdown drains cleanly
+
+
+async def test_shutdown_drains_then_raises():
+    q = wq.WorkQueue("t")
+    for i in range(3):
+        q.add(f"k{i}")
+    q.shut_down()
+    drained = []
+    for _ in range(3):
+        key = await q.get()
+        drained.append(key)
+        q.done(key)
+    assert drained == ["k0", "k1", "k2"]
+    with pytest.raises(wq.ShutDown):
+        await q.get()
+    q.add("late")  # dropped, not queued
+    assert len(q) == 0
+
+
+async def test_shutdown_wakes_blocked_getter():
+    q = wq.WorkQueue("t")
+
+    async def getter():
+        with pytest.raises(wq.ShutDown):
+            await q.get()
+
+    task = asyncio.create_task(getter())
+    await asyncio.sleep(0.01)
+    q.shut_down()
+    await asyncio.wait_for(task, timeout=1)
+
+
+async def test_shutdown_cancels_scheduled_timers():
+    q = wq.WorkQueue("t")
+    q.add_after("k", 0.01)
+    q.shut_down()
+    await asyncio.sleep(0.05)
+    assert len(q) == 0
+
+
+# ----------------------------------------------------------------------
+# controller integration: scheduled requeue replaces sleep loops
+
+
+async def test_controller_scheduled_requeue_is_cancellable():
+    from tpu_operator.controllers.runtime import Controller
+
+    runs = []
+
+    async def tick(key: str):
+        runs.append(key)
+        return 0.01  # periodic: re-runs itself via the workqueue
+
+    ctrl = Controller("periodic", tick)
+    await ctrl.start()
+    ctrl.enqueue("loop")
+    await asyncio.sleep(0.2)
+    await ctrl.stop()
+    n = len(runs)
+    assert n >= 3  # the cadence ran
+    await asyncio.sleep(0.1)
+    assert len(runs) == n  # and stop() actually cancelled it
+
+
+async def test_controller_priority_enqueue_orders_work():
+    from tpu_operator.controllers.runtime import Controller
+
+    seen = []
+    release = asyncio.Event()
+
+    async def reconcile(key: str):
+        if key == "first":
+            await release.wait()
+        seen.append(key)
+        return None
+
+    ctrl = Controller("t", reconcile)
+    await ctrl.start()
+    ctrl.enqueue("first")  # occupies the worker until released
+    await asyncio.sleep(0.02)
+    for i in range(5):
+        ctrl.enqueue(f"bulk-{i}", priority=wq.PRIORITY_LOW)
+    ctrl.enqueue("urgent", priority=wq.PRIORITY_HIGH)
+    release.set()
+    await asyncio.sleep(0.1)
+    await ctrl.stop()
+    assert seen[0] == "first"
+    assert seen[1] == "urgent"
+
+
+# ----------------------------------------------------------------------
+# hash ring
+
+
+def test_ring_assignment_is_stable():
+    ring = HashRing([f"s{i}" for i in range(4)])
+    owners = {f"node-{i}": ring.owner(f"node-{i}") for i in range(200)}
+    ring2 = HashRing([f"s{i}" for i in range(4)])
+    assert owners == {k: ring2.owner(k) for k in owners}
+
+
+def test_ring_spreads_keys():
+    ring = HashRing([f"s{i}" for i in range(4)])
+    counts: dict[str, int] = {}
+    for i in range(1000):
+        counts[ring.owner(f"node-{i}")] = counts.get(ring.owner(f"node-{i}"), 0) + 1
+    assert len(counts) == 4
+    assert min(counts.values()) > 100  # no shard starved
+
+
+def test_ring_removal_moves_only_the_lost_shards_keys():
+    ring = HashRing([f"s{i}" for i in range(4)])
+    before = {f"node-{i}": ring.owner(f"node-{i}") for i in range(500)}
+    ring.remove("s2")
+    moved = 0
+    for key, owner in before.items():
+        now = ring.owner(key)
+        if owner == "s2":
+            assert now != "s2"
+        elif now != owner:
+            moved += 1
+    assert moved == 0  # consistent hashing: surviving shards keep their keys
+
+
+def test_ring_empty_owner_none():
+    assert HashRing().owner("k") is None

@@ -122,7 +122,19 @@ async def run(args: argparse.Namespace) -> None:
     reconciler = ClusterPolicyReconciler(
         client, namespace, fleet=fleet, explain=explain, **obs
     )
-    reconciler.setup(mgr)
+    # fleet-scale delta plane: per-node work hash-ring sharded across
+    # in-process workers, node events enqueue only the affected key, and
+    # the full-walk policy pass becomes the slow resync safety net
+    # (docs/PERFORMANCE.md "Delta reconcile & sharding")
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+
+    plane = NodePlane(
+        NodeReconciler(reconciler.reader, namespace, metrics=metrics),
+        metrics=metrics,
+    )
+    plane.setup(mgr)
+    reconciler.setup(mgr, plane=plane)
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)
     RemediationReconciler(client, namespace, **obs).setup(mgr)

@@ -280,6 +280,20 @@ VALIDATOR_SLEEP_SECONDS = 5.0        # validator/main.go:133-134
 VALIDATOR_WORKLOAD_RETRIES = 60      # :167-170
 VALIDATOR_RESOURCE_RETRIES = 30      # :171-174
 
+# Fleet-scale reconcile plane (k8s/workqueue.py, k8s/sharding.py,
+# controllers/plane.py; docs/PERFORMANCE.md "Delta reconcile & sharding").
+# LIST chunk size for informer relists: a 10k-node relist streams in pages
+# instead of materializing one giant response on the apiserver.
+LIST_PAGE_SIZE = 500
+# in-process worker shards the per-node delta work is consistently hashed
+# across; each shard serializes its keys (a node never reconciles
+# concurrently with itself) while distinct nodes fan out
+NODE_SHARDS = 4
+# periodic full-resync safety net: every known node re-enqueued at LOW
+# priority so drift the watch missed converges without a full-state walk
+# in the hot path
+NODE_RESYNC_SECONDS = 300.0
+
 # API-request resilience envelope (k8s/retry.py; docs/ROBUSTNESS.md).  The
 # per-try timeout is the hung-connection bound — before it existed a stalled
 # apiserver socket parked a reconcile pass on aiohttp's 5-minute default.

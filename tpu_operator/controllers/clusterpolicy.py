@@ -321,7 +321,14 @@ class ClusterPolicyReconciler:
     # ------------------------------------------------------------------
     # Watch wiring (SetupWithManager analogue).
 
-    def setup(self, mgr: Manager) -> Controller:
+    def setup(self, mgr: Manager, plane=None) -> Controller:
+        """``plane`` (a :class:`~tpu_operator.controllers.plane.NodePlane`)
+        switches node-event handling to the event-driven delta path: a node
+        event enqueues only that node's key on its hash-ring shard, and the
+        full-walk policy reconcile becomes the safety net (fleet-size
+        transitions + the plane's slow periodic resync) instead of running
+        per node event.  Without a plane the historical full-walk wiring is
+        unchanged."""
         if mgr.operator_metrics is None:
             # breaker-state gauge + degraded-mode counter for the supervisor
             mgr.operator_metrics = self.metrics
@@ -342,7 +349,11 @@ class ClusterPolicyReconciler:
             self.explain = mgr.explain
         if self.explain is not None and self.recorder.sink is None:
             self.recorder.sink = self.explain.observe_event
-        controller = mgr.add_controller(Controller("clusterpolicy", self.reconcile))
+        # fairness lane per policy: one storming CR cannot starve another's
+        # reconciles when queues are shared (the key IS the policy name)
+        controller = mgr.add_controller(
+            Controller("clusterpolicy", self.reconcile, fairness=lambda key: key)
+        )
 
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")
@@ -373,9 +384,30 @@ class ClusterPolicyReconciler:
                 k.startswith("tpu.google.com/") or k.startswith("cloud.google.com/gke-tpu")
                 for k in (deep_get(obj, "metadata", "labels", default={}) or {})
             )
-            if event_type == "DELETED" or relevant:
-                for p in policies.items():
-                    controller.enqueue(p["metadata"]["name"])
+            if not (event_type == "DELETED" or relevant):
+                return
+            if plane is not None:
+                # delta path: only the affected node's key is enqueued —
+                # health-relevant events (agent verdict, NotReady) ride the
+                # HIGH class so they preempt a queued resync sweep
+                from tpu_operator.k8s import workqueue as wq
+
+                node_labels = deep_get(obj, "metadata", "labels", default={}) or {}
+                unhealthy = (
+                    node_labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY
+                )
+                plane.enqueue(
+                    obj["metadata"]["name"],
+                    priority=wq.PRIORITY_HIGH if unhealthy else wq.PRIORITY_NORMAL,
+                )
+                if event_type in ("ADDED", "DELETED"):
+                    # fleet-size change: the full pass owns node count,
+                    # operand scaling, and fleet evidence
+                    for p in policies.items():
+                        controller.enqueue(p["metadata"]["name"])
+                return
+            for p in policies.items():
+                controller.enqueue(p["metadata"]["name"])
 
         async def on_daemonset(event_type: str, obj: dict) -> None:
             for ref in deep_get(obj, "metadata", "ownerReferences", default=[]) or []:
@@ -385,4 +417,13 @@ class ClusterPolicyReconciler:
         policies.add_handler(on_policy)
         nodes.add_handler(on_node)
         daemonsets.add_handler(on_daemonset)
+        if plane is not None:
+            # the plane's slow resync sweep also kicks the full-walk safety
+            # net, so both layers converge drift the watch stream missed
+            plane.resync_hooks.append(
+                lambda: [
+                    controller.enqueue(p["metadata"]["name"])
+                    for p in policies.items()
+                ]
+            )
         return controller

@@ -76,6 +76,7 @@ from tpu_operator.controllers.upgrade import (
     VALIDATOR_POD_SELECTOR,
 )
 from tpu_operator.k8s import nodeinfo
+from tpu_operator.k8s import workqueue as wq
 from tpu_operator.k8s.cache import CachedReader
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
@@ -808,7 +809,11 @@ class HealthReconciler:
             # central-signal hookup without explicit plumbing: whatever
             # aggregator the manager ended up with feeds the hysteresis
             self.fleet = mgr.fleet
-        controller = mgr.add_controller(Controller("health", self.reconcile))
+        # HIGH priority class: when queues are shared, detection/actuation
+        # keys preempt bulk label sweeps (k8s/workqueue.py)
+        controller = mgr.add_controller(
+            Controller("health", self.reconcile, priority=wq.PRIORITY_HIGH)
+        )
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")
         # optional (cache-backing only): an unsynced Pod informer must not

@@ -1,0 +1,215 @@
+"""Per-node delta reconciler — bounded work for ONE node.
+
+The event-driven half of the fleet-scale reconcile plane
+(docs/PERFORMANCE.md "Delta reconcile & sharding"): where the clusterpolicy
+full pass walks every node each time anything changes, this reconciler is
+handed a single node key by an informer event (via the sharded
+``controllers/plane.py``) and does only that node's work:
+
+- the node's own label reconciliation (identity, deploy gates, workload
+  config) — the per-node unit of ``labels.label_tpu_nodes``;
+- the node's slice group's pooled readiness — membership tracked in an
+  in-memory index so the group sweep touches ``O(slice)`` nodes, never the
+  fleet.
+
+All reads ride the PR-3 ``CachedReader`` (informer stores), so a steady
+state reconcile costs zero API verbs and a changed node costs O(1) patches
+regardless of fleet size.  The clusterpolicy full walk remains the slow
+periodic resync safety net for drift the watch stream missed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.controllers import clusterinfo, labels
+from tpu_operator.controllers.clusterinfo import is_tpu_node
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiError
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.nodes")
+
+
+class NodeReconciler:
+    """Delta reconcile for one node key (plus its slice group)."""
+
+    def __init__(self, reader: CachedReader, namespace: str, metrics=None):
+        self.reader = reader
+        self.namespace = namespace
+        self.metrics = metrics
+        # slice-group membership index: group key -> node names, maintained
+        # from the nodes this reconciler has seen (informer events replay
+        # the full fleet on start, and the periodic resync re-asserts it)
+        self._groups: dict[str, set[str]] = {}
+        self._node_group: dict[str, str] = {}
+        # EVERY node ever seen alive — single-host nodes carry no slice
+        # group but the resync sweep must still revisit them
+        self._known: set[str] = set()
+        # pool-identity fingerprint per node (is-TPU, accelerator,
+        # topology, nodepool, workload config): when it CHANGES on a live
+        # node the full policy pass owns the consequences (per-pool operand
+        # rendering, node counts), so the plane kicks it via this hook —
+        # a MODIFIED event can flip identity without an ADD/DELETE
+        self._identity: dict[str, tuple] = {}
+        self.on_identity_change: Optional[Callable[[], None]] = None
+
+    # ------------------------------------------------------------------
+    def tracked(self) -> list[str]:
+        """Every node name seen alive (resync seeding) — grouped or not."""
+        return list(self._known)
+
+    async def prime(self) -> None:
+        """Seed the slice-group index from one (cached) fleet listing so a
+        freshly-started plane computes group readiness against full
+        membership instead of rediscovering it event by event.  This is a
+        full-resync entry point (check_delta_paths allowlist), called once
+        at plane start — never from the per-key path."""
+        for node in await self.reader.list_items("", "Node"):
+            self._index(node["metadata"]["name"], node)
+
+    @staticmethod
+    def _identity_of(node: dict) -> tuple:
+        node_labels = deep_get(node, "metadata", "labels", default={}) or {}
+        return (
+            is_tpu_node(node),
+            node_labels.get(consts.GKE_TPU_ACCELERATOR_LABEL),
+            node_labels.get(consts.GKE_TPU_TOPOLOGY_LABEL),
+            node_labels.get(consts.GKE_NODEPOOL_LABEL),
+            node_labels.get(consts.TPU_WORKLOAD_CONFIG_LABEL),
+        )
+
+    def _index(self, name: str, node: Optional[dict]) -> set[str]:
+        """Update the membership index for ``name``; returns the group keys
+        whose readiness may have changed (old and/or new group).  Fires
+        ``on_identity_change`` when a LIVE node's pool identity flipped —
+        the full policy pass, not this delta path, owns that fallout."""
+        if node is None:
+            self._known.discard(name)
+            self._identity.pop(name, None)
+        else:
+            self._known.add(name)
+            identity = self._identity_of(node)
+            prev = self._identity.get(name)
+            self._identity[name] = identity
+            if (
+                prev is not None and prev != identity
+                and self.on_identity_change is not None
+            ):
+                self.on_identity_change()
+        new_group = (
+            labels.slice_group_key(node)
+            if node is not None and is_tpu_node(node)
+            else None
+        )
+        old_group = self._node_group.get(name)
+        affected: set[str] = set()
+        if old_group is not None and old_group != new_group:
+            members = self._groups.get(old_group)
+            if members is not None:
+                members.discard(name)
+                if not members:
+                    del self._groups[old_group]
+            affected.add(old_group)
+        if new_group is not None:
+            self._groups.setdefault(new_group, set()).add(name)
+            self._node_group[name] = new_group
+            affected.add(new_group)
+        elif name in self._node_group and new_group is None:
+            del self._node_group[name]
+        return affected
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, name: str) -> Optional[float]:
+        """Bounded delta pass for one node: O(1) reads via the cache, at
+        most one label patch for the node plus the slice-ready patches its
+        group transition requires (O(slice), not O(fleet))."""
+        policy_obj = await clusterinfo.active_cluster_policy(self.reader)
+        if policy_obj is None:
+            # no active policy: node labels are unmanaged, exactly like the
+            # full walk (which only runs inside a policy reconcile)
+            return None
+        from tpu_operator.api.types import TPUClusterPolicy
+
+        spec = TPUClusterPolicy(policy_obj).spec
+
+        try:
+            node = await self.reader.get("", "Node", name)
+        except ApiError as e:
+            if not e.not_found:
+                raise
+            node = None
+
+        affected_groups = self._index(name, node)
+        if node is not None:
+            await self._sync_node_labels(node, spec)
+        # worklist: a sweep can discover a member that moved groups, whose
+        # NEW group then needs its own readiness recomputed
+        done: set[str] = set()
+        while affected_groups:
+            group = affected_groups.pop()
+            if group in done:
+                continue
+            done.add(group)
+            affected_groups |= await self._sync_group(group) - done
+        return None
+
+    async def _sync_node_labels(self, node: dict, spec) -> None:
+        desired = labels.desired_node_labels(node, spec)
+        current = deep_get(node, "metadata", "labels", default={}) or {}
+        patch_labels = {}
+        for key, value in desired.items():
+            if value is None and key in current:
+                patch_labels[key] = None
+            elif value is not None and current.get(key) != value:
+                patch_labels[key] = value
+        if patch_labels:
+            name = node["metadata"]["name"]
+            await self.reader.patch(
+                "", "Node", name, {"metadata": {"labels": patch_labels}}
+            )
+            log.info("delta-labelled node %s: %s", name, patch_labels)
+
+    async def _sync_group(self, group: str) -> set[str]:
+        """Pooled slice readiness for ONE group (the per-group unit of
+        ``labels.label_slice_readiness``): every host must advertise
+        google.com/tpu before any host gets slice.ready=true.  Returns any
+        OTHER groups whose membership this sweep discovered changed (a
+        member moved pools) so the caller can recompute them too."""
+        members: list[dict] = []
+        spilled: set[str] = set()
+        for member_name in sorted(self._groups.get(group, ())):
+            try:
+                member = await self.reader.get("", "Node", member_name)
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                self._index(member_name, None)
+                continue
+            # keep the index honest: a member whose labels moved it out of
+            # this group re-indexes (and its new group needs a recompute)
+            if (
+                not is_tpu_node(member)
+                or labels.slice_group_key(member) != group
+            ):
+                spilled |= self._index(member_name, member) - {group}
+                continue
+            members.append(member)
+        if not members:
+            return spilled
+        expected = max(nodeinfo.slice_hosts(m) for m in members)
+        ready = len(members) >= max(1, expected) and all(
+            labels.node_advertises_tpu(m) for m in members
+        )
+        value = "true" if ready else "false"
+        for member in members:
+            current = deep_get(member, "metadata", "labels", default={}) or {}
+            if current.get(consts.SLICE_READY_LABEL) != value:
+                await self.reader.patch(
+                    "", "Node", member["metadata"]["name"],
+                    {"metadata": {"labels": {consts.SLICE_READY_LABEL: value}}},
+                )
+        return spilled

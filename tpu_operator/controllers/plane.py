@@ -1,0 +1,197 @@
+"""Hash-ring sharded delta reconcile plane for per-node work.
+
+The third layer of the fleet-scale reconcile architecture
+(docs/PERFORMANCE.md "Delta reconcile & sharding"): informer events enqueue
+only the affected node key; the key is consistently hashed onto one of N
+in-process worker shards (``k8s/sharding.py``), each a ``Controller`` on
+its own priority/fairness ``WorkQueue``.  One key always lands on one
+shard, so a node never reconciles concurrently with itself, while distinct
+nodes fan out across workers.
+
+Shard fences generalize the PR-4 leader ``WriteFence``: every shard
+reconcile runs under an ambient per-request fence that re-checks ring
+ownership live, so a handoff mid-reconcile refuses the old owner's next
+write instead of double-actuating (``client.request_fence``).  A key popped
+by a shard the ring no longer assigns it to is silently re-routed to the
+current owner.
+
+A slow periodic resync (LOW priority, so real events preempt it) re-enqueues
+every known node and kicks the registered full-pass hooks — the safety net
+for drift the watch stream missed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.controllers.nodes import NodeReconciler
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s import client as client_api
+from tpu_operator.k8s import retry as retry_api
+from tpu_operator.k8s import workqueue as wq
+from tpu_operator.k8s.sharding import HashRing
+
+log = logging.getLogger("tpu_operator.plane")
+
+RESYNC_KEY = "node-resync"
+
+
+class NodePlane:
+    """N shard controllers + a hash ring + the periodic resync driver."""
+
+    def __init__(
+        self,
+        reconciler: NodeReconciler,
+        metrics=None,
+        shards: int = consts.NODE_SHARDS,
+        resync_seconds: float = consts.NODE_RESYNC_SECONDS,
+    ):
+        self.reconciler = reconciler
+        self.metrics = metrics
+        self.resync_seconds = resync_seconds
+        self.shard_ids = [f"node-shard-{i}" for i in range(max(1, shards))]
+        self.ring = HashRing(self.shard_ids)
+        self.controllers: dict[str, Controller] = {
+            sid: Controller(sid, self._shard_reconcile(sid), metrics=metrics)
+            for sid in self.shard_ids
+        }
+        # resync runs as a scheduled-requeue controller on the same
+        # framework — cancellable and saturation-instrumented, never a
+        # hand-rolled sleep loop
+        self.resync_controller = Controller(
+            "node-resync", self._resync, metrics=metrics,
+            priority=wq.PRIORITY_LOW,
+        )
+        # full-pass hooks the resync sweep kicks (the clusterpolicy safety
+        # net registers one per policy)
+        self.resync_hooks: list[Callable[[], None]] = []
+        # a MODIFIED node event can flip pool identity (accelerator /
+        # topology / nodepool / workload-config label change) without an
+        # ADD/DELETE — the delta path can't own that fallout (per-pool
+        # operand rendering), so the reconciler reports it and the full
+        # pass is kicked immediately instead of waiting for the resync
+        if getattr(reconciler, "on_identity_change", "absent") is None:
+            reconciler.on_identity_change = self._kick_full_pass
+        self._started = False
+
+    def _kick_full_pass(self) -> None:
+        for hook in self.resync_hooks:
+            hook()
+
+    # ------------------------------------------------------------------
+    def enqueue(self, key: str, priority: int = wq.PRIORITY_NORMAL) -> None:
+        """Route a node key to its owning shard's queue."""
+        owner = self.ring.owner(key)
+        if owner is None:
+            return
+        self.controllers[owner].enqueue(key, priority=priority)
+
+    def resync(self) -> None:
+        """Re-enqueue every known node at LOW priority (event-driven keys
+        preempt the sweep) and kick the registered full-pass hooks."""
+        for name in self.reconciler.tracked():
+            self.enqueue(name, priority=wq.PRIORITY_LOW)
+        for hook in self.resync_hooks:
+            hook()
+
+    async def _resync(self, key: str) -> Optional[float]:
+        self.resync()
+        return self.resync_seconds if self.resync_seconds > 0 else None
+
+    def quiesced(self) -> bool:
+        """True when every shard queue is empty with no reconcile in
+        flight (backoff/resync timers excluded — they are future work)."""
+        return all(c.queue.idle for c in self.controllers.values())
+
+    # ------------------------------------------------------------------
+    def _shard_reconcile(self, shard_id: str):
+        async def run(key: str) -> Optional[float]:
+            # the class the key was popped at, preserved across any
+            # re-route: a HIGH health key must not demote to NORMAL just
+            # because a handoff moved it mid-rebalance
+            popped_priority = (
+                self.controllers[shard_id].queue.processing_priority(key)
+            )
+            if popped_priority is None:
+                popped_priority = wq.PRIORITY_NORMAL
+            owner = self.ring.owner(key)
+            if owner != shard_id:
+                # handed off while queued: the current owner picks it up;
+                # this shard never touches the key's state
+                if owner is not None:
+                    self.controllers[owner].enqueue(key, priority=popped_priority)
+                return None
+            if self.metrics is not None:
+                self.metrics.shard_reconciles_total.labels(shard=shard_id).inc()
+            fence = retry_api.WriteFence(
+                lambda: self.ring.owner(key) == shard_id
+            )
+            try:
+                with client_api.request_fence(fence):
+                    return await self.reconciler.reconcile(key)
+            except retry_api.FencedError:
+                # ring moved mid-reconcile: the fence refused the write the
+                # old owner was about to issue — hand the key to the new
+                # owner, which re-reads state and finishes the job exactly
+                # once
+                if self.metrics is not None:
+                    self.metrics.shard_fence_rejections_total.inc()
+                new_owner = self.ring.owner(key)
+                if new_owner is not None and new_owner != shard_id:
+                    self.controllers[new_owner].enqueue(
+                        key, priority=popped_priority
+                    )
+                return None
+        return run
+
+    # ------------------------------------------------------------------
+    # Handoff / rebalance: ring membership changes re-route moved keys at
+    # pop time (the ownership check above) and fence in-flight writes; a
+    # removed shard's worker keeps draining its queue by re-routing.
+
+    def remove_shard(self, shard_id: str) -> None:
+        self.ring.remove(shard_id)
+        self._count_handoff()
+        log.info("shard %s removed from ring (%d remain)", shard_id, len(self.ring))
+
+    def add_shard(self, shard_id: str) -> None:
+        if shard_id not in self.controllers:
+            raise ValueError(f"unknown shard {shard_id}")
+        self.ring.add(shard_id)
+        self._count_handoff()
+        log.info("shard %s re-added to ring (%d total)", shard_id, len(self.ring))
+
+    def _count_handoff(self) -> None:
+        if self.metrics is not None:
+            self.metrics.shard_handoffs_total.inc()
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> "NodePlane":
+        """Register the shard + resync controllers with a Manager (they
+        inherit the degraded-mode gate, suspend/resume, and metrics
+        stamping) and prime the resync cycle."""
+        for controller in self.controllers.values():
+            mgr.add_controller(controller)
+        mgr.add_controller(self.resync_controller)
+        if self.resync_seconds > 0:
+            self.resync_controller.enqueue(RESYNC_KEY)
+        self._started = True
+        return self
+
+    async def start(self) -> None:
+        """Standalone start (no Manager): bench/test harnesses."""
+        await self.reconciler.prime()
+        for controller in self.controllers.values():
+            await controller.start()
+        await self.resync_controller.start()
+        if self.resync_seconds > 0:
+            self.resync_controller.enqueue(RESYNC_KEY)
+        self._started = True
+
+    async def stop(self) -> None:
+        for controller in self.controllers.values():
+            await controller.stop()
+        await self.resync_controller.stop()
+        self._started = False

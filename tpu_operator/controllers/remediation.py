@@ -50,6 +50,7 @@ from tpu_operator.controllers.upgrade import (
     NON_TERMINAL_STATES as UPGRADE_NON_TERMINAL,
     VALIDATOR_POD_SELECTOR,
 )
+from tpu_operator.k8s import workqueue as wq
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.obs import events as obs_events
@@ -359,7 +360,11 @@ class RemediationReconciler:
 
     # ------------------------------------------------------------------
     def setup(self, mgr: Manager) -> Controller:
-        controller = mgr.add_controller(Controller("remediation", self.reconcile))
+        # HIGH priority class: remediation actuation preempts bulk sweeps
+        # on shared queues (k8s/workqueue.py)
+        controller = mgr.add_controller(
+            Controller("remediation", self.reconcile, priority=wq.PRIORITY_HIGH)
+        )
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")
 

@@ -19,6 +19,7 @@ from aiohttp import web
 
 from tpu_operator import consts
 from tpu_operator.k8s import retry as retry_api
+from tpu_operator.k8s import workqueue as wq
 from tpu_operator.k8s.client import ApiClient
 from tpu_operator.k8s.informer import Informer
 from tpu_operator.k8s.leader import LeaderElector
@@ -29,28 +30,6 @@ log = logging.getLogger("tpu_operator.controllers")
 # reconcile(key) returns the requeue delay in seconds, or None for "done".
 ReconcileFn = Callable[[str], Awaitable[Optional[float]]]
 
-
-class RateLimiter:
-    """Per-key exponential backoff (workqueue.DefaultItemBasedRateLimiter)."""
-
-    def __init__(
-        self,
-        base: float = consts.RATE_LIMIT_BASE_SECONDS,
-        cap: float = consts.RATE_LIMIT_MAX_SECONDS,
-    ):
-        self.base = base
-        self.cap = cap
-        self.failures: dict[str, int] = {}
-
-    def when(self, key: str) -> float:
-        n = self.failures.get(key, 0)
-        self.failures[key] = n + 1
-        return min(self.base * (2**n), self.cap)
-
-    def forget(self, key: str) -> None:
-        self.failures.pop(key, None)
-
-
 # busy-fraction EWMA weight: one loop iteration (wait + work) contributes
 # this much; ~0.2 settles in a handful of iterations without jittering on
 # a single slow pass
@@ -58,26 +37,41 @@ _BUSY_EWMA_ALPHA = 0.2
 
 
 class Controller:
-    """One reconcile loop fed by a deduplicating delayed workqueue.
+    """One reconcile worker fed by a shared-framework workqueue
+    (``k8s/workqueue.py``: dedup/coalescing, priority classes, fairness
+    lanes, per-item rate-limited requeue, scheduled requeue).
 
     Saturation-instrumented (the controller-runtime workqueue metrics
     analogue, docs/OBSERVABILITY.md "Fleet telemetry & SLOs"): queue depth,
     enqueue→pop wait latency, requeue counts by reason, and an EWMA
-    worker busy fraction — the per-controller signals reconcile-plane
-    sharding will balance on.  ``metrics`` is stamped by the Manager
+    worker busy fraction — the per-controller signals the sharded reconcile
+    plane balances on.  ``metrics`` is stamped by the Manager
     (``add_controller``/``start``); a standalone controller just skips the
     bookkeeping.
+
+    ``priority`` is the class this controller's plain ``enqueue`` uses
+    (health/remediation pass :data:`~tpu_operator.k8s.workqueue.PRIORITY_HIGH`
+    so their keys preempt bulk sweeps when a queue is shared);
+    ``fairness`` optionally maps a key to its fairness lane (e.g. the
+    owning policy) so one storming source cannot starve the rest.
     """
 
-    def __init__(self, name: str, reconcile: ReconcileFn, metrics=None):
+    def __init__(
+        self,
+        name: str,
+        reconcile: ReconcileFn,
+        metrics=None,
+        priority: int = wq.PRIORITY_NORMAL,
+        fairness: Optional[Callable[[str], str]] = None,
+        queue: Optional[wq.WorkQueue] = None,
+    ):
         self.name = name
         self.reconcile = reconcile
-        self.limiter = RateLimiter()
-        self.metrics = metrics
-        self._queue: asyncio.Queue[str] = asyncio.Queue()
-        self._pending: set[str] = set()  # dedupe: keys queued but not yet popped
-        self._enqueued_ts: dict[str, float] = {}  # key -> monotonic enqueue time
-        self._timers: dict[str, asyncio.TimerHandle] = {}
+        self.priority = priority
+        self.fairness = fairness
+        self.queue = queue if queue is not None else wq.WorkQueue(name=name, metrics=metrics)
+        if queue is not None and metrics is not None:
+            self.queue.metrics = metrics
         self._task: Optional[asyncio.Task] = None
         self._busy_fraction = 0.0
         # run-permission gate installed by the manager: cleared while the
@@ -85,19 +79,37 @@ class Controller:
         # None (standalone controller) means always-run
         self.gate: Optional[asyncio.Event] = None
 
-    def enqueue(self, key: str) -> None:
-        if key in self._pending:
-            return
-        self._pending.add(key)
-        self._enqueued_ts[key] = time.monotonic()
-        self._queue.put_nowait(key)
-        self._report_depth()
+    # metrics flow through to the queue (the Manager stamps controllers
+    # after construction, and the queue owns the depth/latency gauges)
+    @property
+    def metrics(self):
+        return self.queue.metrics
 
-    def _report_depth(self) -> None:
-        if self.metrics is not None:
-            self.metrics.controller_queue_depth.labels(
-                controller=self.name
-            ).set(len(self._pending))
+    @metrics.setter
+    def metrics(self, value) -> None:
+        self.queue.metrics = value
+
+    def _lane(self, key: str) -> str:
+        return self.fairness(key) if self.fairness is not None else wq.DEFAULT_LANE
+
+    def enqueue(self, key: str, priority: Optional[int] = None) -> None:
+        self.queue.add(
+            key,
+            priority=self.priority if priority is None else priority,
+            lane=self._lane(key),
+        )
+
+    def enqueue_after(
+        self, key: str, delay: float, priority: Optional[int] = None
+    ) -> None:
+        """Delayed add via the workqueue's scheduled-requeue API; an earlier
+        timer for the same key wins (AddAfter semantics)."""
+        self.queue.add_after(
+            key,
+            delay,
+            priority=self.priority if priority is None else priority,
+            lane=self._lane(key),
+        )
 
     def _count_requeue(self, reason: str) -> None:
         if self.metrics is not None:
@@ -118,25 +130,6 @@ class Controller:
                 controller=self.name
             ).set(round(self._busy_fraction, 4))
 
-    def enqueue_after(self, key: str, delay: float) -> None:
-        """Delayed add; an earlier timer for the same key is replaced only if
-        the new one fires sooner (mirrors workqueue.AddAfter semantics
-        closely enough for requeue use)."""
-        if delay <= 0:
-            self.enqueue(key)
-            return
-        loop = asyncio.get_running_loop()
-        existing = self._timers.get(key)
-        if existing is not None:
-            if existing.when() - loop.time() <= delay:
-                return
-            existing.cancel()
-        self._timers[key] = loop.call_later(delay, self._fire, key)
-
-    def _fire(self, key: str) -> None:
-        self._timers.pop(key, None)
-        self.enqueue(key)
-
     async def start(self) -> None:
         self._task = asyncio.create_task(self._worker(), name=f"controller-{self.name}")
 
@@ -152,9 +145,7 @@ class Controller:
             self._task = None
 
     async def stop(self) -> None:
-        for t in self._timers.values():
-            t.cancel()
-        self._timers.clear()
+        self.queue.shut_down()
         await self._cancel_worker()
 
     # -- pause/resume (degraded mode, leadership loss) ------------------
@@ -171,15 +162,11 @@ class Controller:
     async def _worker(self) -> None:
         while True:
             wait_t0 = time.monotonic()
-            key = await self._queue.get()
+            try:
+                key = await self.queue.get()
+            except wq.ShutDown:
+                return
             popped = time.monotonic()
-            self._pending.discard(key)
-            self._report_depth()
-            enqueued_at = self._enqueued_ts.pop(key, None)
-            if self.metrics is not None and enqueued_at is not None:
-                self.metrics.controller_queue_latency.labels(
-                    controller=self.name
-                ).observe(max(0.0, popped - enqueued_at))
             try:
                 if self.gate is not None:
                     # paused (degraded / not leader): hold the popped key
@@ -192,17 +179,18 @@ class Controller:
                 # suspended with the key popped (mid-reconcile or parked at
                 # the gate): the pass may be half-applied — requeue so the
                 # resumed worker finishes the job
-                self.enqueue(key)
+                self.queue.abort(key)
                 raise
             except Exception:  # noqa: BLE001
-                delay = self.limiter.when(key)
+                delay = self.queue.fail(key)
                 log.exception("[%s] reconcile %s failed; retrying in %.2fs", self.name, key, delay)
                 self._count_requeue("failure")
                 self._observe_iteration(popped - wait_t0, time.monotonic() - popped)
-                self.enqueue_after(key, delay)
+                self.queue.done(key)
                 continue
             self._observe_iteration(popped - wait_t0, time.monotonic() - popped)
-            self.limiter.forget(key)
+            self.queue.forget(key)
+            self.queue.done(key)
             if requeue is not None:
                 self._count_requeue("scheduled")
                 self.enqueue_after(key, requeue)
@@ -252,7 +240,13 @@ class Manager:
         # fleet loop below.  Flows through setup() like the aggregator.
         self.explain = explain
         self.fleet_eval_interval = fleet_eval_interval
-        self._fleet_task: Optional[asyncio.Task] = None
+        # fleet-eval rides the shared workqueue framework as a scheduled-
+        # requeue controller (cancellable + saturation-instrumented) instead
+        # of a hand-rolled sleep loop.  Deliberately NOT in self.controllers:
+        # evaluation is push-fed (zero API verbs) and must keep running
+        # through degraded mode so burn-rate state stays live while the
+        # apiserver is down (Events still defer via the retry queue).
+        self._fleet_controller: Optional[Controller] = None
         # --leader-lease-renew-deadline analogue (cmd/gpu-operator
         # main.go:72-81): operators tune these for flaky control planes
         self.lease_duration = lease_duration
@@ -330,9 +324,11 @@ class Manager:
             self._supervise(), name="manager-supervisor"
         )
         if self.fleet is not None:
-            self._fleet_task = asyncio.create_task(
-                self._fleet_loop(), name="manager-fleet"
+            self._fleet_controller = Controller(
+                "fleet-eval", self._fleet_eval, metrics=self.operator_metrics
             )
+            await self._fleet_controller.start()
+            self._fleet_controller.enqueue("fleet")
         self.started.set()
         log.info(
             "manager started: %d informers, %d controllers, ns=%s",
@@ -340,17 +336,18 @@ class Manager:
         )
 
     async def stop(self) -> None:
-        for task_attr in ("_supervisor", "_fleet_task"):
-            task = getattr(self, task_attr)
-            if task:
-                task.cancel()
-                try:
-                    await task
-                except asyncio.CancelledError:
-                    pass
-                except Exception:  # noqa: BLE001
-                    log.debug("manager %s errored during stop", task_attr, exc_info=True)
-                setattr(self, task_attr, None)
+        if self._supervisor:
+            self._supervisor.cancel()
+            try:
+                await self._supervisor
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001
+                log.debug("manager supervisor errored during stop", exc_info=True)
+            self._supervisor = None
+        if self._fleet_controller is not None:
+            await self._fleet_controller.stop()
+            self._fleet_controller = None
         for controller in self.controllers:
             await controller.stop()
         for informer in self.informers.values():
@@ -435,51 +432,52 @@ class Manager:
             await self._flush_events()
             await asyncio.sleep(0.05)
 
-    async def _fleet_loop(self) -> None:
-        """SLO burn-rate evaluation + fleet gauge export at a fixed cadence.
-        Breach/recovery transitions post through the same retry-until-
-        posted Event queue as degraded mode — an SLOBurnRate that fires
-        during an apiserver wobble must still land as evidence."""
+    async def _fleet_eval(self, key: str) -> Optional[float]:
+        """One SLO burn-rate evaluation + fleet gauge export pass, driven by
+        the fleet-eval controller's scheduled requeue (the hand-rolled
+        ``while True: sleep`` loop this replaces was uncancellable and
+        invisible to the saturation gauges).  Breach/recovery transitions
+        post through the same retry-until-posted Event queue as degraded
+        mode — an SLOBurnRate that fires during an apiserver wobble must
+        still land as evidence."""
         from tpu_operator.obs import events as fleet_events
 
-        while True:
-            try:
-                if not self._is_leader():
-                    # a standby replica keeps ingesting whatever reaches it
-                    # but must not evaluate: only the leader may post
-                    # SLOBurnRate evidence, or an HA pair double-fires
-                    await asyncio.sleep(self.fleet_eval_interval)
-                    continue
-                # offender sets BEFORE evaluation: a recovery pops its
-                # offenders, and the explain timeline must still name the
-                # nodes the episode was about
-                prior_offenders = self.fleet.slo_engine.breached_offenders()
-                transitions = self.fleet.evaluate_slos()
-                current_offenders = self.fleet.slo_engine.breached_offenders()
-                for kind, slo, message in transitions:
-                    if kind == "fired":
-                        self._queue_event(
-                            "warning", fleet_events.namespace_ref(self.namespace),
-                            fleet_events.REASON_SLO_BURN_RATE, message,
-                        )
-                        log.warning("SLO burn: %s", message)
-                    else:
-                        self._queue_event(
-                            "normal", fleet_events.namespace_ref(self.namespace),
-                            fleet_events.REASON_SLO_RECOVERED, message,
-                        )
-                        log.info("SLO recovered: %s", message)
-                    if self.explain is not None:
-                        offenders = (
-                            current_offenders if kind == "fired"
-                            else prior_offenders
-                        ).get(slo, [])
-                        self.explain.observe_slo(kind, slo, message, offenders)
-                if self.operator_metrics is not None:
-                    self.fleet.export()
-            except Exception:  # noqa: BLE001 — telemetry loop must not die
-                log.exception("fleet evaluation pass failed")
-            await asyncio.sleep(self.fleet_eval_interval)
+        try:
+            if not self._is_leader():
+                # a standby replica keeps ingesting whatever reaches it
+                # but must not evaluate: only the leader may post
+                # SLOBurnRate evidence, or an HA pair double-fires
+                return self.fleet_eval_interval
+            # offender sets BEFORE evaluation: a recovery pops its
+            # offenders, and the explain timeline must still name the
+            # nodes the episode was about
+            prior_offenders = self.fleet.slo_engine.breached_offenders()
+            transitions = self.fleet.evaluate_slos()
+            current_offenders = self.fleet.slo_engine.breached_offenders()
+            for kind, slo, message in transitions:
+                if kind == "fired":
+                    self._queue_event(
+                        "warning", fleet_events.namespace_ref(self.namespace),
+                        fleet_events.REASON_SLO_BURN_RATE, message,
+                    )
+                    log.warning("SLO burn: %s", message)
+                else:
+                    self._queue_event(
+                        "normal", fleet_events.namespace_ref(self.namespace),
+                        fleet_events.REASON_SLO_RECOVERED, message,
+                    )
+                    log.info("SLO recovered: %s", message)
+                if self.explain is not None:
+                    offenders = (
+                        current_offenders if kind == "fired"
+                        else prior_offenders
+                    ).get(slo, [])
+                    self.explain.observe_slo(kind, slo, message, offenders)
+            if self.operator_metrics is not None:
+                self.fleet.export()
+        except Exception:  # noqa: BLE001 — telemetry cadence must not die
+            log.exception("fleet evaluation pass failed")
+        return self.fleet_eval_interval
 
     def _on_leadership(self, leader: bool) -> None:
         ref = obs_events.lease_ref(self.namespace, consts.LEADER_ELECTION_ID)

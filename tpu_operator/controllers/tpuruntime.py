@@ -11,7 +11,6 @@ cross-CR nodeSelector conflict validation (internal/validator/validator.go:
 
 from __future__ import annotations
 
-import asyncio
 import copy
 import logging
 from typing import Optional
@@ -43,6 +42,10 @@ from tpu_operator.utils import deep_get
 log = logging.getLogger("tpu_operator.tpuruntime")
 
 STATE_LABEL_VALUE = "tpu-runtime-cr"  # distinct from state-libtpu's label
+# fast revisit while an old DaemonSet (immutable selector mismatch) finishes
+# terminating — replaces the in-pass 5x100ms sleep-poll with a cancellable
+# scheduled requeue at the same effective latency
+SELECTOR_SWAP_REQUEUE_SECONDS = 0.5
 
 
 class TPURuntimeReconciler:
@@ -61,6 +64,9 @@ class TPURuntimeReconciler:
         self.metrics = metrics or OperatorMetrics()
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
+        # set per pass: an immutable-selector DS swap is mid-termination and
+        # the reconcile should revisit fast (scheduled requeue, no sleeps)
+        self._selector_swap_pending = False
 
     # ------------------------------------------------------------------
     async def reconcile(self, name: str) -> Optional[float]:
@@ -101,6 +107,7 @@ class TPURuntimeReconciler:
         pools = get_node_pools(nodes, runtime.spec.node_selector)
         desired_ds: set[str] = set()
         all_ready = True
+        self._selector_swap_pending = False
         for pool in pools:
             ds_name = hashed_name(f"tpu-runtime-{runtime.name}", pool.name)
             desired_ds.add(ds_name)
@@ -114,6 +121,10 @@ class TPURuntimeReconciler:
             return consts.REQUEUE_NO_TPU_NODES_SECONDS
         if not all_ready:
             await self._update_status(runtime, State.NOT_READY, "runtime DaemonSets not ready")
+            if self._selector_swap_pending:
+                # an old DS is still terminating: revisit fast via the
+                # workqueue instead of having slept in-pass
+                return SELECTOR_SWAP_REQUEUE_SECONDS
             return consts.REQUEUE_NOT_READY_SECONDS
         await self._update_status(runtime, State.READY, "")
         return None
@@ -237,7 +248,13 @@ class TPURuntimeReconciler:
         it and report unsafe until the object is actually GONE — a replace
         issued while the old object lingers with a deletionTimestamp hits the
         same 422 this path exists to avoid (pods re-roll on recreate; the
-        runtime DS is OnDelete-tolerant by design)."""
+        runtime DS is OnDelete-tolerant by design).
+
+        No in-pass sleep-poll (check_delta_paths discipline): one re-read
+        after the delete catches the common immediately-gone case; a
+        lingering finalizer defers to the workqueue's scheduled requeue
+        (``_reconcile`` returns ``SELECTOR_SWAP_REQUEUE_SECONDS``) instead
+        of parking the worker."""
         name = desired["metadata"]["name"]
         try:
             live = await self.client.get("apps", "DaemonSet", name, self.namespace)
@@ -255,17 +272,13 @@ class TPURuntimeReconciler:
                 name, have, want,
             )
             await self.client.delete("apps", "DaemonSet", name, self.namespace)
-        # brief poll: in the common case deletion completes immediately and
-        # this pass can recreate; a lingering finalizer defers to the next
-        # requeue instead of risking the 422
-        for _ in range(5):
-            try:
-                await self.client.get("apps", "DaemonSet", name, self.namespace)
-            except ApiError as e:
-                if e.not_found:
-                    return True
-                raise
-            await asyncio.sleep(0.1)
+        try:
+            await self.client.get("apps", "DaemonSet", name, self.namespace)
+        except ApiError as e:
+            if e.not_found:
+                return True
+            raise
+        self._selector_swap_pending = True
         return False
 
     async def _cleanup_stale(self, runtime: TPURuntime, desired: set[str]) -> None:

@@ -109,7 +109,16 @@ class CachedReader:
         namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
         field_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
     ) -> dict:
+        if limit is not None or continue_token:
+            # chunked listing is a live-API protocol (continue tokens are
+            # server state); cached callers never paginate
+            return await self.live.list(
+                group, kind, namespace, label_selector, field_selector,
+                limit=limit, continue_token=continue_token,
+            )
         inf = self.informer_for(group, kind, namespace)
         if inf is not None and field_selector is None:
             self._hit(kind)

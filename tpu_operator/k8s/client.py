@@ -171,6 +171,25 @@ def request_policy(policy: retry_api.RetryPolicy) -> Iterator[None]:
         _REQUEST_POLICY.reset(token)
 
 
+# Ambient (per-task) write fence, checked AFTER the client-wide leader fence.
+# The sharded reconcile plane installs one per shard reconcile: mutating
+# verbs are refused the instant the hash ring reassigns the key to another
+# shard, so a handoff can never double-actuate a drain or duplicate a create
+# (k8s/sharding.py; docs/PERFORMANCE.md "Delta reconcile & sharding").
+_REQUEST_FENCE: ContextVar[Optional["retry_api.WriteFence"]] = ContextVar(
+    "tpu_operator_k8s_request_fence", default=None
+)
+
+
+@contextlib.contextmanager
+def request_fence(fence: retry_api.WriteFence) -> Iterator[None]:
+    token = _REQUEST_FENCE.set(fence)
+    try:
+        yield
+    finally:
+        _REQUEST_FENCE.reset(token)
+
+
 class ApiClient:
     TOKEN_REFRESH_SECONDS = 60.0
 
@@ -272,6 +291,9 @@ class ApiClient:
         """
         if self.fence is not None:
             self.fence.check(method, path)
+        ambient_fence = _REQUEST_FENCE.get()
+        if ambient_fence is not None:
+            ambient_fence.check(method, path)
         policy = _REQUEST_POLICY.get() or self.retry_policy
         deadline = (
             time.monotonic() + policy.total_timeout
@@ -434,7 +456,14 @@ class ApiClient:
         namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
         field_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
     ) -> dict:
+        """One LIST page.  ``limit``/``continue_token`` are the apiserver
+        chunking protocol: a limited response carries ``metadata.continue``
+        when more items remain; resuming with an expired token gets a 410
+        ``Expired`` and the caller must relist from scratch (the informer's
+        410 taxonomy already does exactly that)."""
         info = obj_api.lookup(group, kind)
         path = self._collection_path(info, namespace)
         params = {}
@@ -442,7 +471,39 @@ class ApiClient:
             params["labelSelector"] = label_selector
         if field_selector:
             params["fieldSelector"] = field_selector
+        if limit is not None:
+            params["limit"] = str(limit)
+        if continue_token:
+            params["continue"] = continue_token
         return await self._request("GET", path, params=params)
+
+    async def list_paged(
+        self,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        page_size: int = consts.LIST_PAGE_SIZE,
+    ) -> dict:
+        """Full listing assembled from ``limit``-sized pages so a 10k-node
+        relist never materializes one giant response on the apiserver.  The
+        returned dict mimics a single List (items + the FINAL page's
+        resourceVersion — on a real apiserver every chunk is served at the
+        first page's snapshot rv, so any page's rv is the listing's rv).
+        A mid-pagination 410 (continue token expired) propagates to the
+        caller, whose relist-from-scratch path is the protocol answer."""
+        items: list[dict] = []
+        continue_token: Optional[str] = None
+        while True:
+            page = await self.list(
+                group, kind, namespace, label_selector,
+                limit=page_size, continue_token=continue_token,
+            )
+            items.extend(page.get("items", []))
+            continue_token = (page.get("metadata") or {}).get("continue")
+            if not continue_token:
+                page["items"] = items
+                return page
 
     async def list_items(self, *args, **kwargs) -> list[dict]:
         return (await self.list(*args, **kwargs)).get("items", [])

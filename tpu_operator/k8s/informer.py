@@ -13,6 +13,7 @@ from typing import Awaitable, Callable, Optional
 
 import aiohttp
 
+from tpu_operator import consts
 from tpu_operator.k8s import objects as obj_api
 from tpu_operator.k8s.client import ApiClient, ApiError
 
@@ -36,6 +37,7 @@ class Informer:
         label_selector: Optional[str] = None,
         resync_seconds: float = 600.0,
         required: bool = True,
+        page_size: Optional[int] = None,
     ):
         self.client = client
         self.group = group
@@ -43,6 +45,9 @@ class Informer:
         self.namespace = namespace
         self.label_selector = label_selector
         self.resync_seconds = resync_seconds
+        # LIST chunk size for relists (None -> consts.LIST_PAGE_SIZE);
+        # injectable so tests can force multi-page relists on small fleets
+        self.page_size = page_size
         # required informers gate manager start/readyz; optional ones back
         # the CachedReader opportunistically — a kind whose API is absent
         # (ServiceMonitor without prometheus-operator) must neither hang
@@ -117,8 +122,13 @@ class Informer:
             watch_started = 0.0
             served = False  # did this cycle's watch deliver anything?
             try:
-                listing = await self.client.list(
-                    self.group, self.kind, self.namespace, self.label_selector
+                # paginated relist (limit/continue): a 10k-object listing
+                # streams in LIST_PAGE_SIZE chunks; a continue token that
+                # expires mid-pagination surfaces as a 410, handled below by
+                # the same relist-from-scratch branch as a watch expiry
+                listing = await self.client.list_paged(
+                    self.group, self.kind, self.namespace, self.label_selector,
+                    page_size=self.page_size or consts.LIST_PAGE_SIZE,
                 )
                 rv = listing.get("metadata", {}).get("resourceVersion")
                 fresh: dict[tuple[str, str], dict] = {}

@@ -1,0 +1,84 @@
+"""Consistent hash-ring sharding for per-key reconcile work.
+
+The delta reconcile plane (``controllers/plane.py``) partitions per-node
+work across N worker shards: every key hashes to exactly one shard, so one
+node's reconciles are always serialized (no key ever runs concurrently with
+itself) while distinct nodes fan out across workers.  Consistent hashing —
+each shard projected onto the ring at ``vnodes`` points — keeps a shard
+add/remove from reshuffling more than ~1/N of the key space, which is what
+bounds the work a handoff re-routes.
+
+Ownership is the *fence* input: a shard worker actuates a key only while
+``ring.owner(key)`` still names it (generalizing the PR-4 leader
+``WriteFence`` to per-shard scope — ``k8s/client.py`` ``request_fence``).
+In-process the ring mutates on the same event loop that checks it, so the
+fence is exact: after a handoff the old shard's very next write for a moved
+key is refused, never duplicated.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional, Sequence
+
+from tpu_operator.utils import fnv1a_64
+
+
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _hash(value: str) -> int:
+    # FNV-1a is stable across processes/runs (unlike hash()) but has weak
+    # avalanche on short common-prefix strings (node-0001 vs node-0002 land
+    # on the same ring arc); the murmur3 fmix64 finalizer spreads them
+    h = fnv1a_64(value.encode())
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _MASK64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _MASK64
+    h ^= h >> 33
+    return h
+
+
+class HashRing:
+    """Consistent hash ring mapping string keys onto shard ids."""
+
+    def __init__(self, shards: Sequence[str] = (), vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: list[tuple[int, str]] = []
+        self._hashes: list[int] = []  # parallel to _points, for bisect
+        self._shards: set[str] = set()
+        for shard in shards:
+            self.add(shard)
+
+    def __len__(self) -> int:
+        return len(self._shards)
+
+    @property
+    def shards(self) -> list[str]:
+        return sorted(self._shards)
+
+    def add(self, shard: str) -> None:
+        if shard in self._shards:
+            return
+        self._shards.add(shard)
+        for v in range(self.vnodes):
+            self._points.append((_hash(f"{shard}#{v}"), shard))
+        self._points.sort()
+        self._hashes = [h for h, _ in self._points]
+
+    def remove(self, shard: str) -> None:
+        if shard not in self._shards:
+            return
+        self._shards.discard(shard)
+        self._points = [(h, s) for h, s in self._points if s != shard]
+        self._hashes = [h for h, _ in self._points]
+
+    def owner(self, key: str) -> Optional[str]:
+        """The shard owning ``key`` right now, or None on an empty ring."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._hashes, _hash(key))
+        if idx == len(self._points):
+            idx = 0  # wrap around
+        return self._points[idx][1]

@@ -1,0 +1,329 @@
+"""Shared priority/fairness workqueue for every reconcile loop.
+
+client-go ``workqueue`` analogue (the layer controller-runtime builds its
+per-controller queues on), grown for a 10k-node fleet:
+
+- **Dedup/coalescing** — a key queued twice collapses to one pending entry
+  (the reconcile reads current state, so one pass absorbs any number of
+  triggering events).  A key re-added *while its reconcile runs* lands in a
+  dirty set and re-queues the moment the run completes (client-go
+  processing/dirty semantics) — with shared worker pools this is what keeps
+  one key from ever reconciling concurrently with itself.
+- **Priority classes** — :data:`PRIORITY_HIGH` (health/remediation
+  actuation), :data:`PRIORITY_NORMAL` (event-driven deltas), and
+  :data:`PRIORITY_LOW` (periodic full-resync sweeps).  ``get()`` always
+  serves the highest class with work, so a node the health engine needs
+  drained preempts a 10k-key label resync backlog; re-adding a pending key
+  at a higher class upgrades it in place.
+- **Fairness lanes** — within one priority class, keys are drawn
+  round-robin across lanes (e.g. one lane per TPUClusterPolicy, or per
+  slice group), so a storming source cannot starve a quiet one.
+- **Rate-limited requeue** — ``fail(key)`` schedules the key back with
+  per-item exponential backoff (base/cap mirror the old ``RateLimiter``);
+  ``forget(key)`` resets the item's failure streak.
+- **Scheduled requeue** — ``add_after(key, delay)`` with earlier-wins timer
+  coalescing; the cancellable replacement for hand-rolled
+  ``while True: sleep`` poll loops (``hack/check_delta_paths.py`` bans
+  those under ``controllers/``).
+- **Metrics** — depth/latency/requeues ride the PR-6 ``Controller`` gauges
+  (labelled by queue name) plus the ``tpu_operator_workqueue_*`` families
+  for the new dimensions (per-priority depth, coalesced adds, backoff
+  retries) — docs/PERFORMANCE.md "Delta reconcile & sharding".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Any, Optional
+
+from tpu_operator import consts
+
+# Priority classes, lowest number served first.  Deliberately a short enum:
+# every extra class is another starvation relationship to reason about.
+PRIORITY_HIGH = 0      # health/remediation actuation paths
+PRIORITY_NORMAL = 1    # event-driven delta reconciles
+PRIORITY_LOW = 2       # periodic full-resync safety-net sweeps
+
+_PRIORITIES = (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_LOW)
+PRIORITY_NAMES = {PRIORITY_HIGH: "high", PRIORITY_NORMAL: "normal", PRIORITY_LOW: "low"}
+
+DEFAULT_LANE = ""
+
+
+class ShutDown(Exception):
+    """Raised by ``get()`` once the queue is shut down and drained."""
+
+
+class WorkQueue:
+    """Deduplicating delayed priority queue with fairness lanes.
+
+    Single-event-loop discipline: every method is called from the loop that
+    runs the workers (enqueue sites are informer handlers and reconcile
+    returns, both loop-side), so plain dicts/deques need no locking.
+    """
+
+    def __init__(
+        self,
+        name: str = "",
+        metrics: Optional[Any] = None,
+        base: float = consts.RATE_LIMIT_BASE_SECONDS,
+        cap: float = consts.RATE_LIMIT_MAX_SECONDS,
+    ):
+        self.name = name
+        # OperatorMetrics (or None).  Mutable on purpose: the Manager stamps
+        # controller metrics after construction (add_controller/start).
+        self.metrics = metrics
+        self.base = base
+        self.cap = cap
+        # priority -> lane -> deque of keys; _lane_rr holds the round-robin
+        # rotation of non-empty lanes per priority
+        self._lanes: dict[int, dict[str, deque[str]]] = {p: {} for p in _PRIORITIES}
+        self._lane_rr: dict[int, deque[str]] = {p: deque() for p in _PRIORITIES}
+        self._pending: dict[str, tuple[int, str]] = {}  # key -> (priority, lane)
+        # incremental per-priority tally: depth reporting must stay O(1) per
+        # add/pop — recomputing over pending would make a 10k-key resync
+        # burst O(N^2) on the event loop
+        self._pri_counts: dict[int, int] = {p: 0 for p in _PRIORITIES}
+        self._enqueued_ts: dict[str, float] = {}
+        self._processing: dict[str, tuple[int, str]] = {}  # key -> meta at pop
+        self._dirty: dict[str, tuple[int, str]] = {}  # re-adds during processing
+        self._timers: dict[str, asyncio.TimerHandle] = {}
+        self._failures: dict[str, int] = {}
+        self._ready = asyncio.Event()
+        self._shutting_down = False
+
+    # ------------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    @property
+    def shutting_down(self) -> bool:
+        return self._shutting_down
+
+    @property
+    def idle(self) -> bool:
+        """Nothing pending and nothing in flight (scheduled timers are
+        future work and deliberately excluded)."""
+        return not self._pending and not self._processing
+
+    def pending_keys(self) -> list[str]:
+        return list(self._pending)
+
+    def processing_priority(self, key: str) -> Optional[int]:
+        """The priority class an in-flight key was popped at (None when the
+        key is not processing) — lets a re-routing caller (shard handoff)
+        preserve the class instead of demoting to NORMAL."""
+        meta = self._processing.get(key)
+        return meta[0] if meta is not None else None
+
+    def _report_depth(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.controller_queue_depth.labels(controller=self.name).set(
+            len(self._pending)
+        )
+        for priority, n in self._pri_counts.items():
+            self.metrics.workqueue_depth.labels(
+                queue=self.name, priority=PRIORITY_NAMES[priority]
+            ).set(n)
+
+    # ------------------------------------------------------------------
+    def add(
+        self,
+        key: str,
+        priority: int = PRIORITY_NORMAL,
+        lane: str = DEFAULT_LANE,
+    ) -> None:
+        """Queue ``key``; collapses onto an existing pending entry (keeping
+        the earlier enqueue timestamp, upgrading priority when the new add
+        outranks it) and defers onto the dirty set while the key's reconcile
+        is in flight."""
+        if self._shutting_down:
+            return
+        if key in self._processing:
+            prev = self._dirty.get(key)
+            if prev is None or priority < prev[0]:
+                self._dirty[key] = (priority, lane)
+            self._count_coalesced()
+            return
+        existing = self._pending.get(key)
+        if existing is not None:
+            if priority < existing[0]:
+                # preemption: pull the key out of its old slot and re-queue
+                # it at the stronger class (front-of-lane: it has waited)
+                self._remove_pending(key)
+                self._pending[key] = (priority, lane)
+                self._pri_counts[priority] += 1
+                self._lane_for(priority, lane).appendleft(key)
+                self._report_depth()
+                self._ready.set()
+            self._count_coalesced()
+            return
+        # an immediate add beats any scheduled timer for the same key
+        timer = self._timers.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        self._pending[key] = (priority, lane)
+        self._pri_counts[priority] += 1
+        self._enqueued_ts.setdefault(key, time.monotonic())
+        self._lane_for(priority, lane).append(key)
+        self._report_depth()
+        self._ready.set()
+
+    def _lane_for(self, priority: int, lane: str) -> deque[str]:
+        lanes = self._lanes[priority]
+        d = lanes.get(lane)
+        if d is None:
+            d = lanes[lane] = deque()
+        if not d:
+            # (re)joining the rotation — only empty lanes are absent from it
+            self._lane_rr[priority].append(lane)
+        return d
+
+    def _remove_pending(self, key: str) -> None:
+        priority, lane = self._pending.pop(key)
+        self._pri_counts[priority] -= 1
+        d = self._lanes[priority].get(lane)
+        if d is not None:
+            try:
+                d.remove(key)
+            except ValueError:
+                pass
+            if not d:
+                try:
+                    self._lane_rr[priority].remove(lane)
+                except ValueError:
+                    pass
+
+    def add_after(
+        self,
+        key: str,
+        delay: float,
+        priority: int = PRIORITY_NORMAL,
+        lane: str = DEFAULT_LANE,
+    ) -> None:
+        """Delayed add; an existing timer for the key is replaced only when
+        the new one fires sooner (AddAfter semantics), and a key already
+        pending needs no timer at all."""
+        if self._shutting_down:
+            return
+        if delay <= 0:
+            self.add(key, priority, lane)
+            return
+        if key in self._pending:
+            return
+        loop = asyncio.get_running_loop()
+        existing = self._timers.get(key)
+        if existing is not None:
+            if existing.when() - loop.time() <= delay:
+                return
+            existing.cancel()
+        self._timers[key] = loop.call_later(
+            delay, self._fire, key, priority, lane
+        )
+
+    def _fire(self, key: str, priority: int, lane: str) -> None:
+        self._timers.pop(key, None)
+        self.add(key, priority, lane)
+
+    # ------------------------------------------------------------------
+    async def get(self) -> str:
+        """Next key, highest priority class first, round-robin across that
+        class's fairness lanes.  The key enters the processing set; the
+        caller MUST finish with ``done(key)`` (or ``fail``+``done``).
+        Raises :class:`ShutDown` once the queue is shut down and empty."""
+        while True:
+            if self._pending:
+                return self._pop()
+            if self._shutting_down:
+                raise ShutDown(self.name)
+            self._ready.clear()
+            await self._ready.wait()
+
+    def _pop(self) -> str:
+        for priority in _PRIORITIES:
+            rr = self._lane_rr[priority]
+            if not rr:
+                continue
+            lane = rr.popleft()
+            d = self._lanes[priority][lane]
+            key = d.popleft()
+            if d:
+                rr.append(lane)  # rotate: next get serves the next lane
+            meta = self._pending.pop(key)
+            self._pri_counts[meta[0]] -= 1
+            self._processing[key] = meta
+            enqueued_at = self._enqueued_ts.pop(key, None)
+            if self.metrics is not None and enqueued_at is not None:
+                self.metrics.controller_queue_latency.labels(
+                    controller=self.name
+                ).observe(max(0.0, time.monotonic() - enqueued_at))
+            self._report_depth()
+            return key
+        raise RuntimeError("pending map and lanes disagree")  # unreachable
+
+    def done(self, key: str) -> None:
+        """Processing finished; a dirty re-add (event arrived mid-reconcile)
+        flushes back onto the queue immediately."""
+        meta = self._processing.pop(key, None)
+        dirty = self._dirty.pop(key, None)
+        if dirty is not None and not self._shutting_down:
+            self.add(key, *dirty)
+        elif meta is None and dirty is None:
+            pass  # done() on an unknown key is a no-op by design
+
+    def fail(self, key: str) -> float:
+        """Reconcile failed: schedule the key back with per-item exponential
+        backoff (capped); returns the delay chosen.  Call before ``done`` so
+        a dirty immediate re-add (fresh evidence) wins over the backoff."""
+        n = self._failures.get(key, 0)
+        self._failures[key] = n + 1
+        delay = min(self.base * (2**n), self.cap)
+        meta = self._processing.get(key) or (PRIORITY_NORMAL, DEFAULT_LANE)
+        if self.metrics is not None:
+            self.metrics.workqueue_retries_total.labels(queue=self.name).inc()
+        # release the processing slot first or add_after's add path would
+        # divert into the dirty set
+        self._processing.pop(key, None)
+        self.add_after(key, delay, *meta)
+        return delay
+
+    def forget(self, key: str) -> None:
+        self._failures.pop(key, None)
+
+    def abort(self, key: str) -> None:
+        """The worker died mid-reconcile (cancelled): put the key straight
+        back so a resumed worker finishes the job."""
+        meta = self._processing.pop(key, (PRIORITY_NORMAL, DEFAULT_LANE))
+        dirty = self._dirty.pop(key, None)
+        if dirty is not None and dirty[0] < meta[0]:
+            meta = dirty
+        if not self._shutting_down:
+            self.add(key, *meta)
+
+    def _count_coalesced(self) -> None:
+        if self.metrics is not None:
+            self.metrics.workqueue_coalesced_total.labels(queue=self.name).inc()
+
+    # ------------------------------------------------------------------
+    def shut_down(self) -> None:
+        """Stop accepting work and cancel scheduled timers; queued keys keep
+        draining through ``get()`` until empty, then ``get()`` raises
+        :class:`ShutDown` (clean-drain semantics)."""
+        self._shutting_down = True
+        for t in self._timers.values():
+            t.cancel()
+        self._timers.clear()
+        self._dirty.clear()
+        self._ready.set()  # wake waiters so they observe the shutdown
+
+    async def drain(self, timeout: float = 5.0) -> bool:
+        """Wait until nothing is pending or processing; True on success."""
+        deadline = time.monotonic() + timeout
+        while self._pending or self._processing:
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(0.005)
+        return True

@@ -242,6 +242,50 @@ class OperatorMetrics:
             ["controller"],
             registry=self.registry,
         )
+        # shared priority/fairness workqueue framework (k8s/workqueue.py)
+        # + hash-ring sharded delta plane (k8s/sharding.py,
+        # controllers/plane.py) — docs/PERFORMANCE.md "Delta reconcile &
+        # sharding".  Label spaces are bounded: queue = controller/shard
+        # names, priority = high|normal|low, shard = node-shard-<i>.
+        self.workqueue_depth = Gauge(
+            "tpu_operator_workqueue_depth",
+            "Keys pending per workqueue per priority class "
+            "(high = health/remediation actuation, normal = event-driven "
+            "deltas, low = periodic resync sweeps)",
+            ["queue", "priority"],
+            registry=self.registry,
+        )
+        self.workqueue_retries_total = Counter(
+            "tpu_operator_workqueue_retries_total",
+            "Keys re-queued with per-item exponential backoff after a "
+            "failed reconcile, per workqueue",
+            ["queue"],
+            registry=self.registry,
+        )
+        self.workqueue_coalesced_total = Counter(
+            "tpu_operator_workqueue_coalesced_total",
+            "Adds collapsed onto an already-pending or in-flight key "
+            "(dedup/coalescing hits), per workqueue",
+            ["queue"],
+            registry=self.registry,
+        )
+        self.shard_reconciles_total = Counter(
+            "tpu_operator_shard_reconciles_total",
+            "Per-node delta reconciles executed per hash-ring worker shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.shard_handoffs_total = c(
+            "tpu_operator_shard_handoffs_total",
+            "Hash-ring rebalances (shards added/removed); every handoff "
+            "re-routes the moved keys and fences the old owner's writes",
+        )
+        self.shard_fence_rejections_total = c(
+            "tpu_operator_shard_fence_rejections_total",
+            "Mutating requests refused by a shard write fence because the "
+            "hash ring reassigned the key mid-reconcile (each one is a "
+            "double-actuation that did NOT happen)",
+        )
         # fleet telemetry plane (obs/fleet.py): windowed fleet rollups +
         # aggregator health.  Only ROLLUPS are exported — per-node series
         # stay inside the ring so operator-registry cardinality is bounded

@@ -614,11 +614,18 @@ class FakeCluster:
             for item in items:
                 item.pop("kind", None)
                 item.pop("apiVersion", None)
+            meta: dict = {"resourceVersion": str(self._rv)}
+            limit = q.get("limit", "")
+            token = q.get("continue", "")
+            if limit or token:
+                items, cont = self._paginate(store, items, limit, token)
+                if cont:
+                    meta["continue"] = cont
             return web.json_response(
                 {
                     "kind": store.info.gvk.kind + "List",
                     "apiVersion": store.info.gvk.api_version,
-                    "metadata": {"resourceVersion": str(self._rv)},
+                    "metadata": meta,
                     "items": items,
                 }
             )
@@ -631,6 +638,53 @@ class FakeCluster:
                 store.delete(item["metadata"].get("namespace"), item["metadata"]["name"])
             return web.json_response({"status": "Success"})
         raise ApiException(405, "MethodNotAllowed", request.method)
+
+    def _paginate(
+        self, store: Store, items: list[dict], limit: str, token: str
+    ) -> tuple[list[dict], Optional[str]]:
+        """limit/continue chunking over the (sorted) listing.
+
+        The continue token is opaque to clients: base64 of the snapshot rv
+        + the LAST SERVED (ns, name) key — continuation is key-based, as on
+        a real apiserver, so objects created or deleted between pages never
+        shift the cursor (an offset-based cursor would silently skip or
+        duplicate items under churn).  Expiry mirrors the watch-window rule
+        — once the store's event ring has wrapped past the token's rv the
+        server can no longer promise a coherent continuation and answers
+        410 ``Expired`` (the etcd-compaction behaviour), which sends the
+        client back to a fresh list."""
+        import base64
+
+        try:
+            n = int(limit) if limit else 0
+        except ValueError:
+            raise ApiException(400, "BadRequest", f"invalid limit {limit!r}")
+
+        def item_key(it: dict) -> list:
+            meta = it.get("metadata", {})
+            return [meta.get("namespace", "") or "", meta.get("name", "")]
+
+        if token:
+            try:
+                rv0, after_key = json.loads(base64.b64decode(token))
+            except Exception:
+                raise ApiException(400, "BadRequest", "malformed continue token")
+            ring_full = len(store.events) == (store.events.maxlen or 0)
+            if ring_full and store.events and rv0 < store.events[0][0]:
+                raise ApiException(
+                    410, "Expired",
+                    "The provided continue parameter is too old",
+                )
+            items = [it for it in items if item_key(it) > after_key]
+        else:
+            rv0 = self._rv
+        page = items[:n] if n > 0 else items
+        cont: Optional[str] = None
+        if n > 0 and len(items) > n:
+            cont = base64.b64encode(
+                json.dumps([rv0, item_key(page[-1])]).encode()
+            ).decode()
+        return page, cont
 
     async def _handle_object(
         self,
