@@ -2,13 +2,13 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = lint gates + counter-catalogue drift check +
 # the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint unit-test chaos chaos-health chaos-migrate fleet-obs
+test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint unit-test chaos chaos-health chaos-migrate fleet-obs bench-join
 
 # the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
 # and the docs/OBSERVABILITY.md catalogue may never drift
@@ -99,6 +99,17 @@ bench:
 RECONCILE_TIERS ?= 2000,5000,10000
 bench-reconcile:
 	$(PYTHON) bench.py --reconcile --tiers $(RECONCILE_TIERS)
+
+# fleet compile-cache + warm-pool validation tier (chip-free; ~20 s):
+# cold vs warm re-validation waves through the real coordinator, artifact
+# plane, and push ingest — gated on warm join_to_validated p99 ≥2x better
+# than cold (the `join_warm_p99` regression verdict), exactly one seeder
+# compile per kind, compile dominance flipping cold→warm, and the
+# disruption budget holding (docs/PERFORMANCE.md "Compile cache &
+# warm-pool validation")
+JOIN_NODES ?= 12
+bench-join:
+	env JAX_PLATFORMS=cpu $(PYTHON) bench.py --join --nodes $(JOIN_NODES) --seed $(CHAOS_SEED)
 
 # seeded chaos acceptance soak (chip-free; ~1 min): 100-node fake cluster,
 # 5% transient API errors + watch drops + one leader-lease steal must still

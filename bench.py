@@ -57,6 +57,7 @@ PRIOR_ROUNDS = {
 LOWER_IS_BETTER = {
     "join_to_validated_s", "join_to_schedulable_s", "revalidation_s",
     "reconcile_converge_100n_s", "reconcile_steady_requests_per_pass_100n",
+    "join_warm_p99", "join_cold_p99",
 }
 
 # populated by _exec_workload_pod as the fake kubelet executes the real
@@ -1630,6 +1631,47 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
                         and verdict.get("phase") == "compile"
                     )
 
+                    # D7: the compile-dominance gate FLIPPED on the warm
+                    # path (ISSUE 11).  The cold pushes above keep the
+                    # before-picture gate (compile dominant); a second
+                    # round of pushes models re-validation through the
+                    # compile-artifact cache — the "compile" segment is a
+                    # disk read now — and over a window holding only
+                    # those samples compile must NOT dominate.  The real
+                    # cold/warm numbers are measured by `bench.py --join`;
+                    # this asserts the telemetry plane renders the flip.
+                    await asyncio.sleep(0.3)
+                    warm_t0 = time.time()
+                    warm_fracs = {
+                        "runtime-ready": 0.32, "validator-scheduled": 0.22,
+                        "plugin-advertised": 0.18, "compile": 0.06,
+                        "collective": 0.22,
+                    }
+                    for i in range(0, n_nodes, 4):
+                        node = f"tpu-{i // 4}-2"
+                        total = rng.uniform(1.0, 2.0)
+                        async with http.post(push_url, json={
+                            "node": node,
+                            "join_phases": {
+                                p: round(total * f, 6)
+                                for p, f in warm_fracs.items()
+                            },
+                        }) as resp:
+                            assert resp.status == 200
+                    warm_roll = fleet.join_phase_rollup(
+                        time.time() - warm_t0 + 0.05
+                    )
+                    warm_compile = (warm_roll.get("compile") or {}).get("mean", 0.0)
+                    result["warm_phase_rollup_nodes"] = (
+                        (warm_roll.get("compile") or {}).get("count", 0)
+                    )
+                    # same dominance definition as the cold gate: compile
+                    # strictly above EVERY other phase's mean
+                    result["warm_compile_dominant"] = bool(warm_roll) and all(
+                        warm_compile > r["mean"]
+                        for p, r in warm_roll.items() if p != "compile"
+                    )
+
                 # -- steady state: aggregation must cost zero API verbs ---
                 fc.chaos.stop()
                 steady_requests = None
@@ -1693,6 +1735,13 @@ async def _fleet_obs_soak(n_nodes: int, seed: int) -> dict:
             failures.append(
                 "compile is not the dominant join phase in the rollups"
             )
+        if result.get("warm_compile_dominant"):
+            failures.append(
+                "compile still dominates the WARM join path rollups — the "
+                "compile-cache flip is not rendered"
+            )
+        if not result.get("warm_phase_rollup_nodes"):
+            failures.append("no warm-path join-phase samples rolled up")
         if not result.get("stuck_blocking_ok"):
             failures.append(
                 "/debug/explain mis-named the stuck node's blocking phase: "
@@ -1732,6 +1781,416 @@ def run_fleet_obs_soak(n_nodes: int = 100, seed: int = 1) -> dict:
         f"(sum {result.get('join_phase_sum_mean')} vs join {result.get('join_mean')}, "
         f"compile dominant {result.get('compile_dominant')}), "
         f"trace joined {result.get('explain_trace_joined')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# `bench.py --join` — fleet compile cache + warm-pool validation tier
+# (ISSUE 11; docs/PERFORMANCE.md "Compile cache & warm-pool validation").
+
+JOIN_TIER_TIMEOUT = 240.0
+# warm join p99 must beat cold by at least this factor (the acceptance
+# gate; measured over the warm-pool fan-out population — the seeders ARE
+# the cold path by design, exactly one per kind)
+JOIN_WARM_SPEEDUP_GATE = 2.0
+
+
+async def _join_soak(n_nodes: int, seed: int) -> dict:
+    """Cold vs warm fleet re-validation through the REAL machinery:
+
+    - the real RevalidationCoordinator (seeder-first, budget-bounded
+      promotion on the shared workqueue) schedules each wave;
+    - each admitted node's validation executes REAL XLA compiles — the
+      canonical warm-pool program set (workloads/warmpool.py) on the CPU
+      backend, fresh function objects per node so every cold compile is
+      paid honestly even in one process;
+    - artifacts flow through the REAL HTTP plane: the seeder publishes to
+      the Manager's /compile-cache/* surface, warm nodes prewarm from it,
+      and every node's measured join phases ride the real /push ingest.
+
+    Wave 1 (cold): no fleet cache — every node pays the compiler; the
+    before-picture.  A simulated upgrade then bumps the runtime version
+    (rotating every cache kind), and wave 2 (warm) runs with the fleet
+    cache: one seeder compile per kind, everyone else pays disk.
+
+    Gates: warm fan-out p99 ≥ JOIN_WARM_SPEEDUP_GATE× better than cold,
+    exactly one seeder compile per kind (hit/miss counters), compile
+    dominance flipping cold→warm in the fleet join-phase rollups, and the
+    in-flight re-validation count never exceeding the disruption budget.
+    """
+    import threading
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import TPUClusterPolicy
+    from tpu_operator.controllers.revalidation import (
+        RevalidationCoordinator, node_kind,
+    )
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.fleet import FleetAggregator, quantile
+    from tpu_operator.utils import deep_get
+    from tpu_operator.testing import FakeCluster, SimConfig
+    from tpu_operator.workloads import compile_cache as cc
+    from tpu_operator.workloads import warmpool
+
+    kinds = (("tpu-v5-lite-podslice", "2x4"), ("tpu-v5p-slice", "4x4"))
+    budget_spec = "25%"
+    workdir = os.path.join(
+        os.environ.get("TPU_VALIDATION_ROOT", "/tmp/tpu-bench-run"),
+        f"join-tier-{os.getpid()}",
+    )
+    os.makedirs(workdir, exist_ok=True)
+    jax_version = cc.current_versions()[0]
+
+    def kind_fp(kind_str: str) -> str:
+        acc, topo, ver = kind_str.split("/")
+        return cc.kind_fingerprint(acc, topo, jax_version, ver)
+
+    # shared with the executor threads: per-node measured results + the
+    # wave's fleet-cache URL ("" = cold)
+    node_results: dict[str, dict] = {}
+    results_lock = threading.Lock()
+
+    def _pod_env(pod: dict) -> dict:
+        spec = pod["spec"]["containers"][0]
+        return {e["name"]: e.get("value", "") for e in spec.get("env", [])}
+
+    def _join_executor(pod: dict) -> str:
+        """The workload pod body: REAL warm-pool validation for one node.
+        Compile/fetch seconds and cache counters are measured here and
+        pushed as join phases through the real agent→operator push hop."""
+        env = _pod_env(pod)
+        node = env["BENCH_JOIN_NODE"]
+        store = cc.ArtifactStore(env["TPU_COMPILE_CACHE_ARTIFACTS"])
+        client = cc.FleetCacheClient(env.get("TPU_FLEET_CACHE_URL", ""))
+        fields = dict(
+            generation=env["TPU_CACHE_GENERATION"],
+            topology=env["TPU_CACHE_TOPOLOGY"],
+            jax_version=jax_version,
+            libtpu_version=env["TPU_LIBTPU_VERSION"],
+        )
+        result = warmpool.run(store=store, client=client, fields=fields)
+        phases = {
+            # the join critical path's compile slot: compiler time cold,
+            # artifact-load time warm
+            "compile": result["compile_s"] + result["fetch_s"],
+            "collective": max(
+                0.0, result["duration_s"] - result["compile_s"] - result["fetch_s"]
+            ),
+        }
+        flight_api.push_join_phases(node, phases, url=env["BENCH_PUSH_URL"])
+        with results_lock:
+            node_results[node] = result
+        return "Succeeded" if result["ok"] else "Failed"
+
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.02, pod_executor=_join_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed, "kinds": len(kinds)}
+    async with FakeCluster(sim) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        fleet = FleetAggregator(metrics)
+        fleet_cache = cc.FleetCompileCache(
+            os.path.join(workdir, "fleet-cache"), metrics=metrics
+        )
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, operator_metrics=metrics,
+            fleet=fleet, recorder=recorder, compile_cache=fleet_cache,
+        )
+        coordinator = RevalidationCoordinator(
+            client, NS, metrics=metrics, recorder=recorder,
+            warm_fn=lambda kind_str: fleet_cache.has_kind(kind_fp(kind_str)),
+        )
+        coordinator.setup(mgr)
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "health": {"maxUnhealthyPercent": budget_spec},
+                }).obj)
+                names = []
+                for i in range(n_nodes):
+                    acc, topo = kinds[i % len(kinds)]
+                    name = f"tpu-{i % len(kinds)}-{i // len(kinds)}"
+                    fc.add_node(name, accelerator=acc, topology=topo, labels={
+                        consts.TFD_RUNTIME_VERSION_LABEL: "v1",
+                    })
+                    names.append(name)
+                from tpu_operator.controllers.health import parse_budget
+
+                budget = max(1, parse_budget(budget_spec, n_nodes))
+                result["budget"] = budget
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                push_url = f"{base}/push"
+
+                async def run_wave(tag: str, fleet_url: str, version: str) -> dict:
+                    """Stamp the whole fleet validate=pending and drive
+                    the coordinator-scheduled wave to completion, playing
+                    the node-agent role: promoted nodes get a REAL
+                    workload pod whose executor runs the validation."""
+                    with results_lock:
+                        node_results.clear()
+                    promoted_ts: dict[str, float] = {}
+                    done_ts: dict[str, float] = {}
+                    seeders: list[str] = []
+                    seeder_kinds: set[str] = set()
+                    launched: set[str] = set()
+                    max_in_flight = 0
+                    for name in names:
+                        await client.patch("", "Node", name, {"metadata": {"labels": {
+                            consts.VALIDATE_REQUEST_LABEL: consts.VALIDATE_PENDING,
+                            consts.TFD_RUNTIME_VERSION_LABEL: version,
+                            consts.REMEDIATION_STATE_LABEL: None,
+                        }}})
+
+                    async def finalize(name: str, pod_name: str) -> None:
+                        while True:
+                            pod = await client.get("", "Pod", pod_name, NS)
+                            phase = deep_get(pod, "status", "phase")
+                            if phase in ("Succeeded", "Failed"):
+                                break
+                            await asyncio.sleep(0.02)
+                        done_ts[name] = time.perf_counter()
+                        await client.patch("", "Node", name, {"metadata": {"labels": {
+                            consts.VALIDATE_REQUEST_LABEL: None,
+                            consts.REMEDIATION_STATE_LABEL:
+                                "healthy" if phase == "Succeeded"
+                                else "remediation-failed",
+                        }}})
+                        await client.delete("", "Pod", pod_name, NS)
+
+                    t0 = time.perf_counter()
+                    finalizers = []
+                    while True:
+                        nodes_live = list(fc.store("", "nodes").objects.values())
+                        in_flight = 0
+                        for node in nodes_live:
+                            name = node["metadata"]["name"]
+                            labels = deep_get(
+                                node, "metadata", "labels", default={}
+                            ) or {}
+                            if labels.get(consts.VALIDATE_REQUEST_LABEL) != "requested":
+                                continue
+                            in_flight += 1
+                            if name in launched:
+                                continue
+                            launched.add(name)
+                            promoted_ts[name] = time.perf_counter()
+                            # the first node admitted per kind is that
+                            # kind's seeder (the coordinator's order)
+                            if node_kind(node) not in seeder_kinds:
+                                seeder_kinds.add(node_kind(node))
+                                seeders.append(name)
+                            acc = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+                            topo = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+                            pod_name = f"warm-validate-{name}"
+                            pod = {
+                                "apiVersion": "v1", "kind": "Pod",
+                                "metadata": {"name": pod_name, "namespace": NS,
+                                             "labels": {"app": "warm-validate"}},
+                                "spec": {
+                                    "nodeName": name,
+                                    "restartPolicy": "Never",
+                                    "containers": [{
+                                        "name": "validate",
+                                        "image": "bench",
+                                        "env": [
+                                            {"name": "BENCH_JOIN_NODE", "value": name},
+                                            {"name": "BENCH_PUSH_URL", "value": push_url},
+                                            {"name": "TPU_CACHE_GENERATION", "value": acc},
+                                            {"name": "TPU_CACHE_TOPOLOGY", "value": topo},
+                                            {"name": "TPU_LIBTPU_VERSION", "value": version},
+                                            {"name": "TPU_FLEET_CACHE_URL", "value": fleet_url},
+                                            {"name": "TPU_COMPILE_CACHE_ARTIFACTS",
+                                             "value": os.path.join(
+                                                 workdir, f"{tag}-{name}", "artifacts")},
+                                        ],
+                                    }],
+                                },
+                            }
+                            await client.create(pod)
+                            finalizers.append(
+                                asyncio.create_task(finalize(name, pod_name))
+                            )
+                        max_in_flight = max(max_in_flight, in_flight)
+                        if len(done_ts) == n_nodes:
+                            break
+                        if time.perf_counter() - t0 > JOIN_TIER_TIMEOUT:
+                            raise TimeoutError(
+                                f"{tag} wave stalled: {len(done_ts)}/{n_nodes} done"
+                            )
+                        await asyncio.sleep(0.02)
+                    for task in finalizers:
+                        await task
+                    durations = {
+                        n: done_ts[n] - promoted_ts[n] for n in promoted_ts
+                    }
+                    # the headline metric, through the real aggregator so
+                    # /debug/fleet carries the tier's evidence
+                    for n, dur in durations.items():
+                        fleet.ingest(
+                            "join_to_validated_seconds", dur, {"node": n}
+                        )
+                    with results_lock:
+                        wave_results = dict(node_results)
+                    return {
+                        "durations": durations,
+                        "seeders": seeders,
+                        "max_in_flight": max_in_flight,
+                        "wall_s": round(time.perf_counter() - t0, 3),
+                        "results": wave_results,
+                    }
+
+                def _percentiles(durs: list) -> dict:
+                    vals = sorted(durs)
+                    return {
+                        q: round(quantile(vals, frac), 4)
+                        for q, frac in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99))
+                    }
+
+                # -- wave 1: COLD — no fleet cache, every node compiles --
+                cold = await run_wave("cold", fleet_url="", version="v1")
+                cold_roll = fleet.join_phase_rollup(cold["wall_s"] + 2.0)
+                await asyncio.sleep(0.5)  # age cold phases out of warm window
+
+                # -- simulated upgrade: runtime version bump rotates every
+                # cache kind, then wave 2: WARM — fleet cache live --------
+                warm_t0 = time.time()
+                warm = await run_wave("warm", fleet_url=base, version="v2")
+                warm_roll = fleet.join_phase_rollup(time.time() - warm_t0 + 0.05)
+
+                n_programs = len(warmpool.validation_programs())
+                cold_all = list(cold["durations"].values())
+                warm_seeders = set(warm["seeders"])
+                warm_fanout = [
+                    d for n, d in warm["durations"].items()
+                    if n not in warm_seeders
+                ]
+                cold_fanout = [
+                    d for n, d in cold["durations"].items()
+                    if n not in set(cold["seeders"])
+                ]
+                warm_misses = sum(
+                    r["misses"] for r in warm["results"].values()
+                )
+                warm_hits = sum(r["hits"] for r in warm["results"].values())
+                hit_nodes = sum(
+                    1 for n, r in warm["results"].items()
+                    if n not in warm_seeders and r["hits"] > 0
+                )
+
+                def _dominant(roll: dict):
+                    # p50, not mean: the warm wave still contains exactly
+                    # one cold compile per kind (the seeders, by design),
+                    # and the claim under test is about the TYPICAL node's
+                    # critical path — the median — not an average the two
+                    # seeders can drag
+                    if not roll or "compile" not in roll:
+                        return None
+                    compile_p50 = roll["compile"]["p50"]
+                    return all(
+                        compile_p50 > r["p50"]
+                        for p, r in roll.items() if p != "compile"
+                    )
+
+                result.update({
+                    "programs_per_node": n_programs,
+                    "cold": {
+                        **_percentiles(cold_all),
+                        "wall_s": cold["wall_s"],
+                        "max_in_flight": cold["max_in_flight"],
+                    },
+                    "warm": {
+                        **_percentiles(list(warm["durations"].values())),
+                        "fanout": _percentiles(warm_fanout),
+                        "wall_s": warm["wall_s"],
+                        "max_in_flight": warm["max_in_flight"],
+                        "seeders": sorted(warm_seeders),
+                        "hits": warm_hits,
+                        "misses": warm_misses,
+                        "hit_nodes": hit_nodes,
+                    },
+                    "join_cold_p99": _percentiles(cold_fanout)["p99"],
+                    "join_warm_p99": _percentiles(warm_fanout)["p99"],
+                    "cold_compile_dominant": _dominant(cold_roll),
+                    "warm_compile_dominant": _dominant(warm_roll),
+                    "cold_phase_p50": {
+                        p: round(r["p50"], 4) for p, r in cold_roll.items()
+                    },
+                    "warm_phase_p50": {
+                        p: round(r["p50"], 4) for p, r in warm_roll.items()
+                    },
+                })
+                result["warm_speedup_p99"] = round(
+                    result["join_cold_p99"] / max(1e-9, result["join_warm_p99"]), 2
+                )
+        finally:
+            await client.close()
+
+    failures = []
+    if result["warm_speedup_p99"] < JOIN_WARM_SPEEDUP_GATE:
+        failures.append(
+            f"warm join p99 only {result['warm_speedup_p99']}x better than "
+            f"cold (gate {JOIN_WARM_SPEEDUP_GATE}x): "
+            f"cold {result['join_cold_p99']}s vs warm {result['join_warm_p99']}s"
+        )
+    expected_misses = len(kinds) * result["programs_per_node"]
+    if result["warm"]["misses"] != expected_misses:
+        failures.append(
+            f"warm wave compiled {result['warm']['misses']} programs, "
+            f"expected exactly one seeder compile per kind "
+            f"({expected_misses})"
+        )
+    if result["warm"]["hit_nodes"] != n_nodes - len(kinds):
+        failures.append(
+            f"only {result['warm']['hit_nodes']} warm-pool nodes hit the "
+            f"fleet cache (expected {n_nodes - len(kinds)})"
+        )
+    if result["cold_compile_dominant"] is not True:
+        failures.append(
+            "compile did not dominate the COLD join phase rollups "
+            f"({result['cold_compile_dominant']})"
+        )
+    if result["warm_compile_dominant"] is not False:
+        failures.append(
+            "compile still dominates the WARM join phase rollups "
+            f"({result['warm_compile_dominant']})"
+        )
+    for tag in ("cold", "warm"):
+        if result[tag]["max_in_flight"] > result["budget"]:
+            failures.append(
+                f"{tag} wave exceeded the disruption budget: "
+                f"{result[tag]['max_in_flight']} in flight > {result['budget']}"
+            )
+    result["ok"] = not failures
+    result["failures"] = failures
+    return result
+
+
+def run_join_soak(n_nodes: int = 12, seed: int = 1) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # chip-free tier
+    print(f"  join tier: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_join_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  join FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  join tier: cold p99 {result.get('join_cold_p99')}s vs warm p99 "
+        f"{result.get('join_warm_p99')}s ({result.get('warm_speedup_p99')}x), "
+        f"seeders {result.get('warm', {}).get('seeders')}, "
+        f"hits {result.get('warm', {}).get('hits')} / "
+        f"misses {result.get('warm', {}).get('misses')}, "
+        f"budget {result.get('budget')} (max in-flight cold "
+        f"{result.get('cold', {}).get('max_in_flight')} / warm "
+        f"{result.get('warm', {}).get('max_in_flight')}), "
+        f"compile dominant cold {result.get('cold_compile_dominant')} -> warm "
+        f"{result.get('warm_compile_dominant')}, "
         f"{'OK' if result['ok'] else 'FAILED'}",
         file=sys.stderr,
     )
@@ -2076,6 +2535,7 @@ def _bench_metrics(output: dict) -> dict:
 
     put("join_to_validated_s", output.get("value"))
     put("join_to_schedulable_s", detail.get("join_to_schedulable_s"))
+    put("join_warm_p99", detail.get("join_warm_p99"))
     put("revalidation_s", detail.get("revalidation_s"))
     put("tflops", output.get("tflops") or matmul.get("tflops"))
     put("mfu", output.get("mfu") or matmul.get("mfu"))
@@ -2344,6 +2804,24 @@ def _int_arg(flag: str, default: int) -> int:
 
 
 def main() -> None:
+    # `bench.py --join [--nodes 12] [--seed 1]`: fleet compile cache +
+    # warm-pool validation tier (no chip needed) — `make bench-join`.
+    # Gated: warm join p99 ≥2x better than cold, one seeder compile per
+    # kind, compile dominance flipping cold→warm, disruption budget held.
+    if "--join" in sys.argv:
+        result = run_join_soak(
+            n_nodes=_int_arg("--nodes", 12), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "join_warm_p99",
+            "value": result.get("join_warm_p99"),
+            "unit": "s",
+            "warm_speedup_p99": result.get("warm_speedup_p99"),
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
     # `bench.py --fleet-obs [--nodes 100] [--seed 1]`: fleet telemetry
     # plane acceptance soak (no chip needed) — `make fleet-obs`
     if "--fleet-obs" in sys.argv:

@@ -3,12 +3,16 @@
 
 Sibling of check_exception_hygiene.py.  Walks the packages whose files are
 *read back as evidence* — the workloads (checkpoint snapshots, results
-drop-boxes), the validator (ready markers, status files), and the obs layer
-(flight records) — and rejects any write-mode ``open(..., "w"/"wb")`` whose
-publish is not atomic: a crash mid-write must leave either the previous
-complete file or nothing, never a truncated file a reader would trust
-(docs/ROBUSTNESS.md "Live migration" is gated on exactly this property for
-checkpoint manifests).
+drop-boxes, compile-cache artifact envelopes), the validator (ready
+markers, status files), the obs layer (flight records), and the
+controllers (the operator-side fleet compile cache publishes artifacts
+through its routes) — and rejects any write-mode ``open(..., "w"/"wb")``
+whose publish is not atomic: a crash mid-write must leave either the
+previous complete file or nothing, never a truncated file a reader would
+trust (docs/ROBUSTNESS.md "Live migration" is gated on exactly this
+property for checkpoint manifests; a torn compile-cache artifact would be
+rejected by its integrity hash, but only a whole-file publish keeps the
+PREVIOUS executable servable through a crash).
 
 A write-mode open is accepted when either
 
@@ -32,6 +36,9 @@ PACKAGES = (
     "tpu_operator/workloads",
     "tpu_operator/validator",
     "tpu_operator/obs",
+    # the fleet compile cache's server side (Manager /compile-cache/*
+    # ingest) lives here; its artifact publication must stay tmp+replace
+    "tpu_operator/controllers",
 )
 
 WRITE_MODES = {"w", "wb", "w+", "wb+", "wt"}

@@ -46,11 +46,12 @@ NODE_LIST_ALLOWLIST = {
     ("clusterinfo.py", "gather"),             # context gatherer (callers pass nodes=)
     ("labels.py", "label_tpu_nodes"),         # the full-walk's label engine
     ("nodes.py", "prime"),                    # one-shot index seed at plane start
-    ("tpuruntime.py", "_reconcile"),          # per-CR pool derivation (cached-read TODO)
-    ("tpuruntime.py", "_selector_conflicts"), # cross-CR conflict validation
+    ("tpuruntime.py", "_reconcile"),          # per-CR pool derivation (informer-cached reads)
+    ("tpuruntime.py", "_selector_conflicts"), # cross-CR conflict validation (cached)
     ("upgrade.py", "_reconcile"),             # fleet-keyed upgrade state machine
     ("remediation.py", "_reconcile"),         # fleet-keyed remediation sweep
     ("health.py", "_reconcile"),              # fleet-keyed health engine pass
+    ("revalidation.py", "_reconcile"),        # fleet-keyed wave scheduling sweep
 }
 
 

@@ -1,0 +1,437 @@
+"""Compile-artifact cache tests (workloads/compile_cache.py + warmpool.py):
+keying, integrity, LRU, concurrency, and the fleet seeding plane over the
+Manager's /compile-cache/* surface."""
+
+import concurrent.futures
+import json
+import os
+import threading
+
+import aiohttp
+import pytest
+
+from tpu_operator.controllers.runtime import Manager
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import flight
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.workloads import compile_cache as cc
+from tpu_operator.workloads import warmpool
+
+NS = "tpu-operator"
+
+KEY = cc.CacheKey(
+    generation="v5e", topology="2x4",
+    jax_version="0.4.37", libtpu_version="lib-1", program="prog:abc",
+)
+
+
+# ----------------------------------------------------------------------
+# keying
+
+
+def test_key_changes_with_every_field():
+    base = KEY.fingerprint()
+    for variant in (
+        cc.CacheKey(**{**KEY.__dict__, "generation": "v5p"}),
+        cc.CacheKey(**{**KEY.__dict__, "topology": "4x4"}),
+        cc.CacheKey(**{**KEY.__dict__, "jax_version": "0.4.38"}),
+        cc.CacheKey(**{**KEY.__dict__, "libtpu_version": "lib-2"}),
+        cc.CacheKey(**{**KEY.__dict__, "program": "prog:def"}),
+    ):
+        assert variant.fingerprint() != base
+    # deterministic: the same fields always address the same artifact
+    assert cc.CacheKey(**KEY.__dict__).fingerprint() == base
+
+
+def test_kind_excludes_program():
+    other_program = cc.CacheKey(**{**KEY.__dict__, "program": "prog:def"})
+    assert other_program.kind() == KEY.kind()
+    other_hw = cc.CacheKey(**{**KEY.__dict__, "topology": "4x4"})
+    assert other_hw.kind() != KEY.kind()
+
+
+def test_store_miss_on_key_mismatch(tmp_path):
+    """Distinct keys never alias: a store holding one program's artifact
+    misses for a sibling key even though the kind matches."""
+    store = cc.ArtifactStore(str(tmp_path))
+    store.put(KEY, b"payload-a")
+    sibling = cc.CacheKey(**{**KEY.__dict__, "jax_version": "9.9.9"})
+    assert store.get(sibling) is None
+    assert store.get(KEY) == b"payload-a"
+
+
+# ----------------------------------------------------------------------
+# integrity: corrupt/truncated artifacts are rejected and recompiled
+
+
+def test_truncated_artifact_rejected(tmp_path):
+    store = cc.ArtifactStore(str(tmp_path))
+    path = store.put(KEY, b"x" * 1024)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:-100])  # torn tail
+    assert store.get(KEY) is None
+    assert store.stats.corrupt == 1
+    assert not os.path.exists(path)  # pruned so the next put republishes
+
+
+def test_bitflip_rejected(tmp_path):
+    store = cc.ArtifactStore(str(tmp_path))
+    path = store.put(KEY, b"y" * 1024)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    data[-1] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    assert store.get(KEY) is None
+    assert store.stats.corrupt == 1
+
+
+def test_mislabeled_artifact_never_served(tmp_path):
+    """An artifact whose embedded key differs from the requested one (a
+    renamed file, a content-addressing bug) must miss — never hand back a
+    wrong executable."""
+    store = cc.ArtifactStore(str(tmp_path))
+    other = cc.CacheKey(**{**KEY.__dict__, "generation": "v5p"})
+    store.put(other, b"wrong-hardware")
+    # forge: move the other key's artifact onto KEY's address
+    os.replace(store.path_for(other), store.path_for(KEY))
+    assert store.get(KEY) is None
+    assert store.stats.corrupt == 1
+
+
+def test_get_or_compile_recompiles_after_corruption(tmp_path):
+    store = cc.ArtifactStore(str(tmp_path))
+    compiles = []
+
+    def compile_fn():
+        compiles.append(1)
+        return b"fresh" * 100
+
+    payload, hit = store.get_or_compile(KEY, compile_fn)
+    assert not hit and len(compiles) == 1
+    with open(store.path_for(KEY), "wb") as f:
+        f.write(b"garbage")
+    payload, hit = store.get_or_compile(KEY, compile_fn)
+    assert not hit and len(compiles) == 2 and payload == b"fresh" * 100
+    _, hit = store.get_or_compile(KEY, compile_fn)
+    assert hit and len(compiles) == 2
+
+
+def test_envelope_parse_rejects_bad_magic_and_name():
+    envelope = cc.build_envelope(KEY, b"abc")
+    key, header, payload = cc.parse_envelope(envelope)
+    assert key == KEY and payload == b"abc"
+    with pytest.raises(cc.CorruptArtifact):
+        cc.parse_envelope(b"not-json\n" + b"abc")
+    # name/key consistency: tampering with the key without re-addressing
+    head, _, body = envelope.partition(b"\n")
+    doc = json.loads(head)
+    doc["key"]["generation"] = "v5p"
+    with pytest.raises(cc.CorruptArtifact):
+        cc.parse_envelope(json.dumps(doc).encode() + b"\n" + body)
+
+
+# ----------------------------------------------------------------------
+# LRU eviction respects the size bound
+
+
+def test_lru_eviction_respects_bound(tmp_path):
+    payload = b"z" * 1000
+    envelope_overhead = len(cc.build_envelope(KEY, payload)) - len(payload)
+    # room for ~3 entries
+    store = cc.ArtifactStore(str(tmp_path), max_bytes=3 * (1000 + envelope_overhead) + 10)
+    keys = [
+        cc.CacheKey(**{**KEY.__dict__, "program": f"prog:{i}"}) for i in range(5)
+    ]
+    for i, key in enumerate(keys):
+        store.put(key, payload)
+        # strictly increasing mtimes even on coarse filesystem clocks
+        os.utime(store.path_for(key), (i, i)) if os.path.exists(
+            store.path_for(key)
+        ) else None
+        store._evict_lru()
+    assert store.total_bytes() <= store.max_bytes
+    assert store.stats.evictions >= 2
+    # newest entries survived, oldest were evicted
+    assert store.get(keys[0]) is None
+    assert store.get(keys[-1]) == payload
+
+
+def test_oversized_single_artifact_not_pinned(tmp_path):
+    store = cc.ArtifactStore(str(tmp_path), max_bytes=100)
+    store.put(KEY, b"w" * 1000)
+    assert store.total_bytes() <= 100  # evicted: bigger than the whole budget
+
+
+# ----------------------------------------------------------------------
+# concurrency: parallel validators on one node never tear an entry
+
+
+def test_concurrent_get_or_compile_never_tears(tmp_path):
+    store = cc.ArtifactStore(str(tmp_path))
+    payload = b"P" * 20000
+    start = threading.Barrier(8)
+    failures = []
+
+    def worker(i):
+        local = cc.ArtifactStore(str(tmp_path))  # own stats, shared dir
+        start.wait()
+        for _ in range(20):
+            got, _ = local.get_or_compile(KEY, lambda: payload)
+            if got != payload:
+                failures.append((i, len(got)))
+            data = local.read_envelope(KEY.fingerprint())
+            if data is not None:
+                try:
+                    _, _, body = cc.parse_envelope(data)
+                except cc.CorruptArtifact as e:
+                    failures.append((i, str(e)))
+                else:
+                    if body != payload:
+                        failures.append((i, "wrong payload"))
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        list(pool.map(worker, range(8)))
+    assert not failures
+    assert store.get(KEY) == payload
+
+
+# ----------------------------------------------------------------------
+# enable(): an unusable path leaves a named flight sample
+
+
+def test_enable_unusable_path_records_disabled_sample(tmp_path, monkeypatch):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("file, not dir")
+    monkeypatch.setenv("TPU_COMPILE_CACHE", str(blocker / "cache"))
+    recorder = flight.FlightRecorder()
+    with flight.activate(recorder):
+        assert cc.enable() is None
+    samples = [
+        s for s in recorder.samples if s["phase"] == "compile_cache_disabled"
+    ]
+    assert len(samples) == 1
+    assert samples[0]["metrics"]["compile_cache_disabled"] == 1.0
+    assert samples[0]["metrics"]["reason"]  # names WHY, for /debug/explain
+
+
+def test_enable_off_by_default(monkeypatch):
+    monkeypatch.delenv("TPU_COMPILE_CACHE", raising=False)
+    assert cc.enable() is None
+    monkeypatch.setenv("TPU_COMPILE_CACHE", "0")
+    assert cc.enable() is None
+
+
+# ----------------------------------------------------------------------
+# fleet plane: ingest verification, idempotence, index, HTTP round trip
+
+
+def test_fleet_cache_ingest_rejects_corrupt(tmp_path):
+    fleet = cc.FleetCompileCache(str(tmp_path))
+    envelope = cc.build_envelope(KEY, b"payload")
+    ok, name = fleet.ingest(envelope)
+    assert ok and name == KEY.fingerprint()
+    ok, _ = fleet.ingest(envelope)  # idempotent re-publish
+    assert ok
+    ok, err = fleet.ingest(envelope[:-3])
+    assert not ok and "sha256" in err or "truncated" in err
+    assert [e["name"] for e in fleet.index(KEY.kind())] == [KEY.fingerprint()]
+    assert fleet.has_kind(KEY.kind())
+    assert not fleet.has_kind("0" * 64)
+
+
+async def test_seeding_plane_over_manager_http(tmp_path):
+    """Seeder publishes through POST /compile-cache/artifact; a warm node
+    prewarms its own store from the index and pays disk, not compiler."""
+    fleet_dir = tmp_path / "fleet"
+    metrics = OperatorMetrics()
+    fleet_cache = cc.FleetCompileCache(str(fleet_dir), metrics=metrics)
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1,
+                metrics_registry=metrics.registry, operator_metrics=metrics,
+                compile_cache=fleet_cache,
+            )
+            async with mgr:
+                base = f"http://127.0.0.1:{mgr.metrics_port}"
+                http_client = cc.FleetCacheClient(base)
+                fields = dict(
+                    generation="v5e", topology="2x4",
+                    jax_version="0.4.37", libtpu_version="lib-1",
+                )
+                kind = cc.kind_fingerprint(**fields)
+                loop_run = __import__("asyncio").get_event_loop().run_in_executor
+
+                # seeder: compiles (simulated), publishes
+                seeder = cc.ArtifactStore(str(tmp_path / "seeder"))
+                key = cc.CacheKey(program="prog:abc", **fields)
+                seeder.put(key, b"executable-bytes")
+                published = await loop_run(
+                    None, cc.publish_kind, seeder, kind, http_client
+                )
+                assert published == 1
+
+                # warm node: prewarm hits the fleet artifact
+                warm = cc.ArtifactStore(str(tmp_path / "warm"))
+                fetched = await loop_run(None, cc.prewarm, warm, kind, http_client)
+                assert fetched == 1
+                assert warm.get(key) == b"executable-bytes"
+
+                # direct surface checks: index + 404 + corrupt upload
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(
+                        f"{base}/compile-cache/index", params={"kind": kind}
+                    ) as resp:
+                        assert resp.status == 200
+                        doc = await resp.json()
+                        assert doc["artifacts"][0]["name"] == key.fingerprint()
+                    async with http.get(
+                        f"{base}/compile-cache/artifact/{'0' * 64}"
+                    ) as resp:
+                        assert resp.status == 404
+                    async with http.post(
+                        f"{base}/compile-cache/artifact", data=b"garbage"
+                    ) as resp:
+                        assert resp.status == 400
+    assert metrics.compile_cache_artifacts._value.get() == 1
+
+
+# ----------------------------------------------------------------------
+# warmpool: real jax programs end to end (CPU backend)
+
+
+def test_warmpool_cold_then_warm(tmp_path):
+    fields = dict(
+        generation="v5e", topology="2x4",
+        jax_version="t", libtpu_version="t",
+    )
+    cold_store = cc.ArtifactStore(str(tmp_path))
+    cold = warmpool.run(store=cold_store, client=cc.FleetCacheClient(""), fields=fields)
+    assert cold["ok"] and cold["misses"] == cold["programs"] and cold["hits"] == 0
+    assert cold["compile_s"] > 0
+
+    warm_store = cc.ArtifactStore(str(tmp_path))  # same dir, fresh stats
+    warm = warmpool.run(store=warm_store, client=cc.FleetCacheClient(""), fields=fields)
+    assert warm["ok"] and warm["hits"] == warm["programs"] and warm["misses"] == 0
+    assert warm["compile_s"] == 0
+    # the warm path loads serialized executables: it must be much cheaper
+    assert warm["fetch_s"] < cold["compile_s"]
+
+
+def test_warmpool_runs_without_any_cache():
+    result = warmpool.run(store=None, client=cc.FleetCacheClient(""), fields=dict(
+        generation="", topology="", jax_version="t", libtpu_version="t",
+    ))
+    assert result["ok"] and result["programs"] == 3
+
+
+# ----------------------------------------------------------------------
+# agent relay: workload pods reach the fleet cache through the node hop
+
+
+async def test_agent_relay_round_trip(tmp_path, monkeypatch):
+    import asyncio
+
+    from tpu_operator.agents import metrics_agent
+
+    metrics = OperatorMetrics()
+    fleet_cache = cc.FleetCompileCache(str(tmp_path / "fleet"), metrics=metrics)
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            mgr = Manager(
+                client, NS, metrics_port=0, health_port=-1,
+                metrics_registry=metrics.registry, operator_metrics=metrics,
+                compile_cache=fleet_cache,
+            )
+            async with mgr:
+                operator_base = f"http://127.0.0.1:{mgr.metrics_port}"
+                monkeypatch.setenv(cc.FLEET_CACHE_URL_ENV, operator_base)
+                stop = asyncio.Event()
+                agent_task = asyncio.create_task(
+                    metrics_agent.serve(15599, stop)
+                )
+                try:
+                    await asyncio.sleep(0.2)
+                    relay = cc.FleetCacheClient("http://127.0.0.1:15599")
+                    run = asyncio.get_event_loop().run_in_executor
+
+                    seeder = cc.ArtifactStore(str(tmp_path / "seeder"))
+                    seeder.put(KEY, b"relayed-executable")
+                    published = await run(
+                        None, cc.publish_kind, seeder, KEY.kind(), relay
+                    )
+                    assert published == 1
+
+                    warm = cc.ArtifactStore(str(tmp_path / "warm"))
+                    fetched = await run(None, cc.prewarm, warm, KEY.kind(), relay)
+                    assert fetched == 1
+                    assert warm.get(KEY) == b"relayed-executable"
+
+                    # the relay validates at the hop: junk names/kinds are
+                    # rejected locally, never forwarded
+                    async with aiohttp.ClientSession() as http:
+                        async with http.get(
+                            "http://127.0.0.1:15599/compile-cache/index",
+                            params={"kind": "not-a-fingerprint"},
+                        ) as resp:
+                            assert resp.status == 400
+                        async with http.get(
+                            "http://127.0.0.1:15599/compile-cache/artifact/../etc"
+                        ) as resp:
+                            assert resp.status in (400, 404)
+                finally:
+                    stop.set()
+                    await asyncio.gather(agent_task, return_exceptions=True)
+
+
+# ----------------------------------------------------------------------
+# review hardening: restricted unpickler + index/eviction coherence
+
+
+def test_load_serialized_refuses_pickle_gadgets():
+    """A crafted payload naming a global outside the jax/numpy allowlist
+    must fail CorruptArtifact-style, never resolve the callable — on
+    BOTH pickle layers (the outer triple and the inner executable)."""
+    import pickle
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    with pytest.raises(cc.CorruptArtifact):
+        cc.load_serialized(pickle.dumps((Evil(), None, None)))
+    # inner layer: a valid-looking outer triple whose serialized bytes
+    # carry the gadget
+    inner = pickle.dumps(Evil())
+    with pytest.raises(cc.CorruptArtifact):
+        cc.load_serialized(pickle.dumps((inner, None, None)))
+
+
+def test_load_serialized_round_trips_real_executable():
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda x: (x * 2).sum()).lower(jnp.ones((8,))).compile()
+    payload = cc.serialize_compiled(compiled)
+    loaded = cc.load_serialized(payload)
+    assert float(loaded(jnp.ones((8,)))) == 16.0
+
+
+def test_fleet_index_prunes_evicted_artifacts(tmp_path):
+    """LRU eviction under the fleet cache must not leave phantom index
+    entries (fetch-404s) or a permanently-full artifact cap; a re-publish
+    of an evicted name must re-store, not answer 'duplicate'."""
+    fleet = cc.FleetCompileCache(str(tmp_path), max_bytes=1)  # evict-everything bound
+    envelope = cc.build_envelope(KEY, b"payload")
+    ok, name = fleet.ingest(envelope)
+    assert ok
+    # the 1-byte bound evicted the file immediately
+    assert fleet.store.read_envelope(name) is None
+    assert fleet.index(KEY.kind()) == []       # no phantom entries served
+    assert not fleet.has_kind(KEY.kind())      # warmness reflects reality
+    ok, again = fleet.ingest(envelope)         # re-publish re-stores
+    assert ok and again == name

@@ -1,0 +1,203 @@
+"""Revalidation coordinator tests (controllers/revalidation.py): herd
+intake, seeder-first promotion, disruption-budget bounding, and the
+remediation handshake."""
+
+from tpu_operator import consts
+from tpu_operator.api.types import TPUClusterPolicy
+from tpu_operator.controllers.remediation import RemediationReconciler, REVALIDATING
+from tpu_operator.controllers.revalidation import RevalidationCoordinator, node_kind
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+async def _cluster(fc, n_per_kind=6, kinds=(("tpu-v5-lite-podslice", "2x4"), ("tpu-v5p-slice", "4x4")),
+                   budget="25%"):
+    client = ApiClient(Config(base_url=fc.base_url))
+    await client.create(TPUClusterPolicy.new(spec={
+        "health": {"maxUnhealthyPercent": budget},
+    }).obj)
+    names = []
+    for k, (acc, topo) in enumerate(kinds):
+        for i in range(n_per_kind):
+            name = f"n{k}-{i}"
+            fc.add_node(name, accelerator=acc, topology=topo)
+            names.append(name)
+    return client, names
+
+
+async def _label(client, name):
+    node = await client.get("", "Node", name)
+    return (deep_get(node, "metadata", "labels", default={}) or {}).get(
+        consts.VALIDATE_REQUEST_LABEL
+    )
+
+
+async def _stamp(client, name, value):
+    await client.patch(
+        "", "Node", name,
+        {"metadata": {"labels": {consts.VALIDATE_REQUEST_LABEL: value}}},
+    )
+
+
+async def _complete(client, name, healthy=True):
+    """Simulate the remediation machine finishing a node: clear the
+    request label, leave a terminal remediation state."""
+    state = "healthy" if healthy else "remediation-failed"
+    await client.patch(
+        "", "Node", name,
+        {"metadata": {"labels": {
+            consts.VALIDATE_REQUEST_LABEL: None,
+            consts.REMEDIATION_STATE_LABEL: state,
+        }}},
+    )
+
+
+async def test_node_kind_includes_runtime_version():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("a", labels={consts.TFD_RUNTIME_VERSION_LABEL: "1.0"})
+        fc.add_node("b", labels={consts.TFD_RUNTIME_VERSION_LABEL: "2.0"})
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            a = await client.get("", "Node", "a")
+            b = await client.get("", "Node", "b")
+            assert node_kind(a) != node_kind(b)  # upgrade rotates the kind
+        finally:
+            await client.close()
+
+
+async def test_herd_demoted_and_seeders_kept():
+    """A fleet-wide validate=requested stamp beyond the budget is batched:
+    one seeder per kind keeps its label, the rest queue as pending."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(fc)  # 12 nodes, 2 kinds, budget 3
+        try:
+            for name in names:
+                await _stamp(client, name, consts.VALIDATE_REQUESTED)
+            metrics = OperatorMetrics()
+            coord = RevalidationCoordinator(client, NS, metrics=metrics)
+            await coord.reconcile("revalidation")
+            requested = [n for n in names if await _label(client, n) == "requested"]
+            pending = [n for n in names if await _label(client, n) == "pending"]
+            assert len(requested) <= 3
+            assert len(requested) + len(pending) == 12
+            # one seeder per kind among the kept nodes
+            kinds = set()
+            for n in requested:
+                node = await client.get("", "Node", n)
+                kinds.add(node_kind(node))
+            assert len(kinds) == 2
+        finally:
+            await client.close()
+
+
+async def test_seeder_first_then_warm_fanout_under_budget():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(fc)  # budget 3
+        try:
+            for name in names:
+                await _stamp(client, name, consts.VALIDATE_PENDING)
+            coord = RevalidationCoordinator(client, NS)
+            await coord.reconcile("revalidation")
+            requested = [n for n in names if await _label(client, n) == "requested"]
+            # cold kinds: exactly one seeder each, NOT the full budget —
+            # fan-out before the kind is warm would all compile cold
+            assert len(requested) == 2
+            max_in_flight = len(requested)
+
+            # seeders complete → kinds warm → fan-out fills the budget
+            for n in requested:
+                await _complete(client, n)
+            await coord.reconcile("revalidation")
+            requested = [n for n in names if await _label(client, n) == "requested"]
+            assert 0 < len(requested) <= 3
+            max_in_flight = max(max_in_flight, len(requested))
+
+            # drain the wave; the in-flight set never exceeds the budget
+            for _ in range(12):
+                for n in list(requested):
+                    await _complete(client, n)
+                await coord.reconcile("revalidation")
+                requested = [
+                    n for n in names if await _label(client, n) == "requested"
+                ]
+                max_in_flight = max(max_in_flight, len(requested))
+                if not requested:
+                    break
+            assert max_in_flight <= 3
+            assert not requested
+            pending = [n for n in names if await _label(client, n) == "pending"]
+            assert not pending
+        finally:
+            await client.close()
+
+
+async def test_single_manual_request_passes_through():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(fc)
+        try:
+            await _stamp(client, names[0], consts.VALIDATE_REQUESTED)
+            coord = RevalidationCoordinator(client, NS)
+            await coord.reconcile("revalidation")
+            assert await _label(client, names[0]) == "requested"  # untouched
+        finally:
+            await client.close()
+
+
+async def test_warm_fn_skips_seeding():
+    """A kind the fleet cache already holds fans out immediately."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(fc)
+        try:
+            for name in names:
+                await _stamp(client, name, consts.VALIDATE_PENDING)
+            coord = RevalidationCoordinator(client, NS, warm_fn=lambda kind: True)
+            await coord.reconcile("revalidation")
+            requested = [n for n in names if await _label(client, n) == "requested"]
+            assert len(requested) == 3  # straight to budget-bounded fan-out
+        finally:
+            await client.close()
+
+
+async def test_failed_seeder_replaced():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(
+            fc, n_per_kind=4, kinds=(("tpu-v5-lite-podslice", "2x4"),),
+        )
+        try:
+            for name in names:
+                await _stamp(client, name, consts.VALIDATE_PENDING)
+            coord = RevalidationCoordinator(client, NS)
+            await coord.reconcile("revalidation")
+            seeder = [n for n in names if await _label(client, n) == "requested"]
+            assert len(seeder) == 1
+            await _complete(client, seeder[0], healthy=False)
+            await coord.reconcile("revalidation")
+            second = [n for n in names if await _label(client, n) == "requested"]
+            # the failed seeder did not warm the kind: exactly one NEW
+            # seeder is promoted, not a cold thundering fan-out
+            assert len(second) == 1 and second[0] != seeder[0]
+        finally:
+            await client.close()
+
+
+async def test_remediation_never_admits_pending():
+    """The handshake: pending is the coordinator's queueing value and the
+    remediation machine must not react to it."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client, names = await _cluster(
+            fc, n_per_kind=2, kinds=(("tpu-v5-lite-podslice", "2x4"),),
+        )
+        try:
+            await _stamp(client, names[0], consts.VALIDATE_PENDING)
+            rem = RemediationReconciler(client, NS)
+            await rem.reconcile("remediation")
+            node = await client.get("", "Node", names[0])
+            labels = deep_get(node, "metadata", "labels", default={}) or {}
+            assert labels.get(consts.VALIDATE_REQUEST_LABEL) == "pending"
+            assert labels.get(consts.REMEDIATION_STATE_LABEL) != REVALIDATING
+        finally:
+            await client.close()

@@ -68,6 +68,12 @@ WORKLOAD_COUNTERS = (
     "tpu_workload_tokens_per_sec",
     "tpu_workload_overhead_dominated",
     "tpu_workload_steps_total",
+    # compile-artifact cache counters (workloads/compile_cache.py): pushed
+    # by validation workloads so the fleet plane sees hit/miss/bytes per
+    # node — the evidence behind the warm-pool join gate
+    "tpu_workload_compile_cache_hits_total",
+    "tpu_workload_compile_cache_misses_total",
+    "tpu_workload_compile_cache_bytes_total",
 )
 
 # HELP text per counter: the exposition format wants a # HELP line per
@@ -88,6 +94,9 @@ COUNTER_HELP = {
     "tpu_workload_tokens_per_sec": "Workload training/serving throughput in tokens/s",
     "tpu_workload_overhead_dominated": "1 when the workload measurement was overhead-dominated",
     "tpu_workload_steps_total": "Workload telemetry samples recorded",
+    "tpu_workload_compile_cache_hits_total": "Compile-artifact cache hits (executables loaded from disk instead of compiled)",
+    "tpu_workload_compile_cache_misses_total": "Compile-artifact cache misses (programs that paid the XLA compiler)",
+    "tpu_workload_compile_cache_bytes_total": "Bytes read+written through the node's compile-artifact store",
 }
 
 
@@ -474,10 +483,71 @@ async def serve(
             )
         return web.json_response({"accepted": accepted})
 
+    # compile-artifact cache relay (workloads/compile_cache.py): workload
+    # pods on this node reach the operator's /compile-cache/* surface
+    # through the agent hop, same as their /push telemetry rides the
+    # FleetForwarder.  The relay enforces the cache's own discipline at
+    # this hop too — artifact names must be content digests, kind
+    # fingerprints must look like fingerprints, and POST bodies are capped
+    # — so a hostile client cannot launder garbage through the node port.
+    from tpu_operator.workloads import compile_cache as cc
+
+    cache_base = os.environ.get(cc.FLEET_CACHE_URL_ENV, "") or (
+        fleet_url.rsplit("/push", 1)[0] if fleet_url.endswith("/push") else ""
+    )
+
+    async def cc_relay(request: web.Request) -> web.Response:
+        if not cache_base:
+            return web.json_response(
+                {"error": "no fleet cache configured"}, status=404
+            )
+        tail = request.match_info.get("tail", "")
+        if request.method == "GET" and tail == "index":
+            kind = request.rel_url.query.get("kind", "")
+            if not cc.valid_artifact_name(kind):
+                return web.json_response({"error": "bad kind"}, status=400)
+            url = f"{cache_base}/compile-cache/index?kind={kind}"
+            body = None
+        elif request.method == "GET" and tail.startswith("artifact/"):
+            name = tail[len("artifact/"):]
+            if not cc.valid_artifact_name(name):
+                return web.json_response({"error": "bad artifact name"}, status=400)
+            url = f"{cache_base}/compile-cache/artifact/{name}"
+            body = None
+        elif request.method == "POST" and tail == "artifact":
+            from tpu_operator.obs.fleet import read_bytes_capped
+
+            # capped looping read (shared helper): a multi-megabyte
+            # envelope spans many TCP segments and a single read would
+            # truncate every large artifact at the hop
+            body, error = await read_bytes_capped(request, cc.ARTIFACT_MAX_BYTES)
+            if error is not None:
+                return error
+            url = f"{cache_base}/compile-cache/artifact"
+        else:
+            return web.json_response({"error": "unknown route"}, status=404)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.request(
+                    request.method, url, data=body,
+                    timeout=aiohttp.ClientTimeout(total=10),
+                ) as resp:
+                    payload = await resp.read()
+                    return web.Response(
+                        body=payload, status=resp.status,
+                        content_type=resp.content_type,
+                    )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return web.json_response(
+                {"error": f"fleet cache unreachable: {e}"}, status=502
+            )
+
     app = web.Application()
     app.router.add_get("/counters", counters_handler)
     app.router.add_get("/metrics", metrics_handler)
     app.router.add_post("/push", push_handler)
+    app.router.add_get("/compile-cache/{tail:.*}", cc_relay)
+    app.router.add_post("/compile-cache/{tail:.*}", cc_relay)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, "0.0.0.0", port)

@@ -95,6 +95,13 @@ async def run(args: argparse.Namespace) -> None:
     recorder = EventRecorder(client, namespace)
     explain = ExplainEngine(fleet=fleet, tracer=tracer)
     recorder.sink = explain.observe_event
+    # fleet compile-artifact cache: enabled by pointing TPU_FLEET_CACHE_DIR
+    # at a writable dir; the Manager then serves /compile-cache/* next to
+    # /push (docs/PERFORMANCE.md "Compile cache & warm-pool validation")
+    from tpu_operator.workloads.compile_cache import FleetCompileCache
+
+    cc_dir = os.environ.get(consts.FLEET_CACHE_DIR_ENV, "")
+    compile_cache = FleetCompileCache(cc_dir, metrics=metrics) if cc_dir else None
     mgr = Manager(
         client,
         namespace,
@@ -110,11 +117,13 @@ async def run(args: argparse.Namespace) -> None:
         operator_metrics=metrics,
         fleet=fleet,
         explain=explain,
+        compile_cache=compile_cache,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
     from tpu_operator.controllers.health import HealthReconciler
     from tpu_operator.controllers.remediation import RemediationReconciler
+    from tpu_operator.controllers.revalidation import RevalidationCoordinator
     from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
@@ -138,6 +147,18 @@ async def run(args: argparse.Namespace) -> None:
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)
     RemediationReconciler(client, namespace, **obs).setup(mgr)
+    # warm-pool wave scheduling in front of remediation (seeder-first,
+    # disruption-budget-bounded promotion of validate=pending nodes).  The
+    # fleet cache's kind index is the warmness probe: a kind already
+    # seeded (this wave OR before an operator restart) skips straight to
+    # fan-out.  Coordinator kinds are "accelerator/topology/runtime-ver"
+    # raw label strings; the probe matches on raw key fields, jax version
+    # ignored (the operator cannot know remote validators' jax builds).
+    warm_fn = None
+    if compile_cache is not None:
+        def warm_fn(kind: str, _cc=compile_cache) -> bool:
+            return _cc.has_kind_labels(*(kind.split("/", 2) + ["", ""])[:3])
+    RevalidationCoordinator(client, namespace, warm_fn=warm_fn, **obs).setup(mgr)
     HealthReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
 
     stop = asyncio.Event()

@@ -32,7 +32,13 @@ UPGRADE_STATE_LABEL = "tpu.google.com/tpu-runtime-upgrade-state"
 # Remediation channel: admins/alert-automation set the request label; the
 # remediation controller answers on the state label (no reference analogue —
 # the reference stops at exporting validation state to Prometheus).
-VALIDATE_REQUEST_LABEL = "tpu.google.com/tpu.validate"          # value: requested
+VALIDATE_REQUEST_LABEL = "tpu.google.com/tpu.validate"          # value: requested | pending
+# value "pending": queued behind the revalidation coordinator
+# (controllers/revalidation.py), which promotes pending -> requested in
+# seeder-first batches under the health disruption budget; remediation only
+# ever admits "requested", so pending nodes cost nothing until promoted
+VALIDATE_PENDING = "pending"
+VALIDATE_REQUESTED = "requested"
 REMEDIATION_STATE_LABEL = "tpu.google.com/tpu-remediation-state"
 # Pooled multi-host readiness: slice readiness is a SET property — every host
 # of the slice must advertise capacity before any host is marked ready
@@ -259,6 +265,10 @@ REQUEUE_NOT_READY_SECONDS = 5.0      # clusterpolicy_controller.go:165,193
 REQUEUE_NO_TPU_NODES_SECONDS = 45.0  # :199 (NFD-missing poll analogue)
 UPGRADE_REQUEUE_SECONDS = 120.0      # upgrade_controller.go:58,196
 REMEDIATION_REQUEUE_SECONDS = 30.0   # validation rounds are minutes, not hours
+# Revalidation coordinator cadence while a wave is draining: promotion is
+# event-driven (node label changes kick the key); this is the safety-net
+# revisit so a missed completion event cannot park a wave forever
+REVALIDATION_REQUEUE_SECONDS = 5.0
 # Health-engine cadence: hysteresis windows are tens of seconds, and a
 # sustained bad signal must accumulate observations between passes, so the
 # engine requeues much faster than the upgrade machine
@@ -315,6 +325,11 @@ K8S_BREAKER_RESET_SECONDS = 5.0
 # ring-buffer series fed by the operator's own spans, the node agents'
 # push hop, and informer-cached node evidence — never by extra API reads.
 FLEET_PUSH_ENV = "TPU_FLEET_PUSH_URL"   # agents forward /push traffic here
+# Fleet compile-artifact cache (workloads/compile_cache.py; served by the
+# Manager next to /push, relayed by the node metrics agent).  The operator
+# enables its server side by pointing this at a writable dir; workload pods
+# reach it through TPU_FLEET_CACHE_URL (compile_cache.FLEET_CACHE_URL_ENV).
+FLEET_CACHE_DIR_ENV = "TPU_FLEET_CACHE_DIR"
 FLEET_RING_SAMPLES = 512                # samples kept per (metric, labels) series
 FLEET_MAX_SERIES = 8192                 # distinct series ceiling (cardinality guard)
 FLEET_EVAL_SECONDS = 1.0                # SLO burn-rate evaluation cadence

@@ -1,0 +1,364 @@
+"""Batched revalidation coordinator: warm-pool scheduling of fleet-wide
+re-validation waves.
+
+After an upgrade — or any fleet-wide ``tpu.google.com/tpu.validate``
+stamp — every node's validator re-proves its chips, and (PR 7's
+``join_phase_seconds`` breakdown) the XLA compile inside that proof
+dominates the join→validated critical path.  The compile-artifact cache
+(``workloads/compile_cache.py``) makes the compile shareable per
+(generation, topology, versions) *kind*; this controller makes the fleet
+actually exploit that:
+
+- **Intake** — a thundering herd of ``validate=requested`` nodes beyond
+  the disruption budget is demoted to ``validate=pending`` (a value the
+  remediation controller never admits), so the wave queues behind the
+  coordinator instead of stampeding the chips.  A single manual request
+  inside the budget passes through untouched.
+- **Seeding order** — for each kind with pending nodes, ONE seeder is
+  promoted first.  Its validation compiles cold and publishes the kind's
+  artifacts to the fleet cache; only after it completes (or the fleet
+  cache already holds the kind) does the rest of the kind fan out, each
+  of those nodes pre-warming from the fleet cache and paying disk, not
+  compiler.
+- **Budget** — total in-flight re-validations (promoted + anything the
+  remediation machine is already driving) never exceed the health
+  engine's ``maxUnhealthyPercent`` disruption budget: a re-validation
+  occupies the node's chips exactly like unhealthiness does.
+
+Promotion is label-only actuation: the coordinator patches
+``pending → requested`` and the existing remediation controller does the
+actual admission (validator-pod churn, migration-aware draining,
+state machine) — one actuation path, not two.  Rides the shared
+priority/fairness workqueue as a single-key controller with scheduled
+requeues as the safety net; steady state with no wave pending costs
+nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
+from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers.health import parse_budget
+from tpu_operator.controllers.remediation import FAILED as REMEDIATION_FAILED, REVALIDATING
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s import workqueue as wq
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
+from tpu_operator.utils import deep_get
+
+log = logging.getLogger("tpu_operator.revalidation")
+
+RECONCILE_KEY = "revalidation"
+
+
+def node_kind(node: dict) -> str:
+    """The warm-pool grouping of a node: generation + topology + runtime
+    version.  Includes the runtime version so an upgrade NATURALLY starts
+    a fresh seeding wave — the old kind's warm state never leaks onto
+    executables compiled against a different libtpu."""
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    return "/".join((
+        labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, ""),
+        labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, ""),
+        labels.get(consts.TFD_RUNTIME_VERSION_LABEL, ""),
+    ))
+
+
+class RevalidationCoordinator:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
+        warm_fn: Optional[Callable[[str], bool]] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+        # the per-pass fleet sweep rides the informer-backed reader (the
+        # health-engine pattern): a draining wave requeues every few
+        # seconds, and that must not cost a live 10k-node LIST each time.
+        # Without registered informers (direct-drive tests) reads fall
+        # back live and behaviour is identical.
+        self.reader = CachedReader(client, metrics=self.metrics)
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
+        # optional extra warmness source: the operator binary wires the
+        # fleet compile cache's kind index here, so a kind whose artifacts
+        # already exist (seeded by an earlier wave, or by a node that
+        # validated outside any wave) skips straight to fan-out
+        self.warm_fn = warm_fn
+        # kinds whose seeder completed successfully this process lifetime
+        # (kind strings include the runtime version, so an upgrade rotates
+        # them out by construction)
+        self._seeded: set[str] = set()
+        # kind -> seeder node name currently in flight
+        self._seeder: dict[str, str] = {}
+        # nodes THIS coordinator promoted, watched for completion
+        self._promoted: set[str] = set()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, key: str) -> Optional[float]:
+        with self.tracer.reconcile("revalidation", key=key):
+            return await self._reconcile(key)
+
+    async def _reconcile(self, key: str) -> Optional[float]:
+        policy = await self._cluster_policy()
+        if policy is None:
+            return None
+        nodes = [
+            n for n in await self.reader.list_items("", "Node")
+            if clusterinfo.is_tpu_node(n)
+        ]
+        if not nodes:
+            return None
+        names = {n["metadata"]["name"] for n in nodes}
+        budget = max(
+            1, parse_budget(policy.spec.health.max_unhealthy_percent, len(nodes))
+        )
+
+        request = {
+            n["metadata"]["name"]: (
+                deep_get(n, "metadata", "labels", default={}) or {}
+            ).get(consts.VALIDATE_REQUEST_LABEL, "")
+            for n in nodes
+        }
+        remediation_state = {
+            n["metadata"]["name"]: (
+                deep_get(n, "metadata", "labels", default={}) or {}
+            ).get(consts.REMEDIATION_STATE_LABEL, "")
+            for n in nodes
+        }
+        kind = {n["metadata"]["name"]: node_kind(n) for n in nodes}
+
+        self._observe_completions(names, request, remediation_state, kind)
+
+        in_flight = {
+            name
+            for name in names
+            if request[name] == consts.VALIDATE_REQUESTED
+            or remediation_state[name] == REVALIDATING
+        }
+        pending = sorted(
+            name for name in names if request[name] == consts.VALIDATE_PENDING
+        )
+
+        # -- intake: demote a thundering herd beyond the budget ----------
+        herd = sorted(
+            name
+            for name in names
+            if request[name] == consts.VALIDATE_REQUESTED
+            and remediation_state[name] != REVALIDATING
+            and name not in self._promoted
+        )
+        if len(in_flight) > budget and herd:
+            keep = self._herd_keepers(herd, kind, in_flight, budget)
+            demoted = 0
+            for name in herd:
+                if name in keep:
+                    self._promoted.add(name)  # tracked like our promotions
+                    continue
+                try:
+                    await self._set_request(name, consts.VALIDATE_PENDING)
+                except ApiError as e:
+                    log.error("revalidation demote of %s failed: %s", name, e)
+                    continue
+                request[name] = consts.VALIDATE_PENDING
+                in_flight.discard(name)
+                pending.append(name)
+                demoted += 1
+            if demoted:
+                self.metrics.revalidation_demotions_total.inc(demoted)
+                await self.recorder.normal(
+                    obs_events.namespace_ref(self.namespace),
+                    obs_events.REASON_REVALIDATION_BATCHED,
+                    f"fleet revalidation wave: {demoted} nodes queued behind "
+                    f"the disruption budget ({budget} of {len(nodes)}); one "
+                    "seeder per kind runs first, the rest fan out warm",
+                )
+            pending.sort()
+
+        # -- promotion: seeders first, then warm fan-out ------------------
+        capacity = budget - len(in_flight)
+        by_kind: dict[str, list[str]] = {}
+        for name in pending:
+            by_kind.setdefault(kind[name], []).append(name)
+        inflight_kinds = {kind[name] for name in in_flight}
+
+        for k in sorted(by_kind):
+            if capacity <= 0:
+                break
+            if self._kind_warm(k) or k in inflight_kinds:
+                # warm already, or its (possibly manual) proof is in
+                # flight — the fan-out pass below handles warm kinds, and
+                # a kind mid-seed waits for its seeder
+                continue
+            seeder = by_kind[k][0]
+            if await self._promote(seeder, role="seeder"):
+                self._seeder[k] = seeder
+                by_kind[k].remove(seeder)
+                in_flight.add(seeder)
+                inflight_kinds.add(k)
+                capacity -= 1
+                await self.recorder.normal(
+                    obs_events.node_ref(seeder),
+                    obs_events.REASON_REVALIDATION_SEEDED,
+                    f"{seeder} seeds compile artifacts for kind {k} "
+                    f"({len(by_kind[k])} nodes wait warm)",
+                )
+        for k in sorted(by_kind):
+            if capacity <= 0:
+                break
+            if not self._kind_warm(k):
+                continue
+            for name in list(by_kind[k]):
+                if capacity <= 0:
+                    break
+                if await self._promote(name, role="warm"):
+                    by_kind[k].remove(name)
+                    in_flight.add(name)
+                    capacity -= 1
+
+        still_pending = sum(len(v) for v in by_kind.values())
+        self.metrics.revalidation_pending.set(still_pending)
+        self.metrics.revalidation_in_flight.set(len(in_flight))
+        if still_pending or self._promoted:
+            return consts.REVALIDATION_REQUEUE_SECONDS
+        return None
+
+    # ------------------------------------------------------------------
+    def _herd_keepers(
+        self,
+        herd: list[str],
+        kind: dict[str, str],
+        in_flight: set[str],
+        budget: int,
+    ) -> set[str]:
+        """Which herd nodes keep their ``requested`` label at intake:
+        seeder-first per cold kind, then fill the budget's remainder."""
+        keep: set[str] = set()
+        room = budget - (len(in_flight) - len(herd))
+        covered = {kind[n] for n in in_flight if n not in herd}
+        for name in herd:
+            if room <= 0:
+                break
+            k = kind[name]
+            if k in covered or self._kind_warm(k):
+                continue
+            keep.add(name)
+            covered.add(k)
+            room -= 1
+        for name in herd:
+            if room <= 0:
+                break
+            if name not in keep and self._kind_warm(kind[name]):
+                keep.add(name)
+                room -= 1
+        return keep
+
+    def _kind_warm(self, k: str) -> bool:
+        if k in self._seeded:
+            return True
+        if self.warm_fn is not None:
+            try:
+                return bool(self.warm_fn(k))
+            except Exception as e:  # noqa: BLE001 — warmness probe must not wedge the wave
+                log.debug("warm_fn failed for %s: %s", k, e)
+        return False
+
+    def _observe_completions(
+        self,
+        live: set[str],
+        request: dict[str, str],
+        remediation_state: dict[str, str],
+        kind: dict[str, str],
+    ) -> None:
+        """A promoted node whose request label cleared and whose machine
+        left ``revalidating`` is done; a HEALTHY seeder marks its kind
+        warm, a failed one frees the seeder slot so another node seeds."""
+        for name in list(self._promoted):
+            if name not in live:
+                self._promoted.discard(name)
+                self._drop_seeder(name)
+                continue
+            if (
+                request.get(name) in (consts.VALIDATE_REQUESTED, consts.VALIDATE_PENDING)
+                or remediation_state.get(name) == REVALIDATING
+            ):
+                continue
+            self._promoted.discard(name)
+            seeded_kind = self._drop_seeder(name)
+            if seeded_kind is not None and remediation_state.get(name) != (
+                REMEDIATION_FAILED
+            ):
+                self._seeded.add(seeded_kind)
+                log.info("kind %s seeded by %s; fan-out may proceed", seeded_kind, name)
+
+    def _drop_seeder(self, name: str) -> Optional[str]:
+        for k, seeder in list(self._seeder.items()):
+            if seeder == name:
+                del self._seeder[k]
+                return k
+        return None
+
+    async def _promote(self, name: str, role: str) -> bool:
+        try:
+            await self._set_request(name, consts.VALIDATE_REQUESTED)
+        except ApiError as e:
+            log.error("revalidation promote of %s failed: %s", name, e)
+            return False
+        self._promoted.add(name)
+        self.metrics.revalidation_promotions_total.labels(role=role).inc()
+        return True
+
+    async def _set_request(self, name: str, value: Optional[str]) -> None:
+        # through the reader: the write-through keeps the very next cached
+        # pass seeing its own promotion instead of re-issuing it
+        await self.reader.patch(
+            "", "Node", name,
+            {"metadata": {"labels": {consts.VALIDATE_REQUEST_LABEL: value}}},
+        )
+
+    async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
+        obj = await clusterinfo.active_cluster_policy(self.reader)
+        return TPUClusterPolicy(obj) if obj else None
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        # HIGH class like remediation: wave scheduling is actuation, and a
+        # queued resync sweep must not delay the seeder that unblocks an
+        # entire kind's fan-out
+        controller = mgr.add_controller(
+            Controller("revalidation", self.reconcile, priority=wq.PRIORITY_HIGH)
+        )
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        nodes = mgr.informer("", "Node")
+        for inf in (policies, nodes):
+            self.reader.add_informer(inf)
+
+        async def on_node(event_type: str, obj: dict) -> None:
+            labels = deep_get(obj, "metadata", "labels", default={}) or {}
+            if (
+                consts.VALIDATE_REQUEST_LABEL in labels
+                or consts.REMEDIATION_STATE_LABEL in labels
+                or obj["metadata"]["name"] in self._promoted
+                or event_type == "DELETED"
+            ):
+                controller.enqueue(RECONCILE_KEY)
+
+        async def kick(event_type: str, obj: dict) -> None:
+            controller.enqueue(RECONCILE_KEY)
+
+        nodes.add_handler(on_node)
+        policies.add_handler(kick)
+        return controller

@@ -216,6 +216,7 @@ class Manager:
         fleet=None,
         explain=None,
         fleet_eval_interval: float = consts.FLEET_EVAL_SECONDS,
+        compile_cache=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -239,6 +240,10 @@ class Manager:
         # evidence by the clusterpolicy reconciler and SLO episodes by the
         # fleet loop below.  Flows through setup() like the aggregator.
         self.explain = explain
+        # workloads.compile_cache.FleetCompileCache: backs the
+        # /compile-cache/* routes (artifact publication by seeder
+        # validators, index+fetch by warm-pool validators) next to /push.
+        self.compile_cache = compile_cache
         self.fleet_eval_interval = fleet_eval_interval
         # fleet-eval rides the shared workqueue framework as a scheduled-
         # requeue controller (cancellable + saturation-instrumented) instead
@@ -531,6 +536,11 @@ class Manager:
         metrics.router.add_get("/debug/fleet", self._fleet_snapshot)
         metrics.router.add_get("/debug/explain", self._explain)
         metrics.router.add_post("/push", self._fleet_push)
+        metrics.router.add_get("/compile-cache/index", self._cc_index)
+        metrics.router.add_get(
+            "/compile-cache/artifact/{name}", self._cc_artifact
+        )
+        metrics.router.add_post("/compile-cache/artifact", self._cc_publish)
         # one server per port unless they coincide
         apps = {}
         if self.health_port >= 0:
@@ -542,6 +552,13 @@ class Manager:
                 health.router.add_get("/debug/fleet", self._fleet_snapshot)
                 health.router.add_get("/debug/explain", self._explain)
                 health.router.add_post("/push", self._fleet_push)
+                health.router.add_get("/compile-cache/index", self._cc_index)
+                health.router.add_get(
+                    "/compile-cache/artifact/{name}", self._cc_artifact
+                )
+                health.router.add_post(
+                    "/compile-cache/artifact", self._cc_publish
+                )
             else:
                 apps[id(metrics)] = (self.metrics_port, metrics)
         for port, app in apps.values():
@@ -664,3 +681,64 @@ class Manager:
                 ).inc()
             return error
         return web.json_response({"accepted": self.fleet.ingest_push(body)})
+
+    # ------------------------------------------------------------------
+    # Fleet compile-artifact cache (workloads/compile_cache.py;
+    # docs/PERFORMANCE.md "Compile cache & warm-pool validation").  The
+    # seeder validator of each (generation, topology, versions) kind
+    # publishes here; warm-pool validators index+fetch before their first
+    # jit trace.  Same unauthenticated-port discipline as /push: bodies
+    # are size-capped and every envelope re-verified on ingest.
+
+    def _cc_unavailable(self) -> Optional[web.Response]:
+        if self.compile_cache is None:
+            return web.json_response(
+                {"error": "compile-artifact cache not enabled"}, status=404
+            )
+        return None
+
+    async def _cc_index(self, request: web.Request) -> web.Response:
+        off = self._cc_unavailable()
+        if off is not None:
+            return off
+        kind = request.rel_url.query.get("kind", "")
+        if not kind:
+            return web.json_response({"error": "kind required"}, status=400)
+        # store scans touch disk: off-loop (FleetCompileCache is
+        # thread-safe), so a seeding wave never stalls the reconcilers
+        artifacts = await asyncio.get_event_loop().run_in_executor(
+            None, self.compile_cache.index, kind
+        )
+        return web.json_response({"artifacts": artifacts})
+
+    async def _cc_artifact(self, request: web.Request) -> web.Response:
+        off = self._cc_unavailable()
+        if off is not None:
+            return off
+        # multi-MB payload read: off-loop like every compile-cache disk op
+        data = await asyncio.get_event_loop().run_in_executor(
+            None, self.compile_cache.get, request.match_info["name"]
+        )
+        if data is None:
+            return web.json_response({"error": "unknown artifact"}, status=404)
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    async def _cc_publish(self, request: web.Request) -> web.Response:
+        from tpu_operator.obs import fleet as fleet_api
+        from tpu_operator.workloads import compile_cache as cc
+
+        off = self._cc_unavailable()
+        if off is not None:
+            return off
+        body, error = await fleet_api.read_bytes_capped(
+            request, cc.ARTIFACT_MAX_BYTES
+        )
+        if error is not None:
+            return error
+        # verification + atomic store write: off-loop
+        accepted, detail = await asyncio.get_event_loop().run_in_executor(
+            None, self.compile_cache.ingest, body
+        )
+        if not accepted:
+            return web.json_response({"error": detail}, status=400)
+        return web.json_response({"name": detail})

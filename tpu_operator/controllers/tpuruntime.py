@@ -28,6 +28,7 @@ from tpu_operator.api.types import (
 from tpu_operator.controllers import clusterinfo
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s.apply import create_or_update
+from tpu_operator.k8s.cache import CachedReader
 from tpu_operator.k8s.client import ApiClient, ApiError
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.obs import events as obs_events
@@ -62,6 +63,14 @@ class TPURuntimeReconciler:
         self.namespace = namespace
         self.renderer = renderer or new_renderer()
         self.metrics = metrics or OperatorMetrics()
+        # every reconcile-path read rides the informer-backed reader (the
+        # clusterpolicy pattern): the full-fleet node list and cross-CR
+        # conflict sweep below are served from the shared informer stores,
+        # so a steady-state TPURuntime pass costs zero API verbs instead
+        # of re-listing nodes live.  Without registered informers
+        # (direct-drive tests) every read falls back live and behaviour is
+        # identical to the raw client.
+        self.reader = CachedReader(client, metrics=self.metrics)
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
         # set per pass: an immutable-selector DS swap is mid-termination and
@@ -75,7 +84,7 @@ class TPURuntimeReconciler:
 
     async def _reconcile(self, name: str) -> Optional[float]:
         try:
-            obj = await self.client.get(GROUP, TPU_RUNTIME_KIND, name)
+            obj = await self.reader.get(GROUP, TPU_RUNTIME_KIND, name)
         except ApiError as e:
             if e.not_found:
                 return None
@@ -103,7 +112,7 @@ class TPURuntimeReconciler:
             )
             return consts.REQUEUE_NOT_READY_SECONDS
 
-        nodes = await self.client.list_items("", "Node")
+        nodes = await self.reader.list_items("", "Node")
         pools = get_node_pools(nodes, runtime.spec.node_selector)
         desired_ds: set[str] = set()
         all_ready = True
@@ -131,19 +140,19 @@ class TPURuntimeReconciler:
 
     # ------------------------------------------------------------------
     async def _cluster_policy(self) -> Optional[TPUClusterPolicy]:
-        obj = await clusterinfo.active_cluster_policy(self.client)
+        obj = await clusterinfo.active_cluster_policy(self.reader)
         return TPUClusterPolicy(obj) if obj else None
 
     async def _selector_conflicts(self, runtime: TPURuntime) -> list[str]:
         """Nodes matched by this CR AND another CR (validator.go:47-69)."""
         others = [
             TPURuntime(o)
-            for o in await self.client.list_items(GROUP, TPU_RUNTIME_KIND)
+            for o in await self.reader.list_items(GROUP, TPU_RUNTIME_KIND)
             if o["metadata"]["name"] != runtime.name
         ]
         if not others:
             return []
-        nodes = await self.client.list_items("", "Node")
+        nodes = await self.reader.list_items("", "Node")
         mine = runtime.spec.node_selector
         conflicts = []
         for node in nodes:
@@ -233,7 +242,7 @@ class TPURuntimeReconciler:
                 ready = False
                 continue
             live, _ = await create_or_update(
-                self.client,
+                self.reader,
                 obj,
                 owner=runtime.obj if is_ds else None,
                 state_label=STATE_LABEL_VALUE,
@@ -257,7 +266,7 @@ class TPURuntimeReconciler:
         of parking the worker."""
         name = desired["metadata"]["name"]
         try:
-            live = await self.client.get("apps", "DaemonSet", name, self.namespace)
+            live = await self.reader.get("apps", "DaemonSet", name, self.namespace)
         except ApiError as e:
             if e.not_found:
                 return True
@@ -271,9 +280,11 @@ class TPURuntimeReconciler:
                 "DS %s pod selector changed %s → %s; delete-and-recreate",
                 name, have, want,
             )
-            await self.client.delete("apps", "DaemonSet", name, self.namespace)
+            await self.reader.delete("apps", "DaemonSet", name, self.namespace)
         try:
-            await self.client.get("apps", "DaemonSet", name, self.namespace)
+            # the reader's delete popped the cached copy, so this re-read
+            # falls back LIVE — exactly the freshness this check needs
+            await self.reader.get("apps", "DaemonSet", name, self.namespace)
         except ApiError as e:
             if e.not_found:
                 return True
@@ -284,13 +295,13 @@ class TPURuntimeReconciler:
     async def _cleanup_stale(self, runtime: TPURuntime, desired: set[str]) -> None:
         """Delete DaemonSets this CR owns that no pool wants any more
         (driver.go:173-198 cleanupStaleDriverDaemonsets)."""
-        items = await self.client.list_items(
+        items = await self.reader.list_items(
             "apps", "DaemonSet", self.namespace,
             label_selector=f"tpu.google.com/runtime-cr={runtime.name}",
         )
         for item in items:
             if item["metadata"]["name"] not in desired:
-                await self.client.delete(
+                await self.reader.delete(
                     "apps", "DaemonSet", item["metadata"]["name"], self.namespace
                 )
                 log.info("deleted stale runtime DS %s", item["metadata"]["name"])
@@ -311,7 +322,7 @@ class TPURuntimeReconciler:
         if runtime.obj.get("status") == old:
             return
         try:
-            await self.client.update_status(runtime.obj)
+            await self.reader.update_status(runtime.obj)
         except ApiError as e:
             if not e.conflict:
                 raise
@@ -322,6 +333,15 @@ class TPURuntimeReconciler:
         runtimes = mgr.informer(GROUP, TPU_RUNTIME_KIND)
         policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
         nodes = mgr.informer("", "Node")
+        # back the reader with every GVK the reconcile chain reads — the
+        # three event-wired informers above plus the namespace DaemonSet
+        # informer (shared with clusterpolicy's when both run; optional so
+        # a standalone TPURuntime controller never wedges manager start)
+        for inf in (
+            runtimes, policies, nodes,
+            mgr.informer("apps", "DaemonSet", namespace=self.namespace, required=False),
+        ):
+            self.reader.add_informer(inf)
 
         async def on_runtime(event_type: str, obj: dict) -> None:
             controller.enqueue(obj["metadata"]["name"])

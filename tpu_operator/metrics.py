@@ -352,3 +352,48 @@ class OperatorMetrics:
             ["slo", "kind"],
             registry=self.registry,
         )
+        # fleet compile-artifact cache (workloads/compile_cache.py served
+        # by the Manager's /compile-cache/* routes — docs/PERFORMANCE.md
+        # "Compile cache & warm-pool validation")
+        self.compile_cache_artifacts = g(
+            "tpu_operator_compile_cache_artifacts",
+            "Serialized-executable artifacts held by the fleet compile cache",
+        )
+        self.compile_cache_bytes = g(
+            "tpu_operator_compile_cache_bytes",
+            "Total bytes held by the fleet compile cache's artifact store",
+        )
+        self.compile_cache_requests_total = Counter(
+            "tpu_operator_compile_cache_requests_total",
+            "Fleet compile-cache operations, by outcome: stored (new "
+            "artifact ingested), duplicate (idempotent re-publish), "
+            "served (artifact download), rejected (corrupt/mis-keyed/"
+            "over-cap upload)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        # batched revalidation coordinator (controllers/revalidation.py):
+        # warm-pool scheduling of fleet-wide re-validation waves
+        self.revalidation_pending = g(
+            "tpu_operator_nodes_revalidation_pending",
+            "Nodes queued (validate=pending) behind the revalidation "
+            "coordinator's seeder-first, budget-bounded promotion order",
+        )
+        self.revalidation_in_flight = g(
+            "tpu_operator_nodes_revalidation_in_flight",
+            "Nodes currently admitted to re-validation by the coordinator "
+            "(validate=requested or remediation revalidating)",
+        )
+        self.revalidation_promotions_total = Counter(
+            "tpu_operator_revalidation_promotions_total",
+            "Coordinator promotions of pending nodes into re-validation, "
+            "by role: seeder (first of its kind — compiles and publishes "
+            "artifacts) or warm (fans out against the seeded fleet cache)",
+            ["role"],
+            registry=self.registry,
+        )
+        self.revalidation_demotions_total = c(
+            "tpu_operator_revalidation_demotions_total",
+            "Thundering-herd validate=requested nodes demoted to pending "
+            "by the coordinator (wave intake beyond the disruption budget)",
+        )

@@ -37,6 +37,8 @@ REASON_UPGRADE_STARTED = "UpgradeStarted"
 REASON_UPGRADE_DONE = "UpgradeDone"
 REASON_UPGRADE_FAILED = "UpgradeFailed"
 REASON_REMEDIATION_STARTED = "RemediationStarted"
+REASON_REVALIDATION_BATCHED = "RevalidationBatched"
+REASON_REVALIDATION_SEEDED = "RevalidationSeeded"
 REASON_REMEDIATION_HEALTHY = "RemediationHealthy"
 REASON_REMEDIATION_FAILED = "RemediationFailed"
 REASON_VALIDATION_FAILED = "ValidationFailed"

@@ -156,11 +156,12 @@ def _parse_k8s_ts(value: str) -> Optional[float]:
         return None
 
 
-async def read_json_capped(request, limit: int = consts.PUSH_MAX_BYTES):
-    """Size-guarded JSON body read shared by the metrics agent's ``/push``
-    and the operator's fleet ingest route (both unauthenticated ports).
-    Returns ``(body, None)`` or ``(None, error_response)`` — 413 past the
-    cap (declared Content-Length or actual bytes), 400 on bad JSON."""
+async def read_bytes_capped(request, limit: int):
+    """Size-guarded raw body read shared by every unauthenticated POST
+    surface (fleet /push ingest, compile-cache artifact publication, the
+    agent's relay hop).  Returns ``(body, None)`` or ``(None,
+    error_response)`` — 413 past the cap (declared Content-Length or
+    actual bytes)."""
     from aiohttp import web
 
     if request.content_length is not None and request.content_length > limit:
@@ -169,7 +170,7 @@ async def read_json_capped(request, limit: int = consts.PUSH_MAX_BYTES):
         )
     # read() must LOOP: StreamReader.read(n) returns whatever is buffered
     # once any bytes arrive, and a body spanning several TCP segments would
-    # otherwise be truncated into a spurious 400
+    # otherwise be truncated
     chunks: list[bytes] = []
     remaining = limit + 1
     while remaining > 0:
@@ -183,6 +184,17 @@ async def read_json_capped(request, limit: int = consts.PUSH_MAX_BYTES):
         return None, web.json_response(
             {"error": f"payload exceeds {limit} bytes"}, status=413
         )
+    return body, None
+
+
+async def read_json_capped(request, limit: int = consts.PUSH_MAX_BYTES):
+    """Size-guarded JSON body read (:func:`read_bytes_capped` + parse);
+    400 on bad JSON."""
+    from aiohttp import web
+
+    body, error = await read_bytes_capped(request, limit)
+    if error is not None:
+        return None, error
     try:
         return json.loads(body), None
     except (UnicodeDecodeError, ValueError):

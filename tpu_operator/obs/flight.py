@@ -66,6 +66,11 @@ COUNTER_KEYS = {
     "mfu": "tpu_workload_mfu",
     "tokens_per_sec": "tpu_workload_tokens_per_sec",
     "overhead_dominated": "tpu_workload_overhead_dominated",
+    # compile-artifact cache counters (workloads/compile_cache.py
+    # ArtifactStore.record_flight_sample) — the warm-pool evidence
+    "cache_hits": "tpu_workload_compile_cache_hits_total",
+    "cache_misses": "tpu_workload_compile_cache_misses_total",
+    "cache_bytes": "tpu_workload_compile_cache_bytes_total",
 }
 
 # result keys worth a flight sample when a check only reports a summary

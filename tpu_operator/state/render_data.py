@@ -109,6 +109,11 @@ def base_render_data(ctx: ClusterContext, spec: TPUClusterPolicySpec) -> dict:
         "validation_dir": consts.VALIDATION_DIR,
         "validation_dir_root": consts.VALIDATION_DIR.rsplit("/", 1)[0],
         "compile_cache_dir": consts.COMPILE_CACHE_DIR,
+        # fleet compile-artifact cache (workloads/compile_cache.py): the
+        # validator (and through it its workload pods) reaches the
+        # operator's /compile-cache/* surface via the node metrics agent's
+        # relay on its localhost hostPort — rendered as TPU_FLEET_CACHE_URL
+        "fleet_cache_url": f"http://127.0.0.1:{spec.metrics_agent.host_port}",
         "service_monitors_available": ctx.service_monitors_available,
         "validator": {
             "image": _operand_image(spec.validator, "validator"),

@@ -1378,6 +1378,7 @@ class Validator:
         ring_min_gbps: float = 0.0,
         results_scope: str = "",
         budget_seconds: float = 0.0,
+        cache_key_env: Optional[dict] = None,
     ) -> dict:
         """Build the workload pod (plugin-workload-validation.yaml analogue,
         validator/main.go:984-1052: node pinning, resource request, ownerRef
@@ -1416,6 +1417,31 @@ class Validator:
                             # (preStop re-gating, upgrade re-proof) skip the
                             # ~2s/program recompiles (workloads/compile_cache.py)
                             {"name": "TPU_COMPILE_CACHE", "value": COMPILE_CACHE_HOST_PATH},
+                            # compile-ARTIFACT store beside it: serialized
+                            # executables keyed on (generation, topology,
+                            # versions, program), prewarmed from the fleet
+                            # cache before the first jit trace
+                            {
+                                "name": "TPU_COMPILE_CACHE_ARTIFACTS",
+                                "value": COMPILE_CACHE_HOST_PATH + "/artifacts",
+                            },
+                            # the seeding-plane contract: fleet cache URL
+                            # (DS-rendered into the validator's own env)
+                            # plus the cache-key fields — an explicit env
+                            # wins, else the node's own labels (computed
+                            # by spawn_workload) fill them in
+                            *(
+                                [{"name": name, "value": value}
+                                 for name in ("TPU_FLEET_CACHE_URL",
+                                              "TPU_CACHE_GENERATION",
+                                              "TPU_CACHE_TOPOLOGY",
+                                              "TPU_LIBTPU_VERSION")
+                                 for value in (
+                                     os.environ.get(name)
+                                     or (cache_key_env or {}).get(name, ""),
+                                 )
+                                 if value]
+                            ),
                             *(
                                 [{"name": "RESULTS_SCOPE", "value": results_scope}]
                                 if results_scope
@@ -1520,10 +1546,32 @@ class Validator:
     ) -> None:
         client = self.client()
         owner = await self._owner_daemonset()
+        # cache-key fields for the compile-artifact plane, from the node's
+        # own labels (raw values — the same vocabulary the revalidation
+        # coordinator's node_kind uses); best-effort: a node without TPU
+        # labels just leaves the fields empty and keying stays node-local
+        cache_key_env: dict = {}
+        if self.config.node_name:
+            try:
+                node = await client.get("", "Node", self.config.node_name)
+                labels = deep_get(node, "metadata", "labels", default={}) or {}
+                cache_key_env = {
+                    "TPU_CACHE_GENERATION": labels.get(
+                        consts.GKE_TPU_ACCELERATOR_LABEL, ""
+                    ),
+                    "TPU_CACHE_TOPOLOGY": labels.get(
+                        consts.GKE_TPU_TOPOLOGY_LABEL, ""
+                    ),
+                    "TPU_LIBTPU_VERSION": labels.get(
+                        consts.TFD_RUNTIME_VERSION_LABEL, ""
+                    ),
+                }
+            except ApiError:
+                pass
         pod = self._workload_pod(
             name, checks, tpu_request, owner, min_gbps=min_gbps,
             ring_min_gbps=ring_min_gbps, results_scope=results_scope,
-            budget_seconds=budget_seconds,
+            budget_seconds=budget_seconds, cache_key_env=cache_key_env,
         )
         await client.delete("", "Pod", name, self.config.namespace)
         await client.create(pod)

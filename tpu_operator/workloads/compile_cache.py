@@ -1,31 +1,819 @@
-"""Persistent XLA compilation cache for the validation workloads.
+"""Fleet-level XLA compilation artifact cache + node-local persistent cache.
 
-The validator deliberately re-proves nodes (preStop removes ``*-ready`` so
-dependents re-gate; the upgrade machine deletes validator pods to force
-fresh evidence), so the same XLA programs — vector-add, the chained
-allreduce, the burn-in step, the matmul sweep — recompile on every
-re-validation.  On a tunneled PJRT backend each compile costs ~2s, which is
-most of a validation round's wall clock.  The TPU-idiomatic fix is XLA's
-persistent compilation cache (``jax_compilation_cache_dir``): keyed on HLO +
-backend config, so re-validations and post-restart validator pods hit disk
-instead of the compiler.
+Three layers (docs/PERFORMANCE.md "Compile cache & warm-pool validation"):
 
-The cache lives under the node's ``/run/tpu`` hostPath (workload pods mount
-it), surviving pod churn but not node replacement — exactly the lifetime of
-the evidence it accelerates.  Enabled ONLY by an explicit
-``TPU_COMPILE_CACHE=<path>`` env (the operator injects it in-cluster);
-unset or ``0`` means no persistent cache.
+1. **Node-local jax cache** — :func:`enable` points jax at the persistent
+   ``jax_compilation_cache_dir`` under the node's ``/run/tpu`` hostPath, so
+   re-validations on one node hit disk instead of the compiler.  This was
+   the whole module before the fleet plane existed.
 
-Reference contrast: the CUDA vectorAdd validation image
-(validator/main.go:1189-1302) ships precompiled SASS so NVIDIA never pays
-this cost; for XLA the persistent cache is the equivalent of shipping
-compiled programs.
+2. **Artifact plane** — :class:`ArtifactStore`: content-addressed storage of
+   serialized XLA executables keyed on :class:`CacheKey` (TPU generation,
+   slice topology, jax/libtpu version, program fingerprint).  Entries are
+   single-file envelopes carrying an integrity sha256 over the payload,
+   published atomically (tmp + ``os.replace`` — a crash mid-write can never
+   leave a truncated artifact a reader would deserialize), bounded in total
+   size with LRU eviction, and counted (hits/misses/bytes) into the flight
+   recorder → agent push → fleet aggregator chain as
+   ``tpu_workload_compile_cache_*`` counters.
+
+3. **Seeding plane** — :class:`FleetCacheClient` (workload side) +
+   :class:`FleetCompileCache` (operator side, served by the Manager next to
+   ``/push`` and relayed by the node metrics agent): the first node of each
+   (generation, topology, versions) *kind* to validate publishes its
+   artifacts; later validators :func:`prewarm` their local store before the
+   first jit trace, so fleet re-validation pays one compile per kind plus a
+   disk read per node instead of one compile per node.
+
+The AOT helpers (:func:`aot_fingerprint` / :func:`compile_or_fetch`) wrap
+jax's explicit lowering path: the program fingerprint hashes the lowered
+StableHLO text (tracing is ~ms; XLA compilation is the 100ms–10s cost being
+cached), and the artifact payload is ``jax.experimental.serialize_executable``
+output.
+
+Trust model: the envelope sha256 proves INTEGRITY (a torn or bit-flipped
+transfer is recompiled, never loaded), not AUTHENTICITY — the fleet routes
+are unauthenticated cluster-internal ports like ``/push``.  Because the
+serialized-executable payload is a pickle, :func:`load_serialized`
+deserializes BOTH pickle layers through restricted unpicklers that admit
+only the enumerated jax/numpy bookkeeping classes a real artifact
+references and refuse every other global — a crafted payload cannot name
+arbitrary callables, it can at worst fail to load and cost a recompile.
+The executable bytes themselves
+are handed to XLA's own deserializer, the same surface jax's persistent
+compilation cache trusts; deployments that cannot trust the cluster
+network should leave ``TPU_FLEET_CACHE_URL`` unset (node-local caching
+still works).
+
+Everything here is an optimization, never a gate: any failure (unusable
+path, corrupt artifact, unreachable fleet cache) falls back to compiling.
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
+import json
+import logging
 import os
-from typing import Optional
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import asdict, dataclass
+from typing import Callable, Optional
+
+log = logging.getLogger("tpu_operator.compile_cache")
+
+# environment contract (documented in docs/OBSERVABILITY.md, rendered into
+# workload pods by the validator alongside TPU_COMPILE_CACHE)
+ARTIFACTS_ENV = "TPU_COMPILE_CACHE_ARTIFACTS"
+MAX_BYTES_ENV = "TPU_COMPILE_CACHE_MAX_BYTES"
+FLEET_CACHE_URL_ENV = "TPU_FLEET_CACHE_URL"
+
+ENVELOPE_MAGIC = "tpuxc1"
+# artifact payload ceiling on BOTH the operator ingest route and the agent
+# relay: the ports are unauthenticated and an unbounded body is an
+# allocation amplifier (the /push discipline, sized for executables)
+ARTIFACT_MAX_BYTES = 32 * 1024 * 1024
+DEFAULT_STORE_MAX_BYTES = 512 * 1024 * 1024
+_FETCH_TIMEOUT = 5.0
+
+
+class CorruptArtifact(Exception):
+    """Envelope failed parsing or integrity verification."""
+
+
+@dataclass(frozen=True)
+class CacheKey:
+    """Identity of one compiled program: any field changing means the
+    executable may be wrong for the hardware/software it would run on."""
+
+    generation: str = ""
+    topology: str = ""
+    jax_version: str = ""
+    libtpu_version: str = ""
+    program: str = ""  # program fingerprint (lowered-HLO hash) or name
+
+    def fingerprint(self) -> str:
+        return hashlib.sha256(
+            json.dumps(asdict(self), sort_keys=True).encode()
+        ).hexdigest()
+
+    def kind(self) -> str:
+        """The warm-pool grouping: every field except the program — nodes
+        of one kind can share every artifact of that kind."""
+        return kind_fingerprint(
+            self.generation, self.topology, self.jax_version, self.libtpu_version
+        )
+
+
+def key_from_fields(raw: dict) -> CacheKey:
+    """CacheKey from an untrusted header field map (unknown fields
+    dropped, values coerced to str) — the one construction rule shared by
+    the envelope parser and the fleet index."""
+    return CacheKey(**{
+        f: str(raw.get(f, ""))
+        for f in ("generation", "topology", "jax_version", "libtpu_version", "program")
+    })
+
+
+def kind_fingerprint(
+    generation: str, topology: str, jax_version: str = "", libtpu_version: str = ""
+) -> str:
+    return hashlib.sha256(json.dumps(
+        [generation, topology, jax_version, libtpu_version]
+    ).encode()).hexdigest()
+
+
+def current_versions() -> tuple[str, str]:
+    """(jax version, libtpu version) of this process — the software half of
+    every :class:`CacheKey` minted locally."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # noqa: BLE001 — keying must work without a backend
+        jax_version = ""
+    return jax_version, os.environ.get("TPU_LIBTPU_VERSION", "")
+
+
+# ---------------------------------------------------------------------------
+# Envelope codec.
+
+
+def build_envelope(key: CacheKey, payload: bytes, created: Optional[float] = None) -> bytes:
+    header = {
+        "magic": ENVELOPE_MAGIC,
+        "name": key.fingerprint(),
+        "key": asdict(key),
+        "sha256": hashlib.sha256(payload).hexdigest(),
+        "size": len(payload),
+        "created": round(created if created is not None else time.time(), 3),
+    }
+    return json.dumps(header, sort_keys=True).encode() + b"\n" + payload
+
+
+def parse_envelope(data: bytes) -> tuple[CacheKey, dict, bytes]:
+    """(key, header, payload) or :class:`CorruptArtifact`.  Every check a
+    reader needs before trusting the payload lives here: magic, key/name
+    consistency (content addressing), declared size, and the payload
+    sha256 — a truncated or bit-flipped artifact is rejected, never
+    deserialized."""
+    newline = data.find(b"\n")
+    if newline < 0:
+        raise CorruptArtifact("no header line")
+    try:
+        header = json.loads(data[:newline])
+    except (UnicodeDecodeError, ValueError) as e:
+        raise CorruptArtifact(f"unparsable header: {e}") from e
+    if not isinstance(header, dict) or header.get("magic") != ENVELOPE_MAGIC:
+        raise CorruptArtifact("bad magic")
+    raw_key = header.get("key")
+    if not isinstance(raw_key, dict):
+        raise CorruptArtifact("missing key")
+    key = key_from_fields(raw_key)
+    if header.get("name") != key.fingerprint():
+        raise CorruptArtifact("name does not match key (content addressing broken)")
+    payload = data[newline + 1:]
+    if header.get("size") != len(payload):
+        raise CorruptArtifact(
+            f"truncated payload: header says {header.get('size')}, got {len(payload)}"
+        )
+    if header.get("sha256") != hashlib.sha256(payload).hexdigest():
+        raise CorruptArtifact("payload sha256 mismatch")
+    return key, header, payload
+
+
+# ---------------------------------------------------------------------------
+# Artifact plane.
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    puts: int = 0
+    evictions: int = 0
+    corrupt: int = 0
+    bytes_read: int = 0
+    bytes_written: int = 0
+    prewarmed: int = 0
+    published: int = 0
+
+    def as_metrics(self) -> dict:
+        return {
+            "cache_hits": float(self.hits),
+            "cache_misses": float(self.misses),
+            "cache_bytes": float(self.bytes_read + self.bytes_written),
+        }
+
+
+class ArtifactStore:
+    """Content-addressed artifact directory with integrity verification,
+    atomic publication, and a byte-bounded LRU.
+
+    Thread/process-safe by construction rather than locks: concurrent
+    writers of one key both publish whole files via ``os.replace`` (last
+    writer wins an identical artifact), and readers verify integrity, so
+    no interleaving can surface a torn entry."""
+
+    SUFFIX = ".xc"
+
+    def __init__(self, root: str, max_bytes: Optional[int] = None):
+        self.root = root
+        env_max = os.environ.get(MAX_BYTES_ENV, "")
+        if max_bytes is None:
+            try:
+                max_bytes = int(env_max) if env_max else DEFAULT_STORE_MAX_BYTES
+            except ValueError:
+                max_bytes = DEFAULT_STORE_MAX_BYTES
+        self.max_bytes = max(0, max_bytes)
+        self.stats = CacheStats()
+
+    def path_for(self, key: CacheKey) -> str:
+        return os.path.join(self.root, key.fingerprint() + self.SUFFIX)
+
+    # ------------------------------------------------------------------
+    def get(self, key: CacheKey) -> Optional[bytes]:
+        """The verified payload, or None (miss).  A corrupt entry is
+        deleted and recompiled — a wrong executable must never load."""
+        path = self.path_for(key)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            self.stats.misses += 1
+            return None
+        try:
+            stored_key, _, payload = parse_envelope(data)
+            if stored_key != key:
+                raise CorruptArtifact("stored key differs from requested key")
+        except CorruptArtifact as e:
+            log.warning("corrupt artifact %s: %s (recompiling)", path, e)
+            self.stats.corrupt += 1
+            self.stats.misses += 1
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        self.stats.hits += 1
+        self.stats.bytes_read += len(payload)
+        try:
+            os.utime(path)  # LRU recency
+        except OSError:
+            pass
+        return payload
+
+    def put(self, key: CacheKey, payload: bytes) -> Optional[str]:
+        """Atomic tmp+replace publication; returns the path, or None when
+        persistence failed (the compile result is still usable in-memory —
+        the cache is an optimization, never a gate)."""
+        path = self.path_for(key)
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            envelope = build_envelope(key, payload)
+            tmp = path + f".{os.getpid()}.{threading.get_ident()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(envelope)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("artifact publish failed for %s: %s", path, e)
+            return None
+        self.stats.puts += 1
+        self.stats.bytes_written += len(payload)
+        self._evict_lru()
+        return path
+
+    def get_or_compile(
+        self, key: CacheKey, compile_fn: Callable[[], bytes]
+    ) -> tuple[bytes, bool]:
+        """(payload, hit?).  Misses run ``compile_fn`` and publish."""
+        payload = self.get(key)
+        if payload is not None:
+            return payload, True
+        payload = compile_fn()
+        self.put(key, payload)
+        return payload, False
+
+    # ------------------------------------------------------------------
+    def entries(self) -> list[tuple[str, dict]]:
+        """(artifact name, header) per entry — the publication manifest.
+        Header-line reads only (a manifest walk must not pay payload
+        bytes); unparsable headers are skipped, and payload integrity is
+        verified where the payload is actually consumed (``get``, fleet
+        ingest)."""
+        out: list[tuple[str, dict]] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.endswith(self.SUFFIX):
+                continue
+            header = self.read_header(name[: -len(self.SUFFIX)])
+            if (
+                header is None
+                or header.get("name") != name[: -len(self.SUFFIX)]
+                or not isinstance(header.get("key"), dict)
+            ):
+                continue
+            out.append((header["name"], header))
+        return out
+
+    def read_envelope(self, name: str) -> Optional[bytes]:
+        """Raw envelope bytes by artifact name (for publication/serving);
+        name is validated as a hex digest so a request can never traverse
+        out of the store root."""
+        if not valid_artifact_name(name):
+            return None
+        try:
+            with open(os.path.join(self.root, name + self.SUFFIX), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def exists(self, name: str) -> bool:
+        """Cheap liveness probe (the LRU may have evicted the file) — no
+        payload read; callers that need the bytes still go through the
+        verifying readers."""
+        return valid_artifact_name(name) and os.path.isfile(
+            os.path.join(self.root, name + self.SUFFIX)
+        )
+
+    def read_header(self, name: str) -> Optional[dict]:
+        """The envelope's header line only — index/manifest probes must
+        not pay a multi-MB payload read per entry.  Unparsable headers
+        read as absent (the verifying readers prune them on access)."""
+        if not valid_artifact_name(name):
+            return None
+        try:
+            with open(os.path.join(self.root, name + self.SUFFIX), "rb") as f:
+                line = f.readline(1 << 20)
+        except OSError:
+            return None
+        try:
+            header = json.loads(line)
+        except (UnicodeDecodeError, ValueError):
+            return None
+        if not isinstance(header, dict) or header.get("magic") != ENVELOPE_MAGIC:
+            return None
+        return header
+
+    def total_bytes(self) -> int:
+        total = 0
+        try:
+            for name in os.listdir(self.root):
+                if name.endswith(self.SUFFIX):
+                    total += os.path.getsize(os.path.join(self.root, name))
+        except OSError:
+            pass
+        return total
+
+    def _evict_lru(self) -> None:
+        """Drop oldest-touched entries until within ``max_bytes``.  The
+        just-published entry carries the newest mtime, so it goes last —
+        it is evicted only when it alone exceeds the whole bound (an
+        artifact bigger than the budget must not pin the store forever)."""
+        if not self.max_bytes:
+            return
+        try:
+            entries = [
+                (os.path.getmtime(p), p, os.path.getsize(p))
+                for name in os.listdir(self.root)
+                if name.endswith(self.SUFFIX)
+                for p in (os.path.join(self.root, name),)
+            ]
+        except OSError:
+            return
+        total = sum(size for _, _, size in entries)
+        entries.sort()  # oldest mtime first
+        for _, path, size in entries:
+            if total <= self.max_bytes:
+                break
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            self.stats.evictions += 1
+
+    # ------------------------------------------------------------------
+    def record_flight_sample(self) -> None:
+        """Surface the counters through the ambient flight recorder (→
+        agent push → fleet aggregator as tpu_workload_compile_cache_*);
+        no-op in untracked processes like every flight record call."""
+        try:
+            from tpu_operator.obs import flight
+
+            flight.record("compile-cache", phase="cache", **self.stats.as_metrics())
+        except Exception as e:  # noqa: BLE001 — telemetry must never gate
+            log.debug("compile-cache flight sample failed: %s", e)
+
+
+def valid_artifact_name(name: str) -> bool:
+    """64-hex content digest — the one naming rule every surface (store,
+    operator routes, agent relay) validates with; kind fingerprints share
+    the shape."""
+    return (
+        isinstance(name, str)
+        and len(name) == 64
+        and all(c in "0123456789abcdef" for c in name)
+    )
+
+
+def default_store(root: Optional[str] = None) -> Optional[ArtifactStore]:
+    """The node-local store under the artifact dir contract, or None when
+    no location is configured (tests and dryruns must never write a
+    persistent cache to the real host implicitly — the enable() rule)."""
+    root = root or os.environ.get(ARTIFACTS_ENV, "")
+    if not root or root == "0":
+        return None
+    return ArtifactStore(root)
+
+
+# ---------------------------------------------------------------------------
+# Seeding plane: fleet cache server object + workload-side client.
+
+
+class FleetCompileCache:
+    """Operator-side artifact cache: an :class:`ArtifactStore` plus a
+    kind index, served by the Manager's HTTP surface (``/compile-cache/*``
+    next to ``/push``) and relayed by the node metrics agent.
+
+    Ingest re-verifies every envelope (integrity + content addressing) —
+    the port is unauthenticated, and a corrupt or mis-keyed upload must be
+    rejected at the door, never served to a warm-pool node.  Thread-safe:
+    ingest arrives from the event loop, reads may come from anywhere."""
+
+    MAX_ARTIFACTS = 4096  # distinct programs ceiling (cardinality guard)
+
+    def __init__(self, root: str, max_bytes: Optional[int] = None, metrics=None):
+        self.store = ArtifactStore(root, max_bytes=max_bytes)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # kind fingerprint -> {artifact name -> header}
+        self._index: dict[str, dict[str, dict]] = {}
+        self._names: set[str] = set()
+        for name, header in self.store.entries():  # warm restart: reindex
+            self._index_entry(name, header)
+
+    def _index_entry(self, name: str, header: dict) -> None:
+        key = key_from_fields(header["key"])
+        with self._lock:
+            self._index.setdefault(key.kind(), {})[name] = header
+            self._names.add(name)
+
+    # ------------------------------------------------------------------
+    def _prune_dead(self) -> None:
+        """Drop index entries whose backing file the store's LRU evicted —
+        without this the MAX_ARTIFACTS cap fills permanently across
+        upgrade waves (every wave mints new names) and the index serves
+        phantom artifacts whose fetch 404s."""
+        with self._lock:
+            for kind in list(self._index):
+                bucket = self._index[kind]
+                for name in list(bucket):
+                    if not self.store.exists(name):
+                        del bucket[name]
+                        self._names.discard(name)
+                if not bucket:
+                    del self._index[kind]
+
+    def ingest(self, data: bytes) -> tuple[bool, str]:
+        """(accepted?, artifact name or error).  Size cap is enforced by
+        the HTTP route before the body reaches here."""
+        try:
+            key, header, payload = parse_envelope(data)
+        except CorruptArtifact as e:
+            self._count("rejected")
+            return False, str(e)
+        name = header["name"]
+        # known AND still on disk ⇒ idempotent duplicate; a known name
+        # whose file was LRU-evicted must re-store, not answer "duplicate"
+        # while warm nodes 404 on the fetch
+        with self._lock:
+            known = name in self._names
+        if known and self.store.exists(name):
+            self._count("duplicate")
+            return True, name  # idempotent re-publish (concurrent seeders)
+        with self._lock:
+            at_cap = not known and len(self._names) >= self.MAX_ARTIFACTS
+        if at_cap:
+            self._prune_dead()
+            with self._lock:
+                if len(self._names) >= self.MAX_ARTIFACTS:
+                    self._count("rejected")
+                    return False, "artifact cap reached"
+        if self.store.put(key, payload) is None:
+            self._count("rejected")
+            return False, "store write failed"
+        self._index_entry(name, header)
+        self._count("stored")
+        self._export_gauges()
+        return True, name
+
+    def index(self, kind: str) -> list[dict]:
+        with self._lock:
+            entries = dict(self._index.get(kind) or {})
+        out = []
+        dead = False
+        for name, header in sorted(entries.items()):
+            if not self.store.exists(name):
+                dead = True  # evicted since indexing; never advertise it
+                continue
+            out.append({
+                "name": name,
+                "program": header["key"].get("program", ""),
+                "size": header.get("size", 0),
+            })
+        if dead:
+            self._prune_dead()
+        return out
+
+    def has_kind(self, kind: str) -> bool:
+        return bool(self.index(kind))
+
+    def has_kind_labels(
+        self, generation: str, topology: str, libtpu_version: str = ""
+    ) -> bool:
+        """Warmness by raw key fields, jax version ignored — the
+        coordinator-side probe (the operator cannot know remote
+        validators' jax versions; a kind seeded under ANY jax build
+        proves the seeding plane reached it)."""
+        with self._lock:
+            headers = [
+                (name, header)
+                for bucket in self._index.values()
+                for name, header in bucket.items()
+            ]
+        for name, header in headers:
+            key = header.get("key") or {}
+            if (
+                key.get("generation") == generation
+                and key.get("topology") == topology
+                and key.get("libtpu_version") == libtpu_version
+                and self.store.exists(name)
+            ):
+                return True
+        return False
+
+    def get(self, name: str) -> Optional[bytes]:
+        data = self.store.read_envelope(name)
+        if data is not None:
+            self._count("served")
+        return data
+
+    # ------------------------------------------------------------------
+    def _count(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.compile_cache_requests_total.labels(outcome=outcome).inc()
+
+    def _export_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        with self._lock:
+            n = len(self._names)
+        self.metrics.compile_cache_artifacts.set(n)
+        self.metrics.compile_cache_bytes.set(self.store.total_bytes())
+
+
+class FleetCacheClient:
+    """Workload-side HTTP client for the fleet cache (the agent relay or
+    the operator surface directly, per ``TPU_FLEET_CACHE_URL``).  Blocking
+    urllib on purpose — it runs in workload processes before the first jit
+    trace, exactly where an event loop does not exist.  Best-effort
+    everywhere: an unreachable fleet cache means compiling, not failing."""
+
+    def __init__(self, base_url: str = "", timeout: float = _FETCH_TIMEOUT):
+        self.base_url = (base_url or os.environ.get(FLEET_CACHE_URL_ENV, "")).rstrip("/")
+        self.timeout = timeout
+
+    def enabled(self) -> bool:
+        return bool(self.base_url)
+
+    def _get(self, path: str) -> Optional[bytes]:
+        try:
+            with urllib.request.urlopen(
+                self.base_url + path, timeout=self.timeout
+            ) as resp:
+                return resp.read()
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def index(self, kind: str) -> list[dict]:
+        data = self._get("/compile-cache/index?kind=" + urllib.parse.quote(kind))
+        if data is None:
+            return []
+        try:
+            doc = json.loads(data)
+        except ValueError:
+            return []
+        artifacts = doc.get("artifacts")
+        return artifacts if isinstance(artifacts, list) else []
+
+    def fetch(self, name: str) -> Optional[bytes]:
+        if not valid_artifact_name(name):
+            return None
+        return self._get("/compile-cache/artifact/" + name)
+
+    def publish(self, envelope: bytes) -> bool:
+        if len(envelope) > ARTIFACT_MAX_BYTES:
+            return False
+        req = urllib.request.Request(
+            self.base_url + "/compile-cache/artifact",
+            data=envelope,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status < 400
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+
+def prewarm(
+    store: ArtifactStore,
+    kind: str,
+    client: Optional[FleetCacheClient] = None,
+) -> int:
+    """Pull this kind's fleet artifacts into the local store BEFORE the
+    first jit trace; returns artifacts fetched.  Every fetched envelope is
+    re-verified locally (parse_envelope inside store.put's reader path) —
+    a corrupt transfer costs a recompile, never a wrong executable."""
+    client = client or FleetCacheClient()
+    if not client.enabled():
+        return 0
+    fetched = 0
+    for entry in client.index(kind):
+        name = entry.get("name", "")
+        if not valid_artifact_name(name):
+            continue
+        if store.exists(name):
+            continue  # already local
+        data = client.fetch(name)
+        if data is None:
+            continue
+        try:
+            key, _, payload = parse_envelope(data)
+        except CorruptArtifact as e:
+            log.warning("prewarm: corrupt artifact %s from fleet cache: %s", name, e)
+            store.stats.corrupt += 1
+            continue
+        if key.kind() != kind:
+            continue  # server confusion; never store under a foreign kind
+        if store.put(key, payload) is not None:
+            fetched += 1
+    store.stats.prewarmed += fetched
+    return fetched
+
+
+def publish_kind(
+    store: ArtifactStore,
+    kind: str,
+    client: Optional[FleetCacheClient] = None,
+) -> int:
+    """Push this kind's local artifacts to the fleet cache (the seeder's
+    half of the warm pool); returns artifacts accepted."""
+    client = client or FleetCacheClient()
+    if not client.enabled():
+        return 0
+    published = 0
+    for name, header in store.entries():
+        if key_from_fields(header["key"]).kind() != kind:
+            continue
+        data = store.read_envelope(name)
+        if data is not None and client.publish(data):
+            published += 1
+    store.stats.published += published
+    return published
+
+
+# ---------------------------------------------------------------------------
+# AOT helpers over jax's explicit lowering path.
+
+
+def aot_fingerprint(fn, *args, name: str = "") -> tuple[object, str]:
+    """(lowered, program fingerprint).  Tracing+lowering costs milliseconds;
+    the fingerprint hashes the lowered StableHLO text, so any change to the
+    program, shapes, or dtypes changes the key."""
+    import jax
+
+    lowered = jax.jit(fn).lower(*args)
+    digest = hashlib.sha256(lowered.as_text().encode()).hexdigest()
+    return lowered, (f"{name}:{digest}" if name else digest)
+
+
+def serialize_compiled(compiled) -> bytes:
+    from jax.experimental.serialize_executable import serialize
+
+    return pickle.dumps(serialize(compiled))
+
+
+# The only globals genuine serialize_executable pickles reference — the
+# OUTER pickle (pytree defs around the triple) and the INNER executable
+# pickle (jax AOT bookkeeping; the compiled code itself travels as opaque
+# bytes through a persistent_id hook straight into XLA's deserializer).
+# The restricted unpicklers below refuse everything else, so a crafted
+# payload cannot resolve arbitrary callables through pickle's reduce
+# machinery — the worst a hostile artifact achieves is a load failure and
+# a recompile.  Enumerated empirically against the pinned jax; an
+# unlisted-but-genuine global on a future jax shows up as loud recompiles
+# (CorruptArtifact in the logs), never as a widened trust surface.
+_PICKLE_ALLOWED_GLOBALS = {
+    ("jax._src.tree_util", "default_registry"),
+    ("jaxlib.xla_extension.pytree", "PyTreeDef"),
+    ("jaxlib.xla_extension", "PyTreeDef"),
+    ("jax._src.core", "JaxprDebugInfo"),
+    ("jax._src.core", "DebugInfo"),
+    ("jax._src.core", "ShapedArray"),
+    ("jax._src.core", "AbstractToken"),
+    ("jax._src.interpreters.pxla", "AllArgsInfo"),
+    ("jax._src.interpreters.pxla", "UnloadedMeshExecutable"),
+    ("jax._src.layout", "DeviceLocalLayout"),
+    ("jax._src.stages", "ArgInfo"),
+    ("jaxlib.xla_extension", "DeviceList"),
+    ("jaxlib.xla_extension", "SingleDeviceSharding"),
+    ("jaxlib.xla_extension", "GSPMDSharding"),
+    ("jaxlib.xla_extension", "NamedSharding"),
+    ("numpy", "dtype"),
+    ("numpy.dtypes", "Float32DType"),
+}
+
+
+class _RestrictedFindClass:
+    """Mixin: allowlisted ``find_class`` shared by both pickle layers."""
+
+    def find_class(self, module, name):  # noqa: D102 — pickle API
+        if (module, name) in _PICKLE_ALLOWED_GLOBALS:
+            return super().find_class(module, name)  # type: ignore[misc]
+        raise CorruptArtifact(
+            f"artifact pickle references disallowed global {module}.{name}"
+        )
+
+
+class _OuterUnpickler(_RestrictedFindClass, pickle.Unpickler):
+    pass
+
+
+def load_serialized(payload: bytes):
+    """``jax.experimental.serialize_executable.deserialize_and_load``
+    with BOTH pickle layers restricted to the allowlist above (jax's own
+    helper unpickles the inner executable unrestricted)."""
+    import jax
+    from jax.experimental.serialize_executable import _JaxPjrtUnpickler
+
+    serialized, in_tree, out_tree = _OuterUnpickler(io.BytesIO(payload)).load()
+
+    class _InnerUnpickler(_RestrictedFindClass, _JaxPjrtUnpickler):
+        pass
+
+    backend = jax.devices()[0].client
+    unloaded_executable, args_info_flat, no_kwargs = _InnerUnpickler(
+        io.BytesIO(serialized), backend
+    ).load()
+    args_info = in_tree.unflatten(args_info_flat)
+    return jax.stages.Compiled(
+        unloaded_executable.load(), args_info, out_tree, no_kwargs=no_kwargs
+    )
+
+
+def compile_or_fetch(store: Optional[ArtifactStore], key: CacheKey, lowered):
+    """Load ``key``'s executable from the artifact store, else compile (and
+    publish locally).  Returns ``(executable, hit?, compile_seconds)`` —
+    the seconds are the *measured critical-path cost*, feeding the
+    ``compile`` join-phase segment.  A payload that fails to deserialize
+    (foreign runtime build despite the key, pickle drift) is treated as
+    corrupt: dropped and recompiled."""
+    t0 = time.perf_counter()
+    if store is not None:
+        payload = store.get(key)
+        if payload is not None:
+            try:
+                executable = load_serialized(payload)
+                return executable, True, time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — any load failure ⇒ recompile
+                log.warning("artifact for %s failed to load: %s", key.program, e)
+                store.stats.corrupt += 1
+                try:
+                    os.remove(store.path_for(key))
+                except OSError:
+                    pass
+    compiled = lowered.compile()
+    if store is not None:
+        try:
+            store.put(key, serialize_compiled(compiled))
+        except Exception as e:  # noqa: BLE001 — unserializable backend: cache skips
+            log.debug("executable for %s not serializable: %s", key.program, e)
+    return compiled, False, time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# Node-local jax persistent cache (the original layer).
 
 
 def enable() -> Optional[str]:
@@ -40,7 +828,11 @@ def enable() -> Optional[str]:
 
     Must run before the first jit compilation (config updates are decisive
     at trace time).  Returns the cache dir, or None when disabled or the
-    location is unusable (never fails validation over a cache)."""
+    location is unusable (never fails validation over a cache) — an
+    *unusable* location additionally leaves a ``compile_cache_disabled``
+    flight sample carrying the reason, so ``/debug/explain`` can name why a
+    node's compile phase is unexpectedly slow instead of the cache just
+    silently not being there."""
     path = os.environ.get("TPU_COMPILE_CACHE", "")
     if not path or path == "0":
         return None
@@ -53,6 +845,26 @@ def enable() -> Optional[str]:
         # WRITE serializes the executable, which on a tunneled backend costs
         # a device round-trip — caching every trivial program made the cold
         # validation 3x slower; only the multi-second compiles are worth it
-    except Exception:  # noqa: BLE001 — cache is an optimization, never a gate
+    except Exception as e:  # noqa: BLE001 — cache is an optimization, never a gate
+        _record_disabled(path, e)
         return None
     return path
+
+
+def _record_disabled(path: str, error: Exception) -> None:
+    """One flight sample naming why the persistent cache is off: the
+    sample rides the node's flight record (and push hop), where the
+    explain/critical-path tooling looks when compile time surprises."""
+    log.warning("compile cache at %s unusable: %s", path, error)
+    try:
+        from tpu_operator.obs import flight
+
+        flight.record(
+            "compile-cache",
+            phase="compile_cache_disabled",
+            compile_cache_disabled=1.0,
+            reason=f"{type(error).__name__}: {error}",
+            path=path,
+        )
+    except Exception as e:  # noqa: BLE001 — telemetry must never gate
+        log.debug("compile_cache_disabled flight sample failed: %s", e)

@@ -7,7 +7,8 @@ the validator (and humans reading pod logs) see the numbers.
 Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
-  ulysses,moe,pipeline,longctx,decode,transformer,transformer-pp,train (default
+  ulysses,moe,pipeline,longctx,decode,transformer,transformer-pp,train,
+  warm-pool (default
   runs the first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
@@ -119,6 +120,15 @@ def check_runners() -> dict:
 
         return pl.quick_check()
 
+    def warm_pool():
+        # the canonical validation programs through the fleet compile-
+        # artifact cache: prewarm → compile-or-fetch → execute → publish
+        # (workloads/warmpool.py; docs/PERFORMANCE.md "Compile cache &
+        # warm-pool validation")
+        from tpu_operator.workloads import warmpool
+
+        return warmpool.quick_check()
+
     def ring():
         return collectives.apply_ring_gate(
             collectives.ring_benchmark(
@@ -182,6 +192,7 @@ def check_runners() -> dict:
         "ring": ring,
         "hbm": hbm,
         "hbm-dma": hbm_dma,
+        "warm-pool": warm_pool,
     }
 
 

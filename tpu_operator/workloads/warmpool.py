@@ -1,0 +1,191 @@
+"""Warm-pool validation: the validator's XLA programs through the fleet
+compile-artifact cache.
+
+The join→validated phase breakdown (PR 7) proved XLA compilation dominates
+the validator's critical path.  This module is the cached replacement for
+paying that compile on every node: the *canonical program set* — the same
+shapes every validator of a (generation, topology, versions) kind proves —
+is compiled through :mod:`tpu_operator.workloads.compile_cache`'s AOT path:
+
+1. trace+lower each program (milliseconds) and fingerprint the lowered
+   StableHLO — the program half of the :class:`~.compile_cache.CacheKey`;
+2. hit the node-local artifact store, else the prewarmed fleet artifacts,
+   else compile (the one cold path) and publish;
+3. EXECUTE the loaded executable and verify its output is finite — a cache
+   hit still proves the chip runs the program, it only skips the compiler.
+
+Runs as the ``warm-pool`` check inside ``run_validation`` (opt-in via
+``WORKLOAD_CHECKS``) and as the per-node validation body of
+``bench.py --join``.  Every figure lands in the flight record (compile_s,
+cache hits/misses/bytes) so the agent push → fleet aggregator chain sees
+per-node warm/cold evidence.
+
+Env contract (injected by the validator's workload-pod spec):
+- ``TPU_COMPILE_CACHE_ARTIFACTS`` — node-local artifact dir (under the
+  compile-cache hostPath); unset ⇒ no artifact cache, every program
+  compiles (tests and dryruns never write persistent state implicitly).
+- ``TPU_FLEET_CACHE_URL`` — the fleet cache (agent relay or operator
+  surface); unset ⇒ node-local only.
+- ``TPU_CACHE_GENERATION`` / ``TPU_CACHE_TOPOLOGY`` /
+  ``TPU_LIBTPU_VERSION`` — the hardware/software half of the cache key.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from tpu_operator.workloads import compile_cache as cc
+
+GENERATION_ENV = "TPU_CACHE_GENERATION"
+TOPOLOGY_ENV = "TPU_CACHE_TOPOLOGY"
+
+
+def key_fields() -> dict:
+    """The non-program :class:`~.compile_cache.CacheKey` fields for this
+    process, from the env contract plus the live jax version."""
+    jax_version, libtpu_version = cc.current_versions()
+    return {
+        "generation": os.environ.get(GENERATION_ENV, ""),
+        "topology": os.environ.get(TOPOLOGY_ENV, ""),
+        "jax_version": jax_version,
+        "libtpu_version": libtpu_version,
+    }
+
+
+def kind_from_env() -> str:
+    fields = key_fields()
+    if not fields["generation"] and not fields["topology"]:
+        return ""
+    return cc.kind_fingerprint(**fields)
+
+
+def validation_programs() -> dict[str, Callable[[], tuple]]:
+    """name → builder returning ``(fn, args)``.  Builders return FRESH
+    function objects so jax's in-memory jit cache never masks a compile
+    that a separate validator process would pay — per-program cost is
+    honest even when several simulated nodes share one process (the
+    ``bench.py --join`` tier).  The set mirrors the validation gate:
+    element-wise (vector-add), a reduction chain (the allreduce shape),
+    and the layered matmul step whose compile dominates real joins."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def vector_add():
+        x = jnp.asarray(np.arange(1 << 12, dtype=np.float32))
+
+        def fn(a):
+            return (a + a).sum()
+
+        return fn, (x,)
+
+    def reduce_chain():
+        x = jnp.ones((64, 256), dtype=jnp.float32)
+
+        def fn(a):
+            for _ in range(4):
+                a = a - a.mean(axis=0, keepdims=True)
+                a = a / (1.0 + jnp.abs(a).max())
+            return a.sum()
+
+        return fn, (x,)
+
+    def train_step():
+        x = jnp.ones((256, 256), dtype=jnp.float32)
+
+        def fn(a):
+            for _ in range(6):
+                a = jnp.tanh(a @ a.T) @ a
+            return a.sum()
+
+        return fn, (x,)
+
+    return {
+        "vector-add": vector_add,
+        "reduce-chain": reduce_chain,
+        "train-step": train_step,
+    }
+
+
+def run(
+    store: Optional[cc.ArtifactStore] = None,
+    client: Optional[cc.FleetCacheClient] = None,
+    fields: Optional[dict] = None,
+    programs: Optional[dict] = None,
+) -> dict:
+    """Compile-or-fetch and execute every canonical program.  Returns the
+    check result: per-program hit/compile seconds, the store counters, and
+    ``ok`` false only on a genuinely wrong execution (non-finite output) —
+    cache trouble is never a failure, it just costs compiles."""
+    import math
+
+    from tpu_operator.obs import flight
+
+    store = store if store is not None else cc.default_store()
+    client = client or cc.FleetCacheClient()
+    fields = fields or key_fields()
+    programs = programs or validation_programs()
+    kind = cc.kind_fingerprint(**fields)
+
+    prewarmed = 0
+    if store is not None and client.enabled():
+        prewarmed = cc.prewarm(store, kind, client)
+
+    ok = True
+    results: dict[str, dict] = {}
+    compile_s = 0.0
+    fetch_s = 0.0
+    t0 = time.perf_counter()
+    for name, build in programs.items():
+        fn, args = build()
+        lowered, program_fp = cc.aot_fingerprint(fn, *args, name=name)
+        key = cc.CacheKey(program=program_fp, **fields)
+        executable, hit, seconds = cc.compile_or_fetch(store, key, lowered)
+        if hit:
+            fetch_s += seconds
+        else:
+            compile_s += seconds
+        value = float(executable(*args))
+        finite = math.isfinite(value)
+        ok = ok and finite
+        results[name] = {
+            "hit": hit,
+            "seconds": round(seconds, 6),
+            "finite": finite,
+        }
+        flight.record(
+            "warm-pool",
+            phase="compile",
+            compile_s=seconds if not hit else 0.0,
+            cache_hit=float(hit),
+        )
+
+    published = 0
+    if store is not None and client.enabled() and store.stats.misses > 0:
+        # only a validator that actually COMPILED something new publishes:
+        # warm-pool nodes must not re-upload the seeder's artifacts from
+        # 10k nodes at once (the fleet side is idempotent regardless)
+        published = cc.publish_kind(store, kind, client)
+    if store is not None:
+        store.record_flight_sample()
+
+    stats = store.stats if store is not None else cc.CacheStats()
+    result = {
+        "ok": ok,
+        "programs": len(results),
+        "hits": stats.hits,
+        "misses": stats.misses,
+        "corrupt": stats.corrupt,
+        "prewarmed": prewarmed,
+        "published": published,
+        "compile_s": round(compile_s, 6),
+        "fetch_s": round(fetch_s, 6),
+        "duration_s": round(time.perf_counter() - t0, 6),
+        "results": results,
+    }
+    return result
+
+
+def quick_check() -> dict:
+    return run()
