@@ -2,59 +2,72 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate fleet-obs lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+# asyncio sanitizers for tier-1 and every soak (docs/STATIC_ANALYSIS.md
+# "Runtime sanitizers"): debug-mode event loops (slow-callback + never-
+# retrieved-exception detection), faulthandler tracebacks on hard crashes,
+# and `coroutine ... was never awaited` promoted from warning to error
+SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
+
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
-# default test target = lint gates + counter-catalogue drift check +
-# the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint unit-test chaos chaos-health chaos-migrate fleet-obs bench-join
+# default test target = the unified analysis gate + the seeded race sweep
+# + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate fleet-obs bench-join
 
-# the telemetry counter tuples (metrics_agent COUNTERS/WORKLOAD_COUNTERS)
-# and the docs/OBSERVABILITY.md catalogue may never drift
+# the unified analysis plane (tpu_operator/analysis/;
+# docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
+# coverage, task-lifecycle, and env-contract analyzers, one process, one
+# AST parse per source file, non-zero on any unbaselined finding.
+# `--changed` gives the sub-2s incremental mode; `--json` the CI report.
+lint-all:
+	$(PYTHON) -m tpu_operator.analysis
+
+# seeded-interleaving race harness (tpu_operator/testing/interleave.py):
+# the workqueue/plane/migration invariant suite across >=200 distinct
+# task schedules per invariant, plus the injected-race regression test
+# proving the rig still catches an un-fenced handoff write
+race:
+	$(SAN_ENV) RACE_SEEDS=200 $(PYTHON) -m pytest tests/test_race.py -q -p no:cacheprovider
+
+# ---- historical per-gate aliases (the checks now run as analysis rules;
+# hack/check_*.py remain as shims for scripts calling them directly) ----
+
+# the telemetry counter tuples vs the docs/OBSERVABILITY.md catalogue
 counters-docs:
-	$(PYTHON) hack/check_counter_docs.py
+	$(PYTHON) -m tpu_operator.analysis --rules counter-docs
 
-# no time.sleep / blocking open / subprocess in async bodies under the
-# reconcile pipeline packages (docs/PERFORMANCE.md)
+# no blocking calls in async bodies under the reconcile pipeline
 async-lint:
-	$(PYTHON) hack/check_async_blocking.py
+	$(PYTHON) -m tpu_operator.analysis --rules async-blocking
 
-# no unbounded label values (pod uid, node at fleet scale, timestamps) on
-# prometheus_client registrations in tpu_operator/ — per-entity series
-# belong in the fleet aggregator's rings (docs/OBSERVABILITY.md)
+# no unbounded label values on prometheus_client registrations
 metric-labels:
-	$(PYTHON) hack/check_metric_labels.py
+	$(PYTHON) -m tpu_operator.analysis --rules metric-labels
 
-# no silent `except Exception: pass` under k8s/ and controllers/ — broad
-# swallows hide the failure taxonomy (docs/ROBUSTNESS.md)
+# no silent broad exception swallows
 except-lint:
-	$(PYTHON) hack/check_exception_hygiene.py
+	$(PYTHON) -m tpu_operator.analysis --rules exception-hygiene
 
-# pod-side span call sites must run under an adopted/activated tracer and
-# every TPU_* env contract the render layer stamps must be documented
-# (docs/OBSERVABILITY.md "Causal tracing & explain")
+# adopted tracers on pod-side spans + the TPU_* env contract surface
 trace-lint:
-	$(PYTHON) hack/check_trace_propagation.py
+	$(PYTHON) -m tpu_operator.analysis --rules trace-adoption,env-contract
 
-# no bare `open(..., 'w')` on checkpoint/result/status surfaces — every
-# publish must go through tmp+replace so a crash can never leave a torn
-# file a reader would trust (docs/ROBUSTNESS.md "Live migration")
+# no torn publishes on evidence surfaces
 atomic-lint:
-	$(PYTHON) hack/check_atomic_writes.py
+	$(PYTHON) -m tpu_operator.analysis --rules atomic-writes
 
-# no hand-rolled `while True: sleep` poll loops and no full-fleet Node
-# lists inside per-key reconcile paths under controllers/ — periodic work
-# rides the workqueue's scheduled-requeue API and per-node work stays
-# node-scoped; explicit full-resync entry points are allowlisted
-# (docs/PERFORMANCE.md "Delta reconcile & sharding")
+# no poll loops / full-fleet lists in per-key reconcile paths
 delta-lint:
-	$(PYTHON) hack/check_delta_paths.py
+	$(PYTHON) -m tpu_operator.analysis --rules delta-paths
 
 # the exact tier-1 invocation (ROADMAP.md "Tier-1 verify", minus the log
-# plumbing): slow-marked tests excluded, collection errors non-fatal
+# plumbing): slow-marked tests excluded, collection errors non-fatal.
+# conftest.py applies the asyncio sanitizers (SAN_ENV equivalents) inside
+# the session so the pinned CI line gets them too.
 unit-test:
-	$(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+	$(SAN_ENV) $(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
 
 # ruff gates the obs/ package (and the rest of the tree it configures in
 # pyproject [tool.ruff]); images without ruff baked in fall back to a
@@ -109,7 +122,7 @@ bench-reconcile:
 # warm-pool validation")
 JOIN_NODES ?= 12
 bench-join:
-	env JAX_PLATFORMS=cpu $(PYTHON) bench.py --join --nodes $(JOIN_NODES) --seed $(CHAOS_SEED)
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --join --nodes $(JOIN_NODES) --seed $(CHAOS_SEED)
 
 # seeded chaos acceptance soak (chip-free; ~1 min): 100-node fake cluster,
 # 5% transient API errors + watch drops + one leader-lease steal must still
@@ -119,7 +132,7 @@ CHAOS_NODES ?= 100
 CHAOS_SEED ?= 1
 CHAOS_ERROR_RATE ?= 0.05
 chaos:
-	$(PYTHON) bench.py --chaos --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED) --error-rate $(CHAOS_ERROR_RATE)
+	$(SAN_ENV) $(PYTHON) bench.py --chaos --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED) --error-rate $(CHAOS_ERROR_RATE)
 
 # node-health-engine acceptance soak (chip-free; ~1-2 min): injected agent
 # verdicts + NotReady flaps + validator crash-loops on a 100-node fake
@@ -128,7 +141,7 @@ chaos:
 # a cordon, and flipping to observe-only (with Event) when a fleet-wide
 # signal source lies (docs/ROBUSTNESS.md "Node health engine")
 chaos-health:
-	$(PYTHON) bench.py --chaos-health --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+	$(SAN_ENV) $(PYTHON) bench.py --chaos-health --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # live-migration acceptance soak (chip-free; ~2 min): real CPU-backend
 # training jobs on a 100-node fake cluster; a seeded mid-training
@@ -138,7 +151,7 @@ chaos-health:
 # with drain_evictions_total{reason=timeout}; a chaos-torn snapshot is
 # never restored (docs/ROBUSTNESS.md "Live migration")
 chaos-migrate:
-	$(PYTHON) bench.py --chaos-migrate --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+	$(SAN_ENV) $(PYTHON) bench.py --chaos-migrate --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must
@@ -148,7 +161,7 @@ chaos-migrate:
 # and aggregation must add ZERO steady-state API verbs per reconcile pass
 # (docs/OBSERVABILITY.md "Fleet telemetry & SLOs")
 fleet-obs:
-	$(PYTHON) bench.py --fleet-obs --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+	$(SAN_ENV) $(PYTHON) bench.py --fleet-obs --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # single image for operator + operands (docker/Dockerfile)
 image:

@@ -3019,5 +3019,43 @@ def main() -> None:
     print(json.dumps(output))
 
 
+def _arm_unraisable_gate() -> None:
+    """Make the never-awaited sanitizer fatal outside pytest.
+
+    ``PYTHONWARNINGS=error:coroutine:RuntimeWarning`` (the Makefile's
+    SAN_ENV) promotes the warning, but it fires during coroutine GC where
+    the promoted error is *unraisable*: the default hook prints and the
+    process still exits 0.  The soaks are gates, so a dropped coroutine
+    must fail them — mirror pytest's PytestUnraisableExceptionWarning
+    promotion by trapping the hook and dying non-zero at exit
+    (docs/STATIC_ANALYSIS.md "Runtime sanitizers")."""
+    prior_hook = sys.unraisablehook
+    seen: list[str] = []
+
+    def hook(unraisable):
+        msg = str(unraisable.exc_value or unraisable.err_msg or "")
+        if "was never awaited" in msg or isinstance(
+            unraisable.exc_value, RuntimeWarning
+        ):
+            seen.append(msg)
+        prior_hook(unraisable)
+
+    sys.unraisablehook = hook
+
+    import atexit
+
+    @atexit.register
+    def _fail_on_dropped_coroutines() -> None:
+        if seen:
+            print(
+                f"SANITIZER: {len(seen)} unraisable coroutine warning(s): "
+                f"{seen[:3]}",
+                file=sys.stderr,
+            )
+            os._exit(70)
+
+
 if __name__ == "__main__":
+    if os.environ.get("PYTHONASYNCIODEBUG"):
+        _arm_unraisable_gate()
     main()

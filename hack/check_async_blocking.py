@@ -1,133 +1,16 @@
 #!/usr/bin/env python
-"""Event-loop blocking lint (make test).
+"""Thin shim: the event-loop blocking lint (make async-lint) now lives in the unified
+analysis plane as rule(s) `async-blocking` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-The reconcile pipeline is a single asyncio loop: one blocking call inside an
-``async def`` stalls every informer, watch stream, and concurrent apply in
-the process.  This walks ``tpu_operator/k8s`` and ``tpu_operator/controllers``
-and rejects the classic offenders inside ``async def`` bodies:
-
-- ``time.sleep(...)``            (use ``await asyncio.sleep``)
-- ``open(...)`` / ``io.open``    (use ``run_in_executor`` for slow paths —
-                                  an NFS/projected-token ``open`` can block
-                                  for seconds)
-- ``subprocess.run/call/check_*``/``os.system``  (use asyncio subprocesses)
-- ``urllib.request.urlopen``, ``requests.*``, ``socket.create_connection``
-  (use aiohttp)
-
-Nested SYNC ``def`` bodies are excluded — the ``def probe(): ...`` handed to
-``run_in_executor`` is the sanctioned pattern.  A line may opt out with a
-``# blocking-ok`` comment (e.g. a sub-millisecond read of an in-memory
-procfs path).  Exits non-zero listing every violation.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGES = ("tpu_operator/k8s", "tpu_operator/controllers")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# (module, attr) calls that block the loop; attr None means any attr
-BLOCKING_ATTR_CALLS = {
-    ("time", "sleep"),
-    ("subprocess", "run"),
-    ("subprocess", "call"),
-    ("subprocess", "check_call"),
-    ("subprocess", "check_output"),
-    ("subprocess", "Popen"),
-    ("os", "system"),
-    ("socket", "create_connection"),
-    ("requests", None),
-}
-BLOCKING_NAME_CALLS = {"open"}
-
-
-def _call_target(node: ast.Call):
-    fn = node.func
-    if isinstance(fn, ast.Name):
-        return None, fn.id
-    if isinstance(fn, ast.Attribute):
-        parts = []
-        cur = fn
-        while isinstance(cur, ast.Attribute):
-            parts.append(cur.attr)
-            cur = cur.value
-        if isinstance(cur, ast.Name):
-            parts.append(cur.id)
-            parts.reverse()
-            return parts[0], parts[-1] if len(parts) == 1 else ".".join(parts[1:])
-    return None, None
-
-
-def _blocking_calls(async_fn: ast.AsyncFunctionDef, source_lines: list[str]) -> list[tuple[int, str]]:
-    out: list[tuple[int, str]] = []
-
-    def walk(node: ast.AST, in_async: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.FunctionDef):
-                continue  # sync helper destined for run_in_executor
-            if isinstance(child, ast.AsyncFunctionDef):
-                continue  # reported separately via ast.walk
-            if isinstance(child, ast.Call) and in_async:
-                root, rest = _call_target(child)
-                label = None
-                if root is None and rest in BLOCKING_NAME_CALLS:
-                    label = rest
-                elif root is not None:
-                    if (root, rest) in BLOCKING_ATTR_CALLS or (root, None) in BLOCKING_ATTR_CALLS:
-                        label = f"{root}.{rest}"
-                    elif root == "urllib" and rest and rest.endswith("urlopen"):
-                        label = f"{root}.{rest}"
-                if label is not None:
-                    line = source_lines[child.lineno - 1] if child.lineno <= len(source_lines) else ""
-                    if "# blocking-ok" not in line:
-                        out.append((child.lineno, label))
-            walk(child, in_async)
-
-    walk(async_fn, True)
-    return out
-
-
-def check_file(path: str) -> list[str]:
-    with open(path) as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: syntax error: {e}"]
-    lines = source.splitlines()
-    problems = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AsyncFunctionDef):
-            for lineno, label in _blocking_calls(node, lines):
-                problems.append(
-                    f"{os.path.relpath(path, REPO)}:{lineno}: blocking {label}() "
-                    f"inside async def {node.name} (stalls the reconcile loop; "
-                    "use the asyncio equivalent or run_in_executor)"
-                )
-    return problems
-
-
-def main() -> int:
-    problems: list[str] = []
-    n_files = 0
-    for pkg in PACKAGES:
-        for dirpath, _, filenames in os.walk(os.path.join(REPO, pkg)):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                n_files += 1
-                problems.extend(check_file(os.path.join(dirpath, name)))
-    if problems:
-        print("async-blocking lint failures:")
-        for p in problems:
-            print(f"  {p}")
-        return 1
-    print(f"async-blocking: {n_files} files clean under {', '.join(PACKAGES)}")
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "async-blocking"]))

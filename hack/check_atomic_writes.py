@@ -1,125 +1,16 @@
 #!/usr/bin/env python
-"""Atomic-write lint (make atomic-lint): no torn publishes on result paths.
+"""Thin shim: the atomic-write lint (make atomic-lint) now lives in the unified
+analysis plane as rule(s) `atomic-writes` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-Sibling of check_exception_hygiene.py.  Walks the packages whose files are
-*read back as evidence* — the workloads (checkpoint snapshots, results
-drop-boxes, compile-cache artifact envelopes), the validator (ready
-markers, status files), the obs layer (flight records), and the
-controllers (the operator-side fleet compile cache publishes artifacts
-through its routes) — and rejects any write-mode ``open(..., "w"/"wb")``
-whose publish is not atomic: a crash mid-write must leave either the
-previous complete file or nothing, never a truncated file a reader would
-trust (docs/ROBUSTNESS.md "Live migration" is gated on exactly this
-property for checkpoint manifests; a torn compile-cache artifact would be
-rejected by its integrity hash, but only a whole-file publish keeps the
-PREVIOUS executable servable through a crash).
-
-A write-mode open is accepted when either
-
-- the enclosing function also calls ``os.replace``/``os.rename`` (the
-  tmp+replace publish pattern — the open targets the tmp side), or
-- the path expression's source mentions ``tmp`` (an explicit temp path
-  whose torn state is debris by construction, e.g. under tempfile dirs).
-
-Append mode (``"a"``), read modes, and binary reads are out of scope —
-append is already crash-tolerant line-wise for the JSONL consumers here.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGES = (
-    "tpu_operator/workloads",
-    "tpu_operator/validator",
-    "tpu_operator/obs",
-    # the fleet compile cache's server side (Manager /compile-cache/*
-    # ingest) lives here; its artifact publication must stay tmp+replace
-    "tpu_operator/controllers",
-)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-WRITE_MODES = {"w", "wb", "w+", "wb+", "wt"}
-
-
-def _mode_of(call: ast.Call) -> str | None:
-    """The literal mode argument of an open() call, if determinable."""
-    args = list(call.args)
-    if len(args) >= 2 and isinstance(args[1], ast.Constant) and isinstance(args[1].value, str):
-        return args[1].value
-    for kw in call.keywords:
-        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
-            return kw.value.value
-    return None
-
-
-def _is_open(call: ast.Call) -> bool:
-    return isinstance(call.func, ast.Name) and call.func.id == "open"
-
-
-def _calls_replace(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            if node.func.attr in ("replace", "rename") and isinstance(node.func.value, ast.Name) \
-                    and node.func.value.id == "os":
-                return True
-    return False
-
-
-def check_file(path: str) -> list[str]:
-    with open(path) as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: syntax error: {e}"]
-    problems = []
-    # map each open() call to its innermost enclosing function
-    functions = [
-        n for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
-    for fn in functions:
-        has_replace = _calls_replace(fn)
-        for node in ast.walk(fn):
-            if not (isinstance(node, ast.Call) and _is_open(node)):
-                continue
-            mode = _mode_of(node)
-            if mode is None or mode not in WRITE_MODES:
-                continue
-            if has_replace:
-                continue
-            path_src = ast.get_source_segment(source, node.args[0]) or "" if node.args else ""
-            if "tmp" in path_src.lower():
-                continue
-            problems.append(
-                f"{os.path.relpath(path, REPO)}:{node.lineno}: bare "
-                f"open({path_src or '...'}, {mode!r}) — publish through "
-                "tmp+os.replace so a crash can never leave a torn file"
-            )
-    return problems
-
-
-def main() -> int:
-    problems: list[str] = []
-    n_files = 0
-    for pkg in PACKAGES:
-        for dirpath, _, filenames in os.walk(os.path.join(REPO, pkg)):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                n_files += 1
-                problems.extend(check_file(os.path.join(dirpath, name)))
-    if problems:
-        print("atomic-write lint failures:")
-        for p in problems:
-            print(f"  {p}")
-        return 1
-    print(f"atomic-writes: {n_files} files clean under {', '.join(PACKAGES)}")
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "atomic-writes"]))

@@ -1,87 +1,16 @@
 #!/usr/bin/env python
-"""Counter-catalogue drift check (make counters-docs).
-
-Two surfaces are pinned against docs/OBSERVABILITY.md:
-
-- the node-agent telemetry catalogue — the COUNTERS + WORKLOAD_COUNTERS
-  tuples in tpu_operator/agents/metrics_agent.py.  Every counter in code
-  must appear in the docs, and every ``tpu_duty…``/``tpu_workload…``-style
-  counter the docs catalogue must exist in code (a renamed counter must
-  rename its row, not strand it).
-- the operator metric families — every ``tpu_operator_*`` family name
-  registered in tpu_operator/metrics.py must be documented (the health
-  engine's gauges/counters made the undocumented-gauge hole visible; the
-  gate now closes it for the whole registry).
-
-Exits non-zero listing the drift.
-"""
-
-from __future__ import annotations
+"""Thin shim: the counter-catalogue drift check (make counters-docs) now lives in the unified
+analysis plane as rule(s) `counter-docs` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-OPERATOR_METRICS = os.path.join(REPO, "tpu_operator", "metrics.py")
-
-# metric families documented elsewhere in the file (operator histograms,
-# validator gauges) are not part of the agent counter catalogue
-_NON_AGENT_PREFIXES = ("tpu_operator_", "tpu_validator_")
-
-
-def main() -> int:
-    from tpu_operator.agents.metrics_agent import COUNTERS, WORKLOAD_COUNTERS
-
-    in_code = set(COUNTERS) | set(WORKLOAD_COUNTERS)
-    with open(DOCS) as f:
-        text = f.read()
-    documented = {
-        name
-        for name in re.findall(r"\btpu_[a-z0-9_]+\b", text)
-        if not name.startswith(_NON_AGENT_PREFIXES)
-        # the catalogue documents counters, not module paths like
-        # tpu_operator/agents — the prefix filter plus the counter
-        # vocabulary below keeps prose out
-        and (name in in_code or re.match(r"tpu_(workload|hbm|ici|duty|tensorcore|chip)_", name))
-    }
-    missing_from_docs = sorted(in_code - documented)
-    missing_from_code = sorted(documented - in_code)
-
-    # operator registry: every family name literal in metrics.py must be
-    # documented (docs-side names not in code are caught by ruff-level
-    # review, not here — prose legitimately mentions derived sample names)
-    with open(OPERATOR_METRICS) as f:
-        operator_in_code = set(
-            re.findall(r'"(tpu_operator_[a-z0-9_]+)"', f.read())
-        )
-    operator_documented = set(re.findall(r"\btpu_operator_[a-z0-9_]+\b", text))
-    operator_missing = sorted(operator_in_code - operator_documented)
-
-    if missing_from_docs:
-        print("counters missing from docs/OBSERVABILITY.md:")
-        for name in missing_from_docs:
-            print(f"  {name}")
-    if missing_from_code:
-        print("documented counters absent from metrics_agent tuples:")
-        for name in missing_from_code:
-            print(f"  {name}")
-    if operator_missing:
-        print("operator metrics missing from docs/OBSERVABILITY.md:")
-        for name in operator_missing:
-            print(f"  {name}")
-    if missing_from_docs or missing_from_code or operator_missing:
-        return 1
-    print(
-        f"counters-docs: {len(in_code)} agent counters "
-        f"({len(COUNTERS)} chip + {len(WORKLOAD_COUNTERS)} workload) and "
-        f"{len(operator_in_code)} operator families in sync"
-    )
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "counter-docs"]))

@@ -1,162 +1,16 @@
 #!/usr/bin/env python
-"""Delta-path lint (make delta-lint).
+"""Thin shim: the delta-path lint (make delta-lint) now lives in the unified
+analysis plane as rule(s) `delta-paths` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-The fleet-scale reconcile plane (docs/PERFORMANCE.md "Delta reconcile &
-sharding") only stays O(1)-per-event if per-key reconcile code never
-regresses into the two patterns it replaced.  This gate bans, under
-``tpu_operator/controllers/``:
-
-1. **Hand-rolled poll loops** — a ``while True:`` loop whose body awaits
-   ``asyncio.sleep``.  Periodic work belongs on the workqueue's
-   scheduled-requeue API (``Controller.enqueue_after`` / a reconcile
-   returning its revisit delay), which is cancellable, dedup'd, and
-   saturation-instrumented; an in-function sleep loop is none of those.
-
-2. **Full-fleet Node lists in per-key paths** — ``.list("", "Node")`` /
-   ``.list_items("", "Node")`` calls.  A per-node/per-key reconcile must do
-   node-scoped reads (cached GETs, the slice-group index); walking the
-   fleet belongs only to the explicit full-resync safety-net entry points.
-
-Both carry an ALLOWLIST of (file, qualified function) entry points that are
-*supposed* to be full-resync or process-lifecycle loops.  Add to it only
-for a genuine resync entry point, never to sneak a fleet walk into a
-per-key path.  Exits non-zero listing offenders.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONTROLLERS = os.path.join(REPO, "tpu_operator", "controllers")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# (filename, function name) pairs allowed to `while True: ... sleep(...)`:
-# process-lifecycle supervisors, not per-key reconcile paths.
-SLEEP_LOOP_ALLOWLIST = {
-    ("runtime.py", "_supervise"),  # manager degraded-mode/leadership supervisor
-}
-
-# (filename, function name) pairs allowed to list the full Node fleet:
-# the explicit full-resync safety nets and fleet-scoped (not per-node)
-# controllers whose pass IS the fleet sweep.
-NODE_LIST_ALLOWLIST = {
-    ("clusterpolicy.py", "_reconcile"),       # full-walk resync safety net
-    ("clusterinfo.py", "gather"),             # context gatherer (callers pass nodes=)
-    ("labels.py", "label_tpu_nodes"),         # the full-walk's label engine
-    ("nodes.py", "prime"),                    # one-shot index seed at plane start
-    ("tpuruntime.py", "_reconcile"),          # per-CR pool derivation (informer-cached reads)
-    ("tpuruntime.py", "_selector_conflicts"), # cross-CR conflict validation (cached)
-    ("upgrade.py", "_reconcile"),             # fleet-keyed upgrade state machine
-    ("remediation.py", "_reconcile"),         # fleet-keyed remediation sweep
-    ("health.py", "_reconcile"),              # fleet-keyed health engine pass
-    ("revalidation.py", "_reconcile"),        # fleet-keyed wave scheduling sweep
-}
-
-
-def _is_asyncio_sleep(call: ast.Call) -> bool:
-    fn = call.func
-    return (
-        isinstance(fn, ast.Attribute)
-        and fn.attr == "sleep"
-        and isinstance(fn.value, ast.Name)
-        and fn.value.id == "asyncio"
-    )
-
-
-def _is_node_fleet_list(call: ast.Call) -> bool:
-    """``<anything>.list("", "Node", ...)`` / ``.list_items("", "Node", ...)``
-    without a label/field selector narrowing it."""
-    fn = call.func
-    if not (isinstance(fn, ast.Attribute) and fn.attr in ("list", "list_items")):
-        return False
-    args = call.args
-    if len(args) < 2:
-        return False
-    first, second = args[0], args[1]
-    if not (
-        isinstance(first, ast.Constant) and first.value == ""
-        and isinstance(second, ast.Constant) and second.value == "Node"
-    ):
-        return False
-    # a selector-narrowed list is node-pool-scoped, not full-fleet
-    for kw in call.keywords:
-        if kw.arg in ("label_selector", "field_selector") and not (
-            isinstance(kw.value, ast.Constant) and kw.value.value is None
-        ):
-            return False
-    if len(args) >= 4 and not (
-        isinstance(args[3], ast.Constant) and args[3].value is None
-    ):
-        return False
-    return True
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self, filename: str):
-        self.filename = filename
-        self.offenders: list[str] = []
-        self._func_stack: list[str] = []
-
-    def _current(self) -> str:
-        return self._func_stack[-1] if self._func_stack else "<module>"
-
-    def _visit_func(self, node):
-        self._func_stack.append(node.name)
-        self.generic_visit(node)
-        self._func_stack.pop()
-
-    visit_FunctionDef = _visit_func
-    visit_AsyncFunctionDef = _visit_func
-
-    def visit_While(self, node: ast.While) -> None:
-        is_forever = isinstance(node.test, ast.Constant) and node.test.value is True
-        if is_forever:
-            sleeps = [
-                n for n in ast.walk(node)
-                if isinstance(n, ast.Call) and _is_asyncio_sleep(n)
-            ]
-            if sleeps and (self.filename, self._current()) not in SLEEP_LOOP_ALLOWLIST:
-                self.offenders.append(
-                    f"{self.filename}:{node.lineno} {self._current()}(): "
-                    f"hand-rolled `while True: asyncio.sleep` poll loop — "
-                    f"use the workqueue's scheduled-requeue API"
-                )
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        if _is_node_fleet_list(node) and (
-            (self.filename, self._current()) not in NODE_LIST_ALLOWLIST
-        ):
-            self.offenders.append(
-                f"{self.filename}:{node.lineno} {self._current()}(): "
-                f"full-fleet Node list in a per-key reconcile path — "
-                f"use node-scoped cached reads (or allowlist a genuine "
-                f"full-resync entry point)"
-            )
-        self.generic_visit(node)
-
-
-def main() -> int:
-    offenders: list[str] = []
-    for fname in sorted(os.listdir(CONTROLLERS)):
-        if not fname.endswith(".py"):
-            continue
-        path = os.path.join(CONTROLLERS, fname)
-        with open(path) as f:
-            tree = ast.parse(f.read(), filename=path)
-        v = _Visitor(fname)
-        v.visit(tree)
-        offenders.extend(v.offenders)
-    if offenders:
-        print("delta-path lint FAILED:")
-        for o in offenders:
-            print(f"  {o}")
-        return 1
-    print("delta-path lint OK")
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "delta-paths"]))

@@ -1,104 +1,16 @@
 #!/usr/bin/env python
-"""Exception-hygiene lint (make test): no silently swallowed Exceptions.
+"""Thin shim: the exception-hygiene lint (make except-lint) now lives in the unified
+analysis plane as rule(s) `exception-hygiene` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-Sibling of check_async_blocking.py.  Walks ``tpu_operator/k8s`` and
-``tpu_operator/controllers`` and rejects handlers that catch ``Exception``
-(bare ``except:``, ``except Exception:``, or a tuple containing it) whose
-body is only ``pass``/``...`` — the pattern that hides the intended failure
-taxonomy: a broad clause swallowing everything indiscriminately turned the
-informer's 410-relist vs transient-backoff vs fatal distinction into mush
-(the PR 4 informer bug).  Swallowing a NARROW exception (``except ApiError:
-pass``) stays legal — that is an explicit decision about a named failure.
-Broad handlers must at least log.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# controllers/ (incl. the health engine), the API plumbing, the obs layer
-# whose Events are the health engine's evidence channel, and the node
-# agents that publish its signal plane
-PACKAGES = (
-    "tpu_operator/k8s",
-    "tpu_operator/controllers",
-    "tpu_operator/obs",
-    "tpu_operator/agents",
-    # the workloads own the checkpoint/migration evidence chain now — a
-    # silently swallowed error there hides a torn-snapshot taxonomy
-    "tpu_operator/workloads",
-)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BROAD = {"Exception", "BaseException"}
-
-
-def _names(expr: ast.expr | None) -> set[str]:
-    """Exception class names named by an ``except`` clause."""
-    if expr is None:
-        return set(BROAD)  # bare except:
-    if isinstance(expr, ast.Tuple):
-        out: set[str] = set()
-        for el in expr.elts:
-            out |= _names(el)
-        return out
-    if isinstance(expr, ast.Name):
-        return {expr.id}
-    if isinstance(expr, ast.Attribute):
-        return {expr.attr}
-    return set()
-
-
-def _is_silent(body: list[ast.stmt]) -> bool:
-    for stmt in body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
-            continue  # docstring / ellipsis
-        return False
-    return True
-
-
-def check_file(path: str) -> list[str]:
-    with open(path) as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: syntax error: {e}"]
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if _names(node.type) & BROAD and _is_silent(node.body):
-            problems.append(
-                f"{os.path.relpath(path, REPO)}:{node.lineno}: broad "
-                "`except Exception: pass` swallows the failure taxonomy — "
-                "narrow the clause or log what was caught"
-            )
-    return problems
-
-
-def main() -> int:
-    problems: list[str] = []
-    n_files = 0
-    for pkg in PACKAGES:
-        for dirpath, _, filenames in os.walk(os.path.join(REPO, pkg)):
-            for name in sorted(filenames):
-                if not name.endswith(".py"):
-                    continue
-                n_files += 1
-                problems.extend(check_file(os.path.join(dirpath, name)))
-    if problems:
-        print("exception-hygiene lint failures:")
-        for p in problems:
-            print(f"  {p}")
-        return 1
-    print(f"exception-hygiene: {n_files} files clean under {', '.join(PACKAGES)}")
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "exception-hygiene"]))

@@ -1,143 +1,16 @@
 #!/usr/bin/env python
-"""Label-cardinality lint (make metric-labels).
+"""Thin shim: the label-cardinality lint (make metric-labels) now lives in the unified
+analysis plane as rule(s) `metric-labels` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-Prometheus series are allocated per label-value combination; a label whose
-values are unbounded (pod names/uids, node names at 10k-node scale,
-timestamps, span/reconcile ids) turns a counter into a memory leak on both
-the operator and every scraper.  The fleet plane keeps per-node series
-inside its OWN ring buffers (obs/fleet.py) and exports only rollups — this
-gate keeps the prometheus_client registries honest about the same
-discipline tree-wide.
-
-Walks every ``Counter``/``Gauge``/``Histogram``/``Summary`` registration
-under ``tpu_operator/`` (AST-level: any call whose first argument is a
-``tpu_*`` metric-name literal, plus direct constructor calls) and rejects
-label names on the denylist below.  Exits non-zero listing offenders.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "tpu_operator")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
-
-# node-LOCAL registries: one process per node, so a "node" label carries
-# exactly one value per registry and exists to name the host (Prometheus's
-# `instance` is the podIP).  The denylist still applies to everything else
-# in these packages via the shared-label subset below.
-NODE_LOCAL_DIRS = (
-    os.path.join("tpu_operator", "validator"),
-    os.path.join("tpu_operator", "agents"),
-)
-NODE_LOCAL_ALLOWED = {"node", "node_name"}
-
-# label names whose value space is unbounded on a large fleet.  "node" is
-# deliberately included: per-node series belong in the fleet aggregator's
-# rings or on the node-local exporters, never on the operator registry.
-DENYLIST = {
-    "pod", "pod_name", "pod_uid", "uid", "name", "node", "node_name",
-    "namespace", "timestamp", "ts", "time", "date", "id", "run_id",
-    "span_id", "trace_id", "reconcile_id", "key", "url", "path", "le",
-}
-
-
-def _literal_strings(node: ast.AST):
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        yield node.value
-    elif isinstance(node, (ast.List, ast.Tuple)):
-        for elt in node.elts:
-            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                yield elt.value
-
-
-def _call_name(call: ast.Call) -> str:
-    func = call.func
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return ""
-
-
-def _candidate_labels(call: ast.Call):
-    """Label-name literals of one metric registration: list/tuple literals
-    in any positional slot past (name, documentation), the ``labelnames``
-    keyword, and bare short identifier-ish strings in those slots (the
-    ``h(name, doc, "controller")`` wrapper pattern)."""
-    for arg in call.args[2:]:
-        if isinstance(arg, (ast.List, ast.Tuple)):
-            yield from _literal_strings(arg)
-        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            if arg.value.isidentifier():
-                yield arg.value
-    for kw in call.keywords:
-        if kw.arg == "labelnames" and kw.value is not None:
-            yield from _literal_strings(kw.value)
-
-
-def check_file(path: str) -> list[str]:
-    with open(path) as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: unparsable: {e}"]
-    rel = os.path.relpath(path, REPO)
-    allowed = (
-        NODE_LOCAL_ALLOWED
-        if any(rel.startswith(d + os.sep) for d in NODE_LOCAL_DIRS)
-        else set()
-    )
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        first = node.args[0] if node.args else None
-        metric_name = (
-            first.value
-            if isinstance(first, ast.Constant) and isinstance(first.value, str)
-            else ""
-        )
-        is_registration = name in _METRIC_CTORS or (
-            metric_name.startswith("tpu_") and len(node.args) >= 2
-        )
-        if not is_registration:
-            continue
-        for label in _candidate_labels(node):
-            if label in DENYLIST and label not in allowed:
-                problems.append(
-                    f"{rel}:{node.lineno}: metric "
-                    f"{metric_name or '<dynamic>'} uses unbounded label "
-                    f"{label!r} (per-entity series belong in the fleet "
-                    "aggregator's rings, not the Prometheus registry)"
-                )
-    return problems
-
-
-def main() -> int:
-    problems: list[str] = []
-    checked = 0
-    for root, _dirs, files in os.walk(PACKAGE):
-        if "__pycache__" in root:
-            continue
-        for fname in files:
-            if fname.endswith(".py"):
-                problems.extend(check_file(os.path.join(root, fname)))
-                checked += 1
-    if problems:
-        print("metric-labels: unbounded label cardinality:")
-        for p in problems:
-            print(f"  {p}")
-        return 1
-    print(f"metric-labels: {checked} files clean (denylist of {len(DENYLIST)})")
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "metric-labels"]))

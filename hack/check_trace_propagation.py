@@ -1,176 +1,16 @@
 #!/usr/bin/env python
-"""Trace-propagation lint (make trace-lint).
+"""Thin shim: the trace-propagation lint (make trace-lint) now lives in the unified
+analysis plane as rule(s) `trace-adoption,env-contract` (tpu_operator/analysis/;
+docs/STATIC_ANALYSIS.md).  `make lint-all` runs the full set in one
+process with one AST parse per file; this entry point remains so the
+historical Makefile target and any scripts calling it keep working."""
 
-The cross-process tracing contract (docs/OBSERVABILITY.md "Causal tracing
-& explain") only holds if every pod-side process that opens spans does so
-under an explicitly established tracer — one that ``adopt()``\\ ed the
-propagated ``TPU_TRACEPARENT`` context (or at least ``activate()``\\ d a
-local tracer on purpose).  A span opened in a module that never
-establishes a tracer is either dead instrumentation or silently riding a
-caller's context the author never audited; a pod entrypoint that
-``activate()``\\ s without ever ``adopt()``\\ ing orphans the operator's
-trace at the process boundary.
-
-Two AST-level rules, same idiom as the sibling hack/ gates:
-
-1. **Adopted-tracer rule** — every module under ``tpu_operator/agents``
-   and ``tpu_operator/validator`` (plus the workload-pod entrypoint
-   ``tpu_operator/workloads/run_validation.py``) that opens spans
-   (``trace.span(...)`` / ``<tracer>.span(...)`` / ``<tracer>.reconcile``)
-   must contain at least one ``.adopt(...)`` or ``.activate(...)`` call.
-   A span line may opt out with a ``# trace-ambient-ok`` comment
-   (library code deliberately relying on the ambient no-op contract).
-
-2. **Env-contract docs rule** — every ``TPU_*`` environment variable the
-   render layer stamps into operand pods (string literals in
-   ``tpu_operator/state/render_data.py`` and ``name: TPU_...`` env
-   entries in ``assets/``) must be documented in ``docs/*.md``: a pod
-   env contract nobody can read about is an integration trap.
-
-Exits non-zero listing every violation.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SPAN_PACKAGES = (
-    os.path.join("tpu_operator", "agents"),
-    os.path.join("tpu_operator", "validator"),
-)
-EXTRA_SPAN_FILES = (
-    os.path.join("tpu_operator", "workloads", "run_validation.py"),
-)
-
-RENDER_DATA = os.path.join(REPO, "tpu_operator", "state", "render_data.py")
-ASSETS = os.path.join(REPO, "assets")
-DOCS_DIR = os.path.join(REPO, "docs")
-
-OPT_OUT = "# trace-ambient-ok"
-
-# env names that are k8s/infra conventions, not operator env contracts
-_ENV_IGNORE: set = set()
-
-
-def _span_files() -> list[str]:
-    out = []
-    for pkg in SPAN_PACKAGES:
-        root = os.path.join(REPO, pkg)
-        for dirpath, _, names in os.walk(root):
-            out.extend(
-                os.path.join(dirpath, n) for n in names if n.endswith(".py")
-            )
-    out.extend(os.path.join(REPO, f) for f in EXTRA_SPAN_FILES)
-    return sorted(out)
-
-
-def _attr_name(call: ast.Call) -> str:
-    return call.func.attr if isinstance(call.func, ast.Attribute) else ""
-
-
-def check_span_adoption() -> list[str]:
-    violations = []
-    for path in _span_files():
-        with open(path) as f:
-            source = f.read()
-        try:
-            tree = ast.parse(source)
-        except SyntaxError as e:
-            violations.append(f"{path}: unparsable: {e}")
-            continue
-        lines = source.splitlines()
-        span_lines = []
-        established = False
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            attr = _attr_name(node)
-            if attr in ("adopt", "activate"):
-                established = True
-            elif attr in ("span", "reconcile"):
-                line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-                if OPT_OUT not in line:
-                    span_lines.append(node.lineno)
-        if span_lines and not established:
-            rel = os.path.relpath(path, REPO)
-            violations.append(
-                f"{rel}:{span_lines[0]}: opens spans (lines "
-                f"{', '.join(map(str, span_lines[:5]))}) but never adopts/"
-                f"activates a tracer — adopt(TraceContext.from_env()) or "
-                f"mark the line {OPT_OUT}"
-            )
-    return violations
-
-
-_ENV_NAME_RE = re.compile(r"^TPU_[A-Z0-9_]+$")
-# assets: `- name: TPU_X` env entries and `{"name": "TPU_X", ...}` extras
-_ASSET_ENV_RE = re.compile(r"name:\s*(TPU_[A-Z0-9_]+)\b")
-_ASSET_DICT_RE = re.compile(r"[\"']name[\"']\s*:\s*[\"'](TPU_[A-Z0-9_]+)[\"']")
-
-
-def _render_env_contracts() -> dict[str, str]:
-    """TPU_* env names the render layer can stamp into pods → where seen."""
-    found: dict[str, str] = {}
-    # string literals in render_data.py (e.g. env names passed to extras)
-    with open(RENDER_DATA) as f:
-        tree = ast.parse(f.read())
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Constant)
-            and isinstance(node.value, str)
-            and _ENV_NAME_RE.match(node.value)
-        ):
-            found.setdefault(node.value, f"state/render_data.py:{node.lineno}")
-    for dirpath, _, names in os.walk(ASSETS):
-        for name in names:
-            if not (name.endswith(".yaml") or name.endswith(".j2")):
-                continue
-            path = os.path.join(dirpath, name)
-            with open(path) as f:
-                text = f.read()
-            rel = os.path.relpath(path, REPO)
-            for regex in (_ASSET_ENV_RE, _ASSET_DICT_RE):
-                for env in regex.findall(text):
-                    found.setdefault(env, rel)
-    return {k: v for k, v in found.items() if k not in _ENV_IGNORE}
-
-
-def check_env_docs() -> list[str]:
-    docs_text = ""
-    for name in sorted(os.listdir(DOCS_DIR)):
-        if name.endswith(".md"):
-            with open(os.path.join(DOCS_DIR, name)) as f:
-                docs_text += f.read()
-    violations = []
-    for env, where in sorted(_render_env_contracts().items()):
-        if env not in docs_text:
-            violations.append(
-                f"{where}: pod env contract {env} is undocumented — add it "
-                "to docs/ (OBSERVABILITY.md env-contract section or the "
-                "relevant operand doc)"
-            )
-    return violations
-
-
-def main() -> int:
-    violations = check_span_adoption() + check_env_docs()
-    if violations:
-        print("trace-propagation violations:")
-        for v in violations:
-            print(f"  {v}")
-        return 1
-    n_env = len(_render_env_contracts())
-    print(
-        f"trace-propagation: {len(_span_files())} pod-side modules under "
-        f"adopted tracers, {n_env} TPU_* env contracts documented"
-    )
-    return 0
-
+from tpu_operator.analysis.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "trace-adoption,env-contract"]))

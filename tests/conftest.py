@@ -5,12 +5,27 @@
   the first ``import jax`` anywhere in the test session.
 - Provides a minimal async test runner (no pytest-asyncio in this image):
   ``async def test_*`` functions run under ``asyncio.run``.
+- Arms the asyncio sanitizers for the whole session (the pinned tier-1
+  line doesn't route through ``make unit-test``'s SAN_ENV, so the session
+  arms them itself): debug-mode event loops, faulthandler tracebacks on
+  hard crashes, and ``coroutine ... was never awaited`` promoted to error
+  via the filterwarnings entry in pyproject
+  (docs/STATIC_ANALYSIS.md "Runtime sanitizers").
 """
 
-import asyncio
+import faulthandler
 import inspect
 import os
 import sys
+
+# PYTHONASYNCIODEBUG is consulted at loop creation; set it before any test
+# (or asyncio itself, below) can build a loop so every loop in the session
+# runs in debug mode — never-retrieved task exceptions and >100ms loop
+# stalls surface in the log instead of vanishing
+os.environ.setdefault("PYTHONASYNCIODEBUG", "1")
+faulthandler.enable()
+
+import asyncio  # noqa: E402
 
 # Force (not setdefault): the axon TPU sitecustomize rewrites JAX_PLATFORMS
 # at interpreter start; tests must run on the virtual 8-device CPU platform

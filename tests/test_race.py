@@ -1,0 +1,293 @@
+"""Seeded-interleaving race suite (make race).
+
+Runs the invariants the async-race / fence-coverage analysis rules guard
+— workqueue dirty-set exclusion, plane-handoff exactly-once under the
+shard WriteFence, migration-coordinator single-restore — across many
+distinct but reproducible task schedules
+(tpu_operator/testing/interleave.py; docs/STATIC_ANALYSIS.md "Runtime
+twin").  ``RACE_SEEDS`` scales the sweep: tier-1 runs a fast default,
+``make race`` runs ≥200 seeds per invariant.
+
+The last test is the rig's own regression test: it deliberately UN-FENCES
+the plane write (the exact bug shape PR 9's exactly-once claim forbids)
+and asserts the sweep catches a double actuation on at least one seed —
+proving the harness can see the race the fence exists to close.
+"""
+
+import asyncio
+import os
+from collections import Counter
+
+from tpu_operator import consts
+from tpu_operator.api.types import MigrationSpec
+from tpu_operator.controllers import migration as mig
+from tpu_operator.controllers.plane import NodePlane
+from tpu_operator.k8s import client as client_api
+from tpu_operator.k8s import workqueue as wq
+from tpu_operator.k8s.client import ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.testing.interleave import run_interleaved, sweep
+
+RACE_SEEDS = int(os.environ.get("RACE_SEEDS", "40"))
+
+
+# ---------------------------------------------------------------------------
+# workqueue: dirty-set exclusion + no lost re-adds under shared workers
+
+
+def test_workqueue_dirty_set_interleaved():
+    """One key must never reconcile concurrently with itself, and an add
+    landing mid-reconcile (the dirty set) must trigger another pass —
+    under every schedule of 4 workers x storming producers."""
+
+    async def scenario():
+        q = wq.WorkQueue(name="race")
+        processed: Counter = Counter()
+        active: set[str] = set()
+        overlaps: list[str] = []
+        adds_after_processing: Counter = Counter()
+
+        async def worker():
+            while True:
+                try:
+                    key = await q.get()
+                except wq.ShutDown:
+                    return
+                if key in active:
+                    overlaps.append(key)
+                active.add(key)
+                await asyncio.sleep(0)  # the window dirty-set semantics cover
+                processed[key] += 1
+                active.discard(key)
+                q.done(key)
+
+        workers = [asyncio.create_task(worker()) for _ in range(4)]
+
+        async def producer(i: int):
+            for key in ("alpha", "beta", "gamma"):
+                q.add(key, priority=wq.PRIORITY_NORMAL if i % 2 else wq.PRIORITY_HIGH)
+                adds_after_processing[key] += 1
+                await asyncio.sleep(0)
+
+        await asyncio.gather(*[producer(i) for i in range(3)])
+        # drain: every pending/dirty key must eventually process
+        for _ in range(2000):
+            if q.idle:
+                break
+            await asyncio.sleep(0)
+        q.shut_down()
+        await asyncio.gather(*workers)
+        assert not overlaps, f"key reconciled concurrently with itself: {overlaps}"
+        for key in ("alpha", "beta", "gamma"):
+            assert processed[key] >= 1, f"{key} never processed"
+        assert q.idle
+
+    report = sweep(scenario, range(RACE_SEEDS))
+    assert not report.failures, report.summary()
+    assert report.total_permutations > 0, "scenario had no schedule freedom"
+
+
+# ---------------------------------------------------------------------------
+# plane handoff: exactly-once actuation under the shard WriteFence
+
+
+class _FencedReconciler:
+    """Level-triggered stub: actuates a key once (guarded by 'current
+    state'), with the read→actuate window split by an await — the shape
+    the shard fence must keep exactly-once across handoffs.  ``fenced``
+    False models a write path that bypasses the ambient fence (the
+    injected regression)."""
+
+    def __init__(self, fenced: bool = True):
+        self.fenced = fenced
+        self.applied: dict[str, bool] = {}
+        self.log: list[str] = []
+        self.on_identity_change = "unused"
+
+    def tracked(self):
+        return []
+
+    async def prime(self):
+        return None
+
+    async def reconcile(self, key):
+        if self.applied.get(key):
+            return None  # read current state: already actuated
+        await asyncio.sleep(0)  # handoff can land in this window
+        if self.fenced:
+            fence = client_api._REQUEST_FENCE.get()
+            assert fence is not None, "plane reconcile ran without a fence"
+            fence.check("PATCH", f"/api/v1/nodes/{key}")  # ApiClient order
+        self.log.append(key)
+        self.applied[key] = True
+        return None
+
+
+async def _churn_plane(rec) -> Counter:
+    plane = NodePlane(rec, shards=3, resync_seconds=0)
+    await plane.start()
+    keys = [f"node-{i}" for i in range(8)]
+    try:
+        for key in keys:
+            plane.enqueue(key)
+        # a rebalance rips a shard mid-flight while the event stream keeps
+        # re-enqueuing the same keys — the cross-shard window where a key
+        # can be in flight on the old owner and queued on the new one
+        await asyncio.sleep(0)
+        plane.remove_shard("node-shard-0")
+        for key in keys:
+            plane.enqueue(key)
+        await asyncio.sleep(0)
+        plane.add_shard("node-shard-0")
+        for key in keys:
+            plane.enqueue(key)
+        for _ in range(4000):
+            if plane.quiesced():
+                break
+            await asyncio.sleep(0)
+        assert plane.quiesced(), "plane failed to quiesce"
+    finally:
+        await plane.stop()
+    return Counter(rec.log)
+
+
+def test_plane_handoff_exactly_once_fenced():
+    """With the shard fence consulted (the shipped path), no schedule may
+    double-actuate a key across a rip+re-add rebalance."""
+
+    async def scenario():
+        actuations = await _churn_plane(_FencedReconciler(fenced=True))
+        dupes = {k: c for k, c in actuations.items() if c > 1}
+        assert not dupes, f"double actuation through the fence: {dupes}"
+        assert len(actuations) == 8, f"keys never actuated: {actuations}"
+
+    report = sweep(scenario, range(RACE_SEEDS))
+    assert not report.failures, report.summary()
+    assert report.total_permutations > 0
+
+
+def test_plane_unfenced_write_race_is_caught():
+    """Regression test for the rig itself: un-fence the write and the
+    sweep MUST observe a double actuation on some schedule — if this ever
+    stops failing, the harness has lost the race the fence exists to
+    close (and the fence-coverage rule is the static twin that keeps real
+    call sites out of this state)."""
+
+    async def scenario():
+        actuations = await _churn_plane(_FencedReconciler(fenced=False))
+        dupes = {k: c for k, c in actuations.items() if c > 1}
+        assert not dupes, f"double actuation: {dupes}"
+
+    report = sweep(scenario, range(max(RACE_SEEDS, 60)))
+    assert report.failures, (
+        "unfenced double-actuation went unobserved across the sweep — the "
+        "interleaving harness can no longer catch the handoff race"
+    )
+
+
+# ---------------------------------------------------------------------------
+# migration coordinator: concurrent drains mint exactly one restore pod
+
+
+class _AtomicPodStore:
+    """Fake apiserver pod surface: network latency is an await BEFORE the
+    atomic check+insert (the server is atomic; the race lives on the
+    client side), matching the 409 AlreadyExists contract."""
+
+    def __init__(self, pods):
+        self.pods = dict(pods)
+        self.creates: list[str] = []
+
+    async def create(self, obj):
+        await asyncio.sleep(0)
+        name = obj["metadata"]["name"]
+        if name in self.pods:
+            raise ApiError(409, "AlreadyExists")
+        self.pods[name] = obj
+        self.creates.append(name)
+        return obj
+
+    async def delete(self, group, kind, name, namespace=None, **kw):
+        await asyncio.sleep(0)
+        self.pods.pop(name, None)
+        return None
+
+    async def patch(self, group, kind, name, patch, namespace=None, **kw):
+        await asyncio.sleep(0)
+        return self.pods.get(name, {})
+
+
+class _NullRecorder:
+    async def normal(self, *a, **kw):
+        return True
+
+    async def warning(self, *a, **kw):
+        return True
+
+
+_MIG_METRICS = OperatorMetrics()
+
+
+def test_migration_concurrent_drains_single_restore():
+    """Two controllers draining the same checkpoint-complete pod (health
+    quarantine + upgrade both own the node) must produce exactly ONE
+    restore pod under every schedule — the deterministic replacement name
+    + create-409-adopt contract."""
+
+    def checkpointed_pod():
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "train-a", "namespace": "default",
+                "labels": {
+                    consts.MIGRATE_HANDLER_LABEL:
+                        consts.MIGRATION_HANDLER_CHECKPOINT,
+                },
+                "annotations": {
+                    consts.MIGRATE_ANNOTATION: consts.MIGRATE_REQUESTED,
+                },
+            },
+            "spec": {"nodeName": "node-bad", "containers": [{"name": "t"}]},
+            "status": {"phase": "Succeeded"},
+        }
+
+    async def scenario():
+        store = _AtomicPodStore({"train-a": checkpointed_pod()})
+        coord = mig.MigrationCoordinator(
+            store, "tpu-operator", metrics=_MIG_METRICS,
+            recorder=_NullRecorder(),
+        )
+        spec = MigrationSpec(enabled=True, timeout_seconds=120)
+        outcomes = await asyncio.gather(
+            coord.drain_pod(checkpointed_pod(), spec, "health", nodes=[]),
+            coord.drain_pod(checkpointed_pod(), spec, "upgrade", nodes=[]),
+        )
+        assert set(outcomes) == {mig.MIGRATED}, outcomes
+        assert store.creates == ["train-a-mig1"], (
+            f"restore minted {len(store.creates)} times: {store.creates}"
+        )
+        assert "train-a" not in store.pods
+
+    report = sweep(scenario, range(RACE_SEEDS))
+    assert not report.failures, report.summary()
+
+
+# ---------------------------------------------------------------------------
+# determinism: the same seed must replay the same schedule
+
+
+def test_interleave_deterministic_replay():
+    async def scenario():
+        order: list[int] = []
+
+        async def tag(i):
+            order.append(i)
+
+        await asyncio.gather(*[tag(i) for i in range(6)])
+        return tuple(order)
+
+    first, _ = run_interleaved(scenario, seed=1234)
+    second, _ = run_interleaved(scenario, seed=1234)
+    assert first == second
+    others = {run_interleaved(scenario, seed=s)[0] for s in range(12)}
+    assert len(others) > 1, "shuffling produced no schedule diversity"

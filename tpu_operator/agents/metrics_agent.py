@@ -200,6 +200,15 @@ class FleetForwarder:
             self._task is None or self._task.done()
         ):
             self._task = asyncio.create_task(self._drain())
+            self._task.add_done_callback(self._drain_finished)
+
+    @staticmethod
+    def _drain_finished(task: asyncio.Task) -> None:
+        """The drain loop handles transport errors itself; anything else
+        escaping it must not vanish with the task reference (an unretained
+        task swallows its exception on GC)."""
+        if not task.cancelled() and task.exception() is not None:
+            log.warning("fleet forward drain crashed: %r", task.exception())
 
     async def _drain(self) -> None:
         backoff = 0

@@ -1,0 +1,20 @@
+"""Unified static-analysis plane (make lint-all).
+
+One engine parses each source file exactly once and runs every registered
+rule over the shared AST; findings come back as structured
+``file:line [rule] message`` records with JSON output, per-rule allowlists,
+a checked-in baseline, and a ``--changed`` incremental mode.  The seven
+historical ``hack/check_*.py`` gates live here as rules now (the scripts
+remain as thin shims), joined by the four analyzers guarding the asyncio
+plane's correctness invariants: ``async-race``, ``fence-coverage``,
+``task-lifecycle``, and ``env-contract``.  docs/STATIC_ANALYSIS.md is the
+rule catalogue.
+"""
+
+from tpu_operator.analysis.core import (  # noqa: F401
+    Context,
+    Engine,
+    Finding,
+    Rule,
+    SourceFile,
+)

@@ -1,0 +1,109 @@
+"""CLI: ``python -m tpu_operator.analysis`` (make lint-all).
+
+Exit status is the gate: 0 when every finding is baselined or none fired,
+1 otherwise.  ``--json`` emits a stable machine-readable report (sorted
+findings, schema version) for CI annotation; ``--changed`` restricts the
+run to rules whose inputs the working tree touched (sub-2s on a typical
+diff); ``--rules a,b`` selects rules by name (the old per-gate Makefile
+targets are aliases onto this); ``--write-baseline`` regenerates the
+checked-in baseline from the current findings (etiquette:
+docs/STATIC_ANALYSIS.md — baselines only ever shrink).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from tpu_operator.analysis import core
+from tpu_operator.analysis.rules import all_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_operator.analysis",
+        description="unified static-analysis plane (see docs/STATIC_ANALYSIS.md)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    p.add_argument("--changed", action="store_true",
+                   help="run only rules relevant to the files the working tree touched")
+    p.add_argument("--rules", default="", metavar="A,B",
+                   help="comma-separated rule names to run (default: all)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline file (default: tpu_operator/analysis/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings and exit 0")
+    p.add_argument("--list", action="store_true", dest="list_rules",
+                   help="list registered rules and exit")
+    p.add_argument("--root", default=core.REPO, help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:20s} {r.doc}")
+        return 0
+
+    engine = core.Engine(rules, root=args.root)
+    names = [n.strip() for n in args.rules.split(",") if n.strip()] or None
+    changed = core.changed_files(args.root) if args.changed else None
+    baseline_path = args.baseline or os.path.join(args.root, core.DEFAULT_BASELINE)
+    baseline = core.load_baseline(baseline_path)
+    try:
+        result = engine.run(names=names, changed=changed, baseline=baseline)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        fresh = {f.fingerprint() for f in result.findings + result.baselined}
+        # a scoped run (--rules / --changed) only re-evaluated the selected
+        # rules: every other rule's existing entries must survive the
+        # rewrite, or baselining one rule silently un-baselines the rest
+        ran = set(result.rules_run)
+        kept = {fp for fp in baseline if fp.split("::", 1)[0] not in ran}
+        core.write_baseline_fingerprints(baseline_path, fresh | kept)
+        print(
+            f"baseline written: {len(fresh)} finding(s) from {len(ran)} "
+            f"rule(s) + {len(kept)} kept from unselected rules → "
+            f"{os.path.relpath(baseline_path, args.root)}"
+        )
+        return 0
+
+    if args.json:
+        report = {
+            "schema": 1,
+            "rules_run": result.rules_run,
+            "files_parsed": result.parse_count,
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": len(result.baselined),
+            "stale_baseline": result.stale_baseline,
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        suffix = f", {len(result.baselined)} baselined" if result.baselined else ""
+        if result.stale_baseline:
+            print(
+                f"note: {len(result.stale_baseline)} stale baseline entr"
+                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} no "
+                "longer fire — shrink the baseline (--write-baseline)"
+            )
+        status = "FAILED" if result.findings else "OK"
+        print(
+            f"analysis {status}: {len(result.rules_run)} rule(s), "
+            f"{result.parse_count} file(s) parsed, "
+            f"{len(result.findings)} finding(s){suffix}"
+        )
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Shared AST helpers for the analysis rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+
+def call_name(call: ast.Call) -> str:
+    """Terminal name of the called thing: ``foo`` for ``foo(...)``,
+    ``bar`` for ``a.b.bar(...)``."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def dotted_target(call: ast.Call) -> tuple[Optional[str], Optional[str]]:
+    """(root, rest) of a dotted call: ``time.sleep()`` → ("time", "sleep"),
+    ``urllib.request.urlopen()`` → ("urllib", "request.urlopen"), a bare
+    ``open()`` → (None, "open")."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return None, fn.id
+    if isinstance(fn, ast.Attribute):
+        parts = []
+        cur: ast.AST = fn
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            parts.reverse()
+            return parts[0], parts[-1] if len(parts) == 1 else ".".join(parts[1:])
+    return None, None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` when the node is ``self.attr``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def literal_strings(node: ast.AST):
+    """String constants in a literal or directly inside a list/tuple."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.List, ast.Tuple)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
+
+
+def functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def contains_await(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in ast.walk(node))

@@ -1,0 +1,357 @@
+"""Rule framework: parse once, run every rule, structured findings.
+
+The engine owns the file set (every ``.py`` under ``tpu_operator/`` plus
+the text surfaces some rules pin — ``docs/``, ``assets/``, ``deploy/``).
+Each :class:`SourceFile` parses lazily and exactly once per run; rules see
+the shared tree through :class:`Context`, so adding a rule costs one AST
+walk, never another parse of the tree (``make lint-all`` is gated on one
+parse per file — ``Context.parse_count`` is the witness).
+
+Suppression has three distinct layers, in order of preference:
+
+- **comment opt-out** (``# blocking-ok`` etc.) — a reviewed, line-scoped
+  decision living next to the code it excuses;
+- **structured allowlist** — (file, function) entries in the rule module
+  for entry points that are *supposed* to look like the pattern;
+- **baseline** — the checked-in ``baseline.json`` of pre-existing findings
+  a new rule inherited.  Baselines keep the gate red-free while debt is
+  paid down; they must only ever shrink (docs/STATIC_ANALYSIS.md
+  "Allowlist & baseline etiquette").
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# directories the engine scans for python sources, repo-relative
+PY_ROOTS = ("tpu_operator",)
+# text surfaces rules may pin (docs rows, rendered env contracts)
+TEXT_ROOTS = ("docs", "assets", "deploy")
+
+DEFAULT_BASELINE = os.path.join("tpu_operator", "analysis", "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One structured record: ``file:line [rule] message``."""
+
+    rule: str
+    file: str  # repo-relative
+    line: int
+    message: str
+
+    def fingerprint(self) -> str:
+        """Baseline identity: line numbers drift with unrelated edits, so
+        the fingerprint is (rule, file, message) — an entry survives code
+        motion but not a second instance of the same bug shape."""
+        return f"{self.rule}::{self.file}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One python source: raw text, split lines, and a lazily-parsed AST.
+
+    The tree is parsed at most once; a syntax error is reported as a
+    finding by the engine (rules never see a broken tree)."""
+
+    def __init__(self, root: str, rel: str, ctx: "Context"):
+        self.root = root
+        self.rel = rel
+        self.path = os.path.join(root, rel)
+        self._ctx = ctx
+        self._source: Optional[str] = None
+        self._lines: Optional[list[str]] = None
+        self._tree: Optional[ast.AST] = None
+        self._parse_error: Optional[SyntaxError] = None
+        self._parsed = False
+
+    @property
+    def source(self) -> str:
+        if self._source is None:
+            with open(self.path) as f:
+                self._source = f.read()
+        return self._source
+
+    @property
+    def lines(self) -> list[str]:
+        if self._lines is None:
+            self._lines = self.source.splitlines()
+        return self._lines
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if not self._parsed:
+            self._parsed = True
+            self._ctx.parse_count += 1
+            try:
+                self._tree = ast.parse(self.source, filename=self.path)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree  # type: ignore[return-value]
+
+    @property
+    def parse_error(self) -> Optional[SyntaxError]:
+        self.tree  # noqa: B018 — force the parse attempt
+        return self._parse_error
+
+    def line_has(self, lineno: int, marker: str) -> bool:
+        """Comment opt-out check for a 1-based line."""
+        if 1 <= lineno <= len(self.lines):
+            return marker in self.lines[lineno - 1]
+        return False
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+
+class Context:
+    """Shared per-run state: the file set, parsed trees, text surfaces."""
+
+    def __init__(self, root: str = REPO):
+        self.root = root
+        self.parse_count = 0
+        self._files: dict[str, SourceFile] = {}
+        self._discovered = False
+        self._text_cache: dict[str, str] = {}
+        self._docs_text: Optional[str] = None
+
+    # -- python sources -------------------------------------------------
+    def _discover(self) -> None:
+        if self._discovered:
+            return
+        self._discovered = True
+        for pkg in PY_ROOTS:
+            top = os.path.join(self.root, pkg)
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                        self._files.setdefault(rel, SourceFile(self.root, rel, self))
+
+    def files(self) -> list[SourceFile]:
+        self._discover()
+        return [self._files[rel] for rel in sorted(self._files)]
+
+    def files_under(self, *prefixes: str) -> list[SourceFile]:
+        """Sources matching any repo-relative prefix (``pkg/sub/`` selects a
+        tree, ``pkg/file.py`` one file)."""
+        self._discover()
+        out = []
+        for rel in sorted(self._files):
+            if any(rel == p or rel.startswith(p.rstrip("/") + "/") for p in prefixes):
+                out.append(self._files[rel])
+        return out
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        self._discover()
+        return self._files.get(rel)
+
+    # -- text surfaces ---------------------------------------------------
+    def docs_text(self) -> str:
+        """Concatenated ``docs/*.md`` — the rows several rules pin."""
+        if self._docs_text is None:
+            parts = []
+            docs = os.path.join(self.root, "docs")
+            if os.path.isdir(docs):
+                for name in sorted(os.listdir(docs)):
+                    if name.endswith(".md"):
+                        with open(os.path.join(docs, name)) as f:
+                            parts.append(f.read())
+            self._docs_text = "\n".join(parts)
+        return self._docs_text
+
+    def text_files_under(self, prefix: str, exts: tuple[str, ...]) -> list[tuple[str, str]]:
+        top = os.path.join(self.root, prefix)
+        out: list[tuple[str, str]] = []
+        if not os.path.isdir(top):
+            return out
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(exts):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                if rel not in self._text_cache:
+                    with open(os.path.join(self.root, rel)) as f:
+                        self._text_cache[rel] = f.read()
+                out.append((rel, self._text_cache[rel]))
+        return out
+
+
+class Rule:
+    """One invariant checker.
+
+    ``paths`` are the repo-relative python trees/files the rule reads (used
+    both to dispatch ``check_file`` and to decide relevance in ``--changed``
+    mode); ``extra_paths`` are non-python inputs (docs/, assets/) that also
+    make the rule relevant to a diff.  Per-file logic goes in
+    ``check_file``; cross-file logic (docs drift, call graphs) in
+    ``finalize``, which runs once after every file the rule asked for.
+    """
+
+    name = ""
+    doc = ""  # one-line: what the rule proves
+    paths: tuple[str, ...] = ()
+    extra_paths: tuple[str, ...] = ()
+
+    def run(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in ctx.files_under(*self.paths):
+            if sf.tree is None:
+                continue  # engine reports the syntax error once
+            out.extend(self.check_file(sf, ctx))
+        out.extend(self.finalize(ctx))
+        return out
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        return ()
+
+    def relevant_to(self, changed: set[str]) -> bool:
+        """Does a diff touching ``changed`` (repo-relative paths) affect
+        this rule's inputs?  A rule is always relevant to edits of its own
+        implementation (analysis/ tree)."""
+        prefixes = tuple(self.paths) + tuple(self.extra_paths) + (
+            "tpu_operator/analysis",
+        )
+        for rel in changed:
+            for p in prefixes:
+                p = p.rstrip("/")
+                if rel == p or rel.startswith(p + "/"):
+                    return True
+        return False
+
+
+# ----------------------------------------------------------------------
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    write_baseline_fingerprints(path, {f.fingerprint() for f in findings})
+
+
+def write_baseline_fingerprints(path: str, fingerprints: set[str]) -> None:
+    data = {
+        "version": 1,
+        "findings": sorted(fingerprints),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def changed_files(root: str) -> set[str]:
+    """Repo-relative paths the working tree changed vs HEAD (staged,
+    unstaged, and untracked) — the ``--changed`` input set."""
+    out: set[str] = set()
+    cmds = (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    for cmd in cmds:
+        try:
+            res = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode == 0:
+            out.update(line.strip() for line in res.stdout.splitlines() if line.strip())
+    return out
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding]          # unbaselined (actionable) findings
+    baselined: list[Finding]         # suppressed by the baseline file
+    rules_run: list[str]
+    parse_count: int
+    stale_baseline: list[str]        # baseline entries that no longer fire
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+class Engine:
+    """Runs a rule set over one shared :class:`Context`."""
+
+    def __init__(self, rules: list[Rule], root: str = REPO):
+        self.rules = rules
+        self.root = root
+
+    def select(
+        self,
+        names: Optional[list[str]] = None,
+        changed: Optional[set[str]] = None,
+    ) -> list[Rule]:
+        rules = self.rules
+        if names is not None:
+            by_name = {r.name: r for r in rules}
+            unknown = [n for n in names if n not in by_name]
+            if unknown:
+                known = ", ".join(sorted(by_name))
+                raise KeyError(f"unknown rule(s) {unknown}; known: {known}")
+            rules = [by_name[n] for n in names]
+        if changed is not None:
+            rules = [r for r in rules if r.relevant_to(changed)]
+        return rules
+
+    def run(
+        self,
+        names: Optional[list[str]] = None,
+        changed: Optional[set[str]] = None,
+        baseline: Optional[set[str]] = None,
+    ) -> RunResult:
+        ctx = Context(self.root)
+        rules = self.select(names, changed)
+        findings: list[Finding] = []
+        for rule in rules:
+            findings.extend(rule.run(ctx))
+        # syntax errors surface once, attributed to the engine itself
+        for sf in ctx.files():
+            if sf._parsed and sf.parse_error is not None:
+                e = sf.parse_error
+                findings.append(
+                    Finding("parse", sf.rel, e.lineno or 0, f"syntax error: {e.msg}")
+                )
+        findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+        baseline = baseline or set()
+        fresh = [f for f in findings if f.fingerprint() not in baseline]
+        suppressed = [f for f in findings if f.fingerprint() in baseline]
+        fired = {f.fingerprint() for f in findings}
+        stale = sorted(baseline - fired) if names is None and changed is None else []
+        return RunResult(
+            findings=fresh,
+            baselined=suppressed,
+            rules_run=[r.name for r in rules],
+            parse_count=ctx.parse_count,
+            stale_baseline=stale,
+        )

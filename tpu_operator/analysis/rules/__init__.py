@@ -1,0 +1,35 @@
+"""Rule registry: one instance of every rule, in catalogue order.
+
+Adding a rule = adding a module here and listing it in ``ALL_RULES``
+(docs/STATIC_ANALYSIS.md "Adding a rule").
+"""
+
+from tpu_operator.analysis.rules.async_blocking import AsyncBlockingRule
+from tpu_operator.analysis.rules.async_race import AsyncRaceRule
+from tpu_operator.analysis.rules.atomic_writes import AtomicWritesRule
+from tpu_operator.analysis.rules.counter_docs import CounterDocsRule
+from tpu_operator.analysis.rules.delta_paths import DeltaPathsRule
+from tpu_operator.analysis.rules.env_contract import EnvContractRule
+from tpu_operator.analysis.rules.exception_hygiene import ExceptionHygieneRule
+from tpu_operator.analysis.rules.fence_coverage import FenceCoverageRule
+from tpu_operator.analysis.rules.metric_labels import MetricLabelsRule
+from tpu_operator.analysis.rules.task_lifecycle import TaskLifecycleRule
+from tpu_operator.analysis.rules.trace_adoption import TraceAdoptionRule
+
+
+def all_rules():
+    """Fresh instances (rules carry no state between runs, but fixture
+    tests monkeypatch allowlists on instances — never share them)."""
+    return [
+        AsyncBlockingRule(),
+        ExceptionHygieneRule(),
+        MetricLabelsRule(),
+        AtomicWritesRule(),
+        DeltaPathsRule(),
+        CounterDocsRule(),
+        TraceAdoptionRule(),
+        AsyncRaceRule(),
+        FenceCoverageRule(),
+        TaskLifecycleRule(),
+        EnvContractRule(),
+    ]

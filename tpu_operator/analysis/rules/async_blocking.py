@@ -1,0 +1,80 @@
+"""async-blocking: no blocking calls inside ``async def`` bodies.
+
+Ported from ``hack/check_async_blocking.py``.  The reconcile pipeline is a
+single asyncio loop: one blocking call inside an ``async def`` stalls every
+informer, watch stream, and concurrent apply in the process.  Rejects the
+classic offenders — ``time.sleep``, bare ``open``, ``subprocess.*``/
+``os.system``, ``urllib.request.urlopen``/``requests.*``/
+``socket.create_connection`` — while excluding nested SYNC ``def`` bodies
+(the ``def probe(): ...`` handed to ``run_in_executor`` is the sanctioned
+pattern).  Opt-out: ``# blocking-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# blocking-ok"
+
+# (module, attr) calls that block the loop; attr None means any attr
+BLOCKING_ATTR_CALLS = {
+    ("time", "sleep"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("os", "system"),
+    ("socket", "create_connection"),
+    ("requests", None),
+}
+BLOCKING_NAME_CALLS = {"open"}
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    doc = "no blocking I/O or sleeps inside async def under the reconcile plane"
+    paths = ("tpu_operator/k8s/", "tpu_operator/controllers/")
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                for lineno, label in self._blocking_calls(node, sf):
+                    yield Finding(
+                        self.name, sf.rel, lineno,
+                        f"blocking {label}() inside async def {node.name} "
+                        "(stalls the reconcile loop; use the asyncio "
+                        "equivalent or run_in_executor)",
+                    )
+
+    def _blocking_calls(
+        self, async_fn: ast.AsyncFunctionDef, sf: SourceFile
+    ) -> list[tuple[int, str]]:
+        out: list[tuple[int, str]] = []
+
+        def walk(node: ast.AST, in_async: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.FunctionDef):
+                    continue  # sync helper destined for run_in_executor
+                if isinstance(child, ast.AsyncFunctionDef):
+                    continue  # visited separately via the outer walk
+                if isinstance(child, ast.Call) and in_async:
+                    root, rest = astutil.dotted_target(child)
+                    label = None
+                    if root is None and rest in BLOCKING_NAME_CALLS:
+                        label = rest
+                    elif root is not None:
+                        if (root, rest) in BLOCKING_ATTR_CALLS or (root, None) in BLOCKING_ATTR_CALLS:
+                            label = f"{root}.{rest}"
+                        elif root == "urllib" and rest and rest.endswith("urlopen"):
+                            label = f"{root}.{rest}"
+                    if label is not None and not sf.line_has(child.lineno, OPT_OUT):
+                        out.append((child.lineno, label))
+                walk(child, in_async)
+
+        walk(async_fn, True)
+        return out

@@ -1,0 +1,319 @@
+"""async-race: no torn read-modify-write of shared state across awaits.
+
+The operator is a single event loop, so "thread safety" degenerates to one
+rule: shared ``self.``-state must never be read, *awaited past*, and then
+written from its stale value — every ``await`` is a scheduling point where
+any other coroutine may mutate the same attribute (the asyncio analogue of
+a data race; the seeded-interleaving harness in
+``tpu_operator/testing/interleave.py`` is the runtime twin of this rule).
+
+Two bug shapes, checked inside every ``async def`` under the reconcile
+plane packages:
+
+1. **stale read-modify-write** — a local captures ``self.attr``, an
+   ``await`` runs, then ``self.attr`` is assigned from that local::
+
+       pending = self._pending        # read
+       await self._flush(pending)     # schedule point: others may append
+       self._pending = {}             # lost-update write
+
+   (also the one-statement form ``self.x = f(self.x, await g())`` where the
+   read precedes the await).  The fix is to mutate before awaiting, to
+   re-read after the await, or to hold a lock across the whole section —
+   a read→write span entirely inside one ``async with <lock>`` block is
+   not flagged.
+
+2. **lock held across an API verb await** — ``async with <lock>:`` whose
+   body awaits a network verb (``create``/``update``/``patch``/``delete``/
+   ``list``/``get``/``watch``/``_request``): a lock that serializes the
+   plane for the duration of a round-trip turns one slow apiserver call
+   into a fleet-wide stall, and a lock held across an await is exactly how
+   asyncio deadlocks are built.
+
+Opt-out: ``# race-ok`` on the write (shape 1) or the awaited call
+(shape 2) — reviewed single-writer or startup-only sections.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# race-ok"
+
+# awaited verbs that hit the network (ApiClient surface + raw transport)
+API_VERBS = {
+    "create", "update", "update_status", "patch", "delete",
+    "delete_collection", "list", "list_items", "list_paged", "watch",
+    "_request", "request",
+}
+
+# a context-manager expression that names a lock-ish primitive
+_LOCK_TOKENS = ("lock", "mutex", "sem")
+
+
+def _is_lockish(expr_src: str) -> bool:
+    low = expr_src.lower()
+    return any(tok in low for tok in _LOCK_TOKENS)
+
+
+def _is_fresh_reset(value: ast.expr) -> bool:
+    """A write of a brand-new value: empty/fresh containers, literals, or
+    bare constructor calls — the reset half of consume-then-reset."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Tuple, ast.Constant)):
+        return True
+    if isinstance(value, ast.Call):
+        return astutil.call_name(value) in (
+            "dict", "list", "set", "tuple", "deque", "Counter", "defaultdict",
+        )
+    return False
+
+
+class _FnScan:
+    """Linear scan of one async function body in program order.
+
+    Tracks, per program point: locals captured from ``self.attr`` reads,
+    await points, and lock depth — enough to recognize the
+    read→await→write shape without a real dataflow engine."""
+
+    def __init__(self, rule: "AsyncRaceRule", sf: SourceFile, fn: ast.AsyncFunctionDef):
+        self.rule = rule
+        self.sf = sf
+        self.fn = fn
+        self.findings: list[Finding] = []
+        self.point = 0
+        self.lock_depth = 0
+        self.await_points: list[tuple[int, int]] = []  # (point, lock_depth)
+        # local name -> (attr, capture point, lock depth at capture)
+        self.captures: dict[str, tuple[str, int, int]] = {}
+        # local name -> last point its value was read (the consume half of
+        # the consume-then-reset shape)
+        self.capture_uses: dict[str, int] = {}
+
+    def run(self) -> list[Finding]:
+        self._stmts(self.fn.body)
+        return self.findings
+
+    # -- traversal -------------------------------------------------------
+    def _stmts(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        self.point += 1
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are their own schedules
+        if isinstance(stmt, (ast.AsyncWith, ast.With)):
+            lockish = any(
+                _is_lockish(self.sf.segment(item.context_expr))
+                for item in stmt.items
+            )
+            if isinstance(stmt, ast.AsyncWith) and lockish:
+                self._check_lock_body(stmt)
+                self.lock_depth += 1
+                self._record_stmt_effects(stmt, header_only=True)
+                self._stmts(stmt.body)
+                self.lock_depth -= 1
+                return
+            self._record_stmt_effects(stmt, header_only=True)
+            self._stmts(stmt.body)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._record_expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._record_expr(stmt.iter)
+            if isinstance(stmt, ast.AsyncFor):
+                self.await_points.append((self.point, self.lock_depth))
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._stmts(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        self._record_stmt_effects(stmt)
+
+    # -- effects ---------------------------------------------------------
+    def _record_stmt_effects(self, stmt: ast.stmt, header_only: bool = False) -> None:
+        """Captures, awaits, and writes contributed by one simple statement
+        (or the header of a compound one)."""
+        if header_only and isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._record_expr(item.context_expr)
+            if isinstance(stmt, ast.AsyncWith):
+                # __aenter__ is a schedule point of its own
+                self.await_points.append((self.point, self.lock_depth))
+            return
+        if isinstance(stmt, ast.Assign):
+            # RHS awaits happen BEFORE the store (left-to-right evaluation)
+            self._record_expr(stmt.value)
+            self._check_write(stmt)
+            # `v = self.attr` capture (plain name target, plain self read)
+            attr = astutil.self_attr(stmt.value)
+            if attr is not None:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.captures[tgt.id] = (attr, self.point, self.lock_depth)
+                return
+            # any other assignment to a name kills a stale capture
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.captures.pop(tgt.id, None)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._record_expr(stmt.value)
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Await):
+                self.await_points.append((self.point, self.lock_depth))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.captures:
+                    self.capture_uses[node.id] = self.point
+
+    def _record_expr(self, expr: Optional[ast.expr]) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Await):
+                self.await_points.append((self.point, self.lock_depth))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.captures:
+                    self.capture_uses[node.id] = self.point
+
+    def _check_write(self, stmt: ast.Assign) -> None:
+        """Flag ``self.attr = <expr using a stale capture>`` writes."""
+        written = [
+            astutil.self_attr(t) for t in stmt.targets
+            if astutil.self_attr(t) is not None
+        ]
+        if not written:
+            return
+        if self.sf.line_has(stmt.lineno, OPT_OUT):
+            return
+        rhs_names = {
+            n.id for n in ast.walk(stmt.value)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        }
+        rhs_attrs = {
+            astutil.self_attr(n)
+            for n in ast.walk(stmt.value)
+            if astutil.self_attr(n) is not None
+            and isinstance(getattr(n, "ctx", None), ast.Load)
+        }
+        # one-statement form: RHS reads self.attr BEFORE an await in the
+        # same expression (left-to-right evaluation: the read is stale by
+        # the time the store happens)
+        awaits_in_rhs = [n for n in ast.walk(stmt.value) if isinstance(n, ast.Await)]
+        for attr in written:
+            if attr in rhs_attrs and awaits_in_rhs:
+                read = next(
+                    n for n in ast.walk(stmt.value)
+                    if astutil.self_attr(n) == attr
+                    and isinstance(getattr(n, "ctx", None), ast.Load)
+                )
+                first_await = min(
+                    awaits_in_rhs, key=lambda a: (a.lineno, a.col_offset)
+                )
+                if (read.lineno, read.col_offset) < (first_await.lineno, first_await.col_offset):
+                    self.findings.append(self._finding(
+                        stmt.lineno, attr,
+                        f"self.{attr} is read and rewritten in one statement "
+                        "with an await between the read and the store",
+                    ))
+        # multi-statement forms: the write clobbers an attr whose earlier
+        # value was captured into a local and an await ran in between —
+        # either the stale copy feeds the write (read-modify-write), or the
+        # captured value was consumed across the await and the attr is
+        # reset to a fresh literal (consume-then-reset: updates that landed
+        # during the await are lost)
+        for name, cap in list(self.captures.items()):
+            attr, cap_point, cap_lock = cap
+            if attr not in written:
+                continue
+            intervening = [
+                p for p, _depth in self.await_points if cap_point < p <= self.point
+            ]
+            if not intervening:
+                continue
+            # the whole read→write span under one held lock is the
+            # sanctioned pattern — skip only when the lock was already held
+            # at capture AND is still held at the write
+            if cap_lock > 0 and self.lock_depth > 0:
+                continue
+            if name in rhs_names:
+                self.findings.append(self._finding(
+                    stmt.lineno, attr,
+                    f"self.{attr} captured into {name!r}, awaited past, "
+                    "then written back from the stale copy — another "
+                    "coroutine's update in the await window is lost",
+                ))
+            elif (
+                self.capture_uses.get(name, -1) > cap_point
+                and _is_fresh_reset(stmt.value)
+            ):
+                self.findings.append(self._finding(
+                    stmt.lineno, attr,
+                    f"self.{attr} captured into {name!r} and consumed "
+                    "across an await, then reset — updates other "
+                    "coroutines made during the await are lost (swap-"
+                    "before-await: `work, self.attr = self.attr, fresh()`)",
+                ))
+
+    def _check_lock_body(self, stmt: ast.AsyncWith) -> None:
+        """Flag awaited API verbs inside an ``async with <lock>`` body."""
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Await):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            verb = astutil.call_name(call)
+            if verb not in API_VERBS:
+                continue
+            # dict.get / queue.get style false positives: require a dotted
+            # receiver (x.verb) — bare get()/list() never hit the client
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            if self.sf.line_has(node.lineno, OPT_OUT):
+                continue
+            self.findings.append(Finding(
+                self.rule.name, self.sf.rel, node.lineno,
+                f"{self.fn.name}(): awaits API verb .{verb}() while holding "
+                f"a lock ({self.sf.segment(stmt.items[0].context_expr)}) — "
+                "a slow round-trip stalls every coroutine queued on it; "
+                "copy state under the lock, release, then call",
+            ))
+
+    def _finding(self, lineno: int, attr: str, detail: str) -> Finding:
+        return Finding(
+            self.rule.name, self.sf.rel, lineno,
+            f"{self.fn.name}(): stale read-modify-write of self.{attr} "
+            f"across an await — {detail} (re-read after the await, mutate "
+            "before it, or hold a lock across the section; reviewed "
+            f"single-writer state may opt out with {OPT_OUT})",
+        )
+
+
+class AsyncRaceRule(Rule):
+    name = "async-race"
+    doc = "no stale read→await→write of self-state; no lock held across API awaits"
+    paths = (
+        "tpu_operator/controllers/",
+        "tpu_operator/k8s/",
+        "tpu_operator/obs/",
+        "tpu_operator/agents/",
+    )
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for fn in astutil.functions(sf.tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                yield from _FnScan(self, sf, fn).run()

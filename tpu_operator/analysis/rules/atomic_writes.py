@@ -1,0 +1,81 @@
+"""atomic-writes: no torn publishes on result paths.
+
+Ported from ``hack/check_atomic_writes.py``.  On the surfaces whose files
+are *read back as evidence* (checkpoint snapshots, results drop-boxes,
+compile-cache artifact envelopes, validator markers, flight records), any
+write-mode ``open`` must be part of a tmp+``os.replace`` publish: a crash
+mid-write must leave either the previous complete file or nothing, never a
+truncated file a reader would trust (docs/ROBUSTNESS.md "Live migration").
+Accepted when the enclosing function also calls ``os.replace``/``os.rename``
+or the path expression mentions ``tmp``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+WRITE_MODES = {"w", "wb", "w+", "wb+", "wt"}
+
+
+def _mode_of(call: ast.Call):
+    args = list(call.args)
+    if len(args) >= 2 and isinstance(args[1], ast.Constant) and isinstance(args[1].value, str):
+        return args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _is_open(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Name) and call.func.id == "open"
+
+
+def _calls_replace(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("replace", "rename") and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "os":
+                return True
+    return False
+
+
+class AtomicWritesRule(Rule):
+    name = "atomic-writes"
+    doc = "every evidence-surface publish goes through tmp+os.replace"
+    paths = (
+        "tpu_operator/workloads/",
+        "tpu_operator/validator/",
+        "tpu_operator/obs/",
+        # the fleet compile cache's server side (Manager /compile-cache/*
+        # ingest) lives here; its artifact publication must stay tmp+replace
+        "tpu_operator/controllers/",
+    )
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        functions = [
+            n for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in functions:
+            has_replace = _calls_replace(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and _is_open(node)):
+                    continue
+                mode = _mode_of(node)
+                if mode is None or mode not in WRITE_MODES:
+                    continue
+                if has_replace:
+                    continue
+                path_src = sf.segment(node.args[0]) if node.args else ""
+                if "tmp" in path_src.lower():
+                    continue
+                yield Finding(
+                    self.name, sf.rel, node.lineno,
+                    f"bare open({path_src or '...'}, {mode!r}) — publish "
+                    "through tmp+os.replace so a crash can never leave a "
+                    "torn file",
+                )

@@ -1,0 +1,93 @@
+"""counter-docs: telemetry catalogues never drift from the docs.
+
+Ported from ``hack/check_counter_docs.py`` (now AST-extracted instead of
+importing the module, so the shared one-parse tree serves it too):
+
+- the node-agent counter catalogue — the ``COUNTERS`` + ``WORKLOAD_COUNTERS``
+  tuples in ``agents/metrics_agent.py`` vs docs/OBSERVABILITY.md; every
+  counter in code must have a docs row and every catalogued
+  ``tpu_duty…``/``tpu_workload…``-style counter must exist in code;
+- the operator metric families — every ``tpu_operator_*`` name registered
+  in ``metrics.py`` must be documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule
+
+AGENT_FILE = "tpu_operator/agents/metrics_agent.py"
+METRICS_FILE = "tpu_operator/metrics.py"
+
+# metric families documented elsewhere in the docs (operator histograms,
+# validator gauges) are not part of the agent counter catalogue
+_NON_AGENT_PREFIXES = ("tpu_operator_", "tpu_validator_")
+_COUNTER_VOCAB = re.compile(r"tpu_(workload|hbm|ici|duty|tensorcore|chip)_")
+
+
+class CounterDocsRule(Rule):
+    name = "counter-docs"
+    doc = "agent counters and operator metric families stay documented"
+    paths = (AGENT_FILE, METRICS_FILE)
+    extra_paths = ("docs/OBSERVABILITY.md",)
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        agent = ctx.file(AGENT_FILE)
+        metrics = ctx.file(METRICS_FILE)
+        if agent is None or agent.tree is None or metrics is None or metrics.tree is None:
+            return
+        in_code = self._catalogue_tuples(agent.tree)
+        # the catalogue lives in OBSERVABILITY.md specifically — other docs
+        # legitimately mention counter-name prefixes in prose
+        text = dict(ctx.text_files_under("docs", (".md",))).get(
+            "docs/OBSERVABILITY.md", ""
+        )
+        documented = {
+            name
+            for name in re.findall(r"\btpu_[a-z0-9_]+\b", text)
+            if not name.startswith(_NON_AGENT_PREFIXES)
+            # the catalogue documents counters, not module paths — the
+            # prefix filter plus the counter vocabulary keeps prose out
+            and (name in in_code or _COUNTER_VOCAB.match(name))
+        }
+        for name in sorted(in_code - documented):
+            yield Finding(
+                self.name, AGENT_FILE, 1,
+                f"counter {name} missing from docs/OBSERVABILITY.md",
+            )
+        for name in sorted(documented - in_code):
+            yield Finding(
+                self.name, "docs/OBSERVABILITY.md", 1,
+                f"documented counter {name} absent from metrics_agent tuples",
+            )
+        # operator registry: every family name literal in metrics.py must
+        # be documented (docs-side names not in code are caught in review —
+        # prose legitimately mentions derived sample names)
+        operator_in_code = {
+            c.value
+            for c in ast.walk(metrics.tree)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            and re.fullmatch(r"tpu_operator_[a-z0-9_]+", c.value)
+        }
+        operator_documented = set(re.findall(r"\btpu_operator_[a-z0-9_]+\b", text))
+        for name in sorted(operator_in_code - operator_documented):
+            yield Finding(
+                self.name, METRICS_FILE, 1,
+                f"operator metric {name} missing from docs/OBSERVABILITY.md",
+            )
+
+    @staticmethod
+    def _catalogue_tuples(tree: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not set(targets) & {"COUNTERS", "WORKLOAD_COUNTERS"}:
+                continue
+            out.update(astutil.literal_strings(node.value))
+        return out

@@ -1,0 +1,146 @@
+"""delta-paths: per-key reconcile code stays O(1)-per-event.
+
+Ported from ``hack/check_delta_paths.py``.  Under ``controllers/``, bans
+the two patterns the fleet-scale reconcile plane replaced
+(docs/PERFORMANCE.md "Delta reconcile & sharding"):
+
+1. hand-rolled ``while True: asyncio.sleep`` poll loops — periodic work
+   belongs on the workqueue's scheduled-requeue API;
+2. full-fleet Node lists in per-key paths — a per-node reconcile must do
+   node-scoped reads; walking the fleet belongs only to the explicit
+   full-resync safety nets.
+
+Both carry an allowlist of (file, qualified function) entry points that
+are *supposed* to be full-resync or process-lifecycle loops.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+# (filename, function name) pairs allowed to `while True: ... sleep(...)`:
+# process-lifecycle supervisors, not per-key reconcile paths.
+SLEEP_LOOP_ALLOWLIST = {
+    ("runtime.py", "_supervise"),  # manager degraded-mode/leadership supervisor
+}
+
+# (filename, function name) pairs allowed to list the full Node fleet:
+# the explicit full-resync safety nets and fleet-scoped (not per-node)
+# controllers whose pass IS the fleet sweep.
+NODE_LIST_ALLOWLIST = {
+    ("clusterpolicy.py", "_reconcile"),       # full-walk resync safety net
+    ("clusterinfo.py", "gather"),             # context gatherer (callers pass nodes=)
+    ("labels.py", "label_tpu_nodes"),         # the full-walk's label engine
+    ("nodes.py", "prime"),                    # one-shot index seed at plane start
+    ("tpuruntime.py", "_reconcile"),          # per-CR pool derivation (informer-cached reads)
+    ("tpuruntime.py", "_selector_conflicts"), # cross-CR conflict validation (cached)
+    ("upgrade.py", "_reconcile"),             # fleet-keyed upgrade state machine
+    ("remediation.py", "_reconcile"),         # fleet-keyed remediation sweep
+    ("health.py", "_reconcile"),              # fleet-keyed health engine pass
+    ("revalidation.py", "_reconcile"),        # fleet-keyed wave scheduling sweep
+}
+
+
+def _is_asyncio_sleep(call: ast.Call) -> bool:
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "sleep"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "asyncio"
+    )
+
+
+def _is_node_fleet_list(call: ast.Call) -> bool:
+    """``<anything>.list("", "Node", ...)`` / ``.list_items("", "Node", ...)``
+    without a label/field selector narrowing it."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in ("list", "list_items")):
+        return False
+    args = call.args
+    if len(args) < 2:
+        return False
+    first, second = args[0], args[1]
+    if not (
+        isinstance(first, ast.Constant) and first.value == ""
+        and isinstance(second, ast.Constant) and second.value == "Node"
+    ):
+        return False
+    # a selector-narrowed list is node-pool-scoped, not full-fleet
+    for kw in call.keywords:
+        if kw.arg in ("label_selector", "field_selector") and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return False
+    if len(args) >= 4 and not (
+        isinstance(args[3], ast.Constant) and args[3].value is None
+    ):
+        return False
+    return True
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule: "DeltaPathsRule", sf: SourceFile):
+        self.rule = rule
+        self.sf = sf
+        self.fname = os.path.basename(sf.rel)
+        self.findings: list[Finding] = []
+        self._func_stack: list[str] = []
+
+    def _current(self) -> str:
+        return self._func_stack[-1] if self._func_stack else "<module>"
+
+    def _visit_func(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_While(self, node: ast.While) -> None:
+        is_forever = isinstance(node.test, ast.Constant) and node.test.value is True
+        if is_forever:
+            sleeps = [
+                n for n in ast.walk(node)
+                if isinstance(n, ast.Call) and _is_asyncio_sleep(n)
+            ]
+            if sleeps and (self.fname, self._current()) not in self.rule.sleep_loop_allowlist:
+                self.findings.append(Finding(
+                    self.rule.name, self.sf.rel, node.lineno,
+                    f"{self._current()}(): hand-rolled `while True: "
+                    "asyncio.sleep` poll loop — use the workqueue's "
+                    "scheduled-requeue API",
+                ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_node_fleet_list(node) and (
+            (self.fname, self._current()) not in self.rule.node_list_allowlist
+        ):
+            self.findings.append(Finding(
+                self.rule.name, self.sf.rel, node.lineno,
+                f"{self._current()}(): full-fleet Node list in a per-key "
+                "reconcile path — use node-scoped cached reads (or "
+                "allowlist a genuine full-resync entry point)",
+            ))
+        self.generic_visit(node)
+
+
+class DeltaPathsRule(Rule):
+    name = "delta-paths"
+    doc = "no poll loops or full-fleet Node lists in per-key reconcile paths"
+    paths = ("tpu_operator/controllers/",)
+
+    def __init__(self):
+        self.sleep_loop_allowlist = set(SLEEP_LOOP_ALLOWLIST)
+        self.node_list_allowlist = set(NODE_LIST_ALLOWLIST)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        v = _Visitor(self, sf)
+        v.visit(sf.tree)
+        return v.findings

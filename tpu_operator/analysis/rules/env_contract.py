@@ -1,0 +1,245 @@
+"""env-contract: every TPU_* pod env is produced, consumed, and documented.
+
+Generalizes the env half of the old trace-lint.  The ``TPU_*`` environment
+variables the render layer stamps into operand pods are a cross-process
+API: the operator writes them, a pod-side process reads them, and the docs
+are the contract a user integrates against.  Three drift shapes, each a
+finding:
+
+1. **stamped but never read** — a producer (``state/render_data.py``
+   literal, or a ``name: TPU_X`` env entry in ``assets/``/``deploy/``)
+   with no consumer anywhere in ``tpu_operator/``: dead contract surface,
+   usually a renamed consumer the producer missed.
+2. **stamped but undocumented** — a producer with no row in ``docs/*.md``:
+   an integration trap nobody can read about.
+3. **read but never stamped *and* undocumented** — a consumer
+   (``os.environ.get("TPU_X")`` / ``os.getenv`` / ``environ[...]``) whose
+   name no producer stamps and no docs row declares: either a stale
+   reader or a contract the render layer silently dropped.  A documented
+   read is a declared config knob — the docs row is its producer
+   contract.
+
+Producer detection covers the render layer (``state/render_data.py``),
+``assets/``/``deploy/`` manifests, the device plugin's
+``cresp.envs["TPU_X"] = ...`` Allocate stores, and rendered pod-spec
+dict literals; env names flowing through module constants
+(``TRACEPARENT_ENV = "TPU_TRACEPARENT"``) are resolved globally.  The
+two ends of the contract that legitimately live outside this repo are
+recorded — with a justification each — in ``EXTERNAL_PRODUCERS`` (read
+here, stamped by the substrate/job author) and ``EXTERNAL_CONSUMERS``
+(stamped here, read by libtpu or job code).
+
+Documented-but-nonexistent names are deliberately NOT flagged: prose
+legitimately mentions derived or historical names; review owns docs-side
+hygiene.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule
+
+RENDER_DATA = "tpu_operator/state/render_data.py"
+
+_ENV_NAME_RE = re.compile(r"^TPU_[A-Z0-9_]+$")
+# assets/deploy: `- name: TPU_X` env entries and `{"name": "TPU_X"}` extras
+_ASSET_ENV_RE = re.compile(r"name:\s*(TPU_[A-Z0-9_]+)\b")
+_ASSET_DICT_RE = re.compile(r"[\"']name[\"']\s*:\s*[\"'](TPU_[A-Z0-9_]+)[\"']")
+
+# env names read in-code that nothing in this repo stamps, each with the
+# reason the read is legitimate.  Keep this justified and short.
+EXTERNAL_PRODUCERS: dict[str, str] = {
+    "TPU_HW_ROOT": "node substrate/test seam: roots all sysfs/dev probes (hw.py)",
+    "TPU_CHIP_COUNT": "container-node substrate stamps the chip truth (sliceconfig)",
+    "TPU_VALIDATOR_PLATFORM": "validator CLI/test seam for off-TPU runs",
+    "TPU_CKPT_EVERY": "job-author knob on the reference train job (checkpoint.py contract)",
+    "TPU_JOB_RESULT_FILE": "job-author/bench drop-box path on the reference train job",
+    "TPU_CKPT_FAULT": "chaos fault seam stamped by the bench.py migration soak",
+    "TPU_VALIDATION_ROOT": "test seam: conftest relocates /run/tpu/validations",
+}
+
+# env names stamped here whose reader is the TPU runtime itself (libtpu /
+# PJRT), not code in this repo.
+EXTERNAL_CONSUMERS: dict[str, str] = {
+    "TPU_VISIBLE_CHIPS": "read by libtpu: per-container chip visibility",
+    "TPU_CHIPS_PER_HOST_BOUNDS": "read by libtpu: host topology bounds",
+    "TPU_MIGRATION_TIMEOUT_SECONDS":
+        "read by job authors: the checkpoint budget the drain will honor "
+        "(docs/ROBUSTNESS.md 'Live migration')",
+}
+
+
+def _receiver_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _env_name_of(arg: ast.AST, aliases: dict[str, str]):
+    """TPU_* env named by an expression: a literal, or a constant whose
+    module-level binding (``TRACEPARENT_ENV = "TPU_TRACEPARENT"``) is in
+    the alias map."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and _ENV_NAME_RE.match(arg.value):
+        return arg.value
+    name = _receiver_name(arg)
+    return aliases.get(name)
+
+
+def _env_aliases(tree: ast.AST) -> dict[str, str]:
+    """Module-level ``NAME = "TPU_X"`` constant bindings."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+            and _ENV_NAME_RE.match(node.value.value)
+        ):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = node.value.value
+    return out
+
+
+def _env_reads(tree: ast.AST, aliases: dict[str, str]) -> Iterable[tuple[str, int]]:
+    """(name, lineno) for environ-ish reads of TPU_* envs (literal or via
+    a shared constant)."""
+    for node in ast.walk(tree):
+        # os.environ.get("X") / os.getenv("X") / env.get("X")-style calls
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("get", "getenv", "setdefault", "pop") and node.args:
+                if _receiver_name(node.func.value) in ("environ", "os", "env"):
+                    env = _env_name_of(node.args[0], aliases)
+                    if env is not None:
+                        yield env, node.lineno
+        # os.environ["X"] subscripts (Load side)
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            if _receiver_name(node.value) == "environ":
+                env = _env_name_of(node.slice, aliases)
+                if env is not None:
+                    yield env, node.lineno
+
+
+def _py_producers(tree: ast.AST, aliases: dict[str, str]) -> Iterable[tuple[str, int]]:
+    """(name, lineno) for python-side env stamping: the device plugin's
+    ``cresp.envs["TPU_X"] = ...`` AllocateResponse stores, rendered pod
+    specs' ``{"name": "TPU_X", ...}`` env entries, and env-map dict
+    literals keyed by a TPU_* name."""
+    for node in ast.walk(tree):
+        # <x>.envs["TPU_X"] = ... / os.environ["TPU_X"] = ...
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Store):
+            if _receiver_name(node.value) in ("envs", "environ"):
+                env = _env_name_of(node.slice, aliases)
+                if env is not None:
+                    yield env, node.lineno
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is None:
+                    continue
+                key = k.value if isinstance(k, ast.Constant) else None
+                # k8s env-entry idiom: {"name": "TPU_X", "value"/"valueFrom": ...}
+                if key == "name":
+                    env = _env_name_of(v, aliases)
+                    if env is not None:
+                        yield env, k.lineno
+                # env-map idiom: {"TPU_X": <value>}
+                elif isinstance(key, str) and _ENV_NAME_RE.match(key):
+                    yield key, k.lineno
+
+
+class EnvContractRule(Rule):
+    name = "env-contract"
+    doc = "TPU_* pod envs have a producer, a consumer, and a docs row"
+    paths = ("tpu_operator/",)
+    extra_paths = ("assets/", "deploy/", "docs/")
+
+    def __init__(self):
+        self.external_producers = dict(EXTERNAL_PRODUCERS)
+        self.external_consumers = dict(EXTERNAL_CONSUMERS)
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        # alias constants are shared across modules (consts.py, trace.py);
+        # resolve them globally before classifying reads/stamps.  The
+        # analysis package itself is excluded: its allowlists and fixtures
+        # name envs without being part of the contract surface.
+        aliases: dict[str, str] = {}
+        trees = [
+            sf for sf in ctx.files_under(*self.paths)
+            if sf.tree is not None
+            and not sf.rel.startswith("tpu_operator/analysis/")
+        ]
+        for sf in trees:
+            aliases.update(_env_aliases(sf.tree))
+
+        consumers: dict[str, tuple[str, int]] = {}
+        producers: dict[str, str] = {}
+        for sf in trees:
+            for env, lineno in _env_reads(sf.tree, aliases):
+                consumers.setdefault(env, (sf.rel, lineno))
+            for env, lineno in _py_producers(sf.tree, aliases):
+                producers.setdefault(env, f"{sf.rel}:{lineno}")
+        rd = ctx.file(RENDER_DATA)
+        if rd is not None and rd.tree is not None:
+            for node in ast.walk(rd.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _ENV_NAME_RE.match(node.value)
+                ):
+                    producers.setdefault(node.value, f"{RENDER_DATA}:{node.lineno}")
+        for prefix in ("assets", "deploy"):
+            for rel, text in ctx.text_files_under(prefix, (".yaml", ".yml", ".j2")):
+                for regex in (_ASSET_ENV_RE, _ASSET_DICT_RE):
+                    for env in regex.findall(text):
+                        producers.setdefault(env, rel)
+
+        docs_text = ctx.docs_text()
+        for env, where in sorted(producers.items()):
+            if env not in consumers and env not in self.external_consumers:
+                yield Finding(
+                    self.name, where.split(":")[0], self._line_of(where),
+                    f"pod env contract {env} is stamped but nothing under "
+                    "tpu_operator/ reads it — dead contract surface "
+                    "(renamed consumer?); drop the stamp, fix the reader, "
+                    "or record the out-of-repo reader in "
+                    "env_contract.EXTERNAL_CONSUMERS",
+                )
+            if env not in docs_text:
+                yield Finding(
+                    self.name, where.split(":")[0], self._line_of(where),
+                    f"pod env contract {env} is undocumented — add it to "
+                    "docs/ (OBSERVABILITY.md env-contract section or the "
+                    "relevant operand doc)",
+                )
+        for env, (rel, lineno) in sorted(consumers.items()):
+            if env in producers or env in self.external_producers:
+                continue
+            # a documented read is a declared user/operator-facing knob —
+            # the docs row IS the producer contract; only an undocumented
+            # orphan read is a trap
+            if env in docs_text:
+                continue
+            yield Finding(
+                self.name, rel, lineno,
+                f"env {env} is read but nothing stamps it and no docs row "
+                "declares it — stale reader or silently dropped contract; "
+                "stamp it, document it as a config knob, or record the "
+                "out-of-repo stamper in env_contract.EXTERNAL_PRODUCERS",
+            )
+
+    @staticmethod
+    def _line_of(where: str) -> int:
+        if ":" in where:
+            try:
+                return int(where.rsplit(":", 1)[1])
+            except ValueError:
+                return 1
+        return 1

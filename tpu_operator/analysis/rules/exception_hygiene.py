@@ -1,0 +1,68 @@
+"""exception-hygiene: no silently swallowed broad Exceptions.
+
+Ported from ``hack/check_exception_hygiene.py``.  Rejects handlers that
+catch ``Exception``/``BaseException`` (or bare ``except:``) whose body is
+only ``pass``/``...`` — the pattern that turned the informer's 410-relist
+vs transient-backoff vs fatal distinction into mush (the PR 4 informer
+bug).  Swallowing a NARROW exception stays legal; broad handlers must at
+least log.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _names(expr) -> set[str]:
+    if expr is None:
+        return set(BROAD)  # bare except:
+    if isinstance(expr, ast.Tuple):
+        out: set[str] = set()
+        for el in expr.elts:
+            out |= _names(el)
+        return out
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.Attribute):
+        return {expr.attr}
+    return set()
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+class ExceptionHygieneRule(Rule):
+    name = "exception-hygiene"
+    doc = "no `except Exception: pass` hiding the failure taxonomy"
+    paths = (
+        "tpu_operator/k8s/",
+        "tpu_operator/controllers/",
+        "tpu_operator/obs/",
+        "tpu_operator/agents/",
+        # the workloads own the checkpoint/migration evidence chain — a
+        # silently swallowed error there hides a torn-snapshot taxonomy
+        "tpu_operator/workloads/",
+    )
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _names(node.type) & BROAD and _is_silent(node.body):
+                yield Finding(
+                    self.name, sf.rel, node.lineno,
+                    "broad `except Exception: pass` swallows the failure "
+                    "taxonomy — narrow the clause or log what was caught",
+                )

@@ -1,0 +1,191 @@
+"""fence-coverage: every mutating API call site runs under a write fence.
+
+PR 9's exactly-once claim — a shard handoff can never double-actuate —
+rests on every mutating verb under ``controllers/`` being issued inside a
+fence context: either the ambient per-shard ``request_fence`` (plane
+reconciles) or the manager's leader ``WriteFence`` installed on the client
+for every Controller-framework reconcile.  This rule makes that a checked
+property instead of a comment.
+
+Mechanically: build the name-based call graph of the ``controllers/``
+package, seed the *fenced set* with
+
+- every function whose body establishes ``request_fence(...)``, and
+- every function registered as a reconcile entry point —
+  the callable passed as the second argument to ``Controller(...)``
+  (those workers only run under the Manager, whose leader fence is
+  installed on the client before the first write can happen),
+
+then flood-fill callees (``self.X(...)``, bare ``X(...)``, and
+``<obj>.X(...)`` resolve to any package function named ``X`` — an
+over-approximation that errs toward reachability).  Any function
+containing an awaited mutating verb (``create``/``update``/
+``update_status``/``patch``/``delete``/``delete_collection``) that the
+flood never reached is flagged: it is a write path with no fence between
+it and a deposed leader or a moved shard.
+
+Opt-outs: ``# fence-ok`` on the call line, or a structured
+``ENTRYPOINT_ALLOWLIST`` entry for call paths that are fenced by
+construction elsewhere (documented per entry).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Iterable
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# fence-ok"
+
+MUTATING_VERBS = {
+    "create", "update", "update_status", "patch", "delete",
+    "delete_collection",
+}
+
+# (filename, function) additional fenced roots: entry points whose every
+# caller is fenced by construction but whose registration the AST cannot
+# see.  Add an entry ONLY with a justification comment; never to sneak an
+# unfenced write path in.
+ENTRYPOINT_ALLOWLIST: set[tuple[str, str]] = set()
+
+
+def _basename(rel: str) -> str:
+    return rel.rsplit("/", 1)[-1]
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """Per-file harvest: function defs, call edges, fence roots, and
+    mutating call sites, all keyed by (filename, function name)."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.fname = _basename(sf.rel)
+        self.defs: set[tuple[str, str]] = set()
+        self.edges: dict[tuple[str, str], set[str]] = defaultdict(set)
+        self.fence_roots: set[tuple[str, str]] = set()
+        self.reconcile_refs: set[str] = set()  # names registered with Controller(...)
+        # (key, lineno, verb, enclosing fn name)
+        self.mutations: list[tuple[tuple[str, str], int, str]] = []
+        self._stack: list[str] = []
+
+    def scan(self) -> None:
+        self.visit(self.sf.tree)
+
+    def _key(self) -> tuple[str, str]:
+        return (self.fname, self._stack[-1] if self._stack else "<module>")
+
+    def _visit_fn(self, node) -> None:
+        self._stack.append(node.name)
+        self.defs.add(self._key())
+        # a nested def is callee of its enclosing function (closures like
+        # the plane's per-shard `run` are invoked by the framework, but
+        # fence flow follows the lexical parent)
+        if len(self._stack) > 1:
+            self.edges[(self.fname, self._stack[-2])].add(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = astutil.call_name(node)
+        key = self._key()
+        if name == "request_fence":
+            self.fence_roots.add(key)
+        elif name == "Controller" and len(node.args) >= 2:
+            # Controller("name", self.reconcile, ...): the reconcile fn
+            # only ever runs under the Manager's leader fence
+            ref = node.args[1]
+            if isinstance(ref, ast.Attribute):
+                self.reconcile_refs.add(ref.attr)
+            elif isinstance(ref, ast.Name):
+                self.reconcile_refs.add(ref.id)
+            elif isinstance(ref, ast.Call):
+                # factory form: Controller(sid, self._shard_reconcile(sid))
+                self.reconcile_refs.add(astutil.call_name(ref))
+        elif name:
+            self.edges[key].add(name)
+        # a bare `self.X` loaded (not called) registers a reference edge:
+        # callback registration (resync hooks, on_transition) keeps the
+        # target reachable from wherever the registration site is
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.ctx, ast.Load) and astutil.self_attr(node) is not None:
+            self.edges[self._key()].add(node.attr)
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await) -> None:
+        call = node.value
+        if isinstance(call, ast.Call) and isinstance(call.func, ast.Attribute):
+            verb = call.func.attr
+            if verb in MUTATING_VERBS and not self.sf.line_has(node.lineno, OPT_OUT):
+                self.mutations.append((self._key(), node.lineno, verb))
+        self.generic_visit(node)
+
+
+class FenceCoverageRule(Rule):
+    name = "fence-coverage"
+    doc = "every mutating verb in controllers/ is reachable only under a write fence"
+    paths = ("tpu_operator/controllers/",)
+
+    def __init__(self):
+        self.entrypoint_allowlist = set(ENTRYPOINT_ALLOWLIST)
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        scans: list[_ModuleScan] = []
+        for sf in ctx.files_under(*self.paths):
+            if sf.tree is None:
+                continue
+            scan = _ModuleScan(sf)
+            scan.scan()
+            scans.append(scan)
+
+        # name -> keys defining it (cross-module, name-based resolution)
+        by_name: dict[str, set[tuple[str, str]]] = defaultdict(set)
+        for scan in scans:
+            for key in scan.defs:
+                by_name[key[1]].add(key)
+
+        fenced: set[tuple[str, str]] = set()
+        for scan in scans:
+            fenced |= scan.fence_roots
+            for ref in scan.reconcile_refs:
+                fenced |= by_name.get(ref, set())
+        for fname, func in self.entrypoint_allowlist:
+            fenced |= by_name.get(func, set()) & {(fname, func)}
+
+        edges: dict[tuple[str, str], set[str]] = defaultdict(set)
+        for scan in scans:
+            for key, callees in scan.edges.items():
+                edges[key] |= callees
+
+        # flood fill: callees of fenced functions are fenced
+        work = list(fenced)
+        while work:
+            key = work.pop()
+            for callee_name in edges.get(key, ()):
+                for target in by_name.get(callee_name, ()):
+                    if target not in fenced:
+                        fenced.add(target)
+                        work.append(target)
+
+        rel_by_fname = {_basename(s.sf.rel): s.sf.rel for s in scans}
+        for scan in scans:
+            for key, lineno, verb in scan.mutations:
+                if key in fenced:
+                    continue
+                fname, func = key
+                yield Finding(
+                    self.name, rel_by_fname.get(fname, scan.sf.rel), lineno,
+                    f"{func}(): awaited mutating .{verb}() is not reachable "
+                    "from any fenced entry point (request_fence context or "
+                    "Controller-registered reconcile) — a deposed leader or "
+                    "moved shard could double-actuate this write; route it "
+                    "through a fenced reconcile, or mark a reviewed "
+                    f"exception with {OPT_OUT}",
+                )

@@ -1,0 +1,85 @@
+"""metric-labels: no unbounded label cardinality on Prometheus series.
+
+Ported from ``hack/check_metric_labels.py``.  A label whose values are
+unbounded (pod names/uids, node names at 10k-node scale, timestamps,
+span/reconcile ids) turns a counter into a memory leak on both the
+operator and every scraper; per-entity series belong in the fleet
+aggregator's rings (obs/fleet.py).  Node-LOCAL registries (validator,
+agents) may carry a ``node`` label: one process per node, one value.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+
+NODE_LOCAL_DIRS = (
+    os.path.join("tpu_operator", "validator"),
+    os.path.join("tpu_operator", "agents"),
+)
+NODE_LOCAL_ALLOWED = {"node", "node_name"}
+
+# label names whose value space is unbounded on a large fleet
+DENYLIST = {
+    "pod", "pod_name", "pod_uid", "uid", "name", "node", "node_name",
+    "namespace", "timestamp", "ts", "time", "date", "id", "run_id",
+    "span_id", "trace_id", "reconcile_id", "key", "url", "path", "le",
+}
+
+
+def _candidate_labels(call: ast.Call):
+    """Label-name literals of one registration: list/tuple literals in any
+    positional slot past (name, documentation), the ``labelnames`` keyword,
+    and bare identifier-ish strings in those slots (the
+    ``h(name, doc, "controller")`` wrapper pattern)."""
+    for arg in call.args[2:]:
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            yield from astutil.literal_strings(arg)
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value.isidentifier():
+                yield arg.value
+    for kw in call.keywords:
+        if kw.arg == "labelnames" and kw.value is not None:
+            yield from astutil.literal_strings(kw.value)
+
+
+class MetricLabelsRule(Rule):
+    name = "metric-labels"
+    doc = "no unbounded label values on prometheus_client registrations"
+    paths = ("tpu_operator/",)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        allowed = (
+            NODE_LOCAL_ALLOWED
+            if any(sf.rel.startswith(d + os.sep) for d in NODE_LOCAL_DIRS)
+            else set()
+        )
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node)
+            first = node.args[0] if node.args else None
+            metric_name = (
+                first.value
+                if isinstance(first, ast.Constant) and isinstance(first.value, str)
+                else ""
+            )
+            is_registration = name in _METRIC_CTORS or (
+                metric_name.startswith("tpu_") and len(node.args) >= 2
+            )
+            if not is_registration:
+                continue
+            for label in _candidate_labels(node):
+                if label in DENYLIST and label not in allowed:
+                    yield Finding(
+                        self.name, sf.rel, node.lineno,
+                        f"metric {metric_name or '<dynamic>'} uses unbounded "
+                        f"label {label!r} (per-entity series belong in the "
+                        "fleet aggregator's rings, not the Prometheus registry)",
+                    )

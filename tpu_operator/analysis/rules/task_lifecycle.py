@@ -1,0 +1,208 @@
+"""task-lifecycle: no fire-and-forget asyncio tasks.
+
+A task whose last reference dies is garbage-collected mid-flight and any
+exception it raises is silently swallowed (CPython only keeps a weak ref
+in the loop's task set) — the exact failure mode that loses a watch
+stream or a drain without a trace.  Every ``asyncio.create_task`` /
+``ensure_future`` / ``loop.create_task`` result must therefore be
+
+1. **retained** — assigned to a name/attribute, appended into a
+   collection, awaited inline, or passed into a retaining call
+   (``gather``/``wait``/…); a bare expression statement discards it and is
+   always flagged;
+2. **disposed** — a task held in a plain local must be awaited, cancelled,
+   gathered, returned, or stored before the function ends; a task stored
+   on ``self.<attr>`` must be awaited or ``.cancel()``-ed somewhere in the
+   same class (the stop/close path).
+
+Opt-out: ``# task-ok`` on the creation line — for tasks whose lifetime is
+genuinely the process (cite the supervisor that owns the crash in the
+comment).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis import astutil
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# task-ok"
+
+_CREATORS = {"create_task", "ensure_future"}
+
+
+def _is_task_create(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and astutil.call_name(node) in _CREATORS
+    )
+
+
+def _walk_own(fn) -> Iterable[ast.AST]:
+    """Walk a function's own body, not nested defs (those are visited as
+    functions in their own right)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class TaskLifecycleRule(Rule):
+    name = "task-lifecycle"
+    doc = "create_task results are retained and awaited or cancelled"
+    paths = ("tpu_operator/",)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(sf, cls)
+        for fn in astutil.functions(sf.tree):
+            yield from self._check_function(sf, fn)
+
+    # -- shape 1: discarded result --------------------------------------
+    def _check_function(self, sf: SourceFile, fn) -> Iterable[Finding]:
+        for stmt in _walk_own(fn):
+            if (
+                isinstance(stmt, ast.Expr)
+                and _is_task_create(stmt.value)
+                and not sf.line_has(stmt.value.lineno, OPT_OUT)
+            ):
+                yield Finding(
+                    self.name, sf.rel, stmt.value.lineno,
+                    f"{fn.name}(): {astutil.call_name(stmt.value)}() result "
+                    "discarded — the task can be garbage-collected mid-"
+                    "flight and its exception is silently swallowed; retain "
+                    "it (and await or cancel it), or mark a process-"
+                    f"lifetime task {OPT_OUT}",
+                )
+        yield from self._check_locals(sf, fn)
+
+    # -- shape 2: retained local never disposed --------------------------
+    def _check_locals(self, sf: SourceFile, fn) -> Iterable[Finding]:
+        created: dict[str, int] = {}
+        for stmt in _walk_own(fn):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not _is_task_create(stmt.value):
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    created[tgt.id] = stmt.value.lineno
+        for name, lineno in created.items():
+            if sf.line_has(lineno, OPT_OUT):
+                continue
+            if not self._local_disposed(fn, name):
+                yield Finding(
+                    self.name, sf.rel, lineno,
+                    f"{fn.name}(): task {name!r} is created but never "
+                    "awaited, cancelled, gathered, stored, or returned in "
+                    "this function — its failure would vanish silently",
+                )
+
+    @staticmethod
+    def _local_disposed(fn, name: str) -> bool:
+        for node in ast.walk(fn):
+            # await name / await gather(..., name, ...)
+            if isinstance(node, ast.Await):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+            # name.cancel() / name.add_done_callback(...)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name
+                and node.func.attr in ("cancel", "add_done_callback", "result")
+            ):
+                return True
+            # retained onward: appended/added/passed/stored/returned/yielded
+            if isinstance(node, ast.Call) and not _is_task_create(node):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            return True
+            if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+            if isinstance(node, ast.Assign):
+                if any(
+                    isinstance(s, ast.Name) and s.id == name
+                    for s in ast.walk(node.value)
+                ) and not _is_task_create(node.value):
+                    return True
+        return False
+
+    # -- shape 3: self-attr task never disposed in the class --------------
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef) -> Iterable[Finding]:
+        created: dict[str, int] = {}
+        disposed: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_task_create(node.value):
+                for tgt in node.targets:
+                    attr = astutil.self_attr(tgt)
+                    if attr is not None:
+                        created.setdefault(attr, node.value.lineno)
+            # self._x.cancel() / add_done_callback
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("cancel", "add_done_callback")
+            ):
+                attr = astutil.self_attr(node.func.value)
+                if attr is not None:
+                    disposed.add(attr)
+            # await self._x  (or self._x inside an awaited expression)
+            if isinstance(node, ast.Await):
+                for sub in ast.walk(node):
+                    attr = astutil.self_attr(sub)
+                    if attr is not None:
+                        disposed.add(attr)
+            # the sweep idiom: `for t in (self._a, self._b): ... t.cancel()`
+            if isinstance(node, (ast.For, ast.AsyncFor)) and isinstance(node.target, ast.Name):
+                var = node.target.id
+                swept = {
+                    astutil.self_attr(e)
+                    for e in ast.walk(node.iter)
+                    if astutil.self_attr(e) is not None
+                }
+                if swept and self._name_disposed_in(node.body, var):
+                    disposed |= swept
+        for attr, lineno in sorted(created.items(), key=lambda kv: kv[1]):
+            if attr in disposed or sf.line_has(lineno, OPT_OUT):
+                continue
+            yield from self._flag_attr(sf, cls, attr, lineno)
+
+    @staticmethod
+    def _name_disposed_in(body: list[ast.stmt], var: str) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == var
+                    and node.func.attr in ("cancel", "add_done_callback")
+                ):
+                    return True
+                if isinstance(node, ast.Await):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+        return False
+
+    def _flag_attr(
+        self, sf: SourceFile, cls: ast.ClassDef, attr: str, lineno: int
+    ) -> Iterable[Finding]:
+        yield Finding(
+            self.name, sf.rel, lineno,
+            f"class {cls.name}: task self.{attr} is created but the "
+            "class never awaits or cancels it — no stop path owns its "
+            "lifecycle, so its failure would vanish silently",
+        )

@@ -1,0 +1,51 @@
+"""trace-adoption: pod-side spans run under an established tracer.
+
+Ported from ``hack/check_trace_propagation.py`` (its env-contract half is
+generalized by the ``env-contract`` rule).  The cross-process tracing
+contract (docs/OBSERVABILITY.md "Causal tracing & explain") only holds if
+every pod-side module that opens spans (``trace.span(...)`` /
+``<tracer>.span(...)`` / ``<tracer>.reconcile``) contains at least one
+``.adopt(...)`` or ``.activate(...)`` call — a span opened without one is
+either dead instrumentation or silently riding a caller's context the
+author never audited.  Opt-out: ``# trace-ambient-ok`` (library code
+deliberately relying on the ambient no-op contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# trace-ambient-ok"
+
+
+class TraceAdoptionRule(Rule):
+    name = "trace-adoption"
+    doc = "pod-side span call sites adopt/activate a tracer first"
+    paths = (
+        "tpu_operator/agents/",
+        "tpu_operator/validator/",
+        "tpu_operator/workloads/run_validation.py",
+    )
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        span_lines: list[int] = []
+        established = False
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = node.func.attr if isinstance(node.func, ast.Attribute) else ""
+            if attr in ("adopt", "activate"):
+                established = True
+            elif attr in ("span", "reconcile"):
+                if not sf.line_has(node.lineno, OPT_OUT):
+                    span_lines.append(node.lineno)
+        if span_lines and not established:
+            yield Finding(
+                self.name, sf.rel, span_lines[0],
+                f"opens spans (lines {', '.join(map(str, span_lines[:5]))}) "
+                "but never adopts/activates a tracer — "
+                f"adopt(TraceContext.from_env()) or mark the line {OPT_OUT}",
+            )

@@ -317,6 +317,10 @@ class FakeCluster:
         self._runner: Optional[web.AppRunner] = None
         self._sim_task: Optional[asyncio.Task] = None
         self._chaos_task: Optional[asyncio.Task] = None
+        # strong refs to in-flight pod-executor tasks: without one a task
+        # can be GC'd mid-flight and its exception vanishes; stop() cancels
+        # any still running so a test teardown never leaks an executor
+        self._exec_tasks: set[asyncio.Task] = set()
         self.port: Optional[int] = None
         self._pod_timers: dict[tuple[str, str], float] = {}
         # workload pods whose executor is currently running (concurrent:
@@ -474,7 +478,7 @@ class FakeCluster:
                 pass
 
     async def stop(self) -> None:
-        for task in (self._sim_task, self._chaos_task):
+        for task in (self._sim_task, self._chaos_task, *tuple(self._exec_tasks)):
             if task:
                 task.cancel()
                 try:
@@ -1076,7 +1080,9 @@ class FakeCluster:
                         continue
                     self._executing.add(key)
                     self._set_pod_phase(pod_store, ns, name, "Running")
-                    asyncio.create_task(self._execute_pod(pod_store, ns, name, pod))
+                    task = asyncio.create_task(self._execute_pod(pod_store, ns, name, pod))
+                    self._exec_tasks.add(task)
+                    task.add_done_callback(self._exec_tasks.discard)
                 elif restart_policy != "Always":
                     self._set_pod_phase(pod_store, ns, name, "Succeeded")
                 else:

@@ -1,0 +1,141 @@
+"""Seeded deterministic event-loop shim: `go test -race` for asyncio.
+
+The operator's concurrency bugs live in *scheduling order*: two coroutines
+both ready, and the loop's FIFO happens to run them in the order that
+hides the lost update.  Production hits the other order at 3am.  This
+module makes that order an *input*: :class:`InterleavingEventLoop` is a
+standard selector loop whose ready queue is shuffled by a seeded RNG
+before every batch, so one test body runs under hundreds of distinct —
+but perfectly reproducible — task interleavings.
+
+Static twin: the ``async-race`` and ``fence-coverage`` analysis rules
+(docs/STATIC_ANALYSIS.md) prove the *shape* of the code; this harness
+executes the schedules those shapes are vulnerable to.  ``make race``
+drives the workqueue dirty-set, plane-handoff, and migration-coordinator
+invariant suites (tests/test_race.py) across ≥200 seeds.
+
+Usage::
+
+    async def scenario():
+        ...build objects, spawn coroutines, assert invariants...
+
+    run_interleaved(scenario, seed=7)           # one schedule
+    report = sweep(scenario, seeds=range(200))  # the acceptance sweep
+    assert not report.failures, report.summary()
+
+Determinism contract: the scenario must not branch on wall-clock time or
+its own ``random`` module state (use the loop's seed); timer *deadlines*
+are honored normally — only the order of same-batch ready callbacks is
+permuted, which is exactly the freedom a production loop has.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import selectors
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Iterable, Optional
+
+Scenario = Callable[[], Awaitable]
+
+
+class InterleavingEventLoop(asyncio.SelectorEventLoop):
+    """Selector loop that permutes the ready-callback batch per iteration.
+
+    ``_run_once`` drains ``self._ready`` FIFO; shuffling the deque right
+    before each drain explores a different legal schedule while keeping
+    every callback exactly-once.  ``permutations`` counts the batches that
+    actually had >1 runnable callback — a scenario that never exceeds one
+    runnable at a time has no schedule freedom to explore, and its sweep
+    proves nothing (assert on ``permutations`` in the test)."""
+
+    def __init__(self, seed: int):
+        super().__init__(selectors.DefaultSelector())
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.permutations = 0
+
+    def _run_once(self) -> None:  # noqa: D401 — BaseEventLoop hook
+        ready = getattr(self, "_ready", None)
+        if ready is not None and len(ready) > 1:
+            batch = list(ready)
+            ready.clear()
+            self._rng.shuffle(batch)
+            ready.extend(batch)
+            self.permutations += 1
+        super()._run_once()
+
+
+@dataclass
+class Failure:
+    seed: int
+    error: BaseException
+
+
+@dataclass
+class SweepReport:
+    seeds_run: int = 0
+    total_permutations: int = 0
+    failures: list[Failure] = field(default_factory=list)
+
+    def summary(self) -> str:
+        lines = [
+            f"{self.seeds_run} seeds, {self.total_permutations} permuted "
+            f"batches, {len(self.failures)} failing schedule(s)"
+        ]
+        for f in self.failures[:10]:
+            lines.append(f"  seed {f.seed}: {type(f.error).__name__}: {f.error}")
+        return "\n".join(lines)
+
+
+def run_interleaved(
+    scenario: Scenario, seed: int, timeout: float = 30.0
+) -> tuple[object, int]:
+    """Run one scenario under one seeded schedule.  Returns
+    ``(result, permutations)``; re-raises whatever the scenario raises
+    (an invariant violation surfaces as its assertion)."""
+    loop = InterleavingEventLoop(seed)
+    try:
+        asyncio.set_event_loop(loop)
+        result = loop.run_until_complete(
+            asyncio.wait_for(scenario(), timeout)
+        )
+        return result, loop.permutations
+    finally:
+        asyncio.set_event_loop(None)
+        # drain cancellations so nothing leaks across seeds
+        try:
+            pending = asyncio.all_tasks(loop)
+            for task in pending:
+                task.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            loop.close()
+
+
+def sweep(
+    scenario: Scenario,
+    seeds: Iterable[int],
+    timeout: float = 30.0,
+    stop_after: Optional[int] = None,
+) -> SweepReport:
+    """Run the scenario across many seeds, collecting failures instead of
+    stopping at the first (a race that fires on 3 of 200 schedules should
+    report all three seeds for replay)."""
+    report = SweepReport()
+    for seed in seeds:
+        report.seeds_run += 1
+        try:
+            _, permutations = run_interleaved(scenario, seed, timeout=timeout)
+            report.total_permutations += permutations
+        except (KeyboardInterrupt, SystemExit):
+            raise  # an operator interrupt is not a racing schedule
+        except BaseException as e:  # noqa: BLE001 — collected, not hidden
+            report.failures.append(Failure(seed, e))
+            if stop_after is not None and len(report.failures) >= stop_after:
+                break
+    return report
