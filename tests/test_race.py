@@ -273,6 +273,222 @@ def test_migration_concurrent_drains_single_restore():
 
 
 # ---------------------------------------------------------------------------
+# cross-pod Lease expiry mid-reconcile: the deposed holder's write must be
+# fence-refused on EVERY schedule, and the new holder actuates exactly once
+# (the LeasedNodePlane twin of the in-process handoff suite above)
+
+
+class _FakeElector:
+    """Lease candidacy stub driven by the scenario: grant()/depose() flip
+    ``is_leader`` and fire transition callbacks synchronously, exactly as
+    ``LeaderElector._set_leader`` does."""
+
+    def __init__(self):
+        self.is_leader = asyncio.Event()
+        self.on_transition = []
+        self.defer_acquire = None
+        self.acquire_lock = None
+
+    async def start(self):
+        return None
+
+    async def stop(self):
+        self.depose()
+
+    def grant(self):
+        if not self.is_leader.is_set():
+            self.is_leader.set()
+            for cb in self.on_transition:
+                cb(True)
+
+    def depose(self):
+        if self.is_leader.is_set():
+            self.is_leader.clear()
+            for cb in self.on_transition:
+                cb(False)
+
+
+class _StubInformer:
+    """Just enough Informer surface for LeasedNodePlane's spawn path."""
+
+    def __init__(self, selector):
+        self.label_selector = selector
+        self.cache_objects = not selector.startswith("!")
+        self.cache = {}
+        self.handlers = []
+        self.synced = asyncio.Event()
+
+    def add_handler(self, h):
+        self.handlers.append(h)
+
+    async def start(self, wait=True):
+        self.synced.set()
+
+    async def stop(self):
+        return None
+
+    def get(self, name, namespace=""):
+        return self.cache.get((namespace, name))
+
+    def items(self):
+        return list(self.cache.values())
+
+
+class _StubLeaseClient:
+    """The only client call LeasedNodePlane itself makes is the acquire-time
+    intake sweep; answer it with the scenario's unstamped node."""
+
+    def __init__(self, names):
+        self.names = names
+
+    async def list_paged(self, group, kind, namespace=None, label_selector=None, **kw):
+        return {
+            "items": [{"metadata": {"name": n, "labels": {}}} for n in self.names]
+        }
+
+    async def iter_pages(self, group, kind, namespace=None, label_selector=None, **kw):
+        yield await self.list_paged(group, kind, namespace, label_selector)
+
+
+class _LeaseFencedReconciler:
+    """Shared-cluster actuator: both replicas' planes reconcile against ONE
+    applied-state dict, with an externally-coordinated window between the
+    state read and the write — the cross-pod deposal lands inside it."""
+
+    def __init__(self, cluster, fenced=True, hold=None):
+        self.cluster = cluster  # shared dict: applied state + actuation log
+        self.fenced = fenced
+        # (entered, proceed) events: the scenario parks the FIRST pass here
+        # so the deposal is guaranteed mid-reconcile; later passes skip
+        self.hold = hold
+        self.on_identity_change = "unused"
+        self.shard_of = None
+
+    def tracked(self):
+        return []
+
+    def arc_of(self, name):
+        return name
+
+    def note_arc(self, name, arc):
+        return None
+
+    async def prime(self, label_selector=None):
+        return None
+
+    def prime_items(self, nodes):
+        return None
+
+    def forget_where(self, pred):
+        return 0
+
+    async def reconcile(self, key):
+        if self.cluster["applied"].get(key):
+            return None
+        if self.hold is not None and not self.hold[0].is_set():
+            self.hold[0].set()          # entered: deposal may now land
+            await self.hold[1].wait()   # parked across the deposal
+        else:
+            await asyncio.sleep(0)
+        if self.fenced:
+            fence = client_api._REQUEST_FENCE.get()
+            assert fence is not None, "lease-plane reconcile ran without a fence"
+            try:
+                fence.check("PATCH", f"/api/v1/nodes/{key}")
+            except Exception:
+                self.cluster["refused"].append(key)
+                raise
+        self.cluster["applied"][key] = True
+        self.cluster["log"].append(key)
+        return None
+
+
+async def _lease_expiry_scenario(fenced: bool) -> dict:
+    from tpu_operator.controllers.plane import LeasedNodePlane
+
+    cluster = {"applied": {}, "log": [], "refused": []}
+    hold = (asyncio.Event(), asyncio.Event())
+    electors = {"a": {}, "b": {}}
+
+    def make_plane(tag, rec):
+        def elector_factory(sid):
+            e = _FakeElector()
+            electors[tag][sid] = e
+            return e
+        return LeasedNodePlane(
+            _StubLeaseClient(["node-x"]), rec, "ns",
+            shards=1, resync_seconds=0,
+            elector_factory=elector_factory,
+            informer_factory=_StubInformer,
+        )
+
+    rec_a = _LeaseFencedReconciler(cluster, fenced=fenced, hold=hold)
+    rec_b = _LeaseFencedReconciler(cluster, fenced=fenced, hold=None)
+    plane_a = make_plane("a", rec_a)
+    plane_b = make_plane("b", rec_b)
+    await plane_a.start()
+    await plane_b.start()
+    sid = "node-shard-0"
+    try:
+        electors["a"][sid].grant()
+        for _ in range(2000):
+            if sid in plane_a.controllers:
+                break
+            await asyncio.sleep(0)
+        plane_a.enqueue("node-x")
+        await hold[0].wait()            # replica A is mid-reconcile
+        electors["a"][sid].depose()     # cross-pod Lease expiry: fence live
+        electors["b"][sid].grant()      # peer acquires; spawn sweeps intake
+        for _ in range(4000):
+            if cluster["log"]:
+                break                   # B actuated the moved key
+            await asyncio.sleep(0)
+        hold[1].set()                   # A's parked pass resumes: its write
+        for _ in range(4000):
+            if plane_a.quiesced() and plane_b.quiesced():
+                break
+            await asyncio.sleep(0)
+    finally:
+        await plane_a.stop()
+        await plane_b.stop()
+    return cluster
+
+
+def test_lease_expiry_mid_reconcile_fence_refuses_every_seed():
+    """A per-shard Lease expiring mid-reconcile must refuse the old
+    holder's write on EVERY schedule while the new holder actuates the
+    moved key exactly once."""
+
+    async def scenario():
+        cluster = await _lease_expiry_scenario(fenced=True)
+        assert cluster["log"] == ["node-x"], (
+            f"exactly-once violated across the Lease handoff: {cluster['log']}"
+        )
+        assert cluster["refused"], "old holder's post-deposal write was not fence-refused"
+
+    report = sweep(scenario, range(RACE_SEEDS))
+    assert not report.failures, report.summary()
+    assert report.total_permutations > 0
+
+
+def test_lease_expiry_unfenced_control_is_caught():
+    """Rig regression: bypass the fence and the same deposal schedule MUST
+    double-actuate — if this stops failing the harness went blind to the
+    cross-pod race (the static twin is fence-coverage's Lease-gated-root
+    recognition)."""
+
+    async def scenario():
+        cluster = await _lease_expiry_scenario(fenced=False)
+        assert len(cluster["log"]) <= 1, f"double actuation: {cluster['log']}"
+
+    report = sweep(scenario, range(max(RACE_SEEDS, 20)))
+    assert report.failures, (
+        "unfenced cross-pod double-actuation went unobserved — the "
+        "interleaving harness can no longer catch the Lease-handoff race"
+    )
+
+
+# ---------------------------------------------------------------------------
 # determinism: the same seed must replay the same schedule
 
 

@@ -1,0 +1,498 @@
+"""Multi-replica sharded operator plane (ISSUE 13 acceptance).
+
+Pins: per-shard Lease leader election (a replica runs a shard Controller
+only while holding that shard's Lease, soft-capped spread across
+replicas), the ``tpu.google.com/shard`` label contract + slice-arc
+colocation, partitioned informer views (including write-through routing
+and the fake apiserver's selector-watch view-transition semantics), the
+cross-pod handoff path (release -> survivor acquire -> moved arc
+re-primed), and the renewal jitter that keeps N x S candidacies from
+renewing in lockstep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP, TPUClusterPolicy
+from tpu_operator.controllers.nodes import NodeReconciler, arc_key
+from tpu_operator.controllers.plane import LeasedNodePlane, shard_lease_name
+from tpu_operator.k8s.cache import CachedReader, PartitionedView
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.k8s.leader import RENEW_JITTER, LeaderElector
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.testing import FakeCluster, SimConfig
+
+pytestmark = pytest.mark.asyncio
+
+NS = "tpu-operator"
+
+
+def _add_pool_nodes(fc, pools: int, hosts: int = 4) -> None:
+    for s in range(pools):
+        for h in range(hosts):
+            fc.add_node(
+                f"tpu-{s}-{h}", topology="4x4",
+                labels={
+                    consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                    consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                },
+            )
+
+
+async def _policy_reader(fc, client, metrics) -> tuple[CachedReader, Informer]:
+    reader = CachedReader(client, metrics=metrics)
+    pol = Informer(client, GROUP, CLUSTER_POLICY_KIND)
+    reader.add_informer(pol)
+    await client.create(TPUClusterPolicy.new().obj)
+    await pol.start(wait=True)
+    return reader, pol
+
+
+def _make_plane(fc, client, reader, metrics, identity, max_held=None):
+    rec = NodeReconciler(reader, NS, metrics=metrics)
+    return LeasedNodePlane(
+        client, rec, NS, metrics=metrics,
+        lease_duration=1.5, renew_interval=0.3,
+        identity=identity, max_held=max_held,
+    )
+
+
+async def _wait(predicate, timeout=20.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def _all_stamped(fc) -> bool:
+    nodes = list(fc.store("", "nodes").objects.values())
+    return bool(nodes) and all(
+        str((n["metadata"].get("labels") or {}).get(consts.SHARD_LABEL, ""))
+        .startswith("node-shard-")
+        and (n["metadata"].get("labels") or {}).get(consts.TPU_COUNT_LABEL)
+        for n in nodes
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lease-per-shard acquisition, stamping, slice-arc colocation
+
+
+async def test_single_replica_acquires_all_shards_and_stamps_arcs():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            reader, pol = await _policy_reader(fc, client, metrics)
+            plane = _make_plane(fc, client, reader, metrics, "replica-a")
+            _add_pool_nodes(fc, pools=6)
+            await plane.start()
+            try:
+                assert await _wait(lambda: len(plane.held_shards()) == consts.NODE_SHARDS)
+                # every shard Lease exists, held by this identity
+                for sid in plane.shard_ids:
+                    lease = fc.get_obj(
+                        "coordination.k8s.io", "Lease", shard_lease_name(sid), NS
+                    )
+                    assert lease["spec"]["holderIdentity"] == "replica-a"
+                assert await _wait(lambda: _all_stamped(fc) and plane.quiesced())
+                # slice-arc colocation: every host of a pool carries the SAME
+                # shard label, and it matches the ring's owner for the pool
+                for s in range(6):
+                    shards = {
+                        fc.get_obj("", "Node", f"tpu-{s}-{h}")["metadata"]["labels"][
+                            consts.SHARD_LABEL
+                        ]
+                        for h in range(4)
+                    }
+                    assert shards == {plane.ring.owner(f"pool-{s}")}, (s, shards)
+            finally:
+                await plane.stop()
+                await pol.stop()
+
+
+async def test_two_replicas_split_leases_and_partition_views():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client_a, ApiClient(
+            Config(base_url=fc.base_url)
+        ) as client_b:
+            metrics_a, metrics_b = OperatorMetrics(), OperatorMetrics()
+            reader_a, pol_a = await _policy_reader(fc, client_a, metrics_a)
+            reader_b = CachedReader(client_b, metrics=metrics_b)
+            pol_b = Informer(client_b, GROUP, CLUSTER_POLICY_KIND)
+            reader_b.add_informer(pol_b)
+            await pol_b.start(wait=True)
+            plane_a = _make_plane(fc, client_a, reader_a, metrics_a, "replica-a", max_held=2)
+            plane_b = _make_plane(fc, client_b, reader_b, metrics_b, "replica-b", max_held=2)
+            _add_pool_nodes(fc, pools=8)
+            await plane_a.start()
+            await plane_b.start()
+            try:
+                # the soft cap splits the four Leases two/two
+                assert await _wait(
+                    lambda: sorted(plane_a.held_shards() + plane_b.held_shards())
+                    == sorted(plane_a.shard_ids)
+                    and len(plane_a.held_shards()) == 2
+                    and len(plane_b.held_shards()) == 2,
+                    timeout=25,
+                )
+                assert await _wait(
+                    lambda: _all_stamped(fc)
+                    and plane_a.quiesced() and plane_b.quiesced(),
+                    timeout=25,
+                )
+                # partitioned views: each replica caches ONLY its arcs
+                total = len(fc.store("", "nodes").objects)
+                cached_a = len(plane_a.view.items())
+                cached_b = len(plane_b.view.items())
+                assert cached_a + cached_b == total
+                assert 0 < cached_a < total and 0 < cached_b < total
+                # and each replica's view holds exactly its held shards' nodes
+                for plane in (plane_a, plane_b):
+                    for item in plane.view.items():
+                        assert (
+                            item["metadata"]["labels"][consts.SHARD_LABEL]
+                            in plane.held_shards()
+                        )
+            finally:
+                await plane_a.stop()
+                await plane_b.stop()
+                await pol_a.stop()
+                await pol_b.stop()
+
+
+async def test_fresh_install_policy_created_after_replica():
+    """Fresh-install ordering: shard replicas deploy BEFORE the
+    TPUClusterPolicy exists.  The whole fleet's intake events arrive
+    while node labels are unmanaged — the reconciler must remember the
+    names (no reads, no writes), and the policy appearing must resweep
+    the backlog into stamped arcs via the binary's policy-resweep wiring
+    rather than waiting for nothing (the regression: the pre-policy
+    early-return forgot the node, leaving tracked()/resync empty and the
+    fleet permanently unstamped)."""
+    from tpu_operator.cmd.shard_replica import wire_policy_resweep
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            metrics = OperatorMetrics()
+            reader = CachedReader(client, metrics=metrics)
+            pol = Informer(client, GROUP, CLUSTER_POLICY_KIND)
+            reader.add_informer(pol)
+            await pol.start(wait=True)  # NO policy exists yet
+            rec = NodeReconciler(reader, NS, metrics=metrics)
+            # churn-proof lease durations: this test never hands shards
+            # over, and a CPU-starved full-suite run losing a 1.5s Lease
+            # mid-test re-primes the arc (GETs) right under the zero-verb
+            # sweep assertion below
+            plane = LeasedNodePlane(
+                client, rec, NS, metrics=metrics,
+                lease_duration=30.0, renew_interval=2.0,
+                identity="replica-a",
+            )
+            wire_policy_resweep(pol, plane)
+            _add_pool_nodes(fc, pools=5)
+            await plane.start()
+            try:
+                # pre-policy: every node remembered, nothing stamped,
+                # and the unconfigured steady state costs zero verbs.
+                # Wait for ALL shards: a shard owning no arcs can finish
+                # acquiring (its backlog sweep GETs nodes) after tracked
+                # hits 20, racing the verb-count reset below.
+                assert await _wait(
+                    lambda: len(plane.held_shards()) == consts.NODE_SHARDS
+                    and len(rec.tracked()) == 20 and plane.quiesced()
+                )
+                assert not _all_stamped(fc)
+                fc.reset_request_counts()
+                plane.resync()
+                assert await _wait(plane.quiesced)
+                # lease renewals tick regardless; the SWEEP must be free
+                assert {
+                    k: v for k, v in fc.request_counts.items()
+                    if "leases" not in k[1]
+                } == {}
+                # the policy appears -> the resweep stamps the backlog
+                await client.create(TPUClusterPolicy.new().obj)
+                assert await _wait(
+                    lambda: _all_stamped(fc) and plane.quiesced(), timeout=25
+                )
+            finally:
+                await plane.stop()
+                await pol.stop()
+
+
+async def test_replica_death_hands_arcs_to_survivor():
+    """Stopping one replica (its electors release their Leases, as a
+    rolling upgrade would) must hand its shards to the survivor, which
+    re-primes ONLY the moved arcs and keeps reconciling them."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client_a, ApiClient(
+            Config(base_url=fc.base_url)
+        ) as client_b:
+            metrics_a, metrics_b = OperatorMetrics(), OperatorMetrics()
+            reader_a, pol_a = await _policy_reader(fc, client_a, metrics_a)
+            reader_b = CachedReader(client_b, metrics=metrics_b)
+            pol_b = Informer(client_b, GROUP, CLUSTER_POLICY_KIND)
+            reader_b.add_informer(pol_b)
+            await pol_b.start(wait=True)
+            plane_a = _make_plane(fc, client_a, reader_a, metrics_a, "replica-a", max_held=2)
+            plane_b = _make_plane(fc, client_b, reader_b, metrics_b, "replica-b", max_held=2)
+            # fast takeover for the test: don't sit out the full defer window
+            for elector in plane_b.electors.values():
+                elector.acquire_defer = 0.3
+            _add_pool_nodes(fc, pools=8)
+            await plane_a.start()
+            await plane_b.start()
+            try:
+                assert await _wait(
+                    lambda: len(plane_a.held_shards()) == 2
+                    and len(plane_b.held_shards()) == 2,
+                    timeout=25,
+                )
+                assert await _wait(
+                    lambda: _all_stamped(fc)
+                    and plane_a.quiesced() and plane_b.quiesced(),
+                    timeout=25,
+                )
+                moved = set(plane_a.held_shards())
+                await plane_a.stop()
+                # survivor acquires the released Leases (past its soft cap:
+                # orphaned shards are never stranded behind a "full" peer)
+                assert await _wait(
+                    lambda: set(plane_b.held_shards()) == set(plane_b.shard_ids),
+                    timeout=30,
+                )
+                # moved arc re-primed and live: strip a label on a moved
+                # node out-of-band; the survivor must heal it
+                victim = next(
+                    n["metadata"]["name"]
+                    for n in fc.store("", "nodes").objects.values()
+                    if n["metadata"]["labels"].get(consts.SHARD_LABEL) in moved
+                )
+                fc.store("", "nodes").patch(
+                    None, victim,
+                    {"metadata": {"labels": {consts.TPU_COUNT_LABEL: None}}},
+                )
+                assert await _wait(
+                    lambda: (
+                        fc.get_obj("", "Node", victim)["metadata"]["labels"]
+                        .get(consts.TPU_COUNT_LABEL)
+                    ),
+                    timeout=20,
+                ), "survivor never reconciled the moved arc"
+                # zero duplicate creations through the whole handoff
+                assert fc.duplicate_creations() == {}
+            finally:
+                await plane_b.stop()
+                await pol_a.stop()
+                await pol_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# fake apiserver: label-selector watch view transitions (satellite pin)
+
+
+async def test_watch_selector_view_transition_semantics():
+    """A label change moving an object out of a selector-filtered watch is
+    delivered as DELETED, into it as ADDED — and a plain MODIFIED only
+    when the watcher could see it before AND after (real apiserver
+    semantics; what partitioned informers rely on for shard re-stamps)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            fc.add_node("n1", labels={consts.SHARD_LABEL: "node-shard-0"})
+
+            async def collect(selector, n_events, timeout=5.0):
+                seen = []
+
+                async def watch():
+                    async for evt in client.watch(
+                        "", "Node", label_selector=selector,
+                        resource_version="0", timeout_seconds=timeout,
+                    ):
+                        if evt.type == "BOOKMARK":
+                            continue
+                        seen.append((evt.type, evt.object["metadata"]["name"]))
+                        if len(seen) >= n_events:
+                            return
+                task = asyncio.create_task(watch())
+                return seen, task
+
+            old_view, t_old = await collect(
+                f"{consts.SHARD_LABEL}=node-shard-0", 2
+            )
+            new_view, t_new = await collect(
+                f"{consts.SHARD_LABEL}=node-shard-1", 1
+            )
+            intake, t_intake = await collect(f"!{consts.SHARD_LABEL}", 1)
+            await asyncio.sleep(0.3)  # watches established (replay rv=0)
+
+            # re-stamp: the node moves shard-0 -> shard-1
+            fc.store("", "nodes").patch(
+                None, "n1",
+                {"metadata": {"labels": {consts.SHARD_LABEL: "node-shard-1"}}},
+            )
+            await asyncio.wait_for(t_old, 10)
+            await asyncio.wait_for(t_new, 10)
+            assert old_view == [("ADDED", "n1"), ("DELETED", "n1")], old_view
+            assert new_view == [("ADDED", "n1")], new_view
+
+            # strip the label entirely: enters the intake (!shard) view
+            fc.store("", "nodes").patch(
+                None, "n1", {"metadata": {"labels": {consts.SHARD_LABEL: None}}}
+            )
+            await asyncio.wait_for(t_intake, 10)
+            assert intake == [("ADDED", "n1")], intake
+
+
+async def test_watch_replay_synthesizes_view_transitions():
+    """A watcher resuming from an rv BEFORE a label move must see the same
+    synthesized transition from the replay ring, not a raw MODIFIED."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            node = fc.add_node("n1", labels={consts.SHARD_LABEL: "node-shard-0"})
+            rv0 = node["metadata"]["resourceVersion"]
+            fc.store("", "nodes").patch(
+                None, "n1",
+                {"metadata": {"labels": {consts.SHARD_LABEL: "node-shard-1"}}},
+            )
+            seen = []
+            async for evt in client.watch(
+                "", "Node",
+                label_selector=f"{consts.SHARD_LABEL}=node-shard-0",
+                resource_version=rv0, timeout_seconds=1.0,
+            ):
+                if evt.type != "BOOKMARK":
+                    seen.append(evt.type)
+                    break
+            assert seen == ["DELETED"], seen
+
+
+# ---------------------------------------------------------------------------
+# partitioned view unit behaviour: union reads + write-through routing
+
+
+async def test_partitioned_view_write_through_moves_between_parts():
+    view = PartitionedView("", "Node")
+
+    class _Part:
+        def __init__(self, selector):
+            self.label_selector = selector
+            self.cache = {}
+            self.synced = asyncio.Event()
+            self.synced.set()
+
+        def get(self, name, namespace=""):
+            return self.cache.get((namespace, name))
+
+        def items(self):
+            return list(self.cache.values())
+
+    p0, p1 = _Part(f"{consts.SHARD_LABEL}=s0"), _Part(f"{consts.SHARD_LABEL}=s1")
+    view.add_part("s0", p0)
+    view.add_part("s1", p1)
+    assert view.synced.is_set()
+
+    obj = {"metadata": {"name": "n", "labels": {consts.SHARD_LABEL: "s0"}}}
+    view.cache[("", "n")] = obj
+    assert p0.cache and not p1.cache
+    assert view.get("n") is obj
+
+    # re-stamp via write-through: the cached copy moves views atomically
+    moved = {"metadata": {"name": "n", "labels": {consts.SHARD_LABEL: "s1"}}}
+    view.cache[("", "n")] = moved
+    assert not p0.cache and p1.cache
+    assert view.get("n") is moved
+    assert view.items() == [moved]
+
+    view.cache.pop(("", "n"))
+    assert view.get("n") is None
+    # losing the only synced part clears the union's synced latch
+    view.remove_part("s0")
+    view.remove_part("s1")
+    assert not view.synced.is_set()
+
+
+# ---------------------------------------------------------------------------
+# intake tap: cache_objects=False dispatches without retaining
+
+
+async def test_lean_informer_dispatches_without_caching():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        async with ApiClient(Config(base_url=fc.base_url)) as client:
+            fc.add_node("n1", tpu=False)
+            fc.add_node("n2", tpu=False)
+            seen = []
+            inf = Informer(client, "", "Node", cache_objects=False)
+
+            async def handler(event_type, obj):
+                seen.append((event_type, obj["metadata"]["name"]))
+
+            inf.add_handler(handler)
+            await inf.start(wait=True)
+            try:
+                assert {n for _, n in seen} == {"n1", "n2"}
+                assert inf.cache == {}, "lean informer must retain nothing"
+                fc.add_node("n3", tpu=False)
+                deadline = asyncio.get_event_loop().time() + 5
+                while asyncio.get_event_loop().time() < deadline:
+                    if any(n == "n3" for _, n in seen):
+                        break
+                    await asyncio.sleep(0.02)
+                assert any(n == "n3" for _, n in seen)
+                assert inf.cache == {}
+            finally:
+                await inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# arc keys
+
+
+def test_arc_key_colocates_slices_and_falls_back_to_name():
+    pooled = {
+        "metadata": {"name": "tpu-1-2", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "4x4",
+            consts.GKE_NODEPOOL_LABEL: "pool-1",
+        }},
+    }
+    assert arc_key(pooled) == "pool-1"
+    single = {
+        "metadata": {"name": "tpu-solo", "labels": {
+            consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            consts.GKE_TPU_TOPOLOGY_LABEL: "1x1",
+        }},
+    }
+    assert arc_key(single) == "tpu-solo"
+    plain = {"metadata": {"name": "cpu-node", "labels": {}}}
+    assert arc_key(plain) == "cpu-node"
+
+
+# ---------------------------------------------------------------------------
+# renewal jitter (satellite pin): candidacies must not renew in lockstep
+
+
+def test_renew_jitter_spreads_candidacies():
+    electors = [
+        LeaderElector.__new__(LeaderElector) for _ in range(4)
+    ]
+    import random
+
+    samples = []
+    for e in electors:
+        e.renew_interval = 5.0
+        e.is_leader = asyncio.Event()
+        e.is_leader.set()
+        e._jitter_rng = random.Random()
+        samples.extend(e._renew_sleep() for _ in range(50))
+    lo, hi = 5.0 * (1 - RENEW_JITTER), 5.0 * (1 + RENEW_JITTER)
+    assert all(lo <= s <= hi for s in samples), (min(samples), max(samples))
+    # genuine spread, not one synchronized tick for every candidacy
+    assert max(samples) - min(samples) > 5.0 * RENEW_JITTER * 0.5
+    assert len({round(s, 6) for s in samples}) > 50

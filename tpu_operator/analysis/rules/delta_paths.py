@@ -105,9 +105,18 @@ class _Visitor(ast.NodeVisitor):
     def visit_While(self, node: ast.While) -> None:
         is_forever = isinstance(node.test, ast.Constant) and node.test.value is True
         if is_forever:
+            # asyncio.sleep(0) is a cooperative yield, not a poll cadence:
+            # it defines no wait interval, so a loop built on it cannot be
+            # the polling shape this rule bans (the workqueue worker uses
+            # one as its event-loop-starvation backstop).
             sleeps = [
                 n for n in ast.walk(node)
                 if isinstance(n, ast.Call) and _is_asyncio_sleep(n)
+                and not (
+                    n.args
+                    and isinstance(n.args[0], ast.Constant)
+                    and n.args[0].value == 0
+                )
             ]
             if sleeps and (self.fname, self._current()) not in self.rule.sleep_loop_allowlist:
                 self.findings.append(Finding(

@@ -96,17 +96,26 @@ class _ModuleScan(ast.NodeVisitor):
         key = self._key()
         if name == "request_fence":
             self.fence_roots.add(key)
-        elif name == "Controller" and len(node.args) >= 2:
+        elif name == "Controller":
             # Controller("name", self.reconcile, ...): the reconcile fn
-            # only ever runs under the Manager's leader fence
-            ref = node.args[1]
-            if isinstance(ref, ast.Attribute):
-                self.reconcile_refs.add(ref.attr)
-            elif isinstance(ref, ast.Name):
-                self.reconcile_refs.add(ref.id)
-            elif isinstance(ref, ast.Call):
-                # factory form: Controller(sid, self._shard_reconcile(sid))
-                self.reconcile_refs.add(astutil.call_name(ref))
+            # only ever runs under the Manager's leader fence, or — for
+            # the sharded plane's per-shard workers (both the in-process
+            # NodePlane and the Lease-gated LeasedNodePlane spawn path) —
+            # under the ambient per-shard request_fence its factory
+            # installs.  Recognize the positional AND keyword form plus
+            # the factory call shape, so Lease-gated shard roots need no
+            # allowlist entries.
+            refs = list(node.args[1:2]) + [
+                kw.value for kw in node.keywords if kw.arg == "reconcile"
+            ]
+            for ref in refs:
+                if isinstance(ref, ast.Attribute):
+                    self.reconcile_refs.add(ref.attr)
+                elif isinstance(ref, ast.Name):
+                    self.reconcile_refs.add(ref.id)
+                elif isinstance(ref, ast.Call):
+                    # factory form: Controller(sid, self._shard_reconcile(sid))
+                    self.reconcile_refs.add(astutil.call_name(ref))
         elif name:
             self.edges[key].add(name)
         # a bare `self.X` loaded (not called) registers a reference edge:

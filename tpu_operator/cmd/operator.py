@@ -58,6 +58,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--leader-lease-renew-deadline", type=_duration, default="10s")
     p.add_argument("--leader-lease-duration", type=_duration, default="15s")
     p.add_argument("--leader-lease-retry-period", type=_duration, default="5s")
+    # Multi-replica sharded node plane (docs/PERFORMANCE.md "Multi-replica
+    # sharding"): per-shard Lease leader election instead of the in-process
+    # ring.  With N operator replicas all passing this flag, each replica
+    # runs the node arcs whose shard Leases it wins (standby replicas no
+    # longer idle), while the rest of the controllers stay single-active
+    # under the global lease.  A dedicated lean worker deployment can run
+    # `python -m tpu_operator.cmd.shard_replica` instead.
+    p.add_argument("--shard-lease-election", action="store_true", default=False)
     p.add_argument("--zap-log-level", default="info")
     # structured logging (zap JSON encoder analogue); json records carry the
     # active reconcile id / controller / operand state from the span context
@@ -108,6 +116,10 @@ async def run(args: argparse.Namespace) -> None:
         metrics_port=_port(args.metrics_bind_address),
         health_port=_port(args.health_probe_bind_address),
         leader_elect=args.leader_elect,
+        # sharded mode: a standby replica must serve its shard Leases, so
+        # the manager starts immediately and the supervisor holds the
+        # leader-gated controllers suspended until global leadership lands
+        leader_wait=not args.shard_lease_election,
         metrics_registry=metrics.registry,
         lease_duration=args.leader_lease_duration,
         renew_interval=args.leader_lease_retry_period,
@@ -136,14 +148,29 @@ async def run(args: argparse.Namespace) -> None:
     # the full-walk policy pass becomes the slow resync safety net
     # (docs/PERFORMANCE.md "Delta reconcile & sharding")
     from tpu_operator.controllers.nodes import NodeReconciler
-    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.controllers.plane import LeasedNodePlane, NodePlane
 
-    plane = NodePlane(
-        NodeReconciler(reconciler.reader, namespace, metrics=metrics),
-        metrics=metrics,
-    )
-    plane.setup(mgr)
-    reconciler.setup(mgr, plane=plane)
+    leased_plane = None
+    if args.shard_lease_election:
+        # cross-pod mode: shard ownership by per-shard Lease; the plane
+        # starts/stops itself (its Controllers live and die with their
+        # Leases, outside the manager's global-leader suspend loop).
+        # Node reads still ride the reconciler's full informer here — the
+        # lean per-arc cache topology is the shard_replica binary's.
+        leased_plane = LeasedNodePlane(
+            client,
+            NodeReconciler(reconciler.reader, namespace, metrics=metrics),
+            namespace,
+            metrics=metrics,
+        ).setup(mgr)
+        reconciler.setup(mgr, plane=leased_plane)
+    else:
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, namespace, metrics=metrics),
+            metrics=metrics,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
     TPURuntimeReconciler(client, namespace, **obs).setup(mgr)
     UpgradeReconciler(client, namespace, **obs).setup(mgr)
     RemediationReconciler(client, namespace, **obs).setup(mgr)
@@ -170,7 +197,13 @@ async def run(args: argparse.Namespace) -> None:
             pass
 
     async with mgr:
-        await stop.wait()
+        if leased_plane is not None:
+            await leased_plane.start()
+        try:
+            await stop.wait()
+        finally:
+            if leased_plane is not None:
+                await leased_plane.stop()
     await client.close()
 
 

@@ -1,0 +1,242 @@
+"""tpu-operator node-plane shard replica binary.
+
+One replica of the multi-replica sharded operator plane
+(docs/PERFORMANCE.md "Multi-replica sharding"): runs ONLY the Lease-owned
+node plane — elector candidacies for every shard Lease, a shard
+``Controller`` plus a partitioned (``tpu.google.com/shard=<sid>``) node
+informer per Lease held, and the per-node delta reconciler.  Deploy N of
+these alongside the (singleton-leader) operator manager to spread the
+fleet's per-node work and informer cache across pods; each replica's RSS
+tracks the arcs it holds, not the fleet.
+
+Run: ``python -m tpu_operator.cmd.shard_replica`` with
+``KUBERNETES_API_URL`` (tests/bench) or in-cluster config, and
+``OPERATOR_NAMESPACE`` for the Lease namespace.
+
+``--status-file`` (used by ``bench.py --reconcile`` at the multi-replica
+tiers) periodically publishes a one-line JSON health snapshot — held
+shards, tracked nodes, quiesced, fence rejections, peak RSS — via
+tmp+rename so a reader never sees a torn write.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import resource
+import signal
+import socket
+import time
+
+from tpu_operator import consts
+from tpu_operator.api.types import CLUSTER_POLICY_KIND, GROUP
+from tpu_operator.controllers.nodes import NodeReconciler
+from tpu_operator.controllers.plane import LeasedNodePlane
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import logging as obs_logging
+
+log = logging.getLogger("tpu_operator.shard_replica")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("tpu-shard-replica")
+    p.add_argument("--identity", default=f"{socket.gethostname()}-{os.getpid()}")
+    p.add_argument("--shards", type=int, default=consts.NODE_SHARDS)
+    # soft per-replica shard cap (0 = unlimited): set to ceil(shards /
+    # replicas) so the Lease distribution balances; orphaned shards are
+    # still taken after the defer window (replica-death takeover)
+    p.add_argument("--max-shards", type=int, default=0)
+    p.add_argument(
+        "--lease-duration", type=float,
+        default=consts.SHARD_LEASE_DURATION_SECONDS,
+    )
+    p.add_argument(
+        "--lease-renew", type=float, default=consts.SHARD_LEASE_RENEW_SECONDS
+    )
+    p.add_argument(
+        "--resync-seconds", type=float, default=consts.NODE_RESYNC_SECONDS
+    )
+    p.add_argument("--status-file", default="")
+    p.add_argument("--status-interval", type=float, default=0.25)
+    p.add_argument(
+        "--log-format",
+        choices=(obs_logging.FORMAT_TEXT, obs_logging.FORMAT_JSON),
+        default=os.environ.get(consts.LOG_FORMAT_ENV, obs_logging.FORMAT_TEXT),
+    )
+    return p.parse_args(argv)
+
+
+def _peak_rss_mb() -> float:
+    """Peak RSS of THIS process image.  VmHWM (reset by execve) rather
+    than ru_maxrss: Linux preserves ru_maxrss across fork+exec, so a
+    replica spawned by a bench parent holding a 100k-node store would
+    inherit the parent's high-water and report ~360 MB before allocating
+    a thing."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
+def _counter_value(counter) -> float:
+    try:
+        return counter._value.get()  # prometheus_client internal, test-only read
+    except AttributeError:
+        return 0.0
+
+
+def wire_policy_resweep(policy_informer: Informer, plane) -> None:
+    """Resweep the held arcs when a TPUClusterPolicy APPEARS or its spec
+    changes.  Fresh install deploys shard replicas before the CR exists:
+    the intake events for the whole fleet arrive while node labels are
+    unmanaged, so the delta reconciler only *remembers* the names — the
+    policy event is what turns that backlog into stamping, now rather
+    than at the next periodic resync (this lean binary has no full-walk
+    pass to pick it up).  Keyed on spec so the manager's status updates
+    don't churn sweeps."""
+    seen_specs: dict = {}
+
+    async def on_policy(event_type: str, obj: dict) -> None:
+        name = obj.get("metadata", {}).get("name")
+        spec = None if event_type == "DELETED" else obj.get("spec")
+        if seen_specs.get(name) == spec:
+            return
+        seen_specs[name] = spec
+        plane.resync()
+
+    policy_informer.add_handler(on_policy)
+
+
+class _StatusWriter:
+    """Atomic (tmp+rename) periodic status publication for the bench
+    driver; a missing --status-file disables it entirely."""
+
+    def __init__(self, path: str, plane: LeasedNodePlane,
+                 reconciler: NodeReconciler, metrics: OperatorMetrics,
+                 identity: str):
+        self.path = path
+        self.plane = plane
+        self.reconciler = reconciler
+        self.metrics = metrics
+        self.identity = identity
+
+    def snapshot(self) -> dict:
+        return {
+            "identity": self.identity,
+            "pid": os.getpid(),
+            "held_shards": self.plane.held_shards(),
+            "tracked": len(self.reconciler.tracked()),
+            "quiesced": self.plane.quiesced(),
+            "fence_rejections": _counter_value(
+                self.metrics.shard_fence_rejections_total
+            ),
+            "peak_rss_mb": _peak_rss_mb(),
+            "ts": time.time(),
+        }
+
+    def _write(self, snap: dict) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, self.path)
+
+    async def run(self, interval: float) -> None:
+        while True:
+            try:
+                # snapshot ON the loop (it reads loop-mutated structures —
+                # taking it in the executor thread races controller
+                # spawn/teardown and a "dict changed size" mid-iteration
+                # would kill this task, silently freezing the status
+                # file); only the file I/O goes to the executor
+                snap = self.snapshot()
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._write, snap
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the heartbeat must outlive
+                # any one bad tick; a dead writer reads as a dead replica
+                log.warning("status write failed", exc_info=True)
+            await asyncio.sleep(interval)
+
+
+async def run(args: argparse.Namespace) -> None:
+    obs_logging.setup(args.log_format)
+    namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV, "tpu-operator")
+    client = ApiClient(Config.from_env())
+    metrics = OperatorMetrics()
+    client.metrics = metrics
+    reader = CachedReader(client, metrics=metrics)
+    # the delta reconciler reads the active policy spec each pass; a small
+    # informer keeps that read cached (node reads ride the plane's
+    # partitioned view registered by LeasedNodePlane itself)
+    policy_informer = Informer(client, GROUP, CLUSTER_POLICY_KIND)
+    reader.add_informer(policy_informer)
+
+    reconciler = NodeReconciler(reader, namespace, metrics=metrics)
+    plane = LeasedNodePlane(
+        client,
+        reconciler,
+        namespace,
+        metrics=metrics,
+        shards=args.shards,
+        resync_seconds=args.resync_seconds,
+        lease_duration=args.lease_duration,
+        renew_interval=args.lease_renew,
+        identity=args.identity,
+        max_held=args.max_shards or None,
+    )
+    wire_policy_resweep(policy_informer, plane)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+
+    await policy_informer.start(wait=True)
+    await plane.start()
+    log.info(
+        "shard replica %s up: %d shard candidacies, ns=%s",
+        args.identity, args.shards, namespace,
+    )
+    status_task = None
+    if args.status_file:
+        writer = _StatusWriter(
+            args.status_file, plane, reconciler, metrics, args.identity
+        )
+        status_task = asyncio.create_task(
+            writer.run(args.status_interval), name="status-writer"
+        )
+    try:
+        await stop.wait()
+    finally:
+        if status_task is not None:
+            status_task.cancel()
+            try:
+                await status_task
+            except asyncio.CancelledError:
+                pass
+        await plane.stop()
+        await policy_informer.stop()
+        await client.close()
+
+
+def main() -> None:
+    asyncio.run(run(parse_args()))
+
+
+if __name__ == "__main__":
+    main()

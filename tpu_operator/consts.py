@@ -304,6 +304,27 @@ NODE_SHARDS = 4
 # in the hot path
 NODE_RESYNC_SECONDS = 300.0
 
+# Multi-replica sharded operator plane (controllers/plane.py
+# ``LeasedNodePlane``; docs/PERFORMANCE.md "Multi-replica sharding").
+# Shard ownership is promoted from in-process task assignment to one
+# coordination.k8s.io/v1 Lease PER SHARD: N operator replicas run elector
+# candidacies for every shard and a replica instantiates a shard
+# Controller only while it holds that shard's Lease.  The operator stamps
+# every node with its owning shard id so each replica's informer watches
+# only its arc (constant per-replica RSS as the fleet grows).  The arc
+# key is the node's slice group when it has one — all hosts of a
+# multi-host slice land on ONE shard, so pooled-readiness sweeps never
+# read across replicas.
+SHARD_LABEL = "tpu.google.com/shard"
+# shard Lease object names: <prefix>-<shard index> in the operator namespace
+SHARD_LEASE_PREFIX = "tpu-node-shard"
+# Shard-lease timings: shorter than the manager lease (15s/5s) because a
+# shard handoff costs one arc resync, not a whole-operator failover —
+# faster takeover is worth the extra renew traffic (which renewal jitter
+# de-synchronizes; see LeaderElector).
+SHARD_LEASE_DURATION_SECONDS = 10.0
+SHARD_LEASE_RENEW_SECONDS = 3.0
+
 # API-request resilience envelope (k8s/retry.py; docs/ROBUSTNESS.md).  The
 # per-try timeout is the hung-connection bound — before it existed a stalled
 # apiserver socket parked a reconcile pass on aiohttp's 5-minute default.

@@ -390,15 +390,23 @@ class ClusterPolicyReconciler:
                 # delta path: only the affected node's key is enqueued —
                 # health-relevant events (agent verdict, NotReady) ride the
                 # HIGH class so they preempt a queued resync sweep
+                from tpu_operator.controllers.nodes import arc_key
                 from tpu_operator.k8s import workqueue as wq
 
                 node_labels = deep_get(obj, "metadata", "labels", default={}) or {}
                 unhealthy = (
                     node_labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY
                 )
+                # arc hint from the event object, exactly like the plane's
+                # own _arc_handler: without it a not-yet-indexed node routes
+                # by bare name, which on the Lease-owned plane can land a
+                # foreign arc's key on a locally held shard — a wasted pass
+                # fenced only at write time, and a foreign node permanently
+                # indexed into this replica's membership maps
                 plane.enqueue(
                     obj["metadata"]["name"],
                     priority=wq.PRIORITY_HIGH if unhealthy else wq.PRIORITY_NORMAL,
+                    arc=arc_key(obj),
                 )
                 if event_type in ("ADDED", "DELETED"):
                     # fleet-size change: the full pass owns node count,

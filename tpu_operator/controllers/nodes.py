@@ -34,6 +34,19 @@ from tpu_operator.utils import deep_get
 log = logging.getLogger("tpu_operator.nodes")
 
 
+def arc_key(node: dict) -> str:
+    """The key a node is sharded BY: its slice group when it has one, else
+    its own name.  Hashing the slice group (not the node name) onto the
+    ring colocates every host of a multi-host slice on ONE shard, so
+    pooled-readiness sweeps stay inside the owning replica's arc — the
+    property that keeps multi-replica steady state at zero live reads."""
+    if is_tpu_node(node):
+        group = labels.slice_group_key(node)
+        if group is not None:
+            return group
+    return node["metadata"]["name"]
+
+
 class NodeReconciler:
     """Delta reconcile for one node key (plus its slice group)."""
 
@@ -56,20 +69,88 @@ class NodeReconciler:
         # a MODIFIED event can flip identity without an ADD/DELETE
         self._identity: dict[str, tuple] = {}
         self.on_identity_change: Optional[Callable[[], None]] = None
+        # arc key per known node (slice group or name) so the plane can
+        # route a bare node name back to its shard without the node object
+        self._arcs: dict[str, str] = {}
+        # shard-label contract hook, installed by the Lease-owned plane:
+        # given the node, the shard id its arc hashes to RIGHT NOW.  When
+        # set, ``_sync_node_labels`` asserts ``consts.SHARD_LABEL`` in the
+        # same patch as the identity labels — stamping new nodes into
+        # their arc and re-stamping when the ring's arc->shard mapping
+        # changes.  None (in-process plane, direct-drive tests) keeps the
+        # node label surface exactly as before.
+        self.shard_of: Optional[Callable[[dict], Optional[str]]] = None
+        # ((policy name, rv), parsed spec) — see _parsed_spec
+        self._spec_memo: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     def tracked(self) -> list[str]:
         """Every node name seen alive (resync seeding) — grouped or not."""
         return list(self._known)
 
-    async def prime(self) -> None:
-        """Seed the slice-group index from one (cached) fleet listing so a
+    def arc_of(self, name: str) -> str:
+        """The arc key ``name`` was last indexed under (falls back to the
+        name itself for a node this reconciler has never seen — correct,
+        since an unseen node cannot belong to a known slice group)."""
+        return self._arcs.get(name, name)
+
+    def note_arc(self, name: str, arc: str) -> None:
+        """Record the arc an event handler computed from the event object
+        BEFORE the node is first reconciled.  Without this, the pop-time
+        ownership check and the write fence would derive the arc from the
+        bare name until ``_index`` runs — disagreeing with the routing
+        decision and bouncing a brand-new node's key between shards."""
+        self._arcs[name] = arc
+
+    def forget_where(self, pred: Callable[[str], bool]) -> int:
+        """Drop every known node matching ``pred`` from the membership /
+        identity / arc indexes — the Lease-owned plane calls this when a
+        shard Lease is lost so a deposed replica's RSS and resync sweep
+        shrink back to the arcs it still holds."""
+        dropped = 0
+        for name in [n for n in self._known if pred(n)]:
+            self._index(name, None)
+            dropped += 1
+        # arc hints recorded at enqueue time for keys this replica never
+        # got to reconcile (queued across the handoff) live only in
+        # _arcs — sweep them too or a deposed replica retains them forever
+        for name in [n for n in self._arcs if pred(n)]:
+            del self._arcs[name]
+        return dropped
+
+    async def prime(self, label_selector: Optional[str] = None) -> None:
+        """Seed the slice-group index from one (cached) listing so a
         freshly-started plane computes group readiness against full
-        membership instead of rediscovering it event by event.  This is a
-        full-resync entry point (check_delta_paths allowlist), called once
-        at plane start — never from the per-key path."""
-        for node in await self.reader.list_items("", "Node"):
+        membership instead of rediscovering it event by event.  The
+        Lease-owned plane primes one ARC at a time (``prime_items`` over
+        the arc informer's first relist — "resync only the moved keys");
+        the in-process plane primes the fleet.  This is a full-resync
+        entry point (check_delta_paths allowlist), called at plane/arc
+        start — never from the per-key path."""
+        self.prime_items(await self.reader.list_items(
+            "", "Node", label_selector=label_selector
+        ))
+
+    def prime_items(self, nodes) -> None:
+        """Index an already-listed node set (read-only: no copies).  The
+        Lease-owned plane feeds the arc informer's own items here on
+        acquire — deep-copying a 12k-node arc through the cached ``list``
+        path stalled the event loop long enough to miss Lease renewals."""
+        for node in nodes:
             self._index(node["metadata"]["name"], node)
+
+    def _parsed_spec(self, policy_obj: dict):
+        """Spec parse memoized on (name, resourceVersion): a 25k-node
+        resync sweep runs this per key, and re-parsing the identical CR
+        into dataclasses 25k times was measurable event-loop stall (which
+        starves shard-Lease renewals on a busy replica)."""
+        from tpu_operator.api.types import TPUClusterPolicy
+
+        meta = policy_obj.get("metadata", {})
+        key = (meta.get("name"), meta.get("resourceVersion"))
+        if self._spec_memo is None or self._spec_memo[0] != key:
+            self._spec_memo = (key, TPUClusterPolicy(policy_obj).spec)
+        return self._spec_memo[1]
 
     @staticmethod
     def _identity_of(node: dict) -> tuple:
@@ -90,8 +171,10 @@ class NodeReconciler:
         if node is None:
             self._known.discard(name)
             self._identity.pop(name, None)
+            self._arcs.pop(name, None)
         else:
             self._known.add(name)
+            self._arcs[name] = arc_key(node)
             identity = self._identity_of(node)
             prev = self._identity.get(name)
             self._identity[name] = identity
@@ -130,14 +213,25 @@ class NodeReconciler:
         policy_obj = await clusterinfo.active_cluster_policy(self.reader)
         if policy_obj is None:
             # no active policy: node labels are unmanaged, exactly like the
-            # full walk (which only runs inside a policy reconcile)
+            # full walk (which only runs inside a policy reconcile).  But
+            # REMEMBER the name: tracked() seeds the resync sweep, so a
+            # fleet intaken while no policy exists yet (fresh install:
+            # shard replicas deploy before the TPUClusterPolicy) must
+            # still be re-enqueued when the policy appears — without this
+            # the sweep is empty and the nodes are never stamped.  Name
+            # only, no read (an unstamped node is outside every arc
+            # informer, so reading here would cost a live GET per pass in
+            # the unconfigured state); a name whose node is gone
+            # self-heals on the first managed pass (404 → unindex).
+            self._known.add(name)
             return None
-        from tpu_operator.api.types import TPUClusterPolicy
-
-        spec = TPUClusterPolicy(policy_obj).spec
+        spec = self._parsed_spec(policy_obj)
 
         try:
-            node = await self.reader.get("", "Node", name)
+            # read-only pass: the reconciler never mutates the node dict,
+            # so skip the cache's defensive deepcopy (25k of them per
+            # resync sweep is real event-loop time on a shard replica)
+            node = await self._read_node(name)
         except ApiError as e:
             if not e.not_found:
                 raise
@@ -157,8 +251,23 @@ class NodeReconciler:
             affected_groups |= await self._sync_group(group) - done
         return None
 
+    async def _read_node(self, name: str) -> dict:
+        """Read-only node fetch: cached reads skip the defensive deepcopy
+        (this reconciler never mutates node dicts); a CachedReader without
+        the fast path — or a raw client — behaves as before."""
+        try:
+            return await self.reader.get("", "Node", name, copy_result=False)
+        except TypeError:
+            return await self.reader.get("", "Node", name)
+
     async def _sync_node_labels(self, node: dict, spec) -> None:
         desired = labels.desired_node_labels(node, spec)
+        if self.shard_of is not None:
+            # shard-label contract (docs/PERFORMANCE.md "Multi-replica
+            # sharding"): the arc owner stamps the node into its shard so
+            # partitioned informers see it; folded into the SAME patch as
+            # the identity labels — partitioning costs no extra verb
+            desired[consts.SHARD_LABEL] = self.shard_of(node)
         current = deep_get(node, "metadata", "labels", default={}) or {}
         patch_labels = {}
         for key, value in desired.items():
@@ -171,7 +280,9 @@ class NodeReconciler:
             await self.reader.patch(
                 "", "Node", name, {"metadata": {"labels": patch_labels}}
             )
-            log.info("delta-labelled node %s: %s", name, patch_labels)
+            # debug: at fleet scale this fires once per joining node, and
+            # formatting the label dict per repair is measurable CPU
+            log.debug("delta-labelled node %s: %s", name, patch_labels)
 
     async def _sync_group(self, group: str) -> set[str]:
         """Pooled slice readiness for ONE group (the per-group unit of
@@ -183,7 +294,7 @@ class NodeReconciler:
         spilled: set[str] = set()
         for member_name in sorted(self._groups.get(group, ())):
             try:
-                member = await self.reader.get("", "Node", member_name)
+                member = await self._read_node(member_name)
             except ApiError as e:
                 if not e.not_found:
                     raise

@@ -2,11 +2,13 @@
 
 The third layer of the fleet-scale reconcile architecture
 (docs/PERFORMANCE.md "Delta reconcile & sharding"): informer events enqueue
-only the affected node key; the key is consistently hashed onto one of N
-in-process worker shards (``k8s/sharding.py``), each a ``Controller`` on
-its own priority/fairness ``WorkQueue``.  One key always lands on one
-shard, so a node never reconciles concurrently with itself, while distinct
-nodes fan out across workers.
+only the affected node key; the key's ARC (its slice group, or its own
+name — ``controllers/nodes.arc_key``) is consistently hashed onto one of N
+worker shards (``k8s/sharding.py``), each a ``Controller`` on its own
+priority/fairness ``WorkQueue``.  One arc always lands on one shard, so a
+node never reconciles concurrently with itself AND every host of a
+multi-host slice reconciles on the same shard, while distinct arcs fan out
+across workers.
 
 Shard fences generalize the PR-4 leader ``WriteFence``: every shard
 reconcile runs under an ambient per-request fence that re-checks ring
@@ -18,24 +20,40 @@ current owner.
 A slow periodic resync (LOW priority, so real events preempt it) re-enqueues
 every known node and kicks the registered full-pass hooks — the safety net
 for drift the watch stream missed.
+
+:class:`NodePlane` runs all N shards inside one process (ownership = ring
+membership).  :class:`LeasedNodePlane` promotes shard ownership to one
+coordination.k8s.io/v1 Lease PER SHARD, so N operator replicas each own an
+arc of the fleet — see "Multi-replica sharding" in docs/PERFORMANCE.md.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
+import time
 from typing import Callable, Optional
 
 from tpu_operator import consts
-from tpu_operator.controllers.nodes import NodeReconciler
+from tpu_operator.controllers.nodes import NodeReconciler, arc_key
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s import client as client_api
 from tpu_operator.k8s import retry as retry_api
 from tpu_operator.k8s import workqueue as wq
+from tpu_operator.k8s.cache import PartitionedView
+from tpu_operator.k8s.informer import Informer
+from tpu_operator.k8s.leader import LeaderElector
 from tpu_operator.k8s.sharding import HashRing
 
 log = logging.getLogger("tpu_operator.plane")
 
 RESYNC_KEY = "node-resync"
+
+
+def shard_lease_name(shard_id: str) -> str:
+    """Lease object name for one shard (``tpu-node-shard-<i>`` in the
+    operator namespace — the shard id already carries the index)."""
+    return f"{consts.SHARD_LEASE_PREFIX}-{shard_id.rsplit('-', 1)[-1]}"
 
 
 class NodePlane:
@@ -53,10 +71,13 @@ class NodePlane:
         self.resync_seconds = resync_seconds
         self.shard_ids = [f"node-shard-{i}" for i in range(max(1, shards))]
         self.ring = HashRing(self.shard_ids)
-        self.controllers: dict[str, Controller] = {
-            sid: Controller(sid, self._shard_reconcile(sid), metrics=metrics)
-            for sid in self.shard_ids
-        }
+        # composed into every shard fence alongside ring ownership; the
+        # Manager's setup() points it at leadership so the ambient shard
+        # fence (which REPLACES the client-wide leader fence per request,
+        # k8s/client.py) never weakens the deposed-leader guarantee.  The
+        # Lease-owned plane swaps in per-shard Lease holdership instead.
+        self.write_gate: Callable[[], bool] = lambda: True
+        self.controllers: dict[str, Controller] = self._build_controllers()
         # resync runs as a scheduled-requeue controller on the same
         # framework — cancellable and saturation-instrumented, never a
         # hand-rolled sleep loop
@@ -80,13 +101,58 @@ class NodePlane:
         for hook in self.resync_hooks:
             hook()
 
+    def _build_controllers(self) -> dict[str, Controller]:
+        """In-process plane: every shard's Controller lives for the plane's
+        lifetime.  The Lease-owned subclass overrides this to none — its
+        controllers are spawned and torn down per acquired Lease."""
+        return {sid: self._make_controller(sid) for sid in self.shard_ids}
+
+    def _make_controller(self, shard_id: str) -> Controller:
+        return Controller(
+            shard_id, self._shard_reconcile(shard_id), metrics=self.metrics
+        )
+
     # ------------------------------------------------------------------
-    def enqueue(self, key: str, priority: int = wq.PRIORITY_NORMAL) -> None:
-        """Route a node key to its owning shard's queue."""
-        owner = self.ring.owner(key)
+    def _arc(self, key: str) -> str:
+        """The arc a node key shards by — its slice group when the
+        reconciler has indexed one (colocating a slice's hosts on one
+        shard), else the key itself.  Stub reconcilers without an arc
+        index route by key, the pre-arc behaviour."""
+        arc_of = getattr(self.reconciler, "arc_of", None)
+        return arc_of(key) if arc_of is not None else key
+
+    def _owns(self, shard_id: str, key: str) -> bool:
+        """Live ownership check — the fence predicate re-evaluates it per
+        write, so a mid-reconcile handoff refuses the very next verb."""
+        return self.ring.owner(self._arc(key)) == shard_id
+
+    def enqueue(
+        self,
+        key: str,
+        priority: int = wq.PRIORITY_NORMAL,
+        arc: Optional[str] = None,
+    ) -> None:
+        """Route a node key to its owning shard's queue.  ``arc`` lets an
+        event handler pass the arc computed from the event object itself
+        (a node the reconciler has not indexed yet routes correctly, and
+        the hint keeps the pop-time/fence ownership checks consistent
+        with this routing decision)."""
+        owner = self.ring.owner(arc if arc is not None else self._arc(key))
         if owner is None:
             return
-        self.controllers[owner].enqueue(key, priority=priority)
+        controller = self.controllers.get(owner)
+        if controller is None:
+            # not ours (Lease-owned plane: a foreign shard's key off the
+            # fleet-wide intake tap) — and don't record the arc hint
+            # either: noting every intake event would grow each replica's
+            # arc index with the WHOLE fleet instead of its owned arcs,
+            # defeating the partitioned-views RSS bound
+            return
+        if arc is not None:
+            note = getattr(self.reconciler, "note_arc", None)
+            if note is not None:
+                note(key, arc)
+        controller.enqueue(key, priority=priority)
 
     def resync(self) -> None:
         """Re-enqueue every known node at LOW priority (event-driven keys
@@ -106,43 +172,70 @@ class NodePlane:
         return all(c.queue.idle for c in self.controllers.values())
 
     # ------------------------------------------------------------------
+    def _reroute(self, key: str, priority: int) -> None:
+        """Hand a key to its current owner after this shard declined it
+        (queued-across-a-handoff, or fenced mid-reconcile).  In-process the
+        new owner's controller lives in the same dict; the Lease-owned
+        plane only re-routes shards this replica OWNS — a foreign owner
+        discovers the key through its own arc informer.  The ownership
+        check is load-bearing, not etiquette: on the Lease plane the ring
+        is static, so a key declined because the LEASE was lost maps back
+        to the very shard that declined it — and until the teardown
+        transition drains, that shard's controller is still in the dict.
+        Re-enqueueing there makes the worker's pop→decline→re-enqueue
+        cycle complete without ever touching an unresolved future, i.e. a
+        synchronous spin that starves the event loop (teardown, renewals,
+        the status heartbeat) for as long as the queue has keys."""
+        owner = self.ring.owner(self._arc(key))
+        if owner is None or not self._owns(owner, key):
+            return
+        controller = self.controllers.get(owner)
+        if controller is not None:
+            controller.enqueue(key, priority=priority)
+
     def _shard_reconcile(self, shard_id: str):
         async def run(key: str) -> Optional[float]:
             # the class the key was popped at, preserved across any
             # re-route: a HIGH health key must not demote to NORMAL just
             # because a handoff moved it mid-rebalance
+            controller = self.controllers.get(shard_id)
             popped_priority = (
-                self.controllers[shard_id].queue.processing_priority(key)
+                controller.queue.processing_priority(key)
+                if controller is not None
+                else None
             )
             if popped_priority is None:
                 popped_priority = wq.PRIORITY_NORMAL
-            owner = self.ring.owner(key)
-            if owner != shard_id:
+            if not self._owns(shard_id, key):
                 # handed off while queued: the current owner picks it up;
                 # this shard never touches the key's state
-                if owner is not None:
-                    self.controllers[owner].enqueue(key, priority=popped_priority)
+                self._reroute(key, popped_priority)
                 return None
             if self.metrics is not None:
                 self.metrics.shard_reconciles_total.labels(shard=shard_id).inc()
             fence = retry_api.WriteFence(
-                lambda: self.ring.owner(key) == shard_id
+                lambda: self.write_gate() and self._owns(shard_id, key)
             )
             try:
                 with client_api.request_fence(fence):
                     return await self.reconciler.reconcile(key)
             except retry_api.FencedError:
-                # ring moved mid-reconcile: the fence refused the write the
-                # old owner was about to issue — hand the key to the new
-                # owner, which re-reads state and finishes the job exactly
-                # once
+                # ownership moved mid-reconcile (ring rebalance, Lease
+                # deposal, leadership loss): the fence refused the write
+                # the old owner was about to issue — hand the key to the
+                # new owner, which re-reads state and finishes the job
+                # exactly once
                 if self.metrics is not None:
                     self.metrics.shard_fence_rejections_total.inc()
-                new_owner = self.ring.owner(key)
-                if new_owner is not None and new_owner != shard_id:
-                    self.controllers[new_owner].enqueue(
-                        key, priority=popped_priority
-                    )
+                if not self._owns(shard_id, key):
+                    self._reroute(key, popped_priority)
+                elif controller is not None:
+                    # still the owner — the gate (leadership / Lease) was
+                    # what refused; keep the key (delayed, so a paused-but-
+                    # not-yet-suspended worker doesn't spin on the fence)
+                    # and the resumed worker finishes the job instead of
+                    # waiting out a resync
+                    controller.enqueue_after(key, 1.0, priority=popped_priority)
                 return None
         return run
 
@@ -172,6 +265,10 @@ class NodePlane:
         """Register the shard + resync controllers with a Manager (they
         inherit the degraded-mode gate, suspend/resume, and metrics
         stamping) and prime the resync cycle."""
+        # fold manager leadership into every shard fence: the ambient
+        # shard fence replaces the client-wide leader fence per request
+        # (k8s/client.py), so it must carry the leadership check itself
+        self.write_gate = mgr._is_leader
         for controller in self.controllers.values():
             mgr.add_controller(controller)
         mgr.add_controller(self.resync_controller)
@@ -195,3 +292,343 @@ class NodePlane:
             await controller.stop()
         await self.resync_controller.stop()
         self._started = False
+
+
+# ---------------------------------------------------------------------------
+# Multi-replica sharded plane: shard ownership by per-shard Lease.
+
+
+class LeasedNodePlane(NodePlane):
+    """Cross-pod sharded node plane (docs/PERFORMANCE.md "Multi-replica
+    sharding").
+
+    Same ring, same shard Controllers, same ambient ``WriteFence`` contract
+    as :class:`NodePlane` — but WHICH replica runs a shard's Controller is
+    decided by one coordination.k8s.io/v1 Lease per shard: this replica
+    runs an elector candidacy for every shard and instantiates a shard's
+    Controller (plus its arc informer) only while it holds that shard's
+    Lease.  The ring itself stays FULL and identical on every replica
+    (``consts.NODE_SHARDS`` shard ids), so the arc→shard mapping — and the
+    ``tpu.google.com/shard`` label stamped from it — is stable across
+    replica churn; a Lease handoff moves a shard's Controller and informer
+    between pods without re-labelling a single node.
+
+    Partitioned views: each held shard gets its own informer watching only
+    ``shard=<sid>`` nodes, plus one shared intake informer watching
+    ``!shard`` (not-yet-stamped) nodes; both feed a
+    :class:`~tpu_operator.k8s.cache.PartitionedView` registered with the
+    reconciler's ``CachedReader`` so per-replica RSS tracks the owned arcs,
+    not the fleet.
+
+    Fencing: the per-reconcile fence predicate is ``lease held AND ring
+    owner`` — ``LeaderElector._set_leader`` clears ``is_leader``
+    synchronously before any further await, so a deposed replica's
+    in-flight write is refused exactly as an in-process handoff is
+    (counted in ``shard_fence_rejections_total``).
+
+    Rebalance: a replica death or rolling upgrade releases (or expires)
+    its Leases; survivors acquire them, prime ONLY the moved arc from the
+    new shard informer's first relist, and re-enqueue just those keys at
+    LOW priority — "resync only the moved keys".
+    """
+
+    def __init__(
+        self,
+        client,
+        reconciler: NodeReconciler,
+        namespace: str,
+        metrics=None,
+        shards: int = consts.NODE_SHARDS,
+        resync_seconds: float = consts.NODE_RESYNC_SECONDS,
+        lease_duration: float = consts.SHARD_LEASE_DURATION_SECONDS,
+        renew_interval: float = consts.SHARD_LEASE_RENEW_SECONDS,
+        identity: Optional[str] = None,
+        max_held: Optional[int] = None,
+        elector_factory: Optional[Callable[[str], LeaderElector]] = None,
+        informer_factory: Optional[Callable[[str], Informer]] = None,
+    ):
+        super().__init__(
+            reconciler, metrics=metrics, shards=shards,
+            resync_seconds=resync_seconds,
+        )
+        self.client = client
+        self.namespace = namespace
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        # soft anti-affinity: at/above this many held shards the replica
+        # DEFERS further acquisitions (LeaderElector.defer_acquire) so
+        # less-loaded peers claim first; an orphaned shard is still taken
+        # after the defer window, so a replica death never strands an arc
+        # behind a "full" survivor.  None = grab everything acquirable.
+        self.max_held = max_held
+        self._informer_factory = informer_factory or self._default_informer
+        self._elector_factory = elector_factory or self._default_elector
+        self.electors: dict[str, LeaderElector] = {}
+        self._acquire_lock = asyncio.Lock()
+        for sid in self.shard_ids:
+            elector = self._elector_factory(sid)
+            elector.on_transition.append(self._transition_cb(sid))
+            if max_held is not None and hasattr(elector, "defer_acquire"):
+                elector.defer_acquire = (
+                    lambda: len(self.held_shards()) >= self.max_held
+                )
+                # serialize this replica's acquisitions so the load check
+                # above observes each win before the next candidacy asks
+                elector.acquire_lock = self._acquire_lock
+            self.electors[sid] = elector
+        # arc informers per held shard + the shared intake view, unioned
+        # into one CachedReader-servable view of the owned scope
+        self.view = PartitionedView("", "Node")
+        self._intake: Optional[Informer] = None
+        # shard-label contract: the arc owner stamps nodes into their
+        # shard (and re-stamps if the arc→shard mapping ever changes)
+        reconciler.shard_of = lambda node: self.ring.owner(arc_key(node))
+        # serve the reconciler's Node reads from the owned arcs — unless
+        # the reader already has an unfiltered Node informer (single-binary
+        # deployments keep the full cache for the policy walk; the view's
+        # partial lists must never shadow it)
+        reader = getattr(reconciler, "reader", None)
+        if (
+            reader is not None
+            and hasattr(reader, "add_informer")
+            and ("", "Node") not in getattr(reader, "_informers", {})
+        ):
+            reader.add_informer(self.view)
+        # lease transitions observed by elector callbacks (synchronous)
+        # are applied by the lifecycle task (spawn/teardown is async)
+        self._transitions: asyncio.Queue = asyncio.Queue()
+        self._transition_active = False
+        self._lifecycle: Optional[asyncio.Task] = None
+
+    def _build_controllers(self) -> dict[str, Controller]:
+        # Lease ownership is the authority: controllers spawn per acquired
+        # shard Lease and die with it — nothing pre-built
+        return {}
+
+    # -- defaults ------------------------------------------------------
+    def _default_elector(self, sid: str) -> LeaderElector:
+        return LeaderElector(
+            self.client,
+            self.namespace,
+            name=shard_lease_name(sid),
+            identity=self.identity,
+            lease_duration=self.lease_duration,
+            renew_interval=self.renew_interval,
+        )
+
+    def _default_informer(self, selector: str) -> Informer:
+        # the intake watch (`!shard`) is an EVENT TAP, not a cache: during
+        # a mass join every replica sees every unstamped node, and caching
+        # them would give each replica a transient full-fleet RSS spike
+        return Informer(
+            self.client, "", "Node", label_selector=selector,
+            resync_seconds=600.0,
+            cache_objects=not selector.startswith("!"),
+        )
+
+    # -- ownership -----------------------------------------------------
+    def holds(self, shard_id: str) -> bool:
+        elector = self.electors.get(shard_id)
+        return elector is not None and elector.is_leader.is_set()
+
+    def held_shards(self) -> list[str]:
+        return [sid for sid in self.shard_ids if self.holds(sid)]
+
+    def _owns(self, shard_id: str, key: str) -> bool:
+        # Lease holdership first: is_leader clears synchronously at
+        # deposal, so the fence refuses the old holder's write the same
+        # instant a peer may legally acquire the shard
+        return self.holds(shard_id) and super()._owns(shard_id, key)
+
+    def _transition_cb(self, sid: str):
+        def cb(held: bool) -> None:
+            if self.metrics is not None:
+                self.metrics.shard_lease_held.labels(shard=sid).set(
+                    1 if held else 0
+                )
+                self.metrics.shard_lease_transitions_total.labels(
+                    shard=sid, direction="acquired" if held else "lost"
+                ).inc()
+            self._transitions.put_nowait((sid, held))
+        return cb
+
+    # -- event wiring --------------------------------------------------
+    def _priority_of(self, obj: dict) -> int:
+        node_labels = (obj.get("metadata") or {}).get("labels") or {}
+        unhealthy = node_labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY
+        return wq.PRIORITY_HIGH if unhealthy else wq.PRIORITY_NORMAL
+
+    def _arc_handler(self):
+        async def on_node(event_type: str, obj: dict) -> None:
+            self.enqueue(
+                obj["metadata"]["name"],
+                priority=self._priority_of(obj),
+                arc=arc_key(obj),
+            )
+        return on_node
+
+    # -- lifecycle -----------------------------------------------------
+    async def start(self) -> None:
+        """Start the intake view, every shard candidacy, the lifecycle
+        driver, and the resync controller.  Shard Controllers/informers
+        spawn lazily as Leases are acquired."""
+        self._intake = self._informer_factory(f"!{consts.SHARD_LABEL}")
+        self._intake.add_handler(self._arc_handler())
+        if self._intake.cache_objects:
+            # a caching intake (tests with tiny fleets) can also serve
+            # reads of not-yet-stamped nodes; the lean default cannot,
+            # and new-node reads simply fall back live until stamped
+            self.view.add_part("intake", self._intake)
+        await self._intake.start(wait=True)
+        self.view.mark_synced()
+        self._lifecycle = asyncio.create_task(
+            self._drive_transitions(), name="shard-lease-lifecycle"
+        )
+        for elector in self.electors.values():
+            await elector.start()
+        await self.resync_controller.start()
+        if self.resync_seconds > 0:
+            self.resync_controller.enqueue(RESYNC_KEY)
+        self._started = True
+
+    async def stop(self) -> None:
+        # electors first: stop() best-effort releases each held Lease so
+        # surviving replicas take over in one renew tick instead of a
+        # full lease-duration expiry (the rolling-upgrade fast path)
+        for elector in self.electors.values():
+            await elector.stop()
+        if self._lifecycle is not None:
+            self._lifecycle.cancel()
+            try:
+                await self._lifecycle
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # noqa: BLE001
+                log.debug("shard lease lifecycle errored during stop", exc_info=True)
+            self._lifecycle = None
+        for sid in list(self.controllers):
+            await self._teardown_shard(sid)
+        if self._intake is not None:
+            await self._intake.stop()
+        await self.resync_controller.stop()
+        self._started = False
+
+    async def _drive_transitions(self) -> None:
+        while True:
+            sid, held = await self._transitions.get()
+            # mark the transition in-flight for quiesced(): get() already
+            # emptied the queue, so without this a spawn's arc prime /
+            # backlog sweep runs while the plane reads as quiesced — and
+            # steady-state gates (bench, tests) sample verbs mid-spawn
+            self._transition_active = True
+            try:
+                # collapse stale flip-flops: act on the CURRENT state
+                if held and self.holds(sid) and sid not in self.controllers:
+                    await self._spawn_shard(sid)
+                elif not held and not self.holds(sid) and sid in self.controllers:
+                    await self._teardown_shard(sid)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one shard's churn must not
+                # kill the lifecycle for every other shard
+                log.exception("shard %s lease transition handling failed", sid)
+            finally:
+                self._transition_active = False
+
+    async def _spawn_shard(self, sid: str) -> None:
+        """Acquired ``sid``: watch its arc, prime the moved keys from the
+        informer's first relist, and start its Controller."""
+        informer = self._informer_factory(f"{consts.SHARD_LABEL}={sid}")
+        informer.add_handler(self._arc_handler())
+        self.view.add_part(sid, informer)
+        await informer.start(wait=True)
+        self.view.mark_synced()
+        controller = self._make_controller(sid)
+        self.controllers[sid] = controller
+        await controller.start()
+        # resync ONLY the moved arc: prime straight off the informer's own
+        # items (read-only, no copies — a deep-copied 12k-node arc list
+        # stalls the loop past the Lease renew deadline) and re-enqueue
+        # each key at LOW priority; zero verbs when the previous owner
+        # left the arc converged.  Yield periodically: enqueue never
+        # suspends, and a 25k-key slab would starve the renewals.
+        self.reconciler.prime_items(informer.items())
+        for i, item in enumerate(informer.items()):
+            self.enqueue(
+                item["metadata"]["name"],
+                priority=wq.PRIORITY_LOW,
+                arc=arc_key(item),
+            )
+            if i % 512 == 511:
+                await asyncio.sleep(0)
+        # sweep the NOT-YET-STAMPED backlog this shard now owns: the
+        # intake tap only streams live events, so nodes that joined (or
+        # were orphaned by a dead stamper) before this acquisition must be
+        # discovered by one selector-scoped list.  Live on purpose — the
+        # partitioned view cannot answer an unlabelled query — and scoped
+        # to `!shard`, so a converged fleet pays one empty page here.
+        backlog = 0
+        async for page in self.client.iter_pages(
+            "", "Node", label_selector=f"!{consts.SHARD_LABEL}"
+        ):
+            # streamed page by page: the unstamped backlog can be the whole
+            # fleet during a mass join, and materializing it would spike
+            # every replica's RSS past the partitioned-views bound
+            for item in page.get("items", []):
+                arc = arc_key(item)
+                if self.ring.owner(arc) == sid:
+                    self.enqueue(
+                        item["metadata"]["name"],
+                        priority=wq.PRIORITY_LOW,
+                        arc=arc,
+                    )
+                    backlog += 1
+        log.info(
+            "acquired shard %s (%d stamped, %d intake)",
+            sid, len(informer.items()), backlog,
+        )
+
+    async def _teardown_shard(self, sid: str) -> None:
+        """Lost ``sid``: writes are already fenced (the elector cleared
+        ``is_leader`` synchronously); stop the Controller, drop the arc's
+        informer and indexes so RSS shrinks to the shards still held."""
+        controller = self.controllers.pop(sid, None)
+        if controller is not None:
+            # bounded drain before the hard stop: the fence — not worker
+            # cancellation — is the exactly-once guarantee, so let the
+            # in-flight pass run into it (its post-deposal write is
+            # refused and COUNTED in shard_fence_rejections_total) rather
+            # than cancelling mid-pass and leaving the reconciler's
+            # in-memory indexes half-updated.  Queued keys drain fast:
+            # the pop-time ownership check reroutes them without writes.
+            deadline = time.monotonic() + 2.0
+            while not controller.queue.idle and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            await controller.stop()
+        part = self.view.remove_part(sid)
+        if part is not None:
+            await part.stop()
+        dropped = self.reconciler.forget_where(
+            lambda name: self.ring.owner(self.reconciler.arc_of(name)) == sid
+        )
+        log.info("released shard %s (%d nodes dropped)", sid, dropped)
+
+    def quiesced(self) -> bool:
+        return (
+            self._transitions.empty()
+            and not self._transition_active
+            and all(c.queue.idle for c in self.controllers.values())
+        )
+
+    def setup(self, mgr: Manager) -> "LeasedNodePlane":
+        """Manager integration: metrics stamping + the degraded-mode
+        coupling the plane needs — NOT leadership gating.  Shard
+        Controllers spawn and die with their Leases, which are themselves
+        the authority; an apiserver outage fails their renewals, expires
+        the Leases, and the fences engage without the manager's help, so
+        the plane is deliberately not registered under the manager's
+        global-leader suspend loop."""
+        if self.metrics is None:
+            self.metrics = mgr.operator_metrics
+        return self

@@ -160,12 +160,22 @@ class Controller:
             await self.start()
 
     async def _worker(self) -> None:
+        spins = 0
         while True:
             wait_t0 = time.monotonic()
             try:
                 key = await self.queue.get()
             except wq.ShutDown:
                 return
+            # cooperative-yield backstop: get()'s non-empty fast path and a
+            # reconcile that short-circuits (e.g. a declined key on the
+            # sharded plane) can both complete without touching an
+            # unresolved future, so a long drain would otherwise run as ONE
+            # uninterrupted callback — starving timers, lease renewals, and
+            # shutdown.  Amortized to every 64 pops.
+            spins += 1
+            if spins % 64 == 0:
+                await asyncio.sleep(0)
             popped = time.monotonic()
             try:
                 if self.gate is not None:
@@ -206,6 +216,7 @@ class Manager:
         metrics_port: int = 8080,
         health_port: int = 8081,
         leader_elect: bool = False,
+        leader_wait: bool = True,
         metrics_registry=None,
         lease_duration: float = 15.0,
         renew_interval: float = 5.0,
@@ -223,6 +234,14 @@ class Manager:
         self.metrics_port = metrics_port
         self.health_port = health_port
         self.leader_elect = leader_elect
+        # block start() until this replica wins the global lease (the
+        # historical single-active behaviour).  The multi-replica sharded
+        # plane passes False: a standby replica must still serve its shard
+        # Leases, so start proceeds immediately and the supervisor keeps
+        # the leader-gated controllers suspended until leadership arrives
+        # (the client-wide leader fence guards writes either way; shard
+        # writes carry their own Lease-backed ambient fence).
+        self.leader_wait = leader_wait
         self.metrics_registry = metrics_registry
         # shared obs.trace.Tracer; its ring buffer backs /debug/traces
         self.tracer = tracer
@@ -314,7 +333,15 @@ class Manager:
             # at the moment the elector flips, not at supervisor cadence
             self.elector.on_transition.append(self._on_leadership)
             await self.elector.start()
-            await self.elector.is_leader.wait()
+            if self.leader_wait:
+                await self.elector.is_leader.wait()
+            else:
+                # standby replicas start paused: close the gate NOW so no
+                # leader-gated reconcile slips through before the first
+                # supervisor tick (resume flips it once leadership lands)
+                if not self.elector.is_leader.is_set():
+                    self._paused = True
+                    self._resume.clear()
         await self._start_http()
         # optional (cache-backing) informers start without blocking on sync:
         # an unserved GVK keeps retrying in the background while reads of it

@@ -18,6 +18,7 @@ is never misread as absent.
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import copy
 import time
@@ -29,6 +30,100 @@ from tpu_operator.k8s.client import ApiClient
 from tpu_operator.k8s.informer import Informer
 
 VERSION_TTL_SECONDS = 600.0
+
+
+class _UnionCache:
+    """Write-through router for a :class:`PartitionedView`: an object
+    written through lands in the part whose selector its labels match NOW
+    (and leaves any part it no longer matches — a shard re-stamp moves the
+    cached copy between views the same instant the write succeeds, without
+    waiting for the synthesized watch delete/add round trip)."""
+
+    def __init__(self, view: "PartitionedView"):
+        self._view = view
+
+    def __setitem__(self, key: tuple[str, str], obj: dict) -> None:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for part in self._view.parts.values():
+            if not part.synced.is_set():
+                continue
+            if selectors.matches(part.label_selector or "", labels):
+                part.cache[key] = obj
+            else:
+                part.cache.pop(key, None)
+
+    def pop(self, key: tuple[str, str], default=None):
+        out = default
+        for part in self._view.parts.values():
+            hit = part.cache.pop(key, None)
+            if hit is not None:
+                out = hit
+        return out
+
+
+class PartitionedView:
+    """Union read view over selector-partitioned informers of ONE kind.
+
+    The multi-replica sharded plane watches Nodes one owned shard at a
+    time (``label_selector=tpu.google.com/shard=<sid>``) plus an intake
+    view of not-yet-stamped nodes; no single informer can serve reads of
+    the kind, but their union is this replica's entire serviceable scope.
+    This composite presents the ``Informer`` read surface (``synced`` /
+    ``get`` / ``items`` / ``cache``) so a :class:`CachedReader` serves
+    node reads from the owned arcs; a read outside them simply misses and
+    falls back live — the CachedReader miss contract already covers it.
+
+    Honesty caveat (why the full manager never registers one of these):
+    ``items()``/``list`` answer with the UNION OF OWNED ARCS, not the
+    fleet.  Only consumers scoped to this replica's arcs — the per-node
+    delta reconciler, per-arc priming — may read through it; a full-walk
+    controller needs an unfiltered informer.
+    """
+
+    def __init__(self, group: str, kind: str):
+        self.group = group
+        self.kind = kind
+        # Informer-surface fields the CachedReader inspects: the union
+        # serves kind-wide point reads (scope-miss falls back live)
+        self.namespace: Optional[str] = None
+        self.label_selector: Optional[str] = None
+        self.required = False
+        self.parts: dict[str, Informer] = {}
+        self.synced = asyncio.Event()
+        self._cache = _UnionCache(self)
+
+    @property
+    def cache(self) -> _UnionCache:
+        return self._cache
+
+    def add_part(self, key: str, informer: Informer) -> None:
+        self.parts[key] = informer
+        if informer.synced.is_set():
+            self.synced.set()
+
+    def mark_synced(self) -> None:
+        """Called once a newly-added part finishes its first relist."""
+        if any(p.synced.is_set() for p in self.parts.values()):
+            self.synced.set()
+
+    def remove_part(self, key: str) -> Optional[Informer]:
+        part = self.parts.pop(key, None)
+        if not any(p.synced.is_set() for p in self.parts.values()):
+            self.synced.clear()
+        return part
+
+    def get(self, name: str, namespace: str = "") -> Optional[dict]:
+        for part in self.parts.values():
+            obj = part.get(name, namespace)
+            if obj is not None:
+                return obj
+        return None
+
+    def items(self) -> list[dict]:
+        out: list[dict] = []
+        for part in self.parts.values():
+            out.extend(part.items())
+        return out
 
 
 class CachedReader:
@@ -88,15 +183,26 @@ class CachedReader:
                 gauge.dec()
 
     # ------------------------------------------------------------------
-    async def get(self, group: str, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+    async def get(
+        self,
+        group: str,
+        kind: str,
+        name: str,
+        namespace: Optional[str] = None,
+        copy_result: bool = True,
+    ) -> dict:
         inf = self.informer_for(group, kind, namespace)
         if inf is not None:
             obj = inf.get(name, namespace or "")
             if obj is not None:
                 self._hit(kind)
                 # deepcopy: callers mutate (hash stamping, status edits) and
-                # must never write into the informer's store
-                return copy.deepcopy(obj)
+                # must never write into the informer's store.
+                # ``copy_result=False`` is the READ-ONLY fast path for
+                # per-key sweeps at fleet scale (the node delta reconciler
+                # reads thousands of nodes per resync and mutates none) —
+                # callers opting in must never write into the result.
+                return copy.deepcopy(obj) if copy_result else obj
             # absent from the store is NOT proof of absence (informer lag on
             # a fresh create); only a live GET may conclude NotFound
         self._miss(kind)

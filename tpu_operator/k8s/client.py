@@ -289,11 +289,18 @@ class ApiClient:
         are never replayed after an ambiguous failure — the apply layer's
         get/adopt path recovers instead of risking duplicate side effects.
         """
-        if self.fence is not None:
-            self.fence.check(method, path)
+        # The ambient (per-task) shard fence, when installed, REPLACES the
+        # client-wide leader fence for this request: a shard reconcile's
+        # authority is its shard Lease, not the manager's global lease — a
+        # replica that is not the global leader must still write for the
+        # shards it holds (multi-replica sharded plane), and the in-process
+        # plane's fence predicate folds the manager's leadership back in
+        # via NodePlane.write_gate, so no path weakens.
         ambient_fence = _REQUEST_FENCE.get()
         if ambient_fence is not None:
             ambient_fence.check(method, path)
+        elif self.fence is not None:
+            self.fence.check(method, path)
         policy = _REQUEST_POLICY.get() or self.retry_policy
         deadline = (
             time.monotonic() + policy.total_timeout
@@ -477,6 +484,33 @@ class ApiClient:
             params["continue"] = continue_token
         return await self._request("GET", path, params=params)
 
+    async def iter_pages(
+        self,
+        group: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        page_size: int = consts.LIST_PAGE_SIZE,
+    ) -> AsyncIterator[dict]:
+        """Chunked listing as an async page stream: consumers that only
+        need to SEE each item (the sharded plane's intake sweeps, lean
+        informer relists) process one ``limit``-sized page at a time
+        instead of materializing the fleet — at 100k nodes the assembled
+        listing alone is hundreds of MB per consumer, the exact spike the
+        partitioned-RSS bound forbids.  A mid-pagination 410 (continue
+        token expired) propagates; relist-from-scratch is the protocol
+        answer."""
+        continue_token: Optional[str] = None
+        while True:
+            page = await self.list(
+                group, kind, namespace, label_selector,
+                limit=page_size, continue_token=continue_token,
+            )
+            yield page
+            continue_token = (page.get("metadata") or {}).get("continue")
+            if not continue_token:
+                return
+
     async def list_paged(
         self,
         group: str,
@@ -490,20 +524,15 @@ class ApiClient:
         returned dict mimics a single List (items + the FINAL page's
         resourceVersion — on a real apiserver every chunk is served at the
         first page's snapshot rv, so any page's rv is the listing's rv).
-        A mid-pagination 410 (continue token expired) propagates to the
-        caller, whose relist-from-scratch path is the protocol answer."""
+        Prefer :meth:`iter_pages` when items are processed-and-dropped."""
         items: list[dict] = []
-        continue_token: Optional[str] = None
-        while True:
-            page = await self.list(
-                group, kind, namespace, label_selector,
-                limit=page_size, continue_token=continue_token,
-            )
+        page: dict = {}
+        async for page in self.iter_pages(
+            group, kind, namespace, label_selector, page_size
+        ):
             items.extend(page.get("items", []))
-            continue_token = (page.get("metadata") or {}).get("continue")
-            if not continue_token:
-                page["items"] = items
-                return page
+        page["items"] = items
+        return page
 
     async def list_items(self, *args, **kwargs) -> list[dict]:
         return (await self.list(*args, **kwargs)).get("items", [])

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import sys
 import time
 from typing import Awaitable, Callable, Optional
 
@@ -38,6 +39,7 @@ class Informer:
         resync_seconds: float = 600.0,
         required: bool = True,
         page_size: Optional[int] = None,
+        cache_objects: bool = True,
     ):
         self.client = client
         self.group = group
@@ -45,6 +47,14 @@ class Informer:
         self.namespace = namespace
         self.label_selector = label_selector
         self.resync_seconds = resync_seconds
+        # cache_objects=False = event tap: handlers fire but nothing is
+        # retained (every relist re-dispatches ADDED for all items).  The
+        # sharded plane's intake watch (`!shard` — nodes not yet stamped
+        # into an arc) uses this: during a 100k-node mass join EVERY
+        # replica sees every unstamped node, and caching them would give
+        # each replica a transient full-fleet RSS spike — the exact thing
+        # partitioned views exist to prevent.
+        self.cache_objects = cache_objects
         # LIST chunk size for relists (None -> consts.LIST_PAGE_SIZE);
         # injectable so tests can force multi-page relists on small fleets
         self.page_size = page_size
@@ -61,11 +71,34 @@ class Informer:
     def add_handler(self, handler: Handler) -> None:
         self.handlers.append(handler)
 
+    @staticmethod
+    def _intern_strings(obj):
+        """Dedup the strings a cached object is made of: every node in a
+        25k-node arc repeats the same ~25 label keys (and most values —
+        "true", counts, pool names), and ``json.loads`` materializes a
+        fresh str per occurrence.  Interning at ingest collapses them to
+        one instance each, cutting tens of MB per replica at fleet scale
+        (the partitioned-views RSS bound is measured against this cache)."""
+        if isinstance(obj, dict):
+            return {
+                (sys.intern(k) if type(k) is str else k):
+                    Informer._intern_strings(v)
+                for k, v in obj.items()
+            }
+        if isinstance(obj, list):
+            return [Informer._intern_strings(x) for x in obj]
+        if type(obj) is str and len(obj) <= 64:
+            return sys.intern(obj)
+        return obj
+
     def _stamp(self, item: dict) -> dict:
         """LIST responses omit per-item TypeMeta on a real apiserver (it
         lives on the List object); cache consumers — readiness checks,
         update_status path building — need it, so stamp at ingest exactly
-        like the live-list path in state/skel.py does."""
+        like the live-list path in state/skel.py does.  Cached ingest also
+        string-interns the object (see _intern_strings)."""
+        if self.cache_objects:
+            item = self._intern_strings(item)
         item.setdefault("kind", self.kind)
         try:
             item.setdefault("apiVersion", obj_api.lookup(self.group, self.kind).gvk.api_version)
@@ -125,31 +158,60 @@ class Informer:
                 # paginated relist (limit/continue): a 10k-object listing
                 # streams in LIST_PAGE_SIZE chunks; a continue token that
                 # expires mid-pagination surfaces as a 410, handled below by
-                # the same relist-from-scratch branch as a watch expiry
-                listing = await self.client.list_paged(
+                # the same relist-from-scratch branch as a watch expiry.
+                # Pages are consumed AS A STREAM — an event-tap informer
+                # (cache_objects=False) dispatches each page and drops it,
+                # so a 100k-object relist never materializes in its RSS.
+                rv = None
+                fresh: dict[tuple[str, str], dict] = {}
+                async for page in self.client.iter_pages(
                     self.group, self.kind, self.namespace, self.label_selector,
                     page_size=self.page_size or consts.LIST_PAGE_SIZE,
-                )
-                rv = listing.get("metadata", {}).get("resourceVersion")
-                fresh: dict[tuple[str, str], dict] = {}
-                for item in listing.get("items", []):
-                    meta = item.get("metadata", {})
-                    fresh[(meta.get("namespace", ""), meta["name"])] = self._stamp(item)
-                # diff against cache → synthetic events; keep the cache
-                # consistent with each event *before* handlers observe it
-                for key, item in fresh.items():
-                    old = self.cache.get(key)
-                    if old is None:
-                        self.cache[key] = item
-                        await self._dispatch("ADDED", item)
-                    elif old.get("metadata", {}).get("resourceVersion") != item["metadata"].get("resourceVersion"):
-                        self.cache[key] = item
-                        await self._dispatch("MODIFIED", item)
-                for key, old in list(self.cache.items()):
-                    if key not in fresh:
-                        del self.cache[key]
-                        await self._dispatch("DELETED", old)
-                self.synced.set()
+                ):
+                    rv = page.get("metadata", {}).get("resourceVersion") or rv
+                    if not self.cache_objects:
+                        for item in page.get("items", []):
+                            await self._dispatch("ADDED", self._stamp(item))
+                        continue
+                    for item in page.get("items", []):
+                        meta = item.get("metadata", {})
+                        fresh[(meta.get("namespace", ""), meta["name"])] = self._stamp(item)
+                # large-relist etiquette: awaiting a handler that never
+                # suspends does NOT yield to the loop, so a 25k-item diff
+                # would run as one synchronous slab — starving everything
+                # else on the loop (on a shard replica, the Lease renewals
+                # whose expiry deposes it).  Breathe every few hundred.
+                dispatched = 0
+
+                async def _breathe():
+                    nonlocal dispatched
+                    dispatched += 1
+                    if dispatched % 256 == 0:
+                        await asyncio.sleep(0)
+
+                if not self.cache_objects:
+                    # event tap: items were already announced page by page
+                    # above (handlers own dedup); nothing is retained
+                    self.synced.set()
+                else:
+                    # diff against cache → synthetic events; keep the cache
+                    # consistent with each event *before* handlers observe it
+                    for key, item in fresh.items():
+                        old = self.cache.get(key)
+                        if old is None:
+                            self.cache[key] = item
+                            await self._dispatch("ADDED", item)
+                            await _breathe()
+                        elif old.get("metadata", {}).get("resourceVersion") != item["metadata"].get("resourceVersion"):
+                            self.cache[key] = item
+                            await self._dispatch("MODIFIED", item)
+                            await _breathe()
+                    for key, old in list(self.cache.items()):
+                        if key not in fresh:
+                            del self.cache[key]
+                            await self._dispatch("DELETED", old)
+                            await _breathe()
+                    self.synced.set()
                 watch_started = time.monotonic()
                 async for evt in self.client.watch(
                     self.group,
@@ -173,11 +235,19 @@ class Informer:
                     served = True
                     meta = evt.object.get("metadata", {})
                     key = (meta.get("namespace", ""), meta.get("name", ""))
-                    if evt.type == "DELETED":
+                    # dispatch the SAME object _stamp returned: on the cached
+                    # path interning copies, so stamping the copy and
+                    # dispatching the original would hand handlers an
+                    # un-TypeMeta'd object on live watch events only
+                    obj = evt.object
+                    if not self.cache_objects:
+                        self._stamp(obj)
+                    elif evt.type == "DELETED":
                         self.cache.pop(key, None)
                     else:
-                        self.cache[key] = self._stamp(evt.object)
-                    await self._dispatch(evt.type, evt.object)
+                        obj = self._stamp(obj)
+                        self.cache[key] = obj
+                    await self._dispatch(evt.type, obj)
             except asyncio.CancelledError:
                 raise
             except ApiError as e:

@@ -12,6 +12,7 @@ import asyncio
 import datetime
 import logging
 import os
+import random
 import socket
 import time as _time
 from typing import Callable, Optional
@@ -26,6 +27,14 @@ log = logging.getLogger("tpu_operator.k8s.leader")
 # the manager hooks these to fence writers / emit Events (client-go's
 # LeaderCallbacks OnStartedLeading/OnStoppedLeading analogue)
 TransitionCallback = Callable[[bool], None]
+
+# Renewal jitter: each renew tick sleeps interval x U(1-j, 1+j).  With the
+# multi-replica sharded plane every replica runs one candidacy per shard
+# Lease (N replicas x NODE_SHARDS leases), and un-jittered ticks align into
+# synchronized renewal bursts against the apiserver; the jitter keeps the
+# candidacies spread while never eating into the renew-deadline ordering
+# (interval * 1.1 stays well under the default 2/3-duration deadline).
+RENEW_JITTER = 0.1
 
 
 def _now() -> str:
@@ -86,6 +95,46 @@ class LeaderElector:
             per_try_timeout=max(0.05, self.renew_interval * 0.9),
             total_timeout=max(0.05, self.renew_interval * 0.9),
         )
+        # per-elector RNG: seeding off the (unique) identity + lease name
+        # would correlate replicas that share a hostname template, so use
+        # an independently-seeded instance per candidacy
+        self._jitter_rng = random.Random()
+        # Soft anti-affinity hook (multi-replica sharded plane): while
+        # ``defer_acquire`` returns True this candidacy holds back from
+        # taking a lease it does not already hold for ``acquire_defer``
+        # seconds, giving less-loaded replicas first claim — then takes it
+        # anyway, so an orphaned shard is never stranded behind a full
+        # peer (bounded takeover: defer + renew cadence).  Renewals of a
+        # HELD lease are never deferred.
+        self.defer_acquire: Optional[Callable[[], bool]] = None
+        self.acquire_defer = lease_duration * 2.0
+        self._defer_until: Optional[float] = None
+        # Shared across one replica's candidacies: serializes ACQUISITION
+        # attempts (renewals skip it) so the defer_acquire load check sees
+        # each prior acquisition land before the next candidacy consults
+        # it — without this, N parallel first ticks all read "0 held" and
+        # one replica grabs every shard Lease at startup.
+        self.acquire_lock: Optional[asyncio.Lock] = None
+
+    def _deferring(self) -> bool:
+        if self.defer_acquire is None or not self.defer_acquire():
+            self._defer_until = None
+            return False
+        now = _time.monotonic()
+        if self._defer_until is None:
+            self._defer_until = now + self.acquire_defer
+        return now < self._defer_until
+
+    def _renew_sleep(self) -> float:
+        """Next renew-tick sleep: the base cadence (halved while not
+        leader, so a waiting candidate notices an expiry promptly) spread
+        by ±RENEW_JITTER so many candidacies never renew in lockstep."""
+        base = (
+            self.renew_interval
+            if self.is_leader.is_set()
+            else self.renew_interval / 2
+        )
+        return base * self._jitter_rng.uniform(1 - RENEW_JITTER, 1 + RENEW_JITTER)
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._run(), name="leader-elector")
@@ -160,9 +209,15 @@ class LeaderElector:
                 ):
                     log.warning("renew deadline exceeded; stepping down (%s)", self.identity)
                     self._set_leader(False)
-            await asyncio.sleep(self.renew_interval if self.is_leader.is_set() else self.renew_interval / 2)
+            await asyncio.sleep(self._renew_sleep())
 
     async def _try_acquire_or_renew(self) -> bool:
+        if not self.is_leader.is_set() and self.acquire_lock is not None:
+            async with self.acquire_lock:
+                return await self._acquire_or_renew()
+        return await self._acquire_or_renew()
+
+    async def _acquire_or_renew(self) -> bool:
         spec = {
             "holderIdentity": self.identity,
             "leaseDurationSeconds": int(self.lease_duration),
@@ -173,6 +228,8 @@ class LeaderElector:
         except ApiError as e:
             if not e.not_found:
                 raise
+            if self._deferring():
+                return False
             lease = {
                 "apiVersion": "coordination.k8s.io/v1",
                 "kind": "Lease",
@@ -181,6 +238,7 @@ class LeaderElector:
             }
             try:
                 await self.client.create(lease)
+                self._defer_until = None
                 return True
             except ApiError as e2:
                 if e2.already_exists:
@@ -199,13 +257,20 @@ class LeaderElector:
             expired = age > lease["spec"].get("leaseDurationSeconds", self.lease_duration)
         if holder == self.identity or holder is None or expired:
             if holder != self.identity:
+                if self._deferring():
+                    return False
                 spec["acquireTime"] = spec["renewTime"]
             lease["spec"].update(spec)
             try:
                 await self.client.update(lease)
+                self._defer_until = None
                 return True
             except ApiError as e:
                 if e.conflict:
                     return False
                 raise
+        # legitimately held by an unexpired peer: any deferral window we
+        # were running is over — the NEXT free episode starts a fresh one
+        # (a stale expired window would let a full replica take instantly)
+        self._defer_until = None
         return False

@@ -286,6 +286,24 @@ class OperatorMetrics:
             "hash ring reassigned the key mid-reconcile (each one is a "
             "double-actuation that did NOT happen)",
         )
+        # multi-replica sharded plane (controllers/plane.py
+        # LeasedNodePlane; docs/PERFORMANCE.md "Multi-replica sharding"):
+        # cross-pod shard ownership via one Lease per shard.  Label space
+        # bounded by consts.NODE_SHARDS.
+        self.shard_lease_held = Gauge(
+            "tpu_operator_shard_lease_held",
+            "1 while this replica holds the shard's Lease (and therefore "
+            "runs its Controller and caches its arc), else 0",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.shard_lease_transitions_total = Counter(
+            "tpu_operator_shard_lease_transitions_total",
+            "Shard-Lease acquisitions and losses on this replica, per "
+            "direction (every loss fences the shard's in-flight writes)",
+            ["shard", "direction"],
+            registry=self.registry,
+        )
         # fleet telemetry plane (obs/fleet.py): windowed fleet rollups +
         # aggregator health.  Only ROLLUPS are exported — per-node series
         # stay inside the ring so operator-registry cardinality is bounded

@@ -11,6 +11,7 @@ matching nodes and drives pod/DaemonSet readiness.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import copy
 import json
 import logging
@@ -64,22 +65,61 @@ class Store:
         self.objects: dict[tuple[str, str], dict] = {}  # (ns, name) -> obj
         # (queue, ns, parsed selector requirements)
         self.watchers: list[tuple[asyncio.Queue, Optional[str], list[selectors.Requirement]]] = []
-        self.events: deque[tuple[int, dict]] = deque(maxlen=2048)  # (rv, event)
+        # (rv, event, pre-update labels or None) — the old labels let
+        # selector-filtered watch delivery synthesize view transitions
+        self.events: deque[tuple[int, dict, Optional[dict]]] = deque(maxlen=2048)
+        # sorted-key snapshot for list/list_page (see _keys_sorted)
+        self._sorted_keys: Optional[list[tuple[str, str]]] = None
 
     def key(self, namespace: Optional[str], name: str) -> tuple[str, str]:
         return (namespace or "", name)
 
-    def _notify(self, event_type: str, obj: dict) -> None:
+    @staticmethod
+    def _view_event(
+        evt: dict,
+        old_labels: Optional[dict],
+        ns: Optional[str],
+        parsed_sel: list[selectors.Requirement],
+    ) -> Optional[dict]:
+        """What one watcher sees for one store event — real-apiserver
+        label-selector watch semantics: a MODIFIED whose label change moves
+        the object OUT of the watcher's view is delivered as DELETED (last
+        visible state), one that moves it IN is delivered as ADDED, and a
+        change invisible to the selector is not delivered at all.  This is
+        what lets a partitioned informer (one view per operator shard)
+        track a node whose ``tpu.google.com/shard`` label is re-stamped:
+        the old shard's view sees a delete, the new shard's view an add."""
+        obj = evt["object"]
+        if ns and obj["metadata"].get("namespace") != ns:
+            return None
+        if not parsed_sel:
+            return evt
+        labels = obj["metadata"].get("labels") or {}
+        matched = all(r.matches(labels) for r in parsed_sel)
+        if evt["type"] != "MODIFIED" or old_labels is None:
+            return evt if matched else None
+        was = all(r.matches(old_labels) for r in parsed_sel)
+        if was and matched:
+            return evt
+        if was and not matched:
+            return {"type": "DELETED", "object": obj}
+        if matched:
+            return {"type": "ADDED", "object": obj}
+        return None
+
+    def _notify(self, event_type: str, obj: dict, old: Optional[dict] = None) -> None:
         rv = int(obj["metadata"]["resourceVersion"])
         evt = {"type": event_type, "object": copy.deepcopy(obj)}
-        self.events.append((rv, evt))
+        old_labels = (
+            copy.deepcopy(old["metadata"].get("labels") or {})
+            if old is not None
+            else None
+        )
+        self.events.append((rv, evt, old_labels))
         for queue, ns, parsed_sel in list(self.watchers):
-            if ns and obj["metadata"].get("namespace") != ns:
-                continue
-            labels = obj["metadata"].get("labels") or {}
-            if parsed_sel and not all(r.matches(labels) for r in parsed_sel):
-                continue
-            queue.put_nowait(evt)
+            delivery = self._view_event(evt, old_labels, ns, parsed_sel)
+            if delivery is not None:
+                queue.put_nowait(delivery)
 
     # -- CRUD ----------------------------------------------------------
     def _admit(self, obj: dict, old: Optional[dict] = None) -> None:
@@ -122,6 +162,7 @@ class Store:
         obj.setdefault("apiVersion", self.info.gvk.api_version)
         obj.setdefault("kind", self.info.gvk.kind)
         self.objects[k] = obj
+        self._sorted_keys = None
         # duplicate-side-effect ledger: the chaos soak asserts no (kind,
         # ns, name) is ever successfully created twice under fault storms
         ck = (self.info.plural, meta.get("namespace", "") or "", name)
@@ -179,7 +220,7 @@ class Store:
             return existing
         merged["metadata"]["resourceVersion"] = str(self.cluster.next_rv())
         self.objects[self.key(namespace, name)] = merged
-        self._notify("MODIFIED", merged)
+        self._notify("MODIFIED", merged, old=existing)
         return merged
 
     def patch(self, namespace: Optional[str], name: str, patch: Any, status_only: bool = False) -> dict:
@@ -195,11 +236,21 @@ class Store:
     def delete(self, namespace: Optional[str], name: str) -> dict:
         obj = self.get(namespace, name)
         del self.objects[self.key(namespace, name)]
+        self._sorted_keys = None
         obj = copy.deepcopy(obj)
         obj["metadata"]["resourceVersion"] = str(self.cluster.next_rv())
         self._notify("DELETED", obj)
         self.cluster.collect_garbage(obj["metadata"]["uid"])
         return obj
+
+    def _keys_sorted(self) -> list[tuple[str, str]]:
+        """Sorted key snapshot, cached until membership changes: at 100k
+        objects a per-request sort is the difference between a usable
+        multi-replica bench and a control plane that starves its own
+        clients (create/delete invalidate; updates keep the key set)."""
+        if self._sorted_keys is None or len(self._sorted_keys) != len(self.objects):
+            self._sorted_keys = sorted(self.objects)
+        return self._sorted_keys
 
     def list(
         self,
@@ -209,8 +260,11 @@ class Store:
     ) -> list[dict]:
         out = []
         reqs = selectors.parse(label_selector) if label_selector else []
-        for (ns, _), obj in sorted(self.objects.items()):
-            if namespace and ns != namespace:
+        for key in self._keys_sorted():
+            obj = self.objects.get(key)
+            if obj is None:
+                continue
+            if namespace and key[0] != namespace:
                 continue
             labels = obj["metadata"].get("labels") or {}
             if reqs and not all(r.matches(labels) for r in reqs):
@@ -219,6 +273,49 @@ class Store:
                 continue
             out.append(obj)
         return out
+
+    def list_page(
+        self,
+        namespace: Optional[str],
+        label_selector: str,
+        field_selector: str,
+        limit: int,
+        after_key: Optional[list],
+    ) -> tuple[list[dict], Optional[list]]:
+        """One ``limit``-sized page starting AFTER ``after_key``: bisect
+        into the sorted key snapshot and scan forward only until the page
+        fills, so a full chunked relist costs one pass over the store
+        total — not one pass per page (O(pages x store), the quadratic
+        that pinned the fake apiserver at 100 % CPU during 100k-node
+        multi-replica relists)."""
+        keys = self._keys_sorted()
+        start = 0
+        if after_key:
+            start = bisect.bisect_right(keys, tuple(after_key))
+        reqs = selectors.parse(label_selector) if label_selector else []
+        page: list[dict] = []
+        last_key: Optional[list] = None
+        for idx in range(start, len(keys)):
+            key = keys[idx]
+            obj = self.objects.get(key)
+            if obj is None:
+                continue
+            if namespace and key[0] != namespace:
+                continue
+            labels = obj["metadata"].get("labels") or {}
+            if reqs and not all(r.matches(labels) for r in reqs):
+                continue
+            if field_selector and not _match_fields(field_selector, obj):
+                continue
+            page.append(obj)
+            if len(page) == limit:
+                last_key = list(key)
+                # continuation is only meaningful if anything matches past
+                # this point; a dangling token costs one empty page, fine
+                if idx + 1 < len(keys):
+                    return page, last_key
+                return page, None
+        return page, None
 
 
 class ApiException(Exception):
@@ -459,7 +556,9 @@ class FakeCluster:
         app.router.add_get("/version", self._handle_version)
         app.router.add_route("*", "/api/v1/{rest:.*}", self._handle_core)
         app.router.add_route("*", "/apis/{group}/{version}/{rest:.*}", self._handle_group)
-        self._runner = web.AppRunner(app, shutdown_timeout=1.0)
+        # access_log=None: at bench scale the per-request access-log line
+        # (formatted eagerly) costs more than serving the request
+        self._runner = web.AppRunner(app, shutdown_timeout=1.0, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
         await site.start()
@@ -608,9 +707,28 @@ class FakeCluster:
         if request.method == "GET" and q.get("watch") in ("1", "true"):
             return await self._serve_watch(request, store, namespace)
         if request.method == "GET":
-            items = copy.deepcopy(
-                store.list(namespace, q.get("labelSelector", ""), q.get("fieldSelector", ""))
-            )
+            meta: dict = {"resourceVersion": str(self._rv)}
+            limit = q.get("limit", "")
+            token = q.get("continue", "")
+            if limit or token:
+                # chunked listing is incremental END TO END: bisect to the
+                # continuation key, scan forward one page, deep-copy only
+                # that page.  (The first cut listed+copied the whole store
+                # per page — O(pages x store) work that pinned the fake
+                # apiserver at 100% CPU under 100k-node multi-replica
+                # relists and starved the replicas' Lease renewals.)
+                items, cont = self._paginate(
+                    store, namespace,
+                    q.get("labelSelector", ""), q.get("fieldSelector", ""),
+                    limit, token,
+                )
+                if cont:
+                    meta["continue"] = cont
+            else:
+                items = store.list(
+                    namespace, q.get("labelSelector", ""), q.get("fieldSelector", "")
+                )
+            items = copy.deepcopy(items)
             # real-apiserver fidelity: per-item TypeMeta is omitted in LIST
             # responses (kind/apiVersion live on the List object) — consumers
             # that need it must stamp it themselves (informer ingest,
@@ -618,13 +736,6 @@ class FakeCluster:
             for item in items:
                 item.pop("kind", None)
                 item.pop("apiVersion", None)
-            meta: dict = {"resourceVersion": str(self._rv)}
-            limit = q.get("limit", "")
-            token = q.get("continue", "")
-            if limit or token:
-                items, cont = self._paginate(store, items, limit, token)
-                if cont:
-                    meta["continue"] = cont
             return web.json_response(
                 {
                     "kind": store.info.gvk.kind + "List",
@@ -644,9 +755,15 @@ class FakeCluster:
         raise ApiException(405, "MethodNotAllowed", request.method)
 
     def _paginate(
-        self, store: Store, items: list[dict], limit: str, token: str
+        self,
+        store: Store,
+        namespace: Optional[str],
+        label_selector: str,
+        field_selector: str,
+        limit: str,
+        token: str,
     ) -> tuple[list[dict], Optional[str]]:
-        """limit/continue chunking over the (sorted) listing.
+        """limit/continue chunking (``Store.list_page`` does the scan).
 
         The continue token is opaque to clients: base64 of the snapshot rv
         + the LAST SERVED (ns, name) key — continuation is key-based, as on
@@ -664,10 +781,7 @@ class FakeCluster:
         except ValueError:
             raise ApiException(400, "BadRequest", f"invalid limit {limit!r}")
 
-        def item_key(it: dict) -> list:
-            meta = it.get("metadata", {})
-            return [meta.get("namespace", "") or "", meta.get("name", "")]
-
+        after_key: Optional[list] = None
         if token:
             try:
                 rv0, after_key = json.loads(base64.b64decode(token))
@@ -679,14 +793,15 @@ class FakeCluster:
                     410, "Expired",
                     "The provided continue parameter is too old",
                 )
-            items = [it for it in items if item_key(it) > after_key]
         else:
             rv0 = self._rv
-        page = items[:n] if n > 0 else items
+        page, last_key = store.list_page(
+            namespace, label_selector, field_selector, n, after_key
+        )
         cont: Optional[str] = None
-        if n > 0 and len(items) > n:
+        if last_key is not None:
             cont = base64.b64encode(
-                json.dumps([rv0, item_key(page[-1])]).encode()
+                json.dumps([rv0, last_key]).encode()
             ).decode()
         return page, cont
 
@@ -739,16 +854,14 @@ class FakeCluster:
         await resp.prepare(request)
         queue: asyncio.Queue = asyncio.Queue()
         parsed_sel = selectors.parse(selector) if selector else []
-        # replay buffered events newer than rv0
-        for rv, evt in list(store.events):
+        # replay buffered events newer than rv0 (same per-view transition
+        # synthesis as live delivery, so a resuming partitioned informer
+        # still observes label-driven view moves it was disconnected for)
+        for rv, evt, old_labels in list(store.events):
             if rv > rv0:
-                obj = evt["object"]
-                if namespace and obj["metadata"].get("namespace") != namespace:
-                    continue
-                labels = obj["metadata"].get("labels") or {}
-                if parsed_sel and not all(r.matches(labels) for r in parsed_sel):
-                    continue
-                queue.put_nowait(evt)
+                delivery = Store._view_event(evt, old_labels, namespace, parsed_sel)
+                if delivery is not None:
+                    queue.put_nowait(delivery)
         store.watchers.append((queue, namespace, parsed_sel))
         try:
             while True:
