@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -163,6 +163,17 @@ chaos-health:
 # never restored (docs/ROBUSTNESS.md "Live migration")
 chaos-migrate:
 	$(SAN_ENV) $(PYTHON) bench.py --chaos-migrate --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# elastic-scheduler acceptance soak (chip-free; ~2 min): sustained
+# TPUSliceRequest allocation/release churn with chaos quarantines
+# mid-churn on a 100-node mixed-generation fake cluster — gated on
+# placement-latency p99 and fragmentation returning to baseline, with a
+# defrag compaction proven ZERO-LOSS: a real CPU-backend training job is
+# checkpointed, resharded 4x4 -> 2x4 onto the consolidated arc, and
+# resumes at its checkpointed step with zero duplicate creations and the
+# steady state back to zero verbs/pass (docs/SCHEDULING.md)
+slice-churn:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --slice-churn --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must

@@ -273,21 +273,27 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
             renew_deadline=2.0, recorder=recorder, operator_metrics=metrics,
         )
         reconciler = ClusterPolicyReconciler(client, NS, metrics=metrics, recorder=recorder)
-        # the soak runs on the SHARDED delta plane (ISSUE 10): node events
-        # ride hash-ring worker shards, and a mid-soak shard handoff below
-        # must cause zero duplicate creations (shard write fences)
+        # the soak runs on the LEASE-OWNED sharded plane (ISSUE 13/14):
+        # shard ownership is per-shard coordination Leases exactly as the
+        # multi-replica deployment runs it — this single manager holds
+        # every Lease, node events ride hash-ring worker shards, and the
+        # mid-soak shard handoff below must cause zero duplicate creations
+        # (shard write fences back the Lease holdership)
         from tpu_operator.controllers.nodes import NodeReconciler
-        from tpu_operator.controllers.plane import NodePlane
+        from tpu_operator.controllers.plane import LeasedNodePlane
 
-        plane = NodePlane(
+        plane = LeasedNodePlane(
+            client,
             NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            NS,
             metrics=metrics, resync_seconds=20.0,
-        )
-        plane.setup(mgr)
+            lease_duration=3.0, renew_interval=0.5,
+        ).setup(mgr)
         reconciler.setup(mgr, plane=plane)
         result: dict = {"nodes": n_nodes, "seed": seed, "error_rate": error_rate}
         try:
             async with mgr:
+                await plane.start()
                 await client.create(TPUClusterPolicy.new().obj)
                 for i in range(n_nodes):
                     s, h = divmod(i, 4)
@@ -407,6 +413,9 @@ async def _chaos_soak(n_nodes: int, seed: int, error_rate: float) -> dict:
                 result["event_reasons"] = sorted(wanted & reasons)
                 result["missing_event_reasons"] = sorted(wanted - reasons)
         finally:
+            # the leased plane's electors/informers live outside the
+            # manager's controller set; settle them before the client goes
+            await plane.stop()
             await client.close()
 
         result["duplicate_creations"] = {
@@ -1181,6 +1190,646 @@ def run_chaos_migrate_soak(n_nodes: int = 100, seed: int = 1) -> dict:
         f"(mesh 4x4 -> 2x4: {result.get('restore_mesh_shrunk')}), "
         f"migrations {result.get('migrations')}, "
         f"evictions {result.get('evictions')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
+SLICE_CHURN_TIMEOUT = 300.0
+# placement-latency p99 gate over the soak's sustained churn: event-driven
+# binds land sub-second; a request that must wait for a release waits one
+# churn beat plus the 5s pending-revisit cadence at worst
+CHURN_PLACEMENT_P99_S = 10.0
+# final fragmentation must return to the empty-fleet baseline (the fleet's
+# shape mix sets the floor; churn+defrag must not leave capacity stranded)
+CHURN_FRAG_SLACK = 0.05
+
+
+async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
+    """The elastic-scheduler acceptance soak (`make slice-churn`;
+    docs/SCHEDULING.md).
+
+    A 100-node fake cluster (one 4x4 pool, eight 2x4 pools, mixed
+    v5e/v5p single-host 2x2s) converges under the real manager with the
+    slice scheduler live, then:
+
+    - **churn** — seeded sustained TPUSliceRequest allocation/release
+      traffic (exact fits, elastic ranges, generation pins, DCN
+      multislice splits) while chaos quarantines nodes mid-churn —
+      including a node under a BOUND grant, forcing the
+      preempt→re-place path; gated on placement-latency p99 (fleet
+      rollup) and on every stamp garbage-collecting after release;
+    - **defrag, zero-loss** — a REAL training job (workloads/checkpoint
+      sharded SGD, CPU backend) bound via its slice request to the 4x4
+      arc; freeing a smaller 2x4 arc pushes fragmentation over the
+      threshold and the scheduler must compact the grant through the
+      migration machine: checkpoint → reshard 4x4→2x4 → restore on the
+      consolidated box, resuming at the checkpointed step with zero
+      duplicate creations and no non-migrated eviction;
+    - **steady state** — once settled, a policy pass and a scheduler
+      pass must each cost zero API verbs, and fragmentation must be
+      back at the empty-fleet baseline.
+    """
+    import random
+    import subprocess
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, SLICE_REQUEST_KIND, State,
+        TPUClusterPolicy, TPUSliceRequest,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.explain import ExplainEngine
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.testing import FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get, topology_chips
+
+    rng = random.Random(seed)
+    workdir = tempfile.mkdtemp(prefix="slice-churn-")
+    job_procs: dict[str, subprocess.Popen] = {}
+    signal_files: dict[str, str] = {}
+
+    def _train_executor(pod: dict) -> str:
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "train-job":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        topo = env.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={topology_chips(topo)}"
+        )
+        sig = os.path.join(workdir, f"{name}.annotations")
+        signal_files[name] = sig
+        env[consts.MIGRATE_SIGNAL_FILE_ENV] = sig
+        env["TPU_VALIDATION_ROOT"] = os.path.join(workdir, f"vroot-{name}")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.checkpoint"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            return "Failed"
+        job_procs[name] = proc
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return "Failed"
+        return "Succeeded" if proc.returncode == 0 else "Failed"
+
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_train_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        fleet = FleetAggregator(metrics)
+        tracer = Tracer(metrics, fleet=fleet)
+        recorder = EventRecorder(client, NS)
+        explain = ExplainEngine(fleet=fleet, tracer=tracer)
+        recorder.sink = explain.observe_event
+        mgr = Manager(
+            client, NS, metrics_port=-1, health_port=-1,
+            recorder=recorder, operator_metrics=metrics, tracer=tracer,
+            fleet=fleet, explain=explain,
+        )
+        obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
+        reconciler = ClusterPolicyReconciler(
+            client, NS, fleet=fleet, explain=explain, **obs
+        )
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            metrics=metrics, resync_seconds=20.0,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
+        sched = SliceSchedulerReconciler(client, NS, fleet=fleet, **obs)
+        sched.setup(mgr)
+
+        async def _mirror_annotations() -> None:
+            pod_store = fc.store("", "pods")
+            while True:
+                for (_, name), pod in list(pod_store.objects.items()):
+                    sig = signal_files.get(name)
+                    if not sig:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    text = "".join(
+                        f'{k}="{v}"\n' for k, v in sorted(anns.items())
+                    )
+                    try:
+                        with open(sig) as f:
+                            current = f.read()
+                    except OSError:
+                        current = None
+                    if current != text:
+                        tmp = sig + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(text)
+                        os.replace(tmp, sig)
+                await asyncio.sleep(0.05)
+
+        mirror = asyncio.create_task(_mirror_annotations())
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "migration": {"timeoutSeconds": 30},
+                    "scheduling": {"defragThreshold": 0.3},
+                    "remediation": {"enabled": False},
+                }).obj)
+                # fleet shape: ONE 4x4 pool (the big contiguous box the
+                # defrag phase frees), eight 2x4 pools, and mixed-
+                # generation single-host 2x2s filling out the count
+                mids = 8
+                names_by_pool: dict[str, list] = {}
+                for h in range(4):
+                    name = f"big-0-{h}"
+                    names_by_pool.setdefault("pool-big-0", []).append(name)
+                    fc.add_node(name, topology="4x4", labels={
+                        consts.GKE_NODEPOOL_LABEL: "pool-big-0",
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                    })
+                for s in range(mids):
+                    for h in range(2):
+                        name = f"mid-{s}-{h}"
+                        names_by_pool.setdefault(f"pool-mid-{s}", []).append(name)
+                        fc.add_node(name, topology="2x4", labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-mid-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        })
+                n_small = max(0, n_nodes - 4 - 2 * mids)
+                small_names = []
+                for i in range(n_small):
+                    accel = (
+                        "tpu-v5p-slice" if i % 6 == 0
+                        else "tpu-v5-lite-podslice"
+                    )
+                    name = f"small-{i}"
+                    small_names.append(name)
+                    fc.add_node(name, topology="2x2", accelerator=accel)
+
+                async def _converged() -> bool:
+                    cr = await client.get(GROUP, CLUSTER_POLICY_KIND, "cluster-policy")
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > SLICE_CHURN_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-churn")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                frag_baseline = _gauge_value(
+                    metrics, "tpu_operator_slice_fragmentation_ratio"
+                )
+                # a scheduler pass has run by now (informer kicks); the
+                # empty-fleet ratio is this fleet shape's floor
+                result["frag_baseline"] = frag_baseline
+
+                # -- phase A: sustained allocation/release churn ----------
+                shapes = [
+                    {"topology": "2x2"},
+                    {"topology": "2x2", "generation": "tpu-v5p-slice"},
+                    {"topology": "2x4"},
+                    {"topology": "2x4", "minTopology": "2x2",
+                     "maxTopology": "4x4"},
+                    {"topology": "4x8", "multislice": True,
+                     "minTopology": "2x4", "maxSlices": 4},
+                ]
+                live_reqs: list[str] = []
+                quarantined: list[str] = []
+                created = 0
+                preempt_injected = False
+                for op in range(40):
+                    if live_reqs and (len(live_reqs) >= 12 or rng.random() < 0.35):
+                        victim = live_reqs.pop(rng.randrange(len(live_reqs)))
+                        await client.delete(GROUP, SLICE_REQUEST_KIND, victim)
+                    else:
+                        name = f"churn-{created}"
+                        created += 1
+                        await client.create(TPUSliceRequest.new(
+                            name, dict(rng.choice(shapes))
+                        ).obj)
+                        live_reqs.append(name)
+                    # chaos quarantines mid-churn: flip the agent-verdict
+                    # label the scheduler's eligibility consumes; one
+                    # injection deliberately lands on a BOUND node so the
+                    # preempt→re-place path is exercised, not just free
+                    # capacity shrinking
+                    if op % 8 == 3:
+                        target = None
+                        if not preempt_injected:
+                            nodes = await client.list_items("", "Node")
+                            bound = [
+                                n["metadata"]["name"] for n in nodes
+                                if consts.SLICE_REQUEST_LABEL
+                                in (deep_get(n, "metadata", "labels", default={}) or {})
+                            ]
+                            if bound:
+                                target = rng.choice(bound)
+                                preempt_injected = True
+                        if target is None:
+                            # tiny --nodes runs have no single-host fill;
+                            # quarantine a pool member instead of crashing
+                            pool_members = [
+                                n for names in names_by_pool.values()
+                                for n in names
+                            ]
+                            target = rng.choice(small_names or pool_members)
+                        quarantined.append(target)
+                        await client.patch("", "Node", target, {
+                            "metadata": {"labels": {
+                                consts.TPU_HEALTH_LABEL: consts.HEALTH_UNHEALTHY,
+                            }},
+                        })
+                    if op % 8 == 7 and quarantined:
+                        healed = quarantined.pop(0)
+                        await client.patch("", "Node", healed, {
+                            "metadata": {"labels": {
+                                consts.TPU_HEALTH_LABEL: consts.HEALTH_OK,
+                            }},
+                        })
+                    await asyncio.sleep(0.25)
+                result["churn_created"] = created
+                result["preempt_injected"] = preempt_injected
+                for healed in quarantined:
+                    await client.patch("", "Node", healed, {
+                        "metadata": {"labels": {
+                            consts.TPU_HEALTH_LABEL: consts.HEALTH_OK,
+                        }},
+                    })
+                for name in live_reqs:
+                    await client.delete(GROUP, SLICE_REQUEST_KIND, name)
+
+                # every stamp must garbage-collect once its CR is gone
+                t1 = time.perf_counter()
+                stray = None
+                while time.perf_counter() - t1 < 60.0:
+                    nodes = await client.list_items("", "Node")
+                    stray = [
+                        n["metadata"]["name"] for n in nodes
+                        if consts.SLICE_REQUEST_LABEL
+                        in (deep_get(n, "metadata", "labels", default={}) or {})
+                    ]
+                    if not stray:
+                        break
+                    await asyncio.sleep(0.25)
+                result["stray_stamps_after_release"] = stray or []
+
+                # -- phase B: defrag compaction proven zero-loss ----------
+                # block every 2x4 arc, then bind the training request: the
+                # only candidate left is the 4x4 arc (elastic max)
+                for s in range(mids):
+                    await client.create(TPUSliceRequest.new(
+                        f"blk-{s}", {"topology": "2x4"}
+                    ).obj)
+                await client.create(TPUSliceRequest.new("r-train", {
+                    "topology": "2x4", "maxTopology": "4x4",
+                }).obj)
+                t2 = time.perf_counter()
+                train_arc = None
+                while time.perf_counter() - t2 < 60.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-train")
+                    status = cr.get("status") or {}
+                    if status.get("phase") == "Bound":
+                        train_arc = status["arcs"][0]
+                        break
+                    await asyncio.sleep(0.25)
+                if train_arc is None or train_arc["key"] != "pool-big-0":
+                    raise AssertionError(
+                        f"r-train did not bind the 4x4 arc: {train_arc}"
+                    )
+
+                res_file = os.path.join(workdir, "train.jsonl")
+                job_env = {
+                    consts.CKPT_DIR_ENV: os.path.join(workdir, "ckpt-train"),
+                    consts.JOB_TOPOLOGY_ENV: "4x4",
+                    "TPU_JOB_RESULT_FILE": res_file,
+                    "TRAIN_STEPS": "1000000",
+                    "TRAIN_STEP_SLEEP_S": "0.05",
+                    "TPU_CKPT_EVERY": "25",
+                }
+                await client.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": "train-job", "namespace": "default",
+                        "labels": {
+                            "app": "train-job",
+                            consts.MIGRATE_HANDLER_LABEL:
+                                consts.MIGRATION_HANDLER_CHECKPOINT,
+                        },
+                    },
+                    "spec": {
+                        "nodeName": train_arc["nodes"][0],
+                        "restartPolicy": "Never",
+                        "containers": [{
+                            "name": "train",
+                            "image": "train-bench:dev",
+                            "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                            "env": [
+                                {"name": k, "value": v}
+                                for k, v in job_env.items()
+                            ],
+                        }],
+                    },
+                })
+
+                def _max_step(events, kinds=("progress", "checkpointed")) -> int:
+                    return max(
+                        (e.get("step", 0) for e in events if e.get("event") in kinds),
+                        default=0,
+                    )
+
+                t3 = time.perf_counter()
+                while _max_step(_read_events(res_file)) < 25:
+                    if time.perf_counter() - t3 > 120:
+                        raise TimeoutError("training job made no progress")
+                    await asyncio.sleep(0.25)
+                pre_steps = _max_step(_read_events(res_file))
+                result["pre_compaction_steps"] = pre_steps
+
+                # free ONE 2x4 arc: fragmentation (many scattered 2x2s +
+                # this 8-chip box) exceeds the threshold and the armed
+                # compaction must consolidate r-train onto it — through
+                # the migration machine, never an evict
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "blk-0")
+                t4 = time.perf_counter()
+                restored = None
+                compacted_status = None
+                while time.perf_counter() - t4 < 120.0:
+                    events = _read_events(res_file)
+                    restored = next(
+                        (e for e in events if e.get("event") == "restored"), None
+                    )
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-train")
+                    compacted_status = (cr.get("status") or {})
+                    if (
+                        restored is not None
+                        and compacted_status.get("arcs")
+                        and compacted_status["arcs"][0]["key"] == "pool-mid-0"
+                    ):
+                        break
+                    await asyncio.sleep(0.25)
+                result["compaction_settle_s"] = round(time.perf_counter() - t4, 3)
+                result["restored"] = restored
+                result["train_arc_after"] = (
+                    (compacted_status or {}).get("arcs") or [{}]
+                )[0].get("key")
+                result["granted_after"] = (compacted_status or {}).get(
+                    "grantedTopology"
+                )
+
+                progressed = False
+                resumed_ok = bound_ok = mesh_shrunk = False
+                if restored is not None:
+                    resumed = int(restored.get("resumed_from_step", 0))
+                    checkpointed = next(
+                        (e.get("step", -1) for e in _read_events(res_file)
+                         if e.get("event") == "checkpointed"
+                         and e.get("trigger") == "migrate-signal"), -1,
+                    )
+                    resumed_ok = resumed > 0
+                    bound_ok = resumed >= checkpointed >= pre_steps
+                    mesh_shrunk = restored.get("mesh") == [2, 4] and (
+                        restored.get("from_mesh") == [4, 4]
+                    )
+                    t5 = time.perf_counter()
+                    while time.perf_counter() - t5 < 60.0:
+                        if _max_step(_read_events(res_file)) > resumed:
+                            progressed = True
+                            break
+                        await asyncio.sleep(0.25)
+                result["resumed_from_step"] = (
+                    restored.get("resumed_from_step") if restored else None
+                )
+                result["step_bound_ok"] = bound_ok and resumed_ok
+                result["restore_mesh_shrunk"] = mesh_shrunk
+                result["post_restore_progress"] = progressed
+
+                # -- phase C: steady state ---------------------------------
+                steady_requests = steady_writes = None
+                sched_requests = None
+                t6 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    policy_n = counter.n
+                    with count_api_requests() as counter:
+                        await sched.reconcile("slices")
+                    sched_n = counter.n
+                    writes = _nonlease_writes(fc)
+                    if policy_n == 0 and sched_n == 0 and writes == 0:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                    if time.perf_counter() - t6 > 90:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                result["steady_scheduler_requests_per_pass"] = sched_requests
+                result["steady_writes_per_pass"] = steady_writes
+                result["frag_final"] = _gauge_value(
+                    metrics, "tpu_operator_slice_fragmentation_ratio"
+                )
+
+                # -- telemetry / event / explain joins --------------------
+                snap = fleet.snapshot()
+                placement = (
+                    (snap.get("metrics") or {}).get("slice_placement_seconds")
+                    or {}
+                )
+                p99 = None
+                for window in sorted(
+                    placement, key=lambda w: float(str(w).rstrip("s")),
+                    reverse=True,
+                ):
+                    roll = placement[window]
+                    if roll.get("count"):
+                        p99 = roll.get("p99")
+                        break
+                result["placement_p99_s"] = p99
+
+                slice_events = [
+                    e for e in fc.store("", "events").objects.values()
+                    if e.get("reason", "").startswith("Slice")
+                ]
+                result["slice_event_reasons"] = sorted(
+                    {e["reason"] for e in slice_events}
+                )
+                result["events_annotated"] = bool(slice_events) and all(
+                    consts.EVENT_RECONCILE_ID_ANNOTATION
+                    in (deep_get(e, "metadata", "annotations", default={}) or {})
+                    for e in slice_events
+                )
+                # /debug/explain join: the compaction decision must appear
+                # on the consolidated arc's node timeline
+                explained = explain.snapshot("mid-0-0")
+                result["explain_compaction_joined"] = any(
+                    entry.get("reason") == "SliceCompacted"
+                    for entry in explained.get("timeline", [])
+                )
+        finally:
+            mirror.cancel()
+            try:
+                await mirror
+            except asyncio.CancelledError:
+                pass
+            await client.close()
+            for proc in job_procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+
+        result["placements"] = {
+            outcome: _counter_value(
+                metrics, "tpu_operator_slice_placements", outcome=outcome
+            )
+            for outcome in ("placed", "preempted", "compacted", "grown",
+                            "released", "unschedulable")
+        }
+        result["evictions"] = {
+            reason: _counter_value(
+                metrics, "tpu_operator_drain_evictions",
+                controller="slicescheduler", reason=reason,
+            )
+            for reason in ("migrated", "timeout", "failed", "no-handler",
+                           "forced")
+        }
+        result["duplicate_creations"] = {
+            "/".join(k): v for k, v in fc.duplicate_creations().items()
+        }
+
+        failures = []
+        if result.get("stray_stamps_after_release"):
+            failures.append(
+                f"allocation stamps outlived their CRs: "
+                f"{result['stray_stamps_after_release']}"
+            )
+        if result["placements"].get("placed", 0) < 15:
+            failures.append(
+                f"too few placements under churn: {result['placements']}"
+            )
+        if result.get("preempt_injected") and (
+            result["placements"].get("preempted", 0) < 1
+        ):
+            failures.append("bound-arc quarantine never preempted a grant")
+        if result["placements"].get("compacted", 0) < 1:
+            failures.append("no defrag compaction happened")
+        if result.get("placement_p99_s") is None or (
+            result["placement_p99_s"] > CHURN_PLACEMENT_P99_S
+        ):
+            failures.append(
+                f"placement latency p99 {result.get('placement_p99_s')}s "
+                f"outside gate {CHURN_PLACEMENT_P99_S}s"
+            )
+        if result.get("frag_final", 1.0) > (
+            result.get("frag_baseline", 0.0) + CHURN_FRAG_SLACK
+        ):
+            failures.append(
+                f"fragmentation did not return to baseline: "
+                f"final {result.get('frag_final')} vs baseline "
+                f"{result.get('frag_baseline')}"
+            )
+        if result.get("restored") is None:
+            failures.append("compacted job was never restored")
+        if not result.get("step_bound_ok"):
+            failures.append(
+                "zero-loss bound violated: "
+                f"resumed={result.get('resumed_from_step')} "
+                f"pre={result.get('pre_compaction_steps')}"
+            )
+        if not result.get("restore_mesh_shrunk"):
+            failures.append(
+                f"compaction did not reshard 4x4 -> 2x4: {result.get('restored')}"
+            )
+        if not result.get("post_restore_progress"):
+            failures.append("compacted job made no further progress")
+        if result.get("train_arc_after") != "pool-mid-0":
+            failures.append(
+                f"grant did not consolidate onto pool-mid-0: "
+                f"{result.get('train_arc_after')}"
+            )
+        if result["evictions"].get("migrated", 0) < 1:
+            failures.append("compaction did not ride the migration path")
+        for reason in ("timeout", "failed", "no-handler", "forced"):
+            if result["evictions"].get(reason, 0):
+                failures.append(
+                    f"defrag plain-evicted a workload (reason={reason})"
+                )
+        if result["duplicate_creations"]:
+            failures.append(
+                f"duplicate creations: {result['duplicate_creations']}"
+            )
+        if result.get("steady_requests_per_pass") != 0:
+            failures.append(
+                f"steady policy requests/pass = "
+                f"{result.get('steady_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_scheduler_requests_per_pass") != 0:
+            failures.append(
+                f"steady scheduler requests/pass = "
+                f"{result.get('steady_scheduler_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_writes_per_pass") != 0:
+            failures.append(
+                f"steady writes/pass = {result.get('steady_writes_per_pass')}"
+                " (want 0)"
+            )
+        for reason in ("SlicePlaced", "SliceCompacted"):
+            if reason not in result.get("slice_event_reasons", []):
+                failures.append(f"{reason} Event not posted")
+        if result.get("preempt_injected") and (
+            "SlicePreempted" not in result.get("slice_event_reasons", [])
+        ):
+            failures.append("SlicePreempted Event not posted")
+        if not result.get("events_annotated"):
+            failures.append(
+                "scheduler Events missing reconcile-id annotations"
+            )
+        if not result.get("explain_compaction_joined"):
+            failures.append(
+                "SliceCompacted not joinable on the target node's "
+                "/debug/explain timeline"
+            )
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_slice_churn_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  slice-churn soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_slice_churn_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  slice-churn FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  slice-churn soak: placements {result.get('placements')}, "
+        f"placement p99 {result.get('placement_p99_s')}s, "
+        f"frag {result.get('frag_baseline')} -> {result.get('frag_final')}, "
+        f"compacted resume step {result.get('resumed_from_step')}, "
         f"{'OK' if result['ok'] else 'FAILED'}",
         file=sys.stderr,
     )
@@ -3262,6 +3911,23 @@ def main() -> None:
             "metric": "fleet_obs_slo_fired_seconds",
             "value": result.get("slo_fired_after_s"),
             "unit": "s",
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --slice-churn [--nodes 100] [--seed 1]`: elastic-scheduler
+    # acceptance soak (sustained TPUSliceRequest churn + chaos quarantines
+    # + zero-loss defrag compaction) — `make slice-churn`
+    if "--slice-churn" in sys.argv:
+        result = run_slice_churn_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "slice_churn_placement_p99_seconds",
+            "value": result.get("placement_p99_s"),
+            "unit": "s",
+            "fragmentation_final": result.get("frag_final"),
             "ok": result["ok"],
             "detail": result,
         }))

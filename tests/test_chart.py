@@ -84,6 +84,39 @@ def test_chart_matches_installer_with_overrides():
     assert "--leader-elect" not in args
 
 
+def test_chart_shard_replica_deployment_matches_installer():
+    """The shard-replica worker Deployment (cmd/shard_replica.py) renders
+    identically from both installers; disabled by default."""
+    assert ("Deployment", "tpu-operator-shard-replica") not in _by_key(
+        helmlite.render_chart(CHART_DIR)
+    )
+    chart = _by_key(
+        helmlite.render_chart(
+            CHART_DIR,
+            values={"shardReplicas": {"enabled": True, "replicas": 3,
+                                      "maxShards": 2}},
+        )
+    )
+    installer = _by_key(_installer_objs([
+        "shardReplicas.enabled=true",
+        "shardReplicas.replicas=3",
+        "shardReplicas.maxShards=2",
+    ]))
+    key = ("Deployment", "tpu-operator-shard-replica")
+    assert key in chart and key in installer
+    assert chart[key] == installer[key]
+    spec = chart[key]["spec"]
+    assert spec["replicas"] == 3
+    container = spec["template"]["spec"]["containers"][0]
+    assert container["command"] == [
+        "python", "-m", "tpu_operator.cmd.shard_replica"
+    ]
+    assert "--shards=4" in container["args"]
+    assert "--max-shards=2" in container["args"]
+    # the worker reuses the operator ServiceAccount (nodes patch + leases)
+    assert spec["template"]["spec"]["serviceAccountName"] == "tpu-operator"
+
+
 def test_chart_crds_in_sync_with_installer():
     """helm's crds/ dir must carry byte-identical copies of the generated
     CRDs (deploy/crds, themselves golden-tested against api/crds.py)."""

@@ -23,7 +23,7 @@ def test_render_manifests_shape():
     values = deploy.load_values(os.path.join(deploy.DEPLOY_DIR, "values.yaml"), [])
     objs = deploy.render_manifests(values)
     kinds = [o["kind"] for o in objs]
-    assert kinds.count("CustomResourceDefinition") == 2
+    assert kinds.count("CustomResourceDefinition") == 3
     for kind in ("Namespace", "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
                  "Deployment", "TPUClusterPolicy"):
         assert kind in kinds, kind

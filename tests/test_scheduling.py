@@ -1,0 +1,267 @@
+"""Pure placement-engine tests (tpu_operator/scheduling/)."""
+
+import dataclasses
+
+import pytest
+
+from tpu_operator import consts, scheduling, slices
+from tpu_operator.api.types import TPUSliceRequestSpec
+
+
+def _node(
+    name,
+    topology="2x4",
+    accelerator="tpu-v5-lite-podslice",
+    pool=None,
+    labels=None,
+    unschedulable=False,
+):
+    node_labels = {
+        consts.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+        consts.GKE_TPU_TOPOLOGY_LABEL: topology,
+    }
+    if pool:
+        node_labels[consts.GKE_NODEPOOL_LABEL] = pool
+    node_labels.update(labels or {})
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": node_labels},
+        "spec": {},
+        "status": {"allocatable": {consts.TPU_RESOURCE: "4"}},
+    }
+    if unschedulable:
+        node["spec"]["unschedulable"] = True
+    return node
+
+
+def _request(name, topology, **kw):
+    spec = TPUSliceRequestSpec.from_dict({"topology": topology, **kw})
+    return scheduling.request_from_spec(name, spec)
+
+
+# ---------------------------------------------------------------------------
+# shape helpers (slices.py contiguity model)
+
+
+def test_shape_fits_padding_and_orientation():
+    assert slices.shape_fits("2x4", "4x4x4")      # padded to 1x2x4
+    assert slices.shape_fits("4x1", "2x8")        # reoriented onto the 8 axis
+    assert slices.shape_fits("2x4", "2x4")
+    assert not slices.shape_fits("4x4", "2x8")    # no axis assignment works
+    assert not slices.shape_fits("2x2x2", "4x4")  # more axes than the mesh
+
+
+def test_shape_divides_requires_divisibility():
+    assert slices.shape_divides("2x4", "4x4")
+    assert not slices.shape_divides("3x4", "4x4")  # 3 does not divide 4
+    assert slices.shape_divides("2x2", "4x4x4")
+
+
+# ---------------------------------------------------------------------------
+# request parsing
+
+
+def test_request_from_spec_elastic_range():
+    r = _request("r", "2x4", minTopology="2x2", maxTopology="4x4")
+    assert (r.min_chips, r.desired_chips, r.max_chips) == (4, 8, 16)
+
+
+def test_request_from_spec_incoherent_range_raises():
+    with pytest.raises(ValueError, match="elastic range"):
+        _request("r", "2x2", minTopology="4x4")
+
+
+# ---------------------------------------------------------------------------
+# capacity model
+
+
+def test_arcs_group_multi_host_pools():
+    nodes = [
+        _node("a-0", topology="2x4", pool="pool-a"),
+        _node("a-1", topology="2x4", pool="pool-a"),
+        _node("solo", topology="2x2"),
+    ]
+    arcs = {a.key: a for a in scheduling.arcs_from_nodes(nodes)}
+    assert arcs["pool-a"].nodes == ("a-0", "a-1")
+    assert arcs["pool-a"].chips == 8 and arcs["pool-a"].eligible
+    assert arcs["solo"].chips == 4 and arcs["solo"].eligible
+
+
+def test_incomplete_or_unhealthy_arc_ineligible():
+    nodes = [_node("a-0", topology="2x4", pool="pool-a")]  # 1 of 2 hosts
+    (arc,) = scheduling.arcs_from_nodes(nodes)
+    assert not arc.eligible
+    nodes = [
+        _node("a-0", topology="2x4", pool="pool-a"),
+        _node("a-1", topology="2x4", pool="pool-a", unschedulable=True),
+    ]
+    (arc,) = scheduling.arcs_from_nodes(nodes)
+    assert not arc.eligible
+    quarantined = _node(
+        "q", topology="2x2",
+        labels={consts.HEALTH_STATE_LABEL: consts.HEALTH_QUARANTINED},
+    )
+    (arc,) = scheduling.arcs_from_nodes([quarantined])
+    assert not arc.eligible
+
+
+def test_assigned_and_admin_group_detected():
+    nodes = [
+        _node("bound", topology="2x2", labels={consts.SLICE_REQUEST_LABEL: "r1"}),
+        _node("grouped", topology="2x2",
+              labels={consts.MULTISLICE_GROUP_LABEL: "admin-ms"}),
+    ]
+    arcs = {a.key: a for a in scheduling.arcs_from_nodes(nodes)}
+    assert arcs["bound"].assigned == "r1" and not arcs["bound"].free
+    assert arcs["grouped"].admin_group == "admin-ms"
+
+
+# ---------------------------------------------------------------------------
+# placement scoring
+
+
+def test_exact_fit_beats_bigger_arc():
+    arcs = scheduling.arcs_from_nodes([
+        _node("big", topology="4x4", pool="pool-big"),
+        _node("big-1", topology="4x4", pool="pool-big"),
+        _node("big-2", topology="4x4", pool="pool-big"),
+        _node("big-3", topology="4x4", pool="pool-big"),
+        _node("exact", topology="2x2"),
+    ])
+    grant = scheduling.plan_placement(_request("r", "2x2"), arcs)
+    assert grant is not None and grant.arcs[0].key == "exact"
+    assert grant.topology == "2x2" and not grant.multislice
+
+
+def test_generation_pin_filters():
+    arcs = scheduling.arcs_from_nodes([
+        _node("v5e", topology="2x2", accelerator="tpu-v5-lite-podslice"),
+        _node("v5p", topology="2x2", accelerator="tpu-v5p-slice"),
+    ])
+    grant = scheduling.plan_placement(
+        _request("r", "2x2", generation="tpu-v5p-slice"), arcs
+    )
+    assert grant.arcs[0].key == "v5p"
+    assert scheduling.plan_placement(
+        _request("r", "2x2", generation="tpu-v6e-slice"), arcs
+    ) is None
+
+
+def test_abundant_generation_preferred_for_unpinned():
+    # equal fit on both generations; v5e has MORE free capacity left, so
+    # the unpinned request lands there and preserves the scarce v5p pool
+    arcs = scheduling.arcs_from_nodes([
+        _node("v5e-a", topology="2x2", accelerator="tpu-v5-lite-podslice"),
+        _node("v5e-b", topology="2x2", accelerator="tpu-v5-lite-podslice"),
+        _node("v5p-a", topology="2x2", accelerator="tpu-v5p-slice"),
+    ])
+    grant = scheduling.plan_placement(_request("r", "2x2"), arcs)
+    assert grant.arcs[0].generation == "tpu-v5-lite-podslice"
+
+
+def test_elastic_shrink_and_grow():
+    r = _request("r", "2x4", minTopology="2x2", maxTopology="4x4")
+    small = scheduling.arcs_from_nodes([_node("small", topology="2x2")])
+    grant = scheduling.plan_placement(r, small)
+    assert grant.topology == "2x2" and grant.chips == 4  # shrink to min
+    big = scheduling.arcs_from_nodes(
+        [_node(f"big-{i}", topology="4x4", pool="pool-big") for i in range(4)]
+    )
+    grant = scheduling.plan_placement(r, big)
+    assert grant.topology == "4x4" and grant.chips == 16  # grow to max
+
+
+def test_oversize_arc_carves_desired_box():
+    r = _request("r", "2x2")  # min == desired == max == 4 chips
+    arcs = scheduling.arcs_from_nodes(
+        [_node(f"h-{i}", topology="4x4x4", pool="p",
+               accelerator="tpu-v5p-slice") for i in range(16)]
+    )
+    grant = scheduling.plan_placement(r, arcs)
+    assert grant is not None
+    assert grant.topology == "2x2"  # carved, not the whole 64-chip mesh
+
+
+def test_multislice_split_same_generation():
+    nodes = []
+    for i in range(4):
+        nodes.append(_node(f"s{i}-0", topology="2x4", pool=f"pool-{i}"))
+        nodes.append(_node(f"s{i}-1", topology="2x4", pool=f"pool-{i}"))
+    arcs = scheduling.arcs_from_nodes(nodes)
+    r = _request("r", "4x8", multislice=True)  # 32 chips > any one mesh
+    grant = scheduling.plan_placement(r, arcs)
+    assert grant is not None and grant.multislice
+    assert len(grant.arcs) == 4 and grant.chips == 32
+    assert scheduling.plan_placement(_request("r", "4x8"), arcs) is None
+
+
+def test_multislice_excludes_admin_groups_and_respects_max_slices():
+    nodes = []
+    for i in range(4):
+        labels = {consts.MULTISLICE_GROUP_LABEL: "admin"} if i == 0 else {}
+        nodes.append(_node(f"s{i}-0", topology="2x4", pool=f"pool-{i}",
+                           labels=labels))
+        nodes.append(_node(f"s{i}-1", topology="2x4", pool=f"pool-{i}",
+                           labels=labels))
+    arcs = scheduling.arcs_from_nodes(nodes)
+    r = _request("r", "4x8", multislice=True, minTopology="2x4")
+    grant = scheduling.plan_placement(r, arcs)
+    assert grant is not None
+    assert all(a.admin_group == "" for a in grant.arcs)
+    assert len(grant.arcs) == 3  # the admin arc is off limits
+    r2 = _request("r", "4x8", multislice=True, minTopology="2x4", maxSlices=2)
+    grant2 = scheduling.plan_placement(r2, arcs)
+    assert grant2 is not None and len(grant2.arcs) == 2
+
+
+# ---------------------------------------------------------------------------
+# fragmentation + compaction
+
+
+def test_fragmentation_ratio():
+    arcs = scheduling.arcs_from_nodes([
+        _node("a", topology="2x2"), _node("b", topology="2x2"),
+    ])
+    assert scheduling.fragmentation(arcs) == 0.5
+    assert scheduling.fragmentation(arcs[:1]) == 0.0
+    assert scheduling.fragmentation([]) == 0.0
+    bound = [dataclasses.replace(a, assigned="r") for a in arcs]
+    assert scheduling.fragmentation(bound) == 0.0
+
+
+def test_plan_compaction_moves_small_grant_off_big_arc():
+    nodes = [
+        _node("big-0", topology="2x4", pool="pool-big",
+              labels={consts.SLICE_REQUEST_LABEL: "r1"}),
+        _node("big-1", topology="2x4", pool="pool-big",
+              labels={consts.SLICE_REQUEST_LABEL: "r1"}),
+        _node("free-a", topology="2x2"),
+        _node("free-b", topology="2x2"),
+    ]
+    arcs = scheduling.arcs_from_nodes(nodes)
+    bound = {"r1": _request("r1", "2x2", maxTopology="2x4")}
+    move = scheduling.plan_compaction(arcs, bound, threshold=0.4)
+    assert move is not None
+    assert move.request == "r1" and move.source.key == "pool-big"
+    assert move.target.key in ("free-a", "free-b")
+    assert move.freed_chips == 8
+    # below threshold: never armed
+    assert scheduling.plan_compaction(arcs, bound, threshold=1.0) is None
+
+
+def test_plan_compaction_skips_multislice_and_unsatisfiable():
+    nodes = [
+        _node("big-0", topology="2x4", pool="pool-big",
+              labels={consts.SLICE_REQUEST_LABEL: "ms"}),
+        _node("big-1", topology="2x4", pool="pool-big",
+              labels={consts.SLICE_REQUEST_LABEL: "ms"}),
+        _node("leg", topology="2x2",
+              labels={consts.SLICE_REQUEST_LABEL: "ms"}),
+        _node("free-a", topology="2x2"),
+        _node("free-b", topology="2x2"),
+    ]
+    arcs = scheduling.arcs_from_nodes(nodes)
+    bound = {"ms": _request("ms", "2x4", multislice=True, minTopology="2x2")}
+    # ms owns two arcs (a multislice grant): never compacted
+    assert scheduling.plan_compaction(arcs, bound, threshold=0.1) is None

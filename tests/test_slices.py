@@ -49,6 +49,61 @@ def test_chip_assignments_hosts():
     assert layout[1]["hosts"] == [0, 1]
 
 
+def test_partition_rejects_non_divisible_axis():
+    # 2x3+2x3+2x2 covers 16 chips exactly, but 3 divides no axis of 4x4 —
+    # coverage alone must not admit a layout the mesh cannot tile
+    with pytest.raises(slices.PartitionError, match="tile"):
+        slices.partition_topology("4x4", ["2x3", "2x3", "2x2"])
+
+
+def test_partition_rejects_dimension_mismatch():
+    # four 4x4 planes cover a 4x4x4's 64 chips, but a 2D shape does not
+    # tile a 3D mesh in the partitioner's axis-aligned model
+    with pytest.raises(slices.PartitionError, match="tile"):
+        slices.partition_topology("4x4x4", ["4x4"] * 4)
+
+
+def test_chip_assignments_host_aligned_rows():
+    # row-major 2x4: row 0 = chips 0-3 (host 0), row 1 = chips 4-7 (host 1)
+    # — a 1x4 partitioning is exactly host-aligned
+    layout = slices.chip_assignments("2x4", ["1x4", "1x4"], chips_per_host=4)
+    assert layout[0]["chip_ids"] == [0, 1, 2, 3]
+    assert layout[0]["hosts"] == [0]
+    assert layout[1]["chip_ids"] == [4, 5, 6, 7]
+    assert layout[1]["hosts"] == [1]
+
+
+def test_chip_assignments_host_boundary_behavior():
+    # chips_per_host=0 disables host attribution entirely
+    layout = slices.chip_assignments("2x4", ["2x2", "2x2"], chips_per_host=0)
+    assert all(entry["hosts"] == [] for entry in layout)
+    # a host size that does not divide the mesh still attributes by flat
+    # id // chips_per_host: chips {0,1,4,5} -> hosts {0,1}, {2,3,6,7} -> {0,1,2}
+    layout = slices.chip_assignments("2x4", ["2x2", "2x2"], chips_per_host=3)
+    assert layout[0]["hosts"] == [0, 1]
+    assert layout[1]["hosts"] == [0, 1, 2]
+
+
+def test_load_profile_unknown_profile_and_unmatched_rule():
+    config = {
+        "slice-configs": {
+            "v5p-only": [
+                {"accelerators": ["tpu-v5p-slice"], "topology": "4x4x4",
+                 "partitions": ["2x4x4", "2x4x4"]},
+            ]
+        }
+    }
+    with pytest.raises(slices.PartitionError, match="unknown slice profile"):
+        slices.load_profile(config, "absent", "tpu-v5p-slice", "4x4x4")
+    # the profile exists but no rule matches this node's hardware: the
+    # error must name the accelerator/topology, not silently no-op
+    with pytest.raises(slices.PartitionError, match="no rule"):
+        slices.load_profile(config, "v5p-only", "tpu-v5-lite-podslice", "2x4")
+    # empty config dict: every profile is unknown
+    with pytest.raises(slices.PartitionError, match="unknown slice profile"):
+        slices.load_profile({}, "anything", "x", "y")
+
+
 def test_load_profile_matching():
     config = {
         "slice-configs": {

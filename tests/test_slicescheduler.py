@@ -1,0 +1,390 @@
+"""Slice-scheduler controller tests (controllers/slicescheduler.py):
+bind/release lifecycle, elastic shrink on capacity loss, multislice label
+stamping, defrag-by-migration, and the Event/explain surface."""
+
+import asyncio
+
+from tpu_operator import consts
+from tpu_operator.api.types import (
+    GROUP,
+    SLICE_REQUEST_KIND,
+    SlicePhase,
+    TPUClusterPolicy,
+    TPUSliceRequest,
+)
+from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+from tpu_operator.k8s.client import ApiClient, ApiError, Config
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.utils import deep_get
+
+NS = "tpu-operator"
+
+
+async def _cluster(fc, policy_spec=None):
+    client = ApiClient(Config(base_url=fc.base_url))
+    await client.create(TPUClusterPolicy.new(spec=policy_spec or {}).obj)
+    return client
+
+
+def _scheduler(client, fleet=None):
+    return SliceSchedulerReconciler(
+        client, NS, metrics=OperatorMetrics(), fleet=fleet
+    )
+
+
+async def _labels(client, name):
+    node = await client.get("", "Node", name)
+    return deep_get(node, "metadata", "labels", default={}) or {}
+
+
+async def _status(client, name):
+    cr = await client.get(GROUP, SLICE_REQUEST_KIND, name)
+    return cr.get("status") or {}
+
+
+async def _reasons(fc):
+    return {e.get("reason") for e in fc.store("", "events").objects.values()}
+
+
+async def test_bind_release_lifecycle():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        fc.add_node("solo-b", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            status = await _status(client, "r1")
+            assert status["phase"] == SlicePhase.BOUND
+            assert status["grantedTopology"] == "2x2"
+            bound_node = status["arcs"][0]["nodes"][0]
+            labels = await _labels(client, bound_node)
+            assert labels[consts.SLICE_REQUEST_LABEL] == "r1"
+            assert "SlicePlaced" in await _reasons(fc)
+
+            # deleting the CR IS the release API: stamps are collected
+            await client.delete(GROUP, SLICE_REQUEST_KIND, "r1")
+            await sched.reconcile("slices")
+            labels = await _labels(client, bound_node)
+            assert consts.SLICE_REQUEST_LABEL not in labels
+        finally:
+            await client.close()
+
+
+async def test_pending_then_bound_when_capacity_frees():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {"topology": "2x2"}).obj)
+            await client.create(TPUSliceRequest.new("r2", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            phases = {
+                name: (await _status(client, name)).get("phase")
+                for name in ("r1", "r2")
+            }
+            assert sorted(phases.values()) == [SlicePhase.BOUND, SlicePhase.PENDING]
+            bound = next(n for n, p in phases.items() if p == SlicePhase.BOUND)
+            await client.delete(GROUP, SLICE_REQUEST_KIND, bound)
+            await sched.reconcile("slices")
+            other = "r2" if bound == "r1" else "r1"
+            assert (await _status(client, other))["phase"] == SlicePhase.BOUND
+        finally:
+            await client.close()
+
+
+async def test_unschedulable_when_no_shape_can_ever_fit():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(
+                TPUSliceRequest.new("huge", {"topology": "8x8"}).obj
+            )
+            await sched.reconcile("slices")
+            status = await _status(client, "huge")
+            assert status["phase"] == SlicePhase.UNSCHEDULABLE
+            assert "SliceUnschedulable" in await _reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_invalid_elastic_range_is_unschedulable():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new(
+                "bad", {"topology": "2x2", "minTopology": "4x4"}
+            ).obj)
+            await sched.reconcile("slices")
+            status = await _status(client, "bad")
+            assert status["phase"] == SlicePhase.UNSCHEDULABLE
+            assert "elastic range" in status["message"]
+        finally:
+            await client.close()
+
+
+async def test_admission_rejects_malformed_topology():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            try:
+                await client.create(TPUSliceRequest.new(
+                    "bad", {"topology": "2xbogus"}
+                ).obj)
+                raise AssertionError("admission should have rejected it")
+            except ApiError as e:
+                assert e.status == 422 or "does not match" in str(e)
+        finally:
+            await client.close()
+
+
+async def test_multislice_grant_stamps_rendezvous_labels():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        for i in range(3):
+            fc.add_node(f"s{i}-0", topology="2x4",
+                        labels={consts.GKE_NODEPOOL_LABEL: f"pool-{i}",
+                                consts.GKE_TPU_WORKER_ID_LABEL: "0"})
+            fc.add_node(f"s{i}-1", topology="2x4",
+                        labels={consts.GKE_NODEPOOL_LABEL: f"pool-{i}",
+                                consts.GKE_TPU_WORKER_ID_LABEL: "1"})
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("ms", {
+                "topology": "4x6", "multislice": True, "minTopology": "4x4",
+            }).obj)
+            await sched.reconcile("slices")
+            status = await _status(client, "ms")
+            assert status["phase"] == SlicePhase.BOUND
+            assert len(status["arcs"]) == 3
+            labels = await _labels(client, "s0-0")
+            assert labels[consts.SLICE_REQUEST_LABEL] == "ms"
+            assert labels[consts.MULTISLICE_GROUP_LABEL] == "ms"
+            assert labels[consts.MULTISLICE_SLICES_LABEL] == "3"
+            # release strips OUR rendezvous labels too
+            await client.delete(GROUP, SLICE_REQUEST_KIND, "ms")
+            await sched.reconcile("slices")
+            labels = await _labels(client, "s0-0")
+            assert consts.MULTISLICE_GROUP_LABEL not in labels
+        finally:
+            await client.close()
+
+
+async def test_capacity_loss_replaces_grant_elastically():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        fc.add_node("small", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {
+                "topology": "2x4", "minTopology": "2x2",
+            }).obj)
+            await sched.reconcile("slices")
+            status = await _status(client, "r1")
+            assert status["arcs"][0]["key"] == "big"
+            # quarantine the granted node: the grant shrinks to the 2x2
+            await client.patch("", "Node", "big", {"metadata": {"labels": {
+                consts.HEALTH_STATE_LABEL: consts.HEALTH_QUARANTINED,
+            }}})
+            await sched.reconcile("slices")
+            status = await _status(client, "r1")
+            assert status["phase"] == SlicePhase.BOUND
+            assert status["arcs"][0]["key"] == "small"
+            assert status["grantedTopology"] == "2x2"
+            assert "SlicePreempted" in await _reasons(fc)
+            labels = await _labels(client, "big")
+            assert consts.SLICE_REQUEST_LABEL not in labels
+        finally:
+            await client.close()
+
+
+async def test_capacity_loss_with_no_alternative_requeues_pending():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("only", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            await client.patch("", "Node", "only", {"spec": {"unschedulable": True}})
+            await sched.reconcile("slices")
+            status = await _status(client, "r1")
+            assert status["phase"] == SlicePhase.PENDING
+            assert "capacity lost" in status["message"]
+        finally:
+            await client.close()
+
+
+async def test_defrag_compacts_grant_through_empty_arc():
+    """Fragmented free capacity + a grant parked on the big arc: the
+    scheduler moves it (no pods here — the migration path is proven in
+    the slice-churn soak) and the big contiguous box frees up."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc, {"scheduling": {"defragThreshold": 0.4}})
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {
+                "topology": "2x2", "maxTopology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            assert (await _status(client, "r1"))["arcs"][0]["key"] == "big"
+            # now two small free arcs appear -> fragmentation 0.5 > 0.4
+            fc.add_node("free-a", topology="2x2")
+            fc.add_node("free-b", topology="2x2")
+            await sched.reconcile("slices")  # arms the move
+            await sched.reconcile("slices")  # drives it to completion
+            status = await _status(client, "r1")
+            assert status["phase"] == SlicePhase.BOUND
+            assert status["arcs"][0]["key"] in ("free-a", "free-b")
+            assert consts.SLICE_REQUEST_LABEL not in await _labels(client, "big")
+            assert "SliceCompacted" in await _reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_defrag_vetoed_by_non_migratable_pod():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc, {"scheduling": {"defragThreshold": 0.4}})
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {
+                "topology": "2x2", "maxTopology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            # a TPU workload pod that never opted into migration
+            await client.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "train", "namespace": "default"},
+                "spec": {"nodeName": "big", "containers": [
+                    {"name": "c", "resources": {
+                        "limits": {consts.TPU_RESOURCE: "8"}}}]},
+                "status": {"phase": "Running"},
+            })
+            fc.add_node("free-a", topology="2x2")
+            fc.add_node("free-b", topology="2x2")
+            await sched.reconcile("slices")  # arms the move
+            await sched.reconcile("slices")  # veto: pod did not opt in
+            status = await _status(client, "r1")
+            assert status["arcs"][0]["key"] == "big"  # grant unmoved
+            labels_a = await _labels(client, "free-a")
+            labels_b = await _labels(client, "free-b")
+            assert consts.SLICE_REQUEST_LABEL not in labels_a
+            assert consts.SLICE_REQUEST_LABEL not in labels_b
+            assert "SliceCompacted" not in await _reasons(fc)
+            # the veto is memoized: the identical move must NOT re-arm
+            # next pass (that would be a permanent stamp/release/pod-list
+            # loop against a steady cluster)
+            fc.reset_request_counts()
+            await sched.reconcile("slices")
+            writes = sum(
+                n for (verb, _), n in fc.request_counts.items()
+                if verb in ("POST", "PUT", "PATCH", "DELETE")
+            )
+            assert writes == 0, fc.request_counts
+        finally:
+            await client.close()
+
+
+async def test_inflight_move_target_not_double_booked():
+    """While a compaction drains (migratable pod mid-checkpoint), the
+    reserved target arc must be invisible to pending placement — a
+    second request binds the OTHER free arc, never the reservation."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc, {"scheduling": {"defragThreshold": 0.4}})
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {
+                "topology": "2x2", "maxTopology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            # a migratable pod keeps the drain PENDING (annotated, never
+            # reaching Succeeded in this kubelet-less cluster)
+            await client.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "train", "namespace": "default",
+                             "labels": {consts.MIGRATE_HANDLER_LABEL:
+                                        consts.MIGRATION_HANDLER_CHECKPOINT}},
+                "spec": {"nodeName": "big", "containers": [
+                    {"name": "c", "resources": {
+                        "limits": {consts.TPU_RESOURCE: "8"}}}]},
+                "status": {"phase": "Running"},
+            })
+            fc.add_node("free-a", topology="2x2")
+            fc.add_node("free-b", topology="2x2")
+            await sched.reconcile("slices")  # arms the move
+            await sched.reconcile("slices")  # stamps target, drain pending
+            reserved = None
+            for name in ("free-a", "free-b"):
+                stamped = (await _labels(client, name)).get(
+                    consts.SLICE_REQUEST_LABEL
+                )
+                if stamped == "r1":
+                    reserved = name
+            assert reserved is not None
+            other = "free-b" if reserved == "free-a" else "free-a"
+            await client.create(TPUSliceRequest.new("r2", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            status = await _status(client, "r2")
+            assert status["phase"] == SlicePhase.BOUND
+            assert status["arcs"][0]["key"] == other
+            # the reservation survived untouched
+            labels = await _labels(client, reserved)
+            assert labels[consts.SLICE_REQUEST_LABEL] == "r1"
+        finally:
+            await client.close()
+
+
+async def test_deleted_pending_request_prunes_latency_bookkeeping():
+    """A request deleted while pending must not leak its first-seen
+    timestamp into a later request reusing the name (false placement
+    latency)."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("blk", {"topology": "2x2"}).obj)
+            await client.create(TPUSliceRequest.new("r1", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            pending = None
+            for n in ("blk", "r1"):
+                if (await _status(client, n)).get("phase") == SlicePhase.PENDING:
+                    pending = n
+            assert pending is not None
+            await client.delete(GROUP, SLICE_REQUEST_KIND, pending)
+            await sched.reconcile("slices")
+            assert pending not in sched._first_pending
+        finally:
+            await client.close()
+
+
+async def test_steady_state_status_writes_are_zero():
+    """A converged scheduler pass re-asserts nothing: no status update,
+    no label patch, no Event post."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("solo-a", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("r1", {"topology": "2x2"}).obj)
+            await sched.reconcile("slices")
+            fc.reset_request_counts()
+            await sched.reconcile("slices")
+            writes = sum(
+                n for (verb, _), n in fc.request_counts.items()
+                if verb in ("POST", "PUT", "PATCH", "DELETE")
+            )
+            assert writes == 0, fc.request_counts
+        finally:
+            await client.close()

@@ -42,6 +42,7 @@ NODE_LIST_ALLOWLIST = {
     ("remediation.py", "_reconcile"),         # fleet-keyed remediation sweep
     ("health.py", "_reconcile"),              # fleet-keyed health engine pass
     ("revalidation.py", "_reconcile"),        # fleet-keyed wave scheduling sweep
+    ("slicescheduler.py", "_reconcile"),      # fleet-keyed placement sweep (cached)
 }
 
 

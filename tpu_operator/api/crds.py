@@ -183,8 +183,24 @@ def tpu_runtime_crd() -> dict:
     )
 
 
+def slice_request_crd() -> dict:
+    return _crd(
+        t.SLICE_REQUEST_KIND,
+        "tpuslicerequests",
+        "tpuslicerequest",
+        t.SLICE_REQUEST_VERSION,
+        t.TPUSliceRequestSpec,
+        short_names=["tsr"],
+        extra_printer_columns=[
+            {"name": "Topology", "type": "string", "jsonPath": ".spec.topology"},
+            {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+            {"name": "Granted", "type": "string", "jsonPath": ".status.grantedTopology"},
+        ],
+    )
+
+
 def all_crds() -> list[dict]:
-    return [cluster_policy_crd(), tpu_runtime_crd()]
+    return [cluster_policy_crd(), tpu_runtime_crd(), slice_request_crd()]
 
 
 def main() -> None:

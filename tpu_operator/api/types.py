@@ -30,6 +30,8 @@ CLUSTER_POLICY_KIND = "TPUClusterPolicy"
 CLUSTER_POLICY_VERSION = "v1"
 TPU_RUNTIME_KIND = "TPURuntime"
 TPU_RUNTIME_VERSION = "v1alpha1"
+SLICE_REQUEST_KIND = "TPUSliceRequest"
+SLICE_REQUEST_VERSION = "v1alpha1"
 
 
 class State:
@@ -571,6 +573,28 @@ class MigrationSpec(SpecBase):
 
 
 @dataclass
+class SchedulingSpec(SpecBase):
+    """Elastic multi-slice scheduler knobs (controllers/slicescheduler.py;
+    docs/SCHEDULING.md).  The scheduler only acts on TPUSliceRequest CRs,
+    so the default-on flag is safe for fleets that never create one.
+
+    Defragmentation compacts a running grant onto a smaller free arc —
+    through the migration machine (checkpoint–reshard–restore), never a
+    plain evict — once the free-capacity fragmentation ratio exceeds
+    ``defragThreshold`` and a move exists that strictly grows the largest
+    free contiguous box.  1.0 disables compaction (the ratio never
+    exceeds it)."""
+
+    enabled: bool = True
+    # 1 - largest_free_arc_chips / total_free_chips; compaction arms above
+    # this (see scheduling.placement.fragmentation)
+    defrag_threshold: float = field(
+        default=0.5, metadata={"minimum": 0, "maximum": 1}
+    )
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
 class HealthSpec(SpecBase):
     """Autonomous node health engine (controllers/health.py;
     docs/ROBUSTNESS.md "Node health engine").
@@ -647,6 +671,7 @@ class TPUClusterPolicySpec(SpecBase):
     health: HealthSpec = field(default_factory=HealthSpec)
     migration: MigrationSpec = field(default_factory=MigrationSpec)
     observability: ObservabilitySpec = field(default_factory=ObservabilitySpec)
+    scheduling: SchedulingSpec = field(default_factory=SchedulingSpec)
     extra_fields: dict = field(default_factory=dict)
 
     # -- enable gates (isStateEnabled analogue, state_manager.go:994-1036) --
@@ -779,6 +804,93 @@ class TPURuntimeSpec(SpecBase):
 
     def image_path(self) -> str:
         return resolve_image(self.repository, self.image, self.version, "libtpu")
+
+
+# ---------------------------------------------------------------------------
+# TPUSliceRequest — queued slice-capacity request for the elastic scheduler
+# (controllers/slicescheduler.py + tpu_operator/scheduling/;
+# docs/SCHEDULING.md).  No reference analogue: the MIG manager carves
+# devices statically at policy-apply time; this CR makes slice capacity a
+# scheduled, elastic lifecycle instead.
+
+# ICI topology strings: "8", "2x4", "4x4x4" — up to 3 axes, each 1-999.
+TOPOLOGY_PATTERN = r"^[1-9][0-9]{0,2}(x[1-9][0-9]{0,2}){0,2}$"
+
+
+class SlicePhase:
+    """status.phase values (scheduler-owned)."""
+
+    PENDING = "Pending"            # queued; no capacity granted yet
+    BOUND = "Bound"                # granted: member nodes carry the label
+    UNSCHEDULABLE = "Unschedulable"  # no eligible capacity can ever satisfy it
+
+    ALL = (PENDING, BOUND, UNSCHEDULABLE)
+
+
+@dataclass
+class TPUSliceRequestSpec(SpecBase):
+    """One slice-capacity request.
+
+    ``topology`` is the desired ICI shape; the elastic bounds
+    ``minTopology``/``maxTopology`` (Podracer-style pools) let the
+    scheduler grant anything in that chip range — growing the grant when
+    capacity frees up and shrinking it (through checkpoint–reshard
+    migration) when capacity is lost, instead of failing the request.
+    ``generation`` pins the grant to one accelerator kind (mixed v5e/v5p
+    fleets); empty accepts any single kind.  ``multislice`` permits a
+    DCN-split grant across up to ``maxSlices`` arcs when no contiguous ICI
+    box is big enough — the scheduler then stamps the multislice-group
+    labels the validator's cross-slice rendezvous consumes.  Higher
+    ``priority`` requests place first within a pass."""
+
+    topology: str = field(default="", metadata={"pattern": TOPOLOGY_PATTERN})
+    min_topology: Optional[str] = field(
+        default=None, metadata={"pattern": TOPOLOGY_PATTERN}
+    )
+    max_topology: Optional[str] = field(
+        default=None, metadata={"pattern": TOPOLOGY_PATTERN}
+    )
+    # GKE accelerator label value (e.g. tpu-v5p-slice); "" = any one kind
+    generation: str = ""
+    multislice: bool = False
+    max_slices: int = field(default=4, metadata={"minimum": 1})
+    priority: int = 0
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
+class TPUSliceRequest:
+    obj: dict
+    _spec_cache: Optional["TPUSliceRequestSpec"] = field(
+        default=None, repr=False, compare=False
+    )
+
+    @classmethod
+    def new(cls, name: str, spec: Optional[dict] = None) -> "TPUSliceRequest":
+        return cls(
+            obj={
+                "apiVersion": f"{GROUP}/{SLICE_REQUEST_VERSION}",
+                "kind": SLICE_REQUEST_KIND,
+                "metadata": {"name": name},
+                "spec": spec or {},
+            }
+        )
+
+    @property
+    def name(self) -> str:
+        return self.obj["metadata"]["name"]
+
+    @property
+    def spec(self) -> TPUSliceRequestSpec:
+        if self._spec_cache is None:
+            self._spec_cache = TPUSliceRequestSpec.from_dict(
+                self.obj.get("spec") or {}
+            )
+        return self._spec_cache
+
+    @property
+    def status(self) -> dict:
+        return self.obj.setdefault("status", {})
 
 
 @dataclass

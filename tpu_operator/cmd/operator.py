@@ -136,6 +136,7 @@ async def run(args: argparse.Namespace) -> None:
     from tpu_operator.controllers.health import HealthReconciler
     from tpu_operator.controllers.remediation import RemediationReconciler
     from tpu_operator.controllers.revalidation import RevalidationCoordinator
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
     from tpu_operator.controllers.tpuruntime import TPURuntimeReconciler
     from tpu_operator.controllers.upgrade import UpgradeReconciler
 
@@ -187,6 +188,9 @@ async def run(args: argparse.Namespace) -> None:
             return _cc.has_kind_labels(*(kind.split("/", 2) + ["", ""])[:3])
     RevalidationCoordinator(client, namespace, warm_fn=warm_fn, **obs).setup(mgr)
     HealthReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
+    # elastic multi-slice scheduler: TPUSliceRequest lifecycle + scored
+    # placement + defrag-by-migration (docs/SCHEDULING.md)
+    SliceSchedulerReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

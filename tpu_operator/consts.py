@@ -69,6 +69,19 @@ HEALTH_SLICE_DEGRADED = "slice-degraded"  # peer-of-unhealthy-host, label only
 # proves a cross-slice rendezvous before gating jax-ready (no reference
 # analogue — NVLink/IB fabric validation does not exist in the reference).
 MULTISLICE_GROUP_LABEL = "tpu.google.com/multislice-group"
+# Elastic multi-slice scheduler (controllers/slicescheduler.py +
+# tpu_operator/scheduling/; docs/SCHEDULING.md).  A granted TPUSliceRequest
+# is BOUND by stamping every member node of its slice arc(s) with the
+# request's name here — the label is the allocation ledger the scheduler
+# reads back each pass (stateless across operator restarts), and the
+# existing consumers (health slice semantics, migration target selection,
+# revalidation kinds) keep working off the same node-label surface.  For a
+# DCN-split grant spanning several arcs the scheduler additionally stamps
+# MULTISLICE_GROUP_LABEL=<request> + MULTISLICE_SLICES_LABEL=<n> (exactly
+# what the validator's cross-slice rendezvous consumes), releasing them
+# only when the value still names the request (an admin's own multislice
+# grouping is never touched).
+SLICE_REQUEST_LABEL = "tpu.google.com/tpu.slice.request"
 # Expected member-slice count for the group: with it, validation FAILS (and
 # retries) until exactly that many slices are visible — the label query
 # alone cannot distinguish "group of one" from "other slices not up yet".
@@ -273,6 +286,12 @@ REVALIDATION_REQUEUE_SECONDS = 5.0
 # sustained bad signal must accumulate observations between passes, so the
 # engine requeues much faster than the upgrade machine
 HEALTH_REQUEUE_SECONDS = 10.0
+# Slice-scheduler cadences (controllers/slicescheduler.py): the pending
+# revisit is the safety net behind event-driven kicks (capacity or request
+# churn enqueues the key immediately); a defrag move in flight revisits
+# fast because each pass drives one non-blocking migration step
+SLICE_SCHEDULER_REQUEUE_SECONDS = 5.0
+SLICE_DEFRAG_REQUEUE_SECONDS = 1.0
 RATE_LIMIT_BASE_SECONDS = 0.1        # clusterpolicy_controller.go:354
 RATE_LIMIT_MAX_SECONDS = 3.0
 

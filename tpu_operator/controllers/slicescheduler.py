@@ -1,0 +1,784 @@
+"""Elastic multi-slice scheduler: the TPUSliceRequest lifecycle controller.
+
+``slices.py`` tiles ONE mesh at policy-apply time (the MIG-manager
+analogue); this controller promotes slice capacity into a scheduled,
+elastic lifecycle (ROADMAP item 3).  TPUSliceRequest CRs queue through a
+single fleet-keyed pass on the shared priority/fairness workqueue, the
+pure placement engine (``tpu_operator/scheduling/``) scores candidates —
+contiguous-ICI single-arc fits first, DCN-split multislice grants for
+requests bigger than any one mesh, generation-aware pools for mixed
+v5e/v5p fleets — and a grant BINDS by stamping member nodes with
+``consts.SLICE_REQUEST_LABEL``: the node-label surface the rest of the
+operator (health slice semantics, migration target selection,
+revalidation kinds, the validator's multislice rendezvous) already
+consumes, and the ledger this controller reads back each pass, so a
+restarted operator reconstructs every grant from the cluster itself.
+
+Elasticity (Podracer-style pools): a request's ``minTopology`` /
+``maxTopology`` bound the chip range the scheduler may grant.  Capacity
+loss (quarantine, cordon, upgrade) re-places the grant onto what remains
+— shrinking toward the minimum rather than failing — and freed capacity
+grows under-provisioned grants back toward the desired shape, both
+through the checkpoint–reshard–restore migration machine so running work
+moves, it is not lost.
+
+Defragmentation: when the free-capacity fragmentation ratio exceeds
+``scheduling.defragThreshold``, the scheduler compacts one single-arc
+grant at a time onto the smallest free arc that still satisfies it,
+driving the grant's workload pods through
+``MigrationCoordinator.drain_pod`` (checkpoint → reshard onto the
+consolidated box → restore) — never a plain evict.  A grant holding any
+workload pod that did NOT opt into migration is never compacted: a job
+that cannot checkpoint must not be disturbed for tidiness.
+
+Steady state is API-free: every read rides the informer-backed
+CachedReader, status/label writes happen only on transitions, and pod
+lists happen only while a compaction move is in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Optional
+
+from tpu_operator import consts, scheduling
+from tpu_operator.api.types import (
+    CLUSTER_POLICY_KIND,
+    GROUP,
+    SLICE_REQUEST_KIND,
+    SLICE_REQUEST_VERSION,
+    SchedulingSpec,
+    SlicePhase,
+    TPUClusterPolicy,
+    TPUSliceRequest,
+)
+from tpu_operator.controllers import clusterinfo
+from tpu_operator.controllers import migration as mig
+from tpu_operator.controllers.runtime import Controller, Manager
+from tpu_operator.k8s.cache import CachedReader
+from tpu_operator.k8s.client import ApiClient, ApiError
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import events as obs_events
+from tpu_operator.obs import fleet as obs_fleet
+from tpu_operator.obs.events import EventRecorder
+from tpu_operator.obs.trace import Tracer
+from tpu_operator.utils import topology_chips
+
+log = logging.getLogger("tpu_operator.slicescheduler")
+
+RECONCILE_KEY = "slices"
+
+# how long a vetoed relocation (non-migratable workload pod on the grant)
+# sits out before defrag/grow may retry it
+MOVE_VETO_RETRY_SECONDS = 60.0
+
+# in-flight compaction/grow move bookkeeping reasons (placements_total)
+OUTCOME_PLACED = "placed"
+OUTCOME_UNSCHEDULABLE = "unschedulable"
+OUTCOME_PREEMPTED = "preempted"
+OUTCOME_COMPACTED = "compacted"
+OUTCOME_GROWN = "grown"
+OUTCOME_RELEASED = "released"
+
+
+class _Move:
+    """One in-flight relocation (compaction or elastic grow): the target
+    arc is stamped first (reserving it from other requests), the source
+    keeps its stamp until its workload pods have drained through the
+    migration machine, then the source is released and the grant status
+    flips — so a crash mid-move leaves both arcs labelled and the next
+    pass simply resumes the drain."""
+
+    def __init__(self, request: str, source_key: str, target_key: str,
+                 granted: str, outcome: str):
+        self.request = request
+        self.source_key = source_key
+        self.target_key = target_key
+        self.granted = granted
+        self.outcome = outcome
+        self.started = time.monotonic()
+
+
+class SliceSchedulerReconciler:
+    def __init__(
+        self,
+        client: ApiClient,
+        namespace: str,
+        metrics: Optional[OperatorMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[EventRecorder] = None,
+        fleet=None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics or OperatorMetrics()
+        self.tracer = tracer or Tracer(self.metrics)
+        self.recorder = recorder or EventRecorder(client, namespace)
+        # obs.fleet.FleetAggregator (optional): placement latency +
+        # fragmentation land as fleet series for /debug/fleet rollups
+        self.fleet = fleet
+        # reads ride informers registered in setup(); direct-drive tests
+        # without informers fall back live with identical behaviour
+        self.reader = CachedReader(client, metrics=self.metrics)
+        # writes go through the reader too: write-through keeps the next
+        # cached pass seeing its own binds instead of re-issuing them
+        self.migration = mig.MigrationCoordinator(
+            self.reader, namespace, metrics=self.metrics,
+            recorder=self.recorder,
+        )
+        # request name -> monotonic ts first seen pending (placement
+        # latency); falls back to 0-latency for requests first seen bound
+        self._first_pending: dict[str, float] = {}
+        # ONE move in flight at a time: compaction is deliberate, bounded
+        # disruption — not a fleet-wide shuffle
+        self._move: Optional[_Move] = None
+        # vetoed relocations ((request, source arc) -> retry-not-before):
+        # a non-migratable pod vetoes a move, and without this memo the
+        # identical move re-arms every pass — a permanent loop of stamp/
+        # release patches and pod lists against a steady cluster
+        self._move_veto: dict[tuple[str, str], float] = {}
+        # phases whose Unschedulable warning already posted (per request):
+        # the Event correlator dedups, but a repeat post still writes
+        self._warned_unschedulable: set[str] = set()
+
+    # ------------------------------------------------------------------
+    async def reconcile(self, key: str) -> Optional[float]:
+        with self.tracer.reconcile("slicescheduler", key=key):
+            return await self._reconcile(key)
+
+    async def _reconcile(self, key: str) -> Optional[float]:
+        policy_obj = await clusterinfo.active_cluster_policy(self.reader)
+        if policy_obj is None:
+            return None
+        policy = TPUClusterPolicy(policy_obj)
+        sched_spec: SchedulingSpec = policy.spec.scheduling
+        if not sched_spec.enabled:
+            return None
+
+        request_objs = await self.reader.list_items(GROUP, SLICE_REQUEST_KIND)
+        nodes = await self.reader.list_items("", "Node")
+        arcs = scheduling.arcs_from_nodes(nodes)
+        nodes_by_name = {n["metadata"]["name"]: n for n in nodes}
+
+        live: dict[str, TPUSliceRequest] = {}
+        parsed: dict[str, scheduling.Request] = {}
+        for obj in request_objs:
+            cr = TPUSliceRequest(obj)
+            live[cr.name] = cr
+            try:
+                parsed[cr.name] = scheduling.request_from_spec(cr.name, cr.spec)
+            except ValueError as e:
+                await self._set_status(cr, SlicePhase.UNSCHEDULABLE, message=str(e))
+                await self._warn_unschedulable(cr.name, str(e))
+
+        # -- release: stamps for requests that no longer exist ------------
+        arcs = await self._collect_garbage(arcs, live)
+        # bookkeeping for requests that died before ever binding: their
+        # first-seen timestamps must not leak (nor poison the placement
+        # latency of a future request reusing the name)
+        for name in list(self._first_pending):
+            if name not in live:
+                del self._first_pending[name]
+        self._warned_unschedulable &= set(live)
+
+        # -- in-flight move: drive it one non-blocking step ----------------
+        busy_move = False
+        if self._move is not None:
+            move_request, move_target = self._move.request, self._move.target_key
+            busy_move = await self._drive_move(
+                arcs, nodes_by_name, live, policy
+            )
+            # the drive stamped the target AFTER this pass's node list was
+            # taken: claim it in the in-memory view too, or the pending
+            # loop below would double-book the reserved arc onto another
+            # request (conservative on the veto path — the released target
+            # simply sits out one pass)
+            arcs = [
+                dataclasses.replace(a, assigned=a.assigned or move_request)
+                if a.key == move_target else a
+                for a in arcs
+            ]
+
+        owned: dict[str, list[scheduling.Arc]] = {}
+        for a in arcs:
+            if a.assigned:
+                owned.setdefault(a.assigned, []).append(a)
+
+        # -- bound grants: heal capacity loss (elastic shrink) -------------
+        preempted = await self._heal_bound(arcs, live, parsed, owned)
+        if preempted:
+            # re-derive the allocation view: healing moved stamps
+            nodes = await self.reader.list_items("", "Node")
+            arcs = scheduling.arcs_from_nodes(nodes)
+            owned = {}
+            for a in arcs:
+                if a.assigned:
+                    owned.setdefault(a.assigned, []).append(a)
+
+        # -- pending requests: scored placement ----------------------------
+        pending = sorted(
+            (
+                parsed[name]
+                for name in parsed
+                if name not in owned
+                and (self._move is None or self._move.request != name)
+            ),
+            key=lambda r: (-r.priority, self._first_seen(r.name), r.name),
+        )
+        have_pending = False
+        for request in pending:
+            grant = scheduling.plan_placement(request, arcs)
+            if grant is None:
+                # only a placeable-later request keeps the poll alive; a
+                # terminally Unschedulable one waits for informer events
+                if await self._mark_unplaceable(live[request.name], request, arcs):
+                    have_pending = True
+                continue
+            await self._bind(live[request.name], request, grant)
+            # claimed arcs leave the free pool for the rest of this pass
+            taken = {a.key for a in grant.arcs}
+            arcs = [
+                a if a.key not in taken else
+                dataclasses.replace(a, assigned=request.name)
+                for a in arcs
+            ]
+
+        # -- elastic grow + defrag (one move at a time) ---------------------
+        if self._move is None:
+            self._plan_next_move(arcs, parsed, owned, sched_spec)
+            busy_move = self._move is not None
+
+        self._export(arcs, live, parsed, owned)
+
+        if busy_move:
+            return consts.SLICE_DEFRAG_REQUEUE_SECONDS
+        if have_pending:
+            return consts.SLICE_SCHEDULER_REQUEUE_SECONDS
+        if self._move_veto:
+            # a vetoed relocation retries after its window even on a
+            # quiet cluster — one bounded revisit, not a poll loop
+            return MOVE_VETO_RETRY_SECONDS
+        return None
+
+    # ------------------------------------------------------------------
+    def _first_seen(self, name: str) -> float:
+        return self._first_pending.setdefault(name, time.monotonic())
+
+    async def _collect_garbage(
+        self,
+        arcs: list[scheduling.Arc],
+        live: dict[str, TPUSliceRequest],
+    ) -> list[scheduling.Arc]:
+        """Strip allocation stamps whose request no longer exists; the
+        label ledger must never outlive its CR (a deleted request IS the
+        release API)."""
+        out: list[scheduling.Arc] = []
+        released: set[str] = set()
+        for a in arcs:
+            if a.assigned and a.assigned not in live:
+                await self._release_arc(a, a.assigned)
+                released.add(a.assigned)  # one decision, however many arcs
+                if self._move is not None and self._move.request == a.assigned:
+                    self._move = None
+                a = dataclasses.replace(a, assigned="")
+            out.append(a)
+        for _ in released:
+            self.metrics.slice_placements_total.labels(
+                outcome=OUTCOME_RELEASED
+            ).inc()
+        return out
+
+    async def _release_arc(
+        self, arc: scheduling.Arc, request_name: str
+    ) -> None:
+        """Remove our stamps from every member: the allocation label
+        always, the multislice rendezvous labels only while they still
+        name the request (an admin's own grouping is never touched)."""
+        for name in arc.nodes:
+            # fresh read through the reader (write-through cache): the
+            # caller's node snapshot predates any stamps THIS pass made,
+            # and a conditional strip off stale labels would skip them
+            try:
+                node = await self.reader.get("", "Node", name)
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                continue
+            labels = node.get("metadata", {}).get("labels") or {}
+            patch: dict[str, Optional[str]] = {}
+            if labels.get(consts.SLICE_REQUEST_LABEL) == request_name:
+                patch[consts.SLICE_REQUEST_LABEL] = None
+            if labels.get(consts.MULTISLICE_GROUP_LABEL) == request_name:
+                patch[consts.MULTISLICE_GROUP_LABEL] = None
+                patch[consts.MULTISLICE_SLICES_LABEL] = None
+            if not patch:
+                continue
+            try:
+                await self.reader.patch(
+                    "", "Node", name, {"metadata": {"labels": patch}}
+                )
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+
+    async def _stamp_arc(
+        self,
+        arc: scheduling.Arc,
+        request_name: str,
+        multislice_of: int = 0,
+    ) -> None:
+        for name in arc.nodes:
+            labels: dict[str, Optional[str]] = {
+                consts.SLICE_REQUEST_LABEL: request_name
+            }
+            if multislice_of > 1:
+                labels[consts.MULTISLICE_GROUP_LABEL] = request_name
+                labels[consts.MULTISLICE_SLICES_LABEL] = str(multislice_of)
+            await self.reader.patch(
+                "", "Node", name, {"metadata": {"labels": labels}}
+            )
+
+    # ------------------------------------------------------------------
+    async def _bind(
+        self,
+        cr: TPUSliceRequest,
+        request: scheduling.Request,
+        grant: scheduling.Grant,
+    ) -> None:
+        n_slices = len(grant.arcs) if grant.multislice else 0
+        for arc in grant.arcs:
+            await self._stamp_arc(arc, request.name, multislice_of=n_slices)
+        await self._set_status(
+            cr, SlicePhase.BOUND,
+            granted=grant.topology, chips=grant.chips,
+            arcs=[
+                {
+                    "key": a.key, "topology": a.topology,
+                    "generation": a.generation, "nodes": list(a.nodes),
+                }
+                for a in grant.arcs
+            ],
+        )
+        first = self._first_pending.pop(request.name, None)
+        latency = max(0.0, time.monotonic() - first) if first is not None else 0.0
+        self.metrics.slice_placement_latency.observe(latency)
+        self.metrics.slice_placements_total.labels(outcome=OUTCOME_PLACED).inc()
+        if self.fleet is not None:
+            self.fleet.ingest(
+                obs_fleet.METRIC_SLICE_PLACEMENT, latency,
+                source=obs_fleet.SOURCE_NODE,
+            )
+        self._warned_unschedulable.discard(request.name)
+        where = ", ".join(f"{a.key} ({a.topology})" for a in grant.arcs)
+        message = (
+            f"slice request {request.name} bound: topology {grant.topology} "
+            f"({grant.chips} chips) on {where}"
+            + (f" [multislice x{n_slices}]" if n_slices > 1 else "")
+        )
+        await self.recorder.normal(
+            obs_events.slicerequest_ref(request.name),
+            obs_events.REASON_SLICE_PLACED, message,
+        )
+        # mirrored per member node so /debug/explain timelines carry the
+        # decision (the explain engine only ingests Node-involved Events)
+        for arc in grant.arcs:
+            for node_name in arc.nodes:
+                await self.recorder.normal(
+                    obs_events.node_ref(node_name),
+                    obs_events.REASON_SLICE_PLACED, message,
+                )
+        log.info("placed %s: %s", request.name, message)
+
+    async def _mark_unplaceable(
+        self,
+        cr: TPUSliceRequest,
+        request: scheduling.Request,
+        arcs: list[scheduling.Arc],
+    ) -> bool:
+        """No grant THIS pass: Pending (returns True — revisit on the
+        cadence) while busy capacity could satisfy it later, terminal
+        Unschedulable (returns False — only a fleet-shape event can
+        change the answer, and informer events kick the key) when no arc
+        in the fleet — free or not — ever could."""
+        hypothetical = [
+            dataclasses.replace(a, assigned="") for a in arcs if a.eligible
+        ]
+        if scheduling.plan_placement(request, hypothetical) is None:
+            await self._set_status(
+                cr, SlicePhase.UNSCHEDULABLE,
+                message=(
+                    f"no slice arc can satisfy topology {request.topology} "
+                    f"(generation {request.generation or 'any'}); "
+                    "the fleet has no such capacity shape"
+                ),
+            )
+            await self._warn_unschedulable(
+                request.name,
+                f"{request.name}: no capacity shape in the fleet can ever "
+                f"satisfy topology {request.topology}",
+            )
+            return False
+        await self._set_status(
+            cr, SlicePhase.PENDING,
+            message="waiting for capacity (all fitting arcs busy)",
+        )
+        return True
+
+    async def _warn_unschedulable(self, name: str, message: str) -> None:
+        if name in self._warned_unschedulable:
+            return
+        self._warned_unschedulable.add(name)
+        self.metrics.slice_placements_total.labels(
+            outcome=OUTCOME_UNSCHEDULABLE
+        ).inc()
+        await self.recorder.warning(
+            obs_events.slicerequest_ref(name),
+            obs_events.REASON_SLICE_UNSCHEDULABLE, message,
+        )
+
+    # ------------------------------------------------------------------
+    async def _heal_bound(
+        self,
+        arcs: list[scheduling.Arc],
+        live: dict[str, TPUSliceRequest],
+        parsed: dict[str, scheduling.Request],
+        owned: dict[str, list[scheduling.Arc]],
+    ) -> bool:
+        """Elastic shrink: a grant whose arc went ineligible (quarantine,
+        cordon, upgrade) re-places onto the best remaining capacity —
+        down to ``minTopology`` — or returns to Pending.  The failed
+        arc's stamps are released either way; its workload pods are the
+        health/upgrade drain's job (those paths already migrate), ours is
+        the capacity ledger."""
+        preempted = False
+        for name, held in sorted(owned.items()):
+            if self._move is not None and self._move.request == name:
+                continue  # the move driver owns this grant's arcs
+            if name not in parsed:
+                continue  # invalid spec: status already Unschedulable
+            if all(a.eligible for a in held):
+                continue
+            preempted = True
+            request = parsed[name]
+            cr = live[name]
+            for a in held:
+                await self._release_arc(a, name)
+            # reflect the release in the loop's own view: a later grant
+            # healed in this same pass must see these arcs free (if still
+            # eligible) and must NOT see arcs this grant re-claims below
+            arcs = [
+                dataclasses.replace(a, assigned="")
+                if a.assigned == name else a
+                for a in arcs
+            ]
+            grant = scheduling.plan_placement(request, arcs)
+            lost = ", ".join(a.key for a in held if not a.eligible)
+            self.metrics.slice_placements_total.labels(
+                outcome=OUTCOME_PREEMPTED
+            ).inc()
+            await self.recorder.warning(
+                obs_events.slicerequest_ref(name),
+                obs_events.REASON_SLICE_PREEMPTED,
+                f"slice request {name} lost capacity ({lost} ineligible); "
+                + ("re-placing on remaining capacity"
+                   if grant is not None else "re-queued pending capacity"),
+            )
+            for a in held:
+                for node_name in a.nodes:
+                    await self.recorder.warning(
+                        obs_events.node_ref(node_name),
+                        obs_events.REASON_SLICE_PREEMPTED,
+                        f"slice request {name} unbound from {a.key}: "
+                        "arc no longer eligible",
+                    )
+            if grant is not None:
+                await self._bind(cr, request, grant)
+                taken = {a.key for a in grant.arcs}
+                arcs = [
+                    dataclasses.replace(a, assigned=name)
+                    if a.key in taken else a
+                    for a in arcs
+                ]
+            else:
+                self._first_pending.setdefault(name, time.monotonic())
+                await self._set_status(
+                    cr, SlicePhase.PENDING,
+                    message=f"capacity lost ({lost}); waiting for re-placement",
+                )
+        return preempted
+
+    # ------------------------------------------------------------------
+    def _plan_next_move(
+        self,
+        arcs: list[scheduling.Arc],
+        parsed: dict[str, scheduling.Request],
+        owned: dict[str, list[scheduling.Arc]],
+        sched_spec: SchedulingSpec,
+    ) -> None:
+        """Arm at most ONE relocation: defrag compaction first (it
+        unblocks pending capacity), elastic grow second."""
+        bound = {
+            name: parsed[name]
+            for name in owned
+            if name in parsed and len(owned[name]) == 1
+        }
+        now = time.monotonic()
+        vetoed: set[str] = set()
+        for (name, source_key), until in list(self._move_veto.items()):
+            held = owned.get(name)
+            if until <= now or not held or held[0].key != source_key:
+                # expired, or the grant moved on its own: retry is fair
+                del self._move_veto[(name, source_key)]
+            else:
+                vetoed.add(name)
+        move = scheduling.plan_compaction(
+            arcs, bound, float(sched_spec.defrag_threshold), exclude=vetoed
+        )
+        outcome = OUTCOME_COMPACTED
+        if move is None:
+            move = self._plan_grow(
+                arcs, {n: r for n, r in bound.items() if n not in vetoed},
+                owned,
+            )
+            outcome = OUTCOME_GROWN
+        if move is None:
+            return
+        self._move = _Move(
+            move.request, move.source.key, move.target.key,
+            move.granted_topology, outcome,
+        )
+        log.info(
+            "%s move armed: %s from %s (%s) to %s (%s)",
+            outcome, move.request, move.source.key, move.source.topology,
+            move.target.key, move.target.topology,
+        )
+
+    def _plan_grow(
+        self,
+        arcs: list[scheduling.Arc],
+        bound: dict[str, scheduling.Request],
+        owned: dict[str, list[scheduling.Arc]],
+    ) -> Optional[scheduling.Compaction]:
+        """Elastic grow: an under-provisioned grant (below its desired
+        chips) moves to a free arc strictly closer to the desired shape."""
+        for name in sorted(bound):
+            request = bound[name]
+            source = owned[name][0]
+            if not source.eligible or source.chips >= request.desired_chips:
+                continue
+            free_view = [a for a in arcs if a.free]
+            grant = scheduling.plan_placement(request, free_view)
+            if grant is None or grant.multislice or len(grant.arcs) != 1:
+                continue
+            target = grant.arcs[0]
+            if target.chips <= source.chips:
+                continue
+            return scheduling.Compaction(
+                request=name, source=source, target=target,
+                granted_topology=grant.topology, freed_chips=source.chips,
+            )
+        return None
+
+    async def _drive_move(
+        self,
+        arcs: list[scheduling.Arc],
+        nodes_by_name: dict[str, dict],
+        live: dict[str, TPUSliceRequest],
+        policy: TPUClusterPolicy,
+    ) -> bool:
+        """One non-blocking step of the in-flight relocation.  Returns
+        True while the move still needs revisiting."""
+        move = self._move
+        assert move is not None
+        arcs_by_key = {a.key: a for a in arcs}
+        source = arcs_by_key.get(move.source_key)
+        target = arcs_by_key.get(move.target_key)
+        cr = live.get(move.request)
+        if cr is None or source is None or target is None:
+            self._move = None  # request/arc vanished; GC handled the stamps
+            return False
+        if not target.eligible:
+            # the target degraded between arming and driving: abort before
+            # migrating a workload onto capacity the very next pass would
+            # preempt it off again
+            log.warning(
+                "aborting %s move of %s: target %s no longer eligible",
+                move.outcome, move.request, move.target_key,
+            )
+            await self._release_arc(target, move.request)
+            self._move = None  # race-ok: single-writer reconcile key
+            return False
+        if target.assigned != move.request:
+            # reserve the consolidated box FIRST: a crash after this patch
+            # leaves both arcs stamped, and the next pass resumes here
+            await self._stamp_arc(target, move.request)
+
+        # settle the source's workload pods through the migration machine,
+        # steered at the target arc's members.  Non-migratable workload
+        # pods veto the whole move (zero-loss or nothing).
+        migration_spec = policy.spec.migration
+        target_nodes = [
+            nodes_by_name[n] for n in target.nodes if n in nodes_by_name
+        ]
+        remaining = 0
+        for node_name in source.nodes:
+            pods = await self.reader.list_items(
+                "", "Pod", field_selector=f"spec.nodeName={node_name}"
+            )
+            for pod in mig.workload_pods(pods, node_name):
+                if not mig.is_migratable(pod):
+                    log.warning(
+                        "aborting %s move of %s: pod %s on %s did not opt "
+                        "into migration", move.outcome, move.request,
+                        pod["metadata"]["name"], node_name,
+                    )
+                    await self._release_arc(target, move.request)
+                    # memoize the veto: the same move must not re-arm
+                    # every pass (a permanent stamp/release/pod-list loop
+                    # against a steady cluster); retried after the window
+                    # in case the blocking pod finished or opted in
+                    self._move_veto[(move.request, move.source_key)] = (
+                        time.monotonic() + MOVE_VETO_RETRY_SECONDS
+                    )
+                    self._move = None  # race-ok: single-writer reconcile key
+                    return False
+                outcome = await self.migration.drain_pod(
+                    pod, migration_spec, "slicescheduler", nodes=target_nodes
+                )
+                if outcome in (mig.PENDING,):
+                    remaining += 1
+        if remaining:
+            return True
+
+        # source drained: release it and flip the grant
+        await self._release_arc(source, move.request)
+        await self._set_status(
+            cr, SlicePhase.BOUND,
+            granted=move.granted, chips=topology_chips(move.granted),
+            arcs=[{
+                "key": target.key, "topology": target.topology,
+                "generation": target.generation, "nodes": list(target.nodes),
+            }],
+        )
+        self.metrics.slice_placements_total.labels(outcome=move.outcome).inc()
+        verb = "compacted" if move.outcome == OUTCOME_COMPACTED else "grown"
+        message = (
+            f"slice request {move.request} {verb}: {move.source_key} "
+            f"({source.topology}) -> {move.target_key} ({target.topology}), "
+            f"workloads migrated checkpoint-reshard-restore"
+        )
+        reason = (
+            obs_events.REASON_SLICE_COMPACTED
+            if move.outcome == OUTCOME_COMPACTED
+            else obs_events.REASON_SLICE_PLACED
+        )
+        await self.recorder.normal(
+            obs_events.slicerequest_ref(move.request), reason, message
+        )
+        for node_name in (*source.nodes, *target.nodes):
+            await self.recorder.normal(
+                obs_events.node_ref(node_name), reason, message
+            )
+        log.info("%s", message)
+        # only the "slices" key's reconcile touches _move, and the
+        # workqueue's dirty-set semantics guarantee that key never runs
+        # concurrently with itself
+        self._move = None  # race-ok: single-writer reconcile key
+        return False
+
+    # ------------------------------------------------------------------
+    async def _set_status(
+        self,
+        cr: TPUSliceRequest,
+        phase: str,
+        message: str = "",
+        granted: str = "",
+        chips: int = 0,
+        arcs: Optional[list[dict]] = None,
+    ) -> None:
+        desired = {
+            "phase": phase,
+            "message": message,
+            "grantedTopology": granted,
+            "chips": chips,
+            "arcs": arcs or [],
+        }
+        current = {
+            k: (cr.status.get(k) or ([] if k == "arcs" else type(v)()))
+            for k, v in desired.items()
+        }
+        if current == desired:
+            return  # zero-write steady state
+        # list items carry no TypeMeta; the status PUT needs a full object
+        obj = {
+            "apiVersion": f"{GROUP}/{SLICE_REQUEST_VERSION}",
+            "kind": SLICE_REQUEST_KIND,
+            **{k: v for k, v in cr.obj.items() if k not in ("apiVersion", "kind")},
+        }
+        obj["status"] = {**cr.status, **desired}
+        try:
+            await self.reader.update_status(obj)
+        except ApiError as e:
+            if e.conflict:
+                log.debug("status conflict on %s; next pass re-asserts", cr.name)
+            elif not e.not_found:
+                raise
+
+    def _export(
+        self,
+        arcs: list[scheduling.Arc],
+        live: dict[str, TPUSliceRequest],
+        parsed: dict[str, scheduling.Request],
+        owned: dict[str, list[scheduling.Arc]],
+    ) -> None:
+        frag = scheduling.fragmentation(arcs)
+        self.metrics.slice_fragmentation_ratio.set(frag)
+        if self.fleet is not None:
+            self.fleet.ingest(
+                obs_fleet.METRIC_SLICE_FRAGMENTATION, frag,
+                source=obs_fleet.SOURCE_NODE,
+            )
+        counts = {p: 0 for p in SlicePhase.ALL}
+        for name, cr in live.items():
+            if name in owned:
+                counts[SlicePhase.BOUND] += 1
+            elif name not in parsed:
+                counts[SlicePhase.UNSCHEDULABLE] += 1
+            else:
+                phase = cr.status.get("phase") or SlicePhase.PENDING
+                counts[
+                    phase if phase in counts else SlicePhase.PENDING
+                ] += 1
+        for phase, n in counts.items():
+            self.metrics.slice_requests.labels(phase=phase).set(n)
+
+    # ------------------------------------------------------------------
+    def setup(self, mgr: Manager) -> Controller:
+        controller = mgr.add_controller(
+            Controller("slicescheduler", self.reconcile)
+        )
+        policies = mgr.informer(GROUP, CLUSTER_POLICY_KIND)
+        requests = mgr.informer(GROUP, SLICE_REQUEST_KIND)
+        nodes = mgr.informer("", "Node")
+        for inf in (policies, requests, nodes):
+            self.reader.add_informer(inf)
+
+        async def kick(event_type: str, obj: dict) -> None:
+            controller.enqueue(RECONCILE_KEY)
+
+        async def on_node(event_type: str, obj: dict) -> None:
+            labels = (obj.get("metadata", {}).get("labels")) or {}
+            # only TPU capacity (or a node carrying our stamp) can change
+            # a placement decision; CPU-node churn stays out of the queue
+            if (
+                consts.GKE_TPU_ACCELERATOR_LABEL in labels
+                or consts.SLICE_REQUEST_LABEL in labels
+            ):
+                controller.enqueue(RECONCILE_KEY)
+
+        requests.add_handler(kick)
+        policies.add_handler(kick)
+        nodes.add_handler(on_node)
+        return controller

@@ -64,6 +64,7 @@ for g, v, k, pl, ns in [
     # Operator CRDs (api/ package).
     ("tpu.google.com", "v1", "TPUClusterPolicy", "tpuclusterpolicies", False),
     ("tpu.google.com", "v1alpha1", "TPURuntime", "tpuruntimes", False),
+    ("tpu.google.com", "v1alpha1", "TPUSliceRequest", "tpuslicerequests", False),
 ]:
     register_kind(g, v, k, pl, ns)
 

@@ -390,6 +390,42 @@ class OperatorMetrics:
             ["outcome"],
             registry=self.registry,
         )
+        # elastic multi-slice scheduler (controllers/slicescheduler.py +
+        # tpu_operator/scheduling/; docs/SCHEDULING.md).  Label spaces are
+        # bounded enums (phase, outcome), never request names.
+        self.slice_requests = Gauge(
+            "tpu_operator_slice_requests",
+            "TPUSliceRequest count by status.phase "
+            "(Pending | Bound | Unschedulable)",
+            ["phase"],
+            registry=self.registry,
+        )
+        self.slice_placements_total = Counter(
+            "tpu_operator_slice_placements_total",
+            "Slice-scheduler decisions, by outcome: placed (request bound "
+            "to capacity), unschedulable (no eligible capacity can satisfy "
+            "it), preempted (grant lost its arc to failure/quarantine and "
+            "was re-placed or re-queued), compacted (defrag moved a grant "
+            "onto a smaller free arc through migration), grown (elastic "
+            "grant re-placed onto bigger capacity), released (request "
+            "deleted or labels garbage-collected)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.slice_placement_latency = Histogram(
+            "tpu_operator_slice_placement_latency_seconds",
+            "Pending->Bound latency per TPUSliceRequest (first observed "
+            "pending to the bind patch landing)",
+            registry=self.registry,
+            buckets=DURATION_BUCKETS,
+        )
+        self.slice_fragmentation_ratio = g(
+            "tpu_operator_slice_fragmentation_ratio",
+            "Free-capacity fragmentation: 1 - largest_free_arc_chips / "
+            "total_free_chips over eligible free arcs (0 = one contiguous "
+            "box holds all free capacity; defrag arms above "
+            "scheduling.defragThreshold)",
+        )
         # batched revalidation coordinator (controllers/revalidation.py):
         # warm-pool scheduling of fleet-wide re-validation waves
         self.revalidation_pending = g(

@@ -57,6 +57,12 @@ REASON_NODE_RECOVERED = "NodeRecovered"
 REASON_NODE_QUARANTINED = "NodeQuarantined"
 REASON_HEALTH_BUDGET_EXHAUSTED = "HealthBudgetExhausted"
 REASON_HEALTH_BUDGET_RESTORED = "HealthBudgetRestored"
+# elastic multi-slice scheduler (controllers/slicescheduler.py;
+# docs/SCHEDULING.md): request lifecycle + defrag-by-migration evidence
+REASON_SLICE_PLACED = "SlicePlaced"
+REASON_SLICE_PREEMPTED = "SlicePreempted"
+REASON_SLICE_COMPACTED = "SliceCompacted"
+REASON_SLICE_UNSCHEDULABLE = "SliceUnschedulable"
 # fleet SLO engine (obs/fleet.py; docs/OBSERVABILITY.md "Fleet telemetry
 # & SLOs"): multi-window burn-rate breach / recovery
 REASON_SLO_BURN_RATE = "SLOBurnRate"
@@ -98,6 +104,19 @@ def node_ref(name: str) -> dict:
     """Minimal involvedObject for a Node event when only the name is at
     hand (upgrade/remediation state transitions patch by name)."""
     return {"apiVersion": "v1", "kind": "Node", "metadata": {"name": name}}
+
+
+def slicerequest_ref(name: str) -> dict:
+    """involvedObject for slice-scheduler decisions on a TPUSliceRequest
+    (the scheduler also mirrors each decision onto the member nodes via
+    node_ref so /debug/explain timelines carry it)."""
+    from tpu_operator.api import types as api_types
+
+    return {
+        "apiVersion": f"{api_types.GROUP}/{api_types.SLICE_REQUEST_VERSION}",
+        "kind": api_types.SLICE_REQUEST_KIND,
+        "metadata": {"name": name},
+    }
 
 
 def _now() -> str:

@@ -67,6 +67,12 @@ METRIC_JOIN_TO_VALIDATED = "join_to_validated_seconds"
 METRIC_JOIN_PHASE = "join_phase_seconds"
 METRIC_HEALTH_UNHEALTHY = "health_verdict_unhealthy_nodes"
 METRIC_CHIP_SCRAPE_ERRORS = "chip_scrape_errors_total"
+# elastic multi-slice scheduler (controllers/slicescheduler.py): per-bind
+# placement latency and the free-capacity fragmentation ratio, ingested
+# operator-side (zero extra API verbs — the scheduler pass already holds
+# the evidence) so /debug/fleet serves windowed rollups of both
+METRIC_SLICE_PLACEMENT = "slice_placement_seconds"
+METRIC_SLICE_FRAGMENTATION = "slice_fragmentation_ratio"
 
 _WORKLOAD_METRIC_PREFIX = "tpu_workload_"
 _METRIC_NAME_MAX = 128
@@ -77,6 +83,8 @@ OPERATOR_METRICS_CATALOGUE = (
     METRIC_JOIN_PHASE,
     METRIC_HEALTH_UNHEALTHY,
     METRIC_CHIP_SCRAPE_ERRORS,
+    METRIC_SLICE_PLACEMENT,
+    METRIC_SLICE_FRAGMENTATION,
 )
 
 # join→validated critical-path phases, in pipeline order (the validator

@@ -1,0 +1,20 @@
+"""Pure slice-placement logic for the elastic multi-slice scheduler.
+
+The controller half lives in ``tpu_operator/controllers/slicescheduler.py``;
+everything here is side-effect free over plain inputs (node dicts in,
+plans out) so placement behaviour is unit-testable without a cluster —
+the Placeto lesson applied conservatively: a *scored* placement function
+whose inputs and ranking are inspectable, not a learned black box.
+"""
+
+from tpu_operator.scheduling.placement import (  # noqa: F401
+    Arc,
+    Compaction,
+    Grant,
+    Request,
+    arcs_from_nodes,
+    fragmentation,
+    plan_compaction,
+    plan_placement,
+    request_from_spec,
+)

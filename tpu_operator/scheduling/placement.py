@@ -1,0 +1,400 @@
+"""Scored slice placement over ICI arcs.
+
+The capacity model: an **arc** is the schedulable slice unit the fleet
+already exposes through node labels — a multi-host slice's node-pool group
+(``controllers/labels.slice_group_key``) or a single host — carrying one
+contiguous ICI mesh (its topology label), one accelerator generation, and
+an allocation ledger (``consts.SLICE_REQUEST_LABEL`` stamped on members).
+Granting always assigns *whole arcs*: an arc is contiguous by
+construction, so a single-arc grant is a contiguous-ICI grant, and a
+multi-arc (DCN multislice) grant is taken only when no one mesh is big
+enough and the request opted in.
+
+Scoring (lower tuple wins), in ranking order:
+
+1. **satisfaction** — distance of the granted chip count from the desired
+   topology's (exact fit first; when tied, the larger grant wins: an
+   elastic request prefers growing toward ``maxTopology`` over shrinking
+   toward ``minTopology``);
+2. **waste** — arc chips beyond the grant (best-fit packing: never burn a
+   4x4x4 on a 2x2 when a 2x4 is free — this is what keeps fragmentation
+   down *before* defrag has to undo it);
+3. **tiling** — embeddings that keep the mesh axis-divisible
+   (``slices.shape_divides``) beat mere fits;
+4. **generation abundance** — place on the generation with the most free
+   chips, preserving scarce pools (v5p stays available for requests that
+   pin it);
+5. arc key, for determinism.
+
+Everything is pure over its inputs; the controller owns reads/writes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator import consts, slices
+from tpu_operator.controllers.labels import slice_group_key
+from tpu_operator.k8s import nodeinfo
+from tpu_operator.utils import deep_get, topology_chips
+
+
+@dataclass(frozen=True)
+class Arc:
+    """One schedulable slice unit (a contiguous ICI mesh)."""
+
+    key: str                 # nodepool (multi-host) or node name
+    nodes: tuple[str, ...]   # member node names, sorted
+    topology: str            # the arc's full ICI mesh ("2x4", "4x4x4")
+    generation: str          # GKE accelerator label value
+    chips: int
+    eligible: bool           # complete + every member healthy/schedulable
+    assigned: str            # TPUSliceRequest name bound here ("" = free)
+    admin_group: str         # pre-existing multislice group NOT owned by us
+
+    @property
+    def free(self) -> bool:
+        return self.eligible and not self.assigned
+
+
+@dataclass(frozen=True)
+class Request:
+    """A TPUSliceRequestSpec reduced to the numbers placement ranks on."""
+
+    name: str
+    topology: str
+    desired_chips: int
+    min_chips: int
+    max_chips: int
+    generation: str
+    multislice: bool
+    max_slices: int
+    priority: int
+
+
+@dataclass(frozen=True)
+class Grant:
+    """A placement decision: which arcs, and the shape the job meshes over
+    (single-arc grants whose arc is bigger than ``maxTopology`` carve the
+    desired box; everything else uses the arcs' own shapes)."""
+
+    arcs: tuple[Arc, ...]
+    topology: str        # what TPU_JOB_TOPOLOGY-style consumers should use
+    chips: int
+    multislice: bool
+
+
+@dataclass(frozen=True)
+class Compaction:
+    """Move ``request``'s grant from ``source`` onto the smaller free
+    ``target``, freeing the bigger contiguous box."""
+
+    request: str
+    source: Arc
+    target: Arc
+    granted_topology: str
+    freed_chips: int
+
+
+def request_from_spec(name: str, spec) -> Request:
+    """Reduce a TPUSliceRequestSpec; raises ValueError on an incoherent
+    elastic range (the controller surfaces it as Unschedulable with the
+    message — admission cannot relate two topology fields)."""
+    desired = topology_chips(spec.topology)
+    min_chips = (
+        topology_chips(spec.min_topology) if spec.min_topology else desired
+    )
+    max_chips = (
+        topology_chips(spec.max_topology) if spec.max_topology else desired
+    )
+    if not min_chips <= desired <= max_chips:
+        raise ValueError(
+            f"elastic range incoherent: minTopology ({min_chips} chips) <= "
+            f"topology ({desired}) <= maxTopology ({max_chips}) must hold"
+        )
+    return Request(
+        name=name,
+        topology=spec.topology,
+        desired_chips=desired,
+        min_chips=min_chips,
+        max_chips=max_chips,
+        generation=spec.generation,
+        multislice=bool(spec.multislice),
+        max_slices=max(1, int(spec.max_slices)),
+        priority=int(spec.priority),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Capacity model.
+
+
+def _member_healthy(node: dict) -> bool:
+    """An arc member the scheduler may count as capacity: schedulable, no
+    health-engine verdict, not owned by the upgrade machine.  Mirrors
+    ``controllers.migration.node_is_healthy_target`` minus the allocatable
+    check — allocation is a *label* grant, and a slice mid-join (plugin
+    not advertising yet) is still placeable capacity."""
+    if deep_get(node, "spec", "unschedulable"):
+        return False
+    labels = deep_get(node, "metadata", "labels", default={}) or {}
+    if labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY:
+        return False
+    if labels.get(consts.HEALTH_STATE_LABEL, "") not in ("", consts.HEALTH_OK):
+        return False
+    from tpu_operator.controllers.upgrade import NON_TERMINAL_STATES
+
+    return labels.get(consts.UPGRADE_STATE_LABEL, "") not in NON_TERMINAL_STATES
+
+
+def arcs_from_nodes(nodes: list[dict]) -> list[Arc]:
+    """Group the fleet into arcs.  A multi-host slice is eligible only
+    when COMPLETE (members == expected hosts) and every member healthy —
+    granting a partial slice would bind a job to a mesh that cannot form."""
+    groups: dict[str, list[dict]] = {}
+    for node in nodes:
+        attrs = nodeinfo.attributes(node)
+        if not attrs.accelerator or not attrs.topology:
+            continue
+        key = slice_group_key(node) or node["metadata"]["name"]
+        groups.setdefault(key, []).append(node)
+
+    arcs: list[Arc] = []
+    for key, members in sorted(groups.items()):
+        names = tuple(sorted(m["metadata"]["name"] for m in members))
+        first = members[0]
+        labels = deep_get(first, "metadata", "labels", default={}) or {}
+        topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+        try:
+            chips = topology_chips(topology)
+        except ValueError:
+            continue
+        expected = max(nodeinfo.slice_hosts(m) for m in members)
+        eligible = len(members) >= max(1, expected) and all(
+            _member_healthy(m) for m in members
+        )
+        assigned = ""
+        admin_group = ""
+        for m in members:
+            m_labels = deep_get(m, "metadata", "labels", default={}) or {}
+            assigned = assigned or m_labels.get(consts.SLICE_REQUEST_LABEL, "")
+            group = m_labels.get(consts.MULTISLICE_GROUP_LABEL, "")
+            if group and group != assigned:
+                admin_group = group
+        generation = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+        arcs.append(Arc(
+            key=key, nodes=names, topology=topology, generation=generation,
+            chips=chips, eligible=eligible, assigned=assigned,
+            admin_group=admin_group,
+        ))
+    return arcs
+
+
+def fragmentation(arcs: list[Arc]) -> float:
+    """1 - largest_free_arc / total_free chips over eligible free arcs: 0
+    when one contiguous box holds everything still free (or nothing is),
+    approaching 1 as free capacity scatters into small meshes."""
+    free = [a.chips for a in arcs if a.free]
+    total = sum(free)
+    if total <= 0:
+        return 0.0
+    return round(1.0 - max(free) / total, 4)
+
+
+# ---------------------------------------------------------------------------
+# Placement.
+
+
+def _single_grant_topology(request: Request, arc: Arc) -> Optional[str]:
+    """The shape ``request`` would mesh over on ``arc`` alone, or None
+    when the arc cannot satisfy even the elastic minimum.  Whole-arc
+    grants take the arc's own shape (trivially contiguous; elastic jobs
+    reshard to it); an arc bigger than ``maxTopology`` carves the desired
+    box instead — contiguity then requires the embedding to exist."""
+    if arc.chips < request.min_chips:
+        return None
+    if arc.chips <= request.max_chips:
+        return arc.topology
+    if slices.shape_fits(request.topology, arc.topology):
+        return request.topology
+    return None
+
+
+def _gen_free_chips(arcs: list[Arc]) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for a in arcs:
+        if a.free:
+            out[a.generation] = out.get(a.generation, 0) + a.chips
+    return out
+
+
+def _score(request: Request, arc: Arc, granted: str, gen_free: dict[str, int]) -> tuple:
+    granted_chips = topology_chips(granted)
+    return (
+        abs(granted_chips - request.desired_chips),
+        -granted_chips,                      # ties: grow beats shrink
+        arc.chips - granted_chips,           # best-fit: minimal stranded chips
+        0 if slices.shape_divides(granted, arc.topology) else 1,
+        -gen_free.get(arc.generation, 0),    # abundant generation first
+        arc.key,
+    )
+
+
+def plan_placement(request: Request, arcs: list[Arc]) -> Optional[Grant]:
+    """Best grant for ``request`` over the current capacity, or None."""
+    free = [a for a in arcs if a.free]
+    if request.generation:
+        free = [a for a in free if a.generation == request.generation]
+    gen_free = _gen_free_chips(arcs)
+
+    best: Optional[tuple[tuple, Arc, str]] = None
+    for arc in free:
+        granted = _single_grant_topology(request, arc)
+        if granted is None:
+            continue
+        score = _score(request, arc, granted, gen_free)
+        if best is None or score < best[0]:
+            best = (score, arc, granted)
+    single: Optional[Grant] = None
+    if best is not None:
+        _, arc, granted = best
+        single = Grant(
+            arcs=(arc,), topology=granted,
+            chips=topology_chips(granted), multislice=False,
+        )
+    if not request.multislice:
+        return single
+    split = _plan_multislice(request, free)
+    # an elastic minimum can make a lone small arc "satisfy" a request a
+    # DCN split would serve far better — pick whichever lands closer to
+    # the desired chips, single-mesh winning ties (ICI beats DCN)
+    if single is None:
+        return split
+    if split is not None and (
+        abs(split.chips - request.desired_chips)
+        < abs(single.chips - request.desired_chips)
+    ):
+        return split
+    return single
+
+
+def _plan_multislice(request: Request, free: list[Arc]) -> Optional[Grant]:
+    """DCN-split grant: same-generation arcs (a mixed-generation data-
+    parallel mesh steps at the slowest member's pace), largest-first so
+    the slice count stays minimal, arcs already claimed by an admin
+    multislice group excluded (we must not overwrite their rendezvous
+    labels).  Aims for the desired chip count, accepts the elastic
+    minimum, never exceeds ``maxSlices`` arcs or ``maxTopology`` chips."""
+    by_gen: dict[str, list[Arc]] = {}
+    for a in free:
+        if a.admin_group:
+            continue
+        by_gen.setdefault(a.generation, []).append(a)
+
+    best: Optional[Grant] = None
+    for gen in sorted(by_gen):
+        candidates = sorted(by_gen[gen], key=lambda a: (-a.chips, a.key))
+        chosen: list[Arc] = []
+        total = 0
+        for a in candidates:
+            if len(chosen) >= request.max_slices or total >= request.desired_chips:
+                break
+            if total + a.chips > request.max_chips:
+                continue
+            chosen.append(a)
+            total += a.chips
+        # a single arc is not "multislice" — the single-arc pass already
+        # rejected every one of these, so the split needs at least two
+        if len(chosen) < 2 or total < request.min_chips:
+            continue
+        grant = Grant(
+            arcs=tuple(chosen),
+            topology="+".join(a.topology for a in chosen),
+            chips=total,
+            multislice=True,
+        )
+        if (
+            best is None
+            or abs(grant.chips - request.desired_chips)
+            < abs(best.chips - request.desired_chips)
+            or (
+                abs(grant.chips - request.desired_chips)
+                == abs(best.chips - request.desired_chips)
+                and len(grant.arcs) < len(best.arcs)
+            )
+        ):
+            best = grant
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Defragmentation.
+
+
+def plan_compaction(
+    arcs: list[Arc],
+    bound: dict[str, Request],
+    threshold: float,
+    exclude: Optional[set[str]] = None,
+) -> Optional[Compaction]:
+    """The single most productive compaction move, or None.
+
+    Armed only when :func:`fragmentation` exceeds ``threshold``.  A move
+    relocates one single-arc grant onto a strictly smaller free arc that
+    still grants AT LEAST its desired chips — defrag trims over-provision
+    (an elastic grant sprawled past its desired shape), it never demotes
+    a grant below what it asked for just for tidiness: that asymmetry is
+    what keeps compaction and the elastic grow path (which only fires
+    below desired) from endlessly reversing each other, and
+    demand-driven demotion is the preemption economy's job (ROADMAP).
+    A qualifying move must strictly GROW the largest free contiguous box
+    — the property a pending too-big request is waiting on.  Multi-arc
+    (multislice) grants are never compacted: their capacity is already
+    split, and moving one leg cannot grow any contiguous box.
+    ``exclude`` names requests the caller has vetoed (e.g. a
+    non-migratable workload pod on the grant)."""
+    if fragmentation(arcs) <= threshold:
+        return None
+    free = [a for a in arcs if a.free]
+    if not free:
+        return None
+    largest_free = max(a.chips for a in free)
+
+    # single-arc grants only: arcs assigned to a request that owns exactly
+    # one arc (a multislice grant shows the same name on several)
+    owned: dict[str, list[Arc]] = {}
+    for a in arcs:
+        if a.assigned:
+            owned.setdefault(a.assigned, []).append(a)
+
+    best: Optional[Compaction] = None
+    for name, held in sorted(owned.items()):
+        request = bound.get(name)
+        if request is None or len(held) != 1 or name in (exclude or ()):
+            continue
+        source = held[0]
+        if not source.eligible or source.chips <= largest_free:
+            # freeing it would not beat the box we already have
+            continue
+        for target in sorted(free, key=lambda a: (a.chips, a.key)):
+            if target.chips >= source.chips:
+                break  # sorted ascending: nothing smaller remains
+            if request.generation and target.generation != request.generation:
+                continue
+            granted = _single_grant_topology(request, target)
+            if granted is None:
+                continue
+            if topology_chips(granted) < request.desired_chips:
+                continue  # never demote below desired for tidiness
+            move = Compaction(
+                request=name, source=source, target=target,
+                granted_topology=granted, freed_chips=source.chips,
+            )
+            if best is None or (
+                (-move.freed_chips, move.target.chips, move.request)
+                < (-best.freed_chips, best.target.chips, best.request)
+            ):
+                best = move
+            break  # smallest fitting target for THIS grant found
+    return best

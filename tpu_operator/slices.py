@@ -117,6 +117,53 @@ def chip_assignments(topology: str, shapes: list[str], chips_per_host: int) -> l
     return out
 
 
+def shape_dims(topology: str) -> tuple[int, ...]:
+    """Parsed topology with leading 1-axes stripped ("1x2x4" == "2x4"):
+    the canonical coordinate form the scheduler compares shapes in."""
+    dims = tuple(parse_topology(topology))
+    while len(dims) > 1 and dims[0] == 1:
+        dims = dims[1:]
+    return dims
+
+
+def shape_fits(shape: str, mesh: str) -> bool:
+    """True when an axis-aligned contiguous box of ``shape`` can be carved
+    out of ``mesh`` — the contiguity test behind the slice scheduler's
+    single-arc placement (a grant must stay on ICI; only a multislice
+    grant may span meshes).  A lower-dimensional shape embeds by padding
+    with 1-axes (a 2x4 box fits a 4x4x4 mesh as 1x2x4), and axes may be
+    reoriented: sorting both dimension lists descending and comparing
+    pairwise decides whether an injective axis assignment with
+    ``s <= m`` exists."""
+    s = shape_dims(shape)
+    m = shape_dims(mesh)
+    if len(s) > len(m):
+        return False
+    s_sorted = sorted(s, reverse=True)
+    m_sorted = sorted(m, reverse=True)
+    return all(a <= b for a, b in zip(s_sorted, m_sorted))
+
+
+def shape_divides(shape: str, mesh: str) -> bool:
+    """Like :func:`shape_fits` but each assigned mesh axis must also be
+    divisible by the shape axis — the tiling-compatible embedding that
+    keeps a partially-granted mesh partitionable by the slice manager.
+
+    Unlike the ``<=`` relation (where sorted-descending pairwise
+    comparison decides matchability), divisibility is not monotone — 2x3
+    tiles 3x4 via the assignment 3→3, 2→4, which sorted pairing (3→4)
+    misses — so this searches the axis assignments outright (meshes have
+    at most 3 axes; the permutation space is trivial)."""
+    s = shape_dims(shape)
+    m = shape_dims(mesh)
+    if len(s) > len(m):
+        return False
+    return any(
+        all(a <= b and b % a == 0 for a, b in zip(s, assignment))
+        for assignment in itertools.permutations(m, len(s))
+    )
+
+
 def load_profile(config: dict, profile: str, accelerator: str, topology: str) -> list[str]:
     """Resolve a named profile from the slice-config ConfigMap schema
     (assets/state-slice-manager/0400_configmap.yaml) to partition shapes for
