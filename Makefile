@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -174,6 +174,20 @@ chaos-migrate:
 # steady state back to zero verbs/pass (docs/SCHEDULING.md)
 slice-churn:
 	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --slice-churn --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# sustained-serving acceptance soak (chip-free; ~2-3 min): the
+# continuous-batching A/B must beat the sequential baseline ≥2x with
+# identical per-request outputs, then three REAL serving replicas
+# (workloads/serving.py: paged KV cache + iteration-level scheduling on
+# the CPU backend) serve seeded Poisson traffic across the fake cluster
+# while chaos injects Ready-flaps, an upgrade wave, and a quarantine —
+# both drained replicas must live-migrate (checkpoint KV/state → restore,
+# evictions reason=migrated only), the PR-6 burn-rate SLOs on p99 TPOT
+# and tokens/sec must hold through the disruption, and the steady state
+# must return to zero verbs/pass with the tpu_workload_serving_* rollups
+# live on /debug/fleet (docs/SERVING.md)
+serve-soak:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --serve --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must

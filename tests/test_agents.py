@@ -200,6 +200,72 @@ def test_push_store_ttl_expiry_merge_and_cap():
     assert len(capped.snapshot()) == 2
 
 
+def test_push_store_accepts_serving_counters_bounded_vocabulary():
+    """The serving replica's rolling telemetry rides the WORKLOAD_COUNTERS
+    catalogue: every ``tpu_workload_serving_*`` family is accepted under
+    the workload-name label alone, and a per-request-shaped counter name
+    (the cardinality trap the serving engine must never create) is
+    rejected at the door."""
+    from tpu_operator.agents.metrics_agent import (
+        COUNTER_HELP, WORKLOAD_COUNTERS, PushStore, to_prometheus,
+    )
+
+    serving = [c for c in WORKLOAD_COUNTERS if "serving" in c]
+    assert len(serving) == 8
+    for counter in serving:
+        assert counter in COUNTER_HELP  # counters-docs twin at the source
+
+    store = PushStore(ttl=60)
+    assert store.push({"serve-0": {"counters": {
+        "tpu_workload_serving_tokens_per_sec": 118.2,
+        "tpu_workload_serving_tpot_p99_seconds": 0.021,
+        "tpu_workload_serving_queue_depth": 3.0,
+        "tpu_workload_serving_requests_completed_total": 42.0,
+    }}}) == 1
+    snap = store.snapshot()
+    assert snap["serve-0"]["tpu_workload_serving_tokens_per_sec"] == 118.2
+
+    # a request-id-shaped counter name is NOT in the catalogue: dropped,
+    # and a window carrying only such names is rejected entirely
+    assert store.push({"serve-0": {"counters": {
+        "tpu_workload_serving_req_abc123_ttft": 0.5,
+    }}}) == 0
+    assert "tpu_workload_serving_req_abc123_ttft" not in store.snapshot()["serve-0"]
+
+    text = to_prometheus({"chips": {}, "workloads": store.snapshot()})
+    assert (
+        'tpu_workload_serving_tokens_per_sec{source="workload",'
+        'workload="serve-0"} 118.2' in text
+    )
+    assert "# TYPE tpu_workload_serving_requests_completed_total counter" in text
+    assert "# TYPE tpu_workload_serving_tokens_per_sec gauge" in text
+
+
+async def test_fleet_forwarder_queues_serving_counters():
+    """The agent→operator hop applies the same catalogue discipline: a
+    serving push window forwards intact, an off-catalogue name does not
+    survive the hop."""
+    from tpu_operator.agents.metrics_agent import FleetForwarder
+
+    fwd = FleetForwarder("http://127.0.0.1:1/push", node_name="n0")
+    fwd.queue({
+        "serve-1": {"counters": {
+            "tpu_workload_serving_tpot_p99_seconds": 0.019,
+            "tpu_workload_serving_bogus_per_request": 1.0,
+        }},
+    })
+    try:
+        pending = fwd._pending["serve-1"]["counters"]
+        assert pending == {"tpu_workload_serving_tpot_p99_seconds": 0.019}
+    finally:
+        if fwd._task is not None:
+            fwd._task.cancel()
+            try:
+                await fwd._task
+            except asyncio.CancelledError:
+                pass
+
+
 def test_to_prometheus_help_and_label_escaping():
     from tpu_operator.agents.metrics_agent import to_prometheus
 

@@ -145,6 +145,52 @@ def test_push_loop_agent_down_backs_off_and_never_blocks_record():
     assert _time.monotonic() - t1 < 4.0, "close() hung on a dead agent"
 
 
+async def test_push_loop_delivers_serving_counters_live():
+    """The serving replica's per-step telemetry through the REAL push
+    thread to a live endpoint: the posted window carries the catalogued
+    ``tpu_workload_serving_*`` names (and nothing request-scoped), the
+    shape the serve soak's agent hop forwards fleet-ward."""
+    from aiohttp import web
+
+    received: list[dict] = []
+
+    async def push_handler(request: web.Request) -> web.Response:
+        received.append(await request.json())
+        return web.json_response({"accepted": 1})
+
+    app = web.Application()
+    app.router.add_post("/push", push_handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    recorder = flight.FlightRecorder(
+        push_url=f"http://127.0.0.1:{port}/push", push_interval=0.05
+    )
+    try:
+        recorder.record(
+            "serve-0", phase="step", step=1,
+            serve_tokens_per_sec=96.0, serve_tpot_p99_s=0.018,
+            serve_kv_blocks_free=40.0, serve_requests_completed=5.0,
+        )
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while not received and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        assert received, "push thread never delivered the serving window"
+        counters = received[0]["workloads"]["serve-0"]["counters"]
+        assert counters["tpu_workload_serving_tokens_per_sec"] == 96.0
+        assert counters["tpu_workload_serving_tpot_p99_seconds"] == 0.018
+        assert counters["tpu_workload_serving_kv_blocks_free"] == 40.0
+        assert counters["tpu_workload_serving_requests_completed_total"] == 5.0
+        # the step counter rides along; nothing request-scoped ever does
+        assert counters["tpu_workload_steps_total"] == 1.0
+        assert all(k.startswith("tpu_workload_") for k in counters)
+    finally:
+        recorder.close()
+        await runner.cleanup()
+
+
 def test_push_loop_slow_agent_is_bounded_by_socket_timeout():
     """A blackholed agent (accepts the TCP connection, never answers) is
     the nastier failure: the POST must die on its own 1s socket timeout,

@@ -489,6 +489,111 @@ def test_lease_expiry_unfenced_control_is_caught():
 
 
 # ---------------------------------------------------------------------------
+# serving engine: admission + batch-join/retire under interleaving — a
+# request admitted while a retire frees its blocks must never
+# double-allocate a KV page (workloads/serving.py PagedKVCache contract)
+
+
+def test_serving_admission_retire_no_double_alloc():
+    """Submitters, a canceller, and the engine stepper interleaved under
+    every schedule: the paged pool's atomic try_alloc (capacity check and
+    take with NO await between them) must keep every block owned by at
+    most one request, with the pool fully recovered once the traffic
+    drains."""
+    from tpu_operator.workloads import serving as srv
+
+    def _req(rid: str) -> srv.Request:
+        return srv.Request(
+            rid=rid, prompt=[(7 * len(rid)) % 128] * 12,
+            max_new_tokens=4, arrival=0.0,
+        )
+
+    async def scenario():
+        cfg = srv.ServeConfig(
+            heads=2, head_dim=8, num_blocks=8, block_tokens=8,
+            max_batch=2, max_context=32, prefill_budget=32,
+        )
+        engine = srv.ServingEngine(cfg)
+
+        async def submitter(base: int):
+            for j in range(4):
+                engine.submit(_req(f"s{base}-{j}"))
+                await asyncio.sleep(0)
+
+        async def canceller():
+            # rip a queued and a running request mid-flight: retire/free
+            # racing the very admissions the submitters keep feeding
+            for _ in range(4):
+                await asyncio.sleep(0)
+                victims = [r.rid for r in list(engine.queued)[:1]]
+                victims += [r.rid for r in engine.running[:1]]
+                for rid in victims:
+                    engine.cancel(rid)
+
+        async def stepper():
+            for i in range(200):
+                engine.step(float(i))
+                engine.check_integrity()  # the double-allocation invariant
+                if not engine.active and i > 12:
+                    break
+                await asyncio.sleep(0)
+
+        await asyncio.gather(submitter(0), submitter(1), canceller(), stepper())
+        for i in range(200, 400):
+            if not engine.active:
+                break
+            engine.step(float(i))
+            engine.check_integrity()
+        assert engine.active == 0, "requests stranded"
+        assert engine.cache.free_count == 8, "blocks leaked"
+        assert engine.requests_completed + engine.requests_cancelled == 8
+
+    report = sweep(scenario, range(RACE_SEEDS))
+    assert not report.failures, report.summary()
+    assert report.total_permutations > 0, "scenario had no schedule freedom"
+
+
+def test_serving_racy_admission_is_caught():
+    """Rig regression: split the admission's capacity check from the take
+    across an await — the exact bug shape the atomic try_alloc forbids —
+    and the sweep MUST observe a double-allocated (or free-and-owned) KV
+    page on some schedule.  If this stops failing, the harness went blind
+    to the admission/retire race."""
+    from tpu_operator.workloads import serving as srv
+
+    async def scenario():
+        cache = srv.PagedKVCache(4, 4, 1, 4)
+        retiring = cache.try_alloc(2)  # a request about to retire
+        tables: dict[str, list[int]] = {}
+
+        async def racy_admit(rid: str, n: int):
+            if cache.free_count < n:
+                return
+            view = sorted(cache._free)[:n]   # stale read of the free list
+            await asyncio.sleep(0)           # the admission/retire window
+            for b in view:                   # commit WITHOUT revalidating
+                cache._free_set.discard(b)
+                if b in cache._free:
+                    cache._free.remove(b)
+            tables[rid] = view
+
+        async def retire():
+            await asyncio.sleep(0)
+            cache.free(retiring)
+
+        await asyncio.gather(
+            racy_admit("a", 2), racy_admit("b", 2), retire()
+        )
+        cache.check_integrity(tables)
+
+    report = sweep(scenario, range(max(RACE_SEEDS, 60)))
+    assert report.failures, (
+        "racy split admission went unobserved across the sweep — the "
+        "interleaving harness can no longer catch the KV double-allocation"
+    )
+
+
+# ---------------------------------------------------------------------------
 # determinism: the same seed must replay the same schedule
 
 

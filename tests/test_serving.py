@@ -1,0 +1,499 @@
+"""Sustained-serving engine tests: paged KV cache, continuous batching,
+checkpoint/restore (docs/SERVING.md)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_operator.workloads import serving
+from tpu_operator.workloads.serving import (
+    PagedKVCache,
+    PoissonTraffic,
+    Request,
+    ServeConfig,
+    ServingEngine,
+    ServingError,
+)
+
+
+def _tiny_cfg(**over) -> ServeConfig:
+    base = dict(
+        heads=2, head_dim=8, num_blocks=32, block_tokens=8,
+        max_batch=4, max_context=64, prefill_budget=16,
+    )
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _req(rid: str, prompt_len: int = 12, new: int = 6, seed: int = 0,
+         arrival: float = 0.0, vocab: int = 128) -> Request:
+    rng = np.random.default_rng(seed)
+    return Request(
+        rid=rid,
+        prompt=[int(t) for t in rng.integers(0, vocab, prompt_len)],
+        max_new_tokens=new,
+        arrival=arrival,
+    )
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache: allocation, integrity, defrag.
+
+
+def test_cache_alloc_free_atomicity_and_double_free():
+    cache = PagedKVCache(8, 4, 2, 8)
+    a = cache.try_alloc(3)
+    assert a == [0, 1, 2]
+    b = cache.try_alloc(5)
+    assert b is not None and not set(a) & set(b)
+    # capacity-based admission: nothing left
+    assert cache.try_alloc(1) is None
+    assert cache.alloc_failures == 1
+    cache.free(a)
+    assert cache.free_count == 3
+    with pytest.raises(ServingError):
+        cache.free([0])  # double-free must be loud, never silent corruption
+    # freed blocks are re-allocatable, lowest-first
+    assert cache.try_alloc(2) == [0, 1]
+
+
+def test_cache_write_gather_roundtrip_is_lossless():
+    """Paged storage is lossless: scatter across non-contiguous blocks,
+    gather back contiguous — exactly the written values, zero-padded past
+    the valid length."""
+    cache = PagedKVCache(8, 4, 2, 8)
+    # force a non-contiguous table: burn then free some low blocks
+    burn = cache.try_alloc(3)
+    table = cache.try_alloc(3)  # blocks 3,4,5
+    cache.free(burn)
+    rng = np.random.default_rng(1)
+    k = rng.standard_normal((10, 2, 8)).astype(np.float32)
+    v = rng.standard_normal((10, 2, 8)).astype(np.float32)
+    cache.write_tokens(table, 0, k[:6], v[:6])
+    cache.write_tokens(table, 6, k[6:], v[6:])  # append across a block seam
+    gk, gv = cache.gather(table, 10, pad_to=16)
+    np.testing.assert_array_equal(gk[:10], k)
+    np.testing.assert_array_equal(gv[:10], v)
+    assert not gk[10:].any() and not gv[10:].any()
+
+
+def test_cache_integrity_detects_double_allocation():
+    cache = PagedKVCache(8, 4, 2, 8)
+    t1 = cache.try_alloc(2)
+    t2 = cache.try_alloc(2)
+    cache.check_integrity({"a": t1, "b": t2})
+    with pytest.raises(ServingError):
+        cache.check_integrity({"a": t1, "b": [t1[0]] + t2[1:]})
+
+
+def test_cache_defrag_compacts_high_water():
+    cache = PagedKVCache(16, 4, 2, 8)
+    low = cache.try_alloc(6)
+    high = cache.try_alloc(4)  # blocks 6..9
+    cache.k[high] = 7.0
+    cache.v[high] = 9.0
+    cache.free(low)
+    assert cache.high_water() == 10
+    tables = {"r": list(high)}
+    moves = cache.defrag(tables)
+    assert moves == 4
+    assert cache.high_water() == 4
+    assert tables["r"] == [0, 1, 2, 3]
+    # content moved with the blocks
+    assert (cache.k[tables["r"]] == 7.0).all()
+    assert (cache.v[tables["r"]] == 9.0).all()
+    cache.check_integrity(tables)
+
+
+# ---------------------------------------------------------------------------
+# Attention: the paged path against the flash kernel and the dense
+# reference.
+
+
+def test_paged_gather_matches_flash_kernel():
+    """Gathered-from-pages KV through ``longctx.flash_attention_local``
+    equals exact attention — including the zero-padded block tail, which
+    the kernel's causal masking must ignore (the property that lets paged
+    storage compose with the flash kernel unchanged)."""
+    import jax
+
+    from tpu_operator.workloads import longctx
+
+    assert jax.default_backend() == "cpu"  # interpret-mode kernel
+    heads, head_dim, bt = 2, 8, 8
+    cache = PagedKVCache(16, bt, heads, head_dim)
+    table = cache.try_alloc(3)
+    length = 20  # NOT a block multiple: 4 padded slots in the last block
+    rng = np.random.default_rng(3)
+    k = rng.standard_normal((length, heads, head_dim)).astype(np.float32)
+    v = rng.standard_normal((length, heads, head_dim)).astype(np.float32)
+    cache.write_tokens(table, 0, k, v)
+    pad = bt * 3
+    gk, gv = cache.gather(table, length, pad_to=pad)
+    km = np.ascontiguousarray(gk.transpose(1, 0, 2))
+    vm = np.ascontiguousarray(gv.transpose(1, 0, 2))
+    tail = 8
+    q = rng.standard_normal((heads, tail, head_dim)).astype(np.float32)
+    out, _ = longctx.flash_attention_local(
+        q, km, vm, causal=True, block_k=bt, block_q=tail, q_off=length - tail
+    )
+    # exact reference over the UNPADDED kv, causal with the q offset
+    ref = np.zeros_like(q)
+    for h in range(heads):
+        s = (q[h] @ k[:, h, :].T) / np.sqrt(head_dim)
+        q_pos = (length - tail) + np.arange(tail)[:, None]
+        s = np.where(q_pos >= np.arange(length)[None, :], s, -1e30)
+        w = np.exp(s - s.max(axis=-1, keepdims=True))
+        w = w / w.sum(axis=-1, keepdims=True)
+        ref[h] = w @ v[:, h, :]
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+
+def test_flash_and_dense_attend_produce_identical_tokens():
+    """The engine's two attention implementations — jitted dense masked
+    reference vs the longctx flash kernel over gathered pages — must
+    generate the same token streams."""
+
+    def run(attend: str):
+        engine = ServingEngine(_tiny_cfg(max_batch=2, attend=attend))
+        reqs = [_req("r0", 12, 5, seed=5), _req("r1", 9, 5, seed=6)]
+        for req in reqs:
+            assert engine.submit(req)
+        for i in range(40):
+            if not engine.active:
+                break
+            engine.step(float(i))
+        return [list(r.tokens) for r in reqs]
+
+    assert run("dense") == run("flash")
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching semantics.
+
+
+def test_batching_never_changes_outputs():
+    """The acceptance A/B's correctness half: identical per-request token
+    streams at admission width 1 and max_batch, and a real speedup in
+    steps (the wall-clock gate lives in the serve soak)."""
+    ab = serving.batching_ab(n_requests=6, prompt_tokens=16, new_tokens=8)
+    assert ab["identical_outputs"]
+    assert ab["ok"]
+    assert ab["sequential"]["steps"] > ab["batched"]["steps"] * 2
+
+
+def test_admission_is_capacity_based_and_fifo():
+    """A request admits only when its worst-case block need fits; the head
+    of the queue is never overtaken; a retire frees blocks that serve the
+    SAME step's admission."""
+    # pool of 4 blocks x 8 tokens; each request needs 2 blocks (8+4)
+    engine = ServingEngine(_tiny_cfg(num_blocks=4, block_tokens=8,
+                                     max_batch=4, max_context=16,
+                                     prefill_budget=64))
+    first = [_req(f"a{i}", 8, 4, seed=i) for i in range(2)]
+    for req in first:
+        engine.submit(req)
+    overflow = _req("b0", 8, 4, seed=9)
+    engine.submit(overflow)
+    engine.step(0.0)
+    assert {r.state for r in first} <= {serving.PREFILL, serving.RUNNING}
+    assert overflow.state == serving.QUEUED  # pool exhausted: waits
+    assert engine.cache.free_count == 0
+    # drive the first pair to completion; the freed blocks admit b0
+    for i in range(1, 20):
+        engine.step(float(i))
+        if overflow.state != serving.QUEUED:
+            break
+    assert overflow.state in (serving.PREFILL, serving.RUNNING)
+    for i in range(20, 40):
+        if not engine.active:
+            break
+        engine.step(float(i))
+    assert engine.requests_completed == 3
+    assert engine.cache.free_count == 4
+    engine.check_integrity()
+
+
+def test_chunked_prefill_no_head_of_line_blocking():
+    """A long prompt prefills in budget-bounded chunks while the running
+    batch keeps decoding EVERY step — the iteration-level scheduling
+    property (no padding to the longest request, no prefill stall)."""
+    engine = ServingEngine(_tiny_cfg(num_blocks=32, prefill_budget=8,
+                                     max_context=64))
+    short = _req("short", 8, 20, seed=1)
+    engine.submit(short)
+    for i in range(3):
+        engine.step(float(i))
+    assert short.state == serving.RUNNING
+    generated_before = short.generated
+    long_req = _req("long", 40, 4, seed=2)  # 5 prefill chunks at budget 8
+    engine.submit(long_req)
+    steps_to_running = 0
+    for i in range(3, 12):
+        engine.step(float(i))
+        steps_to_running += 1
+        if long_req.state == serving.RUNNING:
+            break
+    assert long_req.state == serving.RUNNING
+    assert steps_to_running >= 5  # the prompt genuinely chunked
+    # the short request kept decoding every step of the long prefill
+    assert short.generated >= generated_before + 5
+
+
+def test_oversize_request_rejected_and_counted():
+    engine = ServingEngine(_tiny_cfg(max_context=32))
+    assert not engine.submit(_req("big", 30, 10))
+    assert engine.requests_rejected == 1
+    assert not engine.submit(Request(rid="empty", prompt=[], max_new_tokens=1,
+                                     arrival=0.0))
+    assert engine.requests_rejected == 2
+    # a request inside the context bound but over the WHOLE pool must be
+    # rejected too: at the queue head it would wedge FIFO admission (no
+    # overtaking) and serve() forever
+    small_pool = ServingEngine(_tiny_cfg(num_blocks=2, block_tokens=8,
+                                         max_context=64))
+    assert not small_pool.submit(_req("wedge", 24, 8))  # needs 4 blocks of 2
+    assert small_pool.requests_rejected == 1
+    assert small_pool.submit(_req("fits", 8, 4))  # 2 blocks: serviceable
+    for i in range(20):
+        if not small_pool.active:
+            break
+        small_pool.step(float(i))
+    assert small_pool.requests_completed == 1
+
+
+def test_cancel_frees_blocks_immediately():
+    engine = ServingEngine(_tiny_cfg())
+    req = _req("c0", 16, 8)
+    engine.submit(req)
+    engine.step(0.0)
+    owned = len(req.blocks)
+    assert owned > 0
+    free_before = engine.cache.free_count
+    assert engine.cancel("c0")
+    # _release empties req.blocks, so count the ownership BEFORE the
+    # cancel: exactly those blocks must be back on the free list
+    assert engine.cache.free_count == free_before + owned
+    assert req.state == serving.CANCELLED and not req.blocks
+    engine.check_integrity()
+    assert not engine.cancel("c0")  # already gone
+
+
+# ---------------------------------------------------------------------------
+# Telemetry surface.
+
+
+def test_telemetry_keys_ride_the_flight_catalogue():
+    """Every telemetry key the engine emits maps onto a catalogued
+    ``tpu_workload_serving_*`` counter — engine and agent allowlist can
+    never drift apart."""
+    from tpu_operator.agents.metrics_agent import WORKLOAD_COUNTERS
+    from tpu_operator.obs.flight import COUNTER_KEYS
+
+    engine = ServingEngine(_tiny_cfg())
+    engine.submit(_req("t0", 8, 3))
+    for i in range(10):
+        engine.step(float(i))
+    telemetry = engine.telemetry(10.0)
+    for key in telemetry:
+        assert key in COUNTER_KEYS, f"telemetry key {key} not in COUNTER_KEYS"
+        counter = COUNTER_KEYS[key]
+        assert counter.startswith("tpu_workload_serving_")
+        assert counter in WORKLOAD_COUNTERS, counter
+
+
+def test_flight_push_maps_serving_sample_to_counters():
+    """A serving flight sample lands in the push window under the
+    catalogued counter names (the hop the serve soak rides end to end)."""
+    from tpu_operator.obs import flight as flight_api
+
+    recorder = flight_api.FlightRecorder(push_url="http://127.0.0.1:1/push")
+    recorder.record(
+        "serve-0", phase="step", step=3,
+        serve_tokens_per_sec=120.5, serve_tpot_p99_s=0.02,
+        serve_queue_depth=2.0, serve_requests_completed=7.0,
+    )
+    pending = recorder._take_pending()
+    recorder._closed = True
+    counters = pending["serve-0"]["counters"]
+    assert counters["tpu_workload_serving_tokens_per_sec"] == 120.5
+    assert counters["tpu_workload_serving_tpot_p99_seconds"] == 0.02
+    assert counters["tpu_workload_serving_queue_depth"] == 2.0
+    assert counters["tpu_workload_serving_requests_completed_total"] == 7.0
+
+
+# ---------------------------------------------------------------------------
+# Traffic generator.
+
+
+def test_poisson_traffic_seeded_and_checkpointable():
+    a = PoissonTraffic(rate=50.0, seed=11)
+    b = PoissonTraffic(rate=50.0, seed=11)
+    ra = a.due(1.0)
+    rb = b.due(1.0)
+    assert [r.rid for r in ra] == [r.rid for r in rb]
+    assert [r.prompt for r in ra] == [r.prompt for r in rb]
+    assert ra, "rate 50/s over 1s produced no arrivals"
+
+    # snapshot mid-schedule: the restored generator continues the SAME
+    # schedule (ids, prompts, gaps) — no duplicated or skipped requests
+    state = a.state()
+    cont = a.due(2.0)
+    fresh = PoissonTraffic(rate=50.0, seed=999)  # wrong seed on purpose
+    fresh.restore(state)
+    resumed = fresh.due(2.0)
+    assert [r.rid for r in cont] == [r.rid for r in resumed]
+    assert [r.prompt for r in cont] == [r.prompt for r in resumed]
+    assert [r.arrival for r in cont] == [r.arrival for r in resumed]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / restore (the PR-8 migration contract).
+
+
+def test_snapshot_restore_resumes_identically(tmp_path):
+    """Interrupting mid-flight and restoring must continue BIT-identically
+    with the uninterrupted run — the KV pages carry the attention state,
+    so no prefill is re-paid and no token changes."""
+    from tpu_operator.workloads import checkpoint as ckpt_api
+
+    def fresh():
+        engine = ServingEngine(_tiny_cfg(num_blocks=32))
+        for i in range(4):
+            engine.submit(_req(f"r{i}", 10 + i, 8, seed=i))
+        return engine
+
+    reference = fresh()
+    for i in range(30):
+        reference.step(float(i))
+
+    engine = fresh()
+    for i in range(7):
+        engine.step(float(i))
+    arrays, extra = engine.snapshot()
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_api.save_checkpoint(ckpt_dir, step=engine.steps,
+                             arrays=arrays, extra=extra)
+    snap = ckpt_api.load_checkpoint(ckpt_dir)
+    assert snap is not None
+    restored = ServingEngine.from_snapshot(
+        _tiny_cfg(num_blocks=32), snap.arrays, snap.extra
+    )
+    restored.check_integrity()
+    for i in range(7, 30):
+        restored.step(float(i))
+    assert restored.tokens_generated == reference.tokens_generated
+    # the snapshot carries pre-interruption completions and latency
+    # windows, so the restored engine reports LIFETIME evidence — its
+    # completion set equals the uninterrupted run's exactly
+    ref_streams = sorted(
+        (c["rid"], c["tokens"]) for c in reference.completions()
+    )
+    res_streams = sorted(
+        (c["rid"], c["tokens"]) for c in restored.completions()
+    )
+    assert restored.requests_completed == reference.requests_completed
+    assert res_streams == ref_streams
+
+
+def test_snapshot_restore_rejects_mismatched_config(tmp_path):
+    engine = ServingEngine(_tiny_cfg())
+    arrays, extra = engine.snapshot()
+    with pytest.raises(ServingError):
+        ServingEngine.from_snapshot(
+            _tiny_cfg(num_blocks=16), arrays, extra
+        )
+
+
+def test_serve_loop_checkpoints_on_migrate_signal(tmp_path, monkeypatch):
+    """The replica main loop end to end: serve → migrate signal lands →
+    final checkpoint + exit; a second serve() call restores and serves
+    the remainder with the token counter and traffic schedule intact."""
+
+    class _Sig:
+        def __init__(self):
+            self.fire = False
+
+        def requested(self):
+            return self.fire
+
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(tmp_path / "vroot"))
+    cfg = _tiny_cfg(num_blocks=32)
+    ckpt_dir = str(tmp_path / "serve-ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    events: list[dict] = []
+    sig = _Sig()
+
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        clock["t"] += 0.02
+        if clock["t"] > 1.0:
+            sig.fire = True
+        return clock["t"]
+
+    traffic = PoissonTraffic(rate=40.0, prompt_tokens=(8, 12),
+                             new_tokens=(4, 8), seed=3)
+    first = serving.serve(
+        cfg, traffic, duration_s=30.0, ckpt_dir=ckpt_dir, sig=sig,
+        progress=events.append, step_interval_s=0.0, clock=fake_clock,
+    )
+    assert first["migrated_out"] and first["checkpointed"]
+    assert first["tokens_total"] > 0
+    assert any(
+        e["event"] == "checkpointed" and e["trigger"] == "migrate-signal"
+        for e in events
+    )
+
+    # the restore: fresh process state, same env contract
+    sig2 = _Sig()
+    clock2 = {"t": 0.0}
+
+    def clock_2():
+        clock2["t"] += 0.02
+        return clock2["t"]
+
+    events2: list[dict] = []
+    traffic2 = PoissonTraffic(rate=40.0, prompt_tokens=(8, 12),
+                              new_tokens=(4, 8), seed=3)
+    second = serving.serve(
+        cfg, traffic2, duration_s=first["elapsed_s"] + 1.5,
+        ckpt_dir=ckpt_dir, sig=sig2,
+        progress=events2.append, step_interval_s=0.0, clock=clock_2,
+    )
+    assert second["resumed"] and not second["migrated_out"]
+    assert events2[0]["event"] == "restored"
+    # the lifetime counter CONTINUED (never restarted from zero)
+    assert second["tokens_total"] >= first["tokens_total"]
+    # the traffic schedule continued: no request id re-served
+    assert traffic2.next_id >= traffic.next_id
+
+
+def test_serve_loop_idle_progress_report_survives(tmp_path, monkeypatch):
+    """Regression: the throughput gauge goes DARK while idle (telemetry
+    omits the key), and the 1 s progress report must tolerate that — a
+    quiet replica crashed here when the report indexed the absent key."""
+    monkeypatch.setenv("TPU_VALIDATION_ROOT", str(tmp_path / "vroot"))
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        clock["t"] += 0.05
+        return clock["t"]
+
+    events: list[dict] = []
+    result = serving.serve(
+        _tiny_cfg(), PoissonTraffic(rate=0.0, seed=1),  # NO traffic: idle
+        duration_s=2.5, progress=events.append,
+        step_interval_s=0.0, clock=fake_clock,
+    )
+    assert result["ok"] and result["tokens_total"] == 0
+    reports = [e for e in events if e["event"] == "serving"]
+    assert reports and all(r["tokens_per_sec"] == 0.0 for r in reports)
+
+
+def test_quick_check_passes():
+    result = serving.quick_check()
+    assert result["ok"], result
+    assert result["identical_outputs"]
+    assert result["check"] == "serving"

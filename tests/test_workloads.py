@@ -851,6 +851,80 @@ def test_decode_check_cpu():
     assert r["decode_us"] > 0 and r["cache_gbps"] > 0
 
 
+def test_block_div_clamping_rules():
+    """The non-divisible block fallback the serving engine's paged shapes
+    lean on: largest Mosaic-aligned (multiple-of-8) divisor at most the
+    requested block, the whole length when nothing aligned divides it."""
+    from tpu_operator.workloads.longctx import _block_div
+
+    assert _block_div(64, 1024) == 64       # t <= want: one block
+    assert _block_div(4096, 1024) == 1024   # want divides: keep it
+    assert _block_div(136, 32) == 8         # 136 = 8*17: only 8 aligns
+    assert _block_div(48, 32) == 24         # largest aligned divisor <= 32
+    assert _block_div(20, 16) == 20         # no aligned divisor: whole t
+    assert _block_div(1000, 1024) == 1000   # t < want
+
+
+def test_decode_benchmark_explicit_batch_one_and_nondivisible_cache():
+    """`decode_benchmark` pinned off the happy shapes the serving engine
+    reuses: batch=1 spelled out, and a cache length NOT divisible by
+    block_k (the _block_div fallback selects an aligned sub-block)."""
+    from tpu_operator.workloads import longctx
+
+    r = longctx.decode_benchmark(
+        seq=136, heads=2, head_dim=8, batch=1, block_k=32,
+        iters=2, best_of=2,
+    )
+    assert r["ok"], r
+    assert r["batch"] == 1 and r["seq"] == 136
+    assert r["decode_us"] > 0 and r["cache_gbps"] > 0
+    assert r["decodes_per_sec"] > 0
+    # cache-traffic arithmetic must reflect the declared shape exactly:
+    # K and V, bf16, batch*heads rows
+    expected_bytes = 2.0 * (1 * 2) * 136 * 8 * 2
+    assert abs(
+        r["cache_gbps"] * (r["decode_us"] / 1e6) * 1e9 - expected_bytes
+    ) / expected_bytes < 1e-6
+
+
+def test_decode_benchmark_batched_requests():
+    """batch>1 through the same kernel: per-token latency is per decode
+    STEP (all requests advance together), so decodes_per_sec scales with
+    batch while the per-step time stays one kernel invocation."""
+    from tpu_operator.workloads import longctx
+
+    r = longctx.decode_benchmark(
+        seq=64, heads=2, head_dim=8, batch=2, block_k=32,
+        iters=2, best_of=2,
+    )
+    assert r["ok"], r
+    assert r["batch"] == 2
+    assert abs(r["decodes_per_sec"] * (r["decode_us"] / 1e6) - 2) < 1e-6
+
+
+def test_flash_attention_local_nondivisible_seq_matches_reference():
+    """flash_attention_local at sequences that do NOT divide the requested
+    blocks (the _block_div clamp in both grid axes) must stay exact — the
+    serving engine's gathered KV hits these shapes whenever a request's
+    length is not a page multiple."""
+    import jax.numpy as jnp
+
+    from tpu_operator.workloads import longctx
+    from tpu_operator.workloads.ring_attention import reference_attention
+
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    b, t, h, d = 1, 40, 2, 8  # 40 % 32 != 0 -> block clamps to 8
+    q, k, v = (jax.random.normal(kk, (b, t, h, d), jnp.bfloat16) for kk in keys)
+    qm, km, vm = (longctx._merge(x) for x in (q, k, v))
+    out, lse = longctx.flash_attention_local(
+        qm, km, vm, causal=True, block_k=32, block_q=16
+    )
+    ref = longctx._merge(reference_attention(q, k, v, True))
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    assert err < 2e-2, err
+    assert bool(jnp.all(jnp.isfinite(lse)))
+
+
 def test_remat_pallas_backward_matches_jnp(monkeypatch):
     """The FA2 block-backward kernel (use_pallas=True remat) must produce
     the same dq/dk/dv as the jnp remat backward — including with q-tiling

@@ -74,6 +74,20 @@ WORKLOAD_COUNTERS = (
     "tpu_workload_compile_cache_hits_total",
     "tpu_workload_compile_cache_misses_total",
     "tpu_workload_compile_cache_bytes_total",
+    # sustained-serving counters (workloads/serving.py): the continuous-
+    # batching replica's rolling telemetry, pushed per engine step.  The
+    # label vocabulary stays BOUNDED by construction: the only label is
+    # the workload name (the replica's TPU_SERVE_NAME); request ids live
+    # in flight samples only and must never become label values — the
+    # PushStore/FleetForwarder cardinality caps depend on it.
+    "tpu_workload_serving_tokens_per_sec",
+    "tpu_workload_serving_ttft_p99_seconds",
+    "tpu_workload_serving_tpot_p99_seconds",
+    "tpu_workload_serving_queue_depth",
+    "tpu_workload_serving_batch_size",
+    "tpu_workload_serving_kv_blocks_free",
+    "tpu_workload_serving_requests_completed_total",
+    "tpu_workload_serving_requests_rejected_total",
 )
 
 # HELP text per counter: the exposition format wants a # HELP line per
@@ -97,6 +111,14 @@ COUNTER_HELP = {
     "tpu_workload_compile_cache_hits_total": "Compile-artifact cache hits (executables loaded from disk instead of compiled)",
     "tpu_workload_compile_cache_misses_total": "Compile-artifact cache misses (programs that paid the XLA compiler)",
     "tpu_workload_compile_cache_bytes_total": "Bytes read+written through the node's compile-artifact store",
+    "tpu_workload_serving_tokens_per_sec": "Serving replica rolling decode throughput in tokens/s",
+    "tpu_workload_serving_ttft_p99_seconds": "Serving replica rolling p99 time-to-first-token",
+    "tpu_workload_serving_tpot_p99_seconds": "Serving replica rolling p99 time-per-output-token",
+    "tpu_workload_serving_queue_depth": "Requests queued behind the serving replica's admission control",
+    "tpu_workload_serving_batch_size": "Requests in the serving replica's running decode batch",
+    "tpu_workload_serving_kv_blocks_free": "Free KV-cache blocks in the serving replica's paged pool",
+    "tpu_workload_serving_requests_completed_total": "Requests the serving replica completed since start",
+    "tpu_workload_serving_requests_rejected_total": "Requests rejected by serving admission (oversize for the configured context)",
 }
 
 

@@ -71,6 +71,21 @@ COUNTER_KEYS = {
     "cache_hits": "tpu_workload_compile_cache_hits_total",
     "cache_misses": "tpu_workload_compile_cache_misses_total",
     "cache_bytes": "tpu_workload_compile_cache_bytes_total",
+    # sustained-serving telemetry (workloads/serving.py
+    # ServingEngine.telemetry): per-step rolling rollups only — request
+    # ids stay inside flight samples, never in the pushed counter surface.
+    # Every serving sample key carries the serve_ prefix: this map is
+    # GLOBAL across workloads, and a generic name here (queue_depth,
+    # requests_completed) would silently publish any other workload's
+    # like-named flight metric into the serving SLO feed.
+    "serve_tokens_per_sec": "tpu_workload_serving_tokens_per_sec",
+    "serve_ttft_p99_s": "tpu_workload_serving_ttft_p99_seconds",
+    "serve_tpot_p99_s": "tpu_workload_serving_tpot_p99_seconds",
+    "serve_queue_depth": "tpu_workload_serving_queue_depth",
+    "serve_batch_size": "tpu_workload_serving_batch_size",
+    "serve_kv_blocks_free": "tpu_workload_serving_kv_blocks_free",
+    "serve_requests_completed": "tpu_workload_serving_requests_completed_total",
+    "serve_requests_rejected": "tpu_workload_serving_requests_rejected_total",
 }
 
 # result keys worth a flight sample when a check only reports a summary

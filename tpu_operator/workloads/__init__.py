@@ -5,6 +5,10 @@ Reference analogue: the CUDA vectorAdd image the validator spawns
 TPU replacements are real XLA programs: a pallas vector-add for single-chip
 sanity, a psum allreduce over ICI with achieved-bandwidth reporting, and a
 sharded burn-in step exercising the MXU + collectives across a device mesh.
+Beyond validation, the package carries the migratable-checkpoint layer
+(checkpoint.py, docs/ROBUSTNESS.md "Live migration") and the sustained-
+serving engine (serving.py: continuous batching over a paged KV cache,
+docs/SERVING.md) — the payloads the chaos soaks drain and restore.
 """
 
 import os

@@ -8,7 +8,7 @@ Env:
 - ``WORKLOAD_CHECKS``: comma list of
   vector-add,allreduce,burn-in,matmul,hbm,hbm-dma,ring,ring-attention,
   ulysses,moe,pipeline,longctx,decode,transformer,transformer-pp,train,
-  warm-pool (default
+  warm-pool,serving (default
   runs the first three; the rest are opt-in
   — they hold the chip longer; ring is the per-ICI-link diagnostic,
   gated by RING_MIN_GBPS; hbm-dma is the pallas DMA-pipeline
@@ -129,6 +129,14 @@ def check_runners() -> dict:
 
         return warmpool.quick_check()
 
+    def serving():
+        # continuous-batching serving engine over the paged KV cache: a
+        # small closed-loop A/B — batching must beat sequential scheduling
+        # with IDENTICAL per-request outputs (docs/SERVING.md)
+        from tpu_operator.workloads import serving as srv
+
+        return srv.quick_check()
+
     def ring():
         return collectives.apply_ring_gate(
             collectives.ring_benchmark(
@@ -193,6 +201,7 @@ def check_runners() -> dict:
         "hbm": hbm,
         "hbm-dma": hbm_dma,
         "warm-pool": warm_pool,
+        "serving": serving,
     }
 
 

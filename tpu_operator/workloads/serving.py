@@ -1,0 +1,1272 @@
+"""Sustained serving plane: continuous batching over a paged KV cache.
+
+Every serving number before this module was a one-shot probe
+(``longctx.decode_benchmark``: one request, one cache, per-token latency).
+Millions of users means *sustained* traffic: requests of unequal lengths
+arriving continuously, sharing one cache region, joining and leaving the
+running batch every decode step.  This module is that engine, CPU-runnable
+end to end (the chaos soak's payload) and kernel-compatible with the TPU
+path:
+
+- :class:`PagedKVCache` — the KV cache as a pool of fixed-size token
+  blocks (the compiler-first O(1) autoregressive-cache discipline: state
+  lives in pre-allocated pages, appended in place, never reshaped):
+  per-request *block tables* map logical token positions to physical
+  blocks, allocation/free are O(blocks) list operations with a double-free
+  guard, admission is capacity-based (a request is admitted only when its
+  worst-case block need fits), and :meth:`PagedKVCache.defrag` compacts
+  live blocks into the lowest-numbered slots so the pool's high-water mark
+  shrinks after churn.
+- :class:`ServingEngine` — iteration-level (continuous-batching)
+  scheduling: every :meth:`ServingEngine.step` retires finished requests
+  FIRST (their blocks serve this very step's admissions), admits queued
+  requests that fit, advances prefill under a per-step token budget
+  (chunked, so one long prompt cannot head-of-line-block the running
+  batch's decode), and decodes ONE token for every running request in a
+  single batched attention call.  Batching never changes results: the
+  attention is computed per batch row over length-masked gathered KV, so a
+  request's token stream is identical at batch size 1 and 8 (pinned by
+  test — the property that makes the throughput A/B meaningful).
+- Decode attention runs over KV *gathered from the paged pool*: the
+  ``dense`` implementation is a jitted length-masked reference (one
+  compile ever — shapes padded to ``max_batch`` × ``max_context``); the
+  ``flash`` implementation routes through
+  ``longctx.flash_attention_local`` exactly like ``decode_benchmark``
+  (8-row query tail, block_k = the KV page size) with the gathered KV
+  zero-padded to a block multiple — causal masking kills the padded tail,
+  so paged storage composes with the flash kernel unchanged.  Both paths
+  produce identical tokens (pinned by test).
+- :class:`PoissonTraffic` — seeded arrivals (exponential inter-arrival
+  gaps, uniform prompt/new-token ranges), checkpointable: the RNG bit
+  state and the next-arrival cursor ride the snapshot so a restored
+  replica continues the SAME request schedule without duplicating ids.
+- :func:`serve` — the replica main loop: real-time stepping, flight
+  samples (``tpu_workload_serving_*`` through the agent push hop), and
+  the PR-8 migration contract: on ``tpu.google.com/migrate=requested``
+  (``MigrationSignal``) the engine checkpoints its FULL serving state —
+  the KV pool arrays, every request's block table and token stream, the
+  traffic cursor — via ``workloads/checkpoint.py``'s atomic snapshot
+  machinery and exits 0; the restore pod resumes mid-request with the
+  cache intact (no prefill is re-paid).
+- :func:`batching_ab` — the acceptance A/B: the same seeded closed-loop
+  request set through sequential (one-request-at-a-time) and
+  continuous-batching scheduling at the SAME compiled batch shape,
+  returning aggregate tokens/sec and per-request TPOT for both — the
+  ``bench.py --serve`` ≥2x gate.
+
+Env contract (docs/SERVING.md): ``TPU_SERVE_RATE`` / ``TPU_SERVE_SECONDS``
+/ ``TPU_SERVE_SEED`` / ``TPU_SERVE_BLOCKS`` / ``TPU_SERVE_BLOCK_TOKENS``
+/ ``TPU_SERVE_MAX_BATCH`` / ``TPU_SERVE_PREFILL_BUDGET`` /
+``TPU_SERVE_PROMPT_TOKENS`` / ``TPU_SERVE_NEW_TOKENS`` /
+``TPU_SERVE_NAME`` / ``TPU_SERVE_STEP_INTERVAL_S`` plus the shared
+``TPU_CKPT_DIR`` / ``TPU_MIGRATE_SIGNAL_FILE`` / ``TPU_JOB_RESULT_FILE``
+migration/drop-box contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import heapq
+import json
+import math
+import os
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from tpu_operator import consts
+from tpu_operator.obs import flight
+from tpu_operator.workloads import checkpoint as ckpt_api
+
+# environment contract (docs/SERVING.md "Env contract")
+RATE_ENV = "TPU_SERVE_RATE"
+SECONDS_ENV = "TPU_SERVE_SECONDS"
+SEED_ENV = "TPU_SERVE_SEED"
+BLOCKS_ENV = "TPU_SERVE_BLOCKS"
+BLOCK_TOKENS_ENV = "TPU_SERVE_BLOCK_TOKENS"
+MAX_BATCH_ENV = "TPU_SERVE_MAX_BATCH"
+PREFILL_BUDGET_ENV = "TPU_SERVE_PREFILL_BUDGET"
+PROMPT_TOKENS_ENV = "TPU_SERVE_PROMPT_TOKENS"
+NEW_TOKENS_ENV = "TPU_SERVE_NEW_TOKENS"
+NAME_ENV = "TPU_SERVE_NAME"
+STEP_INTERVAL_ENV = "TPU_SERVE_STEP_INTERVAL_S"
+
+# request states
+QUEUED = "queued"
+PREFILL = "prefill"
+RUNNING = "running"
+DONE = "done"
+CANCELLED = "cancelled"
+
+# rolling-stat window sizes (samples, not seconds): enough for a stable
+# p99, small enough that a migration-era spike ages out of the pushed
+# gauges within a few hundred steps
+_ROLLING_SAMPLES = 512
+_RATE_WINDOW_S = 5.0
+# minimum evidence span before a rolling rate is reported: a single-step
+# history would divide a batch of tokens by (nearly) zero seconds and
+# push an absurdly inflated gauge into the SLO feed on every ramp-up
+_RATE_MIN_SPAN_S = 0.5
+
+
+def _percentile(values: list[float], frac: float) -> float:
+    """Index percentile over an ASCENDING list (0 when empty) — the one
+    convention the rolling gauges, the replica result, and the A/B gate
+    all share."""
+    if not values:
+        return 0.0
+    return float(values[min(len(values) - 1, int(frac * len(values)))])
+
+
+class ServingError(Exception):
+    """A request the engine cannot ever serve (oversize, bad shape)."""
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache.
+
+
+class PagedKVCache:
+    """Fixed-size-block KV pool shared by every in-flight request.
+
+    K and V live as ``[num_blocks, block_tokens, heads, head_dim]`` numpy
+    arrays; a request owns an ordered *block table* (list of physical
+    block ids) and its logical token position ``p`` lives at
+    ``(table[p // block_tokens], p % block_tokens)``.  Allocation pops
+    from a free list ATOMICALLY — check and take are one synchronous
+    operation with no await point between them, which is the whole
+    admission-race story (tests/test_race.py drives the interleavings and
+    proves a split check-then-take double-allocates).
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_tokens: int,
+        heads: int,
+        head_dim: int,
+        dtype=np.float32,
+    ):
+        if num_blocks <= 0 or block_tokens <= 0:
+            raise ServingError("num_blocks and block_tokens must be positive")
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+        self.heads = heads
+        self.head_dim = head_dim
+        self.k = np.zeros((num_blocks, block_tokens, heads, head_dim), dtype)
+        self.v = np.zeros_like(self.k)
+        # min-heap free list: the smallest block id pops first so
+        # low-numbered blocks are preferred (keeps the high-water mark
+        # honest without defrag) at O(log n) per alloc/free
+        self._free: list[int] = list(range(num_blocks))
+        self._free_set: set[int] = set(self._free)
+        self.alloc_failures = 0
+
+    # -- allocation ----------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.block_tokens))
+
+    def try_alloc(self, n: int) -> Optional[list[int]]:
+        """``n`` blocks, or None when the pool cannot satisfy the request
+        — the capacity-based admission check and the take are ONE atomic
+        operation (no await/yield between them)."""
+        if n <= 0:
+            raise ServingError(f"alloc of {n} blocks")
+        if len(self._free) < n:
+            self.alloc_failures += 1
+            return None
+        blocks = [heapq.heappop(self._free) for _ in range(n)]
+        self._free_set.difference_update(blocks)
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b in self._free_set or not (0 <= b < self.num_blocks):
+                raise ServingError(f"double-free of KV block {b}")
+            self._free_set.add(b)
+            heapq.heappush(self._free, b)
+
+    def high_water(self) -> int:
+        """Highest used block id + 1 (0 when idle): the pool prefix a
+        contiguous-arena backend would have to keep resident."""
+        used = set(range(self.num_blocks)) - self._free_set
+        return (max(used) + 1) if used else 0
+
+    def defrag(self, tables: dict[str, list[int]]) -> int:
+        """Compact live blocks into the lowest-numbered free slots,
+        rewriting the given block tables in place; returns moves made.
+        Fixed-size paging has no *external* fragmentation — any free block
+        serves any request — but a scattered pool pins a high high-water
+        mark (the resident-prefix cost above) and smears gathers across
+        the arena; compaction after a churn burst undoes that."""
+        moves = 0
+        for table in tables.values():
+            for i, src in enumerate(table):
+                if not self._free or self._free[0] >= src:
+                    continue  # heap root IS the min: nothing lower is free
+                dst = heapq.heappop(self._free)
+                self._free_set.discard(dst)
+                self.k[dst] = self.k[src]
+                self.v[dst] = self.v[src]
+                table[i] = dst
+                self._free_set.add(src)
+                heapq.heappush(self._free, src)
+                moves += 1
+        return moves
+
+    # -- token I/O -----------------------------------------------------
+    def write_tokens(
+        self, table: list[int], start: int, k: np.ndarray, v: np.ndarray
+    ) -> None:
+        """Scatter ``k``/``v`` (``[T, heads, head_dim]``) for logical
+        positions ``start .. start+T-1`` into the request's blocks."""
+        bt = self.block_tokens
+        for i in range(k.shape[0]):
+            pos = start + i
+            block = table[pos // bt]
+            slot = pos % bt
+            self.k[block, slot] = k[i]
+            self.v[block, slot] = v[i]
+
+    def gather(
+        self, table: list[int], length: int, pad_to: Optional[int] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Contiguous ``[pad_to, heads, head_dim]`` K and V for the first
+        ``length`` logical tokens (zero-padded past them) — the paged →
+        contiguous hop in front of the attention kernel."""
+        bt = self.block_tokens
+        pad_to = length if pad_to is None else pad_to
+        nb = math.ceil(length / bt)
+        out_k = np.zeros((pad_to, self.heads, self.head_dim), self.k.dtype)
+        out_v = np.zeros_like(out_k)
+        if nb:
+            idx = np.asarray(table[:nb])
+            flat_k = self.k[idx].reshape(nb * bt, self.heads, self.head_dim)
+            flat_v = self.v[idx].reshape(nb * bt, self.heads, self.head_dim)
+            out_k[:length] = flat_k[:length]
+            out_v[:length] = flat_v[:length]
+        return out_k, out_v
+
+    # -- invariants ----------------------------------------------------
+    def check_integrity(self, tables: dict[str, list[int]]) -> None:
+        """Every live table disjoint from every other AND from the free
+        list, and together they account for the whole pool — the
+        double-allocation invariant the race suite sweeps."""
+        seen: dict[int, str] = {}
+        for rid, table in tables.items():
+            for b in table:
+                if b in seen:
+                    raise ServingError(
+                        f"KV block {b} double-allocated: {seen[b]} and {rid}"
+                    )
+                if b in self._free_set:
+                    raise ServingError(
+                        f"KV block {b} owned by {rid} AND on the free list"
+                    )
+                seen[b] = rid
+        if len(self._free) != len(self._free_set):
+            raise ServingError("free list/set diverged")
+        if len(seen) + len(self._free) != self.num_blocks:
+            # EXACT accounting, both directions: over-commit is a double
+            # booking, a shortfall is a LEAKED block (released from a
+            # table without reaching the free list) — the race sweep needs
+            # the step-level localization either way
+            raise ServingError(
+                f"pool accounting broken: {len(seen)} owned + "
+                f"{len(self._free)} free != {self.num_blocks}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Toy deterministic LM: enough model to make serving real (per-position
+# Q/K/V, causal attention over the cache, greedy next-token) while staying
+# seed-reproducible so checkpoint/restore and batch-invariance are
+# bit-checkable.
+
+
+class ToyLM:
+    def __init__(
+        self,
+        vocab: int = 128,
+        heads: int = 2,
+        head_dim: int = 16,
+        max_context: int = 256,
+        seed: int = 0,
+    ):
+        self.vocab = vocab
+        self.heads = heads
+        self.head_dim = head_dim
+        self.max_context = max_context
+        self.seed = seed
+        d = heads * head_dim
+        rng = np.random.default_rng(seed)
+        scale = 1.0 / math.sqrt(d)
+        self.emb = (rng.standard_normal((vocab, d)) * 0.5).astype(np.float32)
+        self.wq = (rng.standard_normal((d, d)) * scale).astype(np.float32)
+        self.wk = (rng.standard_normal((d, d)) * scale).astype(np.float32)
+        self.wv = (rng.standard_normal((d, d)) * scale).astype(np.float32)
+        self.wu = (rng.standard_normal((d, vocab)) * scale).astype(np.float32)
+        # sinusoidal positions: KV must depend on position or the cache
+        # would be content-addressable and the paging untestable
+        pos = np.arange(max_context)[:, None]
+        freq = np.exp(-np.arange(0, d, 2) * (math.log(10000.0) / d))[None, :]
+        table = np.zeros((max_context, d), np.float32)
+        table[:, 0::2] = np.sin(pos * freq)
+        table[:, 1::2] = np.cos(pos * freq)
+        self.pos = table
+
+    def _x(self, tokens: np.ndarray, positions: np.ndarray) -> np.ndarray:
+        return self.emb[tokens] + self.pos[positions]
+
+    def qkv(
+        self, tokens: np.ndarray, positions: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """``[T, heads, head_dim]`` Q, K, V for the given token ids at the
+        given positions."""
+        x = self._x(np.asarray(tokens), np.asarray(positions))
+        shape = (x.shape[0], self.heads, self.head_dim)
+        return (
+            (x @ self.wq).reshape(shape),
+            (x @ self.wk).reshape(shape),
+            (x @ self.wv).reshape(shape),
+        )
+
+    def next_token(self, attended: np.ndarray) -> int:
+        """Greedy decode from one position's attended output
+        (``[heads, head_dim]``)."""
+        logits = attended.reshape(-1) @ self.wu
+        return int(np.argmax(logits))
+
+
+@functools.lru_cache(maxsize=8)
+def _dense_attend(max_batch: int, max_context: int, heads: int, head_dim: int):
+    """One jitted length-masked decode attention per engine SHAPE (not per
+    engine instance): q ``[B, H, D]`` against gathered KV
+    ``[B, C, H, D]`` with per-row valid lengths.  Rows are independent —
+    the batch-invariance property the determinism test pins."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(head_dim)
+
+    @jax.jit
+    def attend(q, k, v, lengths):
+        s = jnp.einsum("bhd,bchd->bhc", q, k) * scale
+        mask = jnp.arange(max_context)[None, None, :] < lengths[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        w = jnp.where(mask, w, 0.0)
+        return jnp.einsum("bhc,bchd->bhd", w, v)
+
+    return attend
+
+
+# ---------------------------------------------------------------------------
+# Requests and traffic.
+
+
+@dataclass
+class Request:
+    rid: str
+    prompt: list[int]
+    max_new_tokens: int
+    arrival: float
+    state: str = QUEUED
+    blocks: list[int] = field(default_factory=list)
+    prefilled: int = 0
+    tokens: list[int] = field(default_factory=list)
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    done_at: Optional[float] = None
+    tpot_samples: list[float] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.tokens:
+            self.tokens = list(self.prompt)
+
+    @property
+    def generated(self) -> int:
+        return len(self.tokens) - len(self.prompt)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.arrival
+
+    def to_snapshot(self) -> dict:
+        return {
+            "rid": self.rid,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "arrival": self.arrival,
+            "state": self.state,
+            "blocks": list(self.blocks),
+            "prefilled": self.prefilled,
+            "tokens": list(self.tokens),
+            "first_token_at": self.first_token_at,
+            "last_token_at": self.last_token_at,
+            "tpot_samples": list(self.tpot_samples),
+        }
+
+    @classmethod
+    def from_snapshot(cls, data: dict) -> "Request":
+        req = cls(
+            rid=data["rid"],
+            prompt=list(data["prompt"]),
+            max_new_tokens=int(data["max_new_tokens"]),
+            arrival=float(data["arrival"]),
+            state=data["state"],
+            blocks=list(data["blocks"]),
+            prefilled=int(data["prefilled"]),
+            tokens=list(data["tokens"]),
+            first_token_at=data.get("first_token_at"),
+            last_token_at=data.get("last_token_at"),
+            tpot_samples=list(data.get("tpot_samples") or []),
+        )
+        return req
+
+
+class PoissonTraffic:
+    """Seeded open-loop arrivals: exponential gaps at ``rate`` requests/s,
+    uniform prompt/new-token draws.  The full generator state (RNG bit
+    state + arrival cursor + id counter) serializes into the serving
+    checkpoint so a migrated replica continues the schedule, not restarts
+    it."""
+
+    def __init__(
+        self,
+        rate: float,
+        prompt_tokens: tuple[int, int] = (24, 64),
+        new_tokens: tuple[int, int] = (12, 32),
+        vocab: int = 128,
+        seed: int = 0,
+        prefix: str = "req",
+    ):
+        self.rate = rate
+        self.prompt_tokens = prompt_tokens
+        self.new_tokens = new_tokens
+        self.vocab = vocab
+        self.prefix = prefix
+        self.rng = np.random.default_rng(seed)
+        self.next_id = 0
+        self.next_at = self._gap()
+
+    def _gap(self) -> float:
+        if self.rate <= 0:
+            return float("inf")
+        return float(self.rng.exponential(1.0 / self.rate))
+
+    def _mint(self, arrival: float) -> Request:
+        plo, phi = self.prompt_tokens
+        nlo, nhi = self.new_tokens
+        prompt_len = int(self.rng.integers(plo, phi + 1))
+        new = int(self.rng.integers(nlo, nhi + 1))
+        prompt = [int(t) for t in self.rng.integers(0, self.vocab, prompt_len)]
+        req = Request(
+            rid=f"{self.prefix}-{self.next_id}",
+            prompt=prompt,
+            max_new_tokens=new,
+            arrival=arrival,
+        )
+        self.next_id += 1
+        return req
+
+    def due(self, now: float) -> list[Request]:
+        out = []
+        while self.next_at <= now:
+            out.append(self._mint(self.next_at))
+            self.next_at += self._gap()
+        return out
+
+    def state(self) -> dict:
+        return {
+            "rate": self.rate,
+            "next_id": self.next_id,
+            "next_at": self.next_at,
+            "rng": self.rng.bit_generator.state,
+        }
+
+    def restore(self, state: dict) -> None:
+        self.next_id = int(state["next_id"])
+        self.next_at = float(state["next_at"])
+        self.rng.bit_generator.state = state["rng"]
+
+
+# ---------------------------------------------------------------------------
+# The engine.
+
+
+@dataclass
+class ServeConfig:
+    vocab: int = 128
+    heads: int = 2
+    head_dim: int = 16
+    num_blocks: int = 96
+    block_tokens: int = 16
+    max_batch: int = 8
+    max_context: int = 128
+    prefill_budget: int = 64
+    # admission width: continuous batching admits up to max_batch; the
+    # sequential baseline admits ONE request at a time (same compiled
+    # shapes, different scheduling — the only variable in the A/B)
+    admit_limit: int = 0  # 0 = max_batch
+    attend: str = "dense"  # dense | flash (flash = longctx kernel path)
+    model_seed: int = 0
+    name: str = "serving"
+
+    def __post_init__(self):
+        if self.max_context % self.block_tokens:
+            raise ServingError("max_context must be a block_tokens multiple")
+
+    @property
+    def admission_width(self) -> int:
+        return self.admit_limit or self.max_batch
+
+
+class ServingEngine:
+    """Iteration-level scheduler over one :class:`PagedKVCache`."""
+
+    def __init__(self, cfg: ServeConfig, model: Optional[ToyLM] = None):
+        self.cfg = cfg
+        self.model = model or ToyLM(
+            vocab=cfg.vocab, heads=cfg.heads, head_dim=cfg.head_dim,
+            max_context=cfg.max_context, seed=cfg.model_seed,
+        )
+        self.cache = PagedKVCache(
+            cfg.num_blocks, cfg.block_tokens, cfg.heads, cfg.head_dim
+        )
+        self.queued: deque[Request] = deque()
+        self.prefilling: list[Request] = []
+        self.running: list[Request] = []
+        self.steps = 0
+        self.tokens_generated = 0
+        self.requests_completed = 0
+        self.requests_rejected = 0
+        self.requests_cancelled = 0
+        # rolling stats (samples, newest-first irrelevant — percentiles)
+        self._ttft: deque[float] = deque(maxlen=_ROLLING_SAMPLES)
+        self._tpot: deque[float] = deque(maxlen=_ROLLING_SAMPLES)
+        self._token_times: deque[tuple[float, int]] = deque(maxlen=4096)
+        self._completions: list[dict] = []
+
+    # -- submission ----------------------------------------------------
+    def submit(self, req: Request) -> bool:
+        """Queue a request; False (counted) when it can never fit — over
+        the context bound OR over the WHOLE pool's block count.  The pool
+        check matters independently: an unserviceable request reaching the
+        queue head would wedge the FIFO forever (admission never overtakes
+        a starved head, and serve() waits for the queue to drain)."""
+        total = len(req.prompt) + req.max_new_tokens
+        if (
+            not req.prompt
+            or total > self.cfg.max_context
+            or self.cache.blocks_for_tokens(total) > self.cache.num_blocks
+        ):
+            self.requests_rejected += 1
+            return False
+        self.queued.append(req)
+        return True
+
+    def cancel(self, rid: str) -> bool:
+        """Client went away: drop the request wherever it stands and free
+        its blocks immediately."""
+        for req in list(self.queued):
+            if req.rid == rid:
+                self.queued.remove(req)
+                req.state = CANCELLED
+                self.requests_cancelled += 1
+                return True
+        for bucket in (self.prefilling, self.running):
+            for req in bucket:
+                if req.rid == rid:
+                    bucket.remove(req)
+                    self._release(req, CANCELLED)
+                    self.requests_cancelled += 1
+                    return True
+        return False
+
+    def _release(self, req: Request, state: str) -> None:
+        if req.blocks:
+            self.cache.free(req.blocks)
+            req.blocks = []
+        req.state = state
+
+    # -- scheduling ----------------------------------------------------
+    def _blocks_needed(self, req: Request) -> int:
+        return self.cache.blocks_for_tokens(
+            len(req.prompt) + req.max_new_tokens
+        )
+
+    def _admit(self) -> int:
+        """FIFO capacity-based admission: a queued request joins only when
+        its WORST-CASE block need allocates (no mid-decode OOM, ever) and
+        the batch has a seat.  The check and the allocation are one atomic
+        ``try_alloc`` — see the race suite."""
+        admitted = 0
+        width = self.cfg.admission_width
+        while self.queued:
+            active = len(self.prefilling) + len(self.running)
+            if active >= min(width, self.cfg.max_batch):
+                break
+            req = self.queued[0]
+            blocks = self.cache.try_alloc(self._blocks_needed(req))
+            if blocks is None:
+                break  # FIFO: no overtaking past a starved head
+            self.queued.popleft()
+            req.blocks = blocks
+            req.state = PREFILL
+            req.prefilled = 0
+            self.prefilling.append(req)
+            admitted += 1
+        return admitted
+
+    def _prefill(self) -> int:
+        """Advance prefill across admitted requests under the per-step
+        token budget (chunked: a long prompt spreads over iterations
+        instead of blocking the batch's decode)."""
+        budget = self.cfg.prefill_budget
+        done: list[Request] = []
+        for req in self.prefilling:
+            if budget <= 0:
+                break
+            take = min(budget, len(req.prompt) - req.prefilled)
+            if take > 0:
+                start = req.prefilled
+                chunk = np.asarray(req.prompt[start:start + take])
+                positions = np.arange(start, start + take)
+                _, k, v = self.model.qkv(chunk, positions)
+                self.cache.write_tokens(req.blocks, start, k, v)
+                req.prefilled += take
+                budget -= take
+            if req.prefilled >= len(req.prompt):
+                done.append(req)
+        for req in done:
+            self.prefilling.remove(req)
+            req.state = RUNNING
+            self.running.append(req)
+        return len(done)
+
+    # -- decode --------------------------------------------------------
+    def _attend_dense(self, reqs: list[Request], qs: np.ndarray) -> np.ndarray:
+        cfg = self.cfg
+        B = cfg.max_batch
+        k = np.zeros((B, cfg.max_context, cfg.heads, cfg.head_dim), np.float32)
+        v = np.zeros_like(k)
+        lengths = np.zeros((B,), np.int32)
+        q = np.zeros((B, cfg.heads, cfg.head_dim), np.float32)
+        for i, req in enumerate(reqs):
+            length = len(req.tokens)
+            gk, gv = self.cache.gather(req.blocks, length, pad_to=cfg.max_context)
+            k[i], v[i] = gk, gv
+            lengths[i] = length
+            q[i] = qs[i]
+        attend = _dense_attend(B, cfg.max_context, cfg.heads, cfg.head_dim)
+        out = np.asarray(attend(q, k, v, lengths))
+        return out[: len(reqs)]
+
+    def _attend_flash(self, reqs: list[Request], qs: np.ndarray) -> np.ndarray:
+        """The TPU-kernel path: per request, ``longctx.flash_attention_local``
+        with an 8-row query tail (``decode_benchmark``'s exact shape) over
+        gathered KV zero-padded to a block multiple — padded keys sit at
+        positions past every query, so the kernel's causal masking drops
+        them and paged storage composes with the flash kernel unchanged."""
+        from tpu_operator.workloads import longctx
+
+        tail = 8
+        cfg = self.cfg
+        out = np.zeros((len(reqs), cfg.heads, cfg.head_dim), np.float32)
+        for i, req in enumerate(reqs):
+            length = len(req.tokens)
+            if length < tail:
+                # a tail shorter than the Mosaic row minimum: dense fallback
+                out[i] = self._attend_dense([req], qs[i:i + 1])[0]
+                continue
+            pad = cfg.block_tokens * math.ceil(length / cfg.block_tokens)
+            gk, gv = self.cache.gather(req.blocks, length, pad_to=pad)
+            # [T, H, D] -> merged [BH=H, T, D]
+            km = np.ascontiguousarray(gk.transpose(1, 0, 2))
+            vm = np.ascontiguousarray(gv.transpose(1, 0, 2))
+            toks = np.asarray(req.tokens[length - tail:length])
+            positions = np.arange(length - tail, length)
+            qt, _, _ = self.model.qkv(toks, positions)
+            qm = np.ascontiguousarray(qt.transpose(1, 0, 2))
+            o, _ = longctx.flash_attention_local(
+                qm, km, vm, causal=True,
+                block_k=cfg.block_tokens, block_q=tail,
+                q_off=length - tail,
+            )
+            out[i] = np.asarray(o)[:, -1, :]
+        return out
+
+    def _decode(self, now: float) -> int:
+        reqs = self.running[: self.cfg.max_batch]
+        if not reqs:
+            return 0
+        # q from each request's LAST token at its position — one vectorized
+        # projection for the whole batch (a per-request loop here would tax
+        # exactly the batched path the scheduler exists to win on)
+        qs, _, _ = self.model.qkv(
+            np.asarray([req.tokens[-1] for req in reqs]),
+            np.asarray([len(req.tokens) - 1 for req in reqs]),
+        )
+        if self.cfg.attend == "flash":
+            attended = self._attend_flash(reqs, qs)
+        else:
+            attended = self._attend_dense(reqs, qs)
+        # greedy next tokens for the whole batch in one projection
+        logits = attended.reshape(len(reqs), -1) @ self.model.wu
+        next_tokens = np.argmax(logits, axis=-1)
+        finished: list[Request] = []
+        continuing: list[tuple[Request, int, int]] = []
+        for i, req in enumerate(reqs):
+            token = int(next_tokens[i])
+            pos = len(req.tokens)
+            req.tokens.append(token)
+            self.tokens_generated += 1
+            if req.first_token_at is None:
+                req.first_token_at = now
+                self._ttft.append(req.ttft_s or 0.0)
+            else:
+                # first_token_at set implies last_token_at set — and it may
+                # legitimately be 0.0 (explicit-clock callers), so no falsy
+                # fallback: `or now` here zeroed the first TPOT sample
+                interval = now - req.last_token_at
+                req.tpot_samples.append(interval)
+                self._tpot.append(interval)
+            req.last_token_at = now
+            if req.generated >= req.max_new_tokens:
+                finished.append(req)
+            else:
+                continuing.append((req, token, pos))
+        if continuing:
+            # the new tokens' KV joins the cache (block seats were reserved
+            # at admission — appends can never OOM mid-flight); one
+            # vectorized projection, scattered per request
+            _, ks, vs = self.model.qkv(
+                np.asarray([t for _, t, _ in continuing]),
+                np.asarray([p for _, _, p in continuing]),
+            )
+            for i, (req, _, pos) in enumerate(continuing):
+                self.cache.write_tokens(req.blocks, pos, ks[i:i + 1], vs[i:i + 1])
+        self._token_times.append((now, len(reqs)))
+        for req in finished:
+            self.running.remove(req)
+            req.done_at = now
+            self._completions.append({
+                "rid": req.rid,
+                "tokens": req.generated,
+                "ttft_s": req.ttft_s,
+                "tpot_mean_s": (
+                    sum(req.tpot_samples) / len(req.tpot_samples)
+                    if req.tpot_samples else 0.0
+                ),
+            })
+            self._release(req, DONE)
+            self.requests_completed += 1
+        return len(finished)
+
+    # -- the iteration -------------------------------------------------
+    def step(self, now: Optional[float] = None) -> dict:
+        """One continuous-batching iteration: retire → admit → prefill →
+        decode.  Retirement runs FIRST so blocks freed by finishing
+        requests serve this same step's admissions (retirement itself
+        happens at the end of the previous decode; this ordering note is
+        the scheduling contract the race suite interleaves)."""
+        now = time.monotonic() if now is None else now
+        self.steps += 1
+        admitted = self._admit()
+        prefilled = self._prefill()
+        finished = self._decode(now)
+        return {
+            "now": now,
+            "admitted": admitted,
+            "prefill_completed": prefilled,
+            "finished": finished,
+            "queue_depth": len(self.queued),
+            "batch": len(self.running),
+            "prefilling": len(self.prefilling),
+            "kv_blocks_free": self.cache.free_count,
+        }
+
+    @property
+    def active(self) -> int:
+        return len(self.queued) + len(self.prefilling) + len(self.running)
+
+    def block_tables(self) -> dict[str, list[int]]:
+        return {
+            req.rid: req.blocks
+            for req in (*self.prefilling, *self.running)
+            if req.blocks
+        }
+
+    def check_integrity(self) -> None:
+        self.cache.check_integrity(self.block_tables())
+
+    # -- rolling telemetry --------------------------------------------
+    @staticmethod
+    def _p99(samples) -> float:
+        return _percentile(sorted(samples), 0.99)
+
+    def tokens_per_sec(self, now: Optional[float] = None) -> Optional[float]:
+        """Rolling decode rate, or None while the window holds too little
+        evidence to divide by — a fresh ramp's single-step history must
+        not push a near-zero-span (and so wildly inflated) rate into the
+        SLO feed.  0.0 means a live batch produced nothing all window: a
+        genuine stall."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - _RATE_WINDOW_S
+        recent = [(ts, n) for ts, n in self._token_times if ts >= cutoff]
+        if not recent:
+            return 0.0 if self.running else None
+        span = now - recent[0][0]
+        if span < _RATE_MIN_SPAN_S:
+            return None
+        return sum(n for _, n in recent) / span
+
+    def telemetry(self, now: Optional[float] = None) -> dict:
+        """The flight-sample metric map (obs/flight COUNTER_KEYS names →
+        the ``tpu_workload_serving_*`` catalogue).
+
+        ``serve_tokens_per_sec`` is emitted only when the rate window
+        holds enough evidence to divide by (:meth:`tokens_per_sec`): an
+        idle replica (warm-up, drain tail, traffic gap) and a
+        just-ramping batch both go DARK on the throughput gauge instead
+        of pushing zeros or near-zero-span inflated rates — idle is not
+        degraded, and the PR-6 burn-rate engine's no-evidence semantics
+        are exactly the right judge for a quiet gauge.  A pushed 0 means
+        a live batch produced nothing all window: a genuine stall the
+        throughput SLO must fire on."""
+        out = {
+            "serve_ttft_p99_s": round(self._p99(self._ttft), 6),
+            "serve_tpot_p99_s": round(self._p99(self._tpot), 6),
+            "serve_queue_depth": float(len(self.queued)),
+            "serve_batch_size": float(len(self.running)),
+            "serve_kv_blocks_free": float(self.cache.free_count),
+            "serve_requests_completed": float(self.requests_completed),
+            "serve_requests_rejected": float(self.requests_rejected),
+        }
+        tps = self.tokens_per_sec(now)
+        if tps is not None:
+            out["serve_tokens_per_sec"] = round(tps, 3)
+        return out
+
+    def completions(self) -> list[dict]:
+        return list(self._completions)
+
+    # -- checkpoint/restore (the PR-8 migration contract) --------------
+    def snapshot(self) -> tuple[dict, dict]:
+        """(arrays, extra) for ``checkpoint.save_checkpoint``: the KV pool
+        rides as shard-hashed arrays, the request/traffic bookkeeping as
+        the JSON ``extra`` — restore resumes every in-flight request with
+        its cache intact (prefill is never re-paid)."""
+        arrays = {"kv_k": self.cache.k, "kv_v": self.cache.v}
+        extra = {
+            "config": {
+                "vocab": self.cfg.vocab,
+                "heads": self.cfg.heads,
+                "head_dim": self.cfg.head_dim,
+                "num_blocks": self.cfg.num_blocks,
+                "block_tokens": self.cfg.block_tokens,
+                "max_context": self.cfg.max_context,
+                "model_seed": self.cfg.model_seed,
+            },
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "requests_completed": self.requests_completed,
+            "requests_rejected": self.requests_rejected,
+            "requests_cancelled": self.requests_cancelled,
+            "requests": [
+                req.to_snapshot()
+                for req in (*self.queued, *self.prefilling, *self.running)
+            ],
+            # latency evidence rides too: the restored replica's final
+            # result must report LIFETIME percentiles, not just the
+            # post-restore tail — the soak's serving_p99_ms gate reads the
+            # newest result event as whole-life coverage
+            "completions": list(self._completions),
+            "ttft_samples": [float(v) for v in self._ttft],
+            "tpot_samples": [float(v) for v in self._tpot],
+        }
+        return arrays, extra
+
+    @classmethod
+    def from_snapshot(
+        cls, cfg: ServeConfig, arrays: dict, extra: dict
+    ) -> "ServingEngine":
+        saved = extra.get("config") or {}
+        for key in ("heads", "head_dim", "num_blocks", "block_tokens",
+                    "max_context", "vocab", "model_seed"):
+            if saved.get(key) is not None and saved[key] != getattr(cfg, key):
+                raise ServingError(
+                    f"snapshot {key}={saved[key]} != config {getattr(cfg, key)}"
+                )
+        engine = cls(cfg)
+        engine.cache.k[...] = np.asarray(arrays["kv_k"], np.float32)
+        engine.cache.v[...] = np.asarray(arrays["kv_v"], np.float32)
+        engine.steps = int(extra.get("steps") or 0)
+        engine.tokens_generated = int(extra.get("tokens_generated") or 0)
+        engine.requests_completed = int(extra.get("requests_completed") or 0)
+        engine.requests_rejected = int(extra.get("requests_rejected") or 0)
+        engine.requests_cancelled = int(extra.get("requests_cancelled") or 0)
+        engine._completions = list(extra.get("completions") or [])
+        engine._ttft.extend(extra.get("ttft_samples") or [])
+        engine._tpot.extend(extra.get("tpot_samples") or [])
+        # reclaim the snapshot's block ownership from the fresh free list
+        owned: list[int] = []
+        for entry in extra.get("requests") or []:
+            req = Request.from_snapshot(entry)
+            owned.extend(req.blocks)
+            if req.state == QUEUED:
+                engine.queued.append(req)
+            elif req.state == PREFILL:
+                engine.prefilling.append(req)
+            elif req.state == RUNNING:
+                engine.running.append(req)
+        owned_set = set(owned)
+        engine.cache._free = [
+            b for b in engine.cache._free if b not in owned_set
+        ]
+        heapq.heapify(engine.cache._free)
+        engine.cache._free_set = set(engine.cache._free)
+        engine.check_integrity()
+        return engine
+
+
+# ---------------------------------------------------------------------------
+# The replica main loop (the serve soak's payload).
+
+
+def serve(
+    cfg: ServeConfig,
+    traffic: PoissonTraffic,
+    duration_s: float,
+    ckpt_dir: str = "",
+    sig: Optional[ckpt_api.MigrationSignal] = None,
+    progress: Optional[Callable[[dict], None]] = None,
+    step_interval_s: float = 0.01,
+    clock: Callable[[], float] = time.monotonic,
+) -> dict:
+    """Real-time serving until ``duration_s`` of service elapse or the
+    migration signal lands.  Elapsed service time (not wall time of one
+    process) is the clock: a restored replica picks up at the snapshot's
+    elapsed point and serves the REMAINDER, with the traffic cursor and
+    every in-flight request intact."""
+    sig = sig or ckpt_api.MigrationSignal()
+    elapsed0 = 0.0
+    resumed = False
+    engine: Optional[ServingEngine] = None
+    if ckpt_dir:
+        snap = ckpt_api.load_checkpoint(ckpt_dir)
+        if snap is not None:
+            engine = ServingEngine.from_snapshot(cfg, snap.arrays, snap.extra)
+            serve_state = snap.extra.get("serve") or {}
+            elapsed0 = float(serve_state.get("elapsed_s") or 0.0)
+            if serve_state.get("traffic"):
+                traffic.restore(serve_state["traffic"])
+            resumed = True
+    if engine is None:
+        engine = ServingEngine(cfg)
+    if progress is not None:
+        progress({
+            "event": "restored" if resumed else "started",
+            "elapsed_s": round(elapsed0, 3),
+            "resumed_requests": engine.active if resumed else 0,
+            "tokens_total": engine.tokens_generated,
+        })
+
+    t0 = clock()
+    last_report = 0.0
+    migrated_out = False
+
+    def now_elapsed() -> float:
+        return elapsed0 + (clock() - t0)
+
+    while True:
+        now = now_elapsed()
+        if now >= duration_s and engine.active == 0:
+            break
+        if sig.requested():
+            migrated_out = True
+            break
+        if now < duration_s:
+            for req in traffic.due(now):
+                engine.submit(req)
+        stats = engine.step(now)
+        metrics = engine.telemetry(now)
+        flight.record(cfg.name, "step", step=engine.steps, **metrics)
+        if progress is not None and now - last_report >= 1.0:
+            last_report = now
+            progress({
+                "event": "serving",
+                "elapsed_s": round(now, 3),
+                "tokens_total": engine.tokens_generated,
+                "completed": engine.requests_completed,
+                "queue_depth": stats["queue_depth"],
+                "batch": stats["batch"],
+                # optional: the throughput gauge goes dark while idle
+                "tokens_per_sec": metrics.get("serve_tokens_per_sec", 0.0),
+            })
+        # pace the loop: decode-bound, not spin-bound
+        spent = now_elapsed() - now
+        if step_interval_s > spent:
+            time.sleep(step_interval_s - spent)
+
+    final_elapsed = now_elapsed()
+    checkpointed = False
+    if migrated_out and ckpt_dir:
+        arrays, extra = engine.snapshot()
+        extra["serve"] = {
+            "elapsed_s": final_elapsed,
+            "traffic": traffic.state(),
+        }
+        writer = ckpt_api.Checkpointer(ckpt_dir)
+        writer.save(engine.steps, arrays, extra=extra, final=True)
+        checkpointed = True
+        if progress is not None:
+            progress({
+                "event": "checkpointed",
+                "trigger": "migrate-signal",
+                "step": engine.steps,
+                "tokens_total": engine.tokens_generated,
+                "in_flight": engine.active,
+            })
+
+    completions = engine.completions()
+    tpots = sorted(c["tpot_mean_s"] for c in completions if c["tpot_mean_s"])
+    ttfts = sorted(
+        c["ttft_s"] for c in completions if c.get("ttft_s") is not None
+    )
+    return {
+        # a drained replica that could not honor the migration contract
+        # (signal received, no snapshot published — in-flight requests
+        # silently dropped) must NOT exit 0: the coordinator reads exit 0
+        # as checkpoint-complete
+        "ok": checkpointed or not migrated_out,
+        "resumed": resumed,
+        "migrated_out": migrated_out,
+        "checkpointed": checkpointed,
+        "elapsed_s": round(final_elapsed, 3),
+        "steps": engine.steps,
+        "tokens_total": engine.tokens_generated,
+        "requests_completed": engine.requests_completed,
+        "requests_rejected": engine.requests_rejected,
+        "in_flight_at_exit": engine.active,
+        # tokens_total spans the whole serving lifetime (snapshots carry
+        # the counter), so the rate denominator is total elapsed service
+        "tokens_per_sec": round(
+            engine.tokens_generated / max(1e-6, final_elapsed), 3
+        ),
+        "ttft_p50_s": round(_percentile(ttfts, 0.5), 6),
+        "ttft_p99_s": round(_percentile(ttfts, 0.99), 6),
+        "tpot_p50_s": round(_percentile(tpots, 0.5), 6),
+        "tpot_p99_s": round(_percentile(tpots, 0.99), 6),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The acceptance A/B: continuous batching vs sequential scheduling.
+
+
+def batching_ab(
+    n_requests: int = 24,
+    prompt_tokens: int = 48,
+    new_tokens: int = 32,
+    max_batch: int = 8,
+    seed: int = 7,
+    cfg: Optional[ServeConfig] = None,
+) -> dict:
+    """The same seeded closed-loop request set (all arrive at t=0) through
+    (a) sequential one-request-at-a-time scheduling and (b) continuous
+    batching — IDENTICAL compiled shapes (both pad to ``max_batch``), so
+    the only variable is the scheduler.  Returns both runs' aggregate
+    tokens/sec and per-request mean-TPOT percentiles, plus the
+    batch-invariance verdict (every request's token stream must be
+    identical across the two runs — throughput must not buy different
+    results)."""
+    base = cfg or ServeConfig(max_batch=max_batch)
+
+    def _requests() -> list[Request]:
+        rng = np.random.default_rng(seed)
+        return [
+            Request(
+                rid=f"ab-{i}",
+                prompt=[int(t) for t in rng.integers(0, base.vocab, prompt_tokens)],
+                max_new_tokens=new_tokens,
+                arrival=0.0,
+            )
+            for i in range(n_requests)
+        ]
+
+    def _run_streams(admit_limit: int) -> tuple[dict, dict[str, list[int]]]:
+        cfg_run = ServeConfig(
+            vocab=base.vocab, heads=base.heads, head_dim=base.head_dim,
+            num_blocks=base.num_blocks, block_tokens=base.block_tokens,
+            max_batch=base.max_batch, max_context=base.max_context,
+            prefill_budget=base.prefill_budget, admit_limit=admit_limit,
+            attend=base.attend, model_seed=base.model_seed,
+        )
+        engine = ServingEngine(cfg_run)
+        reqs = _requests()
+        for req in reqs:
+            assert engine.submit(req)
+        t0 = time.perf_counter()
+        guard = 0
+        while engine.active and guard < 1_000_000:
+            engine.step(time.perf_counter() - t0)
+            guard += 1
+        wall = max(1e-9, time.perf_counter() - t0)
+        comps = engine.completions()
+        tpots = sorted(c["tpot_mean_s"] for c in comps if c["tpot_mean_s"])
+        streams = {
+            req.rid: req.tokens[len(req.prompt):] for req in reqs
+        }
+        return {
+            "tokens": engine.tokens_generated,
+            "wall_s": round(wall, 4),
+            "tokens_per_sec": round(engine.tokens_generated / wall, 2),
+            "completed": engine.requests_completed,
+            "tpot_p50_s": _percentile(tpots, 0.5),
+            "tpot_p99_s": _percentile(tpots, 0.99),
+            "steps": engine.steps,
+        }, streams
+
+    # warm the attention path BEFORE timing either run: a one-time compile
+    # landing inside the first (sequential) timed run would deflate its
+    # rate and flatter the A/B — the comparison is scheduling, nothing
+    # else.  Dense warms its single jitted shape directly; flash (many
+    # per-length shapes) warms via one untimed throwaway run.
+    if base.attend == "dense":
+        warm = _dense_attend(
+            base.max_batch, base.max_context, base.heads, base.head_dim
+        )
+        np.asarray(warm(
+            np.zeros((base.max_batch, base.heads, base.head_dim), np.float32),
+            np.zeros((base.max_batch, base.max_context, base.heads,
+                      base.head_dim), np.float32),
+            np.zeros((base.max_batch, base.max_context, base.heads,
+                      base.head_dim), np.float32),
+            np.ones((base.max_batch,), np.int32),
+        ))
+    else:
+        _run_streams(admit_limit=0)
+
+    sequential, seq_streams = _run_streams(admit_limit=1)
+    batched, batch_streams = _run_streams(admit_limit=0)
+    identical = seq_streams == batch_streams
+    speedup = (
+        batched["tokens_per_sec"] / sequential["tokens_per_sec"]
+        if sequential["tokens_per_sec"] else 0.0
+    )
+    return {
+        "ok": bool(
+            identical
+            and sequential["completed"] == n_requests
+            and batched["completed"] == n_requests
+        ),
+        "n_requests": n_requests,
+        "prompt_tokens": prompt_tokens,
+        "new_tokens": new_tokens,
+        "max_batch": max_batch,
+        "sequential": sequential,
+        "batched": batched,
+        "speedup": round(speedup, 3),
+        "identical_outputs": identical,
+    }
+
+
+def quick_check() -> dict:
+    """The validator's opt-in serving probe: a small closed-loop A/B —
+    continuous batching must beat sequential scheduling on this node with
+    identical per-request outputs (``ok`` covers both)."""
+    result = batching_ab(n_requests=8, prompt_tokens=24, new_tokens=12)
+    result["check"] = "serving"
+    # report-only speedup plus the hard correctness half: a node where
+    # batching CHANGES results is broken in a way throughput cannot excuse
+    result["ok"] = bool(result["identical_outputs"]) and result["ok"]
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Module main: the serve-soak replica payload.
+
+
+def _int_range(env: str, default: tuple[int, int]) -> tuple[int, int]:
+    raw = os.environ.get(env, "")
+    if not raw:
+        return default
+    try:
+        lo, _, hi = raw.partition(",")
+        lo_i, hi_i = int(lo), int(hi or lo)
+        return (lo_i, max(lo_i, hi_i))
+    except ValueError:
+        return default
+
+
+def main() -> int:
+    from tpu_operator import workloads
+    from tpu_operator.validator import status as vstatus
+
+    workloads.honor_cpu_platform_request()
+    name = os.environ.get(NAME_ENV, "serving")
+    cfg = ServeConfig(
+        num_blocks=int(os.environ.get(BLOCKS_ENV, "96") or 96),
+        block_tokens=int(os.environ.get(BLOCK_TOKENS_ENV, "16") or 16),
+        max_batch=int(os.environ.get(MAX_BATCH_ENV, "8") or 8),
+        prefill_budget=int(os.environ.get(PREFILL_BUDGET_ENV, "64") or 64),
+        name=name,
+    )
+    traffic = PoissonTraffic(
+        rate=float(os.environ.get(RATE_ENV, "3") or 3),
+        prompt_tokens=_int_range(PROMPT_TOKENS_ENV, (24, 64)),
+        new_tokens=_int_range(NEW_TOKENS_ENV, (12, 32)),
+        vocab=cfg.vocab,
+        seed=int(os.environ.get(SEED_ENV, "0") or 0),
+        prefix=name,
+    )
+    duration = float(os.environ.get(SECONDS_ENV, "30") or 30)
+    step_interval = float(os.environ.get(STEP_INTERVAL_ENV, "0.01") or 0.01)
+    ckpt_dir = os.environ.get(consts.CKPT_DIR_ENV, "")
+    if ckpt_dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    result_file = os.environ.get("TPU_JOB_RESULT_FILE", "")
+
+    def progress(event: dict) -> None:
+        line = json.dumps({"ts": round(time.time(), 3), **event})
+        print(line, flush=True)
+        if result_file:
+            try:
+                with open(result_file, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+
+    recorder = flight.recorder_for(vstatus.flight_record_path(name))
+    with flight.activate(recorder):
+        result = serve(
+            cfg,
+            traffic,
+            duration_s=duration,
+            ckpt_dir=ckpt_dir,
+            progress=progress,
+            step_interval_s=step_interval,
+        )
+        flight.record_result(name, result)
+    progress({"event": "result", **result})
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
