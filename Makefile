@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -188,6 +188,18 @@ slice-churn:
 # live on /debug/fleet (docs/SERVING.md)
 serve-soak:
 	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --serve --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# chip-time accounting acceptance soak (chip-free; ~2-3 min): the same
+# mid-training reclaim runs twice — once through the migration path
+# (checkpoint → reshard → restore, zero replay), once as a kill (node
+# loss, restore from the last periodic snapshot, replay to the
+# HIGHWATER stamp) — and the chip-time ledger must prove the difference:
+# conservation drift ≤1%, the migration grant's goodput measurably above
+# the kill grant's, replayed steps carved to busy_wasted, and
+# /debug/accounting joinable to /debug/explain via reconcile ids
+# (docs/OBSERVABILITY.md "Chip-time accounting")
+goodput:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --goodput --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must

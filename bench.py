@@ -833,12 +833,14 @@ async def _serve_soak(n_nodes: int, seed: int) -> dict:
     from tpu_operator.api.types import (
         CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy,
     )
+    from tpu_operator import scheduling
     from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
     from tpu_operator.controllers.health import HealthReconciler
     from tpu_operator.controllers.runtime import Manager
     from tpu_operator.controllers.upgrade import UpgradeReconciler
-    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.k8s.client import ApiClient, ApiError, Config
     from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.accounting import ChipTimeLedger
     from tpu_operator.obs.events import EventRecorder
     from tpu_operator.obs.fleet import FleetAggregator
     from tpu_operator.obs.trace import Tracer
@@ -1016,18 +1018,35 @@ async def _serve_soak(n_nodes: int, seed: int) -> dict:
         client.metrics = metrics
         recorder = EventRecorder(client, NS)
         fleet = FleetAggregator(metrics)
+        # chip-time ledger: occupancy from the node-stamp sampler below,
+        # workload evidence from the REAL agent push hop (the serving
+        # replicas' counters ride /push → ingest_push → observe_push)
+        ledger = ChipTimeLedger(metrics, fleet=fleet)
+        fleet.ledger = ledger
         tracer = Tracer(metrics, fleet=fleet)
         mgr = Manager(
             client, NS, metrics_port=0, health_port=-1,
             metrics_registry=metrics.registry, recorder=recorder,
             operator_metrics=metrics, tracer=tracer, fleet=fleet,
-            fleet_eval_interval=0.25,
+            fleet_eval_interval=0.25, accounting=ledger,
         )
         obs = dict(metrics=metrics, recorder=recorder, tracer=tracer)
         reconciler = ClusterPolicyReconciler(client, NS, fleet=fleet, **obs)
         reconciler.setup(mgr)
         UpgradeReconciler(client, NS, **obs).setup(mgr)
-        HealthReconciler(client, NS, fleet=fleet, **obs).setup(mgr)
+        HealthReconciler(client, NS, fleet=fleet, ledger=ledger, **obs).setup(mgr)
+
+        async def _ledger_sampler() -> None:
+            # read-only node LISTs: invisible to the _nonlease_writes
+            # steady gate, so the sampler may run through the whole soak
+            while True:
+                try:
+                    nodes = await client.list_items("", "Node")
+                except (ApiError, OSError):
+                    nodes = None  # chaos fault: skip the window
+                if nodes:
+                    ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes)
+                await asyncio.sleep(0.5)
 
         async def _mirror_annotations() -> None:
             """Fake-kubelet downward-API volume: pod annotations rewritten
@@ -1056,6 +1075,7 @@ async def _serve_soak(n_nodes: int, seed: int) -> dict:
                 await asyncio.sleep(0.05)
 
         mirror = asyncio.create_task(_mirror_annotations())
+        sampler = asyncio.create_task(_ledger_sampler())
         # the upgrade machine progresses one state per pass; at the soak's
         # time-scale the production 120s requeue would stall the wave
         # (consts are read at call time — the same seam the reconcile
@@ -1361,12 +1381,24 @@ async def _serve_soak(n_nodes: int, seed: int) -> dict:
                         f"{steady} mutating verbs per window after the "
                         "post-chaos settle (expected 0)"
                     )
+
+                # -- chip-time conservation at teardown -------------------
+                sampler.cancel()
+                try:
+                    await sampler
+                except asyncio.CancelledError:
+                    pass
+                nodes = await client.list_items("", "Node")
+                ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes)
+                result["conservation"] = ledger.conservation()
         finally:
             mirror.cancel()
-            try:
-                await mirror
-            except asyncio.CancelledError:
-                pass
+            sampler.cancel()
+            for task in (mirror, sampler):
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
             agent_stop.set()
             if agent_task is not None:
                 try:
@@ -1434,6 +1466,12 @@ async def _serve_soak(n_nodes: int, seed: int) -> dict:
                 failures.append(
                     f"non-migrated drain evictions on {controller}: {bad}"
                 )
+        cons_drift = (result.get("conservation") or {}).get("drift")
+        if cons_drift is None or cons_drift > 0.01:
+            failures.append(
+                f"chip-time conservation drift {cons_drift} over 1% "
+                f"({result.get('conservation')})"
+            )
 
         result["ok"] = not failures
         result["failures"] = failures
@@ -1490,10 +1528,12 @@ async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
     from tpu_operator.api.types import (
         CLUSTER_POLICY_KIND, GROUP, State, TPUClusterPolicy,
     )
+    from tpu_operator import scheduling
     from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
     from tpu_operator.controllers.health import HealthReconciler
-    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.k8s.client import ApiClient, ApiError, Config
     from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.accounting import ChipTimeLedger
     from tpu_operator.obs.events import EventRecorder
     from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
     from tpu_operator.utils import deep_get, topology_chips
@@ -1567,8 +1607,22 @@ async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
         )
         obs = dict(metrics=metrics, recorder=recorder)
         ClusterPolicyReconciler(client, NS, **obs).setup(mgr)
-        health = HealthReconciler(client, NS, **obs)
+        # the chip-time ledger rides the health engine's drain path; with
+        # no slice scheduler in this soak, occupancy comes from the same
+        # node-stamp read the restart-reconstruction path uses
+        ledger = ChipTimeLedger(metrics)
+        health = HealthReconciler(client, NS, ledger=ledger, **obs)
         health.setup(mgr)
+
+        async def _ledger_sampler() -> None:
+            while True:
+                try:
+                    nodes = await client.list_items("", "Node")
+                except (ApiError, OSError):
+                    nodes = None  # chaos fault: skip the window
+                if nodes:
+                    ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes)
+                await asyncio.sleep(0.5)
 
         async def _mirror_annotations() -> None:
             """The fake kubelet's downward-API volume: pod annotations
@@ -1596,6 +1650,7 @@ async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
                 await asyncio.sleep(0.05)
 
         mirror = asyncio.create_task(_mirror_annotations())
+        sampler = asyncio.create_task(_ledger_sampler())
         try:
             async with mgr:
                 await client.create(TPUClusterPolicy.new(spec={
@@ -1804,12 +1859,24 @@ async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
                     "MigrationTimedOut", "MigrationFailed",
                     "WorkloadEvicted", "NodeQuarantined",
                 })
+
+                # -- chip-time conservation at teardown -------------------
+                sampler.cancel()
+                try:
+                    await sampler
+                except asyncio.CancelledError:
+                    pass
+                nodes = await client.list_items("", "Node")
+                ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes)
+                result["conservation"] = ledger.conservation()
         finally:
             mirror.cancel()
-            try:
-                await mirror
-            except asyncio.CancelledError:
-                pass
+            sampler.cancel()
+            for task in (mirror, sampler):
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
             await client.close()
             for proc in job_procs.values():
                 if proc.poll() is None:
@@ -1869,6 +1936,12 @@ async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
                        "MigrationTimedOut", "WorkloadEvicted", "NodeQuarantined"):
             if reason not in result["event_reasons"]:
                 failures.append(f"{reason} Event not posted")
+        cons_drift = (result.get("conservation") or {}).get("drift")
+        if cons_drift is None or cons_drift > 0.01:
+            failures.append(
+                f"chip-time conservation drift {cons_drift} over 1% "
+                f"({result.get('conservation')})"
+            )
         result["ok"] = not failures
         result["failures"] = failures
         return result
@@ -1941,6 +2014,7 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
     from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
     from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
     from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs.accounting import ChipTimeLedger
     from tpu_operator.obs.events import EventRecorder
     from tpu_operator.obs.explain import ExplainEngine
     from tpu_operator.obs.fleet import FleetAggregator
@@ -1995,6 +2069,10 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
         metrics = OperatorMetrics()
         client.metrics = metrics
         fleet = FleetAggregator(metrics)
+        # chip-time ledger under churn: every grant/release/compaction
+        # of this soak must keep the conservation invariant
+        ledger = ChipTimeLedger(metrics, fleet=fleet)
+        fleet.ledger = ledger
         tracer = Tracer(metrics, fleet=fleet)
         recorder = EventRecorder(client, NS)
         explain = ExplainEngine(fleet=fleet, tracer=tracer)
@@ -2002,7 +2080,7 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
         mgr = Manager(
             client, NS, metrics_port=-1, health_port=-1,
             recorder=recorder, operator_metrics=metrics, tracer=tracer,
-            fleet=fleet, explain=explain,
+            fleet=fleet, explain=explain, accounting=ledger,
         )
         obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
         reconciler = ClusterPolicyReconciler(
@@ -2014,7 +2092,9 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
         )
         plane.setup(mgr)
         reconciler.setup(mgr, plane=plane)
-        sched = SliceSchedulerReconciler(client, NS, fleet=fleet, **obs)
+        sched = SliceSchedulerReconciler(
+            client, NS, fleet=fleet, ledger=ledger, **obs
+        )
         sched.setup(mgr)
 
         async def _mirror_annotations() -> None:
@@ -2387,6 +2467,8 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
                     entry.get("reason") == "SliceCompacted"
                     for entry in explained.get("timeline", [])
                 )
+                # chip-time conservation after the full churn history
+                result["conservation"] = ledger.conservation()
         finally:
             mirror.cancel()
             try:
@@ -2509,6 +2591,12 @@ async def _slice_churn_soak(n_nodes: int, seed: int) -> dict:
                 "SliceCompacted not joinable on the target node's "
                 "/debug/explain timeline"
             )
+        cons_drift = (result.get("conservation") or {}).get("drift")
+        if cons_drift is None or cons_drift > 0.01:
+            failures.append(
+                f"chip-time conservation drift {cons_drift} over 1% "
+                f"({result.get('conservation')})"
+            )
         result["ok"] = not failures
         result["failures"] = failures
         return result
@@ -2524,6 +2612,737 @@ def run_slice_churn_soak(n_nodes: int = 100, seed: int = 1) -> dict:
         f"placement p99 {result.get('placement_p99_s')}s, "
         f"frag {result.get('frag_baseline')} -> {result.get('frag_final')}, "
         f"compacted resume step {result.get('resumed_from_step')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
+GOODPUT_TIMEOUT = 420.0
+GOODPUT_GAP_MIN = 0.02     # kill must measurably lose to migration
+GOODPUT_DRIFT_MAX = 0.01   # the ledger's conservation invariant (1%)
+
+
+async def _goodput_soak(n_nodes: int, seed: int) -> dict:
+    """The chip-time accounting acceptance soak (`make goodput`;
+    docs/OBSERVABILITY.md "Chip-time accounting").
+
+    Two identical CPU-backend training jobs run the same disruption —
+    their 4x4 grant must vacate mid-training and resume on a freed 2x4
+    arc — through the two preemption mechanisms the fleet has:
+
+    - **phase A (migration)** — the job carries the checkpoint handler;
+      freeing the 2x4 arc pushes fragmentation over the threshold and
+      the scheduler compacts the grant through the migration machine
+      (checkpoint → reshard → restore at the checkpointed step, zero
+      replay);
+    - **phase B (kill)** — the job carries NO handler (compaction is
+      vetoed: zero-loss or nothing), so the reclaim is a node loss:
+      the bound node goes unhealthy, the scheduler preempts and
+      re-places the grant, and the restarted process restores from the
+      last *periodic* snapshot, replaying every step between it and
+      the published HIGHWATER stamp.
+
+    The chip-time ledger (obs/accounting.py) watches both through its
+    production feeds only — scheduler grant/release notes, migration-
+    coordinator transitions, and the flight-record evidence hop — and
+    the soak gates on the ledger's verdict: conservation drift ≤ 1%,
+    phase A's per-grant goodput measurably above phase B's, the kill's
+    replayed steps carved to busy_wasted, `/debug/accounting` joinable
+    to `/debug/explain` via reconcile ids, and the steady state back to
+    zero verbs/pass.
+    """
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, SLICE_REQUEST_KIND, State,
+        TPUClusterPolicy, TPUSliceRequest,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs.accounting import ChipTimeLedger
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.explain import ExplainEngine
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.testing import FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get, topology_chips
+
+    if n_nodes < 20:
+        raise SystemExit(
+            f"--goodput needs --nodes >= 20 (one 4x4 + eight 2x4 pools), "
+            f"got {n_nodes}"
+        )
+    workdir = tempfile.mkdtemp(prefix=f"goodput-{seed}-")
+    job_procs: dict[str, subprocess.Popen] = {}
+    signal_files: dict[str, str] = {}
+
+    def _train_executor(pod: dict) -> str:
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "train-job":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        topo = env.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={topology_chips(topo)}"
+        )
+        sig = os.path.join(workdir, f"{name}.annotations")
+        signal_files[name] = sig
+        env[consts.MIGRATE_SIGNAL_FILE_ENV] = sig
+        env["TPU_VALIDATION_ROOT"] = os.path.join(workdir, f"vroot-{name}")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.checkpoint"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            return "Failed"
+        job_procs[name] = proc
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return "Failed"
+        return "Succeeded" if proc.returncode == 0 else "Failed"
+
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_train_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        fleet = FleetAggregator(metrics)
+        ledger = ChipTimeLedger(metrics, fleet=fleet)
+        fleet.ledger = ledger  # agent pushes feed the evidence carve
+        tracer = Tracer(metrics, fleet=fleet)
+        recorder = EventRecorder(client, NS)
+        explain = ExplainEngine(fleet=fleet, tracer=tracer)
+        recorder.sink = explain.observe_event
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, recorder=recorder,
+            operator_metrics=metrics, tracer=tracer, fleet=fleet,
+            explain=explain, accounting=ledger, fleet_eval_interval=0.25,
+        )
+        obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
+        reconciler = ClusterPolicyReconciler(
+            client, NS, fleet=fleet, explain=explain, **obs
+        )
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            metrics=metrics, resync_seconds=20.0,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
+        sched = SliceSchedulerReconciler(
+            client, NS, fleet=fleet, ledger=ledger, **obs
+        )
+        sched.setup(mgr)
+
+        async def _mirror_annotations() -> None:
+            pod_store = fc.store("", "pods")
+            while True:
+                for (_, name), pod in list(pod_store.objects.items()):
+                    sig = signal_files.get(name)
+                    if not sig:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    text = "".join(
+                        f'{k}="{v}"\n' for k, v in sorted(anns.items())
+                    )
+                    try:
+                        with open(sig) as f:
+                            current = f.read()
+                    except OSError:
+                        current = None
+                    if current != text:
+                        tmp = sig + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(text)
+                        os.replace(tmp, sig)
+                await asyncio.sleep(0.05)
+
+        # -- the evidence hop, collapsed in-process ----------------------
+        # Production: workload flight record → node agent → POST /push →
+        # FleetAggregator.ingest_push → ledger.observe_push.  The serve
+        # soak drives that chain over real HTTP; here the subject is the
+        # ledger's carve, so the soak reads each training pod's flight
+        # JSONL (the same file the agent tails) and feeds ingest_push
+        # directly, attributing each pod's cumulative counters to the
+        # node it ran on.  Ledger baselines per (node, check, counter)
+        # de-duplicate the re-pushed windows.
+        discovered: dict[str, dict] = {}  # pod name -> {node, vroot}
+
+        async def _evidence_poll_once() -> None:
+            pod_store = fc.store("", "pods")
+            for (_, pname), pod in list(pod_store.objects.items()):
+                labels = deep_get(pod, "metadata", "labels", default={}) or {}
+                if labels.get("app") != "train-job":
+                    continue
+                node = deep_get(pod, "spec", "nodeName", default="") or ""
+                if pname not in discovered and node:
+                    discovered[pname] = {
+                        "node": node,
+                        "vroot": os.path.join(workdir, f"vroot-{pname}"),
+                    }
+            for pname, info in discovered.items():
+                fp = os.path.join(
+                    info["vroot"], "workload-results", "flight-migration.jsonl"
+                )
+                try:
+                    with open(fp) as f:
+                        lines = f.readlines()
+                except OSError:
+                    continue  # no flush yet
+                counters: dict = {}
+                for line in lines:
+                    try:
+                        sample = json.loads(line)
+                    except ValueError:
+                        continue  # torn mid-rewrite line
+                    m = sample.get("metrics") or {}
+                    for key, counter in flight_api.COUNTER_KEYS.items():
+                        v = m.get(key)
+                        if isinstance(v, (int, float)) and not isinstance(v, bool):
+                            counters[counter] = float(v)
+                if counters:
+                    # check name scoped per pod: two pods reusing one node
+                    # across phases must not share delta baselines
+                    fleet.ingest_push({
+                        "node": info["node"],
+                        "workloads": {
+                            f"migration:{pname}": {"counters": counters},
+                        },
+                    })
+
+        async def _evidence_hop() -> None:
+            while True:
+                await _evidence_poll_once()
+                await asyncio.sleep(0.3)
+
+        def _max_step(
+            events, kinds=("progress", "checkpointed", "result")
+        ) -> int:
+            # "progress" lands only on snapshot boundaries (every 25
+            # steps); "result" carries the final step, so completion
+            # (step 70) is observable
+            return max(
+                (e.get("step", 0) for e in events if e.get("event") in kinds),
+                default=0,
+            )
+
+        def _train_pods():
+            return [
+                (pname, pod)
+                for (_, pname), pod in list(fc.store("", "pods").objects.items())
+                if (deep_get(pod, "metadata", "labels", default={}) or {})
+                .get("app") == "train-job"
+            ]
+
+        def _job_env(ckpt: str, topo: str, res_file: str) -> list:
+            env = {
+                consts.CKPT_DIR_ENV: os.path.join(workdir, ckpt),
+                consts.JOB_TOPOLOGY_ENV: topo,
+                "TPU_JOB_RESULT_FILE": res_file,
+                "TRAIN_STEPS": "70",
+                "TRAIN_STEP_SLEEP_S": "0.05",
+                "TPU_CKPT_EVERY": "25",
+            }
+            return [{"name": k, "value": v} for k, v in env.items()]
+
+        def _job_pod(name: str, node: str, env: list, handler: bool) -> dict:
+            labels = {"app": "train-job"}
+            if handler:
+                labels[consts.MIGRATE_HANDLER_LABEL] = (
+                    consts.MIGRATION_HANDLER_CHECKPOINT
+                )
+            return {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": name, "namespace": "default", "labels": labels,
+                },
+                "spec": {
+                    "nodeName": node,
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "train",
+                        "image": "train-bench:dev",
+                        "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                        "env": env,
+                    }],
+                },
+            }
+
+        async def _wait_bound(request: str, want_key: str, timeout: float = 60.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                cr = await client.get(GROUP, SLICE_REQUEST_KIND, request)
+                status = cr.get("status") or {}
+                arcs = status.get("arcs") or []
+                if status.get("phase") == "Bound" and arcs:
+                    if want_key and arcs[0]["key"] != want_key:
+                        raise AssertionError(
+                            f"{request} bound {arcs[0]['key']}, "
+                            f"want {want_key}"
+                        )
+                    return status
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"{request} never bound")
+
+        async def _wait_step(res_file: str, step: int, timeout: float = 120.0):
+            t0 = time.perf_counter()
+            while _max_step(_read_events(res_file)) < step:
+                if time.perf_counter() - t0 > timeout:
+                    raise TimeoutError(
+                        f"{res_file} never reached step {step} "
+                        f"(at {_max_step(_read_events(res_file))})"
+                    )
+                await asyncio.sleep(0.25)
+
+        async def _wait_pods_succeeded(timeout: float = 180.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                pods = _train_pods()
+                phases = {
+                    p: deep_get(pod, "status", "phase", default="")
+                    for p, pod in pods
+                }
+                if pods and all(ph == "Succeeded" for ph in phases.values()):
+                    return
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"training pods never finished: {phases}")
+
+        mirror = asyncio.create_task(_mirror_annotations())
+        hop = asyncio.create_task(_evidence_hop())
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "migration": {"timeoutSeconds": 30},
+                    "scheduling": {"defragThreshold": 0.3},
+                    "remediation": {"enabled": False},
+                }).obj)
+                # fleet shape (same as slice-churn): one 4x4 pool the A/B
+                # jobs grow onto, eight 2x4 pools, single-host 2x2 fill
+                mids = 8
+                for h in range(4):
+                    fc.add_node(f"big-0-{h}", topology="4x4", labels={
+                        consts.GKE_NODEPOOL_LABEL: "pool-big-0",
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                    })
+                for s in range(mids):
+                    for h in range(2):
+                        fc.add_node(f"mid-{s}-{h}", topology="2x4", labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-mid-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        })
+                for i in range(max(0, n_nodes - 4 - 2 * mids)):
+                    accel = (
+                        "tpu-v5p-slice" if i % 6 == 0
+                        else "tpu-v5-lite-podslice"
+                    )
+                    fc.add_node(f"small-{i}", topology="2x2", accelerator=accel)
+
+                async def _converged() -> bool:
+                    cr = await client.get(
+                        GROUP, CLUSTER_POLICY_KIND, "cluster-policy"
+                    )
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE
+                        in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > GOODPUT_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                base_url = f"http://127.0.0.1:{mgr.metrics_port}"
+
+                # block every 2x4 arc so both A/B requests must grow onto
+                # the 4x4 — the same starting position for both phases
+                for s in range(mids):
+                    await client.create(TPUSliceRequest.new(
+                        f"blk-{s}", {"topology": "2x4"}
+                    ).obj)
+
+                # -- phase A: preemption through the migration path ------
+                await client.create(TPUSliceRequest.new("r-mig", {
+                    "topology": "2x4", "maxTopology": "4x4",
+                }).obj)
+                mig_status = await _wait_bound("r-mig", "pool-big-0")
+                mig_res = os.path.join(workdir, "mig.jsonl")
+                await client.create(_job_pod(
+                    "job-mig", mig_status["arcs"][0]["nodes"][0],
+                    _job_env("ckpt-mig", "4x4", mig_res), handler=True,
+                ))
+                await _wait_step(mig_res, 30)
+
+                # free one 2x4: fragmentation trips and the scheduler must
+                # compact r-mig through checkpoint → reshard → restore
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "blk-0")
+                t1 = time.perf_counter()
+                restored = None
+                while time.perf_counter() - t1 < 120.0:
+                    restored = next(
+                        (e for e in _read_events(mig_res)
+                         if e.get("event") == "restored"), None,
+                    )
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-mig")
+                    arcs = (cr.get("status") or {}).get("arcs") or []
+                    if restored is not None and arcs and (
+                        arcs[0]["key"] == "pool-mid-0"
+                    ):
+                        break
+                    await asyncio.sleep(0.25)
+                if restored is None:
+                    raise TimeoutError("phase A job was never restored")
+                result["mig_resumed_from_step"] = restored.get(
+                    "resumed_from_step"
+                )
+                await _wait_step(mig_res, 70)
+                await _wait_pods_succeeded()
+                # final flight flush (process exit) → last evidence window
+                await asyncio.sleep(0.7)
+                await _evidence_poll_once()
+                await sched.reconcile("slices")
+                result["conservation_after_phase_a"] = ledger.conservation()
+
+                # teardown A: release the grant, clear the pods, re-block
+                # the 2x4 arc so phase B starts from the same position
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "r-mig")
+                for pname, _pod in _train_pods():
+                    await client.delete("", "Pod", pname, "default")
+                t2 = time.perf_counter()
+                while True:
+                    nodes = await client.list_items("", "Node")
+                    stamped = [
+                        n["metadata"]["name"] for n in nodes
+                        if (deep_get(n, "metadata", "labels", default={})
+                            or {}).get(consts.SLICE_REQUEST_LABEL) == "r-mig"
+                    ]
+                    if not stamped:
+                        break
+                    if time.perf_counter() - t2 > 60.0:
+                        raise TimeoutError(f"r-mig stamps never GC'd: {stamped}")
+                    await asyncio.sleep(0.25)
+                # fresh name: the duplicate-creation tracker counts
+                # creates per object name across the whole soak
+                await client.create(TPUSliceRequest.new(
+                    "blk-0b", {"topology": "2x4"}
+                ).obj)
+                await _wait_bound("blk-0b", "pool-mid-0")
+
+                # -- phase B: kill-based preemption ----------------------
+                # no handler: the defrag veto means the ONLY way this
+                # grant vacates is capacity loss — the kill path
+                await client.create(TPUSliceRequest.new("r-kill", {
+                    "topology": "2x4", "maxTopology": "4x4",
+                }).obj)
+                kill_status = await _wait_bound("r-kill", "pool-big-0")
+                kill_res = os.path.join(workdir, "kill.jsonl")
+                kill_node = kill_status["arcs"][0]["nodes"][0]
+                await client.create(_job_pod(
+                    "job-kill", kill_node,
+                    _job_env("ckpt-kill", "4x4", kill_res), handler=False,
+                ))
+                await _wait_step(kill_res, 30)
+                # run on past the periodic snapshot so the kill lands
+                # mid-window — the replayed span is what the ledger must
+                # carve to busy_wasted
+                await asyncio.sleep(0.6)
+                step_at_kill = _max_step(_read_events(kill_res))
+                result["step_at_kill"] = step_at_kill
+
+                # the reclaim: the bound node dies.  Scheduler preempts
+                # the grant; the process dies with the node (no drain, no
+                # checkpoint) and the pod object is cleaned up.
+                await client.patch("", "Node", kill_node, {
+                    "metadata": {"labels": {
+                        consts.TPU_HEALTH_LABEL: consts.HEALTH_UNHEALTHY,
+                    }},
+                })
+                proc = job_procs.get("job-kill")
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                await client.delete("", "Pod", "job-kill", "default")
+                # free the 2x4 target and wait for the re-place
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "blk-0b")
+                t3 = time.perf_counter()
+                rebound = None
+                while time.perf_counter() - t3 < 120.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-kill")
+                    status = cr.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if status.get("phase") == "Bound" and arcs and (
+                        arcs[0]["key"] == "pool-mid-0"
+                    ):
+                        rebound = status
+                        break
+                    await asyncio.sleep(0.25)
+                if rebound is None:
+                    raise TimeoutError("r-kill was never re-placed after the "
+                                       "node loss")
+
+                # restart-controller analogue: relaunch the job on the new
+                # grant; it restores from the last PERIODIC snapshot and
+                # replays everything up to the HIGHWATER stamp
+                await client.create(_job_pod(
+                    "job-kill-r", rebound["arcs"][0]["nodes"][0],
+                    _job_env(
+                        "ckpt-kill",
+                        rebound.get("grantedTopology") or "2x4",
+                        kill_res,
+                    ),
+                    handler=False,
+                ))
+                t4 = time.perf_counter()
+                krestored = None
+                while time.perf_counter() - t4 < 120.0:
+                    krestored = next(
+                        (e for e in _read_events(kill_res)
+                         if e.get("event") == "restored"), None,
+                    )
+                    if krestored is not None:
+                        break
+                    await asyncio.sleep(0.25)
+                if krestored is None:
+                    raise TimeoutError("phase B job never restored from the "
+                                       "periodic snapshot")
+                result["kill_resumed_from_step"] = krestored.get(
+                    "resumed_from_step"
+                )
+                await _wait_step(kill_res, 70)
+                await _wait_pods_succeeded()
+                await client.patch("", "Node", kill_node, {
+                    "metadata": {"labels": {
+                        consts.TPU_HEALTH_LABEL: consts.HEALTH_OK,
+                    }},
+                })
+
+                # -- the ledger's verdict, over the wire -----------------
+                await asyncio.sleep(0.7)
+                await _evidence_poll_once()
+                await sched.reconcile("slices")
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base_url}/debug/accounting") as resp:
+                        acct = await resp.json()
+                row_a = (acct.get("grants") or {}).get("r-mig") or {}
+                row_b = (acct.get("grants") or {}).get("r-kill") or {}
+                result["conservation_drift"] = acct.get("conservation_drift")
+                result["wall_chip_seconds"] = acct.get("wall_chip_seconds")
+                result["goodput_ratio"] = acct.get("goodput_ratio")
+                result["chip_utilization"] = acct.get("chip_utilization")
+                result["goodput_migration"] = row_a.get("goodput_ratio")
+                result["goodput_kill"] = row_b.get("goodput_ratio")
+                result["goodput_gap"] = round(
+                    (row_a.get("goodput_ratio") or 0.0)
+                    - (row_b.get("goodput_ratio") or 0.0), 6,
+                )
+                result["mig_migrations"] = row_a.get("migrations")
+                result["mig_kills"] = row_a.get("kills")
+                result["kill_replayed_steps"] = row_b.get("replayed_steps")
+                result["kill_lost_steps"] = row_b.get("lost_steps")
+                result["kill_busy_wasted"] = row_b.get("busy_wasted")
+                result["kill_preempt_released"] = any(
+                    t.get("event") == "release" and t.get("owner") == "r-kill"
+                    and t.get("outcome") == "preempted"
+                    for t in acct.get("transitions") or []
+                )
+                # /debug/explain join: accounting reconcile ids must
+                # intersect the scheduler Events' annotations, and phase
+                # A's compaction must sit on the target node's timeline
+                acct_ids = {
+                    t.get("reconcile_id")
+                    for t in acct.get("transitions") or []
+                    if t.get("reconcile_id")
+                } | {
+                    g.get("reconcile_id")
+                    for g in (acct.get("grants") or {}).values()
+                    if g.get("reconcile_id")
+                }
+                slice_events = [
+                    e for e in fc.store("", "events").objects.values()
+                    if e.get("reason", "").startswith("Slice")
+                ]
+                event_ids = {
+                    (deep_get(e, "metadata", "annotations", default={})
+                     or {}).get(consts.EVENT_RECONCILE_ID_ANNOTATION)
+                    for e in slice_events
+                }
+                result["accounting_explain_joined"] = bool(
+                    acct_ids & event_ids
+                )
+                explained = explain.snapshot("mid-0-0")
+                result["explain_compaction_joined"] = any(
+                    entry.get("reason") == "SliceCompacted"
+                    for entry in explained.get("timeline", [])
+                )
+
+                # -- steady state ----------------------------------------
+                steady_requests = sched_requests = steady_writes = None
+                t5 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    policy_n = counter.n
+                    with count_api_requests() as counter:
+                        await sched.reconcile("slices")
+                    sched_n = counter.n
+                    writes = _nonlease_writes(fc)
+                    if policy_n == 0 and sched_n == 0 and writes == 0:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                    if time.perf_counter() - t5 > 90:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                result["steady_scheduler_requests_per_pass"] = sched_requests
+                result["steady_writes_per_pass"] = steady_writes
+        finally:
+            for task in (mirror, hop):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            await client.close()
+            for proc in job_procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+
+        result["evictions"] = {
+            reason: _counter_value(
+                metrics, "tpu_operator_drain_evictions",
+                controller="slicescheduler", reason=reason,
+            )
+            for reason in ("migrated", "timeout", "failed", "no-handler",
+                           "forced")
+        }
+        result["duplicate_creations"] = {
+            "/".join(k): v for k, v in fc.duplicate_creations().items()
+        }
+
+        failures = []
+        drift = result.get("conservation_drift")
+        if drift is None or drift > GOODPUT_DRIFT_MAX:
+            failures.append(
+                f"conservation drift {drift} over the "
+                f"{GOODPUT_DRIFT_MAX:.0%} invariant"
+            )
+        drift_a = (result.get("conservation_after_phase_a") or {}).get("drift")
+        if drift_a is None or drift_a > GOODPUT_DRIFT_MAX:
+            failures.append(f"conservation drifted mid-soak: {drift_a}")
+        if not (result.get("wall_chip_seconds") or 0) > 0:
+            failures.append("ledger tracked no wall chip-seconds")
+        if result.get("goodput_migration") is None or (
+            result.get("goodput_kill") is None
+        ):
+            failures.append(
+                f"missing per-grant goodput rows: "
+                f"A={result.get('goodput_migration')} "
+                f"B={result.get('goodput_kill')}"
+            )
+        elif result["goodput_gap"] < GOODPUT_GAP_MIN:
+            failures.append(
+                f"kill did not measurably lose: goodput gap "
+                f"{result['goodput_gap']} < {GOODPUT_GAP_MIN} "
+                f"(A={result['goodput_migration']} "
+                f"B={result['goodput_kill']})"
+            )
+        if (result.get("mig_migrations") or 0) < 1:
+            failures.append("phase A recorded no ledger migration")
+        if result.get("mig_kills"):
+            failures.append(
+                f"phase A recorded kills: {result.get('mig_kills')}"
+            )
+        if not result.get("kill_preempt_released"):
+            failures.append(
+                "phase B preemption missing from the transition log"
+            )
+        if (result.get("kill_replayed_steps") or 0) < 1:
+            failures.append("phase B replay never reached the ledger")
+        if not (result.get("kill_busy_wasted") or 0) > 0:
+            failures.append("phase B replayed steps were not carved to "
+                            "busy_wasted")
+        if result["evictions"].get("migrated", 0) < 1:
+            failures.append("phase A compaction did not ride the migration "
+                            "path")
+        for reason in ("timeout", "failed", "no-handler", "forced"):
+            if result["evictions"].get(reason, 0):
+                failures.append(
+                    f"a drain plain-evicted a workload (reason={reason})"
+                )
+        if not result.get("accounting_explain_joined"):
+            failures.append(
+                "/debug/accounting reconcile ids do not join the scheduler "
+                "Events"
+            )
+        if not result.get("explain_compaction_joined"):
+            failures.append(
+                "SliceCompacted not joinable on the target node's "
+                "/debug/explain timeline"
+            )
+        if result.get("duplicate_creations"):
+            failures.append(
+                f"duplicate creations: {result['duplicate_creations']}"
+            )
+        if result.get("steady_requests_per_pass") != 0:
+            failures.append(
+                f"steady policy requests/pass = "
+                f"{result.get('steady_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_scheduler_requests_per_pass") != 0:
+            failures.append(
+                f"steady scheduler requests/pass = "
+                f"{result.get('steady_scheduler_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_writes_per_pass") != 0:
+            failures.append(
+                f"steady writes/pass = {result.get('steady_writes_per_pass')}"
+                " (want 0)"
+            )
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_goodput_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  goodput soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_goodput_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  goodput FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  goodput soak: migration {result.get('goodput_migration')} vs "
+        f"kill {result.get('goodput_kill')} (gap {result.get('goodput_gap')}),"
+        f" drift {result.get('conservation_drift')}, "
+        f"fleet goodput {result.get('goodput_ratio')} util "
+        f"{result.get('chip_utilization')}, "
         f"{'OK' if result['ok'] else 'FAILED'}",
         file=sys.stderr,
     )
@@ -4316,6 +5135,12 @@ def _bench_metrics(output: dict) -> dict:
     # against both
     put("serving_tokens_per_sec", detail.get("serving_tokens_per_sec"))
     put("serving_p99_ms", detail.get("serving_p99_ms"))
+    # chip-time accounting verdict rows (bench.py --goodput /
+    # make goodput): the fleet goodput/utilization ratios and the
+    # migration-vs-kill gap the preemption-economy work must widen
+    put("goodput_ratio", detail.get("goodput_ratio"))
+    put("chip_utilization", detail.get("chip_utilization"))
+    put("goodput_gap", detail.get("goodput_gap"))
     put("tflops", output.get("tflops") or matmul.get("tflops"))
     put("mfu", output.get("mfu") or matmul.get("mfu"))
     put("allreduce_gbps", (detail.get("allreduce") or {}).get("algbw_gbps"))
@@ -4650,6 +5475,29 @@ def main() -> None:
             "value": result.get("placement_p99_s"),
             "unit": "s",
             "fragmentation_final": result.get("frag_final"),
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --goodput [--nodes 100] [--seed 1]`: chip-time accounting
+    # acceptance soak (CPU-backend training subprocesses) — `make goodput`.
+    # Gated: ledger conservation drift ≤1% mid-soak and at the end, the
+    # migration-path job's per-grant goodput measurably above the
+    # kill-path job's (the A/B), the kill's replayed steps carved to
+    # busy_wasted, /debug/accounting joinable to /debug/explain via
+    # reconcile ids, and steady-state verbs/pass back to 0.
+    if "--goodput" in sys.argv:
+        result = run_goodput_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "goodput_gap",
+            "value": result.get("goodput_gap"),
+            "unit": "ratio",
+            "goodput_migration": result.get("goodput_migration"),
+            "goodput_kill": result.get("goodput_kill"),
+            "conservation_drift": result.get("conservation_drift"),
             "ok": result["ok"],
             "detail": result,
         }))

@@ -1,0 +1,525 @@
+"""Chip-time accounting plane tests (tpu_operator/obs/accounting.py).
+
+Three families, per the ledger's contract:
+
+* **Conservation property tests** — seeded random grant / release /
+  migrate / kill / quarantine schedules over real
+  ``scheduling.arcs_from_nodes`` arcs: summed attributed chip-seconds
+  must equal tracked chips x wall-clock within 1% (in fact exactly, by
+  construction — the 1% gate is what the soak enforces end-to-end).
+* **Restart reconstruction** — a fresh ledger fed one ``observe_arcs``
+  pass over the same stamped nodes rebuilds every owner, and the first
+  re-push after a restart re-seeds evidence baselines without double
+  counting.
+* **Double-count guards** — identically re-pushed counter windows credit
+  zero; counter resets credit only the new process's value; replayed
+  steps carve to busy_wasted.
+"""
+
+import random
+
+from tpu_operator import consts, scheduling
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import accounting, fleet as obs_fleet, flight
+from tpu_operator.obs.accounting import ChipTimeLedger
+from tpu_operator.workloads import checkpoint as cp
+
+from tests.test_scheduling import _node
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _granted(node, request):
+    """Stamp a node dict the way slicescheduler binding does."""
+    node["metadata"]["labels"][consts.SLICE_REQUEST_LABEL] = request
+    return node
+
+
+def _quarantined(node):
+    node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_UNHEALTHY
+    return node
+
+
+def _observe(ledger, nodes, now=None):
+    ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes, now=now)
+
+
+def _push(counters):
+    return {"train": {"counters": dict(counters)}}
+
+
+# ---------------------------------------------------------------------------
+# conservation invariant
+
+
+def test_conservation_simple_schedule():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [
+        _granted(_node("n1"), "req-a"),
+        _granted(_node("n2"), "req-a"),
+        _node("n3"),
+        _quarantined(_node("n4")),
+    ]
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+    cons = ledger.conservation()
+    # 4 nodes x 8 chips x 100 s
+    assert cons["wall_chip_seconds"] == 3200.0
+    assert cons["attributed_chip_seconds"] == 3200.0
+    assert cons["drift"] == 0.0
+    snap = ledger.snapshot()
+    assert snap["states"][accounting.STATE_IDLE_GRANTED] == 1600.0
+    assert snap["states"][accounting.STATE_IDLE_FREE] == 800.0
+    assert snap["states"][accounting.STATE_QUARANTINED] == 800.0
+    # the six public states always sum to the attributed side
+    assert sum(snap["states"].values()) == snap["attributed_chip_seconds"]
+
+
+def test_conservation_property_random_schedules():
+    """Seeded grant/release/migrate/kill/quarantine churn sums exactly."""
+    for seed in (1, 2, 3):
+        rng = random.Random(seed)
+        clock = FakeClock()
+        ledger = ChipTimeLedger(clock=clock)
+        names = [f"n{i}" for i in range(8)]
+        owners = {}  # node -> request or None
+        quarantine = set()
+        for event in range(60):
+            nodes = []
+            for name in names:
+                n = _node(name)
+                if owners.get(name):
+                    _granted(n, owners[name])
+                if name in quarantine:
+                    _quarantined(n)
+                nodes.append(n)
+            # drop some nodes entirely (retire path) on occasion
+            present = [n for n in nodes if rng.random() > 0.1]
+            _observe(ledger, present)
+            # evidence pushes interleave with occupancy passes
+            if rng.random() < 0.5:
+                node = rng.choice(names)
+                ledger.observe_push(node, _push({
+                    accounting.COUNTER_USEFUL_SECONDS: rng.uniform(0, 50),
+                    accounting.COUNTER_WASTED_SECONDS: rng.uniform(0, 10),
+                }))
+            # mutate the fleet for the next pass
+            op = rng.random()
+            node = rng.choice(names)
+            if op < 0.25:
+                owners[node] = f"req-{rng.randint(0, 3)}"
+                ledger.note_grant(owners[node], nodes=(node,))
+            elif op < 0.45 and owners.get(node):
+                ledger.note_release(owners[node])
+                owners.pop(node)
+            elif op < 0.6:
+                ledger.note_draining(node, reason="defrag")
+                if rng.random() < 0.5:
+                    ledger.note_migrated(node)
+                else:
+                    ledger.note_eviction(node, reason="kill")
+            elif op < 0.75:
+                if node in quarantine:
+                    quarantine.discard(node)
+                else:
+                    quarantine.add(node)
+            clock.tick(rng.uniform(0.1, 30.0))
+        cons = ledger.conservation()
+        assert cons["wall_chip_seconds"] > 0
+        assert cons["drift"] <= 0.01, f"seed {seed}: {cons}"
+        # stronger than the gate: occupancy conserves exactly
+        assert abs(
+            cons["attributed_chip_seconds"] - cons["wall_chip_seconds"]
+        ) < 1e-6, f"seed {seed}: {cons}"
+
+
+def test_evidence_never_creates_chip_seconds():
+    """Overclaiming evidence (multi-host double pushes) clamps at the
+    granted bucket — the carve skews the split, never conservation."""
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(10.0)
+    # claims 1e6 useful chip-seconds against an 80 chip-second grant
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 1e6}))
+    _observe(ledger, nodes)
+    snap = ledger.snapshot()
+    assert snap["conservation_drift"] == 0.0
+    assert snap["states"][accounting.STATE_BUSY_USEFUL] == 80.0
+    assert snap["states"][accounting.STATE_BUSY_WASTED] == 0.0
+    assert snap["states"][accounting.STATE_IDLE_GRANTED] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# restart reconstruction
+
+
+def test_restart_reconstructs_owners_from_stamps():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [
+        _granted(_node("n1"), "req-a"),
+        _granted(_node("n2"), "req-a"),
+        _node("n3"),
+    ]
+    ledger.note_grant("req-a", nodes=("n1", "n2"), outcome="placed")
+    _observe(ledger, nodes)
+    clock.tick(50.0)
+
+    # operator restart: brand-new ledger, same cluster state
+    reborn = ChipTimeLedger(clock=clock)
+    _observe(reborn, nodes)
+    clock.tick(25.0)
+    snap = reborn.snapshot()
+    assert snap["nodes"]["n1"]["owner"] == "req-a"
+    assert snap["nodes"]["n2"]["owner"] == "req-a"
+    assert snap["nodes"]["n3"]["owner"] == ""
+    # the stamp-derived grant row exists and is marked as such
+    assert snap["grants"]["req-a"]["outcome"] == "reconstructed"
+    assert set(snap["grants"]["req-a"]["nodes"]) == {"n1", "n2"}
+    assert snap["conservation_drift"] == 0.0
+
+
+def test_restart_first_push_seeds_baselines_without_double_count():
+    """After a restart the first push re-seeds the per-(node, check,
+    counter) baselines; only the values are credited once."""
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(1000.0)
+    _observe(ledger, nodes)
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 30.0}))
+
+    reborn = ChipTimeLedger(clock=clock)
+    _observe(reborn, nodes)
+    clock.tick(1000.0)
+    _observe(reborn, nodes)
+    # same cumulative counter the old ledger already credited: a fresh
+    # ledger sees it as first sight (one credit), then a re-push of the
+    # identical window credits zero
+    reborn.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 30.0}))
+    reborn.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 30.0}))
+    snap = reborn.snapshot()
+    assert snap["states"][accounting.STATE_BUSY_USEFUL] == 30.0 * 8  # x chips
+
+
+# ---------------------------------------------------------------------------
+# double-count guards
+
+
+def test_repushed_window_credits_zero():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 10.0}))
+    before = ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL]
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 10.0}))
+    after = ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL]
+    assert before == after == 80.0
+
+
+def test_counter_reset_credits_only_new_value():
+    """A restored workload's fresh process restarts its cumulative
+    counters from zero; the ledger must credit the new value, not go
+    negative or re-credit the old total."""
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(200.0)
+    _observe(ledger, nodes)
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 40.0}))
+    # process restart: counter resets below the baseline
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 5.0}))
+    snap = ledger.snapshot()
+    assert snap["states"][accounting.STATE_BUSY_USEFUL] == (40.0 + 5.0) * 8
+
+
+def test_replayed_evidence_carves_to_busy_wasted():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+    ledger.observe_push("n1", _push({
+        accounting.COUNTER_USEFUL_SECONDS: 20.0,
+        accounting.COUNTER_WASTED_SECONDS: 5.0,
+        accounting.COUNTER_REPLAYED_STEPS: 7.0,
+        accounting.COUNTER_LOST_STEPS: 3.0,
+    }))
+    snap = ledger.snapshot()
+    assert snap["states"][accounting.STATE_BUSY_USEFUL] == 160.0
+    assert snap["states"][accounting.STATE_BUSY_WASTED] == 40.0
+    row = snap["grants"]["req-a"]
+    assert row["replayed_steps"] == 7.0
+    assert row["lost_steps"] == 3.0
+    assert snap["goodput_ratio"] == 0.8
+
+
+def test_serving_credit_is_inter_push_gap_and_capped():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-s")]
+    _observe(ledger, nodes)
+    # first token push establishes the seen-ts; no retroactive credit
+    ledger.observe_push(
+        "n1", _push({accounting.COUNTER_DECODED_TOKENS: 100.0}))
+    assert ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL] == 0.0
+    clock.tick(10.0)
+    _observe(ledger, nodes)
+    ledger.observe_push(
+        "n1", _push({accounting.COUNTER_DECODED_TOKENS: 200.0}))
+    assert ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL] == 80.0
+    # a stalled-then-revived pusher cannot claim an unbounded interval
+    clock.tick(10_000.0)
+    _observe(ledger, nodes)
+    ledger.observe_push(
+        "n1", _push({accounting.COUNTER_DECODED_TOKENS: 300.0}))
+    busy = ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL]
+    assert busy == 80.0 + accounting._SERVING_CREDIT_CAP_S * 8
+    # tokens that did NOT advance claim nothing
+    clock.tick(10.0)
+    _observe(ledger, nodes)
+    ledger.observe_push(
+        "n1", _push({accounting.COUNTER_DECODED_TOKENS: 300.0}))
+    assert ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL] == busy
+
+
+# ---------------------------------------------------------------------------
+# transitions feed the drill-down
+
+
+def test_transitions_tally_kills_vs_migrations():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [
+        _granted(_node("n1"), "req-a"),
+        _granted(_node("n2"), "req-a"),
+    ]
+    ledger.note_grant("req-a", nodes=("n1", "n2"))
+    _observe(ledger, nodes)
+    clock.tick(10.0)
+    ledger.note_draining("n1", reason="upgrade")
+    clock.tick(5.0)
+    _observe(ledger, nodes)
+    snap = ledger.snapshot()
+    assert snap["nodes"]["n1"]["occupancy"] == accounting.STATE_DRAINING
+    assert snap["grants"]["req-a"]["draining"] > 0
+    # migration path: eviction with the migrated reason is not a kill
+    ledger.note_eviction("n1", reason=accounting._REASON_MIGRATED)
+    ledger.note_migrated("n1")
+    # kill path
+    ledger.note_draining("n2")
+    ledger.note_eviction("n2", reason="preempted")
+    row = ledger.snapshot()["grants"]["req-a"]
+    assert row["evictions"] == 2
+    assert row["migrations"] == 1
+    assert row["kills"] == 1
+    events = [t["event"] for t in ledger.snapshot()["transitions"]]
+    assert events == [
+        "grant", "draining", "eviction", "migrated", "draining", "eviction",
+    ]
+
+
+def test_release_moves_grant_to_released_ring_and_clears_drains():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    ledger.note_grant("req-a", nodes=("n1",))
+    _observe(ledger, nodes)
+    clock.tick(10.0)
+    ledger.note_draining("n1")
+    ledger.note_release("req-a", reason="preempted")
+    snap = ledger.snapshot()
+    row = snap["grants"]["req-a"]
+    assert row["release_reason"] == "preempted"
+    assert row["released_ts"] > 0
+    # the drain mark died with the grant
+    nodes2 = [_node("n1")]
+    clock.tick(10.0)
+    _observe(ledger, nodes2)
+    assert ledger.snapshot()["nodes"]["n1"]["occupancy"] == \
+        accounting.STATE_IDLE_FREE
+
+
+def test_drain_mark_expires_after_ttl():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    ledger.note_draining("n1")
+    clock.tick(accounting._DRAIN_TTL_S + 1.0)
+    _observe(ledger, nodes)
+    # back to the granted occupancy (carved idle_granted/busy at read time)
+    assert ledger.snapshot()["nodes"]["n1"]["occupancy"] == "granted"
+
+
+# ---------------------------------------------------------------------------
+# export surface
+
+
+def test_export_monotonic_counters_and_grant_gauge_lifecycle():
+    clock = FakeClock()
+    metrics = OperatorMetrics()
+    agg = obs_fleet.FleetAggregator(metrics)
+    ledger = ChipTimeLedger(metrics, fleet=agg, clock=clock)
+    nodes = [_granted(_node("n1"), "req-a"), _node("n2")]
+    ledger.note_grant("req-a", nodes=("n1",))
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+    ledger.observe_push("n1", _push({accounting.COUNTER_USEFUL_SECONDS: 10.0}))
+    ledger.export()
+
+    def counter(state):
+        return metrics.chip_seconds_total.labels(state=state)._value.get()
+
+    assert counter(accounting.STATE_BUSY_USEFUL) == 80.0
+    assert counter(accounting.STATE_IDLE_GRANTED) == 720.0
+    assert counter(accounting.STATE_IDLE_FREE) == 800.0
+    assert metrics.goodput_ratio._value.get() == 1.0
+    assert metrics.chip_utilization._value.get() == 0.1
+    assert metrics.grant_utilization.labels(request="req-a")._value.get() == 0.1
+    # fleet rings received the rollups
+    assert agg.rollup(obs_fleet.METRIC_GOODPUT_RATIO, 60.0)["max"] == 1.0
+    assert agg.rollup(obs_fleet.METRIC_CHIP_UTILIZATION, 60.0)["max"] == 0.1
+
+    # second export with no new chip-time: counters must not re-credit
+    ledger.export()
+    assert counter(accounting.STATE_BUSY_USEFUL) == 80.0
+
+    # release: the per-grant gauge label is removed, not frozen
+    ledger.note_release("req-a")
+    ledger.export()
+    labelled = [
+        s.labels for m in metrics.grant_utilization.collect()
+        for s in m.samples
+    ]
+    assert {"request": "req-a"} not in labelled
+
+
+def test_fleet_ingest_push_forwards_to_ledger():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    agg = obs_fleet.FleetAggregator(ledger=ledger)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+    agg.ingest_push({
+        "node": "n1",
+        "workloads": _push({accounting.COUNTER_USEFUL_SECONDS: 10.0}),
+    })
+    assert ledger.snapshot()["states"][accounting.STATE_BUSY_USEFUL] == 80.0
+
+
+def test_snapshot_schema():
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    nodes = [_granted(_node("n1"), "req-a")]
+    ledger.note_grant("req-a", nodes=("n1",))
+    _observe(ledger, nodes)
+    clock.tick(10.0)
+    snap = ledger.snapshot()
+    assert set(snap) >= {
+        "ts", "wall_chip_seconds", "attributed_chip_seconds",
+        "conservation_drift", "goodput_ratio", "chip_utilization",
+        "states", "nodes", "grants", "transitions",
+    }
+    assert set(snap["states"]) == set(accounting.STATES)
+    row = snap["grants"]["req-a"]
+    assert set(row) >= {
+        "nodes", "chips", "bound_ts", "outcome", "reconcile_id",
+        "released_ts", "release_reason", "granted_chip_seconds",
+        "busy_useful", "busy_wasted", "idle_granted", "draining",
+        "quarantined", "utilization", "goodput_ratio", "migrations",
+        "evictions", "kills", "lost_steps", "replayed_steps",
+        "decoded_tokens",
+    }
+
+
+# ---------------------------------------------------------------------------
+# cross-module pins (the names the plane relies on staying in sync)
+
+
+def test_migrated_reason_pinned_to_migration_coordinator():
+    from tpu_operator.controllers import migration
+
+    assert accounting._REASON_MIGRATED == migration.MIGRATED
+
+
+def test_accounting_counters_ride_the_full_push_path():
+    """Flight COUNTER_KEYS must carry the evidence counters, and the
+    agent catalogue must export + document them — otherwise the push hop
+    silently drops the ledger's entire evidence feed."""
+    from tpu_operator.agents import metrics_agent
+
+    evidence = (
+        accounting.COUNTER_USEFUL_SECONDS,
+        accounting.COUNTER_WASTED_SECONDS,
+        accounting.COUNTER_REPLAYED_STEPS,
+        accounting.COUNTER_LOST_STEPS,
+        accounting.COUNTER_DECODED_TOKENS,
+    )
+    flight_counters = set(flight.COUNTER_KEYS.values())
+    for name in evidence:
+        assert name in flight_counters
+        assert name in metrics_agent.WORKLOAD_COUNTERS
+        assert name in metrics_agent.COUNTER_HELP
+
+
+# ---------------------------------------------------------------------------
+# checkpoint HIGHWATER stamps (satellite: lost-step deltas are derived)
+
+
+def _np_params():
+    import numpy as np
+
+    return {"w": np.arange(8, dtype=np.float32)}
+
+
+def test_highwater_publish_read_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert cp.read_highwater(d) == -1
+    cp.publish_highwater(d, 17)
+    assert cp.read_highwater(d) == 17
+    cp.publish_highwater(d, 23)
+    assert cp.read_highwater(d) == 23
+
+
+def test_restore_flight_sample_carries_lost_step_delta(tmp_path):
+    d = str(tmp_path)
+    cp.save_checkpoint(d, 10, _np_params())
+    # the killed process had stepped past the durable snapshot
+    cp.publish_highwater(d, 14)
+    rec = flight.recorder_for(str(tmp_path / "flight.jsonl"))
+    with flight.activate(rec):
+        ck = cp.load_checkpoint(d)
+    assert ck is not None and ck.step == 10
+    restores = [
+        s for s in rec.samples
+        if s["check"] == "migration" and s["phase"] == "restore"
+    ]
+    assert len(restores) == 1
+    m = restores[0]["metrics"]
+    assert m["step_at_kill"] == 14.0
+    assert m["step_at_restore"] == 10.0
+    assert m["lost_steps"] == 4.0

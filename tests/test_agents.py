@@ -211,7 +211,7 @@ def test_push_store_accepts_serving_counters_bounded_vocabulary():
     )
 
     serving = [c for c in WORKLOAD_COUNTERS if "serving" in c]
-    assert len(serving) == 8
+    assert len(serving) == 9  # 8 rolling-window + decoded_tokens_total (ledger evidence)
     for counter in serving:
         assert counter in COUNTER_HELP  # counters-docs twin at the source
 

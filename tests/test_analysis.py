@@ -443,6 +443,64 @@ def test_env_contract_full_contract_and_aliases_pass(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ledger-transitions: capacity decisions must reach the chip-time ledger
+
+
+def test_ledger_transitions_trips_on_silent_decision(tmp_path):
+    res = run_on(tmp_path, {
+        "tpu_operator/controllers/slicescheduler.py": """
+            class R:
+                def _bind(self, request):
+                    self.metrics.slice_placements_total.labels(
+                        outcome="placed").inc()
+        """,
+        "tpu_operator/controllers/migration.py": """
+            class M:
+                async def evict(self, pod):
+                    self.metrics.drain_evictions_total.labels(
+                        controller="upgrade").inc()
+        """,
+    }, rules=["ledger-transitions"])
+    trips = names_of(res, "ledger-transitions")
+    assert len(trips) == 2
+    assert any("slice_placements_total" in f.message for f in trips)
+    assert any("drain_evictions_total" in f.message for f in trips)
+    assert all("ledger" in f.message for f in trips)
+
+
+def test_ledger_transitions_passes_with_note_or_opt_out(tmp_path):
+    res = run_on(tmp_path, {
+        "tpu_operator/controllers/slicescheduler.py": """
+            class R:
+                def _bind(self, request):
+                    self.metrics.slice_placements_total.labels(
+                        outcome="placed").inc()
+                    if self.ledger is not None:
+                        self.ledger.note_grant(request.name)
+
+                def _warn(self, request):
+                    self.metrics.slice_placements_total.labels(outcome="unschedulable").inc()  # ledger-ok: never held chips
+        """,
+        "tpu_operator/controllers/migration.py": """
+            class M:
+                async def evict(self, pod):
+                    self.metrics.drain_evictions_total.labels(
+                        controller="upgrade").inc()
+                    self.ledger.note_eviction(pod["spec"]["nodeName"])
+        """,
+        # the rule is seam-scoped: the same silent increment anywhere
+        # else in the tree is some other module's business
+        "tpu_operator/controllers/other.py": """
+            class O:
+                def f(self):
+                    self.metrics.slice_placements_total.labels(
+                        outcome="x").inc()
+        """,
+    }, rules=["ledger-transitions"])
+    assert not names_of(res, "ledger-transitions")
+
+
+# ---------------------------------------------------------------------------
 # framework semantics
 
 

@@ -88,6 +88,17 @@ WORKLOAD_COUNTERS = (
     "tpu_workload_serving_kv_blocks_free",
     "tpu_workload_serving_requests_completed_total",
     "tpu_workload_serving_requests_rejected_total",
+    "tpu_workload_serving_decoded_tokens_total",
+    # chip-time accounting evidence (workloads/checkpoint.py training
+    # loop + restore path): cumulative useful/wasted busy seconds and the
+    # stamp-derived replay/loss deltas the operator-side ledger
+    # (obs/accounting.py) carves chip-time with
+    "tpu_workload_checkpoint_seconds",
+    "tpu_workload_restore_seconds",
+    "tpu_workload_useful_seconds_total",
+    "tpu_workload_wasted_seconds_total",
+    "tpu_workload_replayed_steps_total",
+    "tpu_workload_lost_steps_total",
 )
 
 # HELP text per counter: the exposition format wants a # HELP line per
@@ -119,6 +130,13 @@ COUNTER_HELP = {
     "tpu_workload_serving_kv_blocks_free": "Free KV-cache blocks in the serving replica's paged pool",
     "tpu_workload_serving_requests_completed_total": "Requests the serving replica completed since start",
     "tpu_workload_serving_requests_rejected_total": "Requests rejected by serving admission (oversize for the configured context)",
+    "tpu_workload_serving_decoded_tokens_total": "Decode tokens the serving replica produced since start (chip-time busy_useful evidence)",
+    "tpu_workload_checkpoint_seconds": "Last checkpoint save wall time in seconds",
+    "tpu_workload_restore_seconds": "Last checkpoint restore wall time in seconds",
+    "tpu_workload_useful_seconds_total": "Cumulative busy seconds spent on first-time training steps (chip-time busy_useful evidence)",
+    "tpu_workload_wasted_seconds_total": "Cumulative busy seconds spent on replayed steps plus checkpoint/restore overhead (chip-time busy_wasted evidence)",
+    "tpu_workload_replayed_steps_total": "Steps recomputed at-or-below the pre-restart HIGHWATER stamp",
+    "tpu_workload_lost_steps_total": "Stamp-derived steps lost at restore (HIGHWATER minus restored snapshot step)",
 }
 
 

@@ -12,6 +12,7 @@ from tpu_operator.analysis.rules.delta_paths import DeltaPathsRule
 from tpu_operator.analysis.rules.env_contract import EnvContractRule
 from tpu_operator.analysis.rules.exception_hygiene import ExceptionHygieneRule
 from tpu_operator.analysis.rules.fence_coverage import FenceCoverageRule
+from tpu_operator.analysis.rules.ledger_transitions import LedgerTransitionsRule
 from tpu_operator.analysis.rules.metric_labels import MetricLabelsRule
 from tpu_operator.analysis.rules.task_lifecycle import TaskLifecycleRule
 from tpu_operator.analysis.rules.trace_adoption import TraceAdoptionRule
@@ -32,4 +33,5 @@ def all_rules():
         FenceCoverageRule(),
         TaskLifecycleRule(),
         EnvContractRule(),
+        LedgerTransitionsRule(),
     ]

@@ -1,0 +1,93 @@
+"""ledger-transitions: capacity decisions must reach the chip-time ledger.
+
+The chip-time accounting plane (``obs/accounting.py``) is only as
+truthful as its feeds: a scheduler grant/release or a drain-path eviction
+that skips its ``ledger.note_*`` transition silently mis-attributes every
+chip-second the decision moved — goodput drifts with no test to catch it,
+because the conservation invariant still balances (occupancy is re-derived
+from stamps; only the drill-down lineage goes dark).
+
+So the rule pins the seams structurally: any function that increments one
+of the capacity decision counters (``slice_placements_total``,
+``drain_evictions_total``) must also call a ledger transition — a
+``note_*`` method on an attribute chain that names ``ledger`` (e.g.
+``self.ledger.note_grant(...)``).  Sites whose increment genuinely moves
+no chip-time (an Unschedulable warning: the request never held chips)
+opt out with ``# ledger-ok`` on the increment line, leaving a greppable
+audit trail instead of a silent gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+
+OPT_OUT = "# ledger-ok"
+
+# counters whose .inc() marks a capacity decision site
+DECISION_COUNTERS = ("slice_placements_total", "drain_evictions_total")
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """['self', 'ledger', 'note_grant'] for self.ledger.note_grant."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _is_ledger_transition(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return (
+        len(chain) >= 2
+        and chain[-1].startswith("note_")
+        and "ledger" in chain[:-1]
+    )
+
+
+def _decision_lines(fn: ast.AST) -> list[tuple[str, int]]:
+    """(counter, lineno) per decision-counter reference in ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in DECISION_COUNTERS:
+            out.append((node.attr, node.lineno))
+    return out
+
+
+class LedgerTransitionsRule(Rule):
+    name = "ledger-transitions"
+    doc = "grant/release/eviction sites emit a chip-time ledger transition"
+    paths = (
+        "tpu_operator/controllers/slicescheduler.py",
+        "tpu_operator/controllers/migration.py",
+    )
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            decisions = _decision_lines(node)
+            if not decisions:
+                continue
+            has_transition = any(
+                isinstance(sub, ast.Call) and _is_ledger_transition(sub)
+                for sub in ast.walk(node)
+            )
+            if has_transition:
+                continue
+            for counter, lineno in decisions:
+                if sf.line_has(lineno, OPT_OUT):
+                    continue
+                yield Finding(
+                    self.name, sf.rel, lineno,
+                    f"{node.name} increments {counter} without a chip-time "
+                    "ledger transition (ledger.note_*); the accounting "
+                    f"drill-down goes dark for this decision — call the "
+                    f"matching note_* or mark the line {OPT_OUT!r} if no "
+                    "chip-time moves",
+                )

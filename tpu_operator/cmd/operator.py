@@ -23,6 +23,7 @@ from tpu_operator.controllers.runtime import Manager
 from tpu_operator.k8s.client import ApiClient, Config
 from tpu_operator.metrics import OperatorMetrics
 from tpu_operator.obs import logging as obs_logging
+from tpu_operator.obs.accounting import ChipTimeLedger
 from tpu_operator.obs.events import EventRecorder
 from tpu_operator.obs.explain import ExplainEngine
 from tpu_operator.obs.fleet import FleetAggregator
@@ -99,6 +100,10 @@ async def run(args: argparse.Namespace) -> None:
     # ring eviction; the recorder's sink lands every Event on the explain
     # timeline even when the apiserver drops the post.
     fleet = FleetAggregator(metrics)
+    # chip-time accounting: scheduler passes fold occupancy in, the push
+    # hop folds workload evidence in, /debug/accounting reads it out
+    ledger = ChipTimeLedger(metrics, fleet=fleet)
+    fleet.ledger = ledger
     tracer = Tracer(metrics, fleet=fleet)
     recorder = EventRecorder(client, namespace)
     explain = ExplainEngine(fleet=fleet, tracer=tracer)
@@ -130,6 +135,7 @@ async def run(args: argparse.Namespace) -> None:
         fleet=fleet,
         explain=explain,
         compile_cache=compile_cache,
+        accounting=ledger,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
@@ -187,10 +193,13 @@ async def run(args: argparse.Namespace) -> None:
         def warm_fn(kind: str, _cc=compile_cache) -> bool:
             return _cc.has_kind_labels(*(kind.split("/", 2) + ["", ""])[:3])
     RevalidationCoordinator(client, namespace, warm_fn=warm_fn, **obs).setup(mgr)
-    HealthReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
+    HealthReconciler(client, namespace, fleet=fleet, ledger=ledger,
+                     **obs).setup(mgr)
     # elastic multi-slice scheduler: TPUSliceRequest lifecycle + scored
     # placement + defrag-by-migration (docs/SCHEDULING.md)
-    SliceSchedulerReconciler(client, namespace, fleet=fleet, **obs).setup(mgr)
+    SliceSchedulerReconciler(
+        client, namespace, fleet=fleet, ledger=ledger, **obs
+    ).setup(mgr)
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

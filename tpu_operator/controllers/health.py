@@ -159,6 +159,7 @@ class HealthReconciler:
         tracer: Optional[Tracer] = None,
         recorder: Optional[EventRecorder] = None,
         fleet=None,
+        ledger=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -183,9 +184,12 @@ class HealthReconciler:
         # instead of stranding the training job on a dead node
         # (controllers/migration.py); routed through the reader so the
         # pod writes stay read-your-writes coherent with cached passes
+        # the chip-time ledger (obs.accounting.ChipTimeLedger, optional)
+        # rides the coordinator so health-engine drains land as
+        # draining/eviction/migrated transitions like every other drain
         self.migration = mig.MigrationCoordinator(
             self.reader, namespace, metrics=self.metrics,
-            recorder=self.recorder,
+            recorder=self.recorder, ledger=ledger,
         )
         self._tracks: dict[str, _Track] = {}
         self._observe_only = False

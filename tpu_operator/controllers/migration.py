@@ -109,6 +109,7 @@ class MigrationCoordinator:
         namespace: str,
         metrics: Optional[OperatorMetrics] = None,
         recorder: Optional[EventRecorder] = None,
+        ledger=None,
     ):
         # ``client`` may be a raw ApiClient or a CachedReader — the health
         # engine passes its reader so migration writes stay read-your-writes
@@ -119,6 +120,10 @@ class MigrationCoordinator:
         self.recorder = recorder or EventRecorder(
             getattr(client, "client", client), namespace
         )
+        # obs.accounting.ChipTimeLedger (optional): drain requests,
+        # evictions and reschedules emit chip-time transitions so the
+        # draining state and the migration/kill tallies stay truthful
+        self.ledger = ledger
 
     # ------------------------------------------------------------------
     async def drain_pod(
@@ -236,6 +241,11 @@ class MigrationCoordinator:
             namespace=self.namespace_of(pod),
         )
         self.metrics.migrations_total.labels(outcome="requested").inc()
+        if self.ledger is not None:
+            self.ledger.note_draining(
+                deep_get(pod, "spec", "nodeName", default=""),
+                reason=controller,
+            )
         await self.recorder.normal(
             obs_events.pod_ref(meta["name"], self.namespace_of(pod)),
             obs_events.REASON_MIGRATION_REQUESTED,
@@ -267,6 +277,11 @@ class MigrationCoordinator:
         self.metrics.drain_evictions_total.labels(
             controller=controller, reason=reason
         ).inc()
+        if self.ledger is not None:
+            self.ledger.note_eviction(
+                deep_get(pod, "spec", "nodeName", default=""),
+                controller=controller, reason=reason,
+            )
         if warn and reason != MIGRATED:
             await self.recorder.warning(
                 obs_events.pod_ref(meta["name"], ns),
@@ -304,6 +319,8 @@ class MigrationCoordinator:
         self.metrics.drain_evictions_total.labels(
             controller=controller, reason=MIGRATED
         ).inc()
+        if self.ledger is not None:
+            self.ledger.note_migrated(source_node, controller=controller)
         target_name = target["metadata"]["name"] if target else "<unscheduled>"
         target_topo = _topology_of(target) if target else ""
         await self.recorder.normal(

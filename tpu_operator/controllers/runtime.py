@@ -228,6 +228,7 @@ class Manager:
         explain=None,
         fleet_eval_interval: float = consts.FLEET_EVAL_SECONDS,
         compile_cache=None,
+        accounting=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -263,6 +264,10 @@ class Manager:
         # /compile-cache/* routes (artifact publication by seeder
         # validators, index+fetch by warm-pool validators) next to /push.
         self.compile_cache = compile_cache
+        # obs.accounting.ChipTimeLedger: backs /debug/accounting and has
+        # its intervals advanced on the fleet-eval cadence so chip-second
+        # attribution stays fresh between scheduler passes
+        self.accounting = accounting
         self.fleet_eval_interval = fleet_eval_interval
         # fleet-eval rides the shared workqueue framework as a scheduled-
         # requeue controller (cancellable + saturation-instrumented) instead
@@ -507,6 +512,8 @@ class Manager:
                     self.explain.observe_slo(kind, slo, message, offenders)
             if self.operator_metrics is not None:
                 self.fleet.export()
+            if self.accounting is not None:
+                self.accounting.export()
         except Exception:  # noqa: BLE001 — telemetry cadence must not die
             log.exception("fleet evaluation pass failed")
         return self.fleet_eval_interval
@@ -562,6 +569,7 @@ class Manager:
         metrics.router.add_get("/debug/traces", self._traces)
         metrics.router.add_get("/debug/fleet", self._fleet_snapshot)
         metrics.router.add_get("/debug/explain", self._explain)
+        metrics.router.add_get("/debug/accounting", self._accounting)
         metrics.router.add_post("/push", self._fleet_push)
         metrics.router.add_get("/compile-cache/index", self._cc_index)
         metrics.router.add_get(
@@ -578,6 +586,7 @@ class Manager:
                 health.router.add_get("/debug/traces", self._traces)
                 health.router.add_get("/debug/fleet", self._fleet_snapshot)
                 health.router.add_get("/debug/explain", self._explain)
+                health.router.add_get("/debug/accounting", self._accounting)
                 health.router.add_post("/push", self._fleet_push)
                 health.router.add_get("/compile-cache/index", self._cc_index)
                 health.router.add_get(
@@ -685,6 +694,17 @@ class Manager:
                 {"error": "fleet aggregation not enabled"}, status=404
             )
         return web.json_response(self.fleet.snapshot())
+
+    async def _accounting(self, request: web.Request) -> web.Response:
+        """Chip-time ledger rollup + per-grant drill-down
+        (obs/accounting.py; docs/OBSERVABILITY.md "Chip-time accounting").
+        Grant rows carry reconcile ids joinable against /debug/traces and
+        /debug/explain node timelines."""
+        if self.accounting is None:
+            return web.json_response(
+                {"error": "chip-time accounting not enabled"}, status=404
+            )
+        return web.json_response(self.accounting.snapshot())
 
     async def _fleet_push(self, request: web.Request) -> web.Response:
         """Fleet ingest: the hop the node metrics agents forward their

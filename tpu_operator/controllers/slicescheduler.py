@@ -110,6 +110,7 @@ class SliceSchedulerReconciler:
         tracer: Optional[Tracer] = None,
         recorder: Optional[EventRecorder] = None,
         fleet=None,
+        ledger=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -119,6 +120,10 @@ class SliceSchedulerReconciler:
         # obs.fleet.FleetAggregator (optional): placement latency +
         # fragmentation land as fleet series for /debug/fleet rollups
         self.fleet = fleet
+        # obs.accounting.ChipTimeLedger (optional): every grant/release/
+        # compaction decision emits a ledger transition, and each pass's
+        # arcs + node view re-derives fleet occupancy (zero extra verbs)
+        self.ledger = ledger
         # reads ride informers registered in setup(); direct-drive tests
         # without informers fall back live with identical behaviour
         self.reader = CachedReader(client, metrics=self.metrics)
@@ -126,7 +131,7 @@ class SliceSchedulerReconciler:
         # cached pass seeing its own binds instead of re-issuing them
         self.migration = mig.MigrationCoordinator(
             self.reader, namespace, metrics=self.metrics,
-            recorder=self.recorder,
+            recorder=self.recorder, ledger=ledger,
         )
         # request name -> monotonic ts first seen pending (placement
         # latency); falls back to 0-latency for requests first seen bound
@@ -161,6 +166,11 @@ class SliceSchedulerReconciler:
         nodes = await self.reader.list_items("", "Node")
         arcs = scheduling.arcs_from_nodes(nodes)
         nodes_by_name = {n["metadata"]["name"]: n for n in nodes}
+        if self.ledger is not None:
+            # occupancy fold over the view this pass already holds; also
+            # the operator-restart reconstruction path (node stamps are
+            # the ledger of record)
+            self.ledger.observe_arcs(arcs, nodes)
 
         live: dict[str, TPUSliceRequest] = {}
         parsed: dict[str, scheduling.Request] = {}
@@ -284,10 +294,12 @@ class SliceSchedulerReconciler:
                     self._move = None
                 a = dataclasses.replace(a, assigned="")
             out.append(a)
-        for _ in released:
+        for name in released:
             self.metrics.slice_placements_total.labels(
                 outcome=OUTCOME_RELEASED
             ).inc()
+            if self.ledger is not None:
+                self.ledger.note_release(name, reason=OUTCOME_RELEASED)
         return out
 
     async def _release_arc(
@@ -365,6 +377,12 @@ class SliceSchedulerReconciler:
         latency = max(0.0, time.monotonic() - first) if first is not None else 0.0
         self.metrics.slice_placement_latency.observe(latency)
         self.metrics.slice_placements_total.labels(outcome=OUTCOME_PLACED).inc()
+        if self.ledger is not None:
+            self.ledger.note_grant(
+                request.name,
+                nodes=[n for a in grant.arcs for n in a.nodes],
+                outcome=OUTCOME_PLACED,
+            )
         if self.fleet is not None:
             self.fleet.ingest(
                 obs_fleet.METRIC_SLICE_PLACEMENT, latency,
@@ -430,7 +448,7 @@ class SliceSchedulerReconciler:
         if name in self._warned_unschedulable:
             return
         self._warned_unschedulable.add(name)
-        self.metrics.slice_placements_total.labels(
+        self.metrics.slice_placements_total.labels(  # ledger-ok: never held chips
             outcome=OUTCOME_UNSCHEDULABLE
         ).inc()
         await self.recorder.warning(
@@ -478,6 +496,8 @@ class SliceSchedulerReconciler:
             self.metrics.slice_placements_total.labels(
                 outcome=OUTCOME_PREEMPTED
             ).inc()
+            if self.ledger is not None:
+                self.ledger.note_release(name, reason=OUTCOME_PREEMPTED)
             await self.recorder.warning(
                 obs_events.slicerequest_ref(name),
                 obs_events.REASON_SLICE_PREEMPTED,
@@ -663,6 +683,10 @@ class SliceSchedulerReconciler:
             }],
         )
         self.metrics.slice_placements_total.labels(outcome=move.outcome).inc()
+        if self.ledger is not None:
+            self.ledger.note_grant(
+                move.request, nodes=list(target.nodes), outcome=move.outcome,
+            )
         verb = "compacted" if move.outcome == OUTCOME_COMPACTED else "grown"
         message = (
             f"slice request {move.request} {verb}: {move.source_key} "
@@ -740,6 +764,10 @@ class SliceSchedulerReconciler:
                 obs_fleet.METRIC_SLICE_FRAGMENTATION, frag,
                 source=obs_fleet.SOURCE_NODE,
             )
+        if self.ledger is not None:
+            # refresh chip_seconds_total{state} / goodput gauges and feed
+            # the fleet rings on the same cadence as fragmentation
+            self.ledger.export()
         counts = {p: 0 for p in SlicePhase.ALL}
         for name, cr in live.items():
             if name in owned:

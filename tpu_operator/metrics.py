@@ -426,6 +426,41 @@ class OperatorMetrics:
             "box holds all free capacity; defrag arms above "
             "scheduling.defragThreshold)",
         )
+        # chip-time accounting ledger (obs/accounting.py; docs/
+        # OBSERVABILITY.md "Chip-time accounting").  {state} is the fixed
+        # six-value taxonomy, {request} is a live-grant label removed on
+        # release (bounded by concurrent TPUSliceRequests, the slo_breached
+        # precedent).
+        self.chip_seconds_total = Counter(
+            "tpu_operator_chip_seconds_total",
+            "Attributed chip-seconds by ledger state: busy_useful (steps "
+            "past the last durable checkpoint, decoded tokens), busy_wasted "
+            "(replayed-step recompute, checkpoint/restore overhead), "
+            "idle_granted (bound but not stepping), idle_free, draining, "
+            "quarantined.  Summed across states this equals tracked chips "
+            "x wall-clock (conservation invariant, 1% tolerance)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.goodput_ratio = g(
+            "tpu_operator_goodput_ratio",
+            "busy_useful / (busy_useful + busy_wasted) over the ledger's "
+            "lifetime: the fraction of busy chip-time that advanced work "
+            "(1.0 when no busy evidence yet)",
+        )
+        self.chip_utilization = g(
+            "tpu_operator_chip_utilization",
+            "(busy_useful + busy_wasted) / granted chip-seconds: how much "
+            "of what the scheduler granted actually stepped (ROADMAP item "
+            "3's packing signal)",
+        )
+        self.grant_utilization = Gauge(
+            "tpu_operator_grant_utilization",
+            "Per-live-grant busy/granted chip-second ratio (label removed "
+            "when the grant is released)",
+            ["request"],
+            registry=self.registry,
+        )
         # batched revalidation coordinator (controllers/revalidation.py):
         # warm-pool scheduling of fleet-wide re-validation waves
         self.revalidation_pending = g(

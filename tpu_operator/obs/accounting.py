@@ -1,0 +1,596 @@
+"""Chip-time accounting: an event-sourced ledger over the fleet's chip-seconds.
+
+ROADMAP items 1 (preemption economy) and 3 (sub-arc packing) need numbers
+the latency/SLO plane (PRs 2/6/7) cannot produce: where did the
+chip-seconds actually go?  This module attributes every chip-second of
+every tracked TPU node to exactly one **state** and one **owner**:
+
+``busy_useful``
+    training steps past the last durable checkpoint, and serving decode
+    intervals that produced tokens;
+``busy_wasted``
+    recompute of steps replayed after a restore, compile time, and
+    checkpoint/restore overhead;
+``idle_granted``
+    bound to a ``TPUSliceRequest`` (the node carries
+    ``consts.SLICE_REQUEST_LABEL``) but no workload evidence of stepping;
+``idle_free``
+    schedulable capacity nobody owns;
+``draining``
+    a migration in flight (migrate annotation stamped / node cordoned);
+``quarantined``
+    the health engine's verdict labels exclude the node from capacity.
+
+Two layers keep the books honest:
+
+* **Occupancy** is sampled from the same node stamps the slice scheduler
+  already reads each pass (``scheduling.arcs_from_nodes``): assignment
+  labels, health labels, ``spec.unschedulable``.  Every tracked node is in
+  exactly one occupancy state at all times, so the **conservation
+  invariant** — summed attributed chip-seconds == tracked chips x
+  wall-clock — holds by construction; :meth:`ChipTimeLedger.conservation`
+  computes both sides independently and reports the drift (gated at 1% by
+  the ``make goodput`` soak and the property tests).
+* **Evidence** arrives through the agent push hop
+  (``obs/fleet.FleetAggregator.ingest_push`` forwards workload counters
+  here): cumulative useful/wasted busy seconds recorded by
+  ``workloads/checkpoint.py``, replayed/lost step deltas, serving decoded
+  tokens.  Evidence never creates chip-seconds — it *carves* the owner's
+  granted bucket into busy_useful / busy_wasted / idle_granted, clamped so
+  the carve can never exceed what occupancy granted.  A multi-host pusher
+  or a replayed flight record can therefore skew the split but never break
+  conservation.
+
+Because occupancy is re-derived from node stamps every pass and evidence
+counters are cumulative-with-reset-detection, the ledger is
+**reconstructible after an operator restart**: a fresh instance fed one
+``observe_arcs`` pass rebuilds every owner and state; the first push from
+each workload re-seeds the evidence baselines without double counting.
+
+Surfaced as bounded ``tpu_operator_chip_seconds_total{state}`` counters,
+``tpu_operator_goodput_ratio`` / ``tpu_operator_chip_utilization`` gauges,
+per-grant ``tpu_operator_grant_utilization{request}`` (removed on
+release), and the ``GET /debug/accounting`` document (fleet rollup +
+per-grant drill-down, joinable to /debug/explain and /debug/traces via
+reconcile ids).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from tpu_operator import consts
+from tpu_operator.obs import trace
+from tpu_operator.utils import deep_get
+
+# Public state taxonomy (the bounded {state} label set — never grows per
+# entity; see docs/OBSERVABILITY.md "Chip-time accounting").
+STATE_BUSY_USEFUL = "busy_useful"
+STATE_BUSY_WASTED = "busy_wasted"
+STATE_IDLE_GRANTED = "idle_granted"
+STATE_IDLE_FREE = "idle_free"
+STATE_DRAINING = "draining"
+STATE_QUARANTINED = "quarantined"
+
+STATES = (
+    STATE_BUSY_USEFUL,
+    STATE_BUSY_WASTED,
+    STATE_IDLE_GRANTED,
+    STATE_IDLE_FREE,
+    STATE_DRAINING,
+    STATE_QUARANTINED,
+)
+
+# Internal occupancy states (busy/idle split inside a grant is carved from
+# evidence at read time, so occupancy tracks the grant as one bucket).
+_OCC_GRANTED = "granted"
+_OCC_FREE = STATE_IDLE_FREE
+_OCC_DRAINING = STATE_DRAINING
+_OCC_QUARANTINED = STATE_QUARANTINED
+
+# Evidence counters the ledger consumes from the push hop (names are the
+# obs/flight COUNTER_KEYS catalogue names carried in agent pushes).
+COUNTER_USEFUL_SECONDS = "tpu_workload_useful_seconds_total"
+COUNTER_WASTED_SECONDS = "tpu_workload_wasted_seconds_total"
+COUNTER_REPLAYED_STEPS = "tpu_workload_replayed_steps_total"
+COUNTER_LOST_STEPS = "tpu_workload_lost_steps_total"
+COUNTER_DECODED_TOKENS = "tpu_workload_serving_decoded_tokens_total"
+
+# A serving push whose decoded-token counter advanced marks the replica
+# busy_useful for the inter-push gap, capped so a stalled-then-revived
+# pusher cannot claim an unbounded interval retroactively.
+_SERVING_CREDIT_CAP_S = 120.0
+
+# Draining marks set by the migration coordinator expire if neither an
+# eviction nor a reschedule ever lands (handler crashed mid-drain and the
+# annotation was wiped out-of-band) so a node cannot leak in ``draining``.
+_DRAIN_TTL_S = 900.0
+
+_TRANSITION_LOG_LIMIT = 256
+_RELEASED_GRANTS_LIMIT = 64
+
+# controllers/migration.MIGRATED, inlined to keep obs/ import-free of the
+# controller layer (pinned equal by the accounting tests).
+_REASON_MIGRATED = "migrated"
+
+
+@dataclass
+class _NodeTrack:
+    """One tracked TPU node's current occupancy interval."""
+
+    chips: int
+    occ: str
+    owner: str
+    since: float
+    tracked_s: float = 0.0  # closed chip-seconds, state-blind (wall side)
+
+
+@dataclass
+class _GrantMeta:
+    """Per-owner drill-down row state (survives node churn within the
+    grant; pruned ``_RELEASED_GRANTS_LIMIT`` deep once released)."""
+
+    bound_ts: float
+    reconcile_id: str = ""
+    outcome: str = ""
+    nodes: tuple = ()
+    released_ts: float = 0.0
+    release_reason: str = ""
+    migrations: int = 0
+    evictions: int = 0
+    kills: int = 0
+    lost_steps: float = 0.0
+    replayed_steps: float = 0.0
+    decoded_tokens: float = 0.0
+
+
+@dataclass
+class _Evidence:
+    """Cumulative carve evidence for one owner (chip-seconds)."""
+
+    useful: float = 0.0
+    wasted: float = 0.0
+
+
+class ChipTimeLedger:
+    """Event-sourced chip-second attribution with a conservation invariant.
+
+    Thread-hostile by design (single asyncio loop, like every controller
+    object here); all methods are synchronous and cheap.
+    """
+
+    def __init__(self, metrics=None, fleet=None, clock=time.monotonic):
+        self.metrics = metrics
+        self.fleet = fleet
+        self.clock = clock
+        self._nodes: dict[str, _NodeTrack] = {}
+        self._grants: dict[str, _GrantMeta] = {}
+        self._released: deque[tuple[str, _GrantMeta]] = deque(
+            maxlen=_RELEASED_GRANTS_LIMIT
+        )
+        # (occupancy state, owner) -> closed chip-seconds
+        self._buckets: dict[tuple[str, str], float] = {}
+        self._evidence: dict[str, _Evidence] = {}
+        # (node, check, counter) -> last cumulative value seen (the
+        # double-count guard: re-pushed windows delta to zero, process
+        # restarts reset-detect back to the new value).
+        self._baselines: dict[tuple[str, str, str], float] = {}
+        # (node, check) -> ts of last serving credit
+        self._serving_seen: dict[tuple[str, str], float] = {}
+        self._draining: dict[str, float] = {}  # node -> mark ts
+        self._retired_wall_s = 0.0
+        self._transitions: deque[dict] = deque(maxlen=_TRANSITION_LOG_LIMIT)
+        self._exported: dict[str, float] = {}
+
+    # -- occupancy ------------------------------------------------------
+
+    def observe_arcs(self, arcs, nodes: Iterable[dict], now: Optional[float] = None):
+        """Fold one scheduler pass: re-derive every tracked node's
+        occupancy from the same arcs + node objects the pass already
+        holds (zero extra API verbs).  This is also the restart path — a
+        fresh ledger is fully repopulated by its first call."""
+        now = self.clock() if now is None else now
+        by_name = {}
+        for n in nodes:
+            name = deep_get(n, "metadata", "name", default="")
+            if name:
+                by_name[name] = n
+        seen: set[str] = set()
+        for arc in arcs:
+            chips_per_node = max(1, arc.chips // max(1, len(arc.nodes)))
+            for node_name in arc.nodes:
+                seen.add(node_name)
+                node = by_name.get(node_name, {})
+                occ, owner = self._classify(node_name, node, arc, now)
+                self._upsert(node_name, chips_per_node, occ, owner, now)
+                if occ == _OCC_GRANTED and owner and owner not in self._grants:
+                    # restart reconstruction: the stamp is the ledger of
+                    # record, so an owner first seen via labels gets a
+                    # grant row even though note_grant never ran.
+                    self._grants[owner] = _GrantMeta(
+                        bound_ts=now, outcome="reconstructed",
+                        nodes=tuple(arc.nodes),
+                    )
+        for gone in [n for n in self._nodes if n not in seen]:
+            self._retire(gone, now)
+        for name, track in self._nodes.items():
+            if track.occ == _OCC_GRANTED and track.owner in self._grants:
+                meta = self._grants[track.owner]
+                if name not in meta.nodes:
+                    meta.nodes = tuple(sorted(set(meta.nodes) | {name}))
+
+    def _classify(self, name: str, node: dict, arc, now: float) -> tuple[str, str]:
+        labels = deep_get(node, "metadata", "labels", default={}) or {}
+        owner = labels.get(consts.SLICE_REQUEST_LABEL, "") or arc.assigned
+        unhealthy = labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_UNHEALTHY
+        state_label = labels.get(consts.HEALTH_STATE_LABEL, "")
+        if unhealthy or state_label not in ("", consts.HEALTH_OK):
+            self._draining.pop(name, None)
+            return _OCC_QUARANTINED, owner
+        mark = self._draining.get(name)
+        if mark is not None and now - mark > _DRAIN_TTL_S:
+            self._draining.pop(name, None)
+            mark = None
+        if mark is not None or deep_get(node, "spec", "unschedulable"):
+            return _OCC_DRAINING, owner
+        if owner:
+            return _OCC_GRANTED, owner
+        return _OCC_FREE, ""
+
+    def _upsert(self, name: str, chips: int, occ: str, owner: str, now: float):
+        track = self._nodes.get(name)
+        if track is None:
+            self._nodes[name] = _NodeTrack(chips, occ, owner, now)
+            return
+        if track.occ != occ or track.owner != owner or track.chips != chips:
+            self._accrue(track, now)
+            track.chips = chips
+            track.occ = occ
+            track.owner = owner
+        else:
+            self._accrue(track, now)
+
+    def _accrue(self, track: _NodeTrack, now: float):
+        dt = max(0.0, now - track.since)
+        if dt:
+            chip_s = track.chips * dt
+            key = (track.occ, track.owner)
+            self._buckets[key] = self._buckets.get(key, 0.0) + chip_s
+            track.tracked_s += chip_s
+        track.since = now
+
+    def _retire(self, name: str, now: float):
+        track = self._nodes.pop(name)
+        self._accrue(track, now)
+        self._retired_wall_s += track.tracked_s
+        self._draining.pop(name, None)
+
+    def advance(self, now: Optional[float] = None):
+        """Close every open interval into its bucket (no state change)."""
+        now = self.clock() if now is None else now
+        for track in self._nodes.values():
+            self._accrue(track, now)
+
+    # -- transitions (the calls the ledger-transitions rule asserts) ----
+
+    def note_grant(self, request: str, nodes=(), outcome: str = "placed",
+                   now: Optional[float] = None):
+        """A scheduler grant decision landed (bind / compaction / grow)."""
+        now = self.clock() if now is None else now
+        meta = self._grants.get(request)
+        if meta is None:
+            meta = _GrantMeta(bound_ts=now)
+            self._grants[request] = meta
+        meta.outcome = outcome
+        meta.reconcile_id = trace.reconcile_id() or meta.reconcile_id
+        if nodes:
+            meta.nodes = tuple(sorted(nodes))
+        self._event(now, "grant", owner=request, outcome=outcome)
+
+    def note_release(self, request: str, reason: str = "released",
+                     now: Optional[float] = None):
+        """A scheduler release landed (GC / preemption / compaction src)."""
+        now = self.clock() if now is None else now
+        meta = self._grants.pop(request, None)
+        if meta is not None:
+            meta.released_ts = now
+            meta.release_reason = reason
+            meta.reconcile_id = trace.reconcile_id() or meta.reconcile_id
+            self._released.append((request, meta))
+        for name, track in self._nodes.items():
+            if track.owner == request:
+                self._draining.pop(name, None)
+        self._event(now, "release", owner=request, outcome=reason)
+
+    def note_draining(self, node: str, owner: str = "", reason: str = "",
+                      now: Optional[float] = None):
+        """The migration coordinator stamped a drain request."""
+        now = self.clock() if now is None else now
+        self._draining[node] = now
+        track = self._nodes.get(node)
+        if track is not None:
+            self._accrue(track, now)
+            track.occ = _OCC_DRAINING
+            owner = owner or track.owner
+        self._event(now, "draining", node=node, owner=owner, outcome=reason)
+
+    def note_eviction(self, node: str, owner: str = "", controller: str = "",
+                      reason: str = "", now: Optional[float] = None):
+        """The drain path deleted a pod (the single kill funnel)."""
+        now = self.clock() if now is None else now
+        self._draining.pop(node, None)
+        track = self._nodes.get(node)
+        if track is not None and not owner:
+            owner = track.owner
+        meta = self._grants.get(owner)
+        if meta is not None:
+            meta.evictions += 1
+            if reason != _REASON_MIGRATED:
+                meta.kills += 1
+        self._event(now, "eviction", node=node, owner=owner,
+                    outcome=reason or controller)
+
+    def note_migrated(self, node: str, owner: str = "", controller: str = "",
+                      now: Optional[float] = None):
+        """A checkpointed pod was rescheduled (drain completed cleanly)."""
+        now = self.clock() if now is None else now
+        self._draining.pop(node, None)
+        track = self._nodes.get(node)
+        if track is not None and not owner:
+            owner = track.owner
+        meta = self._grants.get(owner)
+        if meta is not None:
+            meta.migrations += 1
+        self._event(now, "migrated", node=node, owner=owner,
+                    outcome=controller)
+
+    def _event(self, now: float, kind: str, node: str = "", owner: str = "",
+               outcome: str = ""):
+        self._transitions.append({
+            "ts": round(now, 3),
+            "event": kind,
+            "node": node,
+            "owner": owner,
+            "outcome": outcome,
+            "reconcile_id": trace.reconcile_id(),
+        })
+
+    # -- evidence (the agent push hop) ----------------------------------
+
+    def observe_push(self, node: str, workloads: dict,
+                     now: Optional[float] = None):
+        """Fold one agent push's workload counters into carve evidence.
+
+        Counters are cumulative per workload process; deltas are taken
+        against per-(node, check, counter) baselines with reset
+        detection, so a re-pushed window credits zero (the double-count
+        guard) and a restore's fresh process re-seeds from its own zero."""
+        now = self.clock() if now is None else now
+        track = self._nodes.get(node)
+        owner = track.owner if track is not None else ""
+        owner_chips = self._owner_chips(owner) if owner else 0
+        ev = self._evidence.setdefault(owner, _Evidence()) if owner else None
+        meta = self._grants.get(owner)
+        for check, payload in (workloads or {}).items():
+            counters = (payload or {}).get("counters") or {}
+            useful_s = self._delta(node, check, COUNTER_USEFUL_SECONDS, counters)
+            wasted_s = self._delta(node, check, COUNTER_WASTED_SECONDS, counters)
+            replayed = self._delta(node, check, COUNTER_REPLAYED_STEPS, counters)
+            lost = self._delta(node, check, COUNTER_LOST_STEPS, counters)
+            tokens = self._delta(node, check, COUNTER_DECODED_TOKENS, counters)
+            if ev is not None:
+                # A step occupies the whole grant, not just the pushing
+                # host — evidence scales by owner chips and the carve
+                # clamp absorbs multi-host double pushes.
+                ev.useful += useful_s * owner_chips
+                ev.wasted += wasted_s * owner_chips
+                if tokens > 0:
+                    last = self._serving_seen.get((node, check))
+                    gap = min(_SERVING_CREDIT_CAP_S,
+                              now - last if last is not None else 0.0)
+                    ev.useful += max(0.0, gap) * owner_chips
+            if COUNTER_DECODED_TOKENS in counters:
+                self._serving_seen[(node, check)] = now
+            if meta is not None:
+                meta.replayed_steps += replayed
+                meta.lost_steps += lost
+                meta.decoded_tokens += tokens
+
+    def _delta(self, node: str, check: str, counter: str, counters: dict) -> float:
+        if counter not in counters:
+            return 0.0
+        try:
+            value = float(counters[counter])
+        except (TypeError, ValueError):
+            return 0.0
+        key = (node, check, counter)
+        last = self._baselines.get(key)
+        self._baselines[key] = value
+        if last is None or value < last:  # first sight or counter reset
+            return max(0.0, value)
+        return value - last
+
+    def _owner_chips(self, owner: str) -> int:
+        return sum(t.chips for t in self._nodes.values() if t.owner == owner)
+
+    # -- read side ------------------------------------------------------
+
+    def _carve(self) -> tuple[dict[str, float], dict[str, dict]]:
+        """Split each owner's granted bucket by evidence, clamped so the
+        six public states always sum to exactly the occupancy total."""
+        states = {s: 0.0 for s in STATES}
+        owners: dict[str, dict] = {}
+        for (occ, owner), chip_s in self._buckets.items():
+            if occ == _OCC_GRANTED:
+                row = owners.setdefault(owner, {"granted": 0.0,
+                                                "draining": 0.0,
+                                                "quarantined": 0.0})
+                row["granted"] += chip_s
+            elif occ in (_OCC_DRAINING, _OCC_QUARANTINED):
+                states[occ] += chip_s
+                if owner:
+                    row = owners.setdefault(owner, {"granted": 0.0,
+                                                    "draining": 0.0,
+                                                    "quarantined": 0.0})
+                    row[occ] += chip_s
+            else:
+                states[STATE_IDLE_FREE] += chip_s
+        for owner, row in owners.items():
+            ev = self._evidence.get(owner, _Evidence())
+            granted = row["granted"]
+            useful = min(ev.useful, granted)
+            wasted = min(ev.wasted, granted - useful)
+            row[STATE_BUSY_USEFUL] = useful
+            row[STATE_BUSY_WASTED] = wasted
+            row[STATE_IDLE_GRANTED] = granted - useful - wasted
+            states[STATE_BUSY_USEFUL] += useful
+            states[STATE_BUSY_WASTED] += wasted
+            states[STATE_IDLE_GRANTED] += granted - useful - wasted
+        return states, owners
+
+    def conservation(self, now: Optional[float] = None) -> dict:
+        """Both sides of the invariant, computed independently: the wall
+        side from state-blind per-node tracking, the attributed side from
+        the state buckets."""
+        now = self.clock() if now is None else now
+        self.advance(now)
+        wall = self._retired_wall_s + sum(
+            t.tracked_s for t in self._nodes.values()
+        )
+        attributed = sum(self._buckets.values())
+        drift = abs(attributed - wall) / wall if wall > 0 else 0.0
+        return {
+            "wall_chip_seconds": round(wall, 6),
+            "attributed_chip_seconds": round(attributed, 6),
+            "drift": round(drift, 6),
+        }
+
+    def rollup(self, now: Optional[float] = None) -> dict:
+        """The headline ratios (also what lands in the fleet rings)."""
+        self.advance(self.clock() if now is None else now)
+        states, _ = self._carve()
+        busy = states[STATE_BUSY_USEFUL] + states[STATE_BUSY_WASTED]
+        granted = busy + states[STATE_IDLE_GRANTED]
+        goodput = states[STATE_BUSY_USEFUL] / busy if busy > 0 else 1.0
+        utilization = busy / granted if granted > 0 else 0.0
+        return {
+            "goodput_ratio": round(goodput, 6),
+            "chip_utilization": round(utilization, 6),
+        }
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The ``GET /debug/accounting`` document."""
+        now = self.clock() if now is None else now
+        self.advance(now)
+        states, owners = self._carve()
+        cons = self.conservation(now)
+        busy = states[STATE_BUSY_USEFUL] + states[STATE_BUSY_WASTED]
+        granted = busy + states[STATE_IDLE_GRANTED]
+        grants = {}
+        # released ring first: a name that was released and re-granted
+        # (preempt → re-place) must surface its LIVE row, not the husk
+        for name, meta in list(self._released) + list(self._grants.items()):
+            row = owners.get(name, {})
+            g = row.get("granted", 0.0)
+            b = row.get(STATE_BUSY_USEFUL, 0.0) + row.get(STATE_BUSY_WASTED, 0.0)
+            grants[name] = {
+                "nodes": list(meta.nodes),
+                "chips": self._owner_chips(name),
+                "bound_ts": round(meta.bound_ts, 3),
+                "outcome": meta.outcome,
+                "reconcile_id": meta.reconcile_id,
+                "released_ts": round(meta.released_ts, 3) or 0,
+                "release_reason": meta.release_reason,
+                "granted_chip_seconds": round(g, 6),
+                "busy_useful": round(row.get(STATE_BUSY_USEFUL, 0.0), 6),
+                "busy_wasted": round(row.get(STATE_BUSY_WASTED, 0.0), 6),
+                "idle_granted": round(row.get(STATE_IDLE_GRANTED, 0.0), 6),
+                "draining": round(row.get(STATE_DRAINING, 0.0), 6),
+                "quarantined": round(row.get(STATE_QUARANTINED, 0.0), 6),
+                "utilization": round(b / g, 6) if g > 0 else 0.0,
+                "goodput_ratio": (
+                    round(row.get(STATE_BUSY_USEFUL, 0.0) / b, 6)
+                    if b > 0 else 1.0
+                ),
+                "migrations": meta.migrations,
+                "evictions": meta.evictions,
+                "kills": meta.kills,
+                "lost_steps": round(meta.lost_steps, 3),
+                "replayed_steps": round(meta.replayed_steps, 3),
+                "decoded_tokens": round(meta.decoded_tokens, 3),
+            }
+        return {
+            "ts": round(now, 3),
+            "wall_chip_seconds": cons["wall_chip_seconds"],
+            "attributed_chip_seconds": cons["attributed_chip_seconds"],
+            "conservation_drift": cons["drift"],
+            "goodput_ratio": (
+                round(states[STATE_BUSY_USEFUL] / busy, 6) if busy > 0 else 1.0
+            ),
+            "chip_utilization": round(busy / granted, 6) if granted > 0 else 0.0,
+            "states": {s: round(v, 6) for s, v in states.items()},
+            "nodes": {
+                name: {
+                    "chips": t.chips,
+                    "occupancy": t.occ,
+                    "owner": t.owner,
+                    "since": round(t.since, 3),
+                }
+                for name, t in sorted(self._nodes.items())
+            },
+            "grants": grants,
+            "transitions": list(self._transitions),
+        }
+
+    # -- export ---------------------------------------------------------
+
+    def export(self, now: Optional[float] = None):
+        """Refresh the Prometheus families and (when wired) the fleet
+        rings.  Counter families export monotonic deltas against the last
+        export; a carve that momentarily re-splits busy time clamps at
+        zero instead of decrementing (within the 1% tolerance)."""
+        now = self.clock() if now is None else now
+        self.advance(now)
+        states, _ = self._carve()
+        busy = states[STATE_BUSY_USEFUL] + states[STATE_BUSY_WASTED]
+        granted = busy + states[STATE_IDLE_GRANTED]
+        goodput = states[STATE_BUSY_USEFUL] / busy if busy > 0 else 1.0
+        utilization = busy / granted if granted > 0 else 0.0
+        if self.metrics is not None:
+            for state, total in states.items():
+                delta = total - self._exported.get(state, 0.0)
+                if delta > 0:
+                    self.metrics.chip_seconds_total.labels(state=state).inc(delta)
+                self._exported[state] = max(total, self._exported.get(state, 0.0))
+            self.metrics.goodput_ratio.set(goodput)
+            self.metrics.chip_utilization.set(utilization)
+            _, owners = self._carve()
+            live = set(self._grants)
+            for name in live:
+                row = owners.get(name, {})
+                g = row.get("granted", 0.0)
+                b = (row.get(STATE_BUSY_USEFUL, 0.0)
+                     + row.get(STATE_BUSY_WASTED, 0.0))
+                self.metrics.grant_utilization.labels(request=name).set(
+                    b / g if g > 0 else 0.0
+                )
+            for name, _meta in list(self._released):
+                if name not in live:
+                    try:
+                        self.metrics.grant_utilization.remove(name)
+                    except KeyError:
+                        pass
+        if self.fleet is not None:
+            from tpu_operator.obs import fleet as obs_fleet
+
+            self.fleet.ingest(
+                obs_fleet.METRIC_GOODPUT_RATIO, goodput, ts=time.time(),
+                source=obs_fleet.SOURCE_NODE,
+            )
+            self.fleet.ingest(
+                obs_fleet.METRIC_CHIP_UTILIZATION, utilization, ts=time.time(),
+                source=obs_fleet.SOURCE_NODE,
+            )

@@ -73,6 +73,11 @@ METRIC_CHIP_SCRAPE_ERRORS = "chip_scrape_errors_total"
 # the evidence) so /debug/fleet serves windowed rollups of both
 METRIC_SLICE_PLACEMENT = "slice_placement_seconds"
 METRIC_SLICE_FRAGMENTATION = "slice_fragmentation_ratio"
+# chip-time accounting (obs/accounting.py): the ledger's headline ratios,
+# ingested operator-side each export so /debug/fleet and the quantile
+# gauges carry windowed goodput/utilization next to the latency rollups
+METRIC_GOODPUT_RATIO = "goodput_ratio"
+METRIC_CHIP_UTILIZATION = "chip_utilization"
 
 _WORKLOAD_METRIC_PREFIX = "tpu_workload_"
 _METRIC_NAME_MAX = 128
@@ -85,6 +90,8 @@ OPERATOR_METRICS_CATALOGUE = (
     METRIC_CHIP_SCRAPE_ERRORS,
     METRIC_SLICE_PLACEMENT,
     METRIC_SLICE_FRAGMENTATION,
+    METRIC_GOODPUT_RATIO,
+    METRIC_CHIP_UTILIZATION,
 )
 
 # join→validated critical-path phases, in pipeline order (the validator
@@ -490,10 +497,15 @@ class FleetAggregator:
         metrics=None,
         ring_samples: int = consts.FLEET_RING_SAMPLES,
         max_series: int = consts.FLEET_MAX_SERIES,
+        ledger=None,
     ):
         self.metrics = metrics
         self.ring_samples = ring_samples
         self.max_series = max_series
+        # obs.accounting.ChipTimeLedger (optional): ingest_push forwards
+        # each node's workload counters so busy evidence reaches the
+        # chip-time carve without a second push endpoint
+        self.ledger = ledger
         # metric → labels-key → series: window scans touch only the
         # queried metric's bucket, not every series in the aggregator
         self._series: dict[str, dict[tuple, _Series]] = {}
@@ -637,6 +649,11 @@ class FleetAggregator:
                         source=SOURCE_PUSH,
                     ):
                         accepted += 1
+            if self.ledger is not None and node:
+                try:
+                    self.ledger.observe_push(node, workloads)
+                except Exception as e:  # noqa: BLE001 — accounting must never fail a push
+                    log.debug("chip-time ledger push observation failed: %s", e)
         accepted += self._ingest_join_phases(
             node, body.get("join_phases"), trace_id
         )

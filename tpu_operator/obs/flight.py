@@ -86,6 +86,17 @@ COUNTER_KEYS = {
     "serve_kv_blocks_free": "tpu_workload_serving_kv_blocks_free",
     "serve_requests_completed": "tpu_workload_serving_requests_completed_total",
     "serve_requests_rejected": "tpu_workload_serving_requests_rejected_total",
+    "serve_decoded_tokens": "tpu_workload_serving_decoded_tokens_total",
+    # chip-time accounting evidence (workloads/checkpoint.py training loop
+    # + restore path; obs/accounting.py carves busy time from these).
+    # acct_* are cumulative-per-process seconds — the ledger deltas them
+    # with reset detection, so re-pushed windows credit zero.
+    "checkpoint_s": "tpu_workload_checkpoint_seconds",
+    "restore_s": "tpu_workload_restore_seconds",
+    "acct_useful_s": "tpu_workload_useful_seconds_total",
+    "acct_wasted_s": "tpu_workload_wasted_seconds_total",
+    "replayed_steps": "tpu_workload_replayed_steps_total",
+    "lost_steps": "tpu_workload_lost_steps_total",
 }
 
 # result keys worth a flight sample when a check only reports a summary

@@ -58,6 +58,11 @@ from tpu_operator.obs import flight
 MANIFEST_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
 LATEST_NAME = "LATEST"
+# highest step the training loop ever COMPLETED on this checkpoint dir
+# (vs LATEST, the newest durable snapshot): the gap between the two at
+# restore time IS the lost-step delta — derived from stamps on disk, not
+# inferred from timings (obs/accounting.py busy_wasted evidence)
+HIGHWATER_NAME = "HIGHWATER"
 _STEP_DIR_RE = re.compile(r"^step-(\d{8})$")
 
 # fault-injection env (testing/chaos.py checkpoint faults): applied to
@@ -188,6 +193,25 @@ def _publish_latest(ckpt_dir: str, name: str) -> None:
     os.replace(tmp, os.path.join(ckpt_dir, LATEST_NAME))
 
 
+def publish_highwater(ckpt_dir: str, step: int) -> None:
+    """Stamp the highest completed step (same tmp+replace publish as
+    LATEST: a torn write can only leave the previous stamp)."""
+    tmp = os.path.join(ckpt_dir, HIGHWATER_NAME + f".tmp-{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(str(int(step)))
+    os.replace(tmp, os.path.join(ckpt_dir, HIGHWATER_NAME))
+
+
+def read_highwater(ckpt_dir: str) -> int:
+    """The step the job had reached when it last ran, or -1 when no stamp
+    (fresh dir / pre-upgrade layout)."""
+    try:
+        with open(os.path.join(ckpt_dir, HIGHWATER_NAME)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return -1
+
+
 def _rmtree(path: str) -> None:
     import shutil
 
@@ -316,10 +340,17 @@ def load_checkpoint(ckpt_dir: str, mesh=None) -> Optional[Checkpoint]:
             path=snap_dir,
             extra=manifest.get("extra") or {},
         )
+        # lost-step delta derived from on-disk stamps: HIGHWATER is where
+        # the killed process stood, the manifest step is where this one
+        # resumes — everything between is recompute (busy_wasted)
+        step_at_kill = read_highwater(ckpt_dir)
         flight.record(
             "migration", "restore", step=ckpt.step,
             restore_s=time.perf_counter() - t0,
             arrays=float(len(arrays)),
+            step_at_kill=float(step_at_kill),
+            step_at_restore=float(ckpt.step),
+            lost_steps=float(max(0, step_at_kill - ckpt.step)),
         )
         return ckpt
     return None
@@ -541,7 +572,17 @@ def run_migratable_training(
 
     start_step = 0
     resumed_from = 0
+    # chip-time accounting evidence: what the dir's stamps say the job had
+    # already reached (steps at-or-below this are replayed recompute), and
+    # cumulative useful/wasted busy seconds pushed as counters so the
+    # operator-side ledger deltas them (obs/accounting.py)
+    highwater_prior = read_highwater(ckpt_dir)
+    acct_useful_s = 0.0
+    acct_wasted_s = 0.0
+    replayed_steps = 0
+    t_restore0 = time.perf_counter()
     ckpt = load_checkpoint(ckpt_dir, mesh=mesh)
+    acct_wasted_s += time.perf_counter() - t_restore0  # restore overhead
     if ckpt is not None:
         params = {"w1": ckpt.arrays["w1"], "w2": ckpt.arrays["w2"]}
         start_step = resumed_from = ckpt.step
@@ -588,10 +629,15 @@ def run_migratable_training(
     ckpt_writer._last_step = resumed_from or None
 
     def snapshot(step: int, final: bool) -> Optional[str]:
+        nonlocal acct_wasted_s
         host = {k: np.asarray(v) for k, v in params.items()}
-        return ckpt_writer.save(
-            step, host, mesh_shape=(dp, mp), specs=specs, final=final,
-        )
+        t_ckpt0 = time.perf_counter()
+        try:
+            return ckpt_writer.save(
+                step, host, mesh_shape=(dp, mp), specs=specs, final=final,
+            )
+        finally:
+            acct_wasted_s += time.perf_counter() - t_ckpt0  # ckpt overhead
 
     checkpointed = resumed_from if ckpt is not None else -1
     step = start_step
@@ -604,10 +650,25 @@ def run_migratable_training(
                 progress({"event": "checkpointed", "step": step,
                           "trigger": "migrate-signal"})
             break
+        t_step0 = time.perf_counter()
         loss, params = step_fn(params, x)
         losses.append(float(loss))
         step += 1
-        flight.record("migration", "step", step=step, step_s=step_sleep_s)
+        step_wall_s = (time.perf_counter() - t_step0) + step_sleep_s
+        replayed = step <= highwater_prior
+        if replayed:
+            replayed_steps += 1
+            acct_wasted_s += step_wall_s
+        else:
+            acct_useful_s += step_wall_s
+            publish_highwater(ckpt_dir, step)
+        flight.record(
+            "migration", "step", step=step, step_s=step_sleep_s,
+            replayed=1.0 if replayed else 0.0,
+            replayed_steps=float(replayed_steps),
+            acct_useful_s=acct_useful_s,
+            acct_wasted_s=acct_wasted_s,
+        )
         if ckpt_every and step % ckpt_every == 0 and step < steps:
             snapshot(step, final=False)
             checkpointed = step

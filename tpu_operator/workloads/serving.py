@@ -857,6 +857,10 @@ class ServingEngine:
             "serve_kv_blocks_free": float(self.cache.free_count),
             "serve_requests_completed": float(self.requests_completed),
             "serve_requests_rejected": float(self.requests_rejected),
+            # cumulative decode output: the chip-time ledger's busy_useful
+            # evidence for serving replicas (a push whose token counter
+            # advanced marks the inter-push gap as useful chip-time)
+            "serve_decoded_tokens": float(self.tokens_generated),
         }
         tps = self.tokens_per_sec(now)
         if tps is not None:
