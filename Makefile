@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput straggler fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput straggler fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -200,6 +200,19 @@ serve-soak:
 # (docs/OBSERVABILITY.md "Chip-time accounting")
 goodput:
 	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --goodput --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# continuous-profiling acceptance soak (chip-free; ~2-3 min): a real
+# two-host CPU-backend training slice runs lock-step behind the file
+# step barrier while a seeded slow-host fault drags one member; the
+# detector must NAME that host within a bounded number of steps,
+# /debug/profile skew+idle must match the flight-record ground truth,
+# detection must actuate NOTHING until feedHealthEngine is opted in,
+# and then the coupling must drive quarantine → zero-loss migration
+# (evictions reason=migrated only) with the grant healed off the bad
+# pool and steady-state verbs back to 0
+# (docs/OBSERVABILITY.md "Continuous profiling & straggler attribution")
+straggler:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --straggler --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # fleet-telemetry acceptance soak (chip-free; ~1 min): 100-node fake
 # cluster under seeded node flaps; injected gated-metric regression must

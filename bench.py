@@ -3349,6 +3349,849 @@ def run_goodput_soak(n_nodes: int = 100, seed: int = 1) -> dict:
     return result
 
 
+STRAGGLER_TIMEOUT = 420.0
+# the detector must NAME the seeded slow host by this training step —
+# the "bounded number of steps" in the acceptance gate
+STRAGGLER_DETECT_STEP_BOUND = 60
+STRAGGLER_SLOW_SLEEP_S = 0.10   # per-step device work on the faulty host
+STRAGGLER_BASE_SLEEP_S = 0.02   # per-step device work on healthy hosts
+
+
+async def _straggler_soak(n_nodes: int, seed: int) -> dict:
+    """The continuous-profiling acceptance soak (`make straggler`;
+    docs/OBSERVABILITY.md "Continuous profiling & straggler attribution").
+
+    A real two-host CPU-backend training slice runs lock-step behind the
+    file step barrier while a seeded slow-host fault (extra per-step
+    device work, a property of one NODE, not of the job) drags one
+    member.  The soak gates the plane end to end, across its trust
+    boundary:
+
+    - **phase 1 (observe)** — with ``feedHealthEngine`` OFF the detector
+      must NAME the faulty host within a bounded number of steps, the
+      ``/debug/profile`` skew and idle rollups must match the ground
+      truth recomputed from the raw flight JSONLs, the Prometheus
+      families must be live, a StragglerDetected Event must land — and
+      NOTHING may actuate: fleet ingest is an unauthenticated route, so
+      detection alone never drives drains.
+    - **phase 2 (actuate)** — flipping ``feedHealthEngine`` on couples
+      the verdict into the health engine: the named node walks the
+      ladder to quarantine, the drain live-migrates the member
+      zero-loss (restored exactly at the migrate-signal checkpoint,
+      evictions reason=migrated only), the replacement sheds the fault
+      with the node, and the slice scheduler heals the grant off the
+      quarantined pool.
+
+    Wrap-up releases the slice (the verdict resolves, StragglerRecovered
+    lands) and the steady state must return to zero verbs/pass with the
+    profiling plane still live.
+    """
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from tpu_operator import consts, scheduling
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, SLICE_REQUEST_KIND, State,
+        TPUClusterPolicy, TPUSliceRequest,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.health import HealthReconciler
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import (
+        ApiClient, ApiError, Config, count_api_requests,
+    )
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs import profile as obs_profile
+    from tpu_operator.obs.accounting import ChipTimeLedger
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.profile import ProfileEngine
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.testing import FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get, topology_chips
+
+    if n_nodes < 12:
+        raise SystemExit(
+            f"--straggler needs --nodes >= 12 (four 2x4 pools + fill), "
+            f"got {n_nodes}"
+        )
+    workdir = tempfile.mkdtemp(prefix=f"straggler-{seed}-")
+    barrier_dir = os.path.join(workdir, "barrier")
+    job_procs: dict[str, subprocess.Popen] = {}
+    signal_files: dict[str, str] = {}
+    # the designated slow HOST, set once the slice binds; the pod
+    # executor (the fake kubelet) injects the fault by node identity
+    fault = {"node": ""}
+
+    def _train_executor(pod: dict) -> str:
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "train-job":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        topo = env.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={topology_chips(topo)}"
+        )
+        # the fake kubelet's downward API: host identity + the seeded
+        # per-HOST fault.  The slow step lives with the NODE — a
+        # replacement pod the migration coordinator pins to a healthy
+        # host (env copied, nodeSelector rewritten) sheds it, which is
+        # exactly what makes migration the right remediation.
+        node = (
+            deep_get(pod, "spec", "nodeName", default="")
+            or (pod["spec"].get("nodeSelector") or {})
+            .get("kubernetes.io/hostname", "")
+        )
+        env["NODE_NAME"] = node
+        env["TRAIN_STEP_SLEEP_S"] = str(
+            STRAGGLER_SLOW_SLEEP_S if node and node == fault["node"]
+            else STRAGGLER_BASE_SLEEP_S
+        )
+        sig = os.path.join(workdir, f"{name}.annotations")
+        signal_files[name] = sig
+        env[consts.MIGRATE_SIGNAL_FILE_ENV] = sig
+        env["TPU_VALIDATION_ROOT"] = os.path.join(workdir, f"vroot-{name}")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.checkpoint"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            return "Failed"
+        job_procs[name] = proc
+        try:
+            proc.wait(timeout=STRAGGLER_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return "Failed"
+        return "Succeeded" if proc.returncode == 0 else "Failed"
+
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_train_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        fleet = FleetAggregator(metrics)
+        ledger = ChipTimeLedger(metrics, fleet=fleet)
+        fleet.ledger = ledger
+        profile = ProfileEngine(metrics=metrics, ledger=ledger)
+        fleet.profile = profile  # step windows ride the same push hop
+        tracer = Tracer(metrics, fleet=fleet)
+        recorder = EventRecorder(client, NS)
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, recorder=recorder,
+            operator_metrics=metrics, tracer=tracer, fleet=fleet,
+            accounting=ledger, profile=profile, fleet_eval_interval=0.25,
+        )
+        obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
+        reconciler = ClusterPolicyReconciler(
+            client, NS, fleet=fleet, profile=profile, **obs
+        )
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            metrics=metrics, resync_seconds=20.0,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
+        sched = SliceSchedulerReconciler(
+            client, NS, fleet=fleet, ledger=ledger, **obs
+        )
+        sched.setup(mgr)
+        # setup() adopts mgr.profile as the opt-in offender feed
+        HealthReconciler(client, NS, fleet=fleet, ledger=ledger, **obs).setup(mgr)
+
+        async def _mirror_annotations() -> None:
+            pod_store = fc.store("", "pods")
+            while True:
+                for (_, name), pod in list(pod_store.objects.items()):
+                    sig = signal_files.get(name)
+                    if not sig:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    text = "".join(
+                        f'{k}="{v}"\n' for k, v in sorted(anns.items())
+                    )
+                    try:
+                        with open(sig) as f:
+                            current = f.read()
+                    except OSError:
+                        current = None
+                    if current != text:
+                        tmp = sig + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(text)
+                        os.replace(tmp, sig)
+                await asyncio.sleep(0.05)
+
+        async def _ledger_sampler() -> None:
+            # read-only occupancy feed (node LISTs are invisible to the
+            # steady-state write gate)
+            while True:
+                try:
+                    nodes = await client.list_items("", "Node")
+                except (ApiError, OSError):
+                    nodes = None
+                if nodes:
+                    ledger.observe_arcs(scheduling.arcs_from_nodes(nodes), nodes)
+                await asyncio.sleep(0.5)
+
+        # -- the evidence hop, collapsed in-process ----------------------
+        # Production: flight record → node agent tail → POST /push →
+        # ingest_push → ProfileEngine.observe_push.  The soak tails each
+        # training pod's flight JSONL (the same file the agent tails)
+        # incrementally and pushes cumulative counters plus only the NEW
+        # step windows — the engine's (node, check) seen-ring is what
+        # keeps re-deliveries idempotent, not the hop.
+        tails: dict[str, dict] = {}
+        gt_samples: dict[str, list] = {}  # pod -> raw step windows (truth)
+
+        async def _evidence_poll_once() -> None:
+            pod_store = fc.store("", "pods")
+            for (_, pname), pod in list(pod_store.objects.items()):
+                labels = deep_get(pod, "metadata", "labels", default={}) or {}
+                if labels.get("app") != "train-job":
+                    continue
+                node = (
+                    deep_get(pod, "spec", "nodeName", default="")
+                    or (pod["spec"].get("nodeSelector") or {})
+                    .get("kubernetes.io/hostname", "")
+                )
+                if pname not in tails and node:
+                    tails[pname] = {
+                        "node": node, "consumed": 0, "counters": {},
+                        "path": os.path.join(
+                            workdir, f"vroot-{pname}",
+                            "workload-results", "flight-migration.jsonl",
+                        ),
+                    }
+            for pname, tail in tails.items():
+                try:
+                    with open(tail["path"]) as f:
+                        lines = f.readlines()
+                except OSError:
+                    continue  # no flush yet
+                if lines and not lines[-1].endswith("\n"):
+                    lines = lines[:-1]  # torn mid-append tail line
+                fresh: list = []
+                for line in lines[tail["consumed"]:]:
+                    tail["consumed"] += 1
+                    try:
+                        sample = json.loads(line)
+                    except ValueError:
+                        continue
+                    m = sample.get("metrics") or {}
+                    for key, counter in flight_api.COUNTER_KEYS.items():
+                        v = m.get(key)
+                        if isinstance(v, (int, float)) and not isinstance(v, bool):
+                            tail["counters"][counter] = float(v)
+                    if sample.get("phase") == "step-window":
+                        entry = {
+                            "step_seq": sample.get("step_seq"),
+                            "host": sample.get("host"),
+                            "wall_s": sample.get("wall_s"),
+                            "phases": sample.get("phases") or {},
+                        }
+                        fresh.append(entry)
+                        gt_samples.setdefault(pname, []).append(entry)
+                if not tail["counters"] and not fresh:
+                    continue
+                cap = obs_profile.MAX_STEPS_PER_PUSH
+                for i in range(0, max(1, len(fresh)), cap):
+                    chunk = fresh[i:i + cap]
+                    fleet.ingest_push({
+                        "node": tail["node"],
+                        "workloads": {
+                            f"migration:{pname}": {
+                                "counters": dict(tail["counters"]),
+                                **({"steps": chunk} if chunk else {}),
+                            },
+                        },
+                    })
+
+        async def _evidence_hop() -> None:
+            while True:
+                await _evidence_poll_once()
+                await asyncio.sleep(0.3)
+
+        def _ground_truth(pods) -> tuple:
+            """(per-pod mean work seconds, idle fraction) recomputed from
+            the raw flight step windows.  Work excludes the compile
+            step(s) — the verdict that fires is sustained over steady
+            post-compile barriers; idle keeps every window, matching
+            what the engine folded into its ring."""
+            work: dict[str, float] = {}
+            wall_sum = cw_sum = 0.0
+            for pname in pods:
+                per = []
+                for s in gt_samples.get(pname) or []:
+                    phases = s["phases"]
+                    wall = float(s["wall_s"])
+                    cw = min(
+                        float(phases.get(
+                            obs_profile.PHASE_COLLECTIVE_WAIT, 0.0
+                        )),
+                        wall,
+                    )
+                    wall_sum += wall
+                    cw_sum += cw
+                    if phases.get(obs_profile.PHASE_COMPILE):
+                        continue
+                    per.append(max(0.0, wall - cw))
+                if per:
+                    work[pname] = sum(per) / len(per)
+            return work, (cw_sum / wall_sum if wall_sum > 0 else 0.0)
+
+        def _train_pods():
+            return [
+                (pname, pod)
+                for (_, pname), pod in list(fc.store("", "pods").objects.items())
+                if (deep_get(pod, "metadata", "labels", default={}) or {})
+                .get("app") == "train-job"
+            ]
+
+        def _job_env(ckpt: str, res_file: str, rank: int) -> list:
+            env = {
+                consts.CKPT_DIR_ENV: os.path.join(workdir, ckpt),
+                consts.JOB_TOPOLOGY_ENV: "2x4",
+                "TPU_JOB_RESULT_FILE": res_file,
+                # effectively unbounded: the soak winds the job down by
+                # migrate-signal, not by step count
+                "TRAIN_STEPS": "1000000",
+                "TPU_CKPT_EVERY": "20",
+                obs_profile.BARRIER_DIR_ENV: barrier_dir,
+                obs_profile.BARRIER_WORLD_ENV: "2",
+                obs_profile.BARRIER_RANK_ENV: str(rank),
+                obs_profile.BARRIER_TIMEOUT_ENV: "1.0",
+                # TRAIN_STEP_SLEEP_S deliberately ABSENT: the fault is
+                # injected by the kubelet per HOST, so a migrated
+                # replacement (env rides along) sheds it with the node
+            }
+            return [{"name": k, "value": v} for k, v in env.items()]
+
+        def _job_pod(name: str, node: str, env: list) -> dict:
+            return {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": name, "namespace": "default",
+                    "labels": {
+                        "app": "train-job",
+                        consts.MIGRATE_HANDLER_LABEL:
+                            consts.MIGRATION_HANDLER_CHECKPOINT,
+                    },
+                },
+                "spec": {
+                    "nodeName": node,
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "train",
+                        "image": "train-bench:dev",
+                        "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                        "env": env,
+                    }],
+                },
+            }
+
+        async def _wait_pods_succeeded(timeout: float = 180.0):
+            phases: dict = {}
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                pods = _train_pods()
+                phases = {
+                    p: deep_get(pod, "status", "phase", default="")
+                    for p, pod in pods
+                }
+                if pods and all(ph == "Succeeded" for ph in phases.values()):
+                    return
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"training pods never finished: {phases}")
+
+        def _evictions() -> dict:
+            return {
+                reason: sum(
+                    _counter_value(
+                        metrics, "tpu_operator_drain_evictions",
+                        controller=controller, reason=reason,
+                    )
+                    for controller in ("health", "slicescheduler", "upgrade")
+                )
+                for reason in ("migrated", "timeout", "failed", "no-handler",
+                               "forced")
+            }
+
+        mirror = asyncio.create_task(_mirror_annotations())
+        sampler = asyncio.create_task(_ledger_sampler())
+        hop = asyncio.create_task(_evidence_hop())
+        prof: dict = {}
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    # ladder tuned to soak time-scale; budget wide enough
+                    # that one quarantined host is within policy
+                    "health": {
+                        "failureThreshold": 2, "windowSeconds": 4,
+                        "cleanSeconds": 3, "escalationBackoffSeconds": 1,
+                        "maxUnhealthyPercent": "20%", "flapMaxTrips": 99,
+                        "flapWindowSeconds": 60,
+                    },
+                    "remediation": {"enabled": False},
+                    "migration": {"timeoutSeconds": 30},
+                    "observability": {"profiling": {
+                        "enabled": True,
+                        # phase 1 runs with the trust boundary CLOSED
+                        "feedHealthEngine": False,
+                        "skewRatioThreshold": 0.25,
+                        "sustainedSteps": 3,
+                        "minHosts": 2,
+                    }},
+                }).obj)
+                # four 2x4 pools (one hosts the slice, three are healing
+                # headroom), single-host 2x2 fill to n_nodes
+                pools = 4
+                for s in range(pools):
+                    for h in range(2):
+                        fc.add_node(f"mid-{s}-{h}", topology="2x4", labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-mid-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        })
+                for i in range(max(0, n_nodes - 2 * pools)):
+                    accel = (
+                        "tpu-v5p-slice" if i % 6 == 0
+                        else "tpu-v5-lite-podslice"
+                    )
+                    fc.add_node(f"small-{i}", topology="2x2", accelerator=accel)
+
+                async def _converged() -> bool:
+                    cr = await client.get(
+                        GROUP, CLUSTER_POLICY_KIND, "cluster-policy"
+                    )
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE
+                        in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > STRAGGLER_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                base_url = f"http://127.0.0.1:{mgr.metrics_port}"
+
+                # -- the multi-host training slice -----------------------
+                await client.create(TPUSliceRequest.new(
+                    "r-train", {"topology": "2x4"}
+                ).obj)
+                t_b = time.perf_counter()
+                nodes0: list = []
+                while time.perf_counter() - t_b < 60.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-train")
+                    status = cr.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if status.get("phase") == "Bound" and arcs:
+                        nodes0 = list(arcs[0]["nodes"])
+                        break
+                    await asyncio.sleep(0.25)
+                if len(nodes0) != 2:
+                    raise TimeoutError(f"r-train never bound 2 hosts: {nodes0}")
+                result["slice_nodes"] = nodes0
+                victim_idx = seed % len(nodes0)
+                victim_node = nodes0[victim_idx]
+                fault["node"] = victim_node
+                result["victim_node"] = victim_node
+                # the engine learns membership from the clusterpolicy
+                # pass's node stamps — make sure that happened before the
+                # first step windows arrive
+                t_m = time.perf_counter()
+                while profile._node_slice.get(victim_node) != "r-train":
+                    await reconciler.reconcile("cluster-policy")
+                    if time.perf_counter() - t_m > 30.0:
+                        raise TimeoutError(
+                            "profile engine never learned slice membership"
+                        )
+                    await asyncio.sleep(0.2)
+
+                res_files: dict = {}
+                for i, node in enumerate(nodes0):
+                    pname = f"job-a-{i}"
+                    res_files[pname] = os.path.join(workdir, f"{pname}.jsonl")
+                    await client.create(_job_pod(
+                        pname, node, _job_env(f"ckpt-r{i}", res_files[pname], i)
+                    ))
+                victim_pod = f"job-a-{victim_idx}"
+                peer_pod = f"job-a-{1 - victim_idx}"
+                victim_res = res_files[victim_pod]
+
+                # -- phase 1: the detector names the slow host -----------
+                t1 = time.perf_counter()
+                det = None
+                async with aiohttp.ClientSession() as http:
+                    while time.perf_counter() - t1 < 150.0:
+                        async with http.get(f"{base_url}/debug/profile") as resp:
+                            prof = await resp.json()
+                        det = (prof.get("stragglers") or {}).get("r-train")
+                        if det:
+                            break
+                        await asyncio.sleep(0.3)
+                if det is None:
+                    raise TimeoutError(
+                        f"straggler never detected; slices={prof.get('slices')} "
+                        f"counters={prof.get('counters')}"
+                    )
+                result["detect_wall_s"] = round(time.perf_counter() - t1, 3)
+                result["detected_node"] = det.get("node")
+                result["detected_step"] = det.get("step_seq")
+                result["detected_ratio"] = det.get("ratio")
+                result["detected_skew_s"] = det.get("skew_s")
+                srow = (prof.get("slices") or {}).get("r-train") or {}
+                result["slice_slow_host"] = srow.get("slow_host")
+                result["slice_straggler"] = srow.get("straggler")
+                result["step_skew_ratio"] = prof.get("step_skew_ratio")
+                result["step_idle_fraction"] = prof.get("step_idle_fraction")
+                result["profile_counters"] = prof.get("counters")
+                result["attribution"] = prof.get("attribution")
+
+                # ground truth, recomputed from the raw flight JSONLs
+                work, gt_idle = _ground_truth((victim_pod, peer_pod))
+                gt_skew = (
+                    (work.get(victim_pod) or 0.0) - (work.get(peer_pod) or 0.0)
+                )
+                result["gt_work_s"] = {k: round(v, 6) for k, v in work.items()}
+                result["gt_skew_s"] = round(gt_skew, 6)
+                result["gt_idle_fraction"] = round(gt_idle, 6)
+
+                # trust boundary: detection alone must not have actuated
+                result["evictions_pre_optin"] = _evictions()
+                vnode = await client.get("", "Node", victim_node)
+                result["victim_cordoned_pre_optin"] = bool(
+                    deep_get(vnode, "spec", "unschedulable", default=False)
+                )
+
+                # exported families live while the verdict is active
+                result["metric_compute_p50"] = _gauge_value(
+                    metrics, "tpu_operator_step_phase_seconds",
+                    phase="compute", quantile="p50",
+                )
+                result["metric_stragglers_total"] = _counter_value(
+                    metrics, "tpu_operator_stragglers_detected"
+                )
+                t_e = time.perf_counter()
+                det_events: list = []
+                while time.perf_counter() - t_e < 15.0:
+                    det_events = [
+                        e for e in fc.store("", "events").objects.values()
+                        if e.get("reason") == "StragglerDetected"
+                    ]
+                    if det_events:
+                        break
+                    await asyncio.sleep(0.2)
+                result["detected_event"] = bool(det_events)
+                result["detected_event_joined"] = any(
+                    (deep_get(e, "metadata", "annotations", default={}) or {})
+                    .get(consts.EVENT_RECONCILE_ID_ANNOTATION)
+                    for e in det_events
+                )
+
+                # -- phase 2: opt the trust boundary in ------------------
+                await client.patch(
+                    GROUP, CLUSTER_POLICY_KIND, "cluster-policy",
+                    {"spec": {"observability": {"profiling": {
+                        "feedHealthEngine": True,
+                    }}}},
+                )
+                await reconciler.reconcile("cluster-policy")
+
+                # the named node walks the ladder to quarantine and the
+                # drain live-migrates the member
+                t2 = time.perf_counter()
+                while _evictions().get("migrated", 0) < 1:
+                    if time.perf_counter() - t2 > 150.0:
+                        raise TimeoutError(
+                            "opt-in coupling never drove the migration drain"
+                        )
+                    await asyncio.sleep(0.3)
+                result["quarantine_migrate_s"] = round(
+                    time.perf_counter() - t2, 3
+                )
+
+                # zero loss: the replacement restores at the exact
+                # migrate-signal checkpoint
+                t3 = time.perf_counter()
+                restored = None
+                while time.perf_counter() - t3 < 120.0:
+                    restored = next(
+                        (e for e in _read_events(victim_res)
+                         if e.get("event") == "restored"), None,
+                    )
+                    if restored is not None:
+                        break
+                    await asyncio.sleep(0.3)
+                if restored is None:
+                    raise TimeoutError("migrated member was never restored")
+                ckpts = [
+                    e.get("step") for e in _read_events(victim_res)
+                    if e.get("event") == "checkpointed"
+                    and e.get("trigger") == "migrate-signal"
+                ]
+                result["migrate_checkpoint_step"] = max(ckpts, default=None)
+                result["resumed_from_step"] = restored.get("resumed_from_step")
+
+                # the scheduler heals the grant off the quarantined pool
+                t4 = time.perf_counter()
+                healed: list = []
+                while time.perf_counter() - t4 < 150.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-train")
+                    status = cr.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if status.get("phase") == "Bound" and arcs and (
+                        victim_node not in arcs[0]["nodes"]
+                    ):
+                        healed = list(arcs[0]["nodes"])
+                        break
+                    await asyncio.sleep(0.3)
+                if not healed:
+                    raise TimeoutError(
+                        "r-train was never healed off the quarantined pool"
+                    )
+                result["healed_nodes"] = healed
+
+                # -- wrap-up: wind the job down, resolve the verdict -----
+                # the soak is the job's restart controller: every
+                # surviving member checkpoints-and-exits on migrate-signal
+                for pname, pod in _train_pods():
+                    if deep_get(pod, "status", "phase", default="") != "Succeeded":
+                        await client.patch("", "Pod", pname, {
+                            "metadata": {"annotations": {
+                                consts.MIGRATE_ANNOTATION:
+                                    consts.MIGRATE_REQUESTED,
+                            }},
+                        }, "default")
+                await _wait_pods_succeeded()
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "r-train")
+                t5 = time.perf_counter()
+                recovered_ok = False
+                async with aiohttp.ClientSession() as http:
+                    while time.perf_counter() - t5 < 90.0:
+                        # membership refresh: released stamps resolve the
+                        # verdict on the next evaluate tick
+                        await reconciler.reconcile("cluster-policy")
+                        async with http.get(f"{base_url}/debug/profile") as resp:
+                            prof2 = await resp.json()
+                        if not (prof2.get("stragglers") or {}):
+                            recovered_ok = True
+                            break
+                        await asyncio.sleep(0.3)
+                result["recovered"] = recovered_ok
+                result["recovered_event"] = any(
+                    e.get("reason") == "StragglerRecovered"
+                    for e in fc.store("", "events").objects.values()
+                )
+
+                # -- steady state ----------------------------------------
+                steady_requests = sched_requests = steady_writes = None
+                t6 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    policy_n = counter.n
+                    with count_api_requests() as counter:
+                        await sched.reconcile("slices")
+                    sched_n = counter.n
+                    writes = _nonlease_writes(fc)
+                    if policy_n == 0 and sched_n == 0 and writes == 0:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                    if time.perf_counter() - t6 > 90:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                result["steady_scheduler_requests_per_pass"] = sched_requests
+                result["steady_writes_per_pass"] = steady_writes
+        finally:
+            for task in (mirror, sampler, hop):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            await client.close()
+            for proc in job_procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+
+        result["evictions"] = _evictions()
+        result["duplicate_creations"] = {
+            "/".join(k): v for k, v in fc.duplicate_creations().items()
+        }
+
+        failures = []
+        if result.get("detected_node") != result.get("victim_node"):
+            failures.append(
+                f"detector named {result.get('detected_node')}, the seeded "
+                f"slow host is {result.get('victim_node')}"
+            )
+        if (result.get("detected_step") or 10**9) > STRAGGLER_DETECT_STEP_BOUND:
+            failures.append(
+                f"detection at step {result.get('detected_step')} over the "
+                f"{STRAGGLER_DETECT_STEP_BOUND}-step bound"
+            )
+        if not result.get("slice_straggler") or (
+            result.get("slice_slow_host") != result.get("victim_node")
+        ):
+            failures.append(
+                f"/debug/profile slice row disagrees: straggler="
+                f"{result.get('slice_straggler')} "
+                f"slow_host={result.get('slice_slow_host')}"
+            )
+        gt_skew = result.get("gt_skew_s") or 0.0
+        det_skew = result.get("detected_skew_s") or 0.0
+        if gt_skew <= 0.02:
+            failures.append(
+                f"seeded fault produced no measurable ground-truth skew "
+                f"({gt_skew}s)"
+            )
+        elif not (0.25 * gt_skew <= det_skew <= 4.0 * gt_skew):
+            failures.append(
+                f"reported skew {det_skew}s outside tolerance of ground "
+                f"truth {gt_skew}s"
+            )
+        idle = result.get("step_idle_fraction")
+        gt_idle = result.get("gt_idle_fraction")
+        if idle is None or abs(idle - (gt_idle or 0.0)) > 0.20:
+            failures.append(
+                f"idle rollup {idle} vs ground truth {gt_idle} over 0.20"
+            )
+        if (result.get("detected_ratio") or 0.0) < 0.25:
+            failures.append(
+                f"detected ratio {result.get('detected_ratio')} under the "
+                f"configured threshold"
+            )
+        if (result.get("step_skew_ratio") or 0.0) < 0.25:
+            failures.append(
+                f"headline skew gauge {result.get('step_skew_ratio')} under "
+                f"threshold while a straggler is active"
+            )
+        pre = result.get("evictions_pre_optin") or {}
+        if any(pre.values()):
+            failures.append(
+                f"detection actuated across the CLOSED trust boundary: {pre}"
+            )
+        if result.get("victim_cordoned_pre_optin"):
+            failures.append(
+                "victim node cordoned before feedHealthEngine was opted in"
+            )
+        if not (result.get("metric_compute_p50") or 0.0) > 0.0:
+            failures.append("step_phase_seconds compute p50 never exported")
+        if (result.get("metric_stragglers_total") or 0.0) < 1:
+            failures.append("stragglers_detected_total never incremented")
+        if not result.get("detected_event"):
+            failures.append("no StragglerDetected Event was posted")
+        elif not result.get("detected_event_joined"):
+            failures.append(
+                "StragglerDetected Event missing the reconcile-id join"
+            )
+        counters = result.get("profile_counters") or {}
+        if not (counters.get("steps_ingested") or 0) > 0:
+            failures.append("no step windows reached the engine")
+        if counters.get("windows_rejected"):
+            failures.append(
+                f"engine rejected {counters.get('windows_rejected')} windows"
+            )
+        attribution = result.get("attribution")
+        if not attribution or not (
+            (attribution.get("wall_chip_seconds") or 0) > 0
+        ):
+            failures.append(
+                f"ledger attribution join missing/empty: {attribution}"
+            )
+        if result.get("resumed_from_step") is None or (
+            result.get("resumed_from_step")
+            != result.get("migrate_checkpoint_step")
+        ):
+            failures.append(
+                f"migration lost steps: resumed at "
+                f"{result.get('resumed_from_step')}, checkpointed at "
+                f"{result.get('migrate_checkpoint_step')}"
+            )
+        if result["evictions"].get("migrated", 0) < 1:
+            failures.append("the drain did not ride the migration path")
+        for reason in ("timeout", "failed", "no-handler", "forced"):
+            if result["evictions"].get(reason, 0):
+                failures.append(
+                    f"a drain plain-evicted a workload (reason={reason})"
+                )
+        if result.get("victim_node") in (result.get("healed_nodes") or []):
+            failures.append("the healed grant still includes the slow host")
+        if not result.get("recovered"):
+            failures.append("the verdict never resolved after the release")
+        if not result.get("recovered_event"):
+            failures.append("no StragglerRecovered Event was posted")
+        if result.get("duplicate_creations"):
+            failures.append(
+                f"duplicate creations: {result['duplicate_creations']}"
+            )
+        if result.get("steady_requests_per_pass") != 0:
+            failures.append(
+                f"steady policy requests/pass = "
+                f"{result.get('steady_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_scheduler_requests_per_pass") != 0:
+            failures.append(
+                f"steady scheduler requests/pass = "
+                f"{result.get('steady_scheduler_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_writes_per_pass") != 0:
+            failures.append(
+                f"steady writes/pass = {result.get('steady_writes_per_pass')}"
+                " (want 0)"
+            )
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_straggler_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  straggler soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_straggler_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  straggler FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  straggler soak: named {result.get('detected_node')} at step "
+        f"{result.get('detected_step')} ({result.get('detect_wall_s')}s), "
+        f"skew {result.get('detected_skew_s')}s (truth "
+        f"{result.get('gt_skew_s')}s), idle "
+        f"{result.get('step_idle_fraction')} (truth "
+        f"{result.get('gt_idle_fraction')}), migrate "
+        f"{result.get('quarantine_migrate_s')}s zero-loss@"
+        f"{result.get('resumed_from_step')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 FLEET_OBS_TIMEOUT = 300.0
 
 
@@ -5498,6 +6341,31 @@ def main() -> None:
             "goodput_migration": result.get("goodput_migration"),
             "goodput_kill": result.get("goodput_kill"),
             "conservation_drift": result.get("conservation_drift"),
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --straggler [--nodes 100] [--seed 1]`: continuous
+    # profiling & straggler attribution acceptance soak (CPU-backend
+    # training subprocesses) — `make straggler`.  Gated: the seeded
+    # slow host named within a bounded number of steps, /debug/profile
+    # skew+idle matching the flight-record ground truth, detection
+    # actuating NOTHING until feedHealthEngine is opted in, then
+    # quarantine → zero-loss migration (evictions reason=migrated
+    # only), the grant healed off the bad pool, and steady-state
+    # verbs/pass back to 0 with the profiling plane live.
+    if "--straggler" in sys.argv:
+        result = run_straggler_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "straggler_detected_step",
+            "value": result.get("detected_step"),
+            "unit": "steps",
+            "detected_node": result.get("detected_node"),
+            "detect_wall_s": result.get("detect_wall_s"),
+            "resumed_from_step": result.get("resumed_from_step"),
             "ok": result["ok"],
             "detail": result,
         }))

@@ -649,3 +649,50 @@ def test_repo_tree_is_clean_under_all_rules():
         ), f"new-analyzer finding may not be baselined: {fp}"
     result = engine.run(baseline=baseline)
     assert result.ok, "\n".join(f.render() for f in result.findings)
+
+
+def test_phase_coverage_trips_on_gap_and_invented_phase(tmp_path):
+    res = run_on(tmp_path, {
+        "tpu_operator/workloads/bad.py": """
+            def run(flight, timer):
+                for i in range(4):
+                    flight.record("train", "compile" if i == 0 else "step",
+                                  step=i, step_s=0.5)
+            def phases(timer, flight):
+                with timer.phase("warmup"):
+                    pass
+                timer.add("compute", 0.5)
+                flight.record_step("train", step_seq=0, wall_s=1.0,
+                                   phases={"netwait": 1.0})
+        """,
+        # same call shapes OUTSIDE workloads/ are out of scope
+        "tpu_operator/controllers/elsewhere.py": """
+            def run(flight):
+                flight.record("train", "step", step=0, step_s=0.5)
+        """,
+    }, rules=["phase-coverage"])
+    trips = names_of(res, "phase-coverage")
+    assert len(trips) == 3 and all(f.file.endswith("bad.py") for f in trips)
+    gap = [f for f in trips if "record_step" in f.message and "invisible" in f.message]
+    assert len(gap) == 1 and "run" in gap[0].message
+    vocab = [f for f in trips if "vocabulary" in f.message]
+    assert len(vocab) == 2
+    assert any("'warmup'" in f.message for f in vocab)
+    assert any("'netwait'" in f.message for f in vocab)
+
+
+def test_phase_coverage_passes_instrumented_loop_and_opt_out(tmp_path):
+    res = run_on(tmp_path, {
+        "tpu_operator/workloads/good.py": """
+            def run(flight, timer):
+                for i in range(4):
+                    with timer.phase("compute"):
+                        pass
+                    flight.record("train", "step", step=i, step_s=0.5)
+                    flight.record_step("train", step_seq=i, wall_s=0.5,
+                                       phases=timer.spans())
+            def legacy(flight):
+                flight.record("probe", "step", step=0, step_s=0.1)  # phase-ok
+        """,
+    }, rules=["phase-coverage"])
+    assert not names_of(res, "phase-coverage")

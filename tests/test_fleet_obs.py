@@ -732,3 +732,109 @@ def test_quantile_helper_edges():
     vals = sorted(float(i) for i in range(1, 101))
     assert quantile(vals, 0.5) == 50.5
     assert abs(quantile(vals, 0.99) - 99.01) < 1e-9
+
+
+# ----------------------------------------------------------------------
+# ingest_push vs the chip-time ledger: duplicate & reset cumulative
+# counters must flow through the same delta path the agent surface uses
+# (ISSUE 17 satellite — the straggler soak leans on this hop)
+
+
+def test_ingest_push_duplicate_counters_credit_ledger_once():
+    from tpu_operator.obs.accounting import ChipTimeLedger
+    from tpu_operator.obs import accounting
+
+    from tests.test_accounting import FakeClock, _granted, _observe, _push
+    from tests.test_scheduling import _node
+
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    agg = FleetAggregator(ledger=ledger)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(100.0)
+    _observe(ledger, nodes)
+
+    body = {"node": "n1",
+            "workloads": _push({accounting.COUNTER_USEFUL_SECONDS: 10.0})}
+    agg.ingest_push(body)
+    # identical cumulative value re-pushed (agent retry / flight requeue):
+    # the delta path must credit zero the second time
+    agg.ingest_push(dict(body))
+    states = ledger.snapshot()["states"]
+    assert states[accounting.STATE_BUSY_USEFUL] == 10.0 * 8
+
+
+def test_ingest_push_counter_reset_credits_only_new_value():
+    from tpu_operator.obs.accounting import ChipTimeLedger
+    from tpu_operator.obs import accounting
+
+    from tests.test_accounting import FakeClock, _granted, _observe, _push
+    from tests.test_scheduling import _node
+
+    clock = FakeClock()
+    ledger = ChipTimeLedger(clock=clock)
+    agg = FleetAggregator(ledger=ledger)
+    nodes = [_granted(_node("n1"), "req-a")]
+    _observe(ledger, nodes)
+    clock.tick(200.0)
+    _observe(ledger, nodes)
+
+    agg.ingest_push({"node": "n1",
+                     "workloads": _push({accounting.COUNTER_USEFUL_SECONDS: 12.0})})
+    # pod restart: cumulative counter drops below its high-water mark.
+    # Only the fresh post-reset accumulation (3.0) may be credited.
+    agg.ingest_push({"node": "n1",
+                     "workloads": _push({accounting.COUNTER_USEFUL_SECONDS: 3.0})})
+    states = ledger.snapshot()["states"]
+    assert states[accounting.STATE_BUSY_USEFUL] == (12.0 + 3.0) * 8
+
+
+def test_rollup_percentiles_stable_under_out_of_order_ingest():
+    fleet = FleetAggregator()
+    vals = [float(i) for i in range(1, 21)]
+    # arrivals deliberately out of timestamp order: newest first, then a
+    # stale straggler batch — percentiles are over values, not arrival
+    shuffled = vals[10:] + vals[:10][::-1]
+    base = 1000.0
+    for i, v in enumerate(shuffled):
+        assert fleet.ingest("tpu_workload_mfu", v, ts=base - i)
+    roll = fleet.rollup("tpu_workload_mfu", window_s=3600.0, now=base)
+    assert roll is not None
+    assert roll["count"] == 20
+    assert roll["min"] == 1.0 and roll["max"] == 20.0
+    assert roll["mean"] == sum(vals) / len(vals)
+    assert roll["p50"] == quantile(vals, 0.5)
+    assert roll["p90"] == quantile(vals, 0.9)
+    # same data ingested in order gives the identical rollup
+    ordered = FleetAggregator()
+    for i, v in enumerate(vals):
+        ordered.ingest("tpu_workload_mfu", v, ts=base - 100 + i)
+    assert ordered.rollup("tpu_workload_mfu", 3600.0, now=base) == roll
+
+
+def test_ingest_push_step_windows_reach_profile_engine():
+    from tpu_operator.obs.profile import ProfileEngine
+
+    eng = ProfileEngine()
+    agg = FleetAggregator(profile=eng)
+    accepted = agg.ingest_push({
+        "node": "tpu-0-0",
+        "workloads": {"migration": {
+            "counters": {},
+            "steps": [{"step_seq": 3, "host": "tpu-0-0", "wall_s": 0.5,
+                       "phases": {"compute": 0.4, "collective-wait": 0.1}}],
+        }},
+    })
+    snap = eng.snapshot()
+    assert snap["counters"]["steps_ingested"] == 1
+    # a push carrying ONLY step windows (no counters at all) still routes
+    agg.ingest_push({
+        "node": "tpu-0-1",
+        "workloads": {"migration": {
+            "steps": [{"step_seq": 3, "host": "tpu-0-1", "wall_s": 0.5,
+                       "phases": {"compute": 0.5}}],
+        }},
+    })
+    assert eng.snapshot()["counters"]["steps_ingested"] == 2
+    assert accepted >= 0

@@ -426,3 +426,78 @@ def test_bench_regression_report():
     assert report["hbm_gbps"]["vs"] == "r05"
     assert report["mfu"]["verdict"] == "improved"
     assert report["mfu"]["vs"] == "r04"
+
+
+# ----------------------------------------------------------------------
+# step-profile windows (ISSUE 17): monotonic step_seq at the source,
+# host identity, and the push window's take/requeue merge contract
+
+
+def test_record_step_monotonic_seq_and_host_identity(monkeypatch):
+    monkeypatch.setenv("NODE_NAME", "tpu-9-9")
+    recorder = flight.FlightRecorder()
+    sample = recorder.record_step(
+        "migration", step_seq=5, wall_s=0.5,
+        phases={"compute": 0.4, "collective-wait": 0.1, "not-a-phase": 9.0},
+    )
+    assert sample is not None
+    assert sample["host"] == "tpu-9-9"
+    assert sample["step_seq"] == 5 and sample["phase"] == "step-window"
+    # out-of-vocabulary phases are dropped at the source, not forwarded
+    assert sample["phases"] == {"compute": 0.4, "collective-wait": 0.1}
+    # replay / out-of-order: at or below the high-water mark is dropped
+    assert recorder.record_step("migration", step_seq=5, wall_s=0.5) is None
+    assert recorder.record_step("migration", step_seq=4, wall_s=0.5) is None
+    # a DIFFERENT check keeps its own sequence space
+    assert recorder.record_step("serve", step_seq=1, wall_s=0.1) is not None
+    # junk never raises mid-step-loop
+    assert recorder.record_step("migration", step_seq="x", wall_s=0.5) is None
+    assert recorder.record_step("migration", step_seq=6, wall_s=-1.0) is None
+    assert recorder.record_step(
+        "migration", step_seq=6, wall_s=float("nan")) is None
+    recorder.close()
+
+
+def test_take_pending_attaches_steps_and_requeue_merges_by_seq():
+    recorder = flight.FlightRecorder()
+    recorder._pending = {"train": {"tpu_workload_mfu": 0.9}}
+    recorder._pending_steps = {
+        "train": [{"step_seq": 1, "host": "h", "wall_s": 0.5, "phases": {}}],
+        "idle": [],
+    }
+    window = recorder._take_pending()
+    assert window["train"]["counters"] == {"tpu_workload_mfu": 0.9}
+    assert [s["step_seq"] for s in window["train"]["steps"]] == [1]
+    assert "idle" not in window  # empty step queue contributes nothing
+    assert recorder._take_pending() is None  # drained
+
+    # POST fails; meanwhile step 2 lands live. Requeue must merge the
+    # failed window back WITHOUT duplicating seqs, sorted for the wire.
+    recorder._pending_steps = {
+        "train": [{"step_seq": 2, "host": "h", "wall_s": 0.4, "phases": {}},
+                  {"step_seq": 1, "host": "h", "wall_s": 9.9, "phases": {}}],
+    }
+    recorder._requeue(window)
+    queue = recorder._pending_steps["train"]
+    assert [s["step_seq"] for s in queue] == [1, 2]
+    # the LIVE seq-1 entry won over the failed window's copy
+    assert queue[0]["wall_s"] == 9.9
+    # counters merged too (live wins is covered by the requeue test above)
+    assert recorder._pending["train"]["tpu_workload_mfu"] == 0.9
+    recorder.close()
+
+
+def test_step_only_window_is_taken_and_pushable():
+    """A window holding ONLY step profiles (no counters recorded between
+    pushes) must still drain — the straggler soak's barrier evidence rides
+    exactly this shape."""
+    recorder = flight.FlightRecorder()
+    recorder._pending_steps = {
+        "migration": [
+            {"step_seq": 7, "host": "h", "wall_s": 0.2, "phases": {}}],
+    }
+    window = recorder._take_pending()
+    assert window is not None
+    assert window["migration"]["counters"] == {}
+    assert [s["step_seq"] for s in window["migration"]["steps"]] == [7]
+    recorder.close()

@@ -1,0 +1,397 @@
+"""Continuous profiling & straggler attribution plane (ISSUE 17,
+alongside the `make straggler` soak): StepTimer phase bounds, the shared
+clean_steps validation gate, FileStepBarrier sync/leave/timeout, the
+ProfileEngine's dedup + out-of-order ingest, work-based skew detection
+with hysteresis, the opt-in health coupling, and the /debug/profile
+snapshot + bounded Prometheus export."""
+
+import threading
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.types import ProfilingSpec
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs import profile as prof
+from tpu_operator.obs.profile import (
+    PHASE_COLLECTIVE_WAIT,
+    PHASE_COMPILE,
+    PHASE_COMPUTE,
+    STEP_PHASES,
+    FileStepBarrier,
+    ProfileEngine,
+    StepTimer,
+    clean_steps,
+)
+
+
+def _node(name: str, slice_req: str = "") -> dict:
+    labels = {consts.SLICE_REQUEST_LABEL: slice_req} if slice_req else {}
+    return {"metadata": {"name": name, "labels": labels}}
+
+
+def _step(seq: int, host: str, wall: float, cw: float = 0.0,
+          compute: float = 0.0) -> dict:
+    phases = {}
+    if cw:
+        phases[PHASE_COLLECTIVE_WAIT] = cw
+    if compute:
+        phases[PHASE_COMPUTE] = compute
+    return {"step_seq": seq, "host": host, "wall_s": wall, "phases": phases}
+
+
+# ----------------------------------------------------------------------
+# workload side
+
+
+def test_step_timer_accumulates_and_bounds_vocabulary():
+    timer = StepTimer()
+    with timer.phase(PHASE_COMPUTE):
+        pass
+    with timer.phase(PHASE_COMPUTE):
+        pass
+    timer.add(PHASE_COLLECTIVE_WAIT, 0.5)
+    timer.add(PHASE_COLLECTIVE_WAIT, 0.25)
+    spans = timer.spans()
+    assert set(spans) == {PHASE_COMPUTE, PHASE_COLLECTIVE_WAIT}
+    assert spans[PHASE_COLLECTIVE_WAIT] == 0.75
+    assert spans[PHASE_COMPUTE] >= 0.0
+    with pytest.raises(ValueError):
+        with timer.phase("gc-pause"):
+            pass
+    with pytest.raises(ValueError):
+        timer.add("gc-pause", 1.0)
+    # invalid seconds are dropped, not raised (measurement never crashes)
+    timer.add(PHASE_COMPUTE, float("nan"))
+    timer.add(PHASE_COMPUTE, -1.0)
+    timer.reset()
+    assert timer.spans() == {}
+
+
+def test_clean_steps_normalizes_and_rejects():
+    entries = clean_steps([
+        {"step_seq": 3, "host": "h" * 200, "wall_s": 1.0,
+         "phases": {PHASE_COMPUTE: 0.9, "bogus-phase": 5.0,
+                    PHASE_COLLECTIVE_WAIT: float("inf")}},
+        {"step_seq": -1, "host": "h", "wall_s": 1.0},     # negative seq
+        {"step_seq": 4, "host": "h", "wall_s": -1.0},     # negative wall
+        {"step_seq": "x", "host": "h", "wall_s": 1.0},    # unparseable seq
+        {"step_seq": 5, "host": "h", "wall_s": True},     # bool is not a float
+        "not-a-dict",
+        {"step_seq": 6, "wall_s": 0.25, "phases": "nope"},
+    ])
+    assert [e["step_seq"] for e in entries] == [3, 6]
+    assert len(entries[0]["host"]) == 64          # host identity truncated
+    assert entries[0]["phases"] == {PHASE_COMPUTE: 0.9}  # vocabulary enforced
+    assert entries[1]["phases"] == {}
+    # list cap: the agent hop forwards at most MAX_STEPS_PER_PUSH per check
+    many = [{"step_seq": i, "host": "h", "wall_s": 0.1} for i in range(500)]
+    assert len(clean_steps(many)) == prof.MAX_STEPS_PER_PUSH
+    assert clean_steps("garbage") == []
+
+
+def test_file_barrier_syncs_two_ranks_and_returns_wait(tmp_path):
+    root = str(tmp_path / "bar")
+    r0 = FileStepBarrier(root, world=2, rank=0, timeout_s=5.0)
+    r1 = FileStepBarrier(root, world=2, rank=1, timeout_s=5.0)
+    waits = {}
+
+    def member(b, key, delay):
+        import time as _t
+        _t.sleep(delay)
+        waits[key] = b.wait(1)
+
+    t0 = threading.Thread(target=member, args=(r0, 0, 0.0))
+    t1 = threading.Thread(target=member, args=(r1, 1, 0.15))
+    t0.start(); t1.start(); t0.join(); t1.join()
+    # the early arriver blocked on the late one, not vice versa
+    assert waits[0] >= 0.1
+    assert waits[1] < waits[0]
+
+
+def test_file_barrier_leave_unblocks_peers_and_rejoin(tmp_path):
+    root = str(tmp_path / "bar")
+    r0 = FileStepBarrier(root, world=2, rank=0, timeout_s=5.0)
+    r1 = FileStepBarrier(root, world=2, rank=1, timeout_s=5.0)
+    r1.leave()                       # rank 1 migrates out
+    assert r0.wait(1) < 2.0          # survivor does not wedge
+    # a restored member withdraws its goodbye on construction
+    r1b = FileStepBarrier(root, world=2, rank=1, timeout_s=0.2)
+    waited = r1b.wait(2)             # rank 0 absent -> bounded by timeout
+    assert waited >= 0.2
+
+
+def test_file_barrier_from_env_gating(tmp_path):
+    assert FileStepBarrier.from_env(env={}) is None
+    assert FileStepBarrier.from_env(env={prof.BARRIER_DIR_ENV: ""}) is None
+    env = {
+        prof.BARRIER_DIR_ENV: str(tmp_path),
+        prof.BARRIER_WORLD_ENV: "1",       # world < 2: no barrier
+        prof.BARRIER_RANK_ENV: "0",
+    }
+    assert FileStepBarrier.from_env(env=env) is None
+    env[prof.BARRIER_WORLD_ENV] = "2"
+    env[prof.BARRIER_RANK_ENV] = "7"       # rank out of range
+    assert FileStepBarrier.from_env(env=env) is None
+    env[prof.BARRIER_RANK_ENV] = "1"
+    env[prof.BARRIER_TIMEOUT_ENV] = "3"
+    b = FileStepBarrier.from_env(env=env)
+    assert b is not None and b.world == 2 and b.rank == 1
+    assert b.timeout_s == 3.0
+    env[prof.BARRIER_WORLD_ENV] = "not-a-number"
+    assert FileStepBarrier.from_env(env=env) is None
+
+
+# ----------------------------------------------------------------------
+# operator side: ingest
+
+
+def _engine(**kw) -> ProfileEngine:
+    t = {"now": 1000.0}
+    eng = ProfileEngine(clock=lambda: t["now"], **kw)
+    eng._t = t  # test handle to advance time
+    return eng
+
+
+def test_ingest_dedups_and_tolerates_out_of_order():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a")])
+    eng.observe_steps("n0", "migration", [
+        _step(2, "n0", 0.1), _step(1, "n0", 0.1), _step(3, "n0", 0.1),
+    ])
+    assert eng.steps_ingested == 3
+    # a re-delivered (requeued/merged) window is idempotent
+    eng.observe_steps("n0", "migration", [
+        _step(2, "n0", 0.1), _step(4, "n0", 0.1),
+    ])
+    assert eng.steps_ingested == 4
+    assert eng.duplicates_dropped == 1
+    # same seq from a DIFFERENT check is its own stream
+    eng.observe_steps("n0", "serve", [_step(2, "n0", 0.1)])
+    assert eng.steps_ingested == 5
+    # malformed entries count as rejections, not crashes
+    eng.observe_steps("n0", "migration", [{"step_seq": "x"}, _step(9, "n0", 0.1)])
+    assert eng.windows_rejected == 1
+    assert eng.steps_ingested == 6
+
+
+def test_observe_push_routes_steps_and_honors_enabled():
+    eng = _engine()
+    eng.observe_push("n0", {
+        "train": {"counters": {}, "steps": [_step(1, "n0", 0.2)]},
+        "other": {"counters": {"tpu_workload_mfu": 0.5}},
+    })
+    assert eng.steps_ingested == 1
+    eng.enabled = False
+    eng.observe_push("n0", {"train": {"steps": [_step(2, "n0", 0.2)]}})
+    assert eng.steps_ingested == 1
+
+
+# ----------------------------------------------------------------------
+# operator side: detection
+
+
+def _feed_barrier(eng, seq, slow_wall=0.0, base=0.10):
+    """One lock-step barrier for slice train-a: both hosts show the SAME
+    wall (the barrier converges them) but the victim's extra work shows
+    up as the peer's collective-wait."""
+    wall = base + slow_wall
+    eng.observe_steps("n0", "migration",
+                      [_step(seq, "n0", wall, cw=0.0, compute=wall)])
+    eng.observe_steps("n1", "migration",
+                      [_step(seq, "n1", wall, cw=slow_wall, compute=base)])
+
+
+def test_straggler_fires_on_sustained_work_skew_and_recovers():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    # two skewed barriers: below sustained_steps=3, nothing fires
+    for seq in (1, 2):
+        _feed_barrier(eng, seq, slow_wall=0.08)
+    assert eng.evaluate() == []
+    v = eng._verdicts["train-a"]
+    # both hosts walled 0.18; work skew names n0 even though wall skew ~ 0
+    assert v["slow_host"] == "n0"
+    assert abs(v["skew_seconds"] - 0.08) < 1e-6
+    assert v["skew_ratio"] > eng.skew_ratio_threshold
+    # third consecutive barrier with the same slow host: verdict fires
+    _feed_barrier(eng, 3, slow_wall=0.08)
+    events = eng.evaluate()
+    assert [e["kind"] for e in events] == ["fired"]
+    assert events[0]["slice"] == "train-a" and events[0]["node"] == "n0"
+    assert eng.stragglers_detected_total == 1
+    assert eng.node_offenders("n0") == []   # feed_health_engine defaults OFF
+    eng.feed_health_engine = True
+    assert eng.node_offenders("n0") == ["straggler:train-a"]
+    assert eng.node_offenders("n1") == []
+    # a re-evaluation without new evidence does not re-fire
+    assert eng.evaluate() == []
+    # sustained clean barriers resolve the verdict
+    for seq in (4, 5, 6):
+        _feed_barrier(eng, seq, slow_wall=0.0)
+    events = eng.evaluate()
+    assert [e["kind"] for e in events] == ["recovered"]
+    assert events[0]["reason"] == "clean"
+    assert eng.node_offenders("n0") == []
+
+
+def test_straggler_requires_same_host_sustained():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    # alternating offender: streak resets, never fires
+    for seq in range(1, 7):
+        slow, fast = ("n0", "n1") if seq % 2 else ("n1", "n0")
+        eng.observe_steps(slow, "migration",
+                          [_step(seq, slow, 0.18, compute=0.18)])
+        eng.observe_steps(fast, "migration",
+                          [_step(seq, fast, 0.18, cw=0.08, compute=0.10)])
+    assert eng.evaluate() == []
+
+
+def test_released_slice_resolves_verdict():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    for seq in (1, 2, 3):
+        _feed_barrier(eng, seq, slow_wall=0.08)
+    assert [e["kind"] for e in eng.evaluate()] == ["fired"]
+    eng.observe_nodes([_node("n0"), _node("n1")])   # grant released
+    events = eng.evaluate()
+    assert [e["kind"] for e in events] == ["recovered"]
+    assert events[0]["reason"] == "released"
+
+
+def test_incomplete_barrier_waits_for_grace_then_skips():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    # only one host reported seq 1; seq 2 is complete and skewed
+    eng.observe_steps("n0", "migration", [_step(1, "n0", 0.18, compute=0.18)])
+    _feed_barrier(eng, 2, slow_wall=0.08)
+    eng.evaluate()
+    # judged nothing: barrier 1 is incomplete and inside the grace window,
+    # and barrier 2 queues behind it (in-order judging)
+    assert "train-a" not in eng._verdicts
+    # past the grace window the torn barrier is skipped, seq 2 is judged
+    eng._t["now"] += prof._INCOMPLETE_GRACE_S + 1
+    eng.evaluate()
+    assert eng._verdicts["train-a"]["step_seq"] == 2
+
+
+def test_min_hosts_gate_blocks_single_host_slices():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "solo-a")])
+    for seq in (1, 2, 3):
+        eng.observe_steps("n0", "migration",
+                          [_step(seq, "n0", 0.2, compute=0.2)])
+    eng._t["now"] += prof._INCOMPLETE_GRACE_S + 1
+    assert eng.evaluate() == []
+    assert "solo-a" not in eng._verdicts
+
+
+def test_disable_resolves_active_verdicts():
+    eng = _engine()
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    for seq in (1, 2, 3):
+        _feed_barrier(eng, seq, slow_wall=0.08)
+    assert [e["kind"] for e in eng.evaluate()] == ["fired"]
+    eng.configure(ProfilingSpec(enabled=False))
+    events = eng.evaluate()
+    assert [e["kind"] for e in events] == ["recovered"]
+    assert eng.node_offenders("n0") == []
+
+
+def test_configure_from_spec_clamps():
+    eng = _engine()
+    eng.configure(ProfilingSpec(
+        enabled=True, feed_health_engine=True, skew_ratio_threshold=0.5,
+        sustained_steps=0, min_hosts=1,
+    ))
+    assert eng.feed_health_engine is True
+    assert eng.skew_ratio_threshold == 0.5
+    assert eng.sustained_steps == 1    # clamped to >= 1
+    assert eng.min_hosts == 2          # clamped to >= 2
+    eng.configure(None)                # keeps prior config
+    assert eng.skew_ratio_threshold == 0.5
+
+
+# ----------------------------------------------------------------------
+# read side: snapshot + export
+
+
+class _FakeLedger:
+    def rollup(self, now):
+        return {"goodput_ratio": 0.9, "chip_utilization": 0.7}
+
+    def conservation(self, now):
+        return {"wall_chip_seconds": 1000.0}
+
+    def _carve(self):
+        return {"busy_useful": 100.0}, {}
+
+
+def test_snapshot_phases_idle_and_attribution():
+    eng = _engine(ledger=_FakeLedger())
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    for seq in (1, 2, 3):
+        _feed_barrier(eng, seq, slow_wall=0.10)  # wall 0.2, cw 0.1 on n1
+    eng.evaluate()
+    doc = eng.snapshot()
+    assert doc["enabled"] is True and doc["feed_health_engine"] is False
+    # 6 windows of wall 0.2; 3 carry cw 0.1 -> idle = 0.3/1.2 = 0.25
+    assert abs(doc["step_idle_fraction"] - 0.25) < 1e-6
+    assert doc["phases"][PHASE_COMPUTE]["count"] == 6.0
+    assert doc["phases"][PHASE_COLLECTIVE_WAIT]["count"] == 3.0
+    assert doc["phases"][PHASE_COMPILE]["count"] == 0.0
+    row = doc["slices"]["train-a"]
+    assert row["slow_host"] == "n0" and row["straggler"] is True
+    assert doc["stragglers"]["train-a"]["node"] == "n0"
+    assert doc["step_skew_ratio"] == row["skew_ratio"]
+    att = doc["attribution"]
+    assert att["busy_useful_chip_seconds"] == 100.0
+    assert abs(att["busy_useful_compute"] - 75.0) < 1e-6
+    assert abs(att["busy_useful_collective_wait"] - 25.0) < 1e-6
+    assert att["wall_chip_seconds"] == 1000.0
+    assert doc["counters"]["steps_ingested"] == 6
+
+
+def test_snapshot_window_expires_old_samples():
+    eng = _engine()
+    eng.observe_steps("n0", "train", [_step(1, "n0", 0.5, compute=0.5)])
+    eng._t["now"] += eng.window_s + 1
+    doc = eng.snapshot()
+    assert doc["phases"][PHASE_COMPUTE]["count"] == 0.0
+    assert doc["step_idle_fraction"] == 0.0
+
+
+def test_export_sets_bounded_families():
+    metrics = OperatorMetrics()
+    eng = _engine(metrics=metrics)
+    eng.observe_nodes([_node("n0", "train-a"), _node("n1", "train-a")])
+    for seq in (1, 2, 3):
+        _feed_barrier(eng, seq, slow_wall=0.08)
+    eng.evaluate()
+    eng.export()
+    eng.export()  # idempotent: the counter must not double-count
+
+    def sample(family, **labels):
+        bare = family[:-6] if family.endswith("_total") else family
+        for fam in metrics.registry.collect():
+            if fam.name == bare:
+                for s in fam.samples:
+                    if s.name == family and all(
+                        s.labels.get(k) == v for k, v in labels.items()
+                    ):
+                        return s.value
+        return None
+
+    assert sample("tpu_operator_step_phase_seconds",
+                  phase=PHASE_COMPUTE, quantile="count") == 6.0
+    assert sample("tpu_operator_step_phase_seconds",
+                  phase=PHASE_COLLECTIVE_WAIT, quantile="p50") == 0.08
+    idle = sample("tpu_operator_step_idle_fraction")
+    assert idle is not None and abs(idle - (0.24 / 1.08)) < 1e-4
+    assert sample("tpu_operator_step_skew_ratio") > 0.25
+    assert sample("tpu_operator_stragglers_detected_total") == 1.0
+    # boundedness: exactly phases x quantiles series on the phase family
+    fam = [f for f in metrics.registry.collect()
+           if f.name == "tpu_operator_step_phase_seconds"][0]
+    assert len(fam.samples) == len(STEP_PHASES) * len(prof._QUANTILE_KEYS)

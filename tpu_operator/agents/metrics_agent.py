@@ -33,6 +33,7 @@ from aiohttp import web
 from tpu_operator import consts, hw
 from tpu_operator.agents import base
 from tpu_operator.obs.fleet import JOIN_PHASES, read_json_capped
+from tpu_operator.obs.profile import MAX_STEPS_PER_PUSH, clean_steps
 from tpu_operator.obs.trace import TraceContext
 
 log = logging.getLogger("tpu_operator.metrics_agent")
@@ -212,16 +213,19 @@ class FleetForwarder:
         if not self.url:
             return
         for check, entry in workloads.items():
+            if not isinstance(entry, dict):
+                continue
             counters = {
                 k: float(v)
-                for k, v in (
-                    (entry or {}).get("counters") or {}
-                ).items()
-                if isinstance(entry, dict)
-                and k in WORKLOAD_COUNTERS
-                and isinstance(v, (int, float))
+                for k, v in ((entry or {}).get("counters") or {}).items()
+                if k in WORKLOAD_COUNTERS and isinstance(v, (int, float))
             }
-            if not counters:
+            # step-profile windows ride the same hop with the same
+            # discipline: validated shape, bounded phase vocabulary,
+            # per-check window cap (obs/profile.clean_steps is the shared
+            # gate the fleet ingest applies again)
+            steps = clean_steps(entry.get("steps"))
+            if not counters and not steps:
                 continue
             name = str(check)
             if (
@@ -231,6 +235,11 @@ class FleetForwarder:
                 continue
             live = self._pending.setdefault(name, {"counters": {}})
             live["counters"].update(counters)
+            if steps:
+                queue = live.setdefault("steps", [])
+                seen = {s["step_seq"] for s in queue}
+                queue.extend(s for s in steps if s["step_seq"] not in seen)
+                del queue[:-MAX_STEPS_PER_PUSH]
         for phase, seconds in (join_phases or {}).items():
             if phase in JOIN_PHASES and isinstance(seconds, (int, float)):
                 self._pending_join[phase] = float(seconds)
@@ -292,6 +301,15 @@ class FleetForwarder:
                     for check, entry in window.items():
                         live = self._pending.setdefault(check, {"counters": {}})
                         live["counters"] = {**entry["counters"], **live["counters"]}
+                        steps = entry.get("steps")
+                        if steps:
+                            queue = live.setdefault("steps", [])
+                            seen = {s["step_seq"] for s in queue}
+                            queue[:0] = [
+                                s for s in steps if s["step_seq"] not in seen
+                            ]
+                            queue.sort(key=lambda s: s["step_seq"])
+                            del queue[:-MAX_STEPS_PER_PUSH]
                     self._pending_join = {**join_window, **self._pending_join}
                     if trace_id and not self._pending_trace:
                         self._pending_trace = trace_id
@@ -327,7 +345,11 @@ class PushStore:
                 for k, v in (entry.get("counters") or {}).items()
                 if k in WORKLOAD_COUNTERS and isinstance(v, (int, float))
             }
-            if not counters:
+            # step-profile windows pass through the store too (bounded,
+            # shape-validated): a step-only push must still count as
+            # accepted or the fleet forward hop behind it never fires
+            steps = clean_steps(entry.get("steps"))
+            if not counters and not steps:
                 continue
             name = str(workload)
             if name not in self._entries and len(self._entries) >= self.max_workloads:
@@ -343,6 +365,11 @@ class PushStore:
             live = self._entries.setdefault(name, {"ts": now, "counters": {}})
             live["ts"] = now
             live["counters"].update(counters)
+            if steps:
+                window = live.setdefault("steps", [])
+                seen = {s["step_seq"] for s in window}
+                window.extend(s for s in steps if s["step_seq"] not in seen)
+                del window[:-MAX_STEPS_PER_PUSH]
             accepted += 1
         return accepted
 

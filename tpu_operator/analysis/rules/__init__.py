@@ -14,6 +14,7 @@ from tpu_operator.analysis.rules.exception_hygiene import ExceptionHygieneRule
 from tpu_operator.analysis.rules.fence_coverage import FenceCoverageRule
 from tpu_operator.analysis.rules.ledger_transitions import LedgerTransitionsRule
 from tpu_operator.analysis.rules.metric_labels import MetricLabelsRule
+from tpu_operator.analysis.rules.phase_coverage import PhaseCoverageRule
 from tpu_operator.analysis.rules.task_lifecycle import TaskLifecycleRule
 from tpu_operator.analysis.rules.trace_adoption import TraceAdoptionRule
 
@@ -34,4 +35,5 @@ def all_rules():
         TaskLifecycleRule(),
         EnvContractRule(),
         LedgerTransitionsRule(),
+        PhaseCoverageRule(),
     ]

@@ -30,6 +30,10 @@ DENYLIST = {
     "pod", "pod_name", "pod_uid", "uid", "name", "node", "node_name",
     "namespace", "timestamp", "ts", "time", "date", "id", "run_id",
     "span_id", "trace_id", "reconcile_id", "key", "url", "path", "le",
+    # continuous profiling plane (obs/profile.py): per-host / per-slice
+    # step evidence stays in the ProfileEngine's rings and /debug/profile;
+    # the exported rollups are bounded to {phase, quantile} by design
+    "host", "hostname", "slice", "slice_request",
 }
 
 

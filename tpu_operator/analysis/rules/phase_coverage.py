@@ -1,0 +1,134 @@
+"""phase-coverage: workload step loops must attribute their step phases.
+
+The continuous-profiling plane (``obs/profile.py``) can only attribute a
+straggler to compile vs host-input vs compute vs collective-wait if every
+step loop actually records a phase breakdown.  A workload that emits the
+legacy per-step flight sample (``flight.record(check, "step", ...)``)
+but never calls ``flight.record_step(...)`` in the same loop is a silent
+observability gap: its steps show up in the flight record but contribute
+nothing to ``/debug/profile`` — the fleet's skew and idle rollups quietly
+under-count that workload, and a straggler hiding in it is unattributable.
+
+So the rule pins the seam structurally: any function under ``workloads/``
+that records a ``"step"`` flight sample must also call
+``flight.record_step`` (the phase-attributed twin) somewhere in the same
+function.  Phase names are a BOUNDED vocabulary — the operator exports
+``tpu_operator_step_phase_seconds{phase,quantile}`` with one series per
+phase, so a typo'd or invented phase literal is flagged wherever it is
+passed (``timer.phase("…")`` / ``timer.add("…", s)`` / a literal key in
+``record_step(..., phases={...})``).  Sites that genuinely have no phase
+split to report opt out with ``# phase-ok`` on the record line, leaving a
+greppable audit trail instead of a silent gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
+from tpu_operator.obs.profile import STEP_PHASES
+
+OPT_OUT = "# phase-ok"
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+def _str_values(node: ast.AST) -> list[str]:
+    """String constants an expression can evaluate to: a literal, or both
+    arms of a conditional (the ``"compile" if i == 0 else "step"`` idiom)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _str_values(node.body) + _str_values(node.orelse)
+    return []
+
+
+def _is_step_record(call: ast.Call) -> bool:
+    """``flight.record(check, "step", ...)`` — including the conditional
+    compile/step phase argument — or ``phase="step"`` as a keyword."""
+    chain = _attr_chain(call.func)
+    if not chain or chain[-1] != "record":
+        return False
+    candidates: list[str] = []
+    if len(call.args) >= 2:
+        candidates += _str_values(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "phase" and kw.value is not None:
+            candidates += _str_values(kw.value)
+    return "step" in candidates
+
+
+def _is_record_step(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return bool(chain) and chain[-1] == "record_step"
+
+
+def _phase_literals(call: ast.Call) -> list[tuple[str, int]]:
+    """(phase literal, lineno) pairs this call asserts into the bounded
+    vocabulary: ``timer.phase("x")`` / ``timer.add("x", s)`` first args and
+    literal keys of a ``phases={...}`` keyword dict."""
+    chain = _attr_chain(call.func)
+    out: list[tuple[str, int]] = []
+    if chain and chain[-1] in ("phase", "add") and len(chain) >= 2 and call.args:
+        first = call.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append((first.value, first.lineno))
+    if chain and chain[-1] in ("record_step", "phase", "add"):
+        for kw in call.keywords:
+            if kw.arg == "phases" and isinstance(kw.value, ast.Dict):
+                for key in kw.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        out.append((key.value, key.lineno))
+    return out
+
+
+class PhaseCoverageRule(Rule):
+    name = "phase-coverage"
+    doc = "workload step loops record a bounded per-step phase breakdown"
+    paths = ("tpu_operator/workloads/",)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            step_records: list[int] = []
+            has_record_step = False
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_record_step(sub):
+                    has_record_step = True
+                elif _is_step_record(sub):
+                    step_records.append(sub.lineno)
+                for phase, lineno in _phase_literals(sub):
+                    if phase not in STEP_PHASES and not sf.line_has(lineno, OPT_OUT):
+                        yield Finding(
+                            self.name, sf.rel, lineno,
+                            f"phase {phase!r} is outside the bounded step-phase "
+                            f"vocabulary {STEP_PHASES} — the operator exports "
+                            "one series per phase, so invented phases either "
+                            "leak cardinality or vanish from the rollups; use "
+                            "an obs.profile.PHASE_* constant",
+                        )
+            if not step_records or has_record_step:
+                continue
+            for lineno in step_records:
+                if sf.line_has(lineno, OPT_OUT):
+                    continue
+                yield Finding(
+                    self.name, sf.rel, lineno,
+                    f"{node.name} records per-step flight samples without a "
+                    "flight.record_step(...) phase breakdown; its steps are "
+                    "invisible to /debug/profile's skew and idle attribution "
+                    f"— add a StepTimer + record_step or mark the line "
+                    f"{OPT_OUT!r} if there is genuinely no phase split",
+                )

@@ -529,6 +529,40 @@ class SLOSpec(SpecBase):
 
 
 @dataclass
+class ProfilingSpec(SpecBase):
+    """Continuous profiling & straggler attribution plane
+    (obs/profile.py; docs/OBSERVABILITY.md "Continuous profiling &
+    straggler attribution").
+
+    The detector compares per-host work (wall − collective-wait) across a
+    slice's member hosts at each step barrier; a skew ratio past
+    ``skewRatioThreshold`` for ``sustainedSteps`` consecutive barriers
+    fires ``StragglerDetected`` naming the slow host.  Detection is
+    always-on evidence; ACTUATION is opt-in: only with
+    ``feedHealthEngine`` does the named host feed the health engine's
+    hysteresis as a sustained ``straggler:<slice>`` signal (the SLOSpec
+    trust-boundary precedent — step windows arrive over an
+    unauthenticated route)."""
+
+    enabled: bool = True
+    # opt-in coupling to the quarantine→migrate ladder; default OFF for
+    # the same reason SLOSpec.feedHealthEngine defaults OFF
+    feed_health_engine: bool = False
+    # (max-min per-host work) / mean step wall that counts as skewed; on
+    # a healthy balanced slice this ratio idles near 0
+    skew_ratio_threshold: float = field(
+        default=0.25, metadata={"minimum": 0}
+    )
+    # consecutive skewed barriers (same slow host) before the verdict
+    # fires; recovery symmetrically needs this many clean barriers
+    sustained_steps: int = field(default=3, metadata={"minimum": 1})
+    # barriers with fewer reporting hosts are skipped, not judged — skew
+    # over a single host is meaningless
+    min_hosts: int = field(default=2, metadata={"minimum": 2})
+    extra_fields: dict = field(default_factory=dict)
+
+
+@dataclass
 class ObservabilitySpec(SpecBase):
     """Fleet telemetry plane knobs (obs/fleet.py; the reference operator has
     no analogue — observability stops at per-process Prometheus there)."""
@@ -540,6 +574,8 @@ class ObservabilitySpec(SpecBase):
         default_factory=list,
         metadata={"items_schema": SLO_ITEM_SCHEMA},
     )
+    # the continuous-profiling / straggler-attribution plane (obs/profile.py)
+    profiling: ProfilingSpec = field(default_factory=ProfilingSpec)
     extra_fields: dict = field(default_factory=dict)
 
 

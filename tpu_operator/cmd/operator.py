@@ -27,6 +27,7 @@ from tpu_operator.obs.accounting import ChipTimeLedger
 from tpu_operator.obs.events import EventRecorder
 from tpu_operator.obs.explain import ExplainEngine
 from tpu_operator.obs.fleet import FleetAggregator
+from tpu_operator.obs.profile import ProfileEngine
 from tpu_operator.obs.trace import Tracer
 from tpu_operator.version import __version__
 
@@ -104,6 +105,10 @@ async def run(args: argparse.Namespace) -> None:
     # hop folds workload evidence in, /debug/accounting reads it out
     ledger = ChipTimeLedger(metrics, fleet=fleet)
     fleet.ledger = ledger
+    # continuous profiling: the push hop folds step windows in, the leader's
+    # fleet-eval tick judges stragglers, /debug/profile reads it out
+    profile = ProfileEngine(metrics=metrics, ledger=ledger)
+    fleet.profile = profile
     tracer = Tracer(metrics, fleet=fleet)
     recorder = EventRecorder(client, namespace)
     explain = ExplainEngine(fleet=fleet, tracer=tracer)
@@ -136,6 +141,7 @@ async def run(args: argparse.Namespace) -> None:
         explain=explain,
         compile_cache=compile_cache,
         accounting=ledger,
+        profile=profile,
     )
     # in-tree controllers can never legitimately be absent: a broken module
     # must crash the operator loudly, not silently drop its controllers
@@ -148,7 +154,7 @@ async def run(args: argparse.Namespace) -> None:
 
     obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
     reconciler = ClusterPolicyReconciler(
-        client, namespace, fleet=fleet, explain=explain, **obs
+        client, namespace, fleet=fleet, explain=explain, profile=profile, **obs
     )
     # fleet-scale delta plane: per-node work hash-ring sharded across
     # in-process workers, node events enqueue only the affected key, and

@@ -71,6 +71,7 @@ class ClusterPolicyReconciler:
         recorder: Optional[EventRecorder] = None,
         fleet=None,
         explain=None,
+        profile=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -97,6 +98,10 @@ class ClusterPolicyReconciler:
         # obs.explain.ExplainEngine: fed the cached node list each pass
         # (zero API verbs) so /debug/explain narrates state transitions
         self.explain = explain
+        # obs.profile.ProfileEngine: keeps its spec knob in sync with the
+        # CR and learns the node→slice map from the same cached node list
+        # (docs/OBSERVABILITY.md "Continuous profiling")
+        self.profile = profile
         # rollout trace context per policy: name -> (spec hash, serialized
         # TraceContext), minted once per SPEC CHANGE from the reconcile
         # span observing it.  Per-pass minting would defeat the render
@@ -158,6 +163,12 @@ class ClusterPolicyReconciler:
             # same zero-API discipline: the explain timeline narrates the
             # node list this pass already holds
             self.explain.observe_nodes(nodes)
+        if self.profile is not None:
+            # spec knobs (enabled / feedHealthEngine / thresholds) from the
+            # CR in hand; node→slice membership from the slice-request
+            # label stamps on the same cached list — zero extra API verbs
+            self.profile.configure(policy.spec.observability.profiling)
+            self.profile.observe_nodes(nodes)
         ctx = await clusterinfo.gather(self.reader, self.namespace, nodes=nodes)
         ctx.traceparent = self._rollout_traceparent(policy)
         ctx.tpu_node_count = await labels.label_tpu_nodes(self.reader, policy.spec, nodes=nodes)

@@ -160,6 +160,7 @@ class HealthReconciler:
         recorder: Optional[EventRecorder] = None,
         fleet=None,
         ledger=None,
+        profile=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -168,6 +169,12 @@ class HealthReconciler:
         # — a fleet-level regression (gated workload metrics tanking on a
         # node) feeds the same hysteresis as the node-local verdicts
         self.fleet = fleet
+        # obs.profile.ProfileEngine (optional): a sustained straggler
+        # verdict naming this node feeds the same hysteresis, but ONLY
+        # when the CR opts in (observability.profiling.feedHealthEngine) —
+        # profiling evidence arrives over the unauthenticated push port,
+        # so detection→quarantine coupling is a deliberate trust decision
+        self.profile = profile
         self.metrics = metrics or OperatorMetrics()
         self.tracer = tracer or Tracer(self.metrics)
         self.recorder = recorder or EventRecorder(client, namespace)
@@ -370,6 +377,17 @@ class HealthReconciler:
                 node["metadata"]["name"]
             ):
                 observe(f"slo:{slo_name}", sustained=True)
+
+        # continuous profiling plane (obs/profile.py): a sustained
+        # straggler verdict naming this node re-asserts while the skew
+        # holds and clears when the slice goes clean again.
+        # node_offenders() itself returns [] unless the CR set
+        # observability.profiling.feedHealthEngine — same opt-in trust
+        # boundary as SLO feedHealthEngine (push-port evidence must not
+        # drive quarantine unless the operator of the cluster said so)
+        if self.profile is not None:
+            for sig in self.profile.node_offenders(node["metadata"]["name"]):
+                observe(sig, sustained=True)
 
         # Node Ready condition: the False *state* is sustained-bad; each
         # True->False transition is additionally a discrete flap event
@@ -813,6 +831,9 @@ class HealthReconciler:
             # central-signal hookup without explicit plumbing: whatever
             # aggregator the manager ended up with feeds the hysteresis
             self.fleet = mgr.fleet
+        if self.profile is None and getattr(mgr, "profile", None) is not None:
+            # same implicit hookup for the straggler plane
+            self.profile = mgr.profile
         # HIGH priority class: when queues are shared, detection/actuation
         # keys preempt bulk label sweeps (k8s/workqueue.py)
         controller = mgr.add_controller(

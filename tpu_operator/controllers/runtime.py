@@ -24,6 +24,7 @@ from tpu_operator.k8s.client import ApiClient
 from tpu_operator.k8s.informer import Informer
 from tpu_operator.k8s.leader import LeaderElector
 from tpu_operator.obs import events as obs_events
+from tpu_operator.obs import trace as obs_trace
 
 log = logging.getLogger("tpu_operator.controllers")
 
@@ -229,6 +230,7 @@ class Manager:
         fleet_eval_interval: float = consts.FLEET_EVAL_SECONDS,
         compile_cache=None,
         accounting=None,
+        profile=None,
     ):
         self.client = client
         self.namespace = namespace
@@ -268,6 +270,10 @@ class Manager:
         # its intervals advanced on the fleet-eval cadence so chip-second
         # attribution stays fresh between scheduler passes
         self.accounting = accounting
+        # obs.profile.ProfileEngine: backs /debug/profile; its straggler
+        # detector runs on the fleet-eval cadence below and its verdicts
+        # post as StragglerDetected Events through the same retry queue
+        self.profile = profile
         self.fleet_eval_interval = fleet_eval_interval
         # fleet-eval rides the shared workqueue framework as a scheduled-
         # requeue controller (cancellable + saturation-instrumented) instead
@@ -476,7 +482,19 @@ class Manager:
         invisible to the saturation gauges).  Breach/recovery transitions
         post through the same retry-until-posted Event queue as degraded
         mode — an SLOBurnRate that fires during an apiserver wobble must
-        still land as evidence."""
+        still land as evidence.  The pass runs under its own reconcile
+        span: queued Events capture the pass's reconcile id at observation
+        time (the flush happens later, from the lifecycle loop, outside
+        any span), so the kubectl evidence joins the /debug/traces pass
+        that actually saw the transition."""
+        if self.tracer is not None:
+            with self.tracer.reconcile("fleet-eval", key=key):
+                self._fleet_eval_pass()
+        else:
+            self._fleet_eval_pass()
+        return self.fleet_eval_interval
+
+    def _fleet_eval_pass(self) -> None:
         from tpu_operator.obs import events as fleet_events
 
         try:
@@ -484,7 +502,7 @@ class Manager:
                 # a standby replica keeps ingesting whatever reaches it
                 # but must not evaluate: only the leader may post
                 # SLOBurnRate evidence, or an HA pair double-fires
-                return self.fleet_eval_interval
+                return
             # offender sets BEFORE evaluation: a recovery pops its
             # offenders, and the explain timeline must still name the
             # nodes the episode was about
@@ -514,9 +532,41 @@ class Manager:
                 self.fleet.export()
             if self.accounting is not None:
                 self.accounting.export()
+            if self.profile is not None:
+                # straggler detection on the same cadence: verdict
+                # transitions post against the named NODE (the host a
+                # kubectl describe must lead to), reconcile/trace-id
+                # annotated by the recorder, explain-joinable via sink
+                for verdict in self.profile.evaluate():
+                    if verdict["kind"] == "fired":
+                        message = (
+                            f"slice {verdict['slice']}: host "
+                            f"{verdict['node']} sustained the worst step "
+                            f"skew (ratio {verdict['ratio']:.3f}, "
+                            f"{verdict['skew_s']:.3f}s at barrier "
+                            f"{verdict['step_seq']})"
+                        )
+                        self._queue_event(
+                            "warning",
+                            fleet_events.node_ref(verdict["node"]),
+                            fleet_events.REASON_STRAGGLER_DETECTED, message,
+                        )
+                        log.warning("straggler: %s", message)
+                    else:
+                        message = (
+                            f"slice {verdict['slice']}: straggler verdict "
+                            f"on host {verdict['node']} resolved "
+                            f"({verdict.get('reason', 'clean')})"
+                        )
+                        self._queue_event(
+                            "normal",
+                            fleet_events.node_ref(verdict["node"]),
+                            fleet_events.REASON_STRAGGLER_RECOVERED, message,
+                        )
+                        log.info("straggler recovered: %s", message)
+                self.profile.export()
         except Exception:  # noqa: BLE001 — telemetry cadence must not die
             log.exception("fleet evaluation pass failed")
-        return self.fleet_eval_interval
 
     def _on_leadership(self, leader: bool) -> None:
         ref = obs_events.lease_ref(self.namespace, consts.LEADER_ELECTION_ID)
@@ -534,7 +584,14 @@ class Manager:
 
     def _queue_event(self, level: str, ref: dict, reason: str, message: str) -> None:
         if self.recorder is not None:
-            self._pending_events.append((level, ref, reason, message))
+            # correlation ids captured at OBSERVATION time: the flush runs
+            # later from the lifecycle loop, outside any span, and the
+            # Event must join the reconcile pass that saw the transition,
+            # not the tick that happened to post it
+            self._pending_events.append((
+                level, ref, reason, message,
+                obs_trace.reconcile_id(), obs_trace.trace_id(),
+            ))
 
     async def _flush_events(self) -> None:
         """Post queued manager Events; keep what fails for the next tick —
@@ -543,9 +600,10 @@ class Manager:
         if self._breaker_unhealthy():
             return  # pointless while failing fast; retried after recovery
         while self._pending_events:
-            level, ref, reason, message = self._pending_events[0]
+            level, ref, reason, message, rid, tid = self._pending_events[0]
             post = self.recorder.warning if level == "warning" else self.recorder.normal
-            if await post(ref, reason, message) is None:
+            trace = {"reconcile_id": rid, "trace_id": tid} if (rid or tid) else None
+            if await post(ref, reason, message, trace=trace) is None:
                 return  # recorder swallowed a failure; retry next tick
             self._pending_events.popleft()
 
@@ -566,10 +624,13 @@ class Manager:
         health.router.add_get("/readyz", self._readyz)
         metrics = web.Application()
         metrics.router.add_get("/metrics", self._metrics)
+        metrics.router.add_get("/debug/", self._debug_index)
+        metrics.router.add_get("/debug", self._debug_index)
         metrics.router.add_get("/debug/traces", self._traces)
         metrics.router.add_get("/debug/fleet", self._fleet_snapshot)
         metrics.router.add_get("/debug/explain", self._explain)
         metrics.router.add_get("/debug/accounting", self._accounting)
+        metrics.router.add_get("/debug/profile", self._profile)
         metrics.router.add_post("/push", self._fleet_push)
         metrics.router.add_get("/compile-cache/index", self._cc_index)
         metrics.router.add_get(
@@ -583,10 +644,13 @@ class Manager:
         if self.metrics_port >= 0:
             if self.metrics_port == self.health_port and self.health_port > 0:
                 health.router.add_get("/metrics", self._metrics)
+                health.router.add_get("/debug/", self._debug_index)
+                health.router.add_get("/debug", self._debug_index)
                 health.router.add_get("/debug/traces", self._traces)
                 health.router.add_get("/debug/fleet", self._fleet_snapshot)
                 health.router.add_get("/debug/explain", self._explain)
                 health.router.add_get("/debug/accounting", self._accounting)
+                health.router.add_get("/debug/profile", self._profile)
                 health.router.add_post("/push", self._fleet_push)
                 health.router.add_get("/compile-cache/index", self._cc_index)
                 health.router.add_get(
@@ -705,6 +769,56 @@ class Manager:
                 {"error": "chip-time accounting not enabled"}, status=404
             )
         return web.json_response(self.accounting.snapshot())
+
+    async def _profile(self, request: web.Request) -> web.Response:
+        """Step-phase rollups, per-slice straggler verdicts, and the
+        MFU/idle attribution join against the chip-time ledger
+        (obs/profile.py; docs/OBSERVABILITY.md "Continuous profiling &
+        straggler attribution")."""
+        if self.profile is None:
+            return web.json_response(
+                {"error": "profiling plane not enabled"}, status=404
+            )
+        return web.json_response(self.profile.snapshot())
+
+    async def _debug_index(self, request: web.Request) -> web.Response:
+        """The debug surface's front door: every /debug/* endpoint with a
+        one-line schema, plus whether its backing engine is enabled in
+        THIS process — the endpoints were undiscoverable except via docs."""
+        endpoints = {
+            "/debug/traces": {
+                "enabled": self.tracer is not None,
+                "schema": "{traces: [{name, kind, reconcile_id, start_ts, "
+                          "duration_s, attrs?, error?, children?}]} — "
+                          "?reconcile_id= / ?trace_id= / ?controller= / "
+                          "?limit= filter, newest first",
+            },
+            "/debug/fleet": {
+                "enabled": self.fleet is not None,
+                "schema": "{ts, windows, metrics: {name: {labels, rollups, "
+                          "exemplars}}, slos} — windowed fleet rollups + "
+                          "SLO burn-rate state",
+            },
+            "/debug/explain": {
+                "enabled": self.explain is not None,
+                "schema": "{node, verdict, blocking_on, timeline: [...]} — "
+                          "?node=<name> selects; without it lists nodes",
+            },
+            "/debug/accounting": {
+                "enabled": self.accounting is not None,
+                "schema": "{ts, wall_chip_seconds, conservation_drift, "
+                          "goodput_ratio, chip_utilization, states, nodes, "
+                          "grants, transitions} — chip-time ledger",
+            },
+            "/debug/profile": {
+                "enabled": self.profile is not None,
+                "schema": "{ts, phases: {phase: quantiles}, "
+                          "step_idle_fraction, step_skew_ratio, slices, "
+                          "stragglers, attribution, counters} — step-phase "
+                          "rollups + straggler verdicts",
+            },
+        }
+        return web.json_response({"endpoints": endpoints})
 
     async def _fleet_push(self, request: web.Request) -> web.Response:
         """Fleet ingest: the hop the node metrics agents forward their

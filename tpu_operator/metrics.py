@@ -324,6 +324,36 @@ class OperatorMetrics:
             ["phase", "quantile"],
             registry=self.registry,
         )
+        # continuous profiling & straggler attribution plane
+        # (obs/profile.py; docs/OBSERVABILITY.md "Continuous profiling &
+        # straggler attribution").  Only bounded rollups export: the phase
+        # label is closed over obs.profile.STEP_PHASES (4 values) and the
+        # quantile set is fixed, so the family is 4x7 series regardless of
+        # fleet size; per-host and per-slice detail lives in
+        # GET /debug/profile only.
+        self.step_phase_seconds = Gauge(
+            "tpu_operator_step_phase_seconds",
+            "Windowed fleet rollup of per-step workload phase spans "
+            "(compile / host-input / compute / collective-wait); "
+            "quantile is p50/p90/p99/min/max/mean/count",
+            ["phase", "quantile"],
+            registry=self.registry,
+        )
+        self.step_skew_ratio = g(
+            "tpu_operator_step_skew_ratio",
+            "Worst per-slice straggler skew ratio at the newest evaluated "
+            "barrier: (max-min per-host work) / mean step wall",
+        )
+        self.step_idle_fraction = g(
+            "tpu_operator_step_idle_fraction",
+            "Fraction of windowed step wall time spent in collective-wait "
+            "fleet-wide (the learner-idle signal actor fleets scale off)",
+        )
+        self.stragglers_detected_total = c(
+            "tpu_operator_stragglers_detected_total",
+            "StragglerDetected verdicts fired by the per-slice skew "
+            "detector (sustained over the configured step threshold)",
+        )
         self.fleet_series = g(
             "tpu_operator_fleet_series",
             "Distinct (metric, labels) series currently held in the "

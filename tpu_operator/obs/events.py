@@ -67,6 +67,11 @@ REASON_SLICE_UNSCHEDULABLE = "SliceUnschedulable"
 # & SLOs"): multi-window burn-rate breach / recovery
 REASON_SLO_BURN_RATE = "SLOBurnRate"
 REASON_SLO_RECOVERED = "SLORecovered"
+# continuous profiling plane (obs/profile.py; docs/OBSERVABILITY.md
+# "Continuous profiling & straggler attribution"): a slice member host
+# sustained the worst per-barrier work skew / the slice went clean again
+REASON_STRAGGLER_DETECTED = "StragglerDetected"
+REASON_STRAGGLER_RECOVERED = "StragglerRecovered"
 # resilience surface (docs/ROBUSTNESS.md): degraded mode + leadership
 REASON_DEGRADED = "DegradedMode"
 REASON_DEGRADED_RECOVERED = "DegradedModeRecovered"
@@ -144,24 +149,36 @@ class EventRecorder:
         self.sink = None
 
     # ------------------------------------------------------------------
-    async def normal(self, involved: dict, reason: str, message: str) -> Optional[dict]:
-        return await self.event(involved, TYPE_NORMAL, reason, message)
+    async def normal(
+        self, involved: dict, reason: str, message: str,
+        trace: Optional[dict] = None,
+    ) -> Optional[dict]:
+        return await self.event(involved, TYPE_NORMAL, reason, message, trace=trace)
 
-    async def warning(self, involved: dict, reason: str, message: str) -> Optional[dict]:
-        return await self.event(involved, TYPE_WARNING, reason, message)
+    async def warning(
+        self, involved: dict, reason: str, message: str,
+        trace: Optional[dict] = None,
+    ) -> Optional[dict]:
+        return await self.event(involved, TYPE_WARNING, reason, message, trace=trace)
 
     async def event(
-        self, involved: dict, type_: str, reason: str, message: str
+        self, involved: dict, type_: str, reason: str, message: str,
+        trace: Optional[dict] = None,
     ) -> Optional[dict]:
         """Post (or count-bump) an Event.  Never raises: Events are
-        evidence for humans/alerting, not reconcile control flow."""
+        evidence for humans/alerting, not reconcile control flow.
+
+        ``trace`` carries explicit ``{"reconcile_id", "trace_id"}``
+        correlation ids for posts that happen OUTSIDE the span that
+        observed the transition (deferred queues, retry loops); it
+        overrides the ambient context read."""
         if self.sink is not None:
             try:
                 self.sink(involved, type_, reason, message)
             except Exception as e:  # noqa: BLE001
                 log.debug("event sink failed: %s", e)
         try:
-            return await self._post(involved, type_, reason, message)
+            return await self._post(involved, type_, reason, message, trace=trace)
         except Exception as e:  # noqa: BLE001
             log.warning("dropped event %s/%s: %s", type_, reason, e)
             return None
@@ -181,14 +198,15 @@ class EventRecorder:
         )
 
     async def _post(
-        self, involved: dict, type_: str, reason: str, message: str
+        self, involved: dict, type_: str, reason: str, message: str,
+        trace: Optional[dict] = None,
     ) -> Optional[dict]:
         key = self._key(involved, type_, reason, message)
         # the posting pass's correlation ids: kubectl get events -o yaml
         # joins to /debug/traces and /debug/explain through these
         trace_anns = {}
-        rid = obs_trace.reconcile_id()
-        tid = obs_trace.trace_id()
+        rid = (trace or {}).get("reconcile_id") or obs_trace.reconcile_id()
+        tid = (trace or {}).get("trace_id") or obs_trace.trace_id()
         if rid:
             trace_anns[consts.EVENT_RECONCILE_ID_ANNOTATION] = rid
         if tid:

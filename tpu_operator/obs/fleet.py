@@ -498,6 +498,7 @@ class FleetAggregator:
         ring_samples: int = consts.FLEET_RING_SAMPLES,
         max_series: int = consts.FLEET_MAX_SERIES,
         ledger=None,
+        profile=None,
     ):
         self.metrics = metrics
         self.ring_samples = ring_samples
@@ -506,6 +507,10 @@ class FleetAggregator:
         # each node's workload counters so busy evidence reaches the
         # chip-time carve without a second push endpoint
         self.ledger = ledger
+        # obs.profile.ProfileEngine (optional): ingest_push forwards each
+        # node's step-profile windows the same way, so straggler
+        # attribution rides the existing hop too
+        self.profile = profile
         # metric → labels-key → series: window scans touch only the
         # queried metric's bucket, not every series in the aggregator
         self._series: dict[str, dict[tuple, _Series]] = {}
@@ -638,7 +643,11 @@ class FleetAggregator:
             for check, entry in workloads.items():
                 counters = (entry or {}).get("counters") if isinstance(entry, dict) else None
                 if not isinstance(counters, dict):
-                    self._reject("bad-shape")
+                    # step-profile-only windows carry no counters; they are
+                    # consumed by the profile hop below, not a shape error
+                    steps = (entry or {}).get("steps") if isinstance(entry, dict) else None
+                    if not isinstance(steps, list):
+                        self._reject("bad-shape")
                     continue
                 for counter, value in counters.items():
                     labels = {"workload": str(check)}
@@ -654,6 +663,11 @@ class FleetAggregator:
                     self.ledger.observe_push(node, workloads)
                 except Exception as e:  # noqa: BLE001 — accounting must never fail a push
                     log.debug("chip-time ledger push observation failed: %s", e)
+            if self.profile is not None and node:
+                try:
+                    self.profile.observe_push(node, workloads)
+                except Exception as e:  # noqa: BLE001 — profiling must never fail a push
+                    log.debug("profile push observation failed: %s", e)
         accepted += self._ingest_join_phases(
             node, body.get("join_phases"), trace_id
         )

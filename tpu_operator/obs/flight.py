@@ -39,6 +39,7 @@ import json
 import logging
 import math
 import os
+import socket
 import threading
 import time
 import urllib.error
@@ -54,6 +55,9 @@ PUSH_ENV = "TPU_METRICS_PUSH_URL"
 
 MAX_SAMPLES = 4096  # ring bound: telemetry, not a database
 _FLUSH_EVERY = 32   # samples between best-effort JSONL rewrites
+# step-profile windows pending push, per check (obs/profile.py plane): a
+# dead agent drops the oldest windows, never blocks the step loop
+MAX_STEP_WINDOW = 64
 
 # sample metric key → canonical workload counter (agents.metrics_agent
 # WORKLOAD_COUNTERS); only mapped keys are pushed — the JSONL record keeps
@@ -145,6 +149,10 @@ class FlightRecorder:
         self.run_id = run_id or f"{os.getpid()}-{int(time.time())}"
         self.push_interval = push_interval
         self.max_samples = max_samples
+        # host identity stamped onto step-profile windows so merged or
+        # re-forwarded push bodies can never misattribute cross-host skew
+        # (NODE_NAME is the downward-API contract every workload pod gets)
+        self.host = os.environ.get("NODE_NAME", "") or socket.gethostname()
         self.samples: list[dict] = []
         self.dropped = 0
         self._unflushed = 0
@@ -155,6 +163,12 @@ class FlightRecorder:
         # blackholed agent inside a timed benchmark loop would inflate
         # every step_s by the socket timeout)
         self._pending: dict[str, dict] = {}
+        # step-profile windows pending push, per check (bounded); and the
+        # per-check monotonic step_seq high-water mark — a replayed or
+        # out-of-order record_step is dropped HERE, at the source, so no
+        # downstream hop ever has to disambiguate duplicate barriers
+        self._pending_steps: dict[str, list] = {}
+        self._step_seq_hwm: dict[str, int] = {}
         # cumulative samples per check for tpu_workload_steps_total: the
         # exposed series must be monotonic (a per-window count would read
         # as endless Prometheus counter resets)
@@ -199,6 +213,11 @@ class FlightRecorder:
             if v is not None
             and not (isinstance(v, float) and not math.isfinite(v))
         }
+        self._append(sample)
+        self._queue_push(check, sample["metrics"])
+        return sample
+
+    def _append(self, sample: dict) -> None:
         if len(self.samples) >= self.max_samples:
             # keep the newest: the tail of a long run is the evidence a
             # regression hunt needs; count what fell off the front
@@ -207,10 +226,77 @@ class FlightRecorder:
             if self._persisted > 0:
                 self._persisted -= 1
         self.samples.append(sample)
-        self._queue_push(check, sample["metrics"])
         self._unflushed += 1
         if self.path and self._unflushed >= _FLUSH_EVERY:
             self.flush()
+
+    def record_step(
+        self,
+        check: str,
+        step_seq: int,
+        wall_s: float,
+        phases: Optional[dict] = None,
+    ) -> Optional[dict]:
+        """One step-profile window: per-step wall time plus the bounded
+        phase breakdown (obs/profile.STEP_PHASES), stamped with this
+        host's identity and a per-check MONOTONIC ``step_seq`` — a replay
+        or out-of-order call is dropped at the source.  The window rides
+        the next push's ``workloads[check]["steps"]`` list and lands in
+        the operator's ProfileEngine; the JSONL record keeps it too (the
+        soaks' evidence hop reads it back from there)."""
+        from tpu_operator.obs import profile as obs_profile
+
+        try:
+            seq = int(step_seq)
+        except (TypeError, ValueError):
+            return None
+        if not isinstance(wall_s, (int, float)) or isinstance(wall_s, bool) \
+                or not math.isfinite(float(wall_s)) or float(wall_s) < 0:
+            return None
+        hwm = self._step_seq_hwm.get(check)
+        if hwm is not None and seq <= hwm:
+            return None
+        self._step_seq_hwm[check] = seq
+        entry = {
+            "step_seq": seq,
+            "host": self.host,
+            "wall_s": round(float(wall_s), 6),
+            "phases": {
+                name: round(float(v), 6)
+                for name, v in (phases or {}).items()
+                if name in obs_profile.STEP_PHASES
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+                and math.isfinite(float(v)) and float(v) >= 0.0
+            },
+        }
+        sample: dict = {
+            "ts": round(time.time(), 6),
+            "run_id": self.run_id,
+            "check": check,
+            "phase": "step-window",
+            "step": seq,
+            **entry,
+        }
+        sp = trace.current_span()
+        if sp is not None:
+            sample["span_id"] = sp.span_id
+            if sp.reconcile_id:
+                sample["reconcile_id"] = sp.reconcile_id
+        tid = (sp.trace_id if sp is not None else "") or self.trace_id
+        if tid:
+            sample["trace_id"] = tid
+        self._append(sample)
+        if self.push_url and not self._closed:
+            with self._push_lock:
+                queue = self._pending_steps.setdefault(check, [])
+                queue.append(entry)
+                del queue[:-MAX_STEP_WINDOW]
+            if self._push_thread is None:
+                self._push_thread = threading.Thread(
+                    target=self._push_loop, name="flight-push", daemon=True
+                )
+                self._push_thread.start()
+            self._push_wake.set()
         return sample
 
     def record_result(self, check: str, result: dict) -> Optional[dict]:
@@ -295,25 +381,41 @@ class FlightRecorder:
 
     def _take_pending(self) -> Optional[dict]:
         with self._push_lock:
-            if not self._pending:
+            if not self._pending and not any(self._pending_steps.values()):
                 return None
             workloads = {
                 check: {"counters": dict(counters)}
                 for check, counters in self._pending.items()
             }
+            for check, steps in self._pending_steps.items():
+                if steps:
+                    entry = workloads.setdefault(check, {"counters": {}})
+                    entry["steps"] = list(steps)
             self._pending.clear()
+            self._pending_steps.clear()
         return workloads
 
     def _requeue(self, workloads: dict) -> None:
         """Put a failed push window back so once-recorded counters (a
         compile_s) survive a transient agent outage; values recorded
-        since the take win over the failed window's."""
+        since the take win over the failed window's.  Step-profile
+        windows merge back by step_seq (live entries win), so a retried
+        POST can never deliver the same barrier twice."""
         with self._push_lock:
             for check, entry in workloads.items():
                 live = self._pending.setdefault(check, {})
-                merged = {**entry["counters"], **live}
+                merged = {**entry.get("counters", {}), **live}
                 live.clear()
                 live.update(merged)
+                steps = entry.get("steps")
+                if steps:
+                    queue = self._pending_steps.setdefault(check, [])
+                    seen = {s["step_seq"] for s in queue}
+                    queue[:0] = [
+                        s for s in steps if s["step_seq"] not in seen
+                    ]
+                    queue.sort(key=lambda s: s["step_seq"])
+                    del queue[:-MAX_STEP_WINDOW]
 
     def _push_loop(self) -> None:
         """Background push thread: drains the pending counters at most once
@@ -353,7 +455,10 @@ class FlightRecorder:
             except (urllib.error.URLError, OSError, ValueError):
                 failures += 1
                 self._requeue(workloads)
-            if self._closed and (failures or not self._pending):
+            if self._closed and (
+                failures
+                or not (self._pending or any(self._pending_steps.values()))
+            ):
                 return
             # throttle between successful pushes
             if not self._closed:
@@ -444,6 +549,17 @@ def record_result(check: str, result: dict) -> None:
     recorder = active()
     if recorder is not None:
         recorder.record_result(check, result)
+
+
+def record_step(
+    check: str, step_seq: int, wall_s: float, phases: Optional[dict] = None
+) -> None:
+    """Step-profile window on the AMBIENT recorder (no-op untracked) —
+    the per-step phase-breakdown companion to ``record()``; see
+    ``FlightRecorder.record_step``."""
+    recorder = active()
+    if recorder is not None:
+        recorder.record_step(check, step_seq, wall_s, phases=phases)
 
 
 def close_active() -> None:

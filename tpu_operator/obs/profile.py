@@ -1,0 +1,677 @@
+"""Continuous profiling & straggler attribution plane.
+
+The chip-time ledger (obs/accounting.py) says how much chip-time was
+useful; this module says where inside a step the time went and which host
+of a multi-host slice is dragging the collective.  On an ICI mesh every
+step ends at an implicit barrier: one slow host stalls every peer, the
+peers book the stall as collective-wait, and the loss is invisible to
+per-process metrics because everyone's *wall* time converges on the
+slowest host.  Attribution therefore needs per-host, per-step phase
+evidence — exactly what this plane moves:
+
+**Workload side.**  :class:`StepTimer` accumulates bounded per-step phase
+spans (``STEP_PHASES``: compile / host-input / compute / collective-wait)
+inside the existing step loops; ``flight.record_step`` stamps each step
+window with a monotonic ``step_seq`` and the host identity and ships it
+through the same agent push hop the workload counters ride (bounded
+vocabulary, like ``join_phase_seconds``).  :class:`FileStepBarrier` is the
+env-gated step barrier multi-host training loops synchronize on when the
+runtime provides no collective (CPU soaks, tests) — the wait it returns
+IS the collective-wait phase.
+
+**Operator side.**  :class:`ProfileEngine` hangs off the FleetAggregator's
+push ingest: it groups step windows per (slice, step_seq) barrier using
+the ``consts.SLICE_REQUEST_LABEL`` node stamps the scheduler already
+maintains, computes per-host **work** (wall − collective-wait), and calls
+the straggler: ``skew = max(work) − min(work)`` per barrier, slow host =
+argmax(work), ``skew_ratio = skew / mean(wall)``.  A ratio past the
+configured threshold for ``sustained_steps`` consecutive barriers fires a
+``StragglerDetected`` verdict (the Manager posts the Event); behind the
+opt-in ``feedHealthEngine`` gate the named host feeds the health engine a
+sustained ``straggler:<slice>`` signal so detection can drive the
+existing quarantine→migrate ladder.
+
+Exports stay bounded: ``tpu_operator_step_phase_seconds{phase,quantile}``
+(4×7 series), ``step_skew_ratio`` / ``step_idle_fraction`` headline
+gauges, and a stragglers counter.  Per-host and per-slice detail lives
+only in the ``GET /debug/profile`` document, which also splits the
+ledger's ``busy_useful`` into compute vs collective-wait —
+``step_idle_fraction`` is the signal ROADMAP item 4 (Podracer-style RL
+fleets) scales actor counts off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import time
+from collections import OrderedDict, deque
+from typing import Iterable, Iterator, Optional
+
+from tpu_operator import consts
+from tpu_operator.utils import deep_get
+
+# The bounded per-step phase vocabulary (the ONLY phase label values that
+# may reach Prometheus; the metric-labels lint and the agent hop both pin
+# membership here).
+PHASE_COMPILE = "compile"
+PHASE_HOST_INPUT = "host-input"
+PHASE_COMPUTE = "compute"
+PHASE_COLLECTIVE_WAIT = "collective-wait"
+
+STEP_PHASES = (
+    PHASE_COMPILE,
+    PHASE_HOST_INPUT,
+    PHASE_COMPUTE,
+    PHASE_COLLECTIVE_WAIT,
+)
+
+# environment contract for the file step barrier (bench.py straggler soak,
+# multi-host CPU training pods sharing a filesystem)
+BARRIER_DIR_ENV = "TPU_STEP_BARRIER_DIR"
+BARRIER_WORLD_ENV = "TPU_STEP_BARRIER_WORLD"
+BARRIER_RANK_ENV = "TPU_STEP_BARRIER_RANK"
+BARRIER_TIMEOUT_ENV = "TPU_STEP_BARRIER_TIMEOUT_S"
+
+# step windows per check per push (agent-side cap mirrors this)
+MAX_STEPS_PER_PUSH = 128
+
+# barrier markers each rank keeps behind its own head: the catch-up
+# budget for a member restored from a checkpoint while its peers
+# free-ran (markers are one tiny file each, GC'd as the rank advances)
+REPLAY_WINDOW_STEPS = 8192
+
+_QUANTILE_KEYS = ("p50", "p90", "p99", "min", "max", "mean", "count")
+
+_PHASE_RING = 2048          # per-phase sample ring (fleet-wide)
+_BARRIERS_PER_SLICE = 128   # retained step_seqs per slice
+_HOSTS_PER_BARRIER = 64     # hosts tracked per (slice, step_seq)
+_SEEN_PER_SOURCE = 512      # dedup ring per (node, check)
+_INCOMPLETE_GRACE_S = 30.0  # how long a barrier may wait for late hosts
+
+
+def _quantile(ordered: list, q: float) -> float:
+    """Linear-interpolation quantile over an ASCENDING list (the
+    obs/fleet.quantile contract, duplicated here so obs/profile stays
+    import-free of the aggregator)."""
+    if not ordered:
+        return 0.0
+    if len(ordered) == 1:
+        return float(ordered[0])
+    pos = q * (len(ordered) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = pos - lo
+    return float(ordered[lo]) * (1 - frac) + float(ordered[hi]) * frac
+
+
+def _roll(values: Iterable[float]) -> dict:
+    ordered = sorted(float(v) for v in values)
+    if not ordered:
+        return {k: 0.0 for k in _QUANTILE_KEYS}
+    return {
+        "p50": round(_quantile(ordered, 0.50), 6),
+        "p90": round(_quantile(ordered, 0.90), 6),
+        "p99": round(_quantile(ordered, 0.99), 6),
+        "min": round(ordered[0], 6),
+        "max": round(ordered[-1], 6),
+        "mean": round(sum(ordered) / len(ordered), 6),
+        "count": float(len(ordered)),
+    }
+
+
+def _finite(value) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    v = float(value)
+    if not math.isfinite(v) or v < 0.0:
+        return None
+    return v
+
+
+def clean_steps(steps, limit: int = MAX_STEPS_PER_PUSH) -> list[dict]:
+    """Validate/normalize a pushed step-window list onto the canonical
+    entry shape ``{step_seq, host, wall_s, phases}`` — the shared gate the
+    agent hop and the fleet ingest both apply, so a malformed or
+    vocabulary-busting entry is dropped at the first hop it touches."""
+    out: list[dict] = []
+    if not isinstance(steps, (list, tuple)):
+        return out
+    for entry in steps:
+        if len(out) >= limit:
+            break
+        if not isinstance(entry, dict):
+            continue
+        try:
+            seq = int(entry.get("step_seq"))
+        except (TypeError, ValueError):
+            continue
+        wall = _finite(entry.get("wall_s"))
+        if seq < 0 or wall is None:
+            continue
+        host = str(entry.get("host") or "")[:64]
+        phases = entry.get("phases") or {}
+        clean_phases: dict[str, float] = {}
+        if isinstance(phases, dict):
+            for name in STEP_PHASES:
+                v = _finite(phases.get(name))
+                if v is not None:
+                    clean_phases[name] = round(v, 6)
+        out.append({
+            "step_seq": seq,
+            "host": host,
+            "wall_s": round(wall, 6),
+            "phases": clean_phases,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# workload side
+
+
+class StepTimer:
+    """Per-step phase accumulator for workload step loops.
+
+    ``with timer.phase(PHASE_COMPUTE): ...`` adds the block's wall time to
+    the phase's span; ``spans()`` yields the bounded phase→seconds map a
+    ``flight.record_step`` window carries.  Phase names are closed over
+    ``STEP_PHASES`` — an unknown name raises immediately (at development
+    time, in the loop author's face) rather than minting unbounded label
+    values three hops downstream."""
+
+    def __init__(self):
+        self._spans: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        if name not in STEP_PHASES:
+            raise ValueError(
+                f"unknown step phase {name!r} (bounded vocabulary: {STEP_PHASES})"
+            )
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._spans[name] = (
+                self._spans.get(name, 0.0) + (time.perf_counter() - t0)
+            )
+
+    def add(self, name: str, seconds: float) -> None:
+        """Credit already-measured seconds to a phase (loops that time a
+        region themselves, e.g. a barrier wait returning its duration)."""
+        if name not in STEP_PHASES:
+            raise ValueError(
+                f"unknown step phase {name!r} (bounded vocabulary: {STEP_PHASES})"
+            )
+        v = _finite(seconds)
+        if v is not None:
+            self._spans[name] = self._spans.get(name, 0.0) + v
+
+    def spans(self) -> dict:
+        return dict(self._spans)
+
+    def reset(self) -> None:
+        self._spans.clear()
+
+
+class FileStepBarrier:
+    """Filesystem step barrier for multi-host training loops.
+
+    Emulates the per-step ICI collective sync on hosts that share a
+    filesystem (the straggler soak, CPU tests): each member writes a
+    ``step-<n>.<rank>`` marker then polls until every live rank's marker
+    exists; :meth:`wait` returns the seconds spent blocked — which IS the
+    step's collective-wait phase.  A member that exits cleanly mid-run (a
+    migrating checkpoint handler) calls :meth:`leave` so peers stop
+    waiting on it; a restored process re-joins by constructing a fresh
+    barrier (the ctor clears its own leave marker).  A dead peer that
+    never said goodbye costs at most ``timeout_s`` per step — the barrier
+    degrades to free-running, it never wedges the loop."""
+
+    def __init__(
+        self,
+        root: str,
+        world: int,
+        rank: int,
+        poll_s: float = 0.002,
+        timeout_s: float = 20.0,
+    ):
+        self.root = root
+        self.world = world
+        self.rank = rank
+        self.poll_s = poll_s
+        self.timeout_s = timeout_s
+        os.makedirs(self.root, exist_ok=True)
+        # re-join: a restored member withdraws its goodbye
+        with contextlib.suppress(OSError):
+            os.remove(self._leave_path(rank))
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FileStepBarrier"]:
+        env = os.environ if env is None else env
+        root = env.get(BARRIER_DIR_ENV, "")
+        if not root:
+            return None
+        try:
+            world = int(env.get(BARRIER_WORLD_ENV, "0"))
+            rank = int(env.get(BARRIER_RANK_ENV, "-1"))
+            timeout_s = float(env.get(BARRIER_TIMEOUT_ENV, "20") or 20)
+        except (TypeError, ValueError):
+            return None
+        if world < 2 or not 0 <= rank < world:
+            return None
+        return cls(root, world, rank, timeout_s=timeout_s)
+
+    def _marker(self, step: int, rank: int) -> str:
+        return os.path.join(self.root, f"step-{step:08d}.{rank}")
+
+    def _leave_path(self, rank: int) -> str:
+        return os.path.join(self.root, f"leave.{rank}")
+
+    def _publish(self, path: str) -> None:
+        """tmp+replace even for a marker: peers read the arrival stamp,
+        and a torn file still satisfies os.path.exists."""
+        tmp = f"{path}.tmp.{self.rank}"
+        with open(tmp, "w") as f:
+            f.write(str(round(time.time(), 6)))
+        os.replace(tmp, path)
+
+    def wait(self, step: int) -> float:
+        """Arrive at ``step``'s barrier; block until every live rank has
+        arrived (or ``timeout_s``); return the seconds spent waiting."""
+        t0 = time.perf_counter()
+        try:
+            self._publish(self._marker(step, self.rank))
+        except OSError:
+            return 0.0  # barrier storage gone: free-run, don't crash
+        deadline = t0 + self.timeout_s
+        while True:
+            arrived = 0
+            for r in range(self.world):
+                if (os.path.exists(self._marker(step, r))
+                        or os.path.exists(self._leave_path(r))):
+                    arrived += 1
+            if arrived >= self.world or time.perf_counter() >= deadline:
+                break
+            time.sleep(self.poll_s)
+        # best-effort GC of my stale markers, keeping a REPLAY WINDOW of
+        # recent steps: a member restored from a checkpoint behind its
+        # peers must find their already-published markers and catch up at
+        # full speed instead of paying timeout_s per replayed step.  The
+        # window must exceed the furthest a free-running survivor can
+        # drift during one migration (leave -> restore), else the
+        # replayer times out per step and never closes the gap.
+        with contextlib.suppress(OSError):
+            os.remove(self._marker(step - REPLAY_WINDOW_STEPS, self.rank))
+        return time.perf_counter() - t0
+
+    def leave(self) -> None:
+        """Say goodbye: peers count this rank as arrived from now on."""
+        with contextlib.suppress(OSError):
+            self._publish(self._leave_path(self.rank))
+
+
+# ---------------------------------------------------------------------------
+# operator side
+
+
+class ProfileEngine:
+    """Fleet-side step-phase aggregation + per-slice straggler detection.
+
+    Fed by ``FleetAggregator.ingest_push`` (step windows riding the
+    workload push hop) and by the clusterpolicy pass's cached node list
+    (slice membership from ``consts.SLICE_REQUEST_LABEL`` stamps — zero
+    extra API verbs).  Thread-hostile like every controller object here:
+    single asyncio loop, synchronous cheap methods."""
+
+    def __init__(self, metrics=None, ledger=None, clock=time.monotonic,
+                 window_s: float = float(consts.FLEET_WINDOWS[0])):
+        self.metrics = metrics
+        self.ledger = ledger
+        self.clock = clock
+        self.window_s = window_s
+        # config (ProfilingSpec; configure() re-syncs each reconcile pass)
+        self.enabled = True
+        self.feed_health_engine = False
+        self.skew_ratio_threshold = 0.25
+        self.sustained_steps = 3
+        self.min_hosts = 2
+        # node -> owning slice request (from node label stamps)
+        self._node_slice: dict[str, str] = {}
+        # phase -> deque[(ts, seconds)] — fleet-wide rollup rings
+        self._phase_rings: dict[str, deque] = {
+            p: deque(maxlen=_PHASE_RING) for p in STEP_PHASES
+        }
+        # (ts, wall_s, collective_wait_s) — the idle-fraction ring
+        self._wall_ring: deque = deque(maxlen=_PHASE_RING)
+        # slice -> step_seq -> host -> {wall, cw, ts}
+        self._slices: dict[str, OrderedDict] = {}
+        # (node, check) -> (set of seen seqs, eviction ring) — the
+        # out-of-order / re-delivered window dedup (satellite: step_seq +
+        # host identity make merged windows idempotent, not double-counted)
+        self._seen: dict[tuple, tuple] = {}
+        # slice -> rolling streak state for hysteresis
+        self._streaks: dict[str, dict] = {}
+        # slice -> newest evaluated verdict (snapshot surface)
+        self._verdicts: dict[str, dict] = {}
+        # slice -> active straggler {node, ratio, skew_s, step_seq, since}
+        self._active: dict[str, dict] = {}
+        self._eval_hwm: dict[str, int] = {}
+        self.steps_ingested = 0
+        self.duplicates_dropped = 0
+        self.windows_rejected = 0
+        self.stragglers_detected_total = 0
+        self._exported_stragglers = 0
+
+    # -- config --------------------------------------------------------
+    def configure(self, spec) -> None:
+        """Sync knobs from the CR's observability.profiling spec (called
+        from the clusterpolicy pass; a None spec keeps defaults)."""
+        if spec is None:
+            return
+        self.enabled = bool(getattr(spec, "enabled", True))
+        self.feed_health_engine = bool(
+            getattr(spec, "feed_health_engine", False)
+        )
+        thr = _finite(getattr(spec, "skew_ratio_threshold", None))
+        if thr:
+            self.skew_ratio_threshold = thr
+        try:
+            self.sustained_steps = max(
+                1, int(getattr(spec, "sustained_steps", self.sustained_steps))
+            )
+            self.min_hosts = max(
+                2, int(getattr(spec, "min_hosts", self.min_hosts))
+            )
+        except (TypeError, ValueError):
+            pass
+
+    # -- membership ----------------------------------------------------
+    def observe_nodes(self, nodes: Iterable[dict]) -> None:
+        """Refresh node→slice membership from the cached node list the
+        clusterpolicy pass already holds (zero API verbs)."""
+        live: dict[str, str] = {}
+        for node in nodes or ():
+            name = deep_get(node, "metadata", "name", default="")
+            labels = deep_get(node, "metadata", "labels", default={}) or {}
+            owner = labels.get(consts.SLICE_REQUEST_LABEL, "")
+            if name and owner:
+                live[name] = owner
+        self._node_slice = live
+        owned = set(live.values())
+        for gone in [s for s in self._slices if s not in owned]:
+            # released slice: drop its barriers/streaks; an active verdict
+            # is resolved by evaluate() (emits the recovery event)
+            self._slices.pop(gone, None)
+            self._streaks.pop(gone, None)
+            self._eval_hwm.pop(gone, None)
+
+    # -- ingest (the push hop) -----------------------------------------
+    def observe_push(self, node: str, workloads: dict,
+                     now: Optional[float] = None) -> None:
+        """Fold one agent push's step windows (mirror of
+        ``ChipTimeLedger.observe_push``, called from the same
+        ``ingest_push`` hook)."""
+        if not self.enabled:
+            return
+        for check, payload in (workloads or {}).items():
+            steps = (payload or {}).get("steps")
+            if steps:
+                self.observe_steps(node, check, steps, now=now)
+
+    def observe_steps(self, node: str, check: str, steps,
+                      now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        entries = clean_steps(steps)
+        if len(entries) != len(steps or ()):
+            self.windows_rejected += len(steps or ()) - len(entries)
+        slice_name = self._node_slice.get(node, "")
+        seen = self._seen.get((node, check))
+        if seen is None:
+            seen = (set(), deque(maxlen=_SEEN_PER_SOURCE))
+            self._seen[(node, check)] = seen
+        seen_set, seen_ring = seen
+        for entry in entries:
+            seq = entry["step_seq"]
+            if seq in seen_set:
+                self.duplicates_dropped += 1
+                continue
+            if len(seen_ring) == seen_ring.maxlen:
+                seen_set.discard(seen_ring[0])
+            seen_ring.append(seq)
+            seen_set.add(seq)
+            self.steps_ingested += 1
+            wall = entry["wall_s"]
+            phases = entry["phases"]
+            cw = min(phases.get(PHASE_COLLECTIVE_WAIT, 0.0), wall)
+            for name, v in phases.items():
+                self._phase_rings[name].append((now, v))
+            self._wall_ring.append((now, wall, cw))
+            if not slice_name:
+                continue
+            host = entry["host"] or node
+            barriers = self._slices.setdefault(slice_name, OrderedDict())
+            row = barriers.setdefault(seq, {})
+            if len(row) < _HOSTS_PER_BARRIER:
+                row[host] = {"wall": wall, "cw": cw, "ts": now}
+            while len(barriers) > _BARRIERS_PER_SLICE:
+                barriers.popitem(last=False)
+
+    # -- the detector --------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> list[dict]:
+        """One detection pass; returns transition events for the Manager
+        to post (``kind`` fired|recovered, plus the verdict fields).
+
+        Skew is computed over per-host **work** (wall − collective-wait):
+        with a real barrier every host's wall converges on the slowest
+        host, so raw wall skew reads ~0 exactly when a straggler exists —
+        the slow host is the one doing the most work (equivalently,
+        waiting the least)."""
+        now = self.clock() if now is None else now
+        events: list[dict] = []
+        if self.enabled:
+            for slice_name, barriers in self._slices.items():
+                self._evaluate_slice(slice_name, barriers, now)
+        # resolve verdicts whose slice released/recovered
+        for slice_name in list(self._active):
+            verdict = self._active[slice_name]
+            streak = self._streaks.get(slice_name, {})
+            released = slice_name not in self._slices
+            clean = streak.get("clean", 0) >= self.sustained_steps
+            if released or clean or not self.enabled:
+                self._active.pop(slice_name)
+                if not self.enabled:
+                    # drop the streak too: a re-enable must re-earn the
+                    # sustained evidence, not re-fire off stale state
+                    self._streaks.pop(slice_name, None)
+                events.append({
+                    "kind": "recovered",
+                    "slice": slice_name,
+                    "node": verdict["node"],
+                    "ratio": streak.get("ratio", 0.0),
+                    "reason": "released" if released else "clean",
+                })
+        # fire the new ones (after recoveries so a re-fire orders sanely)
+        for slice_name, streak in self._streaks.items():
+            if (self.enabled
+                    and streak.get("count", 0) >= self.sustained_steps
+                    and slice_name not in self._active):
+                verdict = {
+                    "node": streak["host"],
+                    "ratio": round(streak["ratio"], 6),
+                    "skew_s": round(streak["skew_s"], 6),
+                    "step_seq": streak["step_seq"],
+                    "since": round(now, 3),
+                }
+                self._active[slice_name] = verdict
+                self.stragglers_detected_total += 1
+                events.append({"kind": "fired", "slice": slice_name, **verdict})
+        return events
+
+    def _evaluate_slice(self, slice_name: str, barriers: OrderedDict,
+                        now: float) -> None:
+        hwm = self._eval_hwm.get(slice_name, -1)
+        streak = self._streaks.setdefault(
+            slice_name,
+            {"host": "", "count": 0, "clean": 0, "ratio": 0.0,
+             "skew_s": 0.0, "step_seq": -1},
+        )
+        for seq in sorted(s for s in barriers if s > hwm):
+            row = barriers[seq]
+            if len(row) < self.min_hosts:
+                newest = max(r["ts"] for r in row.values())
+                if now - newest <= _INCOMPLETE_GRACE_S:
+                    # peers may still arrive; later seqs wait behind it so
+                    # barriers are judged in order
+                    break
+                self._eval_hwm[slice_name] = seq
+                continue
+            work = {
+                h: max(0.0, r["wall"] - r["cw"]) for h, r in row.items()
+            }
+            mean_wall = sum(r["wall"] for r in row.values()) / len(row)
+            slow = max(work, key=lambda h: work[h])
+            skew = work[slow] - min(work.values())
+            ratio = skew / mean_wall if mean_wall > 0 else 0.0
+            self._eval_hwm[slice_name] = seq
+            self._verdicts[slice_name] = {
+                "step_seq": seq,
+                "hosts": sorted(row),
+                "slow_host": slow,
+                "skew_seconds": round(skew, 6),
+                "skew_ratio": round(ratio, 6),
+                "mean_wall_s": round(mean_wall, 6),
+                "idle_fraction": round(
+                    sum(r["cw"] for r in row.values())
+                    / max(1e-9, sum(r["wall"] for r in row.values())),
+                    6,
+                ),
+            }
+            if ratio >= self.skew_ratio_threshold:
+                if streak["host"] == slow:
+                    streak["count"] += 1
+                else:
+                    streak.update(host=slow, count=1)
+                streak.update(
+                    clean=0, ratio=ratio, skew_s=skew, step_seq=seq
+                )
+            else:
+                streak.update(count=0, ratio=ratio, skew_s=skew,
+                              step_seq=seq)
+                streak["clean"] += 1
+
+    # -- actuation coupling (opt-in) -----------------------------------
+    def node_offenders(self, node: str) -> list[str]:
+        """Sustained health-engine signals for ``node``: one
+        ``straggler:<slice>`` per active verdict naming it as the slow
+        host.  Empty unless ``feedHealthEngine`` — fleet ingest is an
+        unauthenticated route, so detection drives actuation only when an
+        operator opted this trust boundary in (the SLOSpec precedent)."""
+        if not (self.enabled and self.feed_health_engine):
+            return []
+        return [
+            f"straggler:{slice_name}"
+            for slice_name, verdict in sorted(self._active.items())
+            if verdict.get("node") == node
+        ]
+
+    # -- read side -----------------------------------------------------
+    def _window_rollups(self, now: float) -> tuple[dict, float, float]:
+        """(per-phase rollups, idle_fraction, wall_sum) over the window."""
+        cutoff = now - self.window_s
+        phases = {}
+        for name, ring in self._phase_rings.items():
+            phases[name] = _roll(v for ts, v in ring if ts >= cutoff)
+        wall_sum = cw_sum = 0.0
+        for ts, wall, cw in self._wall_ring:
+            if ts >= cutoff:
+                wall_sum += wall
+                cw_sum += cw
+        idle = cw_sum / wall_sum if wall_sum > 0 else 0.0
+        return phases, idle, wall_sum
+
+    def skew_ratio(self) -> float:
+        """Headline gauge: the worst newest-barrier skew ratio across
+        slices (0 with no multi-host evidence)."""
+        return max(
+            (v["skew_ratio"] for v in self._verdicts.values()), default=0.0
+        )
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The ``GET /debug/profile`` document."""
+        now = self.clock() if now is None else now
+        phases, idle, wall_sum = self._window_rollups(now)
+        slices = {}
+        for slice_name, verdict in sorted(self._verdicts.items()):
+            active = self._active.get(slice_name)
+            streak = self._streaks.get(slice_name, {})
+            slices[slice_name] = {
+                **verdict,
+                "straggler": active is not None,
+                "sustained_over": streak.get("count", 0),
+                **({"detected": active} if active else {}),
+            }
+        doc = {
+            "ts": round(now, 3),
+            "enabled": self.enabled,
+            "feed_health_engine": self.feed_health_engine,
+            "window_seconds": self.window_s,
+            "skew_ratio_threshold": self.skew_ratio_threshold,
+            "sustained_steps": self.sustained_steps,
+            "phases": phases,
+            "step_idle_fraction": round(idle, 6),
+            "step_skew_ratio": round(self.skew_ratio(), 6),
+            "slices": slices,
+            "stragglers": {
+                name: dict(v) for name, v in sorted(self._active.items())
+            },
+            "counters": {
+                "steps_ingested": self.steps_ingested,
+                "duplicates_dropped": self.duplicates_dropped,
+                "windows_rejected": self.windows_rejected,
+                "stragglers_detected_total": self.stragglers_detected_total,
+            },
+        }
+        if self.ledger is not None:
+            # MFU/idle attribution against the chip-time ledger: split the
+            # carved busy_useful chip-seconds by the window's phase mix —
+            # the compute share is real progress, the collective-wait
+            # share is the straggler/topology tax inside "useful" time
+            try:
+                rollup = self.ledger.rollup(now)
+                cons = self.ledger.conservation(now)
+                states, _ = self.ledger._carve()
+                useful = states.get("busy_useful", 0.0)
+                doc["attribution"] = {
+                    "busy_useful_chip_seconds": round(useful, 6),
+                    "busy_useful_compute": round(useful * (1 - idle), 6),
+                    "busy_useful_collective_wait": round(useful * idle, 6),
+                    "goodput_ratio": rollup["goodput_ratio"],
+                    "chip_utilization": rollup["chip_utilization"],
+                    "wall_chip_seconds": cons["wall_chip_seconds"],
+                }
+            except Exception:  # noqa: BLE001 — read-side join is best-effort
+                doc["attribution"] = None
+        return doc
+
+    # -- export --------------------------------------------------------
+    def export(self, now: Optional[float] = None) -> None:
+        """Refresh the bounded Prometheus families (called from the
+        Manager's fleet-eval tick, after evaluate())."""
+        if self.metrics is None:
+            return
+        now = self.clock() if now is None else now
+        phases, idle, _ = self._window_rollups(now)
+        for name, roll in phases.items():
+            for q in _QUANTILE_KEYS:
+                self.metrics.step_phase_seconds.labels(
+                    phase=name, quantile=q
+                ).set(roll[q])
+        self.metrics.step_idle_fraction.set(round(idle, 6))
+        self.metrics.step_skew_ratio.set(round(self.skew_ratio(), 6))
+        delta = self.stragglers_detected_total - self._exported_stragglers
+        if delta > 0:
+            self.metrics.stragglers_detected_total.inc(delta)
+            self._exported_stragglers = self.stragglers_detected_total

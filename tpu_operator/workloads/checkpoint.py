@@ -54,6 +54,7 @@ import numpy as np
 
 from tpu_operator import consts
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 
 MANIFEST_VERSION = 1
 MANIFEST_NAME = "MANIFEST.json"
@@ -642,6 +643,15 @@ def run_migratable_training(
     checkpointed = resumed_from if ckpt is not None else -1
     step = start_step
     losses: list[float] = []
+    # Step-phase attribution (obs/profile.py): each step's wall time is
+    # split into compile / compute / collective-wait spans; the optional
+    # file barrier (TPU_STEP_BARRIER_DIR + WORLD/RANK) makes a multi-host
+    # slice lock-step per step, so the wait at the barrier IS the
+    # collective-wait a slow peer inflicts on this host — the evidence the
+    # straggler detector attributes from.
+    barrier = obs_profile.FileStepBarrier.from_env()
+    compiled = False
+    timer = obs_profile.StepTimer()
     while step < steps:
         if sig.requested():
             snapshot(step, final=True)
@@ -649,12 +659,28 @@ def run_migratable_training(
             if progress is not None:
                 progress({"event": "checkpointed", "step": step,
                           "trigger": "migrate-signal"})
+            if barrier is not None:
+                # tell peers this rank left on purpose — a migrating
+                # member must not wedge the survivors at the barrier
+                barrier.leave()
             break
+        timer.reset()
         t_step0 = time.perf_counter()
-        loss, params = step_fn(params, x)
+        # first executed step pays jit tracing+compilation; later steps
+        # run the cached executable — classic compile-vs-compute split
+        with timer.phase(obs_profile.PHASE_COMPUTE if compiled
+                         else obs_profile.PHASE_COMPILE):
+            loss, params = step_fn(params, x)
+        compiled = True
         losses.append(float(loss))
         step += 1
-        step_wall_s = (time.perf_counter() - t_step0) + step_sleep_s
+        if step_sleep_s:
+            # simulated per-step device work rides the compute span
+            with timer.phase(obs_profile.PHASE_COMPUTE):
+                time.sleep(step_sleep_s)
+        if barrier is not None:
+            timer.add(obs_profile.PHASE_COLLECTIVE_WAIT, barrier.wait(step))
+        step_wall_s = time.perf_counter() - t_step0
         replayed = step <= highwater_prior
         if replayed:
             replayed_steps += 1
@@ -669,13 +695,15 @@ def run_migratable_training(
             acct_useful_s=acct_useful_s,
             acct_wasted_s=acct_wasted_s,
         )
+        flight.record_step(
+            "migration", step_seq=step, wall_s=step_wall_s,
+            phases=timer.spans(),
+        )
         if ckpt_every and step % ckpt_every == 0 and step < steps:
             snapshot(step, final=False)
             checkpointed = step
             if progress is not None:
                 progress({"event": "progress", "step": step})
-        if step_sleep_s:
-            time.sleep(step_sleep_s)
 
     finite = all(math.isfinite(l) for l in losses) if losses else True
     return {

@@ -27,6 +27,7 @@ from jax.experimental import pallas as pl
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import timing
 
 
@@ -246,6 +247,11 @@ def allreduce_benchmark(
             # applies the shared floor rule
             gbps=size_bytes_per_rep * iters / raw[-1] / 1e9,
         )
+        # phase attribution: a timed all-reduce chain IS collective time
+        flight.record_step(
+            "allreduce", step_seq=rep, wall_s=raw[-1],
+            phases={obs_profile.PHASE_COLLECTIVE_WAIT: raw[-1]},
+        )
     # shared rule (workloads/timing.py): when the floor rivals the compute
     # (tiny buffers or a huge dispatch RTT) subtraction is meaningless —
     # report the unsubtracted, deflated rate and flag it so gates skip
@@ -413,6 +419,10 @@ def ring_benchmark(
             "ring", "step", step=rep, step_s=raw[-1],
             gbps=elems_per_dev * 2 * iters * n / raw[-1] / 1e9,
         )
+        flight.record_step(
+            "ring", step_seq=rep, wall_s=raw[-1],
+            phases={obs_profile.PHASE_COLLECTIVE_WAIT: raw[-1]},
+        )
     # per-hop time: iters revolutions x n pipelined hops each (n-1
     # accumulating + 1 completing)
     times, overhead_dominated = timing.subtract_floor(
@@ -563,6 +573,11 @@ def _acceptance_run(
         flight.record(
             name, "compile" if i == 0 else "step", step=i,
             step_s=now - t_step, loss=losses[-1],
+        )
+        flight.record_step(
+            name, step_seq=i, wall_s=now - t_step,
+            phases={(obs_profile.PHASE_COMPILE if i == 0
+                     else obs_profile.PHASE_COMPUTE): now - t_step},
         )
         t_step = now
     dt = time.perf_counter() - t0

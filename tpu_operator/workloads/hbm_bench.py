@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import timing
 
 
@@ -76,6 +77,10 @@ def hbm_benchmark(
         flight.record(
             "hbm", "step", step=rep, step_s=raw[-1],
             gbps=bytes_per_rep / raw[-1] / 1e9,
+        )
+        flight.record_step(
+            "hbm", step_seq=rep, wall_s=raw[-1],
+            phases={obs_profile.PHASE_COMPUTE: raw[-1]},
         )
     raw = sorted(raw)
     times, overhead_dominated = timing.subtract_floor(raw, floor)

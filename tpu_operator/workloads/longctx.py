@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import timing
 from tpu_operator.workloads.ring_attention import (
     NEG_INF,
@@ -189,6 +190,10 @@ def _amortized_time(
         raw.append(time.perf_counter() - t0)
         if name:
             flight.record(name, "step", step=rep, step_s=raw[-1])
+            flight.record_step(
+                name, step_seq=rep, wall_s=raw[-1],
+                phases={obs_profile.PHASE_COMPUTE: raw[-1]},
+            )
     times, dominated = timing.subtract_floor(raw, overhead, per=iters)
     return times, dominated, last
 

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import timing
 
 
@@ -162,6 +163,10 @@ def _time_matmul(
             # amortized, floor-unsubtracted live rate (shared-rule verdict
             # applied below; the series is a monitoring signal)
             tflops=flops_per_matmul * iters / raw[-1] / 1e12,
+        )
+        flight.record_step(
+            "matmul", step_seq=rep, wall_s=raw[-1],
+            phases={obs_profile.PHASE_COMPUTE: raw[-1]},
         )
     # shared rule (workloads/timing.py): floor-subtract per-matmul time;
     # when the floor rivals the compute, fall back to the unsubtracted,

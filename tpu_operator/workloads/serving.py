@@ -80,6 +80,7 @@ import numpy as np
 
 from tpu_operator import consts
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import checkpoint as ckpt_api
 
 # environment contract (docs/SERVING.md "Env contract")
@@ -1005,12 +1006,23 @@ def serve(
         if sig.requested():
             migrated_out = True
             break
+        # step-phase attribution (obs/profile.py): admission from the
+        # traffic model is the host-input span, the batched
+        # prefill+decode tick is compute
+        timer = obs_profile.StepTimer()
+        t_step0 = time.perf_counter()
         if now < duration_s:
-            for req in traffic.due(now):
-                engine.submit(req)
-        stats = engine.step(now)
+            with timer.phase(obs_profile.PHASE_HOST_INPUT):
+                for req in traffic.due(now):
+                    engine.submit(req)
+        with timer.phase(obs_profile.PHASE_COMPUTE):
+            stats = engine.step(now)
         metrics = engine.telemetry(now)
         flight.record(cfg.name, "step", step=engine.steps, **metrics)
+        flight.record_step(
+            cfg.name, step_seq=engine.steps,
+            wall_s=time.perf_counter() - t_step0, phases=timer.spans(),
+        )
         if progress is not None and now - last_report >= 1.0:
             last_report = now
             progress({

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_operator.obs import flight
+from tpu_operator.obs import profile as obs_profile
 from tpu_operator.workloads import timing
 
 
@@ -115,6 +116,10 @@ def train_benchmark(
             "train", "step", step=rep,
             step_s=raw[-1] / steps,
             tokens_per_sec=b * s * steps / raw[-1],
+        )
+        flight.record_step(
+            "train", step_seq=rep, wall_s=raw[-1],
+            phases={obs_profile.PHASE_COMPUTE: raw[-1]},
         )
     times, overhead_dominated = timing.subtract_floor(raw, overhead, per=steps)
     step_s = times[0]
