@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput straggler fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput preempt-soak straggler fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput straggler fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput preempt-soak straggler fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -200,6 +200,18 @@ serve-soak:
 # (docs/OBSERVABILITY.md "Chip-time accounting")
 goodput:
 	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --goodput --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# preemption-economy acceptance soak (chip-free; ~3-4 min): an
+# oversubscribed fleet where guaranteed arrivals reclaim capacity from
+# the reclaimable tier by demote-or-park, never kill — ≥1 victim
+# checkpoint-resharded onto its elastic minimum, ≥1 parked (final
+# snapshot published, arc released) and auto-resumed at the exact
+# checkpointed step once capacity returns, a whole-nodepool capacity
+# shock ridden through, preempt-vs-kill per-grant goodput gap ≥2 points,
+# conservation drift ≤1%, evictions reason=migrated only, steady-state
+# verbs back to 0 (docs/SCHEDULING.md "Preemption economy")
+preempt-soak:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --preempt --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
 
 # continuous-profiling acceptance soak (chip-free; ~2-3 min): a real
 # two-host CPU-backend training slice runs lock-step behind the file

@@ -3349,6 +3349,926 @@ def run_goodput_soak(n_nodes: int = 100, seed: int = 1) -> dict:
     return result
 
 
+PREEMPT_TIMEOUT = 420.0
+# reclaim-to-bound ceiling for a guaranteed claimant landing on reclaimed
+# capacity (checkpoint + reshard + restore of the victim rides inside it)
+PREEMPT_PLACEMENT_P99_MAX = 90.0
+
+
+async def _preempt_soak(n_nodes: int, seed: int) -> dict:
+    """The preemption-economy acceptance soak (`make preempt-soak`;
+    docs/SCHEDULING.md "Preemption economy").
+
+    An oversubscribed fleet: every arc is bound, with the reclaimable
+    tier holding the marginal capacity and running live CPU-backend
+    training jobs.  Guaranteed requests then arrive and must land inside
+    the placement ceiling by *reclaiming* — demote-or-park, never kill:
+
+    - **demote** — a guaranteed 4x4 arrival takes the big pool from a
+      reclaimable grant mid-training; the victim is checkpoint-resharded
+      onto a freed 2x4 (its elastic minimum) and keeps training;
+    - **park** — a guaranteed 2x4 arrival finds its victim nowhere to
+      shrink to; the victim's final snapshot is published, the arc is
+      released, the CR goes Parked, and it auto-resumes — restored at
+      the EXACT checkpointed step — when capacity returns;
+    - **capacity shock** — the seeded chaos actor quarantines the whole
+      big nodepool mid-soak; the displaced guaranteed grant re-places
+      when the pool recovers (the undersized mids are never reclaimed
+      for it);
+    - **kill A/B** — the same class of disruption through the kill path
+      (no handler, node loss, restart from the last periodic snapshot)
+      replays work; the chip-time ledger's per-grant goodput must show
+      the preemption economy measurably ahead.
+
+    Gated: both guaranteed claimants bound within
+    ``PREEMPT_PLACEMENT_P99_MAX``, ≥1 demotion and ≥1 park→resume at the
+    exact checkpoint step, preempt-vs-kill goodput gap ≥
+    ``GOODPUT_GAP_MIN``, conservation drift ≤ ``GOODPUT_DRIFT_MAX``,
+    evictions reason=migrated only, zero duplicate creations, and
+    steady-state verbs/pass back to 0 post-chaos.
+    """
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, SLICE_REQUEST_KIND, State,
+        TPUClusterPolicy, TPUSliceRequest,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.nodes import NodeReconciler
+    from tpu_operator.controllers.plane import NodePlane
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import ApiClient, Config, count_api_requests
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs.accounting import ChipTimeLedger
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.explain import ExplainEngine
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.testing import ChaosConfig, FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get, topology_chips
+
+    if n_nodes < 20:
+        raise SystemExit(
+            f"--preempt needs --nodes >= 20 (one 4x4 + eight 2x4 pools), "
+            f"got {n_nodes}"
+        )
+    workdir = tempfile.mkdtemp(prefix=f"preempt-{seed}-")
+    job_procs: dict[str, subprocess.Popen] = {}
+    signal_files: dict[str, str] = {}
+
+    def _train_executor(pod: dict) -> str:
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "train-job":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        spec = pod["spec"]["containers"][0]
+        env = {
+            **os.environ,
+            **{e["name"]: e.get("value", "") for e in spec.get("env", [])},
+        }
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        topo = env.get(consts.JOB_TOPOLOGY_ENV, "2x4")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={topology_chips(topo)}"
+        )
+        sig = os.path.join(workdir, f"{name}.annotations")
+        signal_files[name] = sig
+        env[consts.MIGRATE_SIGNAL_FILE_ENV] = sig
+        env["TPU_VALIDATION_ROOT"] = os.path.join(workdir, f"vroot-{name}")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_operator.workloads.checkpoint"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except OSError:
+            return "Failed"
+        job_procs[name] = proc
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return "Failed"
+        return "Succeeded" if proc.returncode == 0 else "Failed"
+
+    # capacity shock only — request faults have their own soak (`make
+    # chaos`).  Restricted to the big nodepool so the shock hits the one
+    # guaranteed grant whose shape nothing else can absorb.
+    chaos = ChaosConfig(
+        seed=seed,
+        pool_shock_interval=3.0, pool_shock_down_s=1.5,
+        pool_shock_prefix="pool-big",
+    )
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_train_executor)
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    async with FakeCluster(sim, chaos=chaos) as fc:
+        fc.chaos.stop()  # quiet until the shock phase
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        fleet = FleetAggregator(metrics)
+        ledger = ChipTimeLedger(metrics, fleet=fleet)
+        fleet.ledger = ledger  # agent pushes feed the evidence carve
+        tracer = Tracer(metrics, fleet=fleet)
+        recorder = EventRecorder(client, NS)
+        explain = ExplainEngine(fleet=fleet, tracer=tracer)
+        recorder.sink = explain.observe_event
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, recorder=recorder,
+            operator_metrics=metrics, tracer=tracer, fleet=fleet,
+            explain=explain, accounting=ledger, fleet_eval_interval=0.25,
+        )
+        obs = dict(metrics=metrics, tracer=tracer, recorder=recorder)
+        reconciler = ClusterPolicyReconciler(
+            client, NS, fleet=fleet, explain=explain, **obs
+        )
+        plane = NodePlane(
+            NodeReconciler(reconciler.reader, NS, metrics=metrics),
+            metrics=metrics, resync_seconds=20.0,
+        )
+        plane.setup(mgr)
+        reconciler.setup(mgr, plane=plane)
+        sched = SliceSchedulerReconciler(
+            client, NS, fleet=fleet, ledger=ledger, **obs
+        )
+        sched.setup(mgr)
+
+        async def _mirror_annotations() -> None:
+            pod_store = fc.store("", "pods")
+            while True:
+                for (_, name), pod in list(pod_store.objects.items()):
+                    sig = signal_files.get(name)
+                    if not sig:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    text = "".join(
+                        f'{k}="{v}"\n' for k, v in sorted(anns.items())
+                    )
+                    try:
+                        with open(sig) as f:
+                            current = f.read()
+                    except OSError:
+                        current = None
+                    if current != text:
+                        tmp = sig + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(text)
+                        os.replace(tmp, sig)
+                await asyncio.sleep(0.05)
+
+        # evidence hop collapsed in-process, same as `make goodput`: the
+        # soak reads each training pod's flight JSONL (the file the node
+        # agent tails in production) and feeds fleet.ingest_push directly
+        discovered: dict[str, dict] = {}  # pod name -> {node, vroot}
+
+        async def _evidence_poll_once() -> None:
+            pod_store = fc.store("", "pods")
+            for (_, pname), pod in list(pod_store.objects.items()):
+                labels = deep_get(pod, "metadata", "labels", default={}) or {}
+                if labels.get("app") != "train-job":
+                    continue
+                node = deep_get(pod, "spec", "nodeName", default="") or ""
+                if pname not in discovered and node:
+                    discovered[pname] = {
+                        "node": node,
+                        "vroot": os.path.join(workdir, f"vroot-{pname}"),
+                    }
+            for pname, info in discovered.items():
+                fp = os.path.join(
+                    info["vroot"], "workload-results", "flight-migration.jsonl"
+                )
+                try:
+                    with open(fp) as f:
+                        lines = f.readlines()
+                except OSError:
+                    continue  # no flush yet
+                counters: dict = {}
+                for line in lines:
+                    try:
+                        sample = json.loads(line)
+                    except ValueError:
+                        continue  # torn mid-rewrite line
+                    m = sample.get("metrics") or {}
+                    for key, counter in flight_api.COUNTER_KEYS.items():
+                        v = m.get(key)
+                        if isinstance(v, (int, float)) and not isinstance(v, bool):
+                            counters[counter] = float(v)
+                if counters:
+                    fleet.ingest_push({
+                        "node": info["node"],
+                        "workloads": {
+                            f"migration:{pname}": {"counters": counters},
+                        },
+                    })
+
+        async def _evidence_hop() -> None:
+            while True:
+                await _evidence_poll_once()
+                await asyncio.sleep(0.3)
+
+        def _max_step(
+            events, kinds=("progress", "checkpointed", "result")
+        ) -> int:
+            return max(
+                (e.get("step", 0) for e in events if e.get("event") in kinds),
+                default=0,
+            )
+
+        def _train_pods():
+            return [
+                (pname, pod)
+                for (_, pname), pod in list(fc.store("", "pods").objects.items())
+                if (deep_get(pod, "metadata", "labels", default={}) or {})
+                .get("app") == "train-job"
+            ]
+
+        def _job_env(ckpt: str, topo: str, res_file: str) -> list:
+            # longer jobs than `make goodput` (140 steps at 0.1 s): the
+            # reclaim drains must land mid-run with wide margin — the
+            # first observable step is the snapshot boundary at 50
+            env = {
+                consts.CKPT_DIR_ENV: os.path.join(workdir, ckpt),
+                consts.JOB_TOPOLOGY_ENV: topo,
+                "TPU_JOB_RESULT_FILE": res_file,
+                "TRAIN_STEPS": "140",
+                "TRAIN_STEP_SLEEP_S": "0.1",
+                "TPU_CKPT_EVERY": "25",
+            }
+            return [{"name": k, "value": v} for k, v in env.items()]
+
+        def _job_pod(name: str, node: str, env: list, handler: bool) -> dict:
+            labels = {"app": "train-job"}
+            if handler:
+                labels[consts.MIGRATE_HANDLER_LABEL] = (
+                    consts.MIGRATION_HANDLER_CHECKPOINT
+                )
+            return {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": name, "namespace": "default", "labels": labels,
+                },
+                "spec": {
+                    "nodeName": node,
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "train",
+                        "image": "train-bench:dev",
+                        "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                        "env": env,
+                    }],
+                },
+            }
+
+        async def _wait_bound(request: str, want_key: str, timeout: float = 90.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                cr = await client.get(GROUP, SLICE_REQUEST_KIND, request)
+                status = cr.get("status") or {}
+                arcs = status.get("arcs") or []
+                if status.get("phase") == "Bound" and arcs:
+                    if want_key and arcs[0]["key"] != want_key:
+                        raise AssertionError(
+                            f"{request} bound {arcs[0]['key']}, "
+                            f"want {want_key}"
+                        )
+                    return status
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"{request} never bound")
+
+        async def _wait_phase(request: str, phase: str, timeout: float = 90.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                cr = await client.get(GROUP, SLICE_REQUEST_KIND, request)
+                if (cr.get("status") or {}).get("phase") == phase:
+                    return cr.get("status") or {}
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"{request} never reached phase {phase}")
+
+        async def _wait_stamps_gone(request: str, timeout: float = 60.0):
+            t0 = time.perf_counter()
+            while True:
+                nodes = await client.list_items("", "Node")
+                stamped = [
+                    n["metadata"]["name"] for n in nodes
+                    if (deep_get(n, "metadata", "labels", default={})
+                        or {}).get(consts.SLICE_REQUEST_LABEL) == request
+                ]
+                if not stamped:
+                    return
+                if time.perf_counter() - t0 > timeout:
+                    raise TimeoutError(
+                        f"{request} stamps never GC'd: {stamped}"
+                    )
+                await asyncio.sleep(0.25)
+
+        async def _wait_event(res_file: str, kind: str, timeout: float = 120.0):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < timeout:
+                hit = next(
+                    (e for e in _read_events(res_file)
+                     if e.get("event") == kind), None,
+                )
+                if hit is not None:
+                    return hit
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"{res_file} never recorded a {kind!r} event")
+
+        async def _wait_step(res_file: str, step: int, timeout: float = 150.0):
+            t0 = time.perf_counter()
+            while _max_step(_read_events(res_file)) < step:
+                if time.perf_counter() - t0 > timeout:
+                    raise TimeoutError(
+                        f"{res_file} never reached step {step} "
+                        f"(at {_max_step(_read_events(res_file))})"
+                    )
+                await asyncio.sleep(0.25)
+
+        async def _wait_pods_succeeded(timeout: float = 240.0):
+            t0 = time.perf_counter()
+            phases: dict = {}
+            while time.perf_counter() - t0 < timeout:
+                pods = _train_pods()
+                phases = {
+                    p: deep_get(pod, "status", "phase", default="")
+                    for p, pod in pods
+                }
+                if pods and all(ph == "Succeeded" for ph in phases.values()):
+                    return
+                await asyncio.sleep(0.25)
+            raise TimeoutError(f"training pods never finished: {phases}")
+
+        mirror = asyncio.create_task(_mirror_annotations())
+        hop = asyncio.create_task(_evidence_hop())
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "migration": {"timeoutSeconds": 30},
+                    # defrag parked high: reclaim is the only mover here
+                    "scheduling": {"defragThreshold": 0.95},
+                    "remediation": {"enabled": False},
+                }).obj)
+                mids = 8
+                for h in range(4):
+                    fc.add_node(f"big-0-{h}", topology="4x4", labels={
+                        consts.GKE_NODEPOOL_LABEL: "pool-big-0",
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                    })
+                for s in range(mids):
+                    for h in range(2):
+                        fc.add_node(f"mid-{s}-{h}", topology="2x4", labels={
+                            consts.GKE_NODEPOOL_LABEL: f"pool-mid-{s}",
+                            consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                        })
+                for i in range(max(0, n_nodes - 4 - 2 * mids)):
+                    accel = (
+                        "tpu-v5p-slice" if i % 6 == 0
+                        else "tpu-v5-lite-podslice"
+                    )
+                    fc.add_node(f"small-{i}", topology="2x2", accelerator=accel)
+
+                async def _converged() -> bool:
+                    cr = await client.get(
+                        GROUP, CLUSTER_POLICY_KIND, "cluster-policy"
+                    )
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE
+                        in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > PREEMPT_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                base_url = f"http://127.0.0.1:{mgr.metrics_port}"
+
+                # -- oversubscribe: every arc bound ----------------------
+                # seven guaranteed fillers take 2x4 arcs; the reclaimable
+                # tier holds the rest — r-park the last 2x4, r-vic the
+                # whole 4x4 DESIRED with an elastic 2x4 floor: compaction
+                # never trims a grant below its desired shape, so the only
+                # way it ever vacates the big pool is demand-driven
+                # demotion (priority 10 so r-park, priority 0, is the
+                # first victim in line when nothing can shrink)
+                for s in range(1, mids):
+                    await client.create(TPUSliceRequest.new(
+                        f"blk-{s}", {"topology": "2x4"}
+                    ).obj)
+                for s in range(1, mids):
+                    await _wait_bound(f"blk-{s}", "")
+                await client.create(TPUSliceRequest.new("r-park", {
+                    "topology": "2x4", "tier": "reclaimable",
+                }).obj)
+                park_status = await _wait_bound("r-park", "")
+                park_key = park_status["arcs"][0]["key"]
+                await client.create(TPUSliceRequest.new("r-vic", {
+                    "topology": "4x4", "minTopology": "2x4",
+                    "tier": "reclaimable", "priority": 10,
+                }).obj)
+                vic_status = await _wait_bound("r-vic", "pool-big-0")
+
+                vic_res = os.path.join(workdir, "vic.jsonl")
+                park_res = os.path.join(workdir, "park.jsonl")
+                await client.create(_job_pod(
+                    "job-vic", vic_status["arcs"][0]["nodes"][0],
+                    _job_env("ckpt-vic", "4x4", vic_res), handler=True,
+                ))
+                await client.create(_job_pod(
+                    "job-park", park_status["arcs"][0]["nodes"][0],
+                    _job_env(
+                        "ckpt-park",
+                        park_status.get("grantedTopology") or "2x4",
+                        park_res,
+                    ),
+                    handler=True,
+                ))
+                await _wait_step(vic_res, 30)
+                await _wait_step(park_res, 30)
+
+                # -- phase A: guaranteed arrival -> demote ---------------
+                # free one 2x4 (the victim's elastic minimum), then ask
+                # for the whole 4x4 at guaranteed tier: the only way it
+                # lands is reclaiming r-vic off the big pool
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "blk-1")
+                await _wait_stamps_gone("blk-1")
+                t_big = time.perf_counter()
+                await client.create(TPUSliceRequest.new("g-big", {
+                    "topology": "4x4", "tier": "guaranteed",
+                }).obj)
+                t1 = time.perf_counter()
+                demoted = None
+                while time.perf_counter() - t1 < 120.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-vic")
+                    status = cr.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if (
+                        status.get("phase") == "Bound" and arcs
+                        and arcs[0]["key"] != "pool-big-0"
+                        and status.get("grantedTopology") == "2x4"
+                    ):
+                        demoted = status
+                        break
+                    await asyncio.sleep(0.25)
+                if demoted is None:
+                    raise TimeoutError("r-vic was never demoted off the big "
+                                       "pool")
+                result["vic_demoted_key"] = demoted["arcs"][0]["key"]
+                result["vic_demoted_message"] = demoted.get("message")
+                await _wait_bound("g-big", "pool-big-0", timeout=120.0)
+                latency_big = round(time.perf_counter() - t_big, 3)
+                vic_restored = await _wait_event(vic_res, "restored")
+                result["vic_resumed_from_step"] = vic_restored.get(
+                    "resumed_from_step"
+                )
+
+                # -- capacity shock: the chaos actor quarantines the big
+                # pool; g-big is displaced (released, outcome=preempted in
+                # the ledger) and re-places when the pool recovers.  The
+                # undersized mids can never host it and nothing is parked
+                # yet, so no reclaim fires — the economy only moves for
+                # capacity it can actually use.
+                fc.chaos.resume()
+                t2 = time.perf_counter()
+                while fc.chaos.report().get("pool_shock", 0) < 1:
+                    if time.perf_counter() - t2 > 60.0:
+                        raise TimeoutError("pool shock never fired")
+                    await asyncio.sleep(0.1)
+                fc.chaos.stop()
+                t3 = time.perf_counter()
+                while True:
+                    nodes = await client.list_items("", "Node")
+                    big_ok = all(
+                        (deep_get(n, "metadata", "labels", default={}) or {})
+                        .get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_OK
+                        for n in nodes
+                        if (deep_get(n, "metadata", "labels", default={})
+                            or {}).get(consts.GKE_NODEPOOL_LABEL)
+                        == "pool-big-0"
+                    )
+                    if big_ok:
+                        break
+                    if time.perf_counter() - t3 > 60.0:
+                        raise TimeoutError("big pool never recovered from "
+                                           "the shock")
+                    await asyncio.sleep(0.25)
+                await _wait_bound("g-big", "pool-big-0", timeout=120.0)
+                result["pool_shocks"] = fc.chaos.report().get("pool_shock", 0)
+
+                # -- phase B: guaranteed arrival -> park -----------------
+                # no capacity anywhere: the lowest-priority reclaimable
+                # (r-park) has nowhere to shrink to — snapshot, release,
+                # Parked
+                t_mid = time.perf_counter()
+                await client.create(TPUSliceRequest.new("g-mid", {
+                    "topology": "2x4", "tier": "guaranteed",
+                }).obj)
+                parked = await _wait_phase("r-park", "Parked", timeout=120.0)
+                result["parked_pods"] = [
+                    deep_get(p, "metadata", "name", default="")
+                    for p in parked.get("parkedPods") or []
+                ]
+                result["parked_since"] = parked.get("parkedSince")
+                await _wait_bound("g-mid", park_key, timeout=120.0)
+                latency_mid = round(time.perf_counter() - t_mid, 3)
+                park_ckpt = await _wait_event(park_res, "checkpointed")
+                step_at_park = max(
+                    park_ckpt.get("step", 0),
+                    _max_step(_read_events(park_res), kinds=("checkpointed",)),
+                )
+                result["step_at_park"] = step_at_park
+
+                # -- capacity returns: the parked request auto-resumes ---
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "g-mid")
+                t4 = time.perf_counter()
+                resumed = await _wait_bound("r-park", "", timeout=180.0)
+                result["park_resume_wait_s"] = round(
+                    time.perf_counter() - t4, 3
+                )
+                result["park_resume_key"] = resumed["arcs"][0]["key"]
+                park_restored = await _wait_event(park_res, "restored")
+                result["park_resumed_from_step"] = park_restored.get(
+                    "resumed_from_step"
+                )
+                result["park_restore_pods"] = sorted(
+                    pname for pname, _pod in _train_pods()
+                    if pname.startswith("job-park") and "-mig" in pname
+                )
+                await _wait_step(park_res, 140)
+                await _wait_step(vic_res, 140)
+                await _wait_pods_succeeded()
+                await asyncio.sleep(0.7)
+                await _evidence_poll_once()
+                await sched.reconcile("slices")
+                result["conservation_after_park"] = ledger.conservation()
+
+                # -- phase C: the kill-based A/B baseline ----------------
+                # same disruption class, no handler: node loss, restart
+                # from the last periodic snapshot, replayed steps carved
+                # to busy_wasted by the ledger.  The economy's grants
+                # retire first (their jobs are done; their ledger rows
+                # persist in the released ring) so the freed mids are the
+                # re-place landing zone and the demoted grant — below its
+                # desired shape — can never ride elastic grow back onto
+                # the big pool mid-baseline.
+                for done in ("r-vic", "r-park", "g-big"):
+                    await client.delete(GROUP, SLICE_REQUEST_KIND, done)
+                    await _wait_stamps_gone(done)
+                await client.create(TPUSliceRequest.new("r-kill", {
+                    "topology": "4x4", "minTopology": "2x4",
+                }).obj)
+                kill_status = await _wait_bound("r-kill", "pool-big-0")
+                kill_res = os.path.join(workdir, "kill.jsonl")
+                kill_node = kill_status["arcs"][0]["nodes"][0]
+                await client.create(_job_pod(
+                    "job-kill", kill_node,
+                    _job_env("ckpt-kill", "4x4", kill_res), handler=False,
+                ))
+                await _wait_step(kill_res, 30)
+                # run past the periodic snapshot so the kill lands
+                # mid-window — the replayed span is the baseline's loss
+                await asyncio.sleep(0.6)
+                result["step_at_kill"] = _max_step(_read_events(kill_res))
+                await client.patch("", "Node", kill_node, {
+                    "metadata": {"labels": {
+                        consts.TPU_HEALTH_LABEL: consts.HEALTH_UNHEALTHY,
+                    }},
+                })
+                proc = job_procs.get("job-kill")
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                await client.delete("", "Pod", "job-kill", "default")
+                t5 = time.perf_counter()
+                rebound = None
+                while time.perf_counter() - t5 < 120.0:
+                    cr = await client.get(GROUP, SLICE_REQUEST_KIND, "r-kill")
+                    status = cr.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if status.get("phase") == "Bound" and arcs and (
+                        arcs[0]["key"] != "pool-big-0"
+                    ):
+                        rebound = status
+                        break
+                    await asyncio.sleep(0.25)
+                if rebound is None:
+                    raise TimeoutError("r-kill was never re-placed after the "
+                                       "node loss")
+                await client.create(_job_pod(
+                    "job-kill-r", rebound["arcs"][0]["nodes"][0],
+                    _job_env(
+                        "ckpt-kill",
+                        rebound.get("grantedTopology") or "2x4",
+                        kill_res,
+                    ),
+                    handler=False,
+                ))
+                krestored = await _wait_event(kill_res, "restored")
+                result["kill_resumed_from_step"] = krestored.get(
+                    "resumed_from_step"
+                )
+                await _wait_step(kill_res, 140)
+                await _wait_pods_succeeded()
+                # retire the baseline grant BEFORE healing the pool: the
+                # rebound grant sits below its desired shape, and a
+                # healed-free big pool would feed an elastic-grow
+                # arm/veto cycle (its pod never opted into migration)
+                # that keeps the steady-state gate from reading zero.
+                # Its ledger row persists in the released ring.
+                await client.delete(GROUP, SLICE_REQUEST_KIND, "r-kill")
+                await _wait_stamps_gone("r-kill")
+                await client.patch("", "Node", kill_node, {
+                    "metadata": {"labels": {
+                        consts.TPU_HEALTH_LABEL: consts.HEALTH_OK,
+                    }},
+                })
+
+                # -- the ledger's verdict, over the wire -----------------
+                await asyncio.sleep(0.7)
+                await _evidence_poll_once()
+                await sched.reconcile("slices")
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base_url}/debug/accounting") as resp:
+                        acct = await resp.json()
+                grants = acct.get("grants") or {}
+                row_vic = grants.get("r-vic") or {}
+                row_park = grants.get("r-park") or {}
+                row_kill = grants.get("r-kill") or {}
+                result["conservation_drift"] = acct.get("conservation_drift")
+                result["wall_chip_seconds"] = acct.get("wall_chip_seconds")
+                result["goodput_ratio"] = acct.get("goodput_ratio")
+                result["chip_utilization"] = acct.get("chip_utilization")
+                result["goodput_vic"] = row_vic.get("goodput_ratio")
+                result["goodput_park"] = row_park.get("goodput_ratio")
+                result["goodput_kill"] = row_kill.get("goodput_ratio")
+                if (
+                    result["goodput_vic"] is not None
+                    and result["goodput_park"] is not None
+                ):
+                    result["preempt_goodput"] = round(
+                        (result["goodput_vic"] + result["goodput_park"]) / 2, 6
+                    )
+                    result["preempt_goodput_gap"] = round(
+                        result["preempt_goodput"]
+                        - (result["goodput_kill"] or 0.0), 6,
+                    )
+                result["kill_replayed_steps"] = row_kill.get("replayed_steps")
+                result["kill_busy_wasted"] = row_kill.get("busy_wasted")
+                transitions = acct.get("transitions") or []
+                result["shock_preempt_released"] = any(
+                    t.get("event") == "release" and t.get("owner") == "g-big"
+                    and t.get("outcome") == "preempted"
+                    for t in transitions
+                )
+                result["kill_preempt_released"] = any(
+                    t.get("event") == "release" and t.get("owner") == "r-kill"
+                    and t.get("outcome") == "preempted"
+                    for t in transitions
+                )
+
+                # guaranteed claimants' reclaim-to-bound latencies (soak
+                # wall clock; the histogram below is the production view)
+                result["placement_latencies_s"] = [latency_big, latency_mid]
+                result["placement_latency_p99_s"] = max(
+                    latency_big, latency_mid
+                )
+                result["reclaim_latency_p99"] = result[
+                    "placement_latency_p99_s"
+                ]
+                hist_count = 0.0
+                for fam in metrics.registry.collect():
+                    if fam.name == "tpu_operator_slice_reclaim_latency_seconds":
+                        hist_count += sum(
+                            s.value for s in fam.samples
+                            if s.name.endswith("_count")
+                        )
+                    if fam.name == "tpu_operator_parked_slices":
+                        result["parked_gauge"] = max(
+                            (s.value for s in fam.samples), default=None
+                        )
+                result["reclaim_latency_samples"] = hist_count
+                result["preemptions"] = {
+                    outcome: _counter_value(
+                        metrics, "tpu_operator_slice_preemptions",
+                        outcome=outcome,
+                    )
+                    for outcome in ("demoted", "parked", "resumed",
+                                    "reclaim-failed", "park-timeout")
+                }
+                result["slice_event_reasons"] = sorted({
+                    e.get("reason", "")
+                    for e in fc.store("", "events").objects.values()
+                    if e.get("reason", "").startswith("Slice")
+                })
+
+                # -- steady state ----------------------------------------
+                steady_requests = sched_requests = steady_writes = None
+                t6 = time.perf_counter()
+                while True:
+                    await asyncio.sleep(0.5)
+                    fc.reset_request_counts()
+                    with count_api_requests() as counter:
+                        await reconciler.reconcile("cluster-policy")
+                    policy_n = counter.n
+                    with count_api_requests() as counter:
+                        await sched.reconcile("slices")
+                    sched_n = counter.n
+                    writes = _nonlease_writes(fc)
+                    if policy_n == 0 and sched_n == 0 and writes == 0:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                    if time.perf_counter() - t6 > 90:
+                        steady_requests, sched_requests = policy_n, sched_n
+                        steady_writes = writes
+                        break
+                result["steady_requests_per_pass"] = steady_requests
+                result["steady_scheduler_requests_per_pass"] = sched_requests
+                result["steady_writes_per_pass"] = steady_writes
+        finally:
+            for task in (mirror, hop):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            await client.close()
+            for proc in job_procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+
+        result["faults_injected"] = fc.chaos.report()
+        result["evictions"] = {
+            reason: _counter_value(
+                metrics, "tpu_operator_drain_evictions",
+                controller="slicescheduler", reason=reason,
+            )
+            for reason in ("migrated", "timeout", "failed", "no-handler",
+                           "forced")
+        }
+        result["duplicate_creations"] = {
+            "/".join(k): v for k, v in fc.duplicate_creations().items()
+        }
+
+        failures = []
+        drift = result.get("conservation_drift")
+        if drift is None or drift > GOODPUT_DRIFT_MAX:
+            failures.append(
+                f"conservation drift {drift} over the "
+                f"{GOODPUT_DRIFT_MAX:.0%} invariant"
+            )
+        drift_mid = (result.get("conservation_after_park") or {}).get("drift")
+        if drift_mid is None or drift_mid > GOODPUT_DRIFT_MAX:
+            failures.append(f"conservation drifted mid-soak: {drift_mid}")
+        if not (result.get("wall_chip_seconds") or 0) > 0:
+            failures.append("ledger tracked no wall chip-seconds")
+        if result.get("preempt_goodput") is None or (
+            result.get("goodput_kill") is None
+        ):
+            failures.append(
+                f"missing per-grant goodput rows: "
+                f"vic={result.get('goodput_vic')} "
+                f"park={result.get('goodput_park')} "
+                f"kill={result.get('goodput_kill')}"
+            )
+        elif result["preempt_goodput_gap"] < GOODPUT_GAP_MIN:
+            failures.append(
+                f"kill baseline did not measurably lose: gap "
+                f"{result['preempt_goodput_gap']} < {GOODPUT_GAP_MIN} "
+                f"(preempt={result['preempt_goodput']} "
+                f"kill={result['goodput_kill']})"
+            )
+        preemptions = result.get("preemptions") or {}
+        if preemptions.get("demoted", 0) < 1:
+            failures.append("no demotion reached the preemption counter")
+        if preemptions.get("parked", 0) < 1:
+            failures.append("no park reached the preemption counter")
+        if preemptions.get("resumed", 0) < 1:
+            failures.append("no resume reached the preemption counter")
+        for outcome in ("reclaim-failed", "park-timeout"):
+            if preemptions.get(outcome, 0):
+                failures.append(
+                    f"unexpected preemption outcome {outcome}: "
+                    f"{preemptions[outcome]}"
+                )
+        if not result.get("parked_pods"):
+            failures.append(
+                "Parked status carried no restore manifest (parkedPods)"
+            )
+        if result.get("park_resumed_from_step") is None or (
+            result.get("park_resumed_from_step")
+            != result.get("step_at_park")
+        ):
+            failures.append(
+                f"parked job did not resume at the exact checkpoint step: "
+                f"resumed from {result.get('park_resumed_from_step')}, "
+                f"parked at {result.get('step_at_park')}"
+            )
+        if not result.get("park_restore_pods"):
+            failures.append("no restore pod was rebuilt from the parked "
+                            "snapshot")
+        if result.get("vic_resumed_from_step") is None:
+            failures.append("demoted job never restored from its drain "
+                            "checkpoint")
+        p99 = result.get("placement_latency_p99_s")
+        if p99 is None or p99 > PREEMPT_PLACEMENT_P99_MAX:
+            failures.append(
+                f"guaranteed placement p99 {p99}s over the "
+                f"{PREEMPT_PLACEMENT_P99_MAX}s ceiling"
+            )
+        if (result.get("reclaim_latency_samples") or 0) < 2:
+            failures.append(
+                "reclaim-latency histogram missed the claimants: "
+                f"{result.get('reclaim_latency_samples')} samples"
+            )
+        if result.get("parked_gauge") != 0:
+            failures.append(
+                f"parked_slices gauge stuck at {result.get('parked_gauge')}"
+            )
+        if (result.get("pool_shocks") or 0) < 1:
+            failures.append("the capacity-shock chaos actor never fired")
+        if not result.get("shock_preempt_released"):
+            failures.append(
+                "the pool shock's displacement is missing from the "
+                "transition log"
+            )
+        if not result.get("kill_preempt_released"):
+            failures.append(
+                "the kill baseline's preemption is missing from the "
+                "transition log"
+            )
+        if (result.get("kill_replayed_steps") or 0) < 1:
+            failures.append("the kill baseline replayed nothing — no A/B")
+        if not (result.get("kill_busy_wasted") or 0) > 0:
+            failures.append("the kill baseline's replay was not carved to "
+                            "busy_wasted")
+        for reason in ("SliceDemoted", "SliceParked", "SliceResumed"):
+            if reason not in result.get("slice_event_reasons", []):
+                failures.append(f"{reason} Event not posted")
+        if result["evictions"].get("migrated", 0) < 2:
+            failures.append(
+                "demote + park drains did not both ride the migration path"
+            )
+        for reason in ("timeout", "failed", "no-handler", "forced"):
+            if result["evictions"].get(reason, 0):
+                failures.append(
+                    f"a drain plain-evicted a workload (reason={reason})"
+                )
+        if result.get("duplicate_creations"):
+            failures.append(
+                f"duplicate creations: {result['duplicate_creations']}"
+            )
+        if result.get("steady_requests_per_pass") != 0:
+            failures.append(
+                f"steady policy requests/pass = "
+                f"{result.get('steady_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_scheduler_requests_per_pass") != 0:
+            failures.append(
+                f"steady scheduler requests/pass = "
+                f"{result.get('steady_scheduler_requests_per_pass')} (want 0)"
+            )
+        if result.get("steady_writes_per_pass") != 0:
+            failures.append(
+                f"steady writes/pass = {result.get('steady_writes_per_pass')}"
+                " (want 0)"
+            )
+        result["ok"] = not failures
+        result["failures"] = failures
+        return result
+
+
+def run_preempt_soak(n_nodes: int = 100, seed: int = 1) -> dict:
+    print(f"  preempt soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_preempt_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  preempt FAILURE: {f}", file=sys.stderr)
+    print(
+        f"  preempt soak: demote->{result.get('vic_demoted_key')} "
+        f"park@{result.get('step_at_park')}->"
+        f"resume@{result.get('park_resumed_from_step')} "
+        f"on {result.get('park_resume_key')}, goodput "
+        f"preempt {result.get('preempt_goodput')} vs "
+        f"kill {result.get('goodput_kill')} "
+        f"(gap {result.get('preempt_goodput_gap')}), "
+        f"p99 {result.get('placement_latency_p99_s')}s, "
+        f"drift {result.get('conservation_drift')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 STRAGGLER_TIMEOUT = 420.0
 # the detector must NAME the seeded slow host by this training step —
 # the "bounded number of steps" in the acceptance gate
@@ -5984,6 +6904,11 @@ def _bench_metrics(output: dict) -> dict:
     put("goodput_ratio", detail.get("goodput_ratio"))
     put("chip_utilization", detail.get("chip_utilization"))
     put("goodput_gap", detail.get("goodput_gap"))
+    # preemption-economy verdict rows (bench.py --preempt /
+    # make preempt-soak): the demote-or-park tier's per-grant goodput
+    # and the guaranteed claimants' reclaim-to-bound p99
+    put("preempt_goodput", detail.get("preempt_goodput"))
+    put("reclaim_latency_p99", detail.get("reclaim_latency_p99"))
     put("tflops", output.get("tflops") or matmul.get("tflops"))
     put("mfu", output.get("mfu") or matmul.get("mfu"))
     put("allreduce_gbps", (detail.get("allreduce") or {}).get("algbw_gbps"))
@@ -6340,6 +7265,32 @@ def main() -> None:
             "unit": "ratio",
             "goodput_migration": result.get("goodput_migration"),
             "goodput_kill": result.get("goodput_kill"),
+            "conservation_drift": result.get("conservation_drift"),
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --preempt [--nodes 100] [--seed 1]`: preemption-economy
+    # acceptance soak (CPU-backend training subprocesses) —
+    # `make preempt-soak`.  Gated: guaranteed arrivals land inside the
+    # placement ceiling by reclaiming (≥1 reclaimable victim demoted via
+    # checkpoint-reshard, ≥1 parked then auto-resumed at the exact
+    # checkpointed step), the capacity-shock chaos actor fires and the
+    # displaced grant recovers, preempt-vs-kill per-grant goodput gap ≥
+    # 2 points, conservation drift ≤1%, evictions reason=migrated only,
+    # zero duplicate creations, steady-state verbs/pass back to 0.
+    if "--preempt" in sys.argv:
+        result = run_preempt_soak(
+            n_nodes=_int_arg("--nodes", 100), seed=_int_arg("--seed", 1),
+        )
+        print(json.dumps({
+            "metric": "preempt_goodput_gap",
+            "value": result.get("preempt_goodput_gap"),
+            "unit": "ratio",
+            "preempt_goodput": result.get("preempt_goodput"),
+            "goodput_kill": result.get("goodput_kill"),
+            "reclaim_latency_p99": result.get("reclaim_latency_p99"),
             "conservation_drift": result.get("conservation_drift"),
             "ok": result["ok"],
             "detail": result,

@@ -468,6 +468,38 @@ def test_ledger_transitions_trips_on_silent_decision(tmp_path):
     assert all("ledger" in f.message for f in trips)
 
 
+def test_ledger_transitions_trips_on_silent_preemption(tmp_path):
+    """The preemption economy's demote/park/resume sites move chip-time
+    between owners: a silent slice_preemptions_total increment is a
+    finding, and note_* / # ledger-ok clear it like any other decision."""
+    res = run_on(tmp_path, {
+        "tpu_operator/controllers/slicescheduler.py": """
+            class R:
+                async def _finish_demotion(self, rec):
+                    self.metrics.slice_preemptions_total.labels(
+                        outcome="demoted").inc()
+        """,
+    }, rules=["ledger-transitions"])
+    trips = names_of(res, "ledger-transitions")
+    assert len(trips) == 1
+    assert "slice_preemptions_total" in trips[0].message
+
+    res = run_on(tmp_path, {
+        "tpu_operator/controllers/slicescheduler.py": """
+            class R:
+                async def _finish_park(self, rec):
+                    self.metrics.slice_preemptions_total.labels(
+                        outcome="parked").inc()
+                    self.ledger.note_release(rec.victim, reason="parked")
+
+                async def _expire(self, rec):
+                    self.metrics.slice_preemptions_total.labels(  # ledger-ok: parked holds no chips
+                        outcome="park-timeout").inc()
+        """,
+    }, rules=["ledger-transitions"])
+    assert not names_of(res, "ledger-transitions")
+
+
 def test_ledger_transitions_passes_with_note_or_opt_out(tmp_path):
     res = run_on(tmp_path, {
         "tpu_operator/controllers/slicescheduler.py": """

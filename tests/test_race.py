@@ -612,3 +612,104 @@ def test_interleave_deterministic_replay():
     assert first == second
     others = {run_interleaved(scenario, seed=s)[0] for s in range(12)}
     assert len(others) > 1, "shuffling produced no schedule diversity"
+
+
+# ---------------------------------------------------------------------------
+# preemption economy: defrag and reclaim must never race for one victim
+# (two drains against one pod would double-drain it — two restore pods
+# minted from one checkpoint)
+
+
+def test_defrag_and_reclaim_never_double_drain_one_victim():
+    """Whichever machine arms first owns the victim: an in-flight reclaim
+    removes the grant from the compaction candidate set, and an in-flight
+    compaction move excludes the grant from victim selection — under
+    every schedule, at most ONE of them may hold the victim and at most
+    one target arc is ever reserved for it."""
+    from tpu_operator.api.types import TPUClusterPolicy, TPUSliceRequest
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import ApiClient, Config
+    from tpu_operator.testing import FakeCluster, SimConfig
+
+    def victim_pod():
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "train-x", "namespace": "default",
+                "labels": {consts.MIGRATE_HANDLER_LABEL:
+                           consts.MIGRATION_HANDLER_CHECKPOINT},
+            },
+            "spec": {"nodeName": "big", "containers": [
+                {"name": "c", "resources": {
+                    "limits": {consts.TPU_RESOURCE: "8"}}}]},
+            # Running + migratable: any drain stays PENDING in this
+            # kubelet-less cluster, holding the race window open
+            "status": {"phase": "Running"},
+        }
+
+    async def one_order(reclaim_first: bool):
+        async with FakeCluster(SimConfig(enabled=False)) as fc:
+            fc.add_node("big", topology="2x4",
+                        accelerator="tpu-v5-lite-device")
+            client = ApiClient(Config(base_url=fc.base_url))
+            sched = SliceSchedulerReconciler(
+                client, "tpu-operator", metrics=OperatorMetrics()
+            )
+            try:
+                await client.create(TPUClusterPolicy.new(
+                    spec={"scheduling": {"defragThreshold": 0.4}}
+                ).obj)
+                await client.create(TPUSliceRequest.new("x", {
+                    "topology": "2x2", "maxTopology": "2x4",
+                    "tier": "reclaimable",
+                }).obj)
+                await sched.reconcile("slices")  # x binds the big arc
+                await client.create(victim_pod())
+                fc.add_node("free-a", topology="2x2")
+                fc.add_node("free-b", topology="2x2")
+                if reclaim_first:
+                    # the claimant arrives with the fragmentation: both
+                    # machines want x in the same pass
+                    await client.create(TPUSliceRequest.new(
+                        "claim", {"topology": "2x4"}
+                    ).obj)
+                else:
+                    # defrag arms and starts draining x FIRST; the
+                    # claimant lands mid-move
+                    await sched.reconcile("slices")
+                    await sched.reconcile("slices")
+                    assert sched._move is not None and sched._move.request == "x"
+                    await client.create(TPUSliceRequest.new(
+                        "claim", {"topology": "2x4"}
+                    ).obj)
+                for _ in range(6):
+                    await sched.reconcile("slices")
+                    move_owns = (
+                        sched._move is not None
+                        and sched._move.request == "x"
+                    )
+                    reclaim_owns = (
+                        sched._reclaim is not None
+                        and sched._reclaim.victim == "x"
+                    )
+                    assert not (move_owns and reclaim_owns), (
+                        "defrag and reclaim both drain victim x"
+                    )
+                    reserved = 0
+                    for n in ("free-a", "free-b"):
+                        node = await client.get("", "Node", n)
+                        labels = node["metadata"].get("labels") or {}
+                        if labels.get(consts.SLICE_REQUEST_LABEL) == "x":
+                            reserved += 1
+                    assert reserved <= 1, (
+                        "two target arcs reserved for one victim"
+                    )
+            finally:
+                await client.close()
+
+    async def scenario():
+        await one_order(reclaim_first=True)
+        await one_order(reclaim_first=False)
+
+    report = sweep(scenario, range(min(RACE_SEEDS, 10)), timeout=60.0)
+    assert not report.failures, report.summary()

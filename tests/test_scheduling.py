@@ -265,3 +265,110 @@ def test_plan_compaction_skips_multislice_and_unsatisfiable():
     bound = {"ms": _request("ms", "2x4", multislice=True, minTopology="2x2")}
     # ms owns two arcs (a multislice grant): never compacted
     assert scheduling.plan_compaction(arcs, bound, threshold=0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# preemption economy: scored victim selection, demote-or-park planning
+
+
+def _bound_pool(pool, topology, hosts, request_name):
+    return [
+        _node(f"{pool}-{i}", topology=topology, pool=pool,
+              labels={consts.SLICE_REQUEST_LABEL: request_name})
+        for i in range(hosts)
+    ]
+
+
+def test_victim_score_priority_then_ledger_then_fit():
+    claimant = _request("claim", "2x4")  # 8 chips, exact range
+    arcs = {a.key: a for a in scheduling.arcs_from_nodes(
+        _bound_pool("exact", "2x4", 2, "a")
+        + _bound_pool("exact2", "2x4", 2, "b")
+        + _bound_pool("big", "4x4", 4, "c")
+    )}
+    lo = _request("a", "2x4", tier="reclaimable", priority=0)
+    hi = _request("b", "2x4", tier="reclaimable", priority=5)
+    # priority dominates everything, including a worse fit and more work
+    assert scheduling.victim_score(
+        lo, arcs["big"], claimant, {"a": 1e6}
+    ) < scheduling.victim_score(hi, arcs["exact2"], claimant, {})
+    # equal priority: least useful chip-seconds at risk wins
+    b = _request("b", "2x4", tier="reclaimable", priority=0)
+    assert scheduling.victim_score(
+        b, arcs["exact2"], claimant, {"a": 100.0, "b": 1.0}
+    ) < scheduling.victim_score(lo, arcs["exact"], claimant, {"a": 100.0, "b": 1.0})
+    # equal priority and ledger: tightest freed-surplus fit wins
+    c = _request("c", "4x4", tier="reclaimable", priority=0)
+    assert scheduling.victim_score(
+        lo, arcs["exact"], claimant, {}
+    ) < scheduling.victim_score(c, arcs["big"], claimant, {})
+
+
+def test_plan_reclaim_demotes_cheapest_reclaimable():
+    nodes = (
+        _bound_pool("pool-v", "2x4", 2, "victim")
+        + _bound_pool("pool-k", "2x4", 2, "keeper")
+        + [_node("small", topology="2x2")]
+    )
+    arcs = scheduling.arcs_from_nodes(nodes)
+    bound = {
+        "victim": _request("victim", "2x4", tier="reclaimable",
+                           minTopology="2x2"),
+        "keeper": _request("keeper", "2x4"),  # guaranteed: untouchable
+    }
+    claimant = _request("claim", "2x4")
+    plan = scheduling.plan_reclaim(claimant, arcs, bound)
+    assert plan is not None and plan.victim == "victim"
+    assert plan.source.key == "pool-v"
+    # demotion target: the free 2x2 satisfies the victim's elastic floor
+    assert plan.target is not None and plan.target.key == "small"
+    assert plan.granted_topology == "2x2"
+    # a reclaimable claimant never reclaims
+    cheap = _request("claim", "2x4", tier="reclaimable")
+    assert scheduling.plan_reclaim(cheap, arcs, bound) is None
+    # exclusion (mid-move / vetoed victims) is honored
+    assert scheduling.plan_reclaim(
+        claimant, arcs, bound, exclude={"victim"}
+    ) is None
+
+
+def test_plan_reclaim_parks_when_nothing_fits_the_victim():
+    nodes = _bound_pool("pool-v", "2x4", 2, "victim")
+    arcs = scheduling.arcs_from_nodes(nodes)
+    bound = {
+        "victim": _request("victim", "2x4", tier="reclaimable",
+                           minTopology="2x2"),
+    }
+    plan = scheduling.plan_reclaim(_request("claim", "2x4"), arcs, bound)
+    assert plan is not None and plan.victim == "victim"
+    assert plan.target is None and plan.granted_topology == ""
+
+
+def test_plan_reclaim_ledger_steers_and_multislice_skipped():
+    nodes = (
+        _bound_pool("pool-a", "2x4", 2, "a")
+        + _bound_pool("pool-b", "2x4", 2, "b")
+        + _bound_pool("ms-0", "2x4", 2, "ms")
+        + _bound_pool("ms-1", "2x4", 2, "ms")
+    )
+    arcs = scheduling.arcs_from_nodes(nodes)
+    bound = {
+        "a": _request("a", "2x4", tier="reclaimable", minTopology="2x2"),
+        "b": _request("b", "2x4", tier="reclaimable", minTopology="2x2"),
+        # reclaimable but multi-arc: a demotion reshard is single-arc only
+        "ms": _request("ms", "4x8", tier="reclaimable", multislice=True,
+                       minTopology="2x4", priority=-1),
+    }
+    claimant = _request("claim", "2x4")
+    # "a" has banked far more useful work: take "b" instead
+    plan = scheduling.plan_reclaim(
+        claimant, arcs, bound, at_risk={"a": 500.0, "b": 2.0}
+    )
+    assert plan is not None and plan.victim == "b"
+
+
+def test_request_from_spec_tier_and_park_timeout():
+    r = _request("r", "2x2", tier="reclaimable", parkTimeoutSeconds=600)
+    assert r.tier == "reclaimable" and r.park_timeout_seconds == 600
+    assert _request("r", "2x2").tier == "guaranteed"
+    assert _request("r", "2x2").park_timeout_seconds == 0

@@ -388,3 +388,269 @@ async def test_steady_state_status_writes_are_zero():
             assert writes == 0, fc.request_counts
         finally:
             await client.close()
+
+
+# ---------------------------------------------------------------------------
+# preemption economy: tier admission, reclaim-by-demotion, park/resume
+
+
+def _tpu_pod(name, node, chips="8", migratable=False, phase="Running",
+             labels=None, annotations=None):
+    pod_labels = dict(labels or {})
+    if migratable:
+        pod_labels[consts.MIGRATE_HANDLER_LABEL] = (
+            consts.MIGRATION_HANDLER_CHECKPOINT
+        )
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": pod_labels,
+                     "annotations": dict(annotations or {})},
+        "spec": {"nodeName": node, "containers": [
+            {"name": "c", "resources": {
+                "limits": {consts.TPU_RESOURCE: chips}}}]},
+        "status": {"phase": phase},
+    }
+
+
+async def test_admission_rejects_bad_tier():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            try:
+                await client.create(TPUSliceRequest.new(
+                    "bad", {"topology": "2x2", "tier": "spot"}
+                ).obj)
+                raise AssertionError("admission should have rejected tier")
+            except ApiError as e:
+                assert e.status == 422 or "enum" in str(e).lower()
+        finally:
+            await client.close()
+
+
+async def test_guaranteed_reclaims_by_demoting_reclaimable():
+    """A Pending guaranteed request demotes the reclaimable grant holding
+    the only fitting arc: the victim reshards onto the small free arc and
+    the claimant takes the big one — nothing is killed."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        fc.add_node("small", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "minTopology": "2x2",
+                "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            assert (await _status(client, "victim"))["arcs"][0]["key"] == "big"
+
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms the reclaim
+            assert sched._reclaim is not None
+            assert sched._reclaim.victim == "victim"
+            status = await _status(client, "claim")
+            assert status["phase"] == SlicePhase.PENDING
+            assert "reclaiming" in status["message"]
+            await sched.reconcile("slices")  # drives the demotion
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.BOUND
+            assert victim["arcs"][0]["key"] == "small"
+            assert victim["grantedTopology"] == "2x2"
+            await sched.reconcile("slices")  # claimant lands on the freed arc
+            claim = await _status(client, "claim")
+            assert claim["phase"] == SlicePhase.BOUND
+            assert claim["arcs"][0]["key"] == "big"
+            assert "SliceDemoted" in await _reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_reclaimable_never_reclaims_and_guaranteed_never_victim():
+    """A reclaimable claimant waits; a guaranteed grant is never taken."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("holder", {
+                "topology": "2x4", "minTopology": "2x2",
+            }).obj)  # guaranteed holder
+            await sched.reconcile("slices")
+            await client.create(TPUSliceRequest.new("cheap", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await client.create(TPUSliceRequest.new("wants", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            await sched.reconcile("slices")
+            assert sched._reclaim is None
+            assert (await _status(client, "holder"))["phase"] == SlicePhase.BOUND
+            for name in ("cheap", "wants"):
+                assert (await _status(client, name))["phase"] == SlicePhase.PENDING
+        finally:
+            await client.close()
+
+
+async def test_reclaim_parks_then_resumes_with_restore_pod():
+    """No capacity fits the victim's minimum: its pod manifest is
+    captured, the CR parks, and the moment the claimant releases the arc
+    the victim resumes — re-bound with a restore pod pinned to the
+    granted node."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            # a migratable workload pod that never started running: the
+            # park drain retires it immediately, manifest captured
+            await client.create(_tpu_pod(
+                "train", "big", migratable=True, phase="Pending"
+            ))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms (no demotion target -> park)
+            assert sched._reclaim is not None and sched._reclaim.park
+            await sched.reconcile("slices")  # drives the park
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.PARKED
+            assert victim["parkedPods"][0]["metadata"]["name"] == "train"
+            assert victim["parkedSince"]
+            assert "SliceParked" in await _reasons(fc)
+            try:
+                await client.get("", "Pod", "train", "default")
+                raise AssertionError("parked pod should be retired")
+            except ApiError as e:
+                assert e.not_found
+            await sched.reconcile("slices")  # claimant binds the freed arc
+            assert (await _status(client, "claim"))["phase"] == SlicePhase.BOUND
+
+            # capacity returns: the claimant releases; the parked victim
+            # auto-resumes from its snapshot
+            await client.delete(GROUP, SLICE_REQUEST_KIND, "claim")
+            sched._parks["victim"].next_try = 0.0  # collapse the backoff
+            await sched.reconcile("slices")
+            await sched.reconcile("slices")
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.BOUND
+            assert victim["arcs"][0]["key"] == "big"
+            assert not victim.get("parkedPods")
+            restore = await client.get("", "Pod", "train-mig1", "default")
+            assert deep_get(restore, "spec", "nodeSelector",
+                            "kubernetes.io/hostname") == "big"
+            assert "SliceResumed" in await _reasons(fc)
+            assert "victim" not in sched._parks
+        finally:
+            await client.close()
+
+
+async def test_park_timeout_degrades_to_unschedulable():
+    import datetime
+
+    from tpu_operator.controllers import nodestate
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+                "parkTimeoutSeconds": 60,
+            }).obj)
+            await sched.reconcile("slices")
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            await sched.reconcile("slices")
+            assert (await _status(client, "victim"))["phase"] == SlicePhase.PARKED
+            # age the park past its ceiling
+            old = (
+                datetime.datetime.now(datetime.timezone.utc)
+                - datetime.timedelta(seconds=120)
+            ).strftime(nodestate.TS_FORMAT)
+            sched._parks["victim"].since = old
+            await sched.reconcile("slices")
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.UNSCHEDULABLE
+            assert "parkTimeoutSeconds" in victim["message"]
+            # the snapshot manifest stays reachable for manual recovery
+            assert victim["parkedPods"] == []
+            assert "victim" not in sched._parks
+            assert "victim" in sched._park_expired
+            # expired means expired: quiet cluster, no retry loop
+            fc.reset_request_counts()
+            await sched.reconcile("slices")
+            assert "victim" in sched._park_expired
+            assert (await _status(client, "victim"))["phase"] == (
+                SlicePhase.UNSCHEDULABLE
+            )
+        finally:
+            await client.close()
+
+
+async def test_reclaim_vetoed_by_non_migratable_pod():
+    """Demote-or-park, never kill: a victim pod that did not opt into
+    migration vetoes the reclaim — the claimant keeps waiting and the
+    victim is untouched."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        fc.add_node("small", topology="2x2")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "minTopology": "2x2",
+                "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            await client.create(_tpu_pod("stubborn", "big", migratable=False))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms
+            await sched.reconcile("slices")  # veto fires
+            assert sched._reclaim is None
+            assert (await _status(client, "victim"))["phase"] == SlicePhase.BOUND
+            assert (await _status(client, "victim"))["arcs"][0]["key"] == "big"
+            assert (await _status(client, "claim"))["phase"] == SlicePhase.PENDING
+            assert "SliceReclaimFailed" in await _reasons(fc)
+            assert "SliceDemoted" not in await _reasons(fc)
+            # the pod survived, un-drained
+            pod = await client.get("", "Pod", "stubborn", "default")
+            anns = deep_get(pod, "metadata", "annotations", default={}) or {}
+            assert consts.MIGRATE_ANNOTATION not in anns
+            # memoized: the identical reclaim must not re-arm immediately
+            await sched.reconcile("slices")
+            assert sched._reclaim is None
+        finally:
+            await client.close()
+
+
+def test_resume_backoff_growth_jitter_and_cap():
+    from tpu_operator.controllers.slicescheduler import (
+        PARK_RESUME_BACKOFF_CAP_SECONDS,
+        resume_backoff,
+    )
+
+    assert resume_backoff("r", 0) == 0.0
+    ladder = [resume_backoff("r", n) for n in range(1, 10)]
+    # exponential growth: each rung at least ~1.6x the last until the cap
+    for lo, hi in zip(ladder, ladder[1:]):
+        assert hi >= lo or lo > PARK_RESUME_BACKOFF_CAP_SECONDS
+    # jitter stays within +25% of the undecorated delay
+    assert 2.0 <= resume_backoff("r", 1) <= 2.0 * 1.25
+    # capped (with jitter headroom)
+    assert resume_backoff("r", 50) <= PARK_RESUME_BACKOFF_CAP_SECONDS * 1.25
+    # deterministic per (name, attempt); distinct across names
+    assert resume_backoff("r", 3) == resume_backoff("r", 3)
+    assert resume_backoff("r", 3) != resume_backoff("q", 3)

@@ -9,7 +9,10 @@ from stamps; only the drill-down lineage goes dark).
 
 So the rule pins the seams structurally: any function that increments one
 of the capacity decision counters (``slice_placements_total``,
-``drain_evictions_total``) must also call a ledger transition — a
+``drain_evictions_total``, ``slice_preemptions_total`` — the last being
+the preemption economy's demote/park/resume sites, which move chip-time
+between owners without a plain grant or eviction) must also call a
+ledger transition — a
 ``note_*`` method on an attribute chain that names ``ledger`` (e.g.
 ``self.ledger.note_grant(...)``).  Sites whose increment genuinely moves
 no chip-time (an Unschedulable warning: the request never held chips)
@@ -27,7 +30,11 @@ from tpu_operator.analysis.core import Context, Finding, Rule, SourceFile
 OPT_OUT = "# ledger-ok"
 
 # counters whose .inc() marks a capacity decision site
-DECISION_COUNTERS = ("slice_placements_total", "drain_evictions_total")
+DECISION_COUNTERS = (
+    "slice_placements_total",
+    "drain_evictions_total",
+    "slice_preemptions_total",
+)
 
 
 def _attr_chain(node: ast.AST) -> list[str]:

@@ -859,8 +859,18 @@ class SlicePhase:
     PENDING = "Pending"            # queued; no capacity granted yet
     BOUND = "Bound"                # granted: member nodes carry the label
     UNSCHEDULABLE = "Unschedulable"  # no eligible capacity can ever satisfy it
+    PARKED = "Parked"              # reclaimed: snapshot published, arc released
 
-    ALL = (PENDING, BOUND, UNSCHEDULABLE)
+    ALL = (PENDING, BOUND, UNSCHEDULABLE, PARKED)
+
+
+# TPUSliceRequest capacity tiers (spec.tier).  A guaranteed request may
+# reclaim capacity from bound reclaimable grants; a reclaimable grant is
+# demoted (checkpoint-reshard onto smaller capacity) or parked (snapshot
+# published, arc released, auto-resumed when capacity returns) — never
+# killed (docs/SCHEDULING.md "Preemption economy").
+TIER_GUARANTEED = "guaranteed"
+TIER_RECLAIMABLE = "reclaimable"
 
 
 @dataclass
@@ -877,7 +887,15 @@ class TPUSliceRequestSpec(SpecBase):
     DCN-split grant across up to ``maxSlices`` arcs when no contiguous ICI
     box is big enough — the scheduler then stamps the multislice-group
     labels the validator's cross-slice rendezvous consumes.  Higher
-    ``priority`` requests place first within a pass."""
+    ``priority`` requests place first within a pass.
+
+    ``tier`` is the preemption-economy contract: a ``guaranteed`` request
+    may reclaim capacity from bound ``reclaimable`` grants, which are
+    demoted (checkpoint-reshard down to ``minTopology``) or parked
+    (snapshot published, arc released, auto-resumed with backoff when
+    capacity returns) — never killed.  ``parkTimeoutSeconds`` bounds how
+    long a parked request waits for resume before degrading to an honest
+    ``Unschedulable`` (0 = wait forever)."""
 
     topology: str = field(default="", metadata={"pattern": TOPOLOGY_PATTERN})
     min_topology: Optional[str] = field(
@@ -891,6 +909,11 @@ class TPUSliceRequestSpec(SpecBase):
     multislice: bool = False
     max_slices: int = field(default=4, metadata={"minimum": 1})
     priority: int = 0
+    tier: str = field(
+        default=TIER_GUARANTEED,
+        metadata={"enum": [TIER_GUARANTEED, TIER_RECLAIMABLE]},
+    )
+    park_timeout_seconds: int = field(default=0, metadata={"minimum": 0})
     extra_fields: dict = field(default_factory=dict)
 
 

@@ -58,6 +58,9 @@ log = logging.getLogger("tpu_operator.migration")
 # drain_pod return statuses: the pod still holds the node only on PENDING
 PENDING = "pending"
 MIGRATED = "migrated"
+# park mode: checkpoint published, source pod retired WITHOUT a restore pod
+# — the slice scheduler holds the captured spec and re-creates it at resume
+PARKED = "parked"
 TIMEOUT = "timeout"
 FAILED = "failed"
 FORCED = "forced"
@@ -134,6 +137,7 @@ class MigrationCoordinator:
         nodes: Optional[list[dict]] = None,
         force: bool = False,
         grace_period_seconds: Optional[int] = None,
+        park: bool = False,
     ) -> str:
         """One non-blocking step of the migrate-instead-of-evict machine.
 
@@ -149,7 +153,15 @@ class MigrationCoordinator:
         node set (target selection must not cost extra API reads per pod);
         ``force`` records the drain's force semantics in the eviction
         reason; ``grace_period_seconds`` is passed through to the fallback
-        evict exactly as the historical delete did."""
+        evict exactly as the historical delete did.
+
+        ``park`` (the preemption economy's zero-capacity branch): the
+        checkpoint phase runs unchanged, but once the snapshot publishes
+        the source pod is retired WITHOUT a restore pod — the caller
+        captured the spec (``build_replacement(pod, None)``) and owns the
+        restore at resume time.  The retirement still counts as a
+        ``migrated`` drain eviction: nothing past the published snapshot
+        is lost."""
         meta = pod["metadata"]
         anns = meta.get("annotations") or {}
         if meta.get("deletionTimestamp"):
@@ -166,7 +178,11 @@ class MigrationCoordinator:
             # no progress exists to checkpoint — relocate the pod directly
             # (a restore pod pinned to a node that degraded before it
             # started must not be timeout-evicted with a valid snapshot
-            # in hand)
+            # in hand); under park, retire it (zero progress to lose and
+            # the caller holds the spec for resume)
+            if park:
+                await self._retire(pod, controller)
+                return PARKED
             await self._reschedule(pod, nodes or [], controller)
             return MIGRATED
         if not anns.get(consts.MIGRATE_ANNOTATION):
@@ -182,6 +198,9 @@ class MigrationCoordinator:
             await self._request(pod, controller)
             return PENDING
         if phase == "Succeeded":
+            if park:
+                await self._retire(pod, controller)
+                return PARKED
             await self._reschedule(pod, nodes or [], controller)
             return MIGRATED
         if phase == "Failed":
@@ -335,6 +354,30 @@ class MigrationCoordinator:
             ns, meta["name"], replacement["metadata"]["name"],
             target_name, controller,
         )
+
+    async def _retire(self, pod: dict, controller: str) -> None:
+        """Park branch of the drain: the snapshot is durable (or the pod
+        never started), so the source pod is deleted with no restore pod
+        minted — the caller re-creates the workload at resume.  Counts as
+        a ``migrated`` eviction: nothing past the snapshot is lost."""
+        meta = pod["metadata"]
+        ns = self.namespace_of(pod)
+        source_node = deep_get(pod, "spec", "nodeName", default="")
+        await self.client.delete("", "Pod", meta["name"], ns)
+        self.metrics.migrations_total.labels(outcome=PARKED).inc()
+        self.metrics.drain_evictions_total.labels(
+            controller=controller, reason=MIGRATED
+        ).inc()
+        if self.ledger is not None:
+            self.ledger.note_migrated(source_node, controller=controller)
+        await self.recorder.normal(
+            obs_events.pod_ref(meta["name"], ns),
+            obs_events.REASON_MIGRATION_COMPLETED,
+            f"checkpoint complete; {meta['name']} parked (snapshot "
+            "published, no capacity to restore onto — resumes when "
+            "capacity returns)",
+        )
+        log.info("parked %s/%s (%s drain)", ns, meta["name"], controller)
 
 
 # ---------------------------------------------------------------------------

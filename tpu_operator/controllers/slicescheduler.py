@@ -31,15 +31,32 @@ consolidated box → restore) — never a plain evict.  A grant holding any
 workload pod that did NOT opt into migration is never compacted: a job
 that cannot checkpoint must not be disturbed for tidiness.
 
+Preemption economy (docs/SCHEDULING.md "Preemption economy"): a Pending
+``guaranteed`` request may reclaim capacity from bound ``reclaimable``
+grants.  Victim selection is the pure scored
+``scheduling.plan_reclaim`` (lowest priority, then least useful
+chip-seconds at risk per the ledger, then tightest freed-surplus fit);
+the victim is demoted through the migration machine — checkpoint, then
+reshard onto whatever smaller capacity still satisfies its elastic
+``minTopology`` — or, when nothing fits, **parked**: final snapshot
+published, arc released, CR moved to ``Parked``, and auto-resumed
+(re-place → restore from the parked snapshot) the moment capacity
+returns, with exponential backoff + jitter on resume attempts and a
+``parkTimeoutSeconds`` ceiling that degrades to an honest
+``Unschedulable``.  Demote-or-park, never kill.
+
 Steady state is API-free: every read rides the informer-backed
 CachedReader, status/label writes happen only on transitions, and pod
-lists happen only while a compaction move is in flight.
+lists happen only while a compaction/reclaim move is in flight.
 """
 
 from __future__ import annotations
 
+import copy
 import dataclasses
+import datetime
 import logging
+import random
 import time
 from typing import Optional
 
@@ -56,6 +73,7 @@ from tpu_operator.api.types import (
 )
 from tpu_operator.controllers import clusterinfo
 from tpu_operator.controllers import migration as mig
+from tpu_operator.controllers import nodestate
 from tpu_operator.controllers.runtime import Controller, Manager
 from tpu_operator.k8s.cache import CachedReader
 from tpu_operator.k8s.client import ApiClient, ApiError
@@ -81,6 +99,52 @@ OUTCOME_PREEMPTED = "preempted"
 OUTCOME_COMPACTED = "compacted"
 OUTCOME_GROWN = "grown"
 OUTCOME_RELEASED = "released"
+# preemption-economy outcomes (slice_preemptions_total)
+OUTCOME_DEMOTED = "demoted"
+OUTCOME_PARKED = "parked"
+OUTCOME_RESUMED = "resumed"
+OUTCOME_RECLAIM_FAILED = "reclaim-failed"
+OUTCOME_PARK_TIMEOUT = "park-timeout"
+
+# parked-resume backoff ladder: base * 2^(attempts-1) capped, plus up to
+# 25% deterministic jitter (seeded per request+attempt) so a herd of
+# parked requests never retries in lockstep while tests replay exactly
+PARK_RESUME_BACKOFF_BASE_SECONDS = 2.0
+PARK_RESUME_BACKOFF_CAP_SECONDS = 300.0
+
+
+def resume_backoff(
+    name: str,
+    attempts: int,
+    base: float = PARK_RESUME_BACKOFF_BASE_SECONDS,
+    cap: float = PARK_RESUME_BACKOFF_CAP_SECONDS,
+) -> float:
+    """Seconds before a parked request's next resume attempt — pure and
+    deterministic over (name, attempts)."""
+    if attempts <= 0:
+        return 0.0
+    delay = min(cap, base * (2.0 ** (attempts - 1)))
+    rng = random.Random(f"{name}:{attempts}")
+    return delay * (1.0 + 0.25 * rng.random())
+
+
+def _sanitize_pod(pod: dict) -> dict:
+    """The restore manifest a park captures into ``status.parkedPods``:
+    name, labels, annotations and spec only — server-owned metadata
+    (uid, resourceVersion, status) must not ride into the re-create at
+    resume."""
+    meta = pod.get("metadata") or {}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": meta.get("name", ""),
+            "namespace": meta.get("namespace") or "default",
+            "labels": dict(meta.get("labels") or {}),
+            "annotations": dict(meta.get("annotations") or {}),
+        },
+        "spec": copy.deepcopy(pod.get("spec") or {}),
+    }
 
 
 class _Move:
@@ -99,6 +163,43 @@ class _Move:
         self.granted = granted
         self.outcome = outcome
         self.started = time.monotonic()
+
+
+class _Reclaim:
+    """One in-flight reclaim (preemption economy): demote the reclaimable
+    ``victim`` off ``source_key`` — onto ``target_key`` when a smaller
+    fit exists, else park it — so the guaranteed ``claimant`` can take
+    the source.  Like ``_Move``, crash-safe by construction: the labels
+    are the durable state, and the drain machine lives on the pods."""
+
+    def __init__(self, claimant: str, victim: str, source_key: str,
+                 target_key: str, granted: str):
+        self.claimant = claimant
+        self.victim = victim
+        self.source_key = source_key
+        self.target_key = target_key   # "" = park (no capacity fits)
+        self.granted = granted
+        self.started = time.monotonic()
+        # original-name -> sanitized pod manifest captured before the park
+        # drain retires it (the "final snapshot" includes the spec needed
+        # to restore; mirrored into status.parkedPods for restart safety)
+        self.captured: dict[str, dict] = {}
+
+    @property
+    def park(self) -> bool:
+        return not self.target_key
+
+
+class _Park:
+    """Bookkeeping for one Parked request: the captured pod manifests,
+    the wall-clock park timestamp (status mirror — restart-safe), and the
+    in-memory resume-backoff state."""
+
+    def __init__(self, pods: list[dict], since: str):
+        self.pods = pods
+        self.since = since
+        self.attempts = 0
+        self.next_try = 0.0  # monotonic; 0 = try immediately
 
 
 class SliceSchedulerReconciler:
@@ -147,6 +248,19 @@ class SliceSchedulerReconciler:
         # phases whose Unschedulable warning already posted (per request):
         # the Event correlator dedups, but a repeat post still writes
         self._warned_unschedulable: set[str] = set()
+        # ONE reclaim in flight at a time (preemption economy), separate
+        # from the defrag/grow slot so reclaim never starves behind a
+        # long compaction — the two must never target the same victim
+        # (_plan_next_move excludes the mid-demotion grant)
+        self._reclaim: Optional[_Reclaim] = None
+        # parked requests (victim name -> _Park); reconstructed from
+        # status.parkedPods/parkedSince after an operator restart
+        self._parks: dict[str, _Park] = {}
+        # parked requests whose parkTimeoutSeconds expired: honestly
+        # Unschedulable, never auto-retried (delete/recreate the CR)
+        self._park_expired: set[str] = set()
+        # claimant -> monotonic ts the reclaim armed (reclaim latency)
+        self._reclaim_claims: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
@@ -192,6 +306,29 @@ class SliceSchedulerReconciler:
             if name not in live:
                 del self._first_pending[name]
         self._warned_unschedulable &= set(live)
+        self._park_expired &= set(live)
+        for name in list(self._parks):
+            if name not in live:
+                del self._parks[name]
+        for name in list(self._reclaim_claims):
+            if name not in live:
+                del self._reclaim_claims[name]
+
+        # parked requests survive operator restarts through their status
+        # mirror: rebuild the in-memory park record (backoff restarts at
+        # attempt 0 — an immediate resume try, which is the right bias
+        # after a restart anyway)
+        for name, cr in live.items():
+            if (
+                cr.status.get("phase") == SlicePhase.PARKED
+                and name not in self._parks
+                and name not in self._park_expired
+                and name in parsed
+            ):
+                self._parks[name] = _Park(
+                    pods=list(cr.status.get("parkedPods") or []),
+                    since=str(cr.status.get("parkedSince") or ""),
+                )
 
         # -- in-flight move: drive it one non-blocking step ----------------
         busy_move = False
@@ -210,6 +347,30 @@ class SliceSchedulerReconciler:
                 if a.key == move_target else a
                 for a in arcs
             ]
+
+        # -- in-flight reclaim (preemption economy): drive one step --------
+        if self._reclaim is not None:
+            rec_victim, rec_target = self._reclaim.victim, self._reclaim.target_key
+            if await self._drive_reclaim(arcs, nodes_by_name, live, policy):
+                busy_move = True
+            if self._reclaim is None:
+                # the reclaim finished (or aborted) within this pass: the
+                # victim's release moved stamps after the node list was
+                # taken.  Re-derive the view — the pending loop below
+                # must see the capacity just freed FOR the claimant, or
+                # it arms a second reclaim against another victim and
+                # needlessly drains a grant the claimant never needed.
+                nodes = await self.reader.list_items("", "Node")
+                nodes_by_name = {n["metadata"]["name"]: n for n in nodes}
+                arcs = scheduling.arcs_from_nodes(nodes)
+            elif rec_target:
+                # same double-booking guard as the move driver: the
+                # demotion target was stamped after this pass's node list
+                arcs = [
+                    dataclasses.replace(a, assigned=a.assigned or rec_victim)
+                    if a.key == rec_target else a
+                    for a in arcs
+                ]
 
         owned: dict[str, list[scheduling.Arc]] = {}
         for a in arcs:
@@ -234,19 +395,49 @@ class SliceSchedulerReconciler:
                 for name in parsed
                 if name not in owned
                 and (self._move is None or self._move.request != name)
+                and (self._reclaim is None or self._reclaim.victim != name)
+                and name not in self._park_expired
             ),
             key=lambda r: (-r.priority, self._first_seen(r.name), r.name),
         )
         have_pending = False
         for request in pending:
+            cr = live[request.name]
+            if request.name in self._parks:
+                waiting, resumed = await self._drive_park(
+                    cr, request, arcs, nodes_by_name
+                )
+                if waiting:
+                    have_pending = True
+                if resumed is not None:
+                    taken = {a.key for a in resumed.arcs}
+                    arcs = [
+                        a if a.key not in taken else
+                        dataclasses.replace(a, assigned=request.name)
+                        for a in arcs
+                    ]
+                continue
             grant = scheduling.plan_placement(request, arcs)
             if grant is None:
+                # a guaranteed request may take capacity from a bound
+                # reclaimable grant before settling for Pending
+                if self._arm_reclaim(request, arcs, parsed, owned):
+                    await self._set_status(
+                        cr, SlicePhase.PENDING,
+                        message=(
+                            "reclaiming capacity from reclaimable grant "
+                            f"{self._reclaim.victim}"
+                        ),
+                    )
+                    have_pending = True
+                    busy_move = True
+                    continue
                 # only a placeable-later request keeps the poll alive; a
                 # terminally Unschedulable one waits for informer events
-                if await self._mark_unplaceable(live[request.name], request, arcs):
+                if await self._mark_unplaceable(cr, request, arcs):
                     have_pending = True
                 continue
-            await self._bind(live[request.name], request, grant)
+            await self._bind(cr, request, grant)
             # claimed arcs leave the free pool for the rest of this pass
             taken = {a.key for a in grant.arcs}
             arcs = [
@@ -258,7 +449,7 @@ class SliceSchedulerReconciler:
         # -- elastic grow + defrag (one move at a time) ---------------------
         if self._move is None:
             self._plan_next_move(arcs, parsed, owned, sched_spec)
-            busy_move = self._move is not None
+            busy_move = busy_move or self._move is not None
 
         self._export(arcs, live, parsed, owned)
 
@@ -292,6 +483,11 @@ class SliceSchedulerReconciler:
                 released.add(a.assigned)  # one decision, however many arcs
                 if self._move is not None and self._move.request == a.assigned:
                     self._move = None
+                if (
+                    self._reclaim is not None
+                    and self._reclaim.victim == a.assigned
+                ):
+                    self._reclaim = None  # victim deleted: reclaim moot
                 a = dataclasses.replace(a, assigned="")
             out.append(a)
         for name in released:
@@ -376,6 +572,12 @@ class SliceSchedulerReconciler:
         first = self._first_pending.pop(request.name, None)
         latency = max(0.0, time.monotonic() - first) if first is not None else 0.0
         self.metrics.slice_placement_latency.observe(latency)
+        armed = self._reclaim_claims.pop(request.name, None)
+        if armed is not None:
+            # reclaim-to-bound: the claimant landed on reclaimed capacity
+            self.metrics.slice_reclaim_latency.observe(
+                max(0.0, time.monotonic() - armed)
+            )
         self.metrics.slice_placements_total.labels(outcome=OUTCOME_PLACED).inc()
         if self.ledger is not None:
             self.ledger.note_grant(
@@ -474,6 +676,8 @@ class SliceSchedulerReconciler:
         for name, held in sorted(owned.items()):
             if self._move is not None and self._move.request == name:
                 continue  # the move driver owns this grant's arcs
+            if self._reclaim is not None and self._reclaim.victim == name:
+                continue  # the reclaim driver owns this grant's arcs
             if name not in parsed:
                 continue  # invalid spec: status already Unschedulable
             if all(a.eligible for a in held):
@@ -553,6 +757,12 @@ class SliceSchedulerReconciler:
                 del self._move_veto[(name, source_key)]
             else:
                 vetoed.add(name)
+        if self._reclaim is not None:
+            # a grant mid-demotion must never enter the compaction
+            # candidate set: defrag and reclaim racing for the same
+            # victim would double-drain one pod (two restore pods minted
+            # from one checkpoint)
+            vetoed.add(self._reclaim.victim)
         move = scheduling.plan_compaction(
             arcs, bound, float(sched_spec.defrag_threshold), exclude=vetoed
         )
@@ -713,6 +923,361 @@ class SliceSchedulerReconciler:
         return False
 
     # ------------------------------------------------------------------
+    # Preemption economy: reclaim-by-demotion (demote-or-park, never kill).
+
+    def _arm_reclaim(
+        self,
+        request: scheduling.Request,
+        arcs: list[scheduling.Arc],
+        parsed: dict[str, scheduling.Request],
+        owned: dict[str, list[scheduling.Arc]],
+    ) -> bool:
+        """Arm a reclaim for a Pending guaranteed ``request`` that could
+        not place, via the pure scored victim planner.  Returns True when
+        a reclaim is in flight for this claimant after the call."""
+        if self._reclaim is not None:
+            # single-flight: reclaim is deliberate, bounded disruption
+            return self._reclaim.claimant == request.name
+        now = time.monotonic()
+        exclude = {
+            name for (name, _key), until in self._move_veto.items()
+            if until > now
+        }
+        if self._move is not None:
+            exclude.add(self._move.request)
+        # a just-parked victim can still look bound in this pass's stale
+        # arc view (stamps released after the node list was taken) —
+        # never re-target it
+        exclude |= set(self._parks)
+        bound = {n: parsed[n] for n in owned if n in parsed}
+        at_risk = (
+            self.ledger.useful_chip_seconds()
+            if self.ledger is not None else {}
+        )
+        plan = scheduling.plan_reclaim(
+            request, arcs, bound, at_risk=at_risk, exclude=exclude
+        )
+        if plan is None:
+            return False
+        self._reclaim = _Reclaim(
+            plan.claimant, plan.victim, plan.source.key,
+            plan.target.key if plan.target is not None else "",
+            plan.granted_topology,
+        )
+        self._reclaim_claims[request.name] = self._reclaim.started
+        log.info(
+            "reclaim armed: guaranteed %s takes %s from %s -> %s",
+            plan.claimant, plan.victim, plan.source.key,
+            plan.target.key if plan.target is not None else "<park>",
+        )
+        return True
+
+    async def _drive_reclaim(
+        self,
+        arcs: list[scheduling.Arc],
+        nodes_by_name: dict[str, dict],
+        live: dict[str, TPUSliceRequest],
+        policy: TPUClusterPolicy,
+    ) -> bool:
+        """One non-blocking step of the in-flight reclaim.  Returns True
+        while it still needs revisiting."""
+        rec = self._reclaim
+        assert rec is not None
+        arcs_by_key = {a.key: a for a in arcs}
+        source = arcs_by_key.get(rec.source_key)
+        victim_cr = live.get(rec.victim)
+        if (
+            victim_cr is None or source is None
+            or source.assigned != rec.victim
+        ):
+            # victim vanished or already released: nothing left to drive
+            self._reclaim = None  # race-ok: single-writer reconcile key
+            return False
+        target = arcs_by_key.get(rec.target_key) if rec.target_key else None
+        if rec.claimant not in live:
+            await self._reclaim_abort(
+                rec, source,
+                f"claimant {rec.claimant} deleted; reclaim of "
+                f"{rec.victim} aborted",
+                target=target,
+            )
+            return False
+        if not rec.park:
+            if target is None or not target.eligible:
+                # the demotion target degraded between arming and driving:
+                # stand down rather than reshard the victim onto capacity
+                # the next pass would preempt it off again
+                await self._reclaim_abort(
+                    rec, source,
+                    f"demotion target {rec.target_key} no longer eligible; "
+                    f"reclaim of {rec.victim} aborted",
+                    target=target,
+                )
+                return False
+            if target.assigned != rec.victim:
+                # reserve the demotion target FIRST (crash-safe: both
+                # arcs stamped means the next pass resumes the drain)
+                await self._stamp_arc(target, rec.victim)
+
+        migration_spec = policy.spec.migration
+        target_nodes = (
+            [nodes_by_name[n] for n in target.nodes if n in nodes_by_name]
+            if target is not None else []
+        )
+        remaining = 0
+        for node_name in source.nodes:
+            pods = await self.reader.list_items(
+                "", "Pod", field_selector=f"spec.nodeName={node_name}"
+            )
+            for pod in mig.workload_pods(pods, node_name):
+                if not mig.is_migratable(pod):
+                    # zero-loss or nothing: a pod that cannot checkpoint
+                    # vetoes this victim; the planner tries another
+                    self._move_veto[(rec.victim, rec.source_key)] = (
+                        time.monotonic() + MOVE_VETO_RETRY_SECONDS
+                    )
+                    await self._reclaim_abort(
+                        rec, source,
+                        f"pod {pod['metadata']['name']} on {node_name} did "
+                        f"not opt into migration; reclaim of {rec.victim} "
+                        "vetoed (demote-or-park, never kill)",
+                        target=target,
+                    )
+                    return False
+                if rec.park:
+                    # capture the restore manifest BEFORE the drain
+                    # retires the pod: the parked snapshot must include
+                    # the spec that can bring the workload back
+                    rec.captured.setdefault(
+                        pod["metadata"]["name"], _sanitize_pod(pod)
+                    )
+                outcome = await self.migration.drain_pod(
+                    pod, migration_spec, "slicescheduler",
+                    nodes=target_nodes, park=rec.park,
+                )
+                if outcome == mig.PENDING:
+                    remaining += 1
+        if remaining:
+            return True
+
+        if rec.park:
+            await self._finish_park(rec, source, victim_cr)
+        else:
+            await self._finish_demotion(rec, source, target, victim_cr)
+        self._reclaim = None  # race-ok: single-writer reconcile key
+        return False
+
+    async def _reclaim_abort(
+        self,
+        rec: _Reclaim,
+        source: scheduling.Arc,
+        message: str,
+        target: Optional[scheduling.Arc] = None,
+    ) -> None:
+        if target is not None:
+            await self._release_arc(target, rec.victim)
+        self.metrics.slice_preemptions_total.labels(  # ledger-ok: no chips moved
+            outcome=OUTCOME_RECLAIM_FAILED
+        ).inc()
+        await self.recorder.warning(
+            obs_events.slicerequest_ref(rec.claimant),
+            obs_events.REASON_SLICE_RECLAIM_FAILED, message,
+        )
+        for node_name in source.nodes:
+            await self.recorder.warning(
+                obs_events.node_ref(node_name),
+                obs_events.REASON_SLICE_RECLAIM_FAILED, message,
+            )
+        log.warning("%s", message)
+        self._reclaim_claims.pop(rec.claimant, None)
+        self._reclaim = None  # race-ok: single-writer reconcile key
+
+    async def _finish_demotion(
+        self,
+        rec: _Reclaim,
+        source: scheduling.Arc,
+        target: scheduling.Arc,
+        victim_cr: TPUSliceRequest,
+    ) -> None:
+        """Source drained onto the smaller target: release the source for
+        the claimant and flip the victim's grant to its demoted shape."""
+        await self._release_arc(source, rec.victim)
+        await self._set_status(
+            victim_cr, SlicePhase.BOUND,
+            message=(
+                f"demoted: capacity reclaimed by guaranteed request "
+                f"{rec.claimant}"
+            ),
+            granted=rec.granted, chips=topology_chips(rec.granted),
+            arcs=[{
+                "key": target.key, "topology": target.topology,
+                "generation": target.generation, "nodes": list(target.nodes),
+            }],
+        )
+        self.metrics.slice_preemptions_total.labels(
+            outcome=OUTCOME_DEMOTED
+        ).inc()
+        if self.ledger is not None:
+            self.ledger.note_grant(
+                rec.victim, nodes=list(target.nodes), outcome=OUTCOME_DEMOTED,
+            )
+        message = (
+            f"slice request {rec.victim} (reclaimable) demoted for "
+            f"guaranteed request {rec.claimant}: {rec.source_key} "
+            f"({source.topology}) -> {rec.target_key} ({target.topology}), "
+            "workloads migrated checkpoint-reshard-restore"
+        )
+        await self.recorder.normal(
+            obs_events.slicerequest_ref(rec.victim),
+            obs_events.REASON_SLICE_DEMOTED, message,
+        )
+        for node_name in (*source.nodes, *target.nodes):
+            await self.recorder.normal(
+                obs_events.node_ref(node_name),
+                obs_events.REASON_SLICE_DEMOTED, message,
+            )
+        log.info("%s", message)
+
+    async def _finish_park(
+        self,
+        rec: _Reclaim,
+        source: scheduling.Arc,
+        victim_cr: TPUSliceRequest,
+    ) -> None:
+        """Source drained with the final snapshot published and no
+        capacity to restore onto: release the arc and move the CR to
+        Parked — it auto-resumes the moment capacity returns."""
+        await self._release_arc(source, rec.victim)
+        since = nodestate.now_ts()
+        pods = list(rec.captured.values())
+        self._parks[rec.victim] = _Park(pods=pods, since=since)
+        await self._set_status(
+            victim_cr, SlicePhase.PARKED,
+            message=(
+                f"parked: capacity reclaimed by guaranteed request "
+                f"{rec.claimant}; final snapshot published, auto-resuming "
+                "when capacity returns"
+            ),
+            parked_pods=pods, parked_since=since,
+        )
+        self.metrics.slice_preemptions_total.labels(
+            outcome=OUTCOME_PARKED
+        ).inc()
+        if self.ledger is not None:
+            self.ledger.note_release(rec.victim, reason=OUTCOME_PARKED)
+        message = (
+            f"slice request {rec.victim} (reclaimable) parked for "
+            f"guaranteed request {rec.claimant}: no free capacity "
+            f"satisfies its minimum; snapshot published, {rec.source_key} "
+            "released"
+        )
+        await self.recorder.normal(
+            obs_events.slicerequest_ref(rec.victim),
+            obs_events.REASON_SLICE_PARKED, message,
+        )
+        for node_name in source.nodes:
+            await self.recorder.normal(
+                obs_events.node_ref(node_name),
+                obs_events.REASON_SLICE_PARKED, message,
+            )
+        log.info("%s", message)
+
+    async def _drive_park(
+        self,
+        cr: TPUSliceRequest,
+        request: scheduling.Request,
+        arcs: list[scheduling.Arc],
+        nodes_by_name: dict[str, dict],
+    ) -> tuple[bool, Optional[scheduling.Grant]]:
+        """One resume step for a Parked request: enforce the
+        ``parkTimeoutSeconds`` ceiling, honor the backoff window, then
+        try to re-place — on success, bind and restore the captured pods
+        from the parked snapshot.  Returns (still-waiting, grant)."""
+        park = self._parks[request.name]
+        now = time.monotonic()
+        if request.park_timeout_seconds > 0:
+            entered = nodestate.parse_ts(park.since) if park.since else None
+            if entered is None:
+                age = float("inf")
+            else:
+                age = (
+                    datetime.datetime.now(datetime.timezone.utc) - entered
+                ).total_seconds()
+            if age >= float(request.park_timeout_seconds):
+                del self._parks[request.name]
+                self._park_expired.add(request.name)
+                self.metrics.slice_preemptions_total.labels(  # ledger-ok: a parked request holds no chips
+                    outcome=OUTCOME_PARK_TIMEOUT
+                ).inc()
+                message = (
+                    "parked past parkTimeoutSeconds="
+                    f"{request.park_timeout_seconds} with no capacity "
+                    "returning; degraded to Unschedulable (snapshot and "
+                    "restore manifest remain in status.parkedPods — "
+                    "delete and recreate the request to retry)"
+                )
+                await self._set_status(
+                    cr, SlicePhase.UNSCHEDULABLE, message=message,
+                    parked_pods=park.pods, parked_since=park.since,
+                )
+                await self._warn_unschedulable(
+                    request.name, f"{request.name}: {message}"
+                )
+                return False, None
+        if park.next_try > now:
+            return True, None  # backoff window: keep the cadence alive
+        grant = scheduling.plan_placement(request, arcs)
+        if grant is None:
+            park.attempts += 1
+            park.next_try = now + resume_backoff(request.name, park.attempts)
+            return True, None
+
+        # capacity returned: re-place, then restore the parked snapshot
+        del self._parks[request.name]
+        await self._bind(cr, request, grant)
+        all_nodes = [n for a in grant.arcs for n in a.nodes]
+        restored: list[str] = []
+        for i, pod in enumerate(park.pods):
+            node = (
+                nodes_by_name.get(all_nodes[i % len(all_nodes)])
+                if all_nodes else None
+            )
+            replacement = mig.build_replacement(copy.deepcopy(pod), node)
+            try:
+                await self.reader.create(replacement)
+            except ApiError as e:
+                # replay-safe: adopt our own prior create
+                if not e.already_exists:
+                    raise
+            restored.append(replacement["metadata"]["name"])
+        self.metrics.slice_preemptions_total.labels(
+            outcome=OUTCOME_RESUMED
+        ).inc()
+        if self.ledger is not None:
+            self.ledger.note_grant(
+                request.name, nodes=all_nodes, outcome=OUTCOME_RESUMED,
+            )
+        message = (
+            f"slice request {request.name} resumed from park on "
+            f"{', '.join(a.key for a in grant.arcs)} ({grant.topology}); "
+            + (
+                f"restored {', '.join(restored)} from the parked snapshot"
+                if restored else "no workload pods to restore"
+            )
+        )
+        await self.recorder.normal(
+            obs_events.slicerequest_ref(request.name),
+            obs_events.REASON_SLICE_RESUMED, message,
+        )
+        for node_name in all_nodes:
+            await self.recorder.normal(
+                obs_events.node_ref(node_name),
+                obs_events.REASON_SLICE_RESUMED, message,
+            )
+        log.info("%s", message)
+        return False, grant
+
+    # ------------------------------------------------------------------
     async def _set_status(
         self,
         cr: TPUSliceRequest,
@@ -721,6 +1286,8 @@ class SliceSchedulerReconciler:
         granted: str = "",
         chips: int = 0,
         arcs: Optional[list[dict]] = None,
+        parked_pods: Optional[list[dict]] = None,
+        parked_since: str = "",
     ) -> None:
         desired = {
             "phase": phase,
@@ -728,6 +1295,10 @@ class SliceSchedulerReconciler:
             "grantedTopology": granted,
             "chips": chips,
             "arcs": arcs or [],
+            # the parked snapshot's restore manifest + wall-clock park ts
+            # (restart reconstruction); cleared by any non-park transition
+            "parkedPods": parked_pods or [],
+            "parkedSince": parked_since,
         }
         current = {
             k: (cr.status.get(k) or ([] if k == "arcs" else type(v)()))
@@ -781,6 +1352,7 @@ class SliceSchedulerReconciler:
                 ] += 1
         for phase, n in counts.items():
             self.metrics.slice_requests.labels(phase=phase).set(n)
+        self.metrics.parked_slices.set(counts[SlicePhase.PARKED])
 
     # ------------------------------------------------------------------
     def setup(self, mgr: Manager) -> Controller:

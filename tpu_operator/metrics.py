@@ -449,6 +449,33 @@ class OperatorMetrics:
             registry=self.registry,
             buckets=DURATION_BUCKETS,
         )
+        # preemption economy (docs/SCHEDULING.md "Preemption economy"):
+        # reclaim-by-demotion of reclaimable grants for guaranteed claimants
+        self.slice_preemptions_total = Counter(
+            "tpu_operator_slice_preemptions_total",
+            "Preemption-economy transitions, by outcome: demoted "
+            "(reclaimable victim checkpoint-resharded onto smaller "
+            "capacity), parked (no capacity satisfied the victim's "
+            "minTopology; snapshot published, arc released), resumed "
+            "(parked request re-placed and restored), reclaim-failed "
+            "(reclaim aborted: non-migratable pod or degraded target), "
+            "park-timeout (parkTimeoutSeconds expired; degraded to "
+            "Unschedulable)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.parked_slices = g(
+            "tpu_operator_parked_slices",
+            "TPUSliceRequests currently Parked: reclaimed with their final "
+            "snapshot published, waiting for capacity to auto-resume",
+        )
+        self.slice_reclaim_latency = Histogram(
+            "tpu_operator_slice_reclaim_latency_seconds",
+            "Reclaim-to-bound latency per guaranteed claimant: reclaim "
+            "move armed (victim selected) to the claimant's bind landing",
+            registry=self.registry,
+            buckets=DURATION_BUCKETS,
+        )
         self.slice_fragmentation_ratio = g(
             "tpu_operator_slice_fragmentation_ratio",
             "Free-capacity fragmentation: 1 - largest_free_arc_chips / "

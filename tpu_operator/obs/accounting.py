@@ -451,6 +451,20 @@ class ChipTimeLedger:
             states[STATE_IDLE_GRANTED] += granted - useful - wasted
         return states, owners
 
+    def useful_chip_seconds(self, now: Optional[float] = None) -> dict:
+        """Per live grant, the busy-useful chip-seconds accrued so far —
+        the "useful work at risk" input the preemption economy's victim
+        scoring ranks on (scheduling.victim_score): among equal-priority
+        reclaimable grants, the one that has banked the least useful
+        work is demoted first."""
+        self.advance(now)
+        _, owners = self._carve()
+        return {
+            owner: round(row.get(STATE_BUSY_USEFUL, 0.0), 6)
+            for owner, row in owners.items()
+            if owner in self._grants
+        }
+
     def conservation(self, now: Optional[float] = None) -> dict:
         """Both sides of the invariant, computed independently: the wall
         side from state-blind per-node tracking, the attributed side from

@@ -63,6 +63,12 @@ REASON_SLICE_PLACED = "SlicePlaced"
 REASON_SLICE_PREEMPTED = "SlicePreempted"
 REASON_SLICE_COMPACTED = "SliceCompacted"
 REASON_SLICE_UNSCHEDULABLE = "SliceUnschedulable"
+# preemption economy (docs/SCHEDULING.md "Preemption economy"): reclaim
+# transitions of reclaimable grants demoted/parked for guaranteed claimants
+REASON_SLICE_DEMOTED = "SliceDemoted"
+REASON_SLICE_PARKED = "SliceParked"
+REASON_SLICE_RESUMED = "SliceResumed"
+REASON_SLICE_RECLAIM_FAILED = "SliceReclaimFailed"
 # fleet SLO engine (obs/fleet.py; docs/OBSERVABILITY.md "Fleet telemetry
 # & SLOs"): multi-window burn-rate breach / recovery
 REASON_SLO_BURN_RATE = "SLOBurnRate"

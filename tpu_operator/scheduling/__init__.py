@@ -11,10 +11,13 @@ from tpu_operator.scheduling.placement import (  # noqa: F401
     Arc,
     Compaction,
     Grant,
+    Reclaim,
     Request,
     arcs_from_nodes,
     fragmentation,
     plan_compaction,
     plan_placement,
+    plan_reclaim,
     request_from_spec,
+    victim_score,
 )

@@ -71,6 +71,8 @@ class Request:
     multislice: bool
     max_slices: int
     priority: int
+    tier: str = "guaranteed"          # capacity tier (preemption economy)
+    park_timeout_seconds: int = 0     # 0 = parked requests wait forever
 
 
 @dataclass(frozen=True)
@@ -95,6 +97,20 @@ class Compaction:
     target: Arc
     granted_topology: str
     freed_chips: int
+
+
+@dataclass(frozen=True)
+class Reclaim:
+    """Reclaim ``victim``'s arc for a pending guaranteed ``claimant``:
+    demote the victim onto ``target`` (checkpoint-reshard down toward its
+    elastic minimum) when one fits, else park it (``target`` is None —
+    snapshot published, arc released, auto-resume when capacity returns)."""
+
+    claimant: str
+    victim: str
+    source: Arc                      # the victim's arc, freed for the claimant
+    target: Optional[Arc]            # demotion target; None = park
+    granted_topology: str            # victim's shape on target ("" when parked)
 
 
 def request_from_spec(name: str, spec) -> Request:
@@ -123,6 +139,10 @@ def request_from_spec(name: str, spec) -> Request:
         multislice=bool(spec.multislice),
         max_slices=max(1, int(spec.max_slices)),
         priority=int(spec.priority),
+        tier=str(getattr(spec, "tier", "") or "guaranteed"),
+        park_timeout_seconds=max(
+            0, int(getattr(spec, "park_timeout_seconds", 0) or 0)
+        ),
     )
 
 
@@ -398,3 +418,87 @@ def plan_compaction(
                 best = move
             break  # smallest fitting target for THIS grant found
     return best
+
+
+# ---------------------------------------------------------------------------
+# Preemption economy (reclaim-by-demotion; docs/SCHEDULING.md).
+
+
+def victim_score(
+    victim: Request, source: Arc, claimant: Request, at_risk: dict
+) -> tuple:
+    """Rank one reclaim candidate (lower wins): lowest ``priority``
+    first, then the least chip-seconds of useful work at risk per the
+    ledger, then the tightest freed-surplus fit (chips the claimant
+    would strand on the freed arc), then the victim name for
+    determinism."""
+    granted = _single_grant_topology(claimant, source)
+    surplus = source.chips - (topology_chips(granted) if granted else 0)
+    return (
+        victim.priority,
+        round(float(at_risk.get(victim.name, 0.0)), 6),
+        surplus,
+        victim.name,
+    )
+
+
+def plan_reclaim(
+    claimant: Request,
+    arcs: list[Arc],
+    bound: dict[str, Request],
+    at_risk: Optional[dict] = None,
+    exclude: Optional[set] = None,
+) -> Optional[Reclaim]:
+    """The reclaim move that lands a Pending **guaranteed** ``claimant``
+    on capacity currently bound to a reclaimable grant, or None.
+
+    Victim selection is pure and scored (:func:`victim_score`).  The
+    chosen victim is demoted onto whatever smaller/fragmented free
+    capacity still satisfies its elastic ``minTopology``; when nothing
+    fits it parks (``target`` is None) — demote-or-park, never kill.
+    ``exclude`` names grants the caller has vetoed (a non-migratable
+    workload pod on the grant) or that are already mid-move."""
+    if claimant.tier != "guaranteed":
+        return None
+    at_risk = at_risk or {}
+    owned: dict[str, list[Arc]] = {}
+    for a in arcs:
+        if a.assigned:
+            owned.setdefault(a.assigned, []).append(a)
+
+    best: Optional[tuple[tuple, Request, Arc]] = None
+    for name, held in sorted(owned.items()):
+        victim = bound.get(name)
+        if victim is None or victim.tier != "reclaimable":
+            continue
+        if name in (exclude or ()) or len(held) != 1:
+            continue
+        source = held[0]
+        if not source.eligible or source.admin_group:
+            continue
+        if claimant.generation and source.generation != claimant.generation:
+            continue
+        if _single_grant_topology(claimant, source) is None:
+            continue  # freeing this arc still would not fit the claimant
+        score = victim_score(victim, source, claimant, at_risk)
+        if best is None or score < best[0]:
+            best = (score, victim, source)
+    if best is None:
+        return None
+    _, victim, source = best
+
+    # demotion target: the best free arc that still satisfies the
+    # victim's elastic range — the claimant takes the source, so the
+    # source is NOT free for the victim.  One contiguous mesh only: a
+    # demotion reshard is a single-arc restore, never a DCN split.
+    free_view = [a for a in arcs if a.free and a.key != source.key]
+    grant = plan_placement(victim, free_view)
+    if grant is not None and (grant.multislice or len(grant.arcs) != 1):
+        grant = None
+    return Reclaim(
+        claimant=claimant.name,
+        victim=victim.name,
+        source=source,
+        target=grant.arcs[0] if grant is not None else None,
+        granted_topology=grant.topology if grant is not None else "",
+    )

@@ -77,6 +77,15 @@ class ChaosConfig:
     agent_unhealthy_interval: float = 0.0  # 0 = off
     agent_unhealthy_down_s: float = 3.0
     agent_unhealthy_reason: str = "chip-scrape-failed"
+    # capacity shock: every interval one whole GKE nodepool (rng-chosen,
+    # optionally restricted to pools whose name starts with the prefix)
+    # goes agent-unhealthy at once — the correlated capacity loss that
+    # forces the preemption economy to reclaim/park rather than nibble at
+    # single-node faults — recovering together after down_s
+    pool_shock_interval: float = 0.0  # 0 = off
+    pool_shock_down_s: float = 5.0
+    pool_shock_prefix: str = ""       # "" = any pool is fair game
+    pool_shock_reason: str = "pool-capacity-shock"
     # checkpoint faults (workloads/checkpoint.py TPU_CKPT_FAULT contract;
     # applied to signal-triggered snapshots only): kill_during_checkpoint
     # SIGKILLs the worker after the shard files but before the manifest —

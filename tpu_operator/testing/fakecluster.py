@@ -438,6 +438,8 @@ class FakeCluster:
         # episodes allowed — that is what exhausts a health budget)
         self._unhealthy_nodes: dict[str, float] = {}
         self._last_agent_fault_at = 0.0
+        self._shocked_pool_nodes: dict[str, float] = {}
+        self._last_pool_shock_at = 0.0
         # DELETE options observed per object: (plural, ns, name, grace) —
         # lets tests assert drain grace propagation without a real kubelet
         self.delete_options: list[tuple[str, str, str, Optional[str]]] = []
@@ -890,6 +892,7 @@ class FakeCluster:
                 self._chaos_crashloops(now)
                 self._chaos_node_flap(now)
                 self._chaos_agent_health(now)
+                self._chaos_pool_shock(now)
             except Exception:  # noqa: BLE001
                 log.exception("chaos actor error")
             await asyncio.sleep(self.sim.tick)
@@ -974,6 +977,44 @@ class FakeCluster:
         self.chaos._count("agent_unhealthy")
         self._unhealthy_nodes[name] = now + cfg.agent_unhealthy_down_s
         self._last_agent_fault_at = now
+
+    def _chaos_pool_shock(self, now: float) -> None:
+        """Every ``pool_shock_interval`` seconds one whole GKE nodepool
+        (rng-chosen; restricted to pools named with ``pool_shock_prefix``
+        when set) publishes ``unhealthy`` agent verdicts on EVERY member
+        at once — the correlated capacity loss (maintenance event, rack
+        power, switch failure) that drains a multi-host slice's entire
+        arc and forces the scheduler to reclaim or park, not heal one
+        node — all members recover together after ``pool_shock_down_s``."""
+        cfg = self.chaos.config
+        if not cfg.pool_shock_interval:
+            return
+        for name, restore_at in list(self._shocked_pool_nodes.items()):
+            if now >= restore_at:
+                del self._shocked_pool_nodes[name]
+                self.set_agent_health(name, consts.HEALTH_OK)
+        if not self.chaos.active:
+            return
+        if now - self._last_pool_shock_at < cfg.pool_shock_interval:
+            return
+        node_store = self.store("", "nodes")
+        pools: dict[str, list[str]] = {}
+        for (_, name), node in sorted(node_store.objects.items()):
+            labels = node["metadata"].get("labels") or {}
+            pool = labels.get(consts.GKE_NODEPOOL_LABEL, "")
+            if not pool or not pool.startswith(cfg.pool_shock_prefix):
+                continue
+            pools.setdefault(pool, []).append(name)
+        if not pools:
+            return
+        pool = self.chaos.rng.choice(sorted(pools))
+        for name in pools[pool]:
+            self.set_agent_health(
+                name, consts.HEALTH_UNHEALTHY, cfg.pool_shock_reason
+            )
+            self._shocked_pool_nodes[name] = now + cfg.pool_shock_down_s
+        self.chaos._count("pool_shock")
+        self._last_pool_shock_at = now
 
     def set_agent_health(
         self, name: str, verdict: str, reason: str = ""
