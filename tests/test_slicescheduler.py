@@ -639,18 +639,324 @@ async def test_reclaim_vetoed_by_non_migratable_pod():
 def test_resume_backoff_growth_jitter_and_cap():
     from tpu_operator.controllers.slicescheduler import (
         PARK_RESUME_BACKOFF_CAP_SECONDS,
+        PARK_RESUME_BACKOFF_JITTER,
         resume_backoff,
     )
 
+    saturation = PARK_RESUME_BACKOFF_CAP_SECONDS / (
+        1.0 + PARK_RESUME_BACKOFF_JITTER
+    )
     assert resume_backoff("r", 0) == 0.0
     ladder = [resume_backoff("r", n) for n in range(1, 10)]
-    # exponential growth: each rung at least ~1.6x the last until the cap
-    for lo, hi in zip(ladder, ladder[1:]):
-        assert hi >= lo or lo > PARK_RESUME_BACKOFF_CAP_SECONDS
+    # exponential growth until the ladder saturates; past saturation only
+    # the per-attempt jitter varies
+    for n, (lo, hi) in enumerate(zip(ladder, ladder[1:]), start=1):
+        assert hi >= lo or 2.0 * (2.0 ** (n - 1)) >= saturation
     # jitter stays within +25% of the undecorated delay
     assert 2.0 <= resume_backoff("r", 1) <= 2.0 * 1.25
-    # capped (with jitter headroom)
-    assert resume_backoff("r", 50) <= PARK_RESUME_BACKOFF_CAP_SECONDS * 1.25
+    # the cap is a HARD ceiling, jitter included — never 375s-style
+    # overshoot past the documented 300s
+    for n in (9, 50, 1000, 10**6):
+        assert saturation <= resume_backoff("r", n) <= (
+            PARK_RESUME_BACKOFF_CAP_SECONDS
+        )
+    # the saturated tail still spreads across the herd (no lockstep)
+    tail = {round(resume_backoff("r", n), 6) for n in range(40, 50)}
+    assert len(tail) > 1
     # deterministic per (name, attempt); distinct across names
     assert resume_backoff("r", 3) == resume_backoff("r", 3)
     assert resume_backoff("r", 3) != resume_backoff("q", 3)
+
+
+def _hist_count(hist):
+    for metric in hist.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_count"):
+                return sample.value
+    return 0.0
+
+
+async def test_park_manifests_persist_before_retirement_and_survive_restart():
+    """The never-kill contract across operator restarts: a multi-pod
+    park writes every restore manifest into status.parkedPods BEFORE
+    retiring its pod, so a fresh reconciler (no memory of the in-flight
+    _Reclaim) reconstructs the interrupted park from the CR alone and
+    finishes it with nothing lost."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            # "early" retires on the first drive step (never started);
+            # "slow" must checkpoint first, keeping the park in flight
+            await client.create(_tpu_pod(
+                "early", "big", chips="4", migratable=True, phase="Pending"
+            ))
+            await client.create(_tpu_pod(
+                "slow", "big", chips="4", migratable=True, phase="Running"
+            ))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms (no target -> park)
+            assert sched._reclaim is not None and sched._reclaim.park
+            await sched.reconcile("slices")  # drives: early retired
+            victim = await _status(client, "victim")
+            # mid-park: early's pod is gone, yet its restore manifest is
+            # already durable on the CR (with the claimant recorded)
+            assert victim["phase"] == SlicePhase.BOUND
+            parked = {p["metadata"]["name"] for p in victim["parkedPods"]}
+            assert parked == {"early", "slow"}
+            assert victim["reclaimClaimant"] == "claim"
+            try:
+                await client.get("", "Pod", "early", "default")
+                raise AssertionError("early should be retired")
+            except ApiError as e:
+                assert e.not_found
+
+            # operator restart: all in-memory reclaim state is lost
+            sched2 = _scheduler(client)
+            # the slow pod's checkpoint completes
+            await client.patch(
+                "", "Pod", "slow", {"status": {"phase": "Succeeded"}},
+                namespace="default",
+            )
+            await sched2.reconcile("slices")  # reconstructs + finishes
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.PARKED
+            parked = {p["metadata"]["name"] for p in victim["parkedPods"]}
+            assert parked == {"early", "slow"}
+            try:
+                await client.get("", "Pod", "slow", "default")
+                raise AssertionError("slow should be retired")
+            except ApiError as e:
+                assert e.not_found
+            # the claimant lands on the freed arc
+            await sched2.reconcile("slices")
+            assert (await _status(client, "claim"))["phase"] == SlicePhase.BOUND
+        finally:
+            await client.close()
+
+
+async def test_park_adopted_when_restart_lands_after_release():
+    """Crash window between the source release and the Parked status
+    write: a Bound CR with parkedPods but no stamped arc is adopted as a
+    completed park, never re-bound without its restore pods."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            # the claimant the crashed reclaim was draining for now holds
+            # the arc the victim vacated
+            await client.create(TPUSliceRequest.new("holder", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")
+            assert (await _status(client, "holder"))["phase"] == (
+                SlicePhase.BOUND
+            )
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            manifest = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "train", "namespace": "default",
+                             "labels": {}, "annotations": {}},
+                "spec": {"containers": []},
+            }
+            cr = await client.get(GROUP, SLICE_REQUEST_KIND, "victim")
+            cr["status"] = {
+                "phase": SlicePhase.BOUND, "parkedPods": [manifest],
+                "reclaimClaimant": "holder",
+            }
+            await client.update_status(cr)
+            await sched.reconcile("slices")
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.PARKED
+            assert victim["parkedPods"][0]["metadata"]["name"] == "train"
+            assert victim["parkedSince"]
+            assert "victim" in sched._parks
+            # the claimant releases: the adopted park resumes with its
+            # restore pod — never re-bound bare
+            await client.delete(GROUP, SLICE_REQUEST_KIND, "holder")
+            sched._parks["victim"].next_try = 0.0
+            await sched.reconcile("slices")
+            await sched.reconcile("slices")
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.BOUND
+            assert victim["arcs"][0]["key"] == "big"
+            restore = await client.get("", "Pod", "train-mig1", "default")
+            assert restore is not None
+            assert "SliceResumed" in await _reasons(fc)
+        finally:
+            await client.close()
+
+
+async def test_reclaim_stands_down_when_claimant_binds_elsewhere():
+    """Capacity frees elsewhere while the reclaim drains: the claimant
+    binds through ordinary placement, the in-flight reclaim aborts
+    instead of needlessly demoting/parking the victim, and the reclaim
+    latency histogram records nothing for the non-reclaim bind."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            # a migratable Running pod keeps the park drain PENDING
+            await client.create(_tpu_pod("train", "big", migratable=True))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms the reclaim
+            assert sched._reclaim is not None
+            await sched.reconcile("slices")  # drives: checkpoint pending
+            # capacity frees elsewhere mid-reclaim
+            fc.add_node("big2", topology="2x4", accelerator="tpu-v5-lite-device")
+            await sched.reconcile("slices")  # claimant binds big2 normally
+            claim = await _status(client, "claim")
+            assert claim["phase"] == SlicePhase.BOUND
+            assert claim["arcs"][0]["key"] == "big2"
+            await sched.reconcile("slices")  # reclaim stands down
+            assert sched._reclaim is None
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.BOUND
+            assert victim["arcs"][0]["key"] == "big"
+            assert not victim.get("parkedPods")
+            # the victim's pod was never killed
+            assert await client.get("", "Pod", "train", "default")
+            assert "SliceReclaimFailed" in await _reasons(fc)
+            # a bind that landed elsewhere is ordinary placement, not a
+            # reclaim outcome
+            assert _hist_count(sched.metrics.slice_reclaim_latency) == 0
+        finally:
+            await client.close()
+
+
+async def test_park_completion_reserves_freed_arc_for_claimant():
+    """The pass that completes a park must hand the freed arc to the
+    reclaim's claimant, NOT to the higher-priority victim it just
+    parked — otherwise park/resume thrash with real checkpoint churn."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable", "priority": 10,
+            }).obj)
+            await sched.reconcile("slices")
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms (tier trumps priority)
+            assert sched._reclaim is not None
+            assert sched._reclaim.victim == "victim"
+            await sched.reconcile("slices")  # park completes
+            # the freed arc went to the claimant; the higher-priority
+            # victim stays parked (backing off), not re-placed onto the
+            # arc it just vacated
+            claim = await _status(client, "claim")
+            assert claim["phase"] == SlicePhase.BOUND
+            assert claim["arcs"][0]["key"] == "big"
+            assert (await _status(client, "victim"))["phase"] == (
+                SlicePhase.PARKED
+            )
+            assert "SliceResumed" not in await _reasons(fc)
+            await sched.reconcile("slices")  # steady: no thrash
+            assert sched._reclaim is None
+            assert (await _status(client, "victim"))["phase"] == (
+                SlicePhase.PARKED
+            )
+        finally:
+            await client.close()
+
+
+async def test_park_checkpoint_timeout_vetoes_instead_of_evicting():
+    """A live pod that blows migration.timeoutSeconds under park is
+    never evicted (that would lose progress past its last snapshot):
+    the reclaim vetoes and the persisted manifest mirror is cleared."""
+    import datetime
+
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            await client.create(_tpu_pod("train", "big", migratable=True))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms (park)
+            await sched.reconcile("slices")  # drives: migrate requested
+            victim = await _status(client, "victim")
+            assert victim["parkedPods"]  # manifest persisted pre-retire
+            # the checkpoint stalls past the deadline
+            pod = fc.store("", "pods").get("default", "train")
+            pod["metadata"]["annotations"][consts.MIGRATE_TS_ANNOTATION] = (
+                datetime.datetime.now(datetime.timezone.utc)
+                - datetime.timedelta(hours=2)
+            ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+            fc.put(pod)
+            await sched.reconcile("slices")  # veto, not evict
+            assert sched._reclaim is None
+            assert await client.get("", "Pod", "train", "default")
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.BOUND
+            assert victim["arcs"][0]["key"] == "big"
+            assert not victim.get("parkedPods")  # mirror cleared on abort
+            assert "SliceReclaimFailed" in await _reasons(fc)
+            assert (await _status(client, "claim"))["phase"] == (
+                SlicePhase.PENDING
+            )
+        finally:
+            await client.close()
+
+
+async def test_park_crashed_checkpoint_retires_with_failed_accounting():
+    """A pod that CRASHED mid-park-checkpoint already lost its
+    post-snapshot progress to the crash: the park completes from the
+    last complete snapshot, but with distinct failed accounting — never
+    silently counted as a clean park."""
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        fc.add_node("big", topology="2x4", accelerator="tpu-v5-lite-device")
+        client = await _cluster(fc)
+        sched = _scheduler(client)
+        try:
+            await client.create(TPUSliceRequest.new("victim", {
+                "topology": "2x4", "tier": "reclaimable",
+            }).obj)
+            await sched.reconcile("slices")
+            await client.create(_tpu_pod("train", "big", migratable=True))
+            await client.create(TPUSliceRequest.new("claim", {
+                "topology": "2x4",
+            }).obj)
+            await sched.reconcile("slices")  # arms (park)
+            await sched.reconcile("slices")  # drives: migrate requested
+            await client.patch(
+                "", "Pod", "train", {"status": {"phase": "Failed"}},
+                namespace="default",
+            )
+            await sched.reconcile("slices")  # park completes, honestly
+            victim = await _status(client, "victim")
+            assert victim["phase"] == SlicePhase.PARKED
+            assert victim["parkedPods"][0]["metadata"]["name"] == "train"
+            assert "MigrationFailed" in await _reasons(fc)
+            evicted = sched.migration.metrics.drain_evictions_total.labels(
+                controller="slicescheduler", reason="failed"
+            )._value.get()
+            assert evicted == 1
+        finally:
+            await client.close()

@@ -159,9 +159,14 @@ class MigrationCoordinator:
         checkpoint phase runs unchanged, but once the snapshot publishes
         the source pod is retired WITHOUT a restore pod — the caller
         captured the spec (``build_replacement(pod, None)``) and owns the
-        restore at resume time.  The retirement still counts as a
+        restore at resume time.  The clean retirement counts as a
         ``migrated`` drain eviction: nothing past the published snapshot
-        is lost."""
+        is lost.  Park also hardens the two fallback rungs: a LIVE pod
+        past the checkpoint deadline is never evicted (:data:`TIMEOUT` is
+        returned un-acted-on so the caller vetoes the reclaim), and a pod
+        that crashed mid-checkpoint — whose post-snapshot progress the
+        crash already lost — is retired with distinct ``failed``
+        accounting rather than silently counted as a clean park."""
         meta = pod["metadata"]
         anns = meta.get("annotations") or {}
         if meta.get("deletionTimestamp"):
@@ -204,6 +209,27 @@ class MigrationCoordinator:
             await self._reschedule(pod, nodes or [], controller)
             return MIGRATED
         if phase == "Failed":
+            if park:
+                # crashed mid-park-checkpoint: progress since the last
+                # COMPLETE snapshot is already lost to the crash itself —
+                # retiring the dead husk loses nothing further, and the
+                # park will resume from that last complete snapshot.  But
+                # the completion must be auditable, never silent: a
+                # distinct Event + failed-migration metric + an eviction
+                # counted as ``failed`` (not the clean ``migrated``).
+                self.metrics.migrations_total.labels(outcome=FAILED).inc()
+                await self.recorder.warning(
+                    obs_events.pod_ref(meta["name"], self.namespace_of(pod)),
+                    obs_events.REASON_MIGRATION_FAILED,
+                    f"workload {meta['name']} crashed before completing "
+                    "its park checkpoint; parking from its last complete "
+                    "snapshot — progress since that snapshot was lost to "
+                    "the crash",
+                )
+                await self.evict(
+                    pod, controller, FAILED, grace_period_seconds, warn=False,
+                )
+                return PARKED
             # crashed mid-checkpoint: the snapshot layer guarantees the torn
             # attempt is not observable, but this pod can no longer complete
             # — fall back to evict now rather than burning the timeout
@@ -232,6 +258,15 @@ class MigrationCoordinator:
                 datetime.datetime.now(datetime.timezone.utc) - entered
             ).total_seconds()
         if age > float(spec.timeout_seconds):
+            if park:
+                # the park path NEVER takes the evict fallback on a live
+                # pod — killing it would lose progress past the last
+                # published snapshot, exactly what park promises not to
+                # do.  Surface TIMEOUT so the caller vetoes/aborts the
+                # reclaim (it owns the event/metric for that outcome;
+                # this step is re-entered every pass, so emitting here
+                # would spam).
+                return TIMEOUT
             self.metrics.migrations_total.labels(outcome=TIMEOUT).inc()
             await self.recorder.warning(
                 obs_events.pod_ref(meta["name"], self.namespace_of(pod)),

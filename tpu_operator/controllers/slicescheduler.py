@@ -82,7 +82,7 @@ from tpu_operator.obs import events as obs_events
 from tpu_operator.obs import fleet as obs_fleet
 from tpu_operator.obs.events import EventRecorder
 from tpu_operator.obs.trace import Tracer
-from tpu_operator.utils import topology_chips
+from tpu_operator.utils import deep_get, topology_chips
 
 log = logging.getLogger("tpu_operator.slicescheduler")
 
@@ -106,11 +106,15 @@ OUTCOME_RESUMED = "resumed"
 OUTCOME_RECLAIM_FAILED = "reclaim-failed"
 OUTCOME_PARK_TIMEOUT = "park-timeout"
 
-# parked-resume backoff ladder: base * 2^(attempts-1) capped, plus up to
-# 25% deterministic jitter (seeded per request+attempt) so a herd of
-# parked requests never retries in lockstep while tests replay exactly
+# parked-resume backoff ladder: base * 2^(attempts-1), plus up to 25%
+# deterministic jitter (seeded per request+attempt) so a herd of parked
+# requests never retries in lockstep while tests replay exactly.  The cap
+# is a hard ceiling JITTER INCLUDED: the exponential delay saturates at
+# cap/(1+jitter) so the jittered result never exceeds the cap and the
+# tail still spreads across the herd.
 PARK_RESUME_BACKOFF_BASE_SECONDS = 2.0
 PARK_RESUME_BACKOFF_CAP_SECONDS = 300.0
+PARK_RESUME_BACKOFF_JITTER = 0.25
 
 
 def resume_backoff(
@@ -120,12 +124,13 @@ def resume_backoff(
     cap: float = PARK_RESUME_BACKOFF_CAP_SECONDS,
 ) -> float:
     """Seconds before a parked request's next resume attempt — pure and
-    deterministic over (name, attempts)."""
+    deterministic over (name, attempts), never exceeding ``cap``."""
     if attempts <= 0:
         return 0.0
-    delay = min(cap, base * (2.0 ** (attempts - 1)))
+    raw = base * (2.0 ** min(attempts - 1, 32))
+    delay = min(cap / (1.0 + PARK_RESUME_BACKOFF_JITTER), raw)
     rng = random.Random(f"{name}:{attempts}")
-    return delay * (1.0 + 0.25 * rng.random())
+    return delay * (1.0 + PARK_RESUME_BACKOFF_JITTER * rng.random())
 
 
 def _sanitize_pod(pod: dict) -> dict:
@@ -170,7 +175,10 @@ class _Reclaim:
     ``victim`` off ``source_key`` — onto ``target_key`` when a smaller
     fit exists, else park it — so the guaranteed ``claimant`` can take
     the source.  Like ``_Move``, crash-safe by construction: the labels
-    are the durable state, and the drain machine lives on the pods."""
+    are the durable state, the drain machine lives on the pods, and under
+    park every captured restore manifest is mirrored into
+    ``status.parkedPods`` BEFORE its pod retires (``_persist_captured``)
+    so a restarted operator finishes the park from the CR alone."""
 
     def __init__(self, claimant: str, victim: str, source_key: str,
                  target_key: str, granted: str):
@@ -182,8 +190,16 @@ class _Reclaim:
         self.started = time.monotonic()
         # original-name -> sanitized pod manifest captured before the park
         # drain retires it (the "final snapshot" includes the spec needed
-        # to restore; mirrored into status.parkedPods for restart safety)
+        # to restore)
         self.captured: dict[str, dict] = {}
+        # captured-manifest names already written to status.parkedPods:
+        # a pod may only be retired once its manifest is in this set
+        self.persisted: set[str] = set()
+        # True once the drain moved/retired any pod: past this point the
+        # reclaim runs to completion (stand-down aborts — claimant gone,
+        # claimant bound elsewhere, veto — would strand a half-drained
+        # victim)
+        self.committed = False
 
     @property
     def park(self) -> bool:
@@ -259,8 +275,16 @@ class SliceSchedulerReconciler:
         # parked requests whose parkTimeoutSeconds expired: honestly
         # Unschedulable, never auto-retried (delete/recreate the CR)
         self._park_expired: set[str] = set()
-        # claimant -> monotonic ts the reclaim armed (reclaim latency)
-        self._reclaim_claims: dict[str, float] = {}
+        # claimant -> (monotonic ts the reclaim armed, reclaimed source
+        # arc key): reclaim latency is observed only when the claimant
+        # actually lands on the reclaimed arc, not on any bind
+        self._reclaim_claims: dict[str, tuple[float, str]] = {}
+        # arc key -> claimant: capacity a finished reclaim freed stays
+        # invisible to every other request until the claimant binds —
+        # otherwise the pass that completes a park would re-place a
+        # higher-priority parked victim straight onto the arc it just
+        # vacated (park/resume thrash with real checkpoint churn)
+        self._reserved: dict[str, str] = {}
 
     # ------------------------------------------------------------------
     async def reconcile(self, key: str) -> Optional[float]:
@@ -330,6 +354,55 @@ class SliceSchedulerReconciler:
                     since=str(cr.status.get("parkedSince") or ""),
                 )
 
+        # an in-flight park ALSO survives restarts, through its
+        # incremental status mirror (_persist_captured): a still-Bound CR
+        # carrying parkedPods is a park interrupted mid-drain — some of
+        # its pods may already be retired with no restore pod, so the
+        # park must finish (then auto-resume), never be forgotten
+        if self._reclaim is None:
+            for name, cr in live.items():
+                if cr.status.get("phase") != SlicePhase.BOUND:
+                    continue
+                pods = cr.status.get("parkedPods") or []
+                if not pods or name in self._parks or name not in parsed:
+                    continue
+                src = next((a for a in arcs if a.assigned == name), None)
+                if src is None:
+                    # crash landed between the source release and the
+                    # Parked status write: the manifests are durable and
+                    # the pods already retired — adopt the park as
+                    # complete rather than re-binding without a restore
+                    since = (
+                        str(cr.status.get("parkedSince") or "")
+                        or nodestate.now_ts()
+                    )
+                    self._parks[name] = _Park(pods=list(pods), since=since)
+                    await self._set_status(
+                        cr, SlicePhase.PARKED,
+                        message=(
+                            "parked (park reconstructed after operator "
+                            "restart); auto-resuming when capacity returns"
+                        ),
+                        parked_pods=list(pods), parked_since=since,
+                    )
+                    continue
+                rec = _Reclaim(
+                    str(cr.status.get("reclaimClaimant") or ""),
+                    name, src.key, "", "",
+                )
+                rec.captured = {
+                    str((p.get("metadata") or {}).get("name") or ""): p
+                    for p in pods
+                }
+                rec.persisted = set(rec.captured)
+                rec.committed = True  # manifests durable; pods may be gone
+                self._reclaim = rec
+                log.info(
+                    "resuming interrupted park of %s from status.parkedPods",
+                    name,
+                )
+                break
+
         # -- in-flight move: drive it one non-blocking step ----------------
         busy_move = False
         if self._move is not None:
@@ -377,6 +450,20 @@ class SliceSchedulerReconciler:
             if a.assigned:
                 owned.setdefault(a.assigned, []).append(a)
 
+        # reclaimed-capacity reservations expire the moment they are no
+        # longer needed (claimant bound or gone) or can no longer be
+        # honored (arc gone/ineligible or taken by someone else)
+        for arc_key, claimant in list(self._reserved.items()):
+            arc = next((a for a in arcs if a.key == arc_key), None)
+            if (
+                claimant not in live
+                or claimant in owned
+                or arc is None
+                or not arc.eligible
+                or (arc.assigned and arc.assigned != claimant)
+            ):
+                del self._reserved[arc_key]
+
         # -- bound grants: heal capacity loss (elastic shrink) -------------
         preempted = await self._heal_bound(arcs, live, parsed, owned)
         if preempted:
@@ -403,9 +490,12 @@ class SliceSchedulerReconciler:
         have_pending = False
         for request in pending:
             cr = live[request.name]
+            # arcs reserved for a different reclaim claimant are
+            # invisible to this request's placement
+            view = self._visible_arcs(arcs, request.name)
             if request.name in self._parks:
                 waiting, resumed = await self._drive_park(
-                    cr, request, arcs, nodes_by_name
+                    cr, request, view, nodes_by_name
                 )
                 if waiting:
                     have_pending = True
@@ -417,11 +507,11 @@ class SliceSchedulerReconciler:
                         for a in arcs
                     ]
                 continue
-            grant = scheduling.plan_placement(request, arcs)
+            grant = scheduling.plan_placement(request, view)
             if grant is None:
                 # a guaranteed request may take capacity from a bound
                 # reclaimable grant before settling for Pending
-                if self._arm_reclaim(request, arcs, parsed, owned):
+                if self._arm_reclaim(request, view, parsed, owned):
                     await self._set_status(
                         cr, SlicePhase.PENDING,
                         message=(
@@ -448,7 +538,9 @@ class SliceSchedulerReconciler:
 
         # -- elastic grow + defrag (one move at a time) ---------------------
         if self._move is None:
-            self._plan_next_move(arcs, parsed, owned, sched_spec)
+            self._plan_next_move(
+                self._visible_arcs(arcs), parsed, owned, sched_spec
+            )
             busy_move = busy_move or self._move is not None
 
         self._export(arcs, live, parsed, owned)
@@ -466,6 +558,19 @@ class SliceSchedulerReconciler:
     # ------------------------------------------------------------------
     def _first_seen(self, name: str) -> float:
         return self._first_pending.setdefault(name, time.monotonic())
+
+    def _visible_arcs(
+        self, arcs: list[scheduling.Arc], for_request: str = ""
+    ) -> list[scheduling.Arc]:
+        """The arc view ``for_request`` may place onto: an arc a finished
+        reclaim reserved for another claimant is invisible until that
+        claimant binds."""
+        if not self._reserved:
+            return arcs
+        return [
+            a for a in arcs
+            if self._reserved.get(a.key, for_request) == for_request
+        ]
 
     async def _collect_garbage(
         self,
@@ -572,12 +677,21 @@ class SliceSchedulerReconciler:
         first = self._first_pending.pop(request.name, None)
         latency = max(0.0, time.monotonic() - first) if first is not None else 0.0
         self.metrics.slice_placement_latency.observe(latency)
-        armed = self._reclaim_claims.pop(request.name, None)
-        if armed is not None:
-            # reclaim-to-bound: the claimant landed on reclaimed capacity
-            self.metrics.slice_reclaim_latency.observe(
-                max(0.0, time.monotonic() - armed)
-            )
+        claim = self._reclaim_claims.pop(request.name, None)
+        if claim is not None:
+            armed, source_key = claim
+            if any(a.key == source_key for a in grant.arcs):
+                # reclaim-to-bound: the claimant landed on the RECLAIMED
+                # capacity (a bind that found room elsewhere is ordinary
+                # placement, not a reclaim outcome)
+                self.metrics.slice_reclaim_latency.observe(
+                    max(0.0, time.monotonic() - armed)
+                )
+        # the bind consumes any arcs a reclaim had reserved for us
+        for key in [
+            k for k, c in self._reserved.items() if c == request.name
+        ]:
+            del self._reserved[key]
         self.metrics.slice_placements_total.labels(outcome=OUTCOME_PLACED).inc()
         if self.ledger is not None:
             self.ledger.note_grant(
@@ -695,7 +809,9 @@ class SliceSchedulerReconciler:
                 if a.assigned == name else a
                 for a in arcs
             ]
-            grant = scheduling.plan_placement(request, arcs)
+            grant = scheduling.plan_placement(
+                request, self._visible_arcs(arcs, name)
+            )
             lost = ", ".join(a.key for a in held if not a.eligible)
             self.metrics.slice_placements_total.labels(
                 outcome=OUTCOME_PREEMPTED
@@ -964,7 +1080,9 @@ class SliceSchedulerReconciler:
             plan.target.key if plan.target is not None else "",
             plan.granted_topology,
         )
-        self._reclaim_claims[request.name] = self._reclaim.started
+        self._reclaim_claims[request.name] = (
+            self._reclaim.started, plan.source.key,
+        )
         log.info(
             "reclaim armed: guaranteed %s takes %s from %s -> %s",
             plan.claimant, plan.victim, plan.source.key,
@@ -994,14 +1112,31 @@ class SliceSchedulerReconciler:
             self._reclaim = None  # race-ok: single-writer reconcile key
             return False
         target = arcs_by_key.get(rec.target_key) if rec.target_key else None
-        if rec.claimant not in live:
-            await self._reclaim_abort(
-                rec, source,
-                f"claimant {rec.claimant} deleted; reclaim of "
-                f"{rec.victim} aborted",
-                target=target,
-            )
-            return False
+        if not rec.committed:
+            # stand-down window: until the drain moves/retires a pod the
+            # reclaim may abort cleanly.  Past that point it runs to
+            # completion even if the claimant vanishes — the victim's
+            # pods are already draining toward the snapshot, and a
+            # half-parked grant must never be stranded mid-flight.
+            if rec.claimant not in live:
+                await self._reclaim_abort(
+                    rec, source,
+                    f"claimant {rec.claimant} deleted; reclaim of "
+                    f"{rec.victim} aborted",
+                    target=target, victim_cr=victim_cr,
+                )
+                return False
+            if any(a.assigned == rec.claimant for a in arcs):
+                # capacity freed elsewhere and the claimant already bound
+                # through ordinary placement: demoting/parking the victim
+                # now would be pure disruption for nothing
+                await self._reclaim_abort(
+                    rec, source,
+                    f"claimant {rec.claimant} bound elsewhere; reclaim of "
+                    f"{rec.victim} stood down",
+                    target=target, victim_cr=victim_cr,
+                )
+                return False
         if not rec.park:
             if target is None or not target.eligible:
                 # the demotion target degraded between arming and driving:
@@ -1011,7 +1146,7 @@ class SliceSchedulerReconciler:
                     rec, source,
                     f"demotion target {rec.target_key} no longer eligible; "
                     f"reclaim of {rec.victim} aborted",
-                    target=target,
+                    target=target, victim_cr=victim_cr,
                 )
                 return False
             if target.assigned != rec.victim:
@@ -1024,39 +1159,86 @@ class SliceSchedulerReconciler:
             [nodes_by_name[n] for n in target.nodes if n in nodes_by_name]
             if target is not None else []
         )
-        remaining = 0
+        # gather the source's workload pods BEFORE acting: the veto scan
+        # must see them all (never partially drain a vetoed victim), and
+        # under park every restore manifest must be durable in
+        # status.parkedPods before its pod retires
+        source_pods: list[dict] = []
         for node_name in source.nodes:
             pods = await self.reader.list_items(
                 "", "Pod", field_selector=f"spec.nodeName={node_name}"
             )
-            for pod in mig.workload_pods(pods, node_name):
-                if not mig.is_migratable(pod):
-                    # zero-loss or nothing: a pod that cannot checkpoint
-                    # vetoes this victim; the planner tries another
-                    self._move_veto[(rec.victim, rec.source_key)] = (
-                        time.monotonic() + MOVE_VETO_RETRY_SECONDS
-                    )
-                    await self._reclaim_abort(
-                        rec, source,
-                        f"pod {pod['metadata']['name']} on {node_name} did "
-                        f"not opt into migration; reclaim of {rec.victim} "
-                        "vetoed (demote-or-park, never kill)",
-                        target=target,
-                    )
-                    return False
-                if rec.park:
-                    # capture the restore manifest BEFORE the drain
-                    # retires the pod: the parked snapshot must include
-                    # the spec that can bring the workload back
-                    rec.captured.setdefault(
-                        pod["metadata"]["name"], _sanitize_pod(pod)
-                    )
-                outcome = await self.migration.drain_pod(
-                    pod, migration_spec, "slicescheduler",
-                    nodes=target_nodes, park=rec.park,
+            source_pods.extend(mig.workload_pods(pods, node_name))
+        for pod in source_pods:
+            if mig.is_migratable(pod):
+                continue
+            if rec.committed:
+                # the opt-in was revoked after a sibling pod already
+                # moved/retired: too late to stand down, and never kill —
+                # hold the reclaim open until the pod opts back in,
+                # finishes, or is deleted
+                log.warning(
+                    "reclaim of %s wedged: pod %s revoked its migration "
+                    "opt-in mid-drain", rec.victim, pod["metadata"]["name"],
                 )
-                if outcome == mig.PENDING:
-                    remaining += 1
+                return True
+            # zero-loss or nothing: a pod that cannot checkpoint vetoes
+            # this victim; the planner tries another
+            self._move_veto[(rec.victim, rec.source_key)] = (
+                time.monotonic() + MOVE_VETO_RETRY_SECONDS
+            )
+            await self._reclaim_abort(
+                rec, source,
+                f"pod {pod['metadata']['name']} on "
+                f"{deep_get(pod, 'spec', 'nodeName', default='')} did "
+                f"not opt into migration; reclaim of {rec.victim} "
+                "vetoed (demote-or-park, never kill)",
+                target=target, victim_cr=victim_cr,
+            )
+            return False
+        if rec.park:
+            # capture the restore manifests and write them through to
+            # status.parkedPods BEFORE any drain step may retire a pod:
+            # the park must be finishable from the CR alone if the
+            # operator dies between a pod's delete and _finish_park
+            for pod in source_pods:
+                rec.captured.setdefault(
+                    pod["metadata"]["name"], _sanitize_pod(pod)
+                )
+            if set(rec.captured) != rec.persisted:
+                await self._persist_captured(rec, victim_cr)
+        remaining = 0
+        for pod in source_pods:
+            outcome = await self.migration.drain_pod(
+                pod, migration_spec, "slicescheduler",
+                nodes=target_nodes, park=rec.park,
+            )
+            if outcome == mig.PENDING:
+                remaining += 1
+            elif rec.park and outcome == mig.TIMEOUT:
+                # the checkpoint blew migration.timeoutSeconds but the
+                # pod is alive: the park path never takes the evict
+                # fallback (killing it would lose progress past the last
+                # published snapshot)
+                if rec.committed:
+                    remaining += 1  # out-wait it; never kill
+                    continue
+                self._move_veto[(rec.victim, rec.source_key)] = (
+                    time.monotonic() + MOVE_VETO_RETRY_SECONDS
+                )
+                await self._reclaim_abort(
+                    rec, source,
+                    f"pod {pod['metadata']['name']} did not publish its "
+                    "park checkpoint within migration.timeoutSeconds; "
+                    f"reclaim of {rec.victim} vetoed "
+                    "(demote-or-park, never kill)",
+                    target=target, victim_cr=victim_cr,
+                )
+                return False
+            else:
+                # a terminal outcome moved/retired this pod: past the
+                # stand-down window, the reclaim now runs to completion
+                rec.committed = True
         if remaining:
             return True
 
@@ -1067,15 +1249,54 @@ class SliceSchedulerReconciler:
         self._reclaim = None  # race-ok: single-writer reconcile key
         return False
 
+    async def _persist_captured(
+        self, rec: _Reclaim, victim_cr: TPUSliceRequest
+    ) -> None:
+        """Durably mirror the captured restore manifests (and the
+        claimant) into the victim's status BEFORE any pod retires: an
+        operator crash mid-park must be able to finish the park from the
+        CR alone — an in-memory-only manifest dies with the process
+        while the drain has already deleted its pod, silently killing
+        the workload."""
+        st = victim_cr.status
+        await self._set_status(
+            victim_cr, str(st.get("phase") or SlicePhase.BOUND),
+            message=str(st.get("message") or ""),
+            granted=str(st.get("grantedTopology") or ""),
+            chips=int(st.get("chips") or 0),
+            arcs=list(st.get("arcs") or []),
+            parked_pods=list(rec.captured.values()),
+            parked_since=str(st.get("parkedSince") or ""),
+            reclaim_claimant=rec.claimant,
+            refresh=True,
+        )
+        rec.persisted = set(rec.captured)
+
     async def _reclaim_abort(
         self,
         rec: _Reclaim,
         source: scheduling.Arc,
         message: str,
         target: Optional[scheduling.Arc] = None,
+        victim_cr: Optional[TPUSliceRequest] = None,
     ) -> None:
         if target is not None:
             await self._release_arc(target, rec.victim)
+        if rec.park and rec.persisted and victim_cr is not None:
+            # clear the incremental park mirror: an aborted (uncommitted)
+            # park retired no pod, and a Bound CR left carrying
+            # parkedPods would read as an interrupted park to the
+            # restart-reconstruction path
+            st = victim_cr.status
+            await self._set_status(
+                victim_cr, str(st.get("phase") or SlicePhase.BOUND),
+                message=str(st.get("message") or ""),
+                granted=str(st.get("grantedTopology") or ""),
+                chips=int(st.get("chips") or 0),
+                arcs=list(st.get("arcs") or []),
+                refresh=True,
+            )
+            rec.persisted = set()
         self.metrics.slice_preemptions_total.labels(  # ledger-ok: no chips moved
             outcome=OUTCOME_RECLAIM_FAILED
         ).inc()
@@ -1102,6 +1323,11 @@ class SliceSchedulerReconciler:
         """Source drained onto the smaller target: release the source for
         the claimant and flip the victim's grant to its demoted shape."""
         await self._release_arc(source, rec.victim)
+        if rec.claimant:
+            # the freed arc is FOR the claimant: reserve it until the
+            # claimant binds, or this pass would hand it right back to a
+            # higher-priority pending/parked request
+            self._reserved[rec.source_key] = rec.claimant
         await self._set_status(
             victim_cr, SlicePhase.BOUND,
             message=(
@@ -1148,6 +1374,13 @@ class SliceSchedulerReconciler:
         capacity to restore onto: release the arc and move the CR to
         Parked — it auto-resumes the moment capacity returns."""
         await self._release_arc(source, rec.victim)
+        if rec.claimant:
+            # reserve the freed arc for the claimant until it binds —
+            # without this, the SAME pass re-places a higher-priority
+            # parked victim onto the arc it just vacated and the
+            # claimant re-arms a reclaim next pass (park/resume thrash
+            # with real checkpoint-restore churn)
+            self._reserved[rec.source_key] = rec.claimant
         since = nodestate.now_ts()
         pods = list(rec.captured.values())
         self._parks[rec.victim] = _Park(pods=pods, since=since)
@@ -1288,6 +1521,8 @@ class SliceSchedulerReconciler:
         arcs: Optional[list[dict]] = None,
         parked_pods: Optional[list[dict]] = None,
         parked_since: str = "",
+        reclaim_claimant: str = "",
+        refresh: bool = False,
     ) -> None:
         desired = {
             "phase": phase,
@@ -1299,6 +1534,9 @@ class SliceSchedulerReconciler:
             # (restart reconstruction); cleared by any non-park transition
             "parkedPods": parked_pods or [],
             "parkedSince": parked_since,
+            # the guaranteed request an in-flight park is draining for
+            # (restart reconstruction of the interrupted reclaim)
+            "reclaimClaimant": reclaim_claimant,
         }
         current = {
             k: (cr.status.get(k) or ([] if k == "arcs" else type(v)()))
@@ -1314,12 +1552,24 @@ class SliceSchedulerReconciler:
         }
         obj["status"] = {**cr.status, **desired}
         try:
-            await self.reader.update_status(obj)
+            updated = await self.reader.update_status(obj)
         except ApiError as e:
             if e.conflict:
                 log.debug("status conflict on %s; next pass re-asserts", cr.name)
             elif not e.not_found:
                 raise
+        else:
+            if refresh:
+                # ``refresh`` folds the server's view back into this
+                # pass's CR so an INTENTIONAL second status transition on
+                # the same object within one pass (park persist ->
+                # Parked flip) carries a fresh resourceVersion.  It is
+                # opt-in: everywhere else the first writer in a pass
+                # wins and a later write drops on the conflict — e.g.
+                # the heal path's "capacity lost" must survive the
+                # pending loop's generic re-mark in the same pass.
+                cr.obj.clear()
+                cr.obj.update(updated)
 
     def _export(
         self,
