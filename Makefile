@@ -8,13 +8,13 @@ PYTHON ?= python
 # and `coroutine ... was never awaited` promoted from warning to error
 SAN_ENV = env PYTHONASYNCIODEBUG=1 PYTHONFAULTHANDLER=1 PYTHONWARNINGS=error:coroutine:RuntimeWarning
 
-.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak goodput preempt-soak straggler fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
+.PHONY: test unit-test proto manifests goldens bench bench-reconcile bench-join chaos chaos-health chaos-migrate slice-churn serve-soak serve-fleet goodput preempt-soak straggler fleet-obs lint lint-all race counters-docs async-lint except-lint metric-labels trace-lint atomic-lint delta-lint all image e2e-kind
 
 all: proto manifests test
 
 # default test target = the unified analysis gate + the seeded race sweep
 # + the tier-1 pytest line CI runs + the seeded chaos acceptance soaks
-test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak goodput preempt-soak straggler fleet-obs bench-join
+test: lint lint-all race unit-test chaos chaos-health chaos-migrate slice-churn serve-soak serve-fleet goodput preempt-soak straggler fleet-obs bench-join
 
 # the unified analysis plane (tpu_operator/analysis/;
 # docs/STATIC_ANALYSIS.md): every rule below plus the async-race, fence-
@@ -188,6 +188,20 @@ slice-churn:
 # live on /debug/fleet (docs/SERVING.md)
 serve-soak:
 	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --serve --nodes $(CHAOS_NODES) --seed $(CHAOS_SEED)
+
+# front-door fleet acceptance soak (chip-free; ~1-2 min): one logical
+# endpoint (serving/frontdoor.py) routes session-affine seeded traffic
+# over an AUTOSCALED replica fleet — the queue-depth control law raises
+# desired replicas, the ServeScaler actuates tiered TPUSliceRequest
+# slots, the slice scheduler binds them, and a mid-ramp quarantine must
+# land as ONE live migration through the drain handoff
+# (checkpoint → park → restore → replay).  Gated: zero failed requests
+# end to end (sheds are honest 429s), exact decode billing, replica
+# count tracks load up past the floor and back down, the serving TPOT
+# SLO never fires, steady-state verbs return to 0
+# (docs/SERVING.md "Front door")
+serve-fleet:
+	$(SAN_ENV) JAX_PLATFORMS=cpu $(PYTHON) bench.py --serve-fleet --nodes 16 --seed $(CHAOS_SEED)
 
 # chip-time accounting acceptance soak (chip-free; ~2-3 min): the same
 # mid-training reclaim runs twice — once through the migration path

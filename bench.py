@@ -1498,6 +1498,676 @@ def run_serve_soak(n_nodes: int = 100, seed: int = 1) -> dict:
     return result
 
 
+# serve-fleet soak knobs: the ramp's peak offered load must exceed what
+# ONE replica can retire (the scale-up gate is meaningless otherwise) and
+# the final rate must sit far enough under one replica's capacity that
+# the autoscaler provably shrinks back to the floor
+SERVE_FLEET_MIN_REPLICAS = 2
+SERVE_FLEET_MAX_REPLICAS = 5
+SERVE_FLEET_PEAK_RPS = 14.0
+SERVE_FLEET_COOL_RPS = 0.5
+
+
+async def _serve_fleet_soak(n_nodes: int, seed: int) -> dict:
+    """The front-door fleet acceptance soak (`make serve-fleet`;
+    docs/SERVING.md "The fleet soak").
+
+    One logical endpoint (``serving/frontdoor.py``) over an AUTOSCALED
+    replica fleet on a converged fake cluster: session-affine seeded
+    traffic ramps past any single replica's capacity, the queue-depth
+    control law (``serving/autoscaler.py``) raises the desired count, the
+    ``ServeScaler`` actuates it as tiered ``TPUSliceRequest`` slots
+    (guaranteed floor + reclaimable burst), the slice scheduler binds
+    them, and a binder loop turns each Bound slot into a migratable
+    replica pod whose executor attaches an in-process ``LocalReplica`` to
+    the door.  Routing reads ONLY the pushed ``tpu_workload_serving_*``
+    rollups (flight counters -> ``ingest_push`` -> ``serving_view`` —
+    the same data ``/debug/fleet`` serves), never the engines directly.
+
+    Mid-ramp, a seeded agent fault quarantines one replica's node: the
+    health engine drains it through the PR-8 migration path and the
+    migrate annotation lands at ``FrontDoor.drain_replica`` (checkpoint +
+    park), the restore pod re-attaches via ``restore_replica`` (resume
+    the snapshot's schedule, replay the parked arrivals) — one live
+    migration riding the quarantine, requests continuing EXACTLY once.
+
+    Gates: zero failed requests end to end (admission sheds are honest
+    429s, counted separately), every accepted rid completes with exact
+    token billing (no duplicate decode billed), the quarantine lands as a
+    ``reason=migrated`` health eviction with a restored handoff, the
+    replica count observably tracks load up (>= 3 ready at peak) and back
+    down (floor at the end), the serving TPOT SLO never fires, the
+    serving rollups are live on ``/debug/fleet``, and the operator
+    returns to its zero-write steady state with the fleet still serving.
+    """
+    import tempfile
+    import threading
+
+    import aiohttp
+
+    from tpu_operator import consts
+    from tpu_operator.api.types import (
+        CLUSTER_POLICY_KIND, GROUP, SLICE_REQUEST_KIND, State,
+        TPUClusterPolicy,
+    )
+    from tpu_operator.controllers.clusterpolicy import ClusterPolicyReconciler
+    from tpu_operator.controllers.health import HealthReconciler
+    from tpu_operator.controllers.runtime import Manager
+    from tpu_operator.controllers.servescaler import ServeScaler
+    from tpu_operator.controllers.slicescheduler import SliceSchedulerReconciler
+    from tpu_operator.k8s.client import ApiClient, ApiError, Config
+    from tpu_operator.metrics import OperatorMetrics
+    from tpu_operator.obs import flight as flight_api
+    from tpu_operator.obs.events import EventRecorder
+    from tpu_operator.obs.fleet import FleetAggregator
+    from tpu_operator.obs.trace import Tracer
+    from tpu_operator.serving import (
+        AutoscaleConfig, FrontDoor, FrontDoorConfig, LocalReplica,
+        ReplicaAutoscaler, SessionTraffic,
+    )
+    from tpu_operator.serving.frontdoor import PARKED, READY, UNKNOWN
+    from tpu_operator.testing import FakeCluster, SimConfig
+    from tpu_operator.utils import deep_get
+    from tpu_operator.workloads.serving import ServeConfig
+
+    # each pool is one 2x4 arc (two 4-chip hosts); the scheduler binds a
+    # slot per pool, so the fleet must hold at least MAX_REPLICAS pools
+    # with headroom for the quarantine's restore target
+    if n_nodes < 16:
+        raise ValueError(
+            f"--serve-fleet needs --nodes >= 16 (8 whole pools), got {n_nodes}"
+        )
+    result: dict = {"nodes": n_nodes, "seed": seed}
+    failures: list[str] = []
+    workdir = tempfile.mkdtemp(prefix="serve-fleet-")
+
+    def _ckpt_dir(slot: str) -> str:
+        return os.path.join(workdir, f"ckpt-{slot}")
+
+    def _serve_cfg(slot: str) -> ServeConfig:
+        # max_batch bounds one replica's decode rate at ~2 tokens per
+        # router tick: the ramp's peak offered token rate then needs >= 3
+        # replicas, which is what the scale-up gate asserts
+        return ServeConfig(
+            name=slot, num_blocks=96, block_tokens=16, max_batch=2,
+        )
+
+    # -- the door, the control law, the traffic -------------------------
+    fd = FrontDoor(FrontDoorConfig(
+        stale_after_s=1.0, dead_after_s=2.5, hedge_after_s=0.75,
+        retry_budget=6, shed_queue_depth=12.0,
+    ))
+    autoscaler = ReplicaAutoscaler(AutoscaleConfig(
+        min_replicas=SERVE_FLEET_MIN_REPLICAS,
+        max_replicas=SERVE_FLEET_MAX_REPLICAS,
+        up_after_s=1.0, down_after_s=2.5, cooldown_s=1.0,
+        idle_queue_depth=0.5, busy_queue_depth=2.5,
+    ))
+    traffic = SessionTraffic(
+        rate=0.0, n_sessions=12, new_tokens=(8, 16), seed=seed,
+    )
+    accepted: dict[str, int] = {}
+    shed_count = 0
+    scale_max_ready = 0
+    exec_events: dict[str, threading.Event] = {}
+
+    def _fleet_executor(pod: dict) -> str:
+        """The replica pod's 'process': attach an in-process LocalReplica
+        to the door (restore path when the slot is PARKED — the restore
+        pod of a drain handoff), then hold the pod Running until the
+        binder or the drain mirror releases it."""
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get("app") != "serve-fd":
+            return "Succeeded"
+        name = pod["metadata"]["name"]
+        slot = labels.get("serve-slot") or ""
+        spec = pod.get("spec") or {}
+        node = spec.get("nodeName") or (
+            (spec.get("nodeSelector") or {}).get("kubernetes.io/hostname")
+        ) or ""
+        stop = exec_events.setdefault(name, threading.Event())
+        try:
+            if fd.replica_states().get(slot) == PARKED:
+                replica, _extra = LocalReplica.restore(
+                    slot, _serve_cfg(slot), _ckpt_dir(slot), node=node,
+                )
+                fd.restore_replica(slot, replica, node=node)
+            else:
+                fd.add_replica(
+                    slot, LocalReplica(slot, _serve_cfg(slot), node=node),
+                    node=node, ckpt_dir=_ckpt_dir(slot),
+                )
+        except Exception:  # noqa: BLE001 — a failed attach must fail the pod
+            return "Failed"
+        stop.wait(timeout=240)
+        return "Succeeded"
+
+    def _replica_pod(slot: str, node: str) -> dict:
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": slot, "namespace": "default",
+                "labels": {
+                    "app": "serve-fd",
+                    "serve-slot": slot,
+                    consts.MIGRATE_HANDLER_LABEL:
+                        consts.MIGRATION_HANDLER_CHECKPOINT,
+                },
+            },
+            "spec": {
+                "nodeName": node,
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "serve",
+                    "image": "serve-replica:dev",
+                    "resources": {"limits": {consts.TPU_RESOURCE: "4"}},
+                    "env": [],
+                }],
+            },
+        }
+
+    health_spec = {
+        "failureThreshold": 2, "windowSeconds": 4, "cleanSeconds": 3,
+        "escalationBackoffSeconds": 1, "maxUnhealthyPercent": "20%",
+        "flapMaxTrips": 99, "flapWindowSeconds": 60,
+    }
+    # TPOT only: the throughput SLO of the serve soak would fire by
+    # construction when the ramp-down drains offered load to zero
+    slos = [{
+        "name": "serving-tpot",
+        "metric": "tpu_workload_serving_tpot_p99_seconds",
+        "comparison": "le", "threshold": SERVE_TPOT_SLO_S,
+        "objective": 0.9, "windows": [5, 20],
+        "burnRateThreshold": 2.0, "minSamples": 3,
+    }]
+
+    sim = SimConfig(tick=0.02, pod_ready_delay=0.05, pod_executor=_fleet_executor)
+    tasks: list[asyncio.Task] = []
+    async with FakeCluster(sim) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        metrics = OperatorMetrics()
+        client.metrics = metrics
+        recorder = EventRecorder(client, NS)
+        fleet = FleetAggregator(metrics)
+        tracer = Tracer(metrics, fleet=fleet)
+        mgr = Manager(
+            client, NS, metrics_port=0, health_port=-1,
+            metrics_registry=metrics.registry, recorder=recorder,
+            operator_metrics=metrics, tracer=tracer, fleet=fleet,
+            fleet_eval_interval=0.25,
+        )
+        obs = dict(metrics=metrics, recorder=recorder, tracer=tracer)
+        reconciler = ClusterPolicyReconciler(client, NS, fleet=fleet, **obs)
+        reconciler.setup(mgr)
+        HealthReconciler(client, NS, fleet=fleet, **obs).setup(mgr)
+        SliceSchedulerReconciler(client, NS, fleet=fleet, **obs).setup(mgr)
+        scaler = ServeScaler(
+            client, lambda: autoscaler.desired, topology="2x4",
+            guaranteed_floor=SERVE_FLEET_MIN_REPLICAS,
+        )
+
+        # -- the driver: traffic -> door -> pushed evidence -> control --
+        async def _drive() -> None:
+            nonlocal shed_count, scale_max_ready
+            while True:
+                now = time.time()
+                for sid, req in traffic.due(now):
+                    v = fd.submit(
+                        sid, req.prompt, req.max_new_tokens,
+                        now=now, rid=req.rid,
+                    )
+                    if v["status"] == "accepted":
+                        accepted[req.rid] = req.max_new_tokens
+                    else:
+                        shed_count += 1
+                fd.tick(now)
+                # the evidence hop: each live replica's flight counters
+                # ride ingest_push exactly as the agent forwards them; the
+                # router then reads the freshness-stamped serving_view —
+                # the SAME rollups /debug/fleet publishes
+                for slot, rep in list(fd._replicas.items()):
+                    t = rep.handle.telemetry(now) if rep.handle else None
+                    if t is None:
+                        continue  # dead/blackholed replicas push nothing
+                    counters = {
+                        flight_api.COUNTER_KEYS[k]: float(v)
+                        for k, v in t.items()
+                        if k in flight_api.COUNTER_KEYS
+                        and isinstance(v, (int, float))
+                    }
+                    if counters:
+                        fleet.ingest_push({
+                            "node": rep.node,
+                            "workloads": {slot: {"counters": counters}},
+                        })
+                fd.observe_fleet(
+                    fleet.serving_view(now, stale_after_s=fd.cfg.stale_after_s),
+                    now,
+                )
+                burning = any(
+                    name.startswith("serving-")
+                    for name in fleet.slo_engine.breached_slos()
+                )
+                autoscaler.observe(
+                    now, fd.ready_count(), fd.mean_queue_depth(), burning,
+                )
+                scale_max_ready = max(scale_max_ready, fd.ready_count())
+                await asyncio.sleep(0.03)
+
+        async def _scale_loop() -> None:
+            while True:
+                try:
+                    await scaler.reconcile_once()
+                except (ApiError, OSError):
+                    pass  # transient API fault: next pass re-lists
+                await asyncio.sleep(0.4)
+
+        # -- the binder: Bound slot -> replica pod; slot gone -> retire --
+        created_slots: set[str] = set()
+        cleaned_pods: set[str] = set()
+
+        def _slot_pods() -> dict[str, list]:
+            out: dict[str, list] = {}
+            for (_, pname), pod in list(fc.store("", "pods").objects.items()):
+                labels = pod["metadata"].get("labels") or {}
+                if labels.get("app") != "serve-fd":
+                    continue
+                out.setdefault(labels.get("serve-slot") or "", []).append(
+                    (pname, pod)
+                )
+            return out
+
+        async def _bind_loop() -> None:
+            while True:
+                try:
+                    listing = await client.list(GROUP, SLICE_REQUEST_KIND)
+                except (ApiError, OSError):
+                    listing = {}
+                bound: dict[str, str] = {}
+                cr_names: set[str] = set()
+                for item in listing.get("items") or []:
+                    name = (item.get("metadata") or {}).get("name") or ""
+                    if not name.startswith(scaler.prefix):
+                        continue
+                    cr_names.add(name)
+                    status = item.get("status") or {}
+                    arcs = status.get("arcs") or []
+                    if status.get("phase") == "Bound" and arcs:
+                        bound[name] = arcs[0]["nodes"][0]
+                pods = _slot_pods()
+                for slot, node in bound.items():
+                    # one pod per CR lifetime: the migration path owns all
+                    # later pods for the slot (-migN restores), so a
+                    # Succeeded husk must never trigger a duplicate create
+                    if slot in created_slots or pods.get(slot):
+                        continue
+                    try:
+                        await client.create(_replica_pod(slot, node))
+                        created_slots.add(slot)
+                    except (ApiError, OSError):
+                        pass
+                for slot in sorted(created_slots):
+                    if slot in cr_names:
+                        continue
+                    # slot reclaimed by the scaler: graceful retire — no
+                    # new work routes there, the pod leaves once the door
+                    # reaps the emptied replica
+                    fd.retire_replica(slot)
+                    if slot in fd.replica_states():
+                        continue
+                    for pname, _pod in pods.get(slot) or []:
+                        ev = exec_events.get(pname)
+                        if ev is not None:
+                            ev.set()
+                        if pname not in cleaned_pods:
+                            cleaned_pods.add(pname)
+                            try:
+                                await client.delete("", "Pod", pname, "default")
+                            except (ApiError, OSError):
+                                pass
+                    if not pods.get(slot):
+                        created_slots.discard(slot)
+                await asyncio.sleep(0.15)
+
+        # -- the drain mirror: migrate annotation -> checkpoint handoff --
+        drained_pods: set[str] = set()
+
+        async def _migrate_mirror() -> None:
+            while True:
+                for (_, pname), pod in list(
+                    fc.store("", "pods").objects.items()
+                ):
+                    labels = pod["metadata"].get("labels") or {}
+                    if labels.get("app") != "serve-fd" or pname in drained_pods:
+                        continue
+                    anns = pod["metadata"].get("annotations") or {}
+                    if anns.get(consts.MIGRATE_ANNOTATION) != (
+                        consts.MIGRATE_REQUESTED
+                    ):
+                        continue
+                    slot = labels.get("serve-slot") or ""
+                    if fd.replica_states().get(slot) not in (READY, UNKNOWN):
+                        continue
+                    drained_pods.add(pname)
+                    # drain_replica IS the pod's checkpoint handler: once
+                    # it returns the snapshot is published, so releasing
+                    # the executor (pod Succeeded) tells drain_pod to
+                    # create the restore pod
+                    try:
+                        fd.drain_replica(slot, ckpt_dir=_ckpt_dir(slot))
+                    except Exception:  # noqa: BLE001 — a dead handle has nothing to drain
+                        pass
+                    ev = exec_events.get(pname)
+                    if ev is not None:
+                        ev.set()
+                await asyncio.sleep(0.05)
+
+        try:
+            async with mgr:
+                await client.create(TPUClusterPolicy.new(spec={
+                    "health": health_spec,
+                    "remediation": {"enabled": False},
+                    "migration": {"timeoutSeconds": 30},
+                    "observability": {"slos": slos},
+                }).obj)
+                for i in range(n_nodes):
+                    s, h = divmod(i, 2)
+                    fc.add_node(f"tpu-{s}-{h}", topology="2x4", labels={
+                        consts.GKE_NODEPOOL_LABEL: f"pool-{s}",
+                        consts.GKE_TPU_WORKER_ID_LABEL: str(h),
+                    })
+
+                async def _converged() -> bool:
+                    cr = await client.get(
+                        GROUP, CLUSTER_POLICY_KIND, "cluster-policy"
+                    )
+                    if deep_get(cr, "status", "state") != State.READY:
+                        return False
+                    nodes = await client.list_items("", "Node")
+                    return len(nodes) == n_nodes and all(
+                        consts.TPU_RESOURCE
+                        in (deep_get(n, "status", "allocatable") or {})
+                        for n in nodes
+                    )
+
+                t0 = time.perf_counter()
+                while not await _converged():
+                    if time.perf_counter() - t0 > SERVE_SOAK_TIMEOUT:
+                        raise TimeoutError("pipeline never converged pre-soak")
+                    await asyncio.sleep(0.2)
+                result["converge_s"] = round(time.perf_counter() - t0, 3)
+                base_url = f"http://127.0.0.1:{mgr.metrics_port}"
+
+                tasks = [
+                    asyncio.create_task(_drive()),
+                    asyncio.create_task(_scale_loop()),
+                    asyncio.create_task(_bind_loop()),
+                    asyncio.create_task(_migrate_mirror()),
+                ]
+
+                # -- floor up: the scaler's guaranteed slots come alive --
+                t1 = time.perf_counter()
+                while fd.ready_count() < SERVE_FLEET_MIN_REPLICAS:
+                    if time.perf_counter() - t1 > 60:
+                        raise TimeoutError(
+                            "guaranteed floor never came up: "
+                            f"{fd.replica_states()}"
+                        )
+                    await asyncio.sleep(0.2)
+                result["floor_up_s"] = round(time.perf_counter() - t1, 3)
+
+                # -- ramp past one replica's capacity --------------------
+                for rate in (4.0, 8.0):
+                    traffic.rate = rate
+                    await asyncio.sleep(2.0)
+                traffic.rate = SERVE_FLEET_PEAK_RPS
+                t2 = time.perf_counter()
+                while fd.ready_count() < 3:
+                    if time.perf_counter() - t2 > 60:
+                        raise TimeoutError(
+                            "autoscaler never grew past the floor under the "
+                            f"ramp: desired={autoscaler.desired} "
+                            f"states={fd.replica_states()}"
+                        )
+                    await asyncio.sleep(0.2)
+                result["scale_up_s"] = round(time.perf_counter() - t2, 3)
+
+                # -- mid-ramp: quarantine one replica's node -------------
+                victim = "serve-fd-0"
+                victim_node = fd._replicas[victim].node
+                result["quarantined_node"] = victim_node
+                fc.set_agent_health(
+                    victim_node, "unhealthy", "chip-scrape-failed"
+                )
+                t3 = time.perf_counter()
+                while time.perf_counter() - t3 < 90.0:
+                    if (
+                        fd.counts["handoff_restored"] >= 1
+                        and _counter_value(
+                            metrics, "tpu_operator_drain_evictions",
+                            controller="health", reason="migrated",
+                        ) >= 1
+                    ):
+                        break
+                    await asyncio.sleep(0.25)
+                result["quarantine_migrate_s"] = round(
+                    time.perf_counter() - t3, 3
+                )
+
+                # hold the peak briefly with the restored replica serving
+                await asyncio.sleep(2.0)
+
+                # -- the rollups must be LIVE on /debug/fleet ------------
+                async with aiohttp.ClientSession() as http:
+                    async with http.get(f"{base_url}/debug/fleet") as resp:
+                        snap = await resp.json()
+                serving_key = snap.get("serving") or {}
+                result["debug_fleet_replicas"] = sorted(serving_key)
+                fresh_replicas = [
+                    name for name, entry in serving_key.items()
+                    if entry.get("fresh")
+                ]
+                if not fresh_replicas:
+                    failures.append(
+                        "/debug/fleet 'serving' key carries no fresh "
+                        f"replica rollups: {sorted(serving_key)}"
+                    )
+
+                # -- cool down: the fleet must shrink back to the floor --
+                traffic.rate = SERVE_FLEET_COOL_RPS
+                t4 = time.perf_counter()
+                while time.perf_counter() - t4 < 90.0:
+                    try:
+                        listing = await client.list(GROUP, SLICE_REQUEST_KIND)
+                    except (ApiError, OSError):
+                        listing = {}
+                    n_slots = sum(
+                        1 for item in listing.get("items") or []
+                        if ((item.get("metadata") or {}).get("name") or "")
+                        .startswith(scaler.prefix)
+                    )
+                    if (
+                        autoscaler.desired == SERVE_FLEET_MIN_REPLICAS
+                        and n_slots == SERVE_FLEET_MIN_REPLICAS
+                        and len(fd.replica_states())
+                        == SERVE_FLEET_MIN_REPLICAS
+                    ):
+                        break
+                    await asyncio.sleep(0.25)
+                result["scale_down_s"] = round(time.perf_counter() - t4, 3)
+
+                # -- stop the stream; every accepted rid must finish -----
+                traffic.rate = 0.0
+                t5 = time.perf_counter()
+                while fd._tracks or fd._waiting:
+                    if time.perf_counter() - t5 > 60:
+                        break
+                    await asyncio.sleep(0.2)
+
+                stats = fd.stats(time.time())
+                result["frontdoor"] = {
+                    "counts": stats["counts"],
+                    "replicas": stats["replicas"],
+                    "sheds": shed_count,
+                    "max_ready": scale_max_ready,
+                    "final_ready": fd.ready_count(),
+                    "final_desired": autoscaler.desired,
+                    "accepted": len(accepted),
+                    "ttft_p99_s": stats["ttft_p99_s"],
+                    "tpot_p99_s": stats["tpot_p99_s"],
+                }
+
+                # -- zero-loss + exact-billing gates ---------------------
+                if not accepted:
+                    failures.append("the stream never carried real work")
+                if stats["counts"]["failed"] or stats["failed_rids"]:
+                    failures.append(
+                        f"{stats['counts']['failed']} failed requests "
+                        f"({stats['failed_rids'][:5]}...) — the front door "
+                        "lost work"
+                    )
+                unfinished = 0
+                for rid, max_new in accepted.items():
+                    res = fd.result(rid)
+                    if res is None or res["state"] != "done" or (
+                        res["delivered"] != max_new
+                    ):
+                        unfinished += 1
+                if unfinished:
+                    failures.append(
+                        f"{unfinished}/{len(accepted)} accepted requests "
+                        "never completed exactly"
+                    )
+                if stats["counts"]["tokens_billed"] != sum(accepted.values()):
+                    failures.append(
+                        "decode billing drifted: billed "
+                        f"{stats['counts']['tokens_billed']} != accepted "
+                        f"{sum(accepted.values())} (dups "
+                        f"{stats['counts']['dup_tokens']})"
+                    )
+
+                # -- scaling + handoff gates -----------------------------
+                if scale_max_ready < 3:
+                    failures.append(
+                        f"fleet never grew past the floor (max ready "
+                        f"{scale_max_ready}) — the ramp must force scale-up"
+                    )
+                if fd.ready_count() != SERVE_FLEET_MIN_REPLICAS:
+                    failures.append(
+                        f"fleet did not shrink back to the floor: "
+                        f"{fd.replica_states()}"
+                    )
+                if stats["counts"]["handoff_restored"] < 1:
+                    failures.append(
+                        "the quarantine never produced a restored drain "
+                        "handoff"
+                    )
+                result["evictions"] = {
+                    reason: _counter_value(
+                        metrics, "tpu_operator_drain_evictions",
+                        controller="health", reason=reason,
+                    )
+                    for reason in (
+                        "migrated", "timeout", "failed", "no-handler",
+                        "forced",
+                    )
+                }
+                if result["evictions"].get("migrated", 0) < 1:
+                    failures.append(
+                        "drain_evictions_total{controller=health,"
+                        "reason=migrated} == 0 — the quarantine was not a "
+                        "live migration"
+                    )
+                bad_evictions = {
+                    r: n for r, n in result["evictions"].items()
+                    if r != "migrated" and n
+                }
+                if bad_evictions:
+                    failures.append(
+                        f"non-migrated health evictions: {bad_evictions}"
+                    )
+
+                # -- SLO verdict through the disruption ------------------
+                slo_state = snap.get("slos") or {}
+                result["slos"] = {
+                    name: {"breached": entry.get("breached")}
+                    for name, entry in slo_state.items()
+                }
+                reasons = {
+                    e.get("reason"): e.get("message", "")
+                    for e in fc.store("", "events").objects.values()
+                }
+                serving_burns = [
+                    msg for reason, msg in reasons.items()
+                    if reason == "SLOBurnRate" and "serving-" in (msg or "")
+                ]
+                result["serving_slo_burns"] = serving_burns
+                if "serving-tpot" not in slo_state:
+                    failures.append("SLO serving-tpot never configured")
+                if serving_burns:
+                    failures.append(
+                        f"serving SLO fired through the soak: {serving_burns}"
+                    )
+
+                # -- zero-write steady state, fleet still serving --------
+                steady = None
+                t6 = time.perf_counter()
+                while True:
+                    fc.reset_request_counts()
+                    await asyncio.sleep(2.5)
+                    steady = _nonlease_writes(fc)
+                    if steady == 0 or time.perf_counter() - t6 > 60:
+                        break
+                result["steady_writes"] = steady
+                result["steady_settle_s"] = round(time.perf_counter() - t6, 3)
+                if steady:
+                    failures.append(
+                        f"{steady} mutating verbs per window at steady "
+                        "state (expected 0)"
+                    )
+        finally:
+            for task in tasks:
+                task.cancel()
+            for task in tasks:
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — a crashed loop IS a soak failure, never silent
+                    failures.append(
+                        f"background loop died: {type(e).__name__}: {e}"
+                    )
+            # release every parked executor thread before the cluster exits
+            for ev in exec_events.values():
+                ev.set()
+            await client.close()
+
+    result["ok"] = not failures
+    result["failures"] = failures
+    return result
+
+
+def run_serve_fleet_soak(n_nodes: int = 16, seed: int = 1) -> dict:
+    print(f"  serve-fleet soak: {n_nodes} nodes, seed={seed}", file=sys.stderr)
+    result = asyncio.run(_serve_fleet_soak(n_nodes, seed))
+    for f in result["failures"]:
+        print(f"  serve-fleet FAILURE: {f}", file=sys.stderr)
+    door = result.get("frontdoor") or {}
+    counts = door.get("counts") or {}
+    print(
+        f"  serve-fleet: {door.get('accepted')} accepted "
+        f"({door.get('sheds')} shed), failed {counts.get('failed')}, "
+        f"ready {SERVE_FLEET_MIN_REPLICAS}->{door.get('max_ready')}->"
+        f"{door.get('final_ready')}, handoffs restored "
+        f"{counts.get('handoff_restored')}, evictions "
+        f"{result.get('evictions')}, steady writes "
+        f"{result.get('steady_writes')}, "
+        f"{'OK' if result['ok'] else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return result
+
+
 async def _chaos_migrate_soak(n_nodes: int, seed: int) -> dict:
     """The live-migration acceptance soak (`make chaos-migrate`;
     docs/ROBUSTNESS.md "Live migration").
@@ -7211,6 +7881,29 @@ def main() -> None:
             "unit": "tokens/s",
             "serving_p99_ms": result.get("serving_p99_ms"),
             "batching_speedup": (result.get("ab") or {}).get("speedup"),
+            "ok": result["ok"],
+            "detail": result,
+        }))
+        sys.exit(0 if result["ok"] else 1)
+
+    # `bench.py --serve-fleet [--nodes 16] [--seed 1]`: front-door fleet
+    # acceptance soak (no chip needed) — `make serve-fleet`.  Gated: zero
+    # failed requests end to end (sheds are honest 429s, counted
+    # separately), exact decode billing, the mid-ramp quarantine lands as
+    # one live migration through the drain handoff, the replica count
+    # tracks load up past the floor and back down, the serving TPOT SLO
+    # never fires, and steady-state verbs return to 0.
+    if "--serve-fleet" in sys.argv:
+        result = run_serve_fleet_soak(
+            n_nodes=_int_arg("--nodes", 16), seed=_int_arg("--seed", 1),
+        )
+        counts = (result.get("frontdoor") or {}).get("counts") or {}
+        print(json.dumps({
+            "metric": "frontdoor_failed_requests",
+            "value": counts.get("failed"),
+            "unit": "requests",
+            "accepted": (result.get("frontdoor") or {}).get("accepted"),
+            "max_ready": (result.get("frontdoor") or {}).get("max_ready"),
             "ok": result["ok"],
             "detail": result,
         }))

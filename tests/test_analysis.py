@@ -89,6 +89,27 @@ def test_metric_labels_trips_and_node_local_allowance(tmp_path):
     assert len(trips) == 1 and trips[0].file.endswith("controllers/bad.py")
 
 
+def test_metric_labels_pins_frontdoor_label_space_shut(tmp_path):
+    res = run_on(tmp_path, {
+        "tpu_operator/serving/bad.py": """
+            from prometheus_client import Counter
+            A = Counter("tpu_operator_frontdoor_routed_total", "doc", ["session"])
+            B = Counter("tpu_operator_frontdoor_hedges_total", "doc", ["model_rev"])
+        """,
+        "tpu_operator/serving/good.py": """
+            from prometheus_client import Counter
+            C = Counter("tpu_operator_frontdoor_routed_total", "doc", ["outcome"])
+            D = Counter("tpu_operator_frontdoor_replicas", "doc", ["state"])
+        """,
+    }, rules=["metric-labels"])
+    trips = names_of(res, "metric-labels")
+    # "session" is denylisted outright; "model_rev" passes the global
+    # denylist but falls outside the closed front-door label set
+    assert len(trips) == 2
+    assert all(f.file.endswith("serving/bad.py") for f in trips)
+    assert any("model_rev" in f.message for f in trips)
+
+
 def test_atomic_writes_trips_and_passes(tmp_path):
     res = run_on(tmp_path, {
         "tpu_operator/workloads/bad.py": """

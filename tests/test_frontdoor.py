@@ -1,0 +1,481 @@
+"""Front-door router tests: session affinity, admission-aware shedding,
+freshness-driven routing, retry budget, the single idempotent-prefill
+hedge, drain handoff exact-once replay, burn-driven autoscaling, and the
+ServeScaler actuator (docs/SERVING.md "Front door")."""
+
+import asyncio
+
+import pytest
+
+from tpu_operator.api.types import GROUP, SLICE_REQUEST_KIND
+from tpu_operator.k8s.client import ApiClient, Config
+from tpu_operator.controllers.servescaler import ServeScaler
+from tpu_operator.metrics import OperatorMetrics
+from tpu_operator.obs.fleet import FleetAggregator
+from tpu_operator.serving import (
+    AutoscaleConfig,
+    FrontDoor,
+    FrontDoorConfig,
+    LocalReplica,
+    ReplicaAutoscaler,
+    SessionTraffic,
+)
+from tpu_operator.serving.frontdoor import DEAD, READY, UNKNOWN
+from tpu_operator.testing import FakeCluster, SimConfig
+from tpu_operator.workloads.serving import ServeConfig
+
+
+def _replica(name: str, node: str = "") -> LocalReplica:
+    return LocalReplica(name, ServeConfig(name=name), node=node)
+
+
+def _view(now: float, names, queue_depth: float = 0.0):
+    return {
+        n: {
+            "ts": now, "age_s": 0.0, "fresh": True,
+            "metrics": {"queue_depth": queue_depth, "kv_blocks_free": 60.0},
+        }
+        for n in names
+    }
+
+
+def _door(cfg=None, names=("a", "b"), now=0.0):
+    fd = FrontDoor(cfg or FrontDoorConfig(), metrics=None)
+    reps = {}
+    for n in names:
+        reps[n] = _replica(n, node=f"node-{n}")
+        fd.add_replica(n, reps[n], node=f"node-{n}", now=now)
+    return fd, reps
+
+
+def _run(fd, now, ticks, names, tick_s=0.05, depth_fn=None):
+    for _ in range(ticks):
+        now += tick_s
+        fd.tick(now)
+        live = [n for n in names if n in fd._replicas
+                and fd._replicas[n].handle.alive
+                and not fd._replicas[n].handle.blackholed]
+        view = _view(now, live)
+        if depth_fn:
+            for n in live:
+                view[n]["metrics"]["queue_depth"] = depth_fn(n)
+        fd.observe_fleet(view, now)
+    return now
+
+
+# ---------------------------------------------------------------------------
+# Routing: affinity, spillover, freshness, shedding.
+
+
+def test_session_sticks_to_its_replica():
+    fd, _ = _door()
+    first = fd.submit("s1", [1, 2, 3], 4, now=0.0)
+    bound = fd._tracks[first["rid"]].primary
+    # even with the other replica reporting an emptier queue, the session
+    # stays put while its replica is fresh and under the ceiling
+    other = "b" if bound == "a" else "a"
+    fd.observe_fleet({
+        bound: {"ts": 0.1, "fresh": True,
+                "metrics": {"queue_depth": 3.0, "kv_blocks_free": 10.0}},
+        other: {"ts": 0.1, "fresh": True,
+                "metrics": {"queue_depth": 0.0, "kv_blocks_free": 60.0}},
+    }, now=0.1)
+    second = fd.submit("s1", [4, 5], 4, now=0.1)
+    assert fd._tracks[second["rid"]].primary == bound
+
+
+def test_new_session_spills_to_least_loaded():
+    fd, _ = _door()
+    fd.observe_fleet({
+        "a": {"ts": 0.0, "fresh": True,
+              "metrics": {"queue_depth": 5.0, "kv_blocks_free": 8.0}},
+        "b": {"ts": 0.0, "fresh": True,
+              "metrics": {"queue_depth": 1.0, "kv_blocks_free": 50.0}},
+    }, now=0.0)
+    v = fd.submit("fresh-session", [1, 2], 4, now=0.0)
+    assert fd._tracks[v["rid"]].primary == "b"
+
+
+def test_stale_evidence_means_replica_unknown_and_routed_away():
+    cfg = FrontDoorConfig(stale_after_s=0.5, dead_after_s=99.0)
+    fd, _ = _door(cfg)
+    # a pushed at t=0 then went quiet; b keeps pushing
+    fd.observe_fleet(_view(0.0, ["a", "b"]), now=0.0)
+    fd.observe_fleet(_view(2.0, ["b"]), now=2.0)
+    assert fd.replica_states() == {"a": UNKNOWN, "b": READY}
+    for i in range(4):
+        v = fd.submit(f"s{i}", [1], 4, now=2.0)
+        assert fd._tracks[v["rid"]].primary == "b"
+
+
+def test_shed_is_honest_and_counted_separately():
+    cfg = FrontDoorConfig(shed_queue_depth=2.0)
+    fd, _ = _door(cfg)
+    fd.observe_fleet(_view(0.0, ["a", "b"], queue_depth=9.0), now=0.0)
+    v = fd.submit("s1", [1, 2], 4, now=0.0)
+    assert v["status"] == "shed"
+    assert v["retry_after_s"] > 0
+    assert fd.counts["shed"] == 1 and fd.counts["failed"] == 0
+    # capacity returns -> the same client retry is admitted
+    fd.observe_fleet(_view(0.1, ["a", "b"], queue_depth=0.0), now=0.1)
+    assert fd.submit("s1", [1, 2], 4, now=0.1)["status"] == "accepted"
+
+
+# ---------------------------------------------------------------------------
+# Loss: retry budget, blackhole conviction, hedging.
+
+
+def test_replica_loss_spends_retry_budget_then_fails_honestly():
+    cfg = FrontDoorConfig(retry_budget=1, hedge_after_s=99.0)
+    fd, reps = _door(cfg)
+    v = fd.submit("s1", [1, 2, 3], 16, now=0.0)
+    rid = v["rid"]
+    now = _run(fd, 0.0, 3, ["a", "b"])
+    first = fd._tracks[rid].primary
+    reps[first].kill()
+    now = _run(fd, now, 3, ["a", "b"])
+    # budget spent, request re-placed on the survivor, tokens dedup'd
+    assert fd.counts["retries"] == 1
+    second = fd._tracks[rid].primary
+    assert second != first
+    reps[second].kill()
+    now = _run(fd, now, 3, ["a", "b"])
+    assert fd.counts["failed"] == 1
+    assert fd.result(rid)["state"] == "failed"
+    assert fd._sessions["s1"].retry_budget == 0
+
+
+def test_blackholed_replica_is_convicted_by_freshness_alone():
+    cfg = FrontDoorConfig(
+        stale_after_s=0.2, dead_after_s=0.5, hedge_after_s=99.0
+    )
+    fd, reps = _door(cfg)
+    v = fd.submit("s1", [1, 2], 8, now=0.0)
+    rid = v["rid"]
+    now = _run(fd, 0.0, 2, ["a", "b"])
+    victim = fd._tracks[rid].primary
+    reps[victim].blackhole()       # still "alive": only the push trail stops
+    assert reps[victim].alive
+    now = _run(fd, now, 20, ["a", "b"])
+    assert fd.replica_states()[victim] == DEAD
+    now = _run(fd, now, 30, ["a", "b"])
+    assert fd.counts["failed"] == 0
+    assert fd.result(rid)["state"] == "done"
+
+
+def test_single_hedge_fires_only_before_first_token_and_never_double_bills():
+    cfg = FrontDoorConfig(hedge_after_s=0.1, dead_after_s=99.0,
+                          stale_after_s=99.0)
+    fd, reps = _door(cfg)
+    v = fd.submit("s1", [1, 2, 3], 6, now=0.0)
+    rid = v["rid"]
+    primary = fd._tracks[rid].primary
+    # the primary swallows the request (accepts, never decodes) but its
+    # evidence is kept artificially fresh: only the overdue FIRST token
+    # triggers the hedge
+    reps[primary].blackhole()
+    now = 0.0
+    for _ in range(40):
+        now += 0.05
+        fd.tick(now)
+        fd.observe_fleet(_view(now, ["a", "b"]), now)  # both "fresh"
+    assert fd.counts["hedges_fired"] == 1
+    assert fd.counts["hedges_won"] == 1
+    assert fd.counts["failed"] == 0
+    res = fd.result(rid)
+    assert res["state"] == "done" and res["delivered"] == 6
+    # exactly max_new_tokens billed: the loser never decoded on the bill
+    assert fd.counts["tokens_billed"] == 6
+
+
+def test_no_hedge_once_decode_has_started():
+    cfg = FrontDoorConfig(hedge_after_s=0.01, dead_after_s=99.0,
+                          stale_after_s=99.0)
+    fd, _ = _door(cfg)
+    v = fd.submit("s1", [1, 2], 12, now=0.0)
+    now = _run(fd, 0.0, 3, ["a", "b"])     # first token lands
+    assert fd._tracks[v["rid"]].delivered > 0
+    now = _run(fd, now, 20, ["a", "b"])    # far past the hedge deadline
+    # decode is never idempotent billing-wise: no hedge after token one
+    assert fd.counts["hedges_fired"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Drain handoff: park -> restore -> replay, exact-once (satellite 3).
+
+
+def test_drain_handoff_resumes_schedule_exactly_once(tmp_path):
+    cfg = FrontDoorConfig(hedge_after_s=99.0, dead_after_s=99.0,
+                          stale_after_s=99.0)
+    fd = FrontDoor(cfg)
+    rep = _replica("e")
+    fd.add_replica("e", rep, now=0.0)
+    traffic = SessionTraffic(rate=30.0, n_sessions=3, new_tokens=(6, 10),
+                             seed=7)
+    accepted = {}
+    now = 0.0
+
+    def pour(until):
+        nonlocal now
+        while now < until:
+            now += 0.05
+            for sid, req in traffic.due(now):
+                v = fd.submit(sid, req.prompt, req.max_new_tokens,
+                              now=now, rid=req.rid)
+                assert v["status"] == "accepted", v
+                accepted[req.rid] = req.max_new_tokens
+            fd.tick(now)
+            fd.observe_fleet(_view(now, ["e"]), now)
+
+    pour(0.5)                                   # in-flight work builds up
+    schedule = fd.drain_replica("e", ckpt_dir=str(tmp_path), now=now)
+    assert schedule, "drain must catch requests mid-flight"
+    # mid-drain arrivals park at the router: latency, not errors
+    parked = fd.submit("s0", [9, 9, 9], 4, now=now)
+    assert parked.get("parked") and parked["status"] == "accepted"
+    accepted[parked["rid"]] = 4
+    restored, extra = LocalReplica.restore("e", ServeConfig(name="e"),
+                                           str(tmp_path))
+    assert extra["schedule"] == schedule        # the continuation contract
+    out = fd.restore_replica("e", restored, now=now)
+    assert out["resumed"] == len(schedule)
+    traffic.rate = 0.0
+    pour(now + 4.0)
+    s = fd.stats(now)
+    # PoissonTraffic's continuation contract: every accepted rid completes
+    # exactly once -- nothing in the snapshot re-ran, nothing outside it
+    # was skipped
+    assert s["counts"]["failed"] == 0
+    done = {rid for rid in accepted if fd.result(rid)["state"] == "done"}
+    assert done == set(accepted)
+    assert s["counts"]["completed"] == len(accepted)
+    for rid, max_new in accepted.items():
+        res = fd.result(rid)
+        assert res["delivered"] == max_new, (rid, res)
+        assert len(res["tokens"]) == max_new
+    # billing is exact: one bill per generated position across the handoff
+    assert s["counts"]["tokens_billed"] == sum(accepted.values())
+    assert s["counts"]["handoff_restored"] == 1
+    assert s["counts"]["handoff_replayed"] == 1  # the parked arrival
+
+
+# ---------------------------------------------------------------------------
+# Freshness-stamped serving rollups on /debug/fleet (satellite 1).
+
+
+def test_serving_view_stamps_freshness_and_routes_stale_to_unknown():
+    fleet = FleetAggregator()
+    fleet.ingest("tpu_workload_serving_queue_depth", 3.0,
+                 {"workload": "serve-fd-0", "node": "n0"}, ts=100.0)
+    fleet.ingest("tpu_workload_serving_kv_blocks_free", 41.0,
+                 {"workload": "serve-fd-0", "node": "n0"}, ts=100.5)
+    fleet.ingest("tpu_workload_serving_queue_depth", 1.0,
+                 {"workload": "serve-fd-1", "node": "n1"}, ts=96.0)
+    view = fleet.serving_view(now=101.0, stale_after_s=2.0)
+    assert view["serve-fd-0"]["fresh"] is True
+    assert view["serve-fd-0"]["node"] == "n0"
+    assert view["serve-fd-0"]["metrics"] == {
+        "queue_depth": 3.0, "kv_blocks_free": 41.0,
+    }
+    # serve-fd-1 last pushed 5s ago: stale, and the router treats it as
+    # replica-unknown -- route away, never onto
+    assert view["serve-fd-1"]["fresh"] is False
+    assert view["serve-fd-1"]["age_s"] == pytest.approx(5.0)
+    fd = FrontDoor(FrontDoorConfig(stale_after_s=2.0))
+    fd.add_replica("serve-fd-0", _replica("serve-fd-0"), now=100.9)
+    fd.add_replica("serve-fd-1", _replica("serve-fd-1"), now=95.9)
+    fd.observe_fleet(view, now=101.0)
+    assert fd.replica_states() == {
+        "serve-fd-0": READY, "serve-fd-1": UNKNOWN,
+    }
+
+
+def test_fleet_snapshot_carries_serving_view():
+    fleet = FleetAggregator()
+    fleet.ingest("tpu_workload_serving_queue_depth", 2.0,
+                 {"workload": "serve-fd-0", "node": "n0"}, ts=10.0)
+    snap = fleet.snapshot()
+    assert "serve-fd-0" in snap["serving"]
+    assert "fresh" in snap["serving"]["serve-fd-0"]
+
+
+# ---------------------------------------------------------------------------
+# Autoscaling control law.
+
+
+def test_autoscaler_grows_on_sustained_burn_and_shrinks_on_idle():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3, up_after_s=1.0,
+                          down_after_s=2.0, cooldown_s=1.5)
+    scaler = ReplicaAutoscaler(cfg)
+    # a transient spike shorter than up_after_s must not scale
+    assert scaler.observe(0.0, ready=1, queue_depth_mean=9.0,
+                          burning=False) == 1
+    assert scaler.observe(0.5, ready=1, queue_depth_mean=0.0,
+                          burning=False) == 1
+    # sustained SLO burn grows the fleet, one step per cooldown
+    t, desired = 1.0, 1
+    while desired < 3 and t < 30.0:
+        desired = scaler.observe(t, ready=desired, queue_depth_mean=2.0,
+                                 burning=True)
+        t += 0.5
+    assert desired == 3
+    grew_at = t
+    # stays pinned at max under continued burn
+    assert scaler.observe(t + 5.0, ready=3, queue_depth_mean=2.0,
+                          burning=True) == 3
+    # sustained idleness shrinks back to the floor
+    t = grew_at + 10.0
+    while desired > 1 and t < grew_at + 60.0:
+        desired = scaler.observe(t, ready=desired, queue_depth_mean=0.0,
+                                 burning=False)
+        t += 0.5
+    assert desired == 1
+
+
+def test_autoscaler_never_shrinks_an_underprovisioned_fleet():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=4, down_after_s=0.5,
+                          cooldown_s=0.0)
+    scaler = ReplicaAutoscaler(cfg)
+    scaler.desired = 3
+    # grants still materialising (ready < desired): an empty queue is a
+    # ramp artefact, not idleness
+    for t in (0.0, 1.0, 2.0, 3.0):
+        assert scaler.observe(t, ready=1, queue_depth_mean=0.0,
+                              burning=False) == 3
+    # once the fleet catches up, idleness counts
+    assert scaler.observe(4.0, ready=3, queue_depth_mean=0.0,
+                          burning=False) == 3
+    assert scaler.observe(5.0, ready=3, queue_depth_mean=0.0,
+                          burning=False) == 2
+
+
+# ---------------------------------------------------------------------------
+# ServeScaler: desired count -> elastic TPUSliceRequests, zero-write fixed
+# point.
+
+
+async def test_servescaler_is_level_triggered_with_tiered_slots():
+    async with FakeCluster(SimConfig(enabled=False)) as fc:
+        client = ApiClient(Config(base_url=fc.base_url))
+        try:
+            desired = 3
+            scaler = ServeScaler(client, lambda: desired, topology="2x2",
+                                 guaranteed_floor=1)
+            out = await scaler.reconcile_once()
+            assert out["created"] == ["serve-fd-0", "serve-fd-1",
+                                      "serve-fd-2"]
+            specs = {}
+            for i in range(3):
+                cr = await client.get(GROUP, SLICE_REQUEST_KIND,
+                                      f"serve-fd-{i}")
+                specs[i] = cr["spec"]
+            # guaranteed floor under a reclaimable burst (PR-18 economy)
+            assert specs[0]["tier"] == "guaranteed"
+            assert specs[1]["tier"] == "reclaimable"
+            assert specs[2]["tier"] == "reclaimable"
+            # fixed point: zero writes
+            out = await scaler.reconcile_once()
+            assert out["created"] == [] and out["deleted"] == []
+            # shrink retires the youngest (burst) slots first
+            desired = 1
+            out = await scaler.reconcile_once()
+            assert out["deleted"] == ["serve-fd-2", "serve-fd-1"]
+            listing = await client.list(GROUP, SLICE_REQUEST_KIND)
+            names = {i["metadata"]["name"] for i in listing["items"]}
+            assert names == {"serve-fd-0"}
+        finally:
+            await client.close()
+
+
+# ---------------------------------------------------------------------------
+# Retiring replicas drain gracefully out of the pool.
+
+
+def test_retired_replica_takes_no_new_work_and_leaves_when_empty():
+    fd, _ = _door(FrontDoorConfig(hedge_after_s=99.0))
+    v = fd.submit("s1", [1, 2], 4, now=0.0)
+    victim = fd._tracks[v["rid"]].primary
+    fd.retire_replica(victim)
+    other = "b" if victim == "a" else "a"
+    w = fd.submit("s2", [3], 4, now=0.0)
+    assert fd._tracks[w["rid"]].primary == other
+    _run(fd, 0.0, 40, ["a", "b"])
+    assert fd.counts["failed"] == 0
+    # in-flight work completed, then the slot left the pool
+    assert victim not in fd.replica_states()
+
+
+# ---------------------------------------------------------------------------
+# Mutable-rate traffic: a quiesced stream resumes from the caller's clock.
+
+
+def test_traffic_resumes_from_the_callers_clock_after_a_quiesce():
+    # rate=0 at construction means next_at=inf; raising the rate later
+    # must restart the schedule from the clock due() is actually driven
+    # with (the fleet soak runs on wall time), never from zero
+    traffic = SessionTraffic(rate=0.0, n_sessions=4, seed=3)
+    t0 = 1.75e9  # a wall-clock epoch, not a zero-based test clock
+    assert traffic.due(t0) == []
+    traffic.rate = 20.0
+    minted = []
+    now = t0
+    for _ in range(100):
+        now += 0.05
+        minted.extend(traffic.due(now))
+    assert 60 <= len(minted) <= 140  # ~20/s over 5s, seeded
+    assert all(t0 < req.arrival <= now for _sid, req in minted)
+    # a later quiesce lets the one already-scheduled arrival land, then
+    # the stream is silent until the rate rises again
+    traffic.rate = 0.0
+    assert len(traffic.due(now + 60.0)) <= 1
+    assert traffic.due(now + 120.0) == []
+
+
+def test_handoff_pause_is_not_decode_latency(tmp_path):
+    # the subprocess serve loop runs on elapsed service time, so a
+    # migration pause never reaches its TPOT ledger; the wall-clock
+    # LocalReplica path must match by rebasing in-flight timing at the
+    # first post-restore step -- otherwise one drain inflates tpot_p99
+    # past any reasonable SLO and the burn engine pages on a pause that
+    # handoff metrics already account for
+    cfg = FrontDoorConfig(hedge_after_s=99.0, dead_after_s=99.0,
+                          stale_after_s=99.0)
+    fd = FrontDoor(cfg)
+    rep = _replica("e")
+    fd.add_replica("e", rep, now=0.0)
+    traffic = SessionTraffic(rate=30.0, n_sessions=3, new_tokens=(12, 20),
+                             seed=11)
+    accepted = {}
+    now = 0.0
+
+    def pour(until):
+        nonlocal now
+        while now < until:
+            now += 0.05
+            for sid, req in traffic.due(now):
+                v = fd.submit(sid, req.prompt, req.max_new_tokens,
+                              now=now, rid=req.rid)
+                assert v["status"] == "accepted", v
+                accepted[req.rid] = req.max_new_tokens
+            fd.tick(now)
+            fd.observe_fleet(_view(now, ["e"]), now)
+
+    pour(0.6)                                   # decode well under way
+    schedule = fd.drain_replica("e", ckpt_dir=str(tmp_path), now=now)
+    assert schedule, "drain must catch requests mid-flight"
+    now += 10.0                                 # the checkpoint-follow gap
+    restored, _extra = LocalReplica.restore("e", ServeConfig(name="e"),
+                                            str(tmp_path))
+    fd.restore_replica("e", restored, now=now)
+    traffic.rate = 0.0
+    pour(now + 4.0)
+    s = fd.stats(now)
+    assert s["counts"]["failed"] == 0
+    done = {rid for rid in accepted if fd.result(rid)["state"] == "done"}
+    assert done == set(accepted)                # the pause costs nothing
+    # no decode-latency sample anywhere near the 10s pause: TPOT keeps
+    # measuring token cadence, TTFT keeps measuring queue-to-first-token
+    assert restored.engine._tpot, "restored engine must have decoded"
+    assert max(restored.engine._tpot) < 1.0
+    # TTFT may carry genuine queue wait (batch slots), never the pause
+    assert max(restored.engine._ttft) < 3.0

@@ -1,0 +1,164 @@
+"""Front-door chaos gates (ChaosConfig replica actors): a replica
+SIGKILLed mid-decode and a blackholed replica must BOTH be recovered by
+the retry budget + single hedge with zero failed requests and no
+duplicate decode billing — the serve-fleet soak's acceptance contract,
+pinned here at unit scale."""
+
+from tpu_operator.serving import FrontDoor, FrontDoorConfig, LocalReplica
+from tpu_operator.serving.frontdoor import SessionTraffic
+from tpu_operator.testing.chaos import ChaosConfig, ChaosEngine
+from tpu_operator.workloads.serving import ServeConfig
+
+TICK = 0.05
+
+
+def _fresh_entry(now, telemetry):
+    return {
+        "ts": now, "fresh": True,
+        "metrics": {
+            "queue_depth": telemetry.get("serve_queue_depth", 0.0),
+            "kv_blocks_free": telemetry.get("serve_kv_blocks_free", 0.0),
+        },
+    }
+
+
+class _Harness:
+    """Seeded mini-fleet: the router, N replicas, real pushed telemetry
+    (a dead/blackholed replica pushes NOTHING — freshness is the only
+    detector), and a replacement loop standing in for the ServeScaler
+    re-granting a killed slot."""
+
+    def __init__(self, chaos_cfg: ChaosConfig, n_replicas: int = 3):
+        # the chaos rates here are extreme (~1 replica loss/second across
+        # the fleet); the budget bounds amplification per loss EVENT, so
+        # it is sized to the injected loss count, not left at the
+        # production default
+        self.fd = FrontDoor(FrontDoorConfig(
+            stale_after_s=0.3, dead_after_s=0.6, hedge_after_s=0.4,
+            retry_budget=10,
+        ))
+        self.chaos = ChaosEngine(chaos_cfg)
+        self.now = 0.0
+        self._next_slot = 0
+        for _ in range(n_replicas):
+            self._grow()
+
+    def _grow(self):
+        name = f"serve-fd-{self._next_slot}"
+        self._next_slot += 1
+        self.fd.add_replica(
+            name, LocalReplica(name, ServeConfig(name=name)), now=self.now,
+        )
+
+    def tick(self, traffic=None, accepted=None):
+        self.now += TICK
+        if traffic is not None:
+            for sid, req in traffic.due(self.now):
+                v = self.fd.submit(sid, req.prompt, req.max_new_tokens,
+                                   now=self.now, rid=req.rid)
+                if v["status"] == "accepted":
+                    accepted[req.rid] = req.max_new_tokens
+        # chaos draws, one per ready replica per tick (the config contract)
+        states = self.fd.replica_states()
+        for name, state in states.items():
+            if state != "ready":
+                continue
+            rep = self.fd._replicas[name]
+            if self.chaos.should_kill_replica():
+                rep.handle.kill()
+                self._grow()
+            elif self.chaos.should_blackhole_replica():
+                rep.handle.blackhole()
+                self._grow()
+        self.fd.tick(self.now)
+        view = {}
+        for name, rep in self.fd._replicas.items():
+            t = rep.handle.telemetry(self.now)
+            if t is not None:  # killed/blackholed replicas go silent
+                view[name] = _fresh_entry(self.now, t)
+        self.fd.observe_fleet(view, self.now)
+
+
+def _soak(chaos_cfg, pour_ticks=80, drain_ticks=400, rate=20.0, seed=11):
+    h = _Harness(chaos_cfg)
+    traffic = SessionTraffic(rate=rate, n_sessions=4, new_tokens=(6, 12),
+                            seed=seed)
+    accepted = {}
+    for _ in range(pour_ticks):
+        h.tick(traffic, accepted)
+    h.chaos.stop()
+    traffic.rate = 0.0
+    for _ in range(drain_ticks):
+        h.tick()
+        if not h.fd._tracks and not h.fd._waiting:
+            break
+    return h, accepted
+
+
+def _assert_zero_loss_exact_billing(h, accepted):
+    s = h.fd.stats(h.now)
+    assert accepted, "the stream must have carried real work"
+    assert s["counts"]["failed"] == 0, s
+    assert s["failed_rids"] == []
+    for rid, max_new in accepted.items():
+        res = h.fd.result(rid)
+        assert res is not None and res["state"] == "done", (rid, res)
+        assert res["delivered"] == max_new, (rid, res)
+    # the no-duplicate-decode-billing gate: every (rid, position) billed
+    # exactly once; whatever a retry/hedge re-decoded was discarded as a
+    # dup, never billed
+    assert s["counts"]["tokens_billed"] == sum(accepted.values()), s
+    return s
+
+
+def test_replica_sigkill_mid_decode_recovers_with_zero_failures():
+    h, accepted = _soak(ChaosConfig(seed=3, replica_kill_rate=0.02))
+    s = _assert_zero_loss_exact_billing(h, accepted)
+    assert h.chaos.injected.get("replica_kill", 0) >= 1
+    assert s["counts"]["retries"] >= 1  # the budget actually worked
+
+
+def test_blackholed_replica_starved_and_rescued_with_zero_failures():
+    h, accepted = _soak(ChaosConfig(seed=5, replica_blackhole_rate=0.02))
+    s = _assert_zero_loss_exact_billing(h, accepted)
+    assert h.chaos.injected.get("replica_blackhole", 0) >= 1
+    # conviction came from evidence freshness: the blackholed replicas
+    # ended DEAD (in-flight) or UNKNOWN (idle), never READY
+    blackholed = [
+        name for name, rep in h.fd._replicas.items()
+        if rep.handle.blackholed
+    ]
+    assert blackholed
+    for name in blackholed:
+        assert h.fd.replica_states()[name] in ("dead", "unknown")
+
+
+def test_combined_kill_and_blackhole_chaos_zero_loss():
+    h, accepted = _soak(ChaosConfig(
+        seed=9, replica_kill_rate=0.01, replica_blackhole_rate=0.01,
+    ))
+    _assert_zero_loss_exact_billing(h, accepted)
+    assert h.chaos.injected.get("replica_kill", 0) >= 1
+    assert h.chaos.injected.get("replica_blackhole", 0) >= 1
+
+
+def test_replica_chaos_draws_are_seeded_and_freezable():
+    def draws(seed):
+        eng = ChaosEngine(ChaosConfig(
+            seed=seed, replica_kill_rate=0.3, replica_blackhole_rate=0.3,
+        ))
+        return [
+            (eng.should_kill_replica(), eng.should_blackhole_replica())
+            for _ in range(64)
+        ]
+
+    assert draws(7) == draws(7)          # byte-identical replay
+    assert draws(7) != draws(8)
+    eng = ChaosEngine(ChaosConfig(seed=1, replica_kill_rate=1.0,
+                                  replica_blackhole_rate=1.0))
+    eng.stop()                            # steady-state measurement phase
+    assert not eng.should_kill_replica()
+    assert not eng.should_blackhole_replica()
+    eng.resume()
+    assert eng.should_kill_replica()
+    assert eng.should_blackhole_replica()

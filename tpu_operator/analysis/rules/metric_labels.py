@@ -34,7 +34,18 @@ DENYLIST = {
     # step evidence stays in the ProfileEngine's rings and /debug/profile;
     # the exported rollups are bounded to {phase, quantile} by design
     "host", "hostname", "slice", "slice_request",
+    # serving front door (serving/frontdoor.py): sessions and request ids
+    # are minted per client — per-session/per-rid evidence lives in the
+    # router's stats() and /debug/frontdoor, never on Prometheus series
+    "session", "session_id", "sid", "request_id", "rid", "replica",
 }
+
+# The front-door families additionally get a closed allowlist: ANY label
+# outside it is a finding even if it never makes the global denylist —
+# a router is the easiest place in the codebase to accidentally grow
+# per-session cardinality, so the label space is pinned shut.
+FRONTDOOR_PREFIX = "tpu_operator_frontdoor_"
+FRONTDOOR_ALLOWED = {"outcome", "state", "reason", "quantile"}
 
 
 def _candidate_labels(call: ast.Call):
@@ -86,4 +97,15 @@ class MetricLabelsRule(Rule):
                         f"metric {metric_name or '<dynamic>'} uses unbounded "
                         f"label {label!r} (per-entity series belong in the "
                         "fleet aggregator's rings, not the Prometheus registry)",
+                    )
+                elif (
+                    metric_name.startswith(FRONTDOOR_PREFIX)
+                    and label not in FRONTDOOR_ALLOWED
+                ):
+                    yield Finding(
+                        self.name, sf.rel, node.lineno,
+                        f"front-door metric {metric_name} uses label "
+                        f"{label!r} outside the closed set "
+                        f"{sorted(FRONTDOOR_ALLOWED)} (per-session/"
+                        "per-request evidence belongs in /debug/frontdoor)",
                     )

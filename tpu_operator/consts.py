@@ -380,5 +380,26 @@ FLEET_WINDOWS = (60.0, 300.0, 3600.0)
 # unauthenticated, and an unbounded body is an allocation amplifier
 PUSH_MAX_BYTES = 256 * 1024
 
+# ---------------------------------------------------------------------------
+# Serving front door (tpu_operator/serving/; docs/SERVING.md "Front door").
+# Replica capacity evidence arrives over the agent push hop at the
+# forwarder's cadence; evidence older than this many push intervals marks
+# the replica UNKNOWN — the router routes away from it rather than onto a
+# possibly-dead engine.  The push interval here mirrors the agents'
+# FLEET_FORWARD_INTERVAL (metrics_agent.py): the router has no side
+# channel to the agents, so the contract lives where both sides can read it.
+SERVE_PUSH_INTERVAL_SECONDS = 1.0
+FRONTDOOR_STALE_PUSHES = 2
+# per-session retry budget: replica-loss retries a session may spend before
+# its in-flight requests are failed honestly (the soak gates 0 failures —
+# the budget exists so a flapping replica cannot bounce one session forever)
+FRONTDOOR_RETRY_BUDGET = 3
+# a request still waiting for its FIRST token after this long is hedged
+# once onto a second replica (prefill is idempotent; decode never hedges)
+FRONTDOOR_HEDGE_AFTER_SECONDS = 1.0
+# evidence-stale replicas holding in-flight work are declared dead after
+# this long without a push (blackhole detector: accepts, never responds)
+FRONTDOOR_DEAD_AFTER_SECONDS = 4.0
+
 # Leader election id (main.go:105-115 analogue: "53822513.nvidia.com").
 LEADER_ELECTION_ID = "53822513.tpu.google.com"

@@ -1,0 +1,133 @@
+"""ServeScaler: actuate the serving autoscaler's desired replica count
+as elastic ``TPUSliceRequest`` objects.
+
+The control law (``serving/autoscaler.py``) says HOW MANY replicas the
+front door needs; this controller makes the cluster agree, one
+``TPUSliceRequest`` per replica slot named ``<prefix><index>``.  It is
+level-triggered and idempotent: each ``reconcile_once()`` lists the
+current slots, creates the missing indices below the desired count, and
+deletes the surplus indices above it (highest first, so a shrink always
+releases the youngest slot — the one the front door was told to retire
+first).  At the fixed point it issues ZERO writes, which is exactly what
+the soak's steady-state gate measures.
+
+Tiering follows the preemption economy (PR 18): the first
+``guaranteed_floor`` slots are ``tier: guaranteed`` — the baseline the
+SLO math assumes always exists — and everything above the floor is
+``tier: reclaimable``, so scale-up burst rides capacity the cluster can
+demote-or-park back when a guaranteed tenant arrives.  A burst replica
+being reclaimed looks to the front door like any other drain handoff.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Awaitable, Callable, Optional, Union
+
+from tpu_operator.api.types import (
+    GROUP,
+    SLICE_REQUEST_KIND,
+    TIER_GUARANTEED,
+    TIER_RECLAIMABLE,
+    TPUSliceRequest,
+)
+from tpu_operator.k8s.client import ApiError
+
+logger = logging.getLogger(__name__)
+
+DesiredFn = Callable[[], Union[int, Awaitable[int]]]
+
+
+class ServeScaler:
+    """Reconciles ``TPUSliceRequest`` slots against a desired count.
+
+    ``desired_fn`` is polled each pass (sync or async) — typically a
+    closure over :class:`ReplicaAutoscaler.desired` — so the controller
+    stays a pure actuator with no control-law state of its own.
+    """
+
+    def __init__(
+        self,
+        client,
+        desired_fn: DesiredFn,
+        topology: str = "2x4",
+        guaranteed_floor: int = 1,
+        prefix: str = "serve-fd-",
+        min_topology: Optional[str] = None,
+    ):
+        self.client = client
+        self.desired_fn = desired_fn
+        self.topology = topology
+        self.guaranteed_floor = max(0, int(guaranteed_floor))
+        self.prefix = prefix
+        self.min_topology = min_topology
+
+    def _slot_name(self, index: int) -> str:
+        return f"{self.prefix}{index}"
+
+    def _slot_spec(self, index: int) -> dict:
+        spec = {
+            "topology": self.topology,
+            "tier": (
+                TIER_GUARANTEED
+                if index < self.guaranteed_floor
+                else TIER_RECLAIMABLE
+            ),
+        }
+        if self.min_topology:
+            spec["minTopology"] = self.min_topology
+        return spec
+
+    async def reconcile_once(self) -> dict:
+        """One level-triggered pass.  Returns ``{"desired", "have",
+        "created": [...], "deleted": [...]}`` for the caller's bookkeeping
+        (the soak asserts created+deleted collapse to empty at steady
+        state)."""
+        desired = self.desired_fn()
+        if hasattr(desired, "__await__"):
+            desired = await desired
+        desired = max(0, int(desired))
+        listing = await self.client.list(GROUP, SLICE_REQUEST_KIND)
+        have: dict[int, dict] = {}
+        for item in listing.get("items") or []:
+            name = (item.get("metadata") or {}).get("name") or ""
+            if not name.startswith(self.prefix):
+                continue
+            suffix = name[len(self.prefix):]
+            if suffix.isdigit():
+                have[int(suffix)] = item
+        created: list[str] = []
+        deleted: list[str] = []
+        for index in range(desired):
+            if index in have:
+                continue
+            name = self._slot_name(index)
+            try:
+                await self.client.create(  # fence-ok
+                    TPUSliceRequest.new(name, self._slot_spec(index)).obj
+                )
+                created.append(name)
+            except ApiError as e:
+                if not e.already_exists:
+                    raise
+        # shrink highest-first: the youngest slot is the reclaimable burst
+        # the front door retires first
+        for index in sorted((i for i in have if i >= desired), reverse=True):
+            name = self._slot_name(index)
+            # fence-ok here and on create above: slot reconciliation is
+            # convergent — a deposed leader double-creating a fixed-name
+            # slot hits 409 AlreadyExists (absorbed), double-deleting hits
+            # ignore_not_found; neither write can diverge the fleet
+            await self.client.delete(GROUP, SLICE_REQUEST_KIND, name)  # fence-ok
+            deleted.append(name)
+        if created or deleted:
+            logger.info(
+                "servescaler: desired=%d created=%s deleted=%s",
+                desired, created, deleted,
+            )
+        return {
+            "desired": desired,
+            "have": len(have),
+            "created": created,
+            "deleted": deleted,
+        }

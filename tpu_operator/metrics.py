@@ -543,3 +543,95 @@ class OperatorMetrics:
             "Thundering-herd validate=requested nodes demoted to pending "
             "by the coordinator (wave intake beyond the disruption budget)",
         )
+        # serving front door (tpu_operator/serving/frontdoor.py;
+        # docs/SERVING.md "Front door").  Label spaces are bounded enums
+        # (outcome, state) — NEVER session ids or request ids: per-session
+        # series on a millions-of-users endpoint is the canonical
+        # cardinality explosion, and the metric-labels analysis rule pins
+        # the frontdoor family to this exact allowlist.
+        self.frontdoor_routed_total = Counter(
+            "tpu_operator_frontdoor_routed_total",
+            "Requests placed onto a replica, by routing outcome: sticky "
+            "(session's bound replica), spillover (new session or rebind "
+            "onto the least-loaded fresh replica), retry (re-placed after "
+            "replica loss, spending session retry budget), replay "
+            "(resubmitted on the restored replica after a drain handoff)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.frontdoor_shed_total = c(
+            "tpu_operator_frontdoor_shed_total",
+            "Requests shed with an honest 429 + Retry-After because no "
+            "fresh replica had admission headroom (counted separately "
+            "from failures: a shed client was told to come back, never "
+            "silently dropped)",
+        )
+        self.frontdoor_hedges_total = Counter(
+            "tpu_operator_frontdoor_hedges_total",
+            "Single-hedge policy outcomes: fired (first token overdue, a "
+            "second prefill placed — idempotent work only), won (hedge "
+            "delivered first and the primary was cancelled), wasted "
+            "(primary delivered first and the hedge was cancelled "
+            "pre-decode — no double billing either way)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.frontdoor_handoffs_total = Counter(
+            "tpu_operator_frontdoor_handoffs_total",
+            "Draining-replica handoff transitions: parked (drain "
+            "checkpointed the replica; its sessions hold at the router), "
+            "restored (the restore pod re-attached and parked sessions "
+            "rebound), replayed (in-flight requests absent from the "
+            "snapshot resubmitted at the snapshot's schedule position)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.frontdoor_failed_total = c(
+            "tpu_operator_frontdoor_failed_total",
+            "Requests failed back to the client after the session retry "
+            "budget was exhausted (the serve-fleet soak gates this at 0: "
+            "every loss path must end in retry, replay, or an honest shed)",
+        )
+        self.frontdoor_sessions = g(
+            "tpu_operator_frontdoor_sessions",
+            "Live sessions bound to a replica at the front door",
+        )
+        self.frontdoor_replicas = Gauge(
+            "tpu_operator_frontdoor_replicas",
+            "Replica fleet as the router sees it, by state: ready, "
+            "draining (checkpoint requested, sessions parking), parked "
+            "(checkpoint taken, restore pending), unknown (capacity "
+            "evidence stale past the freshness bound), dead (declared "
+            "lost; in-flight work retried away)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.frontdoor_ttft_seconds = Histogram(
+            "tpu_operator_frontdoor_ttft_seconds",
+            "Endpoint-level time-to-first-token: submit at the front door "
+            "to first delivered token, across retries/hedges/handoffs "
+            "(the client-visible number, not the per-replica one)",
+            registry=self.registry,
+            buckets=DURATION_BUCKETS,
+        )
+        self.frontdoor_tpot_seconds = Histogram(
+            "tpu_operator_frontdoor_tpot_seconds",
+            "Endpoint-level time-per-output-token between consecutively "
+            "delivered tokens of one request (dedup'd across sources: a "
+            "handoff or hedge never double-counts a position)",
+            registry=self.registry,
+            buckets=DURATION_BUCKETS,
+        )
+        self.frontdoor_tokens_billed_total = c(
+            "tpu_operator_frontdoor_decode_tokens_billed_total",
+            "Decode tokens delivered to clients, billed exactly once per "
+            "(request, position) — the no-double-billing invariant the "
+            "chaos suite pins across hedges and replica-loss retries",
+        )
+        self.frontdoor_dup_tokens_total = c(
+            "tpu_operator_frontdoor_duplicate_tokens_discarded_total",
+            "Tokens that arrived for an already-delivered position (late "
+            "hedge loser, post-restore overlap) and were discarded "
+            "unbilled — nonzero here with billed == delivered is the "
+            "dedup layer doing its job",
+        )

@@ -113,6 +113,11 @@ SOURCE_SPAN = "span"
 SOURCE_PUSH = "push"
 SOURCE_NODE = "node"
 
+# the pushed serving-counter family (obs/flight COUNTER_KEYS serve_* names):
+# per-replica capacity evidence the serving front door routes on.  The
+# ``workload`` label on these series is the replica name (TPU_SERVE_NAME).
+SERVING_METRIC_PREFIX = "tpu_workload_serving_"
+
 _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 
 # exemplars kept per metric: enough to jump from a breach to a handful of
@@ -912,6 +917,67 @@ class FleetAggregator:
                         nodes.add(node)
         return len(nodes)
 
+    def serving_view(
+        self,
+        now: Optional[float] = None,
+        stale_after_s: Optional[float] = None,
+    ) -> dict[str, dict]:
+        """Per-replica serving rollups, freshness-stamped.
+
+        Groups the ``tpu_workload_serving_*`` push series by their
+        ``workload`` label (the replica name) and reports each replica's
+        NEWEST value per counter together with the newest push timestamp::
+
+            {"serve-fd-0": {"ts": 171.2, "age_s": 0.4, "fresh": True,
+                            "node": "tpu-3-1",
+                            "metrics": {"queue_depth": 2.0,
+                                        "kv_blocks_free": 61.0, ...}}}
+
+        ``fresh`` is the router's admission-evidence contract: evidence
+        older than ``stale_after_s`` (default ``FRONTDOOR_STALE_PUSHES``
+        push intervals) means the replica is UNKNOWN — a blackholed or
+        dead engine looks exactly like a quiet one from here, so the
+        router must route AWAY from it, never onto it.  The stamp is the
+        ingest-side receive time of the newest sample, not anything the
+        replica claims about itself: a wedged replica cannot forge
+        freshness."""
+        now = time.time() if now is None else now
+        if stale_after_s is None:
+            stale_after_s = (
+                consts.FRONTDOOR_STALE_PUSHES * consts.SERVE_PUSH_INTERVAL_SECONDS
+            )
+        view: dict[str, dict] = {}
+        with self._lock:
+            for metric, bucket in self._series.items():
+                if not metric.startswith(SERVING_METRIC_PREFIX):
+                    continue
+                short = metric[len(SERVING_METRIC_PREFIX):]
+                for labels_key, series in bucket.items():
+                    if not series.samples:
+                        continue
+                    labels = dict(labels_key)
+                    replica = labels.get("workload")
+                    if not replica:
+                        continue
+                    ts, value = (
+                        series.samples[-1]
+                        if series.ordered
+                        else max(series.samples)
+                    )
+                    entry = view.setdefault(
+                        replica, {"ts": 0.0, "node": "", "metrics": {}}
+                    )
+                    entry["metrics"][short] = value
+                    if ts > entry["ts"]:
+                        entry["ts"] = ts
+                        entry["node"] = labels.get("node", "")
+        for entry in view.values():
+            age = max(0.0, now - entry["ts"])
+            entry["ts"] = round(entry["ts"], 3)
+            entry["age_s"] = round(age, 3)
+            entry["fresh"] = age <= stale_after_s
+        return view
+
     def snapshot(
         self,
         windows: Iterable[float] = consts.FLEET_WINDOWS,
@@ -945,6 +1011,9 @@ class FleetAggregator:
             "join_phases": join_phases,
             "exemplars": exemplars,
             "slos": self.slo_engine.snapshot(),
+            # freshness-stamped per-replica serving capacity (the front
+            # door's routing evidence; docs/SERVING.md "Front door")
+            "serving": self.serving_view(now),
         }
 
     def export(

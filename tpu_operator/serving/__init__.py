@@ -1,0 +1,30 @@
+"""The serving front door (docs/SERVING.md "Front door").
+
+One logical endpoint over the PR-15 replica fleet: session-affine
+admission-aware routing off the pushed ``/debug/fleet`` capacity rollups,
+honest 429 shedding, a per-session retry budget with a single
+idempotent-prefill hedge, draining-replica handoff that follows the
+migration checkpoint, and SLO-burn-driven autoscaling through elastic
+``TPUSliceRequest`` grants.
+"""
+
+from tpu_operator.serving.autoscaler import AutoscaleConfig, ReplicaAutoscaler
+from tpu_operator.serving.frontdoor import (
+    FrontDoor,
+    FrontDoorConfig,
+    SessionTraffic,
+    build_app,
+)
+from tpu_operator.serving.replicas import LocalReplica, ReplicaGone, TokenEvent
+
+__all__ = [
+    "AutoscaleConfig",
+    "FrontDoor",
+    "FrontDoorConfig",
+    "LocalReplica",
+    "ReplicaAutoscaler",
+    "ReplicaGone",
+    "SessionTraffic",
+    "TokenEvent",
+    "build_app",
+]

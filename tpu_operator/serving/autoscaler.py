@@ -1,0 +1,103 @@
+"""Burn-driven replica autoscaling: the loop-closer.
+
+The PR-6 SLO engine already watches the pushed serving rollups and says
+WHEN the fleet is burning (`breached_slos()` on p99 TPOT burn rate);
+the front door already knows HOW LOADED each replica is (its routed
+queue depths).  :class:`ReplicaAutoscaler` folds both into one desired
+replica count with hysteresis — sustained burn or sustained queue
+pressure grows the fleet, sustained idleness shrinks it, and a cooldown
+keeps a restore/scale-up from immediately triggering the next verdict
+off its own transient.
+
+The desired count is actuated by ``controllers/servescaler.py`` as
+elastic ``TPUSliceRequest`` objects (guaranteed floor + reclaimable
+burst — PR-14 min/max grants, PR-18 preemption economy), NOT by this
+class: observe() is pure control law, deterministic from its inputs,
+and is unit-tested that way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class AutoscaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # how long pressure must be sustained before acting (transient spikes
+    # and single stale pushes must not thrash the fleet)
+    up_after_s: float = 2.0
+    down_after_s: float = 8.0
+    # minimum spacing between scaling verdicts in either direction
+    cooldown_s: float = 4.0
+    # mean routed queue depth at/below which the fleet is idle, and
+    # at/above which it is busy even without an SLO burn
+    idle_queue_depth: float = 0.5
+    busy_queue_depth: float = 6.0
+
+
+class ReplicaAutoscaler:
+    """Deterministic control law: feed it (now, ready, mean queue depth,
+    burning?) each evaluation tick; it returns the desired replica count.
+
+    ``burning`` is the caller's reading of the SLO engine —
+    ``bool(fleet.slo_engine.breached_slos())`` filtered to the serving
+    SLOs — so this class stays import-light and trivially testable.
+    """
+
+    def __init__(self, cfg: Optional[AutoscaleConfig] = None):
+        self.cfg = cfg or AutoscaleConfig()
+        self.desired = self.cfg.min_replicas
+        self._busy_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_change: float = float("-inf")
+
+    def observe(
+        self,
+        now: float,
+        ready: int,
+        queue_depth_mean: float,
+        burning: bool,
+    ) -> int:
+        cfg = self.cfg
+        busy = burning or queue_depth_mean >= cfg.busy_queue_depth
+        idle = (
+            not burning
+            and queue_depth_mean <= cfg.idle_queue_depth
+            # never call an under-provisioned fleet idle: grants still
+            # materialising must not be shrunk out from under the ramp
+            and ready >= self.desired
+        )
+        if busy:
+            self._idle_since = None
+            if self._busy_since is None:
+                self._busy_since = now
+        elif idle:
+            self._busy_since = None
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._busy_since = None
+            self._idle_since = None
+        in_cooldown = now - self._last_change < cfg.cooldown_s
+        if (
+            self._busy_since is not None
+            and now - self._busy_since >= cfg.up_after_s
+            and not in_cooldown
+            and self.desired < cfg.max_replicas
+        ):
+            self.desired += 1
+            self._last_change = now
+            self._busy_since = now  # a further step needs fresh sustain
+        elif (
+            self._idle_since is not None
+            and now - self._idle_since >= cfg.down_after_s
+            and not in_cooldown
+            and self.desired > cfg.min_replicas
+        ):
+            self.desired -= 1
+            self._last_change = now
+            self._idle_since = now
+        return self.desired

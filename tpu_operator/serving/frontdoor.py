@@ -1,0 +1,874 @@
+"""Serving front door: one fault-tolerant endpoint over the replica fleet.
+
+The router tier ROADMAP item 2 asks for.  Clients see ONE logical
+endpoint; behind it a session-affine request stream is load-balanced
+across the PR-15 replica fleet entirely off the capacity evidence the
+fleet plane already carries — the pushed ``serving_kv_blocks_free`` /
+queue-depth rollups on ``/debug/fleet`` (``FleetAggregator.serving_view``),
+freshness-stamped so stale evidence means "replica unknown", never
+"replica fine".
+
+The contracts, in routing order:
+
+- **Affinity**: a session sticks to its bound replica while that replica
+  is fresh and under the admission ceiling (KV reuse, ordered streams).
+  New sessions spill onto the least-loaded fresh replica.
+- **Admission / shed**: when no fresh replica has queue headroom the
+  request is shed with an honest 429 + ``Retry-After`` — BEFORE a
+  replica queue blows its latency SLO, and never a silent drop.  Sheds
+  are counted apart from failures; the serve-fleet soak gates failures
+  at zero while sheds are allowed to breathe.
+- **Retry budget**: each session carries a replica-loss budget.  A dead
+  replica (SIGKILL, or capacity evidence stale past the dead bound — the
+  blackhole detector) costs one budget unit to re-place each of the
+  session's in-flight requests; an exhausted budget fails the request
+  honestly.  Token positions already delivered are deduped, so a retry
+  re-decodes but never re-bills.
+- **Single hedge, prefill only**: a request whose FIRST token is overdue
+  gets at most one hedge onto a second replica.  Prefill is idempotent —
+  nothing was delivered, nothing double-bills; the first source to
+  deliver wins and the loser is cancelled before it can decode on the
+  client's bill.  A request that has started decoding never hedges.
+- **Drain handoff**: when ``MigrationCoordinator.drain_pod`` checkpoints
+  a replica, the router parks that replica's sessions (new arrivals wait
+  at the router — latency, not errors), follows the checkpoint to the
+  restored replica, and replays exactly the in-flight requests the
+  snapshot does NOT contain, in arrival order.  Rids inside the
+  snapshot's schedule are never resubmitted; rids outside it are never
+  skipped.
+
+Autoscaling closes the loop in ``serving/autoscaler.py`` (burn-driven
+desired count) and ``controllers/servescaler.py`` (elastic
+``TPUSliceRequest`` reconciliation).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from tpu_operator import consts
+from tpu_operator.serving.replicas import LocalReplica, ReplicaGone, TokenEvent
+from tpu_operator.workloads.serving import PoissonTraffic, Request, _percentile
+
+# replica states as the router sees them (frontdoor_replicas gauge)
+READY = "ready"
+DRAINING = "draining"
+PARKED = "parked"
+UNKNOWN = "unknown"
+DEAD = "dead"
+REPLICA_STATES = (READY, DRAINING, PARKED, UNKNOWN, DEAD)
+
+# submit() verdicts
+ACCEPTED = "accepted"
+SHED = "shed"
+
+# routed outcomes
+ROUTE_STICKY = "sticky"
+ROUTE_SPILLOVER = "spillover"
+ROUTE_RETRY = "retry"
+ROUTE_REPLAY = "replay"
+
+
+@dataclass
+class FrontDoorConfig:
+    # replica-loss retries one session may spend before failing honestly
+    retry_budget: int = consts.FRONTDOOR_RETRY_BUDGET
+    # first-token deadline before the single idempotent-prefill hedge
+    hedge_after_s: float = consts.FRONTDOOR_HEDGE_AFTER_SECONDS
+    # capacity evidence older than this = replica UNKNOWN (route away)
+    stale_after_s: float = (
+        consts.FRONTDOOR_STALE_PUSHES * consts.SERVE_PUSH_INTERVAL_SECONDS
+    )
+    # UNKNOWN replica still holding in-flight work is declared DEAD after
+    # this long without a push (the blackhole detector)
+    dead_after_s: float = consts.FRONTDOOR_DEAD_AFTER_SECONDS
+    # per-replica admission ceiling: a routed queue depth at/above this
+    # sheds instead (set from the replica's SLO headroom, not its limits)
+    shed_queue_depth: float = 12.0
+    # Retry-After floor/ceiling on sheds
+    retry_after_min_s: float = 0.25
+    retry_after_max_s: float = 5.0
+    # estimated per-replica request drain rate backing the Retry-After
+    # hint (requests/s a healthy replica retires)
+    drain_rate_rps: float = 8.0
+
+
+@dataclass
+class _Replica:
+    name: str
+    handle: LocalReplica
+    node: str = ""
+    state: str = READY
+    # newest pushed capacity evidence (the ONLY routing input besides
+    # liveness — the router never peeks into a handle's engine)
+    evidence_ts: float = 0.0
+    queue_depth: float = 0.0
+    kv_blocks_free: float = 0.0
+    retiring: bool = False
+    ckpt_dir: str = ""
+    # rids the drain checkpoint carried (set while PARKED)
+    schedule: list = field(default_factory=list)
+
+
+@dataclass
+class _Session:
+    sid: str
+    replica: Optional[str] = None
+    retry_budget: int = 0
+
+
+@dataclass
+class _Track:
+    """One client request's lifetime at the endpoint."""
+
+    rid: str
+    sid: str
+    prompt: list
+    max_new_tokens: int
+    submitted_at: float
+    primary: Optional[str] = None     # replica currently decoding it
+    hedge: Optional[str] = None       # second replica while a hedge races
+    hedged: bool = False              # single-hedge-ever latch
+    pending: bool = False             # parked at the router (drain handoff)
+    delivered: int = 0                # generated positions billed so far
+    tokens: list = field(default_factory=list)
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    done: bool = False
+
+    @property
+    def decoding(self) -> bool:
+        return self.delivered > 0
+
+
+class FrontDoor:
+    """The router.  All public methods are thread-safe behind one lock —
+    the soak drives ticks from the bench loop while the migration mirror
+    drains from the asyncio side.  Time is always an explicit ``now``
+    (the repo's deterministic-clock idiom); nothing in here sleeps."""
+
+    def __init__(
+        self,
+        cfg: Optional[FrontDoorConfig] = None,
+        metrics=None,
+    ):
+        self.cfg = cfg or FrontDoorConfig()
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        self._replicas: dict[str, _Replica] = {}
+        self._sessions: dict[str, _Session] = {}
+        self._tracks: dict[str, _Track] = {}
+        self._completed: dict[str, _Track] = {}
+        self._failed: list[str] = []
+        # rids awaiting a replica (parked handoff or retry with no
+        # capacity), in arrival order — the replay schedule
+        self._waiting: list[str] = []
+        self._next_rid = 0
+        self.counts: dict[str, int] = {
+            "routed": 0, "shed": 0, "failed": 0, "completed": 0,
+            "retries": 0, "hedges_fired": 0, "hedges_won": 0,
+            "hedges_wasted": 0, "handoff_parked": 0, "handoff_restored": 0,
+            "handoff_replayed": 0, "tokens_billed": 0, "dup_tokens": 0,
+        }
+        self._ttft: list[float] = []
+        self._tpot: list[float] = []
+
+    # ------------------------------------------------------------------
+    # Fleet membership.
+
+    def add_replica(
+        self,
+        name: str,
+        handle: LocalReplica,
+        node: str = "",
+        now: Optional[float] = None,
+        ckpt_dir: str = "",
+    ) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            # a fresh replica has not pushed yet: grant it one staleness
+            # window of benefit of the doubt before UNKNOWN kicks in
+            self._replicas[name] = _Replica(
+                name=name, handle=handle, node=node,
+                evidence_ts=now, ckpt_dir=ckpt_dir,
+                kv_blocks_free=float(handle.cfg.num_blocks),
+            )
+
+    def retire_replica(self, name: str) -> None:
+        """Graceful scale-down: stop routing new work; the replica leaves
+        once its in-flight work completes (checked each tick)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                rep.retiring = True
+
+    def replica_states(self) -> dict[str, str]:
+        with self._lock:
+            return {name: rep.state for name, rep in self._replicas.items()}
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for rep in self._replicas.values()
+                if rep.state == READY and not rep.retiring
+            )
+
+    # ------------------------------------------------------------------
+    # Capacity evidence (satellite: freshness-stamped serving_view).
+
+    def observe_fleet(self, view: dict, now: Optional[float] = None) -> None:
+        """Ingest ``FleetAggregator.serving_view()`` (or the ``serving``
+        key of ``/debug/fleet``): newest per-replica capacity + freshness.
+        Stale evidence does NOT update the routing numbers — it ages the
+        replica toward UNKNOWN instead."""
+        now = time.time() if now is None else now
+        with self._lock:
+            for name, entry in (view or {}).items():
+                rep = self._replicas.get(name)
+                if rep is None:
+                    continue
+                ts = float(entry.get("ts") or 0.0)
+                if ts <= rep.evidence_ts and not entry.get("fresh", True):
+                    continue
+                rep.evidence_ts = max(rep.evidence_ts, ts)
+                metrics = entry.get("metrics") or {}
+                if "queue_depth" in metrics:
+                    rep.queue_depth = float(metrics["queue_depth"])
+                if "kv_blocks_free" in metrics:
+                    rep.kv_blocks_free = float(metrics["kv_blocks_free"])
+            self._refresh_states(now)
+
+    def _refresh_states(self, now: float) -> None:
+        for rep in self._replicas.values():
+            if rep.state in (DRAINING, PARKED, DEAD):
+                continue
+            if not rep.handle.alive:
+                continue  # tick's dead-scan owns the DEAD transition
+            age = now - rep.evidence_ts
+            if age > self.cfg.stale_after_s:
+                rep.state = UNKNOWN
+            else:
+                rep.state = READY
+
+    def _eligible(self, now: float, exclude: tuple = ()) -> list[_Replica]:
+        """Fresh, live, non-retiring replicas — the only routing targets.
+        UNKNOWN is excluded by construction: stale evidence must mean
+        'route away', not 'assume the last numbers still hold'."""
+        out = []
+        for rep in self._replicas.values():
+            if rep.name in exclude or rep.retiring:
+                continue
+            if rep.state != READY or not rep.handle.alive:
+                continue
+            if now - rep.evidence_ts > self.cfg.stale_after_s:
+                continue
+            out.append(rep)
+        return out
+
+    # ------------------------------------------------------------------
+    # The endpoint.
+
+    def submit(
+        self,
+        sid: str,
+        prompt: list,
+        max_new_tokens: int,
+        now: Optional[float] = None,
+        rid: Optional[str] = None,
+    ) -> dict:
+        """Route one request.  Returns ``{"status": "accepted", "rid"}``
+        or ``{"status": "shed", "retry_after_s"}`` — never an exception,
+        never a silent drop."""
+        now = time.time() if now is None else now
+        with self._lock:
+            session = self._sessions.get(sid)
+            if session is None:
+                session = self._sessions[sid] = _Session(
+                    sid=sid, retry_budget=self.cfg.retry_budget
+                )
+            if rid is None:
+                rid = f"rid-{self._next_rid}"
+                self._next_rid += 1
+            track = _Track(
+                rid=rid, sid=sid, prompt=list(prompt),
+                max_new_tokens=int(max_new_tokens), submitted_at=now,
+            )
+            # a session whose replica is mid-handoff parks new arrivals at
+            # the router: the client sees latency, not an error
+            bound = (
+                self._replicas.get(session.replica)
+                if session.replica else None
+            )
+            if bound is not None and bound.state in (DRAINING, PARKED):
+                track.pending = True
+                track.primary = bound.name
+                self._tracks[rid] = track
+                self._waiting.append(rid)
+                return {"status": ACCEPTED, "rid": rid, "parked": True}
+            target, outcome = self._pick(session, now)
+            if target is None:
+                retry_after = self._retry_after(now)
+                self.counts["shed"] += 1
+                if self.metrics is not None:
+                    self.metrics.frontdoor_shed_total.inc()
+                return {"status": SHED, "retry_after_s": retry_after}
+            self._place(track, target, now, outcome)
+            session.replica = target.name
+            self._tracks[rid] = track
+            return {"status": ACCEPTED, "rid": rid}
+
+    def _pick(
+        self, session: _Session, now: float, exclude: tuple = ()
+    ) -> tuple[Optional[_Replica], str]:
+        eligible = self._eligible(now, exclude=exclude)
+        under = [
+            r for r in eligible if r.queue_depth < self.cfg.shed_queue_depth
+        ]
+        if not under:
+            return None, ""
+        bound = session.replica
+        for rep in under:
+            if rep.name == bound:
+                return rep, ROUTE_STICKY
+        # spillover: emptiest queue first, most free KV as the tiebreak
+        under.sort(key=lambda r: (r.queue_depth, -r.kv_blocks_free, r.name))
+        return under[0], ROUTE_SPILLOVER
+
+    def _place(
+        self, track: _Track, rep: _Replica, now: float, outcome: str
+    ) -> None:
+        req = Request(
+            rid=track.rid, prompt=list(track.prompt),
+            max_new_tokens=track.max_new_tokens, arrival=now,
+        )
+        rep.handle.submit(req)
+        track.primary = rep.name
+        track.pending = False
+        # optimistic local bump so a burst between pushes spreads out
+        # instead of piling onto the replica whose evidence looked emptiest
+        rep.queue_depth += 1.0
+        self.counts["routed"] += 1
+        if self.metrics is not None:
+            self.metrics.frontdoor_routed_total.labels(outcome=outcome).inc()
+
+    def _retry_after(self, now: float) -> float:
+        """An honest hint: how long until the least-backed-up replica
+        drains back under the admission ceiling."""
+        depths = [
+            rep.queue_depth for rep in self._replicas.values()
+            if rep.state == READY and rep.handle.alive
+        ]
+        if not depths:
+            return self.cfg.retry_after_max_s
+        over = max(0.0, min(depths) - self.cfg.shed_queue_depth + 1.0)
+        est = over / max(self.cfg.drain_rate_rps, 1e-6)
+        return round(
+            min(
+                max(est, self.cfg.retry_after_min_s),
+                self.cfg.retry_after_max_s,
+            ), 3,
+        )
+
+    # ------------------------------------------------------------------
+    # The tick: step local replicas, collect tokens, hedge, detect loss.
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._refresh_states(now)
+            for rep in list(self._replicas.values()):
+                if rep.state in (PARKED, DEAD):
+                    continue
+                rep.handle.step(now)
+                events, _finished = rep.handle.poll(now)
+                self._on_tokens(rep, events, now)
+            self._hedge_scan(now)
+            self._dead_scan(now)
+            self._drain_waiting(now)
+            self._reap_retired()
+            if self.metrics is not None:
+                self._export()
+            return {
+                "now": now,
+                "live_tracks": len(self._tracks),
+                "waiting": len(self._waiting),
+                "ready": self.ready_count(),
+            }
+
+    def _on_tokens(
+        self, rep: _Replica, events: list[TokenEvent], now: float
+    ) -> None:
+        for ev in events:
+            track = self._tracks.get(ev.rid)
+            if track is None or track.done:
+                continue  # cancelled or already completed elsewhere
+            if rep.name not in (track.primary, track.hedge):
+                continue  # a detached loser still flushing
+            if ev.position < track.delivered:
+                # an already-billed position (hedge loser, retry replay,
+                # post-restore overlap): discarded, never re-billed
+                self.counts["dup_tokens"] += 1
+                if self.metrics is not None:
+                    self.metrics.frontdoor_dup_tokens_total.inc()
+                continue
+            if track.hedge is not None:
+                # first delivery settles the race: the other source is
+                # cancelled while the request is still on ITS prefill —
+                # decode only ever runs (and bills) on the winner
+                winner, loser = (
+                    (track.primary, track.hedge)
+                    if rep.name == track.primary
+                    else (track.hedge, track.primary)
+                )
+                self._cancel_on(loser, track.rid)
+                won = winner == track.hedge
+                self.counts["hedges_won" if won else "hedges_wasted"] += 1
+                if self.metrics is not None:
+                    self.metrics.frontdoor_hedges_total.labels(
+                        outcome="won" if won else "wasted"
+                    ).inc()
+                track.primary = winner
+                track.hedge = None
+                self._sessions[track.sid].replica = winner
+            track.delivered += 1
+            track.tokens.append(ev.token)
+            self.counts["tokens_billed"] += 1
+            if self.metrics is not None:
+                self.metrics.frontdoor_tokens_billed_total.inc()
+            if track.first_token_at is None:
+                track.first_token_at = ev.ts
+                ttft = ev.ts - track.submitted_at
+                self._ttft.append(ttft)
+                if self.metrics is not None:
+                    self.metrics.frontdoor_ttft_seconds.observe(max(ttft, 0.0))
+            else:
+                tpot = ev.ts - track.last_token_at
+                self._tpot.append(tpot)
+                if self.metrics is not None:
+                    self.metrics.frontdoor_tpot_seconds.observe(max(tpot, 0.0))
+            track.last_token_at = ev.ts
+            if track.delivered >= track.max_new_tokens:
+                self._complete(track)
+
+    def _cancel_on(self, name: Optional[str], rid: str) -> None:
+        rep = self._replicas.get(name or "")
+        if rep is not None and rep.handle.alive:
+            rep.handle.cancel(rid)
+
+    def _complete(self, track: _Track) -> None:
+        track.done = True
+        self._tracks.pop(track.rid, None)
+        self._completed[track.rid] = track
+        self.counts["completed"] += 1
+
+    def _fail(self, track: _Track) -> None:
+        track.done = True
+        self._tracks.pop(track.rid, None)
+        self._failed.append(track.rid)
+        self.counts["failed"] += 1
+        if self.metrics is not None:
+            self.metrics.frontdoor_failed_total.inc()
+
+    def _hedge_scan(self, now: float) -> None:
+        for track in list(self._tracks.values()):
+            if (
+                track.done or track.pending or track.hedged
+                or track.decoding
+                or now - track.submitted_at < self.cfg.hedge_after_s
+            ):
+                continue
+            target, _ = self._pick(
+                self._sessions[track.sid], now,
+                exclude=(track.primary or "",),
+            )
+            track.hedged = True  # one attempt ever, placed or not
+            if target is None:
+                continue
+            req = Request(
+                rid=track.rid, prompt=list(track.prompt),
+                max_new_tokens=track.max_new_tokens, arrival=now,
+            )
+            try:
+                target.handle.submit(req)
+            except ReplicaGone:
+                continue
+            track.hedge = target.name
+            target.queue_depth += 1.0
+            self.counts["hedges_fired"] += 1
+            if self.metrics is not None:
+                self.metrics.frontdoor_hedges_total.labels(
+                    outcome="fired"
+                ).inc()
+
+    def _dead_scan(self, now: float) -> None:
+        for rep in list(self._replicas.values()):
+            if rep.state in (PARKED, DEAD, DRAINING):
+                continue
+            evidence_age = now - rep.evidence_ts
+            if not rep.handle.alive:
+                rep.state = DEAD
+            elif evidence_age > self.cfg.dead_after_s and self._has_work(rep):
+                # a blackhole: accepting connections, pushing nothing —
+                # only the freshness trail convicts it
+                rep.state = DEAD
+            else:
+                continue
+            self._evacuate(rep, now)
+
+    def _has_work(self, rep: _Replica) -> bool:
+        return any(
+            rep.name in (t.primary, t.hedge)
+            for t in self._tracks.values()
+            if not t.pending
+        )
+
+    def _evacuate(self, rep: _Replica, now: float) -> None:
+        """Re-place every in-flight request of a DEAD replica.  A live
+        hedge partner absorbs the loss for free — the race just lost a
+        contender.  Everything else charges the session's retry budget
+        ONCE per loss event: a session's requests all rode the same
+        replica (that is what affinity means), so one crash is one
+        strike, however many requests were in flight."""
+        orphans: dict[str, list[_Track]] = {}
+        for track in list(self._tracks.values()):
+            if track.done or track.pending:
+                continue
+            if rep.name not in (track.primary, track.hedge):
+                continue
+            survivor = (
+                track.hedge if track.primary == rep.name else track.primary
+            )
+            if track.hedge is not None and survivor is not None:
+                other = self._replicas.get(survivor)
+                if other is not None and other.handle.alive:
+                    track.primary = survivor
+                    track.hedge = None
+                    self._sessions[track.sid].replica = survivor
+                    continue
+                track.hedge = None
+            orphans.setdefault(track.sid, []).append(track)
+        for sid, tracks in orphans.items():
+            session = self._sessions[sid]
+            if session.retry_budget <= 0:
+                for track in tracks:
+                    self._fail(track)
+                continue
+            session.retry_budget -= 1
+            self.counts["retries"] += 1
+            for track in tracks:
+                self._reroute(track, session, now, lost=rep.name)
+
+    def _reroute(
+        self, track: _Track, session: _Session, now: float, lost: str
+    ) -> None:
+        target, _ = self._pick(session, now, exclude=(lost,))
+        if target is None:
+            # no capacity right now: wait at the router, re-placed by
+            # _drain_waiting once a replica frees up — latency, not loss
+            track.primary = None
+            track.hedge = None
+            track.pending = True
+            self._waiting.append(track.rid)
+            return
+        track.hedge = None
+        self._place(track, target, now, ROUTE_RETRY)
+        session.replica = target.name
+
+    def _drain_waiting(self, now: float) -> None:
+        """Re-place router-parked work (retry backlog whose replicas were
+        full, or drain-parked arrivals whose replica DIED instead of
+        restoring).  Handoff-parked tracks stay put while their replica is
+        DRAINING/PARKED — restore_replica replays those."""
+        still: list[str] = []
+        for rid in self._waiting:
+            track = self._tracks.get(rid)
+            if track is None or track.done:
+                continue
+            bound = self._replicas.get(track.primary or "")
+            if bound is not None and bound.state in (DRAINING, PARKED):
+                still.append(rid)  # the handoff owns this one
+                continue
+            session = self._sessions[track.sid]
+            target, _ = self._pick(session, now)
+            if target is None:
+                still.append(rid)
+                continue
+            self._place(track, target, now, ROUTE_RETRY)
+            session.replica = target.name
+        self._waiting = still
+
+    def _reap_retired(self) -> None:
+        for name, rep in list(self._replicas.items()):
+            if not rep.retiring:
+                continue
+            busy = any(
+                name in (t.primary, t.hedge)
+                for t in self._tracks.values()
+            )
+            if not busy:
+                del self._replicas[name]
+
+    # ------------------------------------------------------------------
+    # Drain handoff (MigrationCoordinator.drain_pod follows this exactly:
+    # drain_replica() IS the pod's "checkpoint complete" — the fake
+    # kubelet reports Succeeded once it returns — and restore_replica()
+    # is the restore pod's startup).
+
+    def drain_replica(
+        self, name: str, ckpt_dir: str = "", now: Optional[float] = None
+    ) -> list[str]:
+        """Checkpoint ``name`` for a drain: final token sweep, park its
+        sessions, snapshot engine + schedule.  Returns the schedule (the
+        rids riding inside the snapshot)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            rep = self._replicas[name]
+            ckpt_dir = ckpt_dir or rep.ckpt_dir
+            rep.state = DRAINING
+            # final sweep: everything decoded up to the checkpoint cut is
+            # delivered BEFORE the snapshot, so restore-side re-announce
+            # dedup starts from a consistent count
+            events, _ = rep.handle.poll(now)
+            self._on_tokens(rep, events, now)
+            sessions = {
+                t.sid for t in self._tracks.values()
+                if name in (t.primary, t.hedge)
+            }
+            schedule = rep.handle.checkpoint(
+                ckpt_dir,
+                # drained_at marks the checkpoint cut: the restored
+                # replica rebases in-flight timing past the pause
+                extra={"sessions": sorted(sessions), "drained_at": now},
+            )
+            rep.schedule = list(schedule)
+            rep.ckpt_dir = ckpt_dir
+            rep.state = PARKED
+            # in-flight work parks with its sessions; a racing hedge pair
+            # collapses to the parked side deterministically
+            for track in self._tracks.values():
+                if track.done:
+                    continue
+                if name in (track.primary, track.hedge):
+                    if track.hedge is not None:
+                        other = (
+                            track.primary
+                            if track.hedge == name else track.hedge
+                        )
+                        self._cancel_on(other, track.rid)
+                        track.hedge = None
+                    track.primary = name
+            self.counts["handoff_parked"] += len(sessions)
+            if self.metrics is not None and sessions:
+                self.metrics.frontdoor_handoffs_total.labels(
+                    outcome="parked"
+                ).inc(len(sessions))
+            return schedule
+
+    def restore_replica(
+        self,
+        name: str,
+        handle: LocalReplica,
+        node: str = "",
+        now: Optional[float] = None,
+    ) -> dict:
+        """Attach the restored replica and replay the handoff backlog.
+
+        The snapshot's schedule resumes INSIDE the restored engine at its
+        exact request-schedule position — those rids are only re-tracked,
+        never resubmitted.  Everything else the router holds for this
+        replica (arrivals parked mid-drain) is replayed in arrival order.
+        """
+        now = time.time() if now is None else now
+        with self._lock:
+            rep = self._replicas[name]
+            in_snapshot = set(rep.schedule)
+            rep.handle = handle
+            rep.node = node or rep.node
+            rep.state = READY
+            rep.evidence_ts = now  # restore grace, like add_replica
+            rep.queue_depth = 0.0
+            replayed = 0
+            still: list[str] = []
+            for rid in self._waiting:
+                track = self._tracks.get(rid)
+                if track is None or track.done or track.primary != name:
+                    still.append(rid)
+                    continue
+                if rid in in_snapshot:
+                    # already riding the snapshot: resubmitting would
+                    # duplicate it at the engine — the no-dup contract
+                    track.pending = False
+                    continue
+                self._place(track, rep, now, ROUTE_REPLAY)
+                replayed += 1
+            self._waiting = still
+            rep.schedule = []
+            self.counts["handoff_restored"] += 1
+            self.counts["handoff_replayed"] += replayed
+            if self.metrics is not None:
+                self.metrics.frontdoor_handoffs_total.labels(
+                    outcome="restored"
+                ).inc()
+                if replayed:
+                    self.metrics.frontdoor_handoffs_total.labels(
+                        outcome="replayed"
+                    ).inc(replayed)
+            return {"replayed": replayed, "resumed": len(in_snapshot)}
+
+    # ------------------------------------------------------------------
+    # Introspection.
+
+    def _export(self) -> None:
+        states = {s: 0 for s in REPLICA_STATES}
+        for rep in self._replicas.values():
+            states[rep.state] = states.get(rep.state, 0) + 1
+        for state, n in states.items():
+            self.metrics.frontdoor_replicas.labels(state=state).set(n)
+        self.metrics.frontdoor_sessions.set(len(self._sessions))
+
+    def result(self, rid: str) -> Optional[dict]:
+        with self._lock:
+            track = self._completed.get(rid) or self._tracks.get(rid)
+            if track is None:
+                state = "failed" if rid in self._failed else "unknown"
+                return {"rid": rid, "state": state} if state != "unknown" else None
+            return {
+                "rid": rid,
+                "state": "done" if track.done else (
+                    "parked" if track.pending else "running"
+                ),
+                "delivered": track.delivered,
+                "tokens": list(track.tokens),
+            }
+
+    def mean_queue_depth(self) -> float:
+        with self._lock:
+            ready = [
+                rep.queue_depth for rep in self._replicas.values()
+                if rep.state == READY and not rep.retiring
+            ]
+            return sum(ready) / len(ready) if ready else 0.0
+
+    def stats(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            states = {s: 0 for s in REPLICA_STATES}
+            for rep in self._replicas.values():
+                states[rep.state] += 1
+            return {
+                "ts": round(now, 3),
+                "replicas": states,
+                "replica_names": {
+                    name: {
+                        "state": rep.state, "node": rep.node,
+                        "queue_depth": rep.queue_depth,
+                        "evidence_age_s": round(now - rep.evidence_ts, 3),
+                        "retiring": rep.retiring,
+                    }
+                    for name, rep in self._replicas.items()
+                },
+                "sessions": len(self._sessions),
+                "live_requests": len(self._tracks),
+                "waiting": len(self._waiting),
+                "counts": dict(self.counts),
+                "failed_rids": list(self._failed),
+                "ttft_p99_s": round(_percentile(sorted(self._ttft), 0.99), 6),
+                "tpot_p99_s": round(_percentile(sorted(self._tpot), 0.99), 6),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Session-affine traffic: the open-loop stream the soak pours at the door.
+
+
+class SessionTraffic:
+    """Wraps :class:`PoissonTraffic` with a seeded session assignment —
+    the same deterministic schedule contract (rate, arrival cursor, rng
+    bit state), plus each request draws one of ``n_sessions`` session
+    ids.  ``rate`` is mutable mid-stream: the ramp profile just sets it."""
+
+    def __init__(
+        self,
+        rate: float,
+        n_sessions: int = 16,
+        prompt_tokens: tuple = (24, 64),
+        new_tokens: tuple = (12, 32),
+        seed: int = 0,
+        prefix: str = "fd",
+    ):
+        self.traffic = PoissonTraffic(
+            rate, prompt_tokens=prompt_tokens, new_tokens=new_tokens,
+            seed=seed, prefix=prefix,
+        )
+        self.n_sessions = n_sessions
+        self._srng = np.random.default_rng(seed + 1)
+
+    @property
+    def rate(self) -> float:
+        return self.traffic.rate
+
+    @rate.setter
+    def rate(self, value: float) -> None:
+        self.traffic.rate = value
+
+    def due(self, now: float) -> list[tuple[str, Request]]:
+        return [
+            (f"s{int(self._srng.integers(0, self.n_sessions))}", req)
+            for req in self.traffic.due(now)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# The HTTP face: one logical endpoint.
+
+
+def build_app(fd: FrontDoor):
+    """aiohttp application exposing the front door:
+
+    - ``POST /v1/generate`` ``{"session", "prompt", "max_new_tokens"}`` →
+      202 ``{"rid"}`` or 429 with a ``Retry-After`` header
+    - ``GET /v1/result/{rid}`` → request state + delivered tokens
+    - ``GET /debug/frontdoor`` → router stats
+    - ``GET /healthz``
+    """
+    from aiohttp import web
+
+    async def generate(request):
+        try:
+            body = await request.json()
+            sid = str(body["session"])
+            prompt = [int(t) for t in body["prompt"]]
+            max_new = int(body.get("max_new_tokens") or 16)
+        except (KeyError, TypeError, ValueError):
+            return web.json_response({"error": "bad request"}, status=400)
+        verdict = fd.submit(sid, prompt, max_new)
+        if verdict["status"] == SHED:
+            return web.json_response(
+                verdict, status=429,
+                headers={"Retry-After": f"{verdict['retry_after_s']:g}"},
+            )
+        return web.json_response(verdict, status=202)
+
+    async def result(request):
+        out = fd.result(request.match_info["rid"])
+        if out is None:
+            return web.json_response({"error": "unknown rid"}, status=404)
+        return web.json_response(out)
+
+    async def debug(request):
+        return web.json_response(fd.stats())
+
+    async def healthz(request):
+        return web.json_response({"ok": True, "ready": fd.ready_count()})
+
+    app = web.Application()
+    app.router.add_post("/v1/generate", generate)
+    app.router.add_get("/v1/result/{rid}", result)
+    app.router.add_get("/debug/frontdoor", debug)
+    app.router.add_get("/healthz", healthz)
+    return app

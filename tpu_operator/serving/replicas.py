@@ -1,0 +1,229 @@
+"""Replica handles: the engines the front door routes onto.
+
+A :class:`LocalReplica` wraps one PR-15 :class:`ServingEngine` behind the
+narrow surface the router needs — submit / cancel / step / poll /
+telemetry — plus the two seams everything fault-tolerant about the front
+door is tested through:
+
+- ``kill()``: the SIGKILL story.  Engine state (KV cache, batch, queue)
+  is gone with no checkpoint; any further call raises
+  :class:`ReplicaGone`.  Only the router's session retry budget brings
+  the in-flight work back.
+- ``blackhole()``: the failure mode a liveness probe misses.  The
+  replica keeps ACCEPTING submissions but never steps, never emits a
+  token, and never reports telemetry again — so its pushed capacity
+  evidence goes stale and the router's freshness rule (obs/fleet
+  ``serving_view``) is the only detector.
+
+``checkpoint()`` / ``restore()`` ride the PR-8 checkpoint machinery
+(atomic manifest-last snapshots, hash-verified restore): the engine's
+full snapshot plus a ``frontdoor`` extra carrying the in-flight request
+SCHEDULE — the ordered rids inside the snapshot — which is the
+no-duplicate/no-skip contract the router's drain handoff replays
+against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator.workloads import checkpoint as ckpt_api
+from tpu_operator.workloads.serving import (
+    DONE,
+    Request,
+    ServeConfig,
+    ServingEngine,
+    ServingError,
+)
+
+
+class ReplicaGone(Exception):
+    """The replica's process is dead; nothing on it can be reached."""
+
+
+@dataclass(frozen=True)
+class TokenEvent:
+    """One decoded token surfaced to the router.
+
+    ``position`` is the generated-token index (0-based, prompt excluded):
+    the dedup/billing key.  Two sources (a hedge pair, a pre- and
+    post-restore engine) emitting the same ``(rid, position)`` must bill
+    once — the model is deterministic greedy decode, so the token VALUES
+    agree and the router only has to count positions.
+    """
+
+    rid: str
+    position: int
+    token: int
+    ts: float
+
+
+class LocalReplica:
+    """One in-process serving replica (the soak's fleet unit).
+
+    All calls arrive from the front door under its lock — the handle
+    itself keeps no lock.  ``_tracked`` holds live references to the
+    engine's own :class:`Request` objects; the engine mutates them in
+    place, so :meth:`poll` surfaces new tokens by diffing each request's
+    generated count against what was already reported.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        cfg: Optional[ServeConfig] = None,
+        node: str = "",
+        engine: Optional[ServingEngine] = None,
+    ):
+        self.name = name
+        self.cfg = cfg or ServeConfig(name=name)
+        self.node = node
+        self.engine = engine if engine is not None else ServingEngine(self.cfg)
+        self.alive = True
+        self.blackholed = False
+        # rid -> live engine Request; rid -> generated tokens already polled
+        self._tracked: dict[str, Request] = {}
+        self._reported: dict[str, int] = {}
+        # submissions swallowed while blackholed (accepted, never served)
+        self.swallowed: list[str] = []
+        # checkpoint cut time awaiting the first post-restore step: the
+        # drain→restore pause is not service time (the subprocess serve
+        # loop runs on elapsed service time and never sees it; a
+        # wall-clock caller must rebase instead)
+        self._rebase_from: Optional[float] = None
+
+    # -- the router-facing surface -------------------------------------
+    def submit(self, req: Request) -> bool:
+        if not self.alive:
+            raise ReplicaGone(self.name)
+        if self.blackholed:
+            # connection accepted, request swallowed: the blackhole
+            # contract — the caller sees success and waits forever
+            self.swallowed.append(req.rid)
+            return True
+        ok = self.engine.submit(req)
+        if ok:
+            self._tracked[req.rid] = req
+            self._reported.setdefault(req.rid, 0)
+        return ok
+
+    def cancel(self, rid: str) -> bool:
+        if not self.alive or self.blackholed:
+            return False
+        self._tracked.pop(rid, None)
+        self._reported.pop(rid, None)
+        return self.engine.cancel(rid)
+
+    def step(self, now: float) -> Optional[dict]:
+        """One engine iteration; None when dead or blackholed (a black
+        hole makes no progress — that is the point)."""
+        if not self.alive or self.blackholed:
+            return None
+        if self._rebase_from is not None:
+            pause = now - self._rebase_from
+            self._rebase_from = None
+            if pause > 0:
+                # shift in-flight timing past the handoff gap so TPOT
+                # and TTFT keep measuring decode latency, not the
+                # migration pause (which handoff metrics already count)
+                for req in (*self.engine.queued, *self.engine.prefilling,
+                            *self.engine.running):
+                    if req.last_token_at is not None:
+                        req.last_token_at += pause
+                    if req.first_token_at is None:
+                        req.arrival += pause
+        return self.engine.step(now)
+
+    def poll(self, now: float) -> tuple[list[TokenEvent], list[str]]:
+        """(new token events since last poll, rids that finished)."""
+        events: list[TokenEvent] = []
+        finished: list[str] = []
+        if not self.alive or self.blackholed:
+            return events, finished
+        for rid, req in list(self._tracked.items()):
+            seen = self._reported.get(rid, 0)
+            gen = req.generated
+            base = len(req.prompt)
+            for pos in range(seen, gen):
+                events.append(TokenEvent(rid, pos, req.tokens[base + pos], now))
+            if gen > seen:
+                self._reported[rid] = gen
+            if req.state == DONE:
+                finished.append(rid)
+                del self._tracked[rid]
+                self._reported.pop(rid, None)
+        return events, finished
+
+    def telemetry(self, now: float) -> Optional[dict]:
+        """The ``serve_*`` capacity evidence the push hop forwards; None
+        when dead or blackholed — the push simply stops, the fleet-side
+        freshness stamp ages out, and the router routes away."""
+        if not self.alive or self.blackholed:
+            return None
+        return self.engine.telemetry(now)
+
+    @property
+    def inflight(self) -> int:
+        return len(self._tracked)
+
+    # -- chaos seams ---------------------------------------------------
+    def kill(self) -> None:
+        """SIGKILL: all engine state is gone, no checkpoint, no goodbye."""
+        self.alive = False
+        self.engine = None  # type: ignore[assignment]
+        self._tracked.clear()
+        self._reported.clear()
+
+    def blackhole(self, on: bool = True) -> None:
+        self.blackholed = on
+
+    # -- drain / restore (the PR-8 migration contract) -----------------
+    def checkpoint(self, ckpt_dir: str, extra: Optional[dict] = None) -> list[str]:
+        """Full-state snapshot for a drain; returns the SCHEDULE — the
+        in-flight rids inside the snapshot, in the engine's queue order
+        (queued → prefilling → running).  The restored engine resumes
+        exactly these; anything the router holds beyond them must be
+        replayed, anything on this list must NOT be."""
+        if not self.alive or self.blackholed:
+            raise ReplicaGone(self.name)
+        arrays, eng_extra = self.engine.snapshot()
+        schedule = [entry["rid"] for entry in eng_extra["requests"]]
+        eng_extra["frontdoor"] = {
+            **(extra or {}),
+            "replica": self.name,
+            "schedule": schedule,
+        }
+        ckpt_api.save_checkpoint(
+            ckpt_dir, step=self.engine.steps, arrays=arrays, extra=eng_extra
+        )
+        return schedule
+
+    @classmethod
+    def restore(
+        cls,
+        name: str,
+        cfg: ServeConfig,
+        ckpt_dir: str,
+        node: str = "",
+    ) -> tuple["LocalReplica", dict]:
+        """(restored replica, the checkpoint's ``frontdoor`` extra).
+
+        Every snapshot request re-registers as tracked with its generated
+        count marked already-reported: those tokens were delivered by the
+        pre-drain replica, and the router's position dedup absorbs any
+        overlap regardless.
+        """
+        snap = ckpt_api.load_checkpoint(ckpt_dir)
+        if snap is None:
+            raise ServingError(f"no restorable checkpoint in {ckpt_dir}")
+        engine = ServingEngine.from_snapshot(cfg, snap.arrays, snap.extra)
+        replica = cls(name, cfg, node=node, engine=engine)
+        for req in (*engine.queued, *engine.prefilling, *engine.running):
+            replica._tracked[req.rid] = req
+            replica._reported[req.rid] = req.generated
+        fd_extra = dict(snap.extra.get("frontdoor") or {})
+        drained_at = fd_extra.get("drained_at")
+        if drained_at is not None:
+            replica._rebase_from = float(drained_at)
+        return replica, fd_extra

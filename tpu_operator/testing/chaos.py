@@ -86,6 +86,16 @@ class ChaosConfig:
     pool_shock_down_s: float = 5.0
     pool_shock_prefix: str = ""       # "" = any pool is fair game
     pool_shock_reason: str = "pool-capacity-shock"
+    # serving front-door fleet actors (driven by the router soak at its
+    # tick cadence, judged once per ready replica per tick): SIGKILL drops
+    # a replica mid-decode with no checkpoint — every in-flight request
+    # must come back through the session retry budget; blackhole makes a
+    # replica accept submissions but never step or push telemetry — the
+    # freshness detector must starve it of traffic and the hedge/retry
+    # path must rescue what it swallowed.  Zero failed requests and no
+    # duplicate decode billing are the gates (tests/test_frontdoor_chaos).
+    replica_kill_rate: float = 0.0       # per ready replica per router tick
+    replica_blackhole_rate: float = 0.0  # per ready replica per router tick
     # checkpoint faults (workloads/checkpoint.py TPU_CKPT_FAULT contract;
     # applied to signal-triggered snapshots only): kill_during_checkpoint
     # SIGKILLs the worker after the shard files but before the manifest —
@@ -216,6 +226,30 @@ class ChaosEngine:
             return False
         if self.rng.random() < self.config.pod_crashloop_rate:
             self._count("pod_crash")
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    def should_kill_replica(self) -> bool:
+        """SIGKILL one serving replica mid-decode: engine state (KV cache,
+        batch, queue) is gone with NO checkpoint — the front door's
+        session retry budget is the only way its in-flight work survives."""
+        if not self.active or not self.config.replica_kill_rate:
+            return False
+        if self.rng.random() < self.config.replica_kill_rate:
+            self._count("replica_kill")
+            return True
+        return False
+
+    def should_blackhole_replica(self) -> bool:
+        """Blackhole one serving replica: it keeps ACCEPTING submissions
+        but never decodes another token and never pushes telemetry again —
+        the failure mode a liveness probe misses and only capacity-evidence
+        freshness catches."""
+        if not self.active or not self.config.replica_blackhole_rate:
+            return False
+        if self.rng.random() < self.config.replica_blackhole_rate:
+            self._count("replica_blackhole")
             return True
         return False
 

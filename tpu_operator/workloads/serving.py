@@ -486,6 +486,11 @@ class PoissonTraffic:
         return req
 
     def due(self, now: float) -> list[Request]:
+        if self.rate > 0 and self.next_at == float("inf"):
+            # stream re-enabled after a rate<=0 quiesce (or constructed
+            # quiesced against a wall-clock ``now``): restart the arrival
+            # schedule from the caller's clock, not from zero
+            self.next_at = now + self._gap()
         out = []
         while self.next_at <= now:
             out.append(self._mint(self.next_at))
